@@ -1,0 +1,108 @@
+"""happysim_tpu — a TPU-native discrete-event simulation framework.
+
+A ground-up rebuild of the capabilities of ``adamfilli/happy-simulator``
+(mounted read-only at /root/reference) with a two-executor architecture:
+
+1. A clean Python host executor (``core``) — fully general: generator
+   behaviors, SimFutures, the entire component library, interactive control.
+2. A JAX/XLA ensemble executor (``tpu``) — restricted simulations compile to
+   a single ``lax.scan`` program, ``vmap`` over thousands of Monte-Carlo
+   replicas and sharded over a ``jax.sharding.Mesh`` of TPU chips, with
+   ``psum``-reduced metrics. This is the native/compiled tier of the project.
+
+Layout (the task's models/ops/parallel/utils template, mapped to this
+domain): components/ ≈ models, tpu/+core/ ≈ ops, parallel/ = host parallel
+runtime, utils/ = utils.
+"""
+
+__version__ = "0.1.0"
+
+import logging
+
+logging.getLogger("happysim_tpu").addHandler(logging.NullHandler())
+
+from happysim_tpu.components import (
+    ConcurrencyModel,
+    Counter,
+    DynamicConcurrency,
+    FIFOQueue,
+    FixedConcurrency,
+    Grant,
+    LIFOQueue,
+    LatencyStats,
+    PriorityQueue,
+    Queue,
+    QueueDriver,
+    QueuePolicy,
+    QueuedResource,
+    RandomRouter,
+    Resource,
+    ResourceStats,
+    Server,
+    ServerStats,
+    Sink,
+    WeightedConcurrency,
+)
+from happysim_tpu.core import (
+    CallbackEntity,
+    Clock,
+    ConditionBreakpoint,
+    Duration,
+    Entity,
+    Event,
+    EventCountBreakpoint,
+    EventHeap,
+    EventTypeBreakpoint,
+    FixedSkew,
+    HLCTimestamp,
+    HybridLogicalClock,
+    Instant,
+    LamportClock,
+    LinearDrift,
+    MetricBreakpoint,
+    NodeClock,
+    NullEntity,
+    ProcessContinuation,
+    SimFuture,
+    Simulatable,
+    Simulation,
+    SimulationControl,
+    TimeBreakpoint,
+    VectorClock,
+    all_of,
+    any_of,
+    enable_event_tracing,
+    simulatable,
+)
+from happysim_tpu.distributions import (
+    ConstantLatency,
+    ExponentialLatency,
+    LatencyDistribution,
+    PercentileFittedLatency,
+    UniformDistribution,
+    UniformLatency,
+    ValueDistribution,
+    ZipfDistribution,
+)
+from happysim_tpu.instrumentation import (
+    BucketedData,
+    Data,
+    InMemoryTraceRecorder,
+    LatencyTracker,
+    NullTraceRecorder,
+    Probe,
+    SimulationSummary,
+    ThroughputTracker,
+)
+from happysim_tpu.load import (
+    ConstantArrivalTimeProvider,
+    ConstantRateProfile,
+    DistributedFieldProvider,
+    EventProvider,
+    LinearRampProfile,
+    PoissonArrivalTimeProvider,
+    Profile,
+    SimpleEventProvider,
+    Source,
+    SpikeProfile,
+)

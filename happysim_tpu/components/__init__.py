@@ -1,0 +1,51 @@
+"""Component library (the "models" tier of the rebuild).
+
+Grows sub-package by sub-package toward the reference's 22 packages
+(~150 classes); see SURVEY.md §2.4 for the inventory.
+"""
+
+from happysim_tpu.components.common import Counter, LatencyStats, Sink
+from happysim_tpu.components.queue import Queue
+from happysim_tpu.components.queue_driver import QueueDriver
+from happysim_tpu.components.queue_policy import (
+    FIFOQueue,
+    LIFOQueue,
+    PriorityQueue,
+    Prioritized,
+    QueuePolicy,
+)
+from happysim_tpu.components.queued_resource import QueuedResource
+from happysim_tpu.components.random_router import RandomRouter
+from happysim_tpu.components.resource import Grant, Resource, ResourceStats
+from happysim_tpu.components.server import (
+    ConcurrencyModel,
+    DynamicConcurrency,
+    FixedConcurrency,
+    Server,
+    ServerStats,
+    WeightedConcurrency,
+)
+
+__all__ = [
+    "ConcurrencyModel",
+    "Counter",
+    "DynamicConcurrency",
+    "FIFOQueue",
+    "FixedConcurrency",
+    "Grant",
+    "LIFOQueue",
+    "LatencyStats",
+    "Prioritized",
+    "PriorityQueue",
+    "Queue",
+    "QueueDriver",
+    "QueuePolicy",
+    "QueuedResource",
+    "RandomRouter",
+    "Resource",
+    "ResourceStats",
+    "Server",
+    "ServerStats",
+    "Sink",
+    "WeightedConcurrency",
+]

@@ -1,0 +1,74 @@
+"""Terminal and counting entities.
+
+Parity target: ``happysimulator/components/common.py`` (``Sink`` :18 with
+``latency_stats()`` :59, ``Counter`` :79).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.instrumentation.data import Data
+
+
+@dataclass(frozen=True)
+class LatencyStats:
+    count: int
+    mean_s: float
+    min_s: float
+    max_s: float
+    p50_s: float
+    p99_s: float
+
+
+class Sink(Entity):
+    """Absorbs events and records end-to-end latency from ``created_at``."""
+
+    def __init__(self, name: str = "Sink"):
+        super().__init__(name)
+        self.events_received = 0
+        self.completion_times: list[Instant] = []
+        self.latencies_s: list[float] = []
+        self._data = Data(f"{name}.latency_s")
+
+    def handle_event(self, event: Event):
+        self.events_received += 1
+        self.completion_times.append(event.time)
+        created_at = event.context.get("created_at")
+        if created_at is not None:
+            latency = (event.time - created_at).to_seconds()
+            self.latencies_s.append(latency)
+            self._data.add(event.time, latency)
+        return None
+
+    @property
+    def latency_data(self) -> Data:
+        return self._data
+
+    def latency_stats(self) -> LatencyStats:
+        data = self._data
+        return LatencyStats(
+            count=data.count(),
+            mean_s=data.mean(),
+            min_s=data.min(),
+            max_s=data.max(),
+            p50_s=data.percentile(50),
+            p99_s=data.percentile(99),
+        )
+
+
+class Counter(Entity):
+    """Counts events by type."""
+
+    def __init__(self, name: str = "Counter"):
+        super().__init__(name)
+        self.count = 0
+        self.counts_by_type: dict[str, int] = {}
+
+    def handle_event(self, event: Event):
+        self.count += 1
+        self.counts_by_type[event.event_type] = self.counts_by_type.get(event.event_type, 0) + 1
+        return None

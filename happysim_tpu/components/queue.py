@@ -1,0 +1,90 @@
+"""Bounded buffer with pull-based delivery.
+
+Parity target: ``happysimulator/components/queue.py`` (``Queue`` :75 and the
+poll/notify/deliver event protocol :23-51). A Queue buffers payload events;
+a driver polls it when the worker has capacity; delivery retargets the
+payload. The TPU executor collapses this protocol to a depth counter per
+replica — the host path keeps the composable form.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.instrumentation.summary import QueueStats
+
+if TYPE_CHECKING:
+    from happysim_tpu.components.queue_policy import QueuePolicy
+
+QUEUE_POLL = "Queue.poll"
+QUEUE_NOTIFY = "Queue.notify"
+QUEUE_DELIVER = "Queue.deliver"
+
+
+class Queue(Entity):
+    """Holds payload events under a :class:`QueuePolicy` until polled."""
+
+    def __init__(
+        self,
+        name: str = "Queue",
+        policy: "QueuePolicy | None" = None,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(name)
+        if policy is None:
+            from happysim_tpu.components.queue_policy import FIFOQueue
+
+            policy = FIFOQueue()
+        self.policy = policy
+        self.capacity = capacity
+        self.driver: Optional[Entity] = None
+        self.enqueued = 0
+        self.dequeued = 0
+        self.dropped = 0
+
+    # -- wiring ------------------------------------------------------------
+    def connect_driver(self, driver: Entity) -> None:
+        self.driver = driver
+
+    @property
+    def depth(self) -> int:
+        return len(self.policy)
+
+    def stats(self) -> QueueStats:
+        return QueueStats(
+            depth=self.depth,
+            enqueued=self.enqueued,
+            dequeued=self.dequeued,
+            dropped=self.dropped,
+        )
+
+    # -- event protocol ----------------------------------------------------
+    def handle_event(self, event: Event):
+        if event.event_type == QUEUE_POLL:
+            return self._handle_poll(event)
+        return self._handle_enqueue(event)
+
+    def _handle_enqueue(self, event: Event):
+        if self.capacity is not None and self.depth >= self.capacity:
+            self.dropped += 1
+            return None
+        was_empty = self.depth == 0
+        self.policy.push(event)
+        self.enqueued += 1
+        if was_empty and self.driver is not None:
+            return [Event(self.now, QUEUE_NOTIFY, target=self.driver)]
+        return None
+
+    def _handle_poll(self, event: Event):
+        if self.depth == 0 or self.driver is None:
+            return None
+        payload = self.policy.pop()
+        self.dequeued += 1
+        deliver = Event(self.now, QUEUE_DELIVER, target=self.driver)
+        deliver.context["payload"] = payload
+        return [deliver]
+
+    def downstream_entities(self):
+        return [self.driver] if self.driver is not None else []

@@ -1,0 +1,62 @@
+"""Queue↔worker mediation with back-pressure.
+
+Parity target: ``happysimulator/components/queue_driver.py:27`` — polls when
+the worker has capacity, retargets delivered payloads to the worker, and
+re-polls via a completion hook when the worker finishes (:78-90).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.components.queue import QUEUE_DELIVER, QUEUE_NOTIFY, QUEUE_POLL
+
+if TYPE_CHECKING:
+    from happysim_tpu.components.queue import Queue
+
+
+class QueueDriver(Entity):
+    """Pulls work from a Queue into a worker as capacity frees up."""
+
+    def __init__(self, name: str, queue: "Queue", worker: Entity):
+        super().__init__(name)
+        self.queue = queue
+        self.worker = worker
+        queue.connect_driver(self)
+
+    def handle_event(self, event: Event):
+        if event.event_type == QUEUE_NOTIFY:
+            return self._maybe_poll()
+        if event.event_type == QUEUE_DELIVER:
+            return self._handle_delivery(event)
+        return None
+
+    def _maybe_poll(self):
+        if self.worker.has_capacity():
+            return [Event(self.now, QUEUE_POLL, target=self.queue)]
+        return None
+
+    def _handle_delivery(self, event: Event):
+        payload: Event = event.context["payload"]
+        work = Event(
+            time=self.now,
+            event_type=payload.event_type,
+            target=self.worker,
+            daemon=payload.daemon,
+            context=payload.context,
+        )
+        work.on_complete.extend(payload.on_complete)
+        # When the worker finishes this item, pull the next one; multi-slot
+        # workers drain via the notify-per-enqueue path plus these hooks.
+        work.add_completion_hook(self._on_worker_done)
+        return [work]
+
+    def _on_worker_done(self, time) -> list[Event]:
+        if self.queue.depth > 0 and self.worker.has_capacity():
+            return [Event(time, QUEUE_POLL, target=self.queue)]
+        return []
+
+    def downstream_entities(self):
+        return [self.worker]

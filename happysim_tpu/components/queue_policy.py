@@ -1,0 +1,118 @@
+"""Pluggable queue ordering disciplines.
+
+Parity target: ``happysimulator/components/queue_policy.py`` (``QueuePolicy``
+:23, FIFO :75, LIFO :117, Priority :204, ``Prioritized`` protocol :163).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from abc import ABC, abstractmethod
+from collections import deque
+from typing import Any, Callable, Optional, Protocol, runtime_checkable
+
+from happysim_tpu.core.event import Event
+
+
+@runtime_checkable
+class Prioritized(Protocol):
+    """Items exposing an explicit priority (lower = served first)."""
+
+    priority: float
+
+
+class QueuePolicy(ABC):
+    """Ordering discipline over buffered items."""
+
+    @abstractmethod
+    def push(self, item: Any) -> None: ...
+
+    @abstractmethod
+    def pop(self) -> Any: ...
+
+    @abstractmethod
+    def peek(self) -> Any: ...
+
+    @abstractmethod
+    def __len__(self) -> int: ...
+
+    def clear(self) -> None:
+        while len(self):
+            self.pop()
+
+
+class FIFOQueue(QueuePolicy):
+    def __init__(self):
+        self._items: deque = deque()
+
+    def push(self, item: Any) -> None:
+        self._items.append(item)
+
+    def pop(self) -> Any:
+        return self._items.popleft()
+
+    def peek(self) -> Any:
+        return self._items[0]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def clear(self) -> None:
+        self._items.clear()
+
+
+class LIFOQueue(QueuePolicy):
+    def __init__(self):
+        self._items: list = []
+
+    def push(self, item: Any) -> None:
+        self._items.append(item)
+
+    def pop(self) -> Any:
+        return self._items.pop()
+
+    def peek(self) -> Any:
+        return self._items[-1]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def clear(self) -> None:
+        self._items.clear()
+
+
+class PriorityQueue(QueuePolicy):
+    """Lowest priority value first; FIFO within equal priorities.
+
+    Priority comes from ``key(item)`` if given, else ``item.priority``, else
+    the event context's ``priority`` field, else 0.
+    """
+
+    def __init__(self, key: Optional[Callable[[Any], float]] = None):
+        self._key = key
+        self._heap: list[tuple[float, int, Any]] = []
+        self._tiebreak = itertools.count()
+
+    def _priority_of(self, item: Any) -> float:
+        if self._key is not None:
+            return self._key(item)
+        priority = getattr(item, "priority", None)
+        if priority is None and isinstance(item, Event):
+            priority = item.context.get("priority")
+        return float(priority) if priority is not None else 0.0
+
+    def push(self, item: Any) -> None:
+        heapq.heappush(self._heap, (self._priority_of(item), next(self._tiebreak), item))
+
+    def pop(self) -> Any:
+        return heapq.heappop(self._heap)[2]
+
+    def peek(self) -> Any:
+        return self._heap[0][2]
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def clear(self) -> None:
+        self._heap.clear()

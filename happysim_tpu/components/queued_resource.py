@@ -1,0 +1,76 @@
+"""Queue + driver + worker composite.
+
+Parity target: ``happysimulator/components/queued_resource.py:52`` — the
+subclass implements ``handle_queued_event`` (:146); an internal worker
+adapter (:45-46) receives delivered work; clock propagation is transparent
+(:126-136).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from happysim_tpu.components.queue import Queue
+from happysim_tpu.components.queue_driver import QueueDriver
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+class _WorkerAdapter(Entity):
+    """Receives delivered work and defers to the owner's queued handler."""
+
+    def __init__(self, owner: "QueuedResource"):
+        super().__init__(f"{owner.name}.worker")
+        self._owner = owner
+
+    def has_capacity(self) -> bool:
+        return self._owner.worker_has_capacity()
+
+    def handle_event(self, event: Event):
+        return self._owner.handle_queued_event(event)
+
+
+class QueuedResource(Entity):
+    """Entity with an attached queue: requests buffer, then get processed.
+
+    Subclasses implement :meth:`handle_queued_event` (which may be a
+    generator) and :meth:`worker_has_capacity` for back-pressure.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        queue_policy: Optional[QueuePolicy] = None,
+        queue_capacity: Optional[int] = None,
+    ):
+        super().__init__(name)
+        self.queue = Queue(f"{name}.queue", policy=queue_policy, capacity=queue_capacity)
+        self._worker = _WorkerAdapter(self)
+        self.driver = QueueDriver(f"{name}.driver", self.queue, self._worker)
+
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        self.queue.set_clock(clock)
+        self.driver.set_clock(clock)
+        self._worker.set_clock(clock)
+
+    # -- surface for subclasses -------------------------------------------
+    def worker_has_capacity(self) -> bool:
+        return True
+
+    def handle_queued_event(self, event: Event):
+        raise NotImplementedError
+
+    # -- event flow --------------------------------------------------------
+    def handle_event(self, event: Event):
+        """Incoming requests are enqueued; the driver pulls them back out."""
+        return self.queue.handle_event(event)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.queue.depth
+
+    def downstream_entities(self):
+        return [self.queue]

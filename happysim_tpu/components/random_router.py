@@ -1,0 +1,33 @@
+"""Uniform fan-out router.
+
+Parity target: ``happysimulator/components/random_router.py:10`` — seeded in
+the rebuild.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional, Sequence
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+class RandomRouter(Entity):
+    """Forwards each event to a uniformly chosen target."""
+
+    def __init__(self, name: str, targets: Sequence[Entity], seed: Optional[int] = None):
+        super().__init__(name)
+        if not targets:
+            raise ValueError("RandomRouter needs at least one target")
+        self.targets = list(targets)
+        self._rng = random.Random(seed)
+        self.events_routed = 0
+
+    def handle_event(self, event: Event):
+        self.events_routed += 1
+        target = self._rng.choice(self.targets)
+        return [self.forward(event, target)]
+
+    def downstream_entities(self):
+        return list(self.targets)

@@ -1,0 +1,150 @@
+"""Contended capacity with future-based acquisition.
+
+Parity target: ``happysimulator/components/resource.py`` (``Resource`` :133,
+``Grant`` :72, ``ResourceStats`` :42 — ``acquire()`` returns a possibly
+pre-resolved ``SimFuture[Grant]`` :211-269, ``try_acquire`` :271, FIFO waiter
+wakeup).
+
+Usage from a generator entity::
+
+    grant = yield resource.acquire()
+    ...critical section...
+    grant.release()
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+@dataclass(frozen=True)
+class ResourceStats:
+    capacity: float
+    in_use: float
+    available: float
+    waiters: int
+    total_acquired: int
+    total_released: int
+    total_wait_seconds: float
+    max_waiters: int
+
+
+class Grant:
+    """A held slice of a resource; release exactly once."""
+
+    __slots__ = ("resource", "amount", "acquired_at", "_released")
+
+    def __init__(self, resource: "Resource", amount: float, acquired_at):
+        self.resource = resource
+        self.amount = amount
+        self.acquired_at = acquired_at
+        self._released = False
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self.resource._release(self.amount)
+
+    def __repr__(self) -> str:
+        return f"Grant({self.resource.name}, amount={self.amount})"
+
+
+class Resource(Entity):
+    """Capacity-limited resource with FIFO waiters.
+
+    Not an event target in normal use — entities interact with it through
+    ``acquire``/``try_acquire`` inside their handlers.
+    """
+
+    def __init__(self, name: str, capacity: float = 1.0):
+        super().__init__(name)
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._in_use = 0.0
+        self._waiters: deque[tuple[SimFuture, float]] = deque()
+        self.total_acquired = 0
+        self.total_released = 0
+        self.total_wait_seconds = 0.0
+        self.max_waiters = 0
+        self._wait_started: dict[int, float] = {}
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def in_use(self) -> float:
+        return self._in_use
+
+    @property
+    def available(self) -> float:
+        return self.capacity - self._in_use
+
+    @property
+    def waiting(self) -> int:
+        return len(self._waiters)
+
+    def stats(self) -> ResourceStats:
+        return ResourceStats(
+            capacity=self.capacity,
+            in_use=self._in_use,
+            available=self.available,
+            waiters=len(self._waiters),
+            total_acquired=self.total_acquired,
+            total_released=self.total_released,
+            total_wait_seconds=self.total_wait_seconds,
+            max_waiters=self.max_waiters,
+        )
+
+    # -- acquisition -------------------------------------------------------
+    def acquire(self, amount: float = 1.0) -> SimFuture:
+        """Future resolving with a :class:`Grant` once capacity is free."""
+        if amount > self.capacity:
+            raise ValueError(f"Requested {amount} exceeds capacity {self.capacity}")
+        future: SimFuture = SimFuture()
+        if not self._waiters and self._in_use + amount <= self.capacity:
+            self._grant(future, amount)
+        else:
+            self._waiters.append((future, amount))
+            self.max_waiters = max(self.max_waiters, len(self._waiters))
+            self._wait_started[id(future)] = self.now.to_seconds()
+        return future
+
+    def try_acquire(self, amount: float = 1.0) -> Optional[Grant]:
+        """Immediate grant or None — never waits."""
+        if not self._waiters and self._in_use + amount <= self.capacity:
+            self._in_use += amount
+            self.total_acquired += 1
+            return Grant(self, amount, self.now)
+        return None
+
+    def _grant(self, future: SimFuture, amount: float) -> None:
+        self._in_use += amount
+        self.total_acquired += 1
+        started = self._wait_started.pop(id(future), None)
+        if started is not None:
+            self.total_wait_seconds += self.now.to_seconds() - started
+        future.resolve(Grant(self, amount, self.now))
+
+    def _release(self, amount: float) -> None:
+        self._in_use = max(0.0, self._in_use - amount)
+        self.total_released += 1
+        # Wake FIFO waiters that now fit (no barging past the head).
+        while self._waiters:
+            future, want = self._waiters[0]
+            if self._in_use + want > self.capacity:
+                break
+            self._waiters.popleft()
+            self._grant(future, want)
+
+    def handle_event(self, event: Event):
+        return None

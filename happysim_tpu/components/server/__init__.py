@@ -1,0 +1,16 @@
+from happysim_tpu.components.server.concurrency import (
+    ConcurrencyModel,
+    DynamicConcurrency,
+    FixedConcurrency,
+    WeightedConcurrency,
+)
+from happysim_tpu.components.server.server import Server, ServerStats
+
+__all__ = [
+    "ConcurrencyModel",
+    "DynamicConcurrency",
+    "FixedConcurrency",
+    "Server",
+    "ServerStats",
+    "WeightedConcurrency",
+]

@@ -1,0 +1,116 @@
+"""Server concurrency models.
+
+Parity target: ``happysimulator/components/server/concurrency.py``
+(``ConcurrencyModel`` :15, ``FixedConcurrency`` :68, ``DynamicConcurrency``
+:144, ``WeightedConcurrency`` :293).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Optional
+
+
+class ConcurrencyModel(ABC):
+    """Tracks in-flight work against a capacity limit."""
+
+    @abstractmethod
+    def has_capacity(self, event: Any = None) -> bool: ...
+
+    @abstractmethod
+    def acquire(self, event: Any = None) -> None: ...
+
+    @abstractmethod
+    def release(self, event: Any = None) -> None: ...
+
+    @property
+    @abstractmethod
+    def active(self) -> float: ...
+
+
+class FixedConcurrency(ConcurrencyModel):
+    """At most ``limit`` requests in flight."""
+
+    def __init__(self, limit: int = 1):
+        if limit < 1:
+            raise ValueError("concurrency limit must be >= 1")
+        self.limit = limit
+        self._active = 0
+
+    def has_capacity(self, event: Any = None) -> bool:
+        return self._active < self.limit
+
+    def acquire(self, event: Any = None) -> None:
+        if self._active >= self.limit:
+            raise RuntimeError("acquire() beyond concurrency limit")
+        self._active += 1
+
+    def release(self, event: Any = None) -> None:
+        if self._active <= 0:
+            raise RuntimeError("release() with nothing in flight")
+        self._active -= 1
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+
+class DynamicConcurrency(ConcurrencyModel):
+    """Runtime-adjustable limit (autoscaling, degradation experiments)."""
+
+    def __init__(self, initial_limit: int = 1):
+        if initial_limit < 1:
+            raise ValueError("concurrency limit must be >= 1")
+        self._limit = initial_limit
+        self._active = 0
+
+    @property
+    def limit(self) -> int:
+        return self._limit
+
+    def set_limit(self, limit: int) -> None:
+        if limit < 1:
+            raise ValueError("concurrency limit must be >= 1")
+        self._limit = limit
+
+    def has_capacity(self, event: Any = None) -> bool:
+        return self._active < self._limit
+
+    def acquire(self, event: Any = None) -> None:
+        self._active += 1
+
+    def release(self, event: Any = None) -> None:
+        self._active -= 1
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+
+class WeightedConcurrency(ConcurrencyModel):
+    """Requests consume variable capacity via a cost function."""
+
+    def __init__(self, capacity: float, cost_fn: Optional[Callable[[Any], float]] = None):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._cost_fn = cost_fn or (lambda event: 1.0)
+        self._in_use = 0.0
+
+    def _cost(self, event: Any) -> float:
+        if event is None:
+            return 1.0
+        return float(self._cost_fn(event))
+
+    def has_capacity(self, event: Any = None) -> bool:
+        return self._in_use + self._cost(event) <= self.capacity
+
+    def acquire(self, event: Any = None) -> None:
+        self._in_use += self._cost(event)
+
+    def release(self, event: Any = None) -> None:
+        self._in_use = max(0.0, self._in_use - self._cost(event))
+
+    @property
+    def active(self) -> float:
+        return self._in_use

@@ -1,0 +1,87 @@
+"""The queueing-theory workhorse: a server with service time + concurrency.
+
+Parity target: ``happysimulator/components/server/server.py:43``
+(``Server(QueuedResource)`` — concurrency model + service-time distribution,
+forward to downstream :202-273; ``ServerStats`` :35).
+
+This is the M/M/c primitive: requests queue, up to ``concurrency`` are
+serviced concurrently, each holding a sampled service time, then forward
+downstream. The TPU executor models the same semantics as a wake-time array
+per replica (see happysim_tpu/tpu/engine.py server kind).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Union
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queued_resource import QueuedResource
+from happysim_tpu.components.server.concurrency import ConcurrencyModel, FixedConcurrency
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.distributions.latency_distribution import ConstantLatency, LatencyDistribution
+
+
+@dataclass(frozen=True)
+class ServerStats:
+    requests_started: int
+    requests_completed: int
+    busy_seconds: float
+    active: float
+    queue_depth: int
+    queue_dropped: int
+
+
+class Server(QueuedResource):
+    """Concurrency-limited service station."""
+
+    def __init__(
+        self,
+        name: str,
+        concurrency: Union[int, ConcurrencyModel] = 1,
+        service_time: Optional[LatencyDistribution] = None,
+        queue_policy: Optional[QueuePolicy] = None,
+        queue_capacity: Optional[int] = None,
+        downstream: Optional[Entity] = None,
+    ):
+        super().__init__(name, queue_policy=queue_policy, queue_capacity=queue_capacity)
+        if isinstance(concurrency, int):
+            concurrency = FixedConcurrency(concurrency)
+        self.concurrency = concurrency
+        self.service_time = service_time if service_time is not None else ConstantLatency(0.0)
+        self.downstream = downstream
+        self.requests_started = 0
+        self.requests_completed = 0
+        self.busy_seconds = 0.0
+
+    def worker_has_capacity(self) -> bool:
+        return self.concurrency.has_capacity()
+
+    def handle_queued_event(self, event: Event):
+        self.concurrency.acquire(event)
+        self.requests_started += 1
+        service = self.service_time.get_latency(self.now).to_seconds()
+        yield service
+        self.busy_seconds += service
+        self.requests_completed += 1
+        self.concurrency.release(event)
+        if self.downstream is not None:
+            return [self.forward(event, self.downstream)]
+        return None
+
+    def stats(self) -> ServerStats:
+        return ServerStats(
+            requests_started=self.requests_started,
+            requests_completed=self.requests_completed,
+            busy_seconds=self.busy_seconds,
+            active=self.concurrency.active,
+            queue_depth=self.queue_depth,
+            queue_dropped=self.queue.dropped,
+        )
+
+    def downstream_entities(self):
+        out = [self.queue]
+        if self.downstream is not None:
+            out.append(self.downstream)
+        return out

@@ -1,0 +1,78 @@
+"""Core runtime: time, events, entities, futures, and the engine."""
+
+from happysim_tpu.core.callback_entity import CallbackEntity, NullEntity
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.control.breakpoints import (
+    Breakpoint,
+    ConditionBreakpoint,
+    EventCountBreakpoint,
+    EventTypeBreakpoint,
+    MetricBreakpoint,
+    TimeBreakpoint,
+)
+from happysim_tpu.core.control.control import SimulationControl
+from happysim_tpu.core.control.state import BreakpointContext, SimulationState
+from happysim_tpu.core.decorators import simulatable
+from happysim_tpu.core.entity import Entity, SimReturn, SimYield
+from happysim_tpu.core.event import (
+    Event,
+    ProcessContinuation,
+    disable_event_tracing,
+    enable_event_tracing,
+    reset_event_counter,
+)
+from happysim_tpu.core.event_heap import EventHeap
+from happysim_tpu.core.logical_clocks import (
+    HLCTimestamp,
+    HybridLogicalClock,
+    LamportClock,
+    VectorClock,
+)
+from happysim_tpu.core.node_clock import ClockModel, FixedSkew, LinearDrift, NodeClock
+from happysim_tpu.core.protocols import HasCapacity, Simulatable
+from happysim_tpu.core.sim_future import SimFuture, all_of, any_of
+from happysim_tpu.core.simulation import Simulation
+from happysim_tpu.core.temporal import Duration, Instant, as_duration, as_instant
+
+__all__ = [
+    "Breakpoint",
+    "BreakpointContext",
+    "CallbackEntity",
+    "Clock",
+    "ClockModel",
+    "ConditionBreakpoint",
+    "Duration",
+    "Entity",
+    "Event",
+    "EventCountBreakpoint",
+    "EventHeap",
+    "EventTypeBreakpoint",
+    "FixedSkew",
+    "HLCTimestamp",
+    "HasCapacity",
+    "HybridLogicalClock",
+    "Instant",
+    "LamportClock",
+    "LinearDrift",
+    "MetricBreakpoint",
+    "NodeClock",
+    "NullEntity",
+    "ProcessContinuation",
+    "SimFuture",
+    "SimReturn",
+    "SimYield",
+    "Simulatable",
+    "Simulation",
+    "SimulationControl",
+    "SimulationState",
+    "TimeBreakpoint",
+    "VectorClock",
+    "all_of",
+    "any_of",
+    "as_duration",
+    "as_instant",
+    "disable_event_tracing",
+    "enable_event_tracing",
+    "reset_event_counter",
+    "simulatable",
+]

@@ -1,0 +1,52 @@
+"""Function-as-entity adapter and the discard sink.
+
+Parity target: ``happysimulator/core/callback_entity.py`` (``CallbackEntity``
+:15, ``NullEntity`` singleton :39).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+class CallbackEntity(Entity):
+    """Wraps a plain function so it can be an event target.
+
+    The function may accept zero args, (event), or (event, now) — dispatched
+    by arity at call time.
+    """
+
+    def __init__(self, name: str, fn: Callable[..., Any]):
+        super().__init__(name)
+        self._fn = fn
+
+    def handle_event(self, event: Event):
+        fn = self._fn
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            return fn(event)
+        arity = code.co_argcount - (1 if hasattr(fn, "__self__") else 0)
+        if arity == 0:
+            return fn()
+        if arity == 1:
+            return fn(event)
+        return fn(event, self.now)
+
+
+class _NullEntity(Entity):
+    """Silently absorbs events; clockless by design."""
+
+    def __init__(self):
+        super().__init__("null")
+
+    def set_clock(self, clock) -> None:  # accepts but ignores
+        self._clock = clock
+
+    def handle_event(self, event: Event):
+        return None
+
+
+NullEntity = _NullEntity()

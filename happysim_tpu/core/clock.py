@@ -1,0 +1,30 @@
+"""Shared mutable simulation clock.
+
+Parity target: ``happysimulator/core/clock.py:11`` (``Clock`` with ``now``/
+``update``). One Clock instance is shared by every entity in a simulation and
+advanced only by the event loop, so all actors observe the same true time.
+"""
+
+from __future__ import annotations
+
+from happysim_tpu.core.temporal import Instant
+
+
+class Clock:
+    """Single source of truth for current simulation time."""
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start_time: Instant = Instant.Epoch):
+        self._now = start_time
+
+    @property
+    def now(self) -> Instant:
+        return self._now
+
+    def update(self, time: Instant) -> None:
+        """Advance the clock. Only the simulation loop should call this."""
+        self._now = time
+
+    def __repr__(self) -> str:
+        return f"Clock(now={self._now!r})"

@@ -1,0 +1,22 @@
+from happysim_tpu.core.control.breakpoints import (
+    Breakpoint,
+    ConditionBreakpoint,
+    EventCountBreakpoint,
+    EventTypeBreakpoint,
+    MetricBreakpoint,
+    TimeBreakpoint,
+)
+from happysim_tpu.core.control.control import SimulationControl
+from happysim_tpu.core.control.state import BreakpointContext, SimulationState
+
+__all__ = [
+    "Breakpoint",
+    "BreakpointContext",
+    "ConditionBreakpoint",
+    "EventCountBreakpoint",
+    "EventTypeBreakpoint",
+    "MetricBreakpoint",
+    "SimulationControl",
+    "SimulationState",
+    "TimeBreakpoint",
+]

@@ -1,0 +1,121 @@
+"""Declarative pause conditions.
+
+Parity target: ``happysimulator/core/control/breakpoints.py`` (``Breakpoint``
+protocol :30; Time/EventCount/Condition/Metric/EventType breakpoints).
+
+Breakpoints are evaluated against the *next* event before it is processed;
+a triggered breakpoint pauses the run with that event still pending. Each
+breakpoint is one-shot by default (``repeat=True`` re-arms it).
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Protocol, runtime_checkable
+
+from happysim_tpu.core.control.state import BreakpointContext
+from happysim_tpu.core.temporal import Instant, as_instant
+
+_OPS: dict[str, Callable[[Any, Any], bool]] = {
+    ">": operator.gt,
+    ">=": operator.ge,
+    "<": operator.lt,
+    "<=": operator.le,
+    "==": operator.eq,
+    "!=": operator.ne,
+}
+
+
+@runtime_checkable
+class Breakpoint(Protocol):
+    repeat: bool
+
+    def should_break(self, ctx: BreakpointContext) -> bool: ...
+
+
+class TimeBreakpoint:
+    """Pause when simulated time reaches ``time``."""
+
+    def __init__(self, time: Instant | float, *, repeat: bool = False):
+        self.time = as_instant(time)
+        self.repeat = repeat
+
+    def should_break(self, ctx: BreakpointContext) -> bool:
+        return ctx.next_event.time >= self.time
+
+    def __repr__(self) -> str:
+        return f"TimeBreakpoint({self.time!r})"
+
+
+class EventCountBreakpoint:
+    """Pause after ``count`` events have been processed."""
+
+    def __init__(self, count: int, *, repeat: bool = False):
+        self.count = count
+        self.repeat = repeat
+
+    def should_break(self, ctx: BreakpointContext) -> bool:
+        return ctx.events_processed >= self.count
+
+    def __repr__(self) -> str:
+        return f"EventCountBreakpoint({self.count})"
+
+
+class ConditionBreakpoint:
+    """Pause when an arbitrary predicate over the context is true."""
+
+    def __init__(self, condition: Callable[[BreakpointContext], bool], *, repeat: bool = False):
+        self.condition = condition
+        self.repeat = repeat
+
+    def should_break(self, ctx: BreakpointContext) -> bool:
+        return bool(self.condition(ctx))
+
+
+class MetricBreakpoint:
+    """Pause when ``getattr(entity, attr) <op> threshold`` becomes true."""
+
+    def __init__(
+        self,
+        entity: Any,
+        attr: str,
+        op: str,
+        threshold: Any,
+        *,
+        repeat: bool = False,
+    ):
+        if op not in _OPS:
+            raise ValueError(f"Unknown operator {op!r}; use one of {sorted(_OPS)}")
+        self.entity = entity
+        self.attr = attr
+        self.op = op
+        self.threshold = threshold
+        self.repeat = repeat
+
+    def should_break(self, ctx: BreakpointContext) -> bool:
+        value = getattr(self.entity, self.attr, None)
+        if callable(value):
+            value = value()
+        if value is None:
+            return False
+        return _OPS[self.op](value, self.threshold)
+
+    def __repr__(self) -> str:
+        name = getattr(self.entity, "name", type(self.entity).__name__)
+        return f"MetricBreakpoint({name}.{self.attr} {self.op} {self.threshold})"
+
+
+class EventTypeBreakpoint:
+    """Pause when the next event has the given type (optionally a target name)."""
+
+    def __init__(self, event_type: str, target_name: str | None = None, *, repeat: bool = False):
+        self.event_type = event_type
+        self.target_name = target_name
+        self.repeat = repeat
+
+    def should_break(self, ctx: BreakpointContext) -> bool:
+        if ctx.next_event.event_type != self.event_type:
+            return False
+        if self.target_name is None:
+            return True
+        return getattr(ctx.next_event.target, "name", None) == self.target_name

@@ -1,0 +1,165 @@
+"""Interactive run control: pause, resume, step, breakpoints, hooks.
+
+Parity target: ``happysimulator/core/control/control.py:28`` (pause/resume/
+step :64-104, ``get_state`` :106, ``reset`` :126-170, breakpoint registry
+:176-199, ``on_event``/``on_time_advance`` hooks :205-229, heap introspection
+:249-278). The control surface costs nothing unless used — the engine only
+takes the slow loop when hooks/breakpoints/step budgets are active.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Optional
+
+from happysim_tpu.core.control.breakpoints import Breakpoint
+from happysim_tpu.core.control.state import BreakpointContext, SimulationState
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.simulation import Simulation
+    from happysim_tpu.instrumentation.summary import SimulationSummary
+
+
+class SimulationControl:
+    """Debugging/stepping surface attached lazily to a Simulation."""
+
+    def __init__(self, simulation: "Simulation"):
+        self._sim = simulation
+        self._paused = False
+        self._pause_requested = False
+        self._step_budget: Optional[int] = None
+        self._breakpoints: list[Breakpoint] = []
+        self._last_break: Optional[Breakpoint] = None
+        self._on_event: list[Callable[[Event], None]] = []
+        self._on_time_advance: list[Callable[[Instant], None]] = []
+
+    # -- pause / resume / step --------------------------------------------
+    def pause(self) -> None:
+        """Request a pause; takes effect before the next event."""
+        self._pause_requested = True
+
+    def resume(self) -> "SimulationSummary":
+        """Continue a paused run to the next stop condition."""
+        self._paused = False
+        self._step_budget = None
+        return self._sim.run()
+
+    def step(self, n: int = 1) -> "SimulationSummary":
+        """Process exactly ``n`` events then pause."""
+        self._paused = False
+        self._step_budget = n
+        return self._sim.run()
+
+    @property
+    def is_paused(self) -> bool:
+        return self._paused
+
+    @property
+    def last_breakpoint(self) -> Optional[Breakpoint]:
+        return self._last_break
+
+    def get_state(self) -> SimulationState:
+        return SimulationState(
+            time=self._sim.now,
+            events_processed=self._sim.events_processed,
+            pending_events=self._sim.event_heap.size(),
+            is_paused=self._paused,
+            is_completed=self._sim._completed,
+        )
+
+    def reset(self) -> None:
+        """Rewind: clear heap, re-prime sources/probes, replay pre-run events.
+
+        Entity state is intentionally NOT reset (matches the reference).
+        """
+        self._paused = False
+        self._pause_requested = False
+        self._step_budget = None
+        self._sim._reset()
+
+    # -- breakpoints -------------------------------------------------------
+    def add_breakpoint(self, breakpoint: Breakpoint) -> Breakpoint:
+        self._breakpoints.append(breakpoint)
+        return breakpoint
+
+    def remove_breakpoint(self, breakpoint: Breakpoint) -> None:
+        if breakpoint in self._breakpoints:
+            self._breakpoints.remove(breakpoint)
+
+    def clear_breakpoints(self) -> None:
+        self._breakpoints.clear()
+
+    @property
+    def breakpoints(self) -> list[Breakpoint]:
+        return list(self._breakpoints)
+
+    # -- hooks -------------------------------------------------------------
+    def on_event(self, callback: Callable[[Event], None]) -> None:
+        """Call ``callback(event)`` after every processed event."""
+        self._on_event.append(callback)
+
+    def on_time_advance(self, callback: Callable[[Instant], None]) -> None:
+        """Call ``callback(now)`` whenever simulated time moves forward."""
+        self._on_time_advance.append(callback)
+
+    # -- heap introspection ------------------------------------------------
+    def peek_next(self) -> Optional[Event]:
+        return self._sim.event_heap.peek()
+
+    def find_events(self, predicate: Callable[[Event], bool]) -> list[Event]:
+        return sorted(
+            (e for e in self._sim.event_heap if predicate(e) and not e.cancelled),
+        )
+
+    # -- engine-side hooks (called from the loop) --------------------------
+    def _needs_loop_hooks(self) -> bool:
+        return bool(
+            self._pause_requested
+            or self._step_budget is not None
+            or self._breakpoints
+            or self._on_event
+            or self._on_time_advance
+        )
+
+    def _consume_pause_request(self) -> bool:
+        if self._pause_requested:
+            self._pause_requested = False
+            self._paused = True
+            return True
+        return False
+
+    def _check_breakpoints(self, next_event: Event) -> bool:
+        if not self._breakpoints:
+            return False
+        ctx = BreakpointContext(
+            simulation=self._sim,
+            next_event=next_event,
+            time=self._sim.now,
+            events_processed=self._sim.events_processed,
+        )
+        for breakpoint in self._breakpoints:
+            if breakpoint.should_break(ctx):
+                self._last_break = breakpoint
+                self._paused = True
+                if not getattr(breakpoint, "repeat", False):
+                    self._breakpoints.remove(breakpoint)
+                return True
+        return False
+
+    def _after_event(self, event: Event, time_advanced: bool) -> None:
+        for callback in self._on_event:
+            callback(event)
+        if time_advanced:
+            for callback in self._on_time_advance:
+                callback(self._sim.now)
+
+    def _step_exhausted(self) -> bool:
+        if self._step_budget is None:
+            return False
+        self._step_budget -= 1
+        if self._step_budget <= 0:
+            self._step_budget = None
+            self._paused = True
+            return True
+        return False

@@ -1,0 +1,35 @@
+"""Snapshots handed to user code by the control surface.
+
+Parity target: ``happysimulator/core/control/state.py`` (``SimulationState``,
+``BreakpointContext`` dataclasses).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
+
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.event import Event
+    from happysim_tpu.core.simulation import Simulation
+
+
+@dataclass(frozen=True)
+class SimulationState:
+    time: Instant
+    events_processed: int
+    pending_events: int
+    is_paused: bool
+    is_completed: bool
+
+
+@dataclass(frozen=True)
+class BreakpointContext:
+    """Passed to Breakpoint.should_break before the next event is processed."""
+
+    simulation: "Simulation"
+    next_event: "Event"
+    time: Instant
+    events_processed: int

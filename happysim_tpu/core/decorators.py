@@ -1,0 +1,52 @@
+"""@simulatable — adapt plain classes into simulation participants.
+
+Parity target: ``happysimulator/core/decorators.py:48`` (injects ``_clock``,
+``set_clock``, ``now``, default ``has_capacity``).
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.temporal import Instant
+
+T = TypeVar("T")
+
+
+def simulatable(cls: type[T]) -> type[T]:
+    """Class decorator adding clock plumbing to satisfy ``Simulatable``.
+
+    The decorated class must define ``handle_event`` and have a ``name``
+    attribute (checked at decoration time for fast failure).
+    """
+    if not hasattr(cls, "handle_event"):
+        raise TypeError(f"@simulatable class {cls.__name__} must define handle_event()")
+
+    original_init = cls.__init__
+
+    def __init__(self, *args, **kwargs):
+        original_init(self, *args, **kwargs)
+        if not hasattr(self, "_clock"):
+            self._clock = None
+
+    def set_clock(self, clock: Clock) -> None:
+        self._clock = clock
+
+    def now(self) -> Instant:
+        if self._clock is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no clock; add it to a Simulation first"
+            )
+        return self._clock.now
+
+    cls.__init__ = __init__
+    if not hasattr(cls, "set_clock"):
+        cls.set_clock = set_clock
+    if not hasattr(cls, "now"):
+        cls.now = property(now)
+    if not hasattr(cls, "has_capacity"):
+        cls.has_capacity = lambda self: True
+    if not hasattr(cls, "downstream_entities"):
+        cls.downstream_entities = lambda self: []
+    return cls

@@ -1,0 +1,75 @@
+"""Entity base class — the actor model of the host executor.
+
+Parity target: ``happysimulator/core/entity.py:31`` (``handle_event`` :70,
+``now`` :57, ``forward()`` :83, ``has_capacity()`` :107,
+``downstream_entities()`` :115; ``SimYield``/``SimReturn`` aliases :24-27).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import TYPE_CHECKING, Any, Generator, Optional, Union
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    pass
+
+# Type aliases for generator-based behaviors:
+#   def handle_event(self, event) -> SimReturn:
+#       yield 0.010              # 10 ms delay
+#       yield 0.010, [evt]       # delay with side-effects
+SimYield = Union[float, tuple]
+SimReturn = Generator[SimYield, Any, Union[None, Event, list[Event]]]
+
+
+class Entity(ABC):
+    """Base class for all simulation actors.
+
+    Subclasses implement ``handle_event`` and may return None, an Event, a
+    list of events, or a generator of timed steps. The clock is injected by
+    the Simulation at bootstrap; ``self.now`` is the current simulated time.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._clock: Optional[Clock] = None
+
+    def set_clock(self, clock: Clock) -> None:
+        self._clock = clock
+
+    @property
+    def now(self) -> Instant:
+        if self._clock is None:
+            raise RuntimeError(
+                f"Entity '{self.name}' has no clock; add it to a Simulation first"
+            )
+        return self._clock.now
+
+    @abstractmethod
+    def handle_event(self, event: Event) -> Union[None, Event, list[Event], SimReturn]:
+        """Process an event; return/yield follow-up work."""
+
+    def forward(self, event: Event, target: "Entity", event_type: str | None = None) -> Event:
+        """Re-address an event to ``target`` at the current time, preserving
+        context (so created_at survives for latency accounting)."""
+        return Event(
+            time=self.now,
+            event_type=event_type or event.event_type,
+            target=target,
+            daemon=event.daemon,
+            context=event.context,
+        )
+
+    def has_capacity(self) -> bool:
+        """Back-pressure signal consumed by queue drivers. Default: always."""
+        return True
+
+    def downstream_entities(self) -> list["Entity"]:
+        """Topology hint for visualization/validation. Default: none."""
+        return []
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name!r})"

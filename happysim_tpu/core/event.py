@@ -1,0 +1,368 @@
+"""Events, deterministic ordering, and generator continuations.
+
+Parity target: ``happysimulator/core/event.py`` (``Event`` :106 — slots,
+(time, sort_index) ordering :337-344, ``cancel()`` lazy deletion :189,
+``Event.once()`` :371, completion hooks :218/:290; ``ProcessContinuation``
+:404; module + per-partition contextvar counters :53-77; tracing flag :82-99).
+
+Rebuild notes:
+- Ordering is a total order on ``(time_ns, sort_index)``; the sort index comes
+  from a contextvar-scoped counter so parallel partitions each get an isolated,
+  deterministic stream (the reference solves the same problem the same way —
+  this is the CPU-side twin of the TPU executor's ``(time, lane, seq)`` sort
+  keys).
+- Generator entities (``yield delay`` / ``yield future``) are a host-path
+  feature; the TPU executor re-expresses behaviors as explicit state machines
+  (see :mod:`happysim_tpu.tpu.engine`), so nothing here needs to vectorize.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import TYPE_CHECKING, Any, Callable, Generator, Iterator, Optional, Union
+
+from happysim_tpu.core.temporal import Duration, Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.protocols import Simulatable
+
+logger = logging.getLogger("happysim_tpu.core.event")
+
+CompletionHook = Callable[[Instant], Union[list["Event"], "Event", None]]
+
+# ---------------------------------------------------------------------------
+# Deterministic sort-index allocation.
+#
+# A contextvar holds the active counter so that (a) a plain run uses one global
+# stream and (b) each parallel partition / windowed run can install its own
+# isolated counter, keeping event order independent of thread scheduling
+# (reference core/event.py:53-77).
+# ---------------------------------------------------------------------------
+
+_sort_counter: ContextVar[Iterator[int]] = ContextVar("hs_sort_counter")
+_global_counter = itertools.count()
+
+
+def _next_sort_index() -> int:
+    counter = _sort_counter.get(None)
+    if counter is None:
+        counter = _global_counter
+    return next(counter)
+
+
+def reset_event_counter() -> None:
+    """Reset the global ordering stream (new Simulation => fresh order)."""
+    global _global_counter
+    _global_counter = itertools.count()
+
+
+@contextmanager
+def isolated_event_counter():
+    """Install a fresh counter for the current context (parallel partitions)."""
+    token = _sort_counter.set(itertools.count())
+    try:
+        yield
+    finally:
+        _sort_counter.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# Application-level event tracing (used by the visual debugger).
+# ---------------------------------------------------------------------------
+
+_TRACING_ENABLED = False
+_MAX_STACK_DEPTH = 50
+
+
+def enable_event_tracing() -> None:
+    """Record handler stacks + spans into ``event.context`` (reference :85)."""
+    global _TRACING_ENABLED
+    _TRACING_ENABLED = True
+
+
+def disable_event_tracing() -> None:
+    global _TRACING_ENABLED
+    _TRACING_ENABLED = False
+
+
+def event_tracing_enabled() -> bool:
+    return _TRACING_ENABLED
+
+
+class Event:
+    """The fundamental unit of simulation work.
+
+    An event is (time, type, target). ``invoke()`` dispatches to the target's
+    ``handle_event`` and normalizes whatever comes back — ``None``, an
+    ``Event``, a list of events, or a generator (which becomes a
+    :class:`ProcessContinuation`). Events sort by ``(time, insertion order)``
+    so same-instant scheduling is deterministic FIFO.
+    """
+
+    __slots__ = (
+        "time",
+        "event_type",
+        "target",
+        "daemon",
+        "on_complete",
+        "context",
+        "_sort_index",
+        "_id",
+        "_cancelled",
+    )
+
+    def __init__(
+        self,
+        time: Instant,
+        event_type: str,
+        target: "Simulatable | None" = None,
+        *,
+        daemon: bool = False,
+        on_complete: Optional[list[CompletionHook]] = None,
+        context: Optional[dict[str, Any]] = None,
+    ):
+        if target is None:
+            raise ValueError(f"Event '{event_type}' requires a target entity")
+        self.time = time
+        self.event_type = event_type
+        self.target = target
+        self.daemon = daemon
+        self.on_complete: list[CompletionHook] = on_complete if on_complete is not None else []
+        self._sort_index = _next_sort_index()
+        self._id = self._sort_index
+        self._cancelled = False
+        if context is not None:
+            self.context = context
+            context.setdefault("id", str(self._id))
+            context.setdefault("created_at", time)
+            context.setdefault("metadata", {})
+        else:
+            self.context = {
+                "id": str(self._id),
+                "created_at": time,
+                "metadata": {},
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def cancel(self) -> None:
+        """Lazy deletion: the loop skips cancelled events on pop."""
+        self._cancelled = True
+
+    def add_completion_hook(self, hook: CompletionHook) -> None:
+        self.on_complete.append(hook)
+
+    def add_context(self, key: str, value: Any) -> None:
+        self.context[key] = value
+
+    def get_context(self, key: str) -> Any:
+        return self.context.get(key)
+
+    # -- dispatch ----------------------------------------------------------
+    def invoke(self) -> list["Event"]:
+        """Dispatch to the target; returns newly produced events."""
+        target = self.target
+        if getattr(target, "_crashed", False):
+            # Crashed nodes silently drop events (reference :261-262).
+            return []
+        if _TRACING_ENABLED:
+            self._trace_invoke()
+        result = target.handle_event(self)
+        if isinstance(result, Generator):
+            return self._start_process(result)
+        return self._finish(result)
+
+    def _finish(self, result: Any, at_time: Instant | None = None) -> list["Event"]:
+        events = _normalize_events(result)
+        if self.on_complete:
+            events.extend(self._run_completion_hooks(at_time if at_time is not None else self.time))
+        return events
+
+    def _run_completion_hooks(self, time: Instant) -> list["Event"]:
+        produced: list[Event] = []
+        hooks, self.on_complete = self.on_complete, []  # one-shot
+        for hook in hooks:
+            produced.extend(_normalize_events(hook(time)))
+        return produced
+
+    def _start_process(self, gen: Generator) -> list["Event"]:
+        continuation = ProcessContinuation(
+            time=self.time,
+            event_type=self.event_type,
+            target=self.target,
+            process=gen,
+            origin=self,
+        )
+        return continuation.invoke()
+
+    def _trace_invoke(self) -> None:
+        stack = self.context.setdefault("stack", [])
+        if len(stack) < _MAX_STACK_DEPTH:
+            stack.append(getattr(self.target, "name", type(self.target).__name__))
+        spans = self.context.setdefault("trace", {}).setdefault("spans", [])
+        spans.append({"at": self.time.nanoseconds, "type": self.event_type})
+
+    # -- ordering / identity ----------------------------------------------
+    def __lt__(self, other: "Event") -> bool:
+        if self.time.nanoseconds != other.time.nanoseconds:
+            return self.time.nanoseconds < other.time.nanoseconds
+        return self._sort_index < other._sort_index
+
+    def __eq__(self, other: object) -> bool:
+        return self is other
+
+    def __hash__(self) -> int:
+        return self._id
+
+    def __repr__(self) -> str:
+        target_name = getattr(self.target, "name", None) or type(self.target).__name__
+        return f"Event({self.time!r}, {self.event_type!r}, target={target_name})"
+
+    # -- function dispatch -------------------------------------------------
+    @staticmethod
+    def once(
+        time: Instant,
+        fn: Callable[..., Any],
+        event_type: str = "Callback",
+        *,
+        daemon: bool = False,
+        context: Optional[dict[str, Any]] = None,
+    ) -> "Event":
+        """Schedule a bare function without writing an Entity (reference :371)."""
+        from happysim_tpu.core.callback_entity import CallbackEntity
+
+        return Event(
+            time,
+            event_type,
+            target=CallbackEntity(f"once:{event_type}", fn),
+            daemon=daemon,
+            context=context,
+        )
+
+
+def _normalize_events(value: Any) -> list[Event]:
+    """None / Event / list-of-Event → list[Event]."""
+    if value is None:
+        return []
+    if isinstance(value, Event):
+        return [value]
+    if isinstance(value, list):
+        return [e for e in value if e is not None]
+    if isinstance(value, Generator):
+        raise TypeError(
+            "Generator returned where events expected; generators are only "
+            "supported as the direct return of handle_event()"
+        )
+    logger.warning("Ignoring non-Event return value %r", type(value))
+    return []
+
+
+class ProcessContinuation(Event):
+    """Steps a generator-based process through the event loop.
+
+    Each ``yield delay`` (seconds or Duration) or ``yield delay, side_effects``
+    schedules the next step; yielding a :class:`~happysim_tpu.core.sim_future.
+    SimFuture` parks the process until the future resolves (reference
+    :404-542). The continuation shares the originating event's context so
+    latency trackers see the original ``created_at``.
+    """
+
+    __slots__ = ("process", "origin", "_send_value")
+
+    def __init__(
+        self,
+        time: Instant,
+        event_type: str,
+        target: "Simulatable",
+        process: Generator,
+        origin: Event,
+        send_value: Any = None,
+    ):
+        super().__init__(time, event_type, target, daemon=origin.daemon, context=origin.context)
+        self.process = process
+        self.origin = origin
+        self._send_value = send_value
+
+    def invoke(self) -> list[Event]:
+        debugger = _active_code_debugger.get(None)
+        tracing = debugger is not None and debugger.wants(self.target)
+        if tracing:
+            debugger.attach(self.target, self.process)
+        try:
+            try:
+                yielded = self.process.send(self._send_value)
+            except StopIteration as stop:
+                # Hooks fire at the time the PROCESS finished, not when it began.
+                return self.origin._finish(stop.value, at_time=self.time)
+            # Parked on a future? (optionally with side-effect events)
+            if getattr(yielded, "__sim_future__", False):
+                yielded._park(self)
+                return []
+            if (
+                isinstance(yielded, tuple)
+                and len(yielded) == 2
+                and getattr(yielded[0], "__sim_future__", False)
+            ):
+                future, effects = yielded
+                side_effects = _normalize_events(effects)
+                future._park(self)
+                return side_effects
+            delay_s, side_effects = self._normalize_yield(yielded)
+            next_step = ProcessContinuation(
+                time=self.time + delay_s,
+                event_type=self.event_type,
+                target=self.target,
+                process=self.process,
+                origin=self.origin,
+            )
+            return [*side_effects, next_step]
+        finally:
+            if tracing:
+                debugger.detach(self.process)
+
+    def resume_at(self, time: Instant, send_value: Any) -> "ProcessContinuation":
+        """Clone of this continuation scheduled at ``time`` (future resolution)."""
+        return ProcessContinuation(
+            time=time,
+            event_type=self.event_type,
+            target=self.target,
+            process=self.process,
+            origin=self.origin,
+            send_value=send_value,
+        )
+
+    @staticmethod
+    def _normalize_yield(value: Any) -> tuple[Union[float, Duration], list[Event]]:
+        if isinstance(value, tuple):
+            delay, effects = value
+            if isinstance(delay, Duration):
+                delay = delay.to_seconds()
+            return float(delay), _normalize_events(effects)
+        if isinstance(value, Duration):
+            return value.to_seconds(), []
+        if isinstance(value, (int, float)):
+            return float(value), []
+        logger.warning("Generator yielded %r; treating as zero delay", type(value))
+        return 0.0, []
+
+
+# ---------------------------------------------------------------------------
+# Code-debugger hook (visual debugger's line-stepping; reference :33-48).
+# ---------------------------------------------------------------------------
+
+_active_code_debugger: ContextVar[Any] = ContextVar("hs_code_debugger")
+
+
+@contextmanager
+def _active_debugger_context(debugger: Any):
+    token = _active_code_debugger.set(debugger)
+    try:
+        yield
+    finally:
+        _active_code_debugger.reset(token)

@@ -1,0 +1,91 @@
+"""Binary min-heap of pending events.
+
+Parity target: ``happysimulator/core/event_heap.py:19`` (push/pop :54-92,
+O(1) daemon-aware ``has_primary_events`` :102, per-heap counters :48).
+
+The heap is the host executor's scheduling structure. The TPU executor uses a
+fixed-capacity struct-of-arrays heap instead (:mod:`happysim_tpu.tpu.heap`);
+both honor the same (time, insertion-order) total order.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import TYPE_CHECKING, Optional, Union
+
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.instrumentation.recorder import TraceRecorder
+
+
+class EventHeap:
+    """Priority queue ordered by (event time, insertion order)."""
+
+    __slots__ = ("_heap", "_primary_count", "_recorder", "_current_time")
+
+    def __init__(self, recorder: "TraceRecorder | None" = None):
+        self._heap: list[Event] = []
+        self._primary_count = 0  # non-daemon, non-cancelled-at-push events
+        self._recorder = recorder
+        self._current_time = Instant.Epoch
+
+    def set_current_time(self, time: Instant) -> None:
+        self._current_time = time
+
+    def push(self, events: Union[Event, list[Event]]) -> None:
+        if isinstance(events, Event):
+            self._push_single(events)
+        else:
+            for event in events:
+                self._push_single(event)
+
+    def _push_single(self, event: Event) -> None:
+        heapq.heappush(self._heap, event)
+        if not event.daemon:
+            self._primary_count += 1
+        if self._recorder is not None:
+            self._recorder.record(
+                "heap.push",
+                time=self._current_time,
+                event=event,
+                data={"heap_size": len(self._heap)},
+            )
+
+    def pop(self) -> Event:
+        event = heapq.heappop(self._heap)
+        if not event.daemon:
+            self._primary_count -= 1
+        if self._recorder is not None:
+            self._recorder.record(
+                "heap.pop",
+                time=event.time,
+                event=event,
+                data={"heap_size": len(self._heap)},
+            )
+        return event
+
+    def peek(self) -> Optional[Event]:
+        return self._heap[0] if self._heap else None
+
+    def has_events(self) -> bool:
+        return bool(self._heap)
+
+    def has_primary_events(self) -> bool:
+        """O(1): any pending event that should block auto-termination?"""
+        return self._primary_count > 0
+
+    def size(self) -> int:
+        return len(self._heap)
+
+    def clear(self) -> None:
+        self._heap.clear()
+        self._primary_count = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __iter__(self):
+        """Unordered iteration over pending events (introspection only)."""
+        return iter(self._heap)

@@ -1,0 +1,143 @@
+"""Logical clocks: Lamport, vector, and hybrid-logical (HLC).
+
+Parity target: ``happysimulator/core/logical_clocks.py`` (``LamportClock``
+:52, ``VectorClock`` :98 with happened_before/is_concurrent/merge,
+``HLCTimestamp`` :213, ``HybridLogicalClock`` :274 — Kulkarni et al. 2014
+send/receive algorithm).
+
+Pure algorithm classes; entities store them as fields and drive them from
+message events.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.temporal import Instant
+
+
+class LamportClock:
+    """Scalar logical clock: ``max`` + increment on receive."""
+
+    def __init__(self, start: int = 0):
+        self._time = start
+
+    @property
+    def time(self) -> int:
+        return self._time
+
+    def tick(self) -> int:
+        """Local event or send: advance and return the new timestamp."""
+        self._time += 1
+        return self._time
+
+    def update(self, received: int) -> int:
+        """Receive: jump past the sender's timestamp."""
+        self._time = max(self._time, received) + 1
+        return self._time
+
+    def __repr__(self) -> str:
+        return f"LamportClock({self._time})"
+
+
+class VectorClock:
+    """Per-node counters supporting causality queries."""
+
+    def __init__(self, node_id: str, clocks: Optional[dict[str, int]] = None):
+        self.node_id = node_id
+        self._clocks: dict[str, int] = dict(clocks or {})
+        self._clocks.setdefault(node_id, 0)
+
+    @property
+    def clocks(self) -> dict[str, int]:
+        return dict(self._clocks)
+
+    def increment(self) -> "VectorClock":
+        self._clocks[self.node_id] = self._clocks.get(self.node_id, 0) + 1
+        return self
+
+    def merge(self, other: "VectorClock") -> "VectorClock":
+        """Receive: element-wise max, then increment own entry."""
+        for node, count in other._clocks.items():
+            self._clocks[node] = max(self._clocks.get(node, 0), count)
+        return self.increment()
+
+    def happened_before(self, other: "VectorClock") -> bool:
+        """self → other: self ≤ other element-wise with at least one <."""
+        strictly_less = False
+        for node in set(self._clocks) | set(other._clocks):
+            mine = self._clocks.get(node, 0)
+            theirs = other._clocks.get(node, 0)
+            if mine > theirs:
+                return False
+            if mine < theirs:
+                strictly_less = True
+        return strictly_less
+
+    def is_concurrent(self, other: "VectorClock") -> bool:
+        return not self.happened_before(other) and not other.happened_before(self)
+
+    def copy(self) -> "VectorClock":
+        return VectorClock(self.node_id, self._clocks)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, VectorClock):
+            return NotImplemented
+        nodes = set(self._clocks) | set(other._clocks)
+        return all(self._clocks.get(n, 0) == other._clocks.get(n, 0) for n in nodes)
+
+    def __repr__(self) -> str:
+        return f"VectorClock({self.node_id!r}, {self._clocks})"
+
+
+@dataclass(frozen=True, order=True)
+class HLCTimestamp:
+    """(wall, logical) pair; totally ordered."""
+
+    wall: int  # nanoseconds
+    logical: int
+
+    def __str__(self) -> str:
+        return f"{self.wall}.{self.logical}"
+
+
+class HybridLogicalClock:
+    """Hybrid logical clock (Kulkarni et al. 2014).
+
+    Stays close to physical time while preserving the happened-before
+    property of Lamport clocks.
+    """
+
+    def __init__(self):
+        self._wall = 0
+        self._logical = 0
+
+    @property
+    def timestamp(self) -> HLCTimestamp:
+        return HLCTimestamp(self._wall, self._logical)
+
+    def now(self, physical: Instant) -> HLCTimestamp:
+        """Local or send event."""
+        pt = physical.nanoseconds
+        if pt > self._wall:
+            self._wall = pt
+            self._logical = 0
+        else:
+            self._logical += 1
+        return self.timestamp
+
+    def receive(self, remote: HLCTimestamp, physical: Instant) -> HLCTimestamp:
+        """Receive algorithm: advance past max(local, remote, physical)."""
+        pt = physical.nanoseconds
+        new_wall = max(self._wall, remote.wall, pt)
+        if new_wall == self._wall and new_wall == remote.wall:
+            self._logical = max(self._logical, remote.logical) + 1
+        elif new_wall == self._wall:
+            self._logical += 1
+        elif new_wall == remote.wall:
+            self._logical = remote.logical + 1
+        else:
+            self._logical = 0
+        self._wall = new_wall
+        return self.timestamp

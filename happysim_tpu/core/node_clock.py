@@ -1,0 +1,74 @@
+"""Per-node perceived time (clock skew and drift models).
+
+Parity target: ``happysimulator/core/node_clock.py`` (``ClockModel`` :49,
+``FixedSkew`` :68, ``LinearDrift`` :91 in ppm, ``NodeClock`` :120).
+
+Events are always ordered by TRUE time; a NodeClock only changes what a node
+*believes* the time is — the essential ingredient for simulating clock-skew
+bugs in consensus/replication protocols.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, runtime_checkable
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.temporal import Duration, Instant
+
+
+@runtime_checkable
+class ClockModel(Protocol):
+    def read(self, true_time: Instant) -> Instant: ...
+
+
+class FixedSkew:
+    """Perceived = true + constant offset."""
+
+    def __init__(self, offset: Duration):
+        self._offset = offset
+
+    @property
+    def offset(self) -> Duration:
+        return self._offset
+
+    def read(self, true_time: Instant) -> Instant:
+        return true_time + self._offset
+
+
+class LinearDrift:
+    """Perceived runs fast/slow by ``rate_ppm`` parts-per-million."""
+
+    def __init__(self, rate_ppm: float):
+        self._rate_ppm = rate_ppm
+
+    @property
+    def rate_ppm(self) -> float:
+        return self._rate_ppm
+
+    def read(self, true_time: Instant) -> Instant:
+        drift_ns = round(true_time.nanoseconds * self._rate_ppm / 1_000_000)
+        return Instant(true_time.nanoseconds + drift_ns)
+
+
+class NodeClock:
+    """A node's view of time, derived from the shared true clock."""
+
+    def __init__(self, model: Optional[ClockModel] = None):
+        self._model = model
+        self._clock: Optional[Clock] = None
+
+    def set_clock(self, clock: Clock) -> None:
+        self._clock = clock
+
+    @property
+    def model(self) -> Optional[ClockModel]:
+        return self._model
+
+    @property
+    def now(self) -> Instant:
+        if self._clock is None:
+            raise RuntimeError("NodeClock not attached; call set_clock first")
+        true_time = self._clock.now
+        if self._model is None:
+            return true_time
+        return self._model.read(true_time)

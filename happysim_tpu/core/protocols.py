@@ -1,0 +1,32 @@
+"""Structural typing for simulation actors.
+
+Parity target: ``happysimulator/core/protocols.py`` (``Simulatable`` :58,
+``HasCapacity`` :98). Anything with ``handle_event``/``set_clock`` can take
+part in a simulation — inheritance from :class:`Entity` is optional.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Protocol, runtime_checkable
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.clock import Clock
+    from happysim_tpu.core.event import Event
+
+
+@runtime_checkable
+class Simulatable(Protocol):
+    """Duck-typed simulation actor."""
+
+    name: str
+
+    def set_clock(self, clock: "Clock") -> None: ...
+
+    def handle_event(self, event: "Event") -> Any: ...
+
+
+@runtime_checkable
+class HasCapacity(Protocol):
+    """Actors that can report back-pressure to queue drivers."""
+
+    def has_capacity(self) -> bool: ...

@@ -1,0 +1,151 @@
+"""Futures that park generator processes until a condition resolves.
+
+Parity target: ``happysimulator/core/sim_future.py`` (``SimFuture`` :100,
+``_park`` :160, ``resolve`` :188, resume-at-now :227-253; ``any_of`` :263 →
+(index, value); ``all_of`` :322 → list; contextvar active heap/clock :56-97).
+
+A generator yields a SimFuture to suspend; ``resolve(value)`` schedules the
+parked continuation at the *current* clock time, so resolution is causally
+ordered after the resolving event. Misuse detection mirrors the reference:
+double-park raises, resolving outside a running simulation raises.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.clock import Clock
+    from happysim_tpu.core.event import ProcessContinuation
+    from happysim_tpu.core.event_heap import EventHeap
+
+_active_heap: ContextVar[Optional["EventHeap"]] = ContextVar("hs_active_heap", default=None)
+_active_clock: ContextVar[Optional["Clock"]] = ContextVar("hs_active_clock", default=None)
+
+
+@contextmanager
+def _active_sim_context(heap: "EventHeap", clock: "Clock"):
+    """Installed by Simulation.run(); lets futures self-schedule."""
+    heap_token = _active_heap.set(heap)
+    clock_token = _active_clock.set(clock)
+    try:
+        yield
+    finally:
+        _active_heap.reset(heap_token)
+        _active_clock.reset(clock_token)
+
+
+def _get_active_heap() -> Optional["EventHeap"]:
+    return _active_heap.get()
+
+
+def _get_active_clock() -> Optional["Clock"]:
+    return _active_clock.get()
+
+
+class SimFuture:
+    """A one-shot resolvable value that a generator can wait on."""
+
+    __sim_future__ = True  # duck-type marker checked by ProcessContinuation
+
+    __slots__ = ("_resolved", "_value", "_continuation", "_callbacks")
+
+    def __init__(self) -> None:
+        self._resolved = False
+        self._value: Any = None
+        self._continuation: Optional["ProcessContinuation"] = None
+        self._callbacks: list[Callable[["SimFuture"], None]] = []
+
+    @property
+    def is_resolved(self) -> bool:
+        return self._resolved
+
+    @property
+    def value(self) -> Any:
+        if not self._resolved:
+            raise RuntimeError("SimFuture value read before resolution")
+        return self._value
+
+    # -- engine-side -------------------------------------------------------
+    def _park(self, continuation: "ProcessContinuation") -> None:
+        if self._continuation is not None:
+            raise RuntimeError(
+                "SimFuture already has a parked process; a future can only be "
+                "awaited by one generator"
+            )
+        if self._resolved:
+            # Pre-resolved (e.g. Resource grant available immediately):
+            # resume right away at current time.
+            self._continuation = continuation
+            self._resume()
+        else:
+            self._continuation = continuation
+
+    def resolve(self, value: Any = None) -> None:
+        """Settle the future; wakes the parked process at clock.now."""
+        if self._resolved:
+            return
+        self._resolved = True
+        self._value = value
+        self._fire_callbacks()
+        if self._continuation is not None:
+            self._resume()
+
+    def _add_settle_callback(self, fn: Callable[["SimFuture"], None]) -> None:
+        if self._resolved:
+            fn(self)
+        else:
+            self._callbacks.append(fn)
+
+    def _fire_callbacks(self) -> None:
+        callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(self)
+
+    def _resume(self) -> None:
+        heap = _get_active_heap()
+        clock = _get_active_clock()
+        if heap is None or clock is None:
+            raise RuntimeError(
+                "SimFuture resolved outside a running simulation; futures may "
+                "only be resolved from event handlers"
+            )
+        continuation, self._continuation = self._continuation, None
+        heap.push(continuation.resume_at(clock.now, self._value))
+
+    def __repr__(self) -> str:
+        state = f"resolved={self._value!r}" if self._resolved else "pending"
+        return f"SimFuture({state})"
+
+
+def any_of(*futures: SimFuture) -> SimFuture:
+    """Future resolving with ``(index, value)`` of the first settled child.
+
+    The canonical building block for timeouts and hedged requests.
+    """
+    combined = SimFuture()
+    for index, future in enumerate(futures):
+        def on_settle(settled: SimFuture, index: int = index) -> None:
+            combined.resolve((index, settled._value))
+        future._add_settle_callback(on_settle)
+    return combined
+
+
+def all_of(*futures: SimFuture) -> SimFuture:
+    """Future resolving with the list of all child values (quorum waits)."""
+    combined = SimFuture()
+    remaining = len(futures)
+    if remaining == 0:
+        combined.resolve([])
+        return combined
+    state = {"remaining": remaining}
+
+    for future in futures:
+        def on_settle(settled: SimFuture) -> None:
+            state["remaining"] -= 1
+            if state["remaining"] == 0:
+                combined.resolve([f._value for f in futures])
+        future._add_settle_callback(on_settle)
+    return combined

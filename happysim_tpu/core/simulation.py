@@ -1,0 +1,358 @@
+"""The discrete-event simulation engine (host executor).
+
+Parity target: ``happysimulator/core/simulation.py`` (``Simulation`` :38 —
+ctor bootstrap :145-169, ``run()`` :230, fast/slow loops :290-507, windowed
+execution for the parallel runtime :527, ``schedule`` + pre-run replay
+:195-228, summary harvesting :543-591, auto-termination on daemon-only heap
+:312-322, time-travel warning :331, ``_event_router`` hook :124-126).
+
+This is executor #1 of the rebuild's two-executor architecture: a clean
+pop-invoke-push loop over a binary heap, fully general (generators, futures,
+arbitrary components), and the correctness oracle for the TPU ensemble
+executor (:mod:`happysim_tpu.tpu`), which compiles restricted models to a
+single XLA program.
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _wall
+from typing import TYPE_CHECKING, Callable, Optional, Union
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.event import (
+    Event,
+    _active_debugger_context,
+    reset_event_counter,
+)
+from happysim_tpu.core.event_heap import EventHeap
+from happysim_tpu.core.sim_future import _active_sim_context
+from happysim_tpu.core.temporal import Duration, Instant, as_instant
+from happysim_tpu.instrumentation.summary import EntitySummary, SimulationSummary
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.control.control import SimulationControl
+    from happysim_tpu.core.protocols import Simulatable
+    from happysim_tpu.faults.schedule import FaultSchedule
+    from happysim_tpu.instrumentation.recorder import TraceRecorder
+    from happysim_tpu.load.source import Source
+
+logger = logging.getLogger("happysim_tpu.core.simulation")
+
+EventRouter = Callable[[list[Event]], list[Event]]
+
+
+class Simulation:
+    """Orchestrates entities, sources, probes, and faults over an event heap."""
+
+    def __init__(
+        self,
+        start_time: Instant | None = None,
+        end_time: Instant | float | None = None,
+        sources: "list[Source] | None" = None,
+        entities: "list[Simulatable] | None" = None,
+        probes: "list[Source] | None" = None,
+        trace_recorder: "TraceRecorder | None" = None,
+        fault_schedule: "FaultSchedule | None" = None,
+        duration: float | Duration | None = None,
+    ):
+        reset_event_counter()
+        if duration is not None and end_time is not None:
+            raise ValueError("Specify either 'duration' or 'end_time', not both")
+        self._start = start_time if start_time is not None else Instant.Epoch
+        if duration is not None:
+            self._end = self._start + (
+                duration.to_seconds() if isinstance(duration, Duration) else duration
+            )
+        elif end_time is not None:
+            self._end = as_instant(end_time)
+        else:
+            self._end = Instant.Infinity
+
+        self._clock = Clock(self._start)
+        self._recorder = trace_recorder
+        self._event_heap = EventHeap(recorder=trace_recorder)
+        self.sources = list(sources or [])
+        self.entities = list(entities or [])
+        self.probes = list(probes or [])
+        self.fault_schedule = fault_schedule
+
+        self._event_router: Optional[EventRouter] = None
+        self._control: "SimulationControl | None" = None
+        self._code_debugger = None  # set by the visual debugger
+        self._is_running = False
+        self._completed = False
+        self._pause_requested = False
+        self._events_processed = 0
+        self._wall_seconds = 0.0
+        self._pre_run_events: list[Event] = []
+        self._time_travel_warned = False
+
+        self._bootstrap()
+
+    # -- bootstrap ---------------------------------------------------------
+    def _bootstrap(self) -> None:
+        """Inject the shared clock and prime sources/probes/faults."""
+        for collection in (self.entities, self.sources, self.probes):
+            for obj in collection:
+                obj.set_clock(self._clock)
+        if self._recorder is not None:
+            self._recorder.record("simulation.init", time=self._start)
+        for source in self.sources:
+            self._event_heap.push(source.start(self._start))
+        for probe in self.probes:
+            self._event_heap.push(probe.start(self._start))
+        if self.fault_schedule is not None:
+            self.fault_schedule.set_clock(self._clock)
+            self.fault_schedule.bind(self)
+            self._event_heap.push(self.fault_schedule.start(self._start))
+
+    # -- public surface ----------------------------------------------------
+    @property
+    def clock(self) -> Clock:
+        return self._clock
+
+    @property
+    def now(self) -> Instant:
+        return self._clock.now
+
+    @property
+    def end_time(self) -> Instant:
+        return self._end
+
+    @property
+    def events_processed(self) -> int:
+        return self._events_processed
+
+    @property
+    def event_heap(self) -> EventHeap:
+        return self._event_heap
+
+    @property
+    def control(self) -> "SimulationControl":
+        """Interactive control surface; lazily created, zero cost unless used."""
+        if self._control is None:
+            from happysim_tpu.core.control.control import SimulationControl
+
+            self._control = SimulationControl(self)
+        return self._control
+
+    def schedule(self, events: Union[Event, list[Event]]) -> None:
+        """Inject events from outside the loop (pre-run events replay on reset)."""
+        self._event_heap.push(events)
+        if not self._is_running:
+            if isinstance(events, Event):
+                self._pre_run_events.append(events)
+            else:
+                self._pre_run_events.extend(events)
+
+    def find_entity(self, name: str):
+        for entity in self.entities:
+            if getattr(entity, "name", None) == name:
+                return entity
+        return None
+
+    def run(self) -> SimulationSummary:
+        """Run to completion or pause; re-entrant after a pause."""
+        if self._completed:
+            return self._build_summary()
+        self._is_running = True
+        self._pause_requested = False
+        wall_start = _wall.perf_counter()
+        if self._recorder is not None:
+            self._recorder.record("simulation.start", time=self._clock.now)
+        try:
+            with _active_sim_context(self._event_heap, self._clock), _active_debugger_context(
+                self._code_debugger
+            ):
+                paused = self._run_loop()
+        finally:
+            self._wall_seconds += _wall.perf_counter() - wall_start
+        if not paused:
+            self._completed = True
+            if not self._end.is_infinite():
+                self._clock.update(self._end)
+        if self._recorder is not None:
+            self._recorder.record("simulation.end", time=self._clock.now)
+        return self._build_summary()
+
+    # -- loops -------------------------------------------------------------
+    def _run_loop(self) -> bool:
+        """Returns True if paused (vs. ran to completion)."""
+        control = self._control
+        slow = (
+            (control is not None and control._needs_loop_hooks())
+            or self._recorder is not None
+            or self._code_debugger is not None
+        )
+        if slow:
+            return self._run_loop_slow()
+        self._execute_until(self._end)
+        return False
+
+    def _execute_until(self, end: Instant, *, window: bool = False) -> int:
+        """The hot loop: pop → invoke → push. Returns events processed.
+
+        With ``window=True`` (parallel runtime), daemon-only auto-termination
+        is disabled and events at exactly ``end`` are left pending, so the
+        coordinator owns the time horizon.
+        """
+        heap = self._event_heap
+        heap_list = heap._heap
+        pop = heap.pop
+        push = heap.push
+        clock = self._clock
+        router = self._event_router
+        # Normal runs process events at exactly `end`; windowed runs leave them
+        # for the next window (the exchange happens at the boundary).
+        limit_ns = end.nanoseconds - 1 if window else end.nanoseconds
+        processed = 0
+        while heap_list:
+            if not window and not heap.has_primary_events():
+                break  # only daemon events remain → nothing can change
+            if heap_list[0].time.nanoseconds > limit_ns:
+                break
+            event = pop()
+            if event._cancelled:
+                continue
+            event_time_ns = event.time.nanoseconds
+            if event_time_ns < clock._now.nanoseconds:
+                self._warn_time_travel(event)
+                continue
+            clock._now = event.time
+            processed += 1
+            new_events = event.invoke()
+            if new_events:
+                if router is not None:
+                    new_events = router(new_events)
+                if new_events:
+                    push(new_events)
+        self._events_processed += processed
+        return processed
+
+    def _run_loop_slow(self) -> bool:
+        """Full-featured loop: control, breakpoints, hooks, tracing."""
+        heap = self._event_heap
+        clock = self._clock
+        control = self._control
+        recorder = self._recorder
+        router = self._event_router
+        end_ns = self._end.nanoseconds
+        while heap.has_events():
+            if control is not None:
+                if control._consume_pause_request():
+                    return True
+            if not heap.has_primary_events():
+                break
+            head = heap.peek()
+            if head.time.nanoseconds > end_ns:
+                break
+            if control is not None and control._check_breakpoints(head):
+                return True
+            event = heap.pop()
+            if event._cancelled:
+                continue
+            if event.time.nanoseconds < clock._now.nanoseconds:
+                self._warn_time_travel(event)
+                continue
+            time_advanced = event.time.nanoseconds > clock._now.nanoseconds
+            clock.update(event.time)
+            if recorder is not None:
+                recorder.record("simulation.dequeue", time=event.time, event=event)
+            self._events_processed += 1
+            new_events = event.invoke()
+            if new_events:
+                if router is not None:
+                    new_events = router(new_events)
+                for produced in new_events:
+                    if recorder is not None:
+                        recorder.record("simulation.schedule", time=clock.now, event=produced)
+                heap.push(new_events)
+            if control is not None:
+                control._after_event(event, time_advanced)
+                if control._step_exhausted():
+                    return True
+        return False
+
+    def _run_window(self, until: Instant) -> int:
+        """Execute strictly below ``until`` for the windowed coordinator."""
+        with _active_sim_context(self._event_heap, self._clock):
+            return self._execute_until(until, window=True)
+
+    def _warn_time_travel(self, event: Event) -> None:
+        if not self._time_travel_warned:
+            self._time_travel_warned = True
+            logger.warning(
+                "Event %r scheduled at %s which is before current time %s; "
+                "skipping (further occurrences suppressed)",
+                event.event_type,
+                event.time,
+                self._clock.now,
+            )
+
+    # -- reset (used by control) ------------------------------------------
+    def _reset(self) -> None:
+        """Clear state and re-prime sources/probes/faults + pre-run events."""
+        reset_event_counter()
+        self._event_heap.clear()
+        self._clock.update(self._start)
+        self._events_processed = 0
+        self._wall_seconds = 0.0
+        self._completed = False
+        self._is_running = False
+        self._time_travel_warned = False
+        for source in self.sources:
+            if hasattr(source, "reset"):
+                source.reset()
+            self._event_heap.push(source.start(self._start))
+        for probe in self.probes:
+            if hasattr(probe, "reset"):
+                probe.reset()
+            self._event_heap.push(probe.start(self._start))
+        if self.fault_schedule is not None:
+            self._event_heap.push(self.fault_schedule.start(self._start))
+        replay, self._pre_run_events = self._pre_run_events, []
+        for spec in replay:
+            clone = Event(
+                time=spec.time,
+                event_type=spec.event_type,
+                target=spec.target,
+                daemon=spec.daemon,
+            )
+            self.schedule(clone)
+
+    # -- summary -----------------------------------------------------------
+    def _build_summary(self) -> SimulationSummary:
+        entities: list[EntitySummary] = []
+        seen = set()
+        for obj in (*self.entities, *self.sources):
+            if id(obj) in seen:
+                continue
+            seen.add(id(obj))
+            extra = {}
+            stats = getattr(obj, "stats", None)
+            if callable(stats):
+                try:
+                    stats = stats()
+                except TypeError:
+                    stats = None
+            if stats is not None and hasattr(stats, "__dataclass_fields__"):
+                extra = {k: getattr(stats, k) for k in stats.__dataclass_fields__}
+            entities.append(
+                EntitySummary(
+                    name=getattr(obj, "name", type(obj).__name__),
+                    kind=type(obj).__name__,
+                    events_received=getattr(obj, "events_received", None),
+                    count=getattr(obj, "count", None),
+                    extra=extra,
+                )
+            )
+        return SimulationSummary(
+            start_time=self._start,
+            end_time=self._clock.now,
+            events_processed=self._events_processed,
+            wall_clock_seconds=self._wall_seconds,
+            entities=entities,
+            completed=self._completed,
+            backend="python",
+        )

@@ -1,0 +1,281 @@
+"""Integer-nanosecond simulation time.
+
+Parity target: ``happysimulator/core/temporal.py`` (reference ``Duration`` :22,
+``Instant`` :165, ``_InfiniteInstant`` :298, singletons :366-368).
+
+Design notes (TPU-first rebuild):
+- Time is a point (`Instant`) or a span (`Duration`), both backed by a single
+  Python ``int`` of nanoseconds. Integer time makes event ordering exact and
+  maps 1:1 onto the TPU executor's ``int64`` time arrays
+  (see :mod:`happysim_tpu.tpu`), where the same nanosecond convention is used
+  so host-path and device-path timestamps are interchangeable.
+- Bare ``int``/``float`` operands in arithmetic are interpreted as SECONDS
+  (the reference convention: ``yield 0.1`` is 100 ms).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+NANOS_PER_SECOND = 1_000_000_000
+NANOS_PER_MILLI = 1_000_000
+NANOS_PER_MICRO = 1_000
+
+_INFINITY_NS = (1 << 63) - 1  # sentinel, matches int64 max on device
+
+
+def _seconds_to_nanos(seconds: Union[int, float]) -> int:
+    return round(seconds * NANOS_PER_SECOND)
+
+
+class Duration:
+    """A signed span of time with nanosecond resolution."""
+
+    __slots__ = ("nanoseconds",)
+
+    def __init__(self, nanoseconds: int):
+        self.nanoseconds = int(nanoseconds)
+
+    # -- factories ---------------------------------------------------------
+    @classmethod
+    def from_seconds(cls, seconds: Union[int, float]) -> "Duration":
+        return cls(_seconds_to_nanos(seconds))
+
+    @classmethod
+    def from_millis(cls, millis: Union[int, float]) -> "Duration":
+        return cls(round(millis * NANOS_PER_MILLI))
+
+    @classmethod
+    def from_micros(cls, micros: Union[int, float]) -> "Duration":
+        return cls(round(micros * NANOS_PER_MICRO))
+
+    @classmethod
+    def from_nanos(cls, nanos: int) -> "Duration":
+        return cls(nanos)
+
+    # -- conversions -------------------------------------------------------
+    def to_seconds(self) -> float:
+        return self.nanoseconds / NANOS_PER_SECOND
+
+    def to_millis(self) -> float:
+        return self.nanoseconds / NANOS_PER_MILLI
+
+    # -- arithmetic (bare numbers are seconds) -----------------------------
+    def __add__(self, other: Union["Duration", int, float]) -> "Duration":
+        if isinstance(other, Duration):
+            return Duration(self.nanoseconds + other.nanoseconds)
+        if isinstance(other, (int, float)):
+            return Duration(self.nanoseconds + _seconds_to_nanos(other))
+        return NotImplemented
+
+    def __radd__(self, other: Union[int, float]) -> "Duration":
+        return self.__add__(other)
+
+    def __sub__(self, other: Union["Duration", int, float]) -> "Duration":
+        if isinstance(other, Duration):
+            return Duration(self.nanoseconds - other.nanoseconds)
+        if isinstance(other, (int, float)):
+            return Duration(self.nanoseconds - _seconds_to_nanos(other))
+        return NotImplemented
+
+    def __mul__(self, other: Union[int, float]) -> "Duration":
+        if isinstance(other, (int, float)):
+            return Duration(round(self.nanoseconds * other))
+        return NotImplemented
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other: Union["Duration", int, float]):
+        if isinstance(other, Duration):
+            return self.nanoseconds / other.nanoseconds
+        if isinstance(other, (int, float)):
+            return Duration(round(self.nanoseconds / other))
+        return NotImplemented
+
+    def __neg__(self) -> "Duration":
+        return Duration(-self.nanoseconds)
+
+    def __abs__(self) -> "Duration":
+        return Duration(abs(self.nanoseconds))
+
+    # -- comparisons -------------------------------------------------------
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Duration):
+            return self.nanoseconds == other.nanoseconds
+        if isinstance(other, (int, float)):
+            return self.nanoseconds == _seconds_to_nanos(other)
+        return NotImplemented
+
+    def __lt__(self, other: "Duration") -> bool:
+        if isinstance(other, Duration):
+            return self.nanoseconds < other.nanoseconds
+        if isinstance(other, (int, float)):
+            return self.nanoseconds < _seconds_to_nanos(other)
+        return NotImplemented
+
+    def __le__(self, other: "Duration") -> bool:
+        if isinstance(other, Duration):
+            return self.nanoseconds <= other.nanoseconds
+        if isinstance(other, (int, float)):
+            return self.nanoseconds <= _seconds_to_nanos(other)
+        return NotImplemented
+
+    def __gt__(self, other: "Duration") -> bool:
+        result = self.__le__(other)
+        return NotImplemented if result is NotImplemented else not result
+
+    def __ge__(self, other: "Duration") -> bool:
+        result = self.__lt__(other)
+        return NotImplemented if result is NotImplemented else not result
+
+    def __hash__(self) -> int:
+        return hash(("Duration", self.nanoseconds))
+
+    def __repr__(self) -> str:
+        return f"Duration({self.to_seconds():.9g}s)"
+
+
+class Instant:
+    """A point on the simulation timeline (nanoseconds since epoch)."""
+
+    __slots__ = ("nanoseconds",)
+
+    # populated after class definitions below
+    Epoch: "Instant"
+    Infinity: "Instant"
+
+    def __init__(self, nanoseconds: int):
+        self.nanoseconds = int(nanoseconds)
+
+    @classmethod
+    def from_seconds(cls, seconds: Union[int, float]) -> "Instant":
+        return cls(_seconds_to_nanos(seconds))
+
+    @classmethod
+    def from_nanos(cls, nanos: int) -> "Instant":
+        return cls(nanos)
+
+    def to_seconds(self) -> float:
+        return self.nanoseconds / NANOS_PER_SECOND
+
+    def is_infinite(self) -> bool:
+        return False
+
+    # -- arithmetic --------------------------------------------------------
+    def __add__(self, other: Union[Duration, int, float]) -> "Instant":
+        if isinstance(other, Duration):
+            return Instant(self.nanoseconds + other.nanoseconds)
+        if isinstance(other, (int, float)):
+            return Instant(self.nanoseconds + _seconds_to_nanos(other))
+        return NotImplemented
+
+    def __radd__(self, other: Union[int, float]) -> "Instant":
+        return self.__add__(other)
+
+    def __sub__(
+        self, other: Union["Instant", Duration, int, float]
+    ) -> Union["Instant", Duration]:
+        if isinstance(other, Instant):
+            return Duration(self.nanoseconds - other.nanoseconds)
+        if isinstance(other, Duration):
+            return Instant(self.nanoseconds - other.nanoseconds)
+        if isinstance(other, (int, float)):
+            return Instant(self.nanoseconds - _seconds_to_nanos(other))
+        return NotImplemented
+
+    # -- comparisons -------------------------------------------------------
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Instant):
+            return self.nanoseconds == other.nanoseconds and not other.is_infinite()
+        return NotImplemented
+
+    def __lt__(self, other: "Instant") -> bool:
+        if isinstance(other, Instant):
+            return other.is_infinite() or self.nanoseconds < other.nanoseconds
+        return NotImplemented
+
+    def __le__(self, other: "Instant") -> bool:
+        if isinstance(other, Instant):
+            return other.is_infinite() or self.nanoseconds <= other.nanoseconds
+        return NotImplemented
+
+    def __gt__(self, other: "Instant") -> bool:
+        if isinstance(other, Instant):
+            return not other.is_infinite() and self.nanoseconds > other.nanoseconds
+        return NotImplemented
+
+    def __ge__(self, other: "Instant") -> bool:
+        if isinstance(other, Instant):
+            return not other.is_infinite() and self.nanoseconds >= other.nanoseconds
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(("Instant", self.nanoseconds))
+
+    def __repr__(self) -> str:
+        return f"Instant({self.to_seconds():.9g}s)"
+
+
+class _InfiniteInstant(Instant):
+    """Instant strictly after every finite instant (reference :298)."""
+
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__(_INFINITY_NS)
+
+    def is_infinite(self) -> bool:
+        return True
+
+    def __add__(self, other):
+        return self
+
+    def __sub__(self, other):
+        if isinstance(other, _InfiniteInstant):
+            raise ArithmeticError("Infinity - Infinity is undefined")
+        if isinstance(other, Instant):
+            return Duration(_INFINITY_NS)
+        return self
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, _InfiniteInstant)
+
+    def __lt__(self, other: "Instant") -> bool:
+        return False
+
+    def __le__(self, other: "Instant") -> bool:
+        return other.is_infinite()
+
+    def __gt__(self, other: "Instant") -> bool:
+        return not other.is_infinite()
+
+    def __ge__(self, other: "Instant") -> bool:
+        return True
+
+    def __hash__(self) -> int:
+        return hash("Instant.Infinity")
+
+    def to_seconds(self) -> float:
+        return float("inf")
+
+    def __repr__(self) -> str:
+        return "Instant.Infinity"
+
+
+Instant.Epoch = Instant(0)
+Instant.Infinity = _InfiniteInstant()
+Duration.ZERO = Duration(0)
+
+
+def as_instant(value: Union[Instant, int, float]) -> Instant:
+    """Coerce seconds-or-Instant to Instant (helper used across the API)."""
+    if isinstance(value, Instant):
+        return value
+    return Instant.from_seconds(value)
+
+
+def as_duration(value: Union[Duration, int, float]) -> Duration:
+    """Coerce seconds-or-Duration to Duration."""
+    if isinstance(value, Duration):
+        return value
+    return Duration.from_seconds(value)

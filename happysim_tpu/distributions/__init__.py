@@ -1,0 +1,25 @@
+from happysim_tpu.distributions.latency_distribution import (
+    ConstantLatency,
+    ExponentialLatency,
+    LatencyDistribution,
+    PercentileFittedLatency,
+    ShiftedLatency,
+    UniformLatency,
+)
+from happysim_tpu.distributions.value_distribution import (
+    UniformDistribution,
+    ValueDistribution,
+    ZipfDistribution,
+)
+
+__all__ = [
+    "ConstantLatency",
+    "ExponentialLatency",
+    "LatencyDistribution",
+    "PercentileFittedLatency",
+    "ShiftedLatency",
+    "UniformDistribution",
+    "UniformLatency",
+    "ValueDistribution",
+    "ZipfDistribution",
+]

@@ -1,0 +1,165 @@
+"""Latency distributions (service times, network delays).
+
+Parity target: ``happysimulator/distributions/`` —
+``LatencyDistribution`` ABC (latency_distribution.py:52-62 with mean
+adjustment), ``ConstantLatency`` (constant.py), ``ExponentialLatency``
+(exponential.py:43), ``PercentileFittedLatency`` (percentile_fitted.py,
+least-squares exponential fit).
+
+Rebuild improvements over the reference:
+- Every stochastic distribution takes an optional ``seed`` and owns a private
+  ``random.Random`` stream (the reference's exponential uses the global RNG).
+- Each distribution exposes ``tpu_spec()`` describing itself as
+  ``(kind, params)`` so the TPU executor can sample the same law with
+  ``jax.random`` per-replica keys (see happysim_tpu/tpu/engine.py).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from abc import ABC, abstractmethod
+from typing import Optional, Sequence
+
+from happysim_tpu.core.temporal import Duration, Instant, as_duration
+
+
+class LatencyDistribution(ABC):
+    """Samples a non-negative delay, possibly time-dependent."""
+
+    @abstractmethod
+    def get_latency(self, time: Instant) -> Duration:
+        """Sample a latency at simulated time ``time``."""
+
+    @abstractmethod
+    def mean(self) -> Duration:
+        """Expected value (used by mean-shift arithmetic and analysis)."""
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        """(kind, params) for device-side sampling; override per subclass."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no TPU sampling equivalent"
+        )
+
+    # Mean adjustment: dist + 0.005 shifts every sample by +5 ms.
+    def __add__(self, offset) -> "ShiftedLatency":
+        return ShiftedLatency(self, as_duration(offset))
+
+    def __sub__(self, offset) -> "ShiftedLatency":
+        return ShiftedLatency(self, as_duration(offset) * -1)
+
+
+class ShiftedLatency(LatencyDistribution):
+    """base + constant shift, clamped at zero."""
+
+    def __init__(self, base: LatencyDistribution, shift: Duration):
+        self._base = base
+        self._shift = shift
+
+    def get_latency(self, time: Instant) -> Duration:
+        sample = self._base.get_latency(time) + self._shift
+        return sample if sample.nanoseconds > 0 else Duration.ZERO
+
+    def mean(self) -> Duration:
+        return self._base.mean() + self._shift
+
+
+class ConstantLatency(LatencyDistribution):
+    """Always the same delay — the determinism workhorse for tests."""
+
+    def __init__(self, latency: Duration | float):
+        self._latency = as_duration(latency)
+
+    def get_latency(self, time: Instant) -> Duration:
+        return self._latency
+
+    def mean(self) -> Duration:
+        return self._latency
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("constant", {"value_s": self._latency.to_seconds()})
+
+    def __repr__(self) -> str:
+        return f"ConstantLatency({self._latency!r})"
+
+
+class ExponentialLatency(LatencyDistribution):
+    """Exponentially distributed delay with the given mean (M/M/* service)."""
+
+    def __init__(self, mean: Duration | float, seed: Optional[int] = None):
+        self._mean = as_duration(mean)
+        if self._mean.nanoseconds <= 0:
+            raise ValueError("ExponentialLatency mean must be positive")
+        self._rng = random.Random(seed)
+
+    def get_latency(self, time: Instant) -> Duration:
+        return Duration(round(self._rng.expovariate(1.0) * self._mean.nanoseconds))
+
+    def mean(self) -> Duration:
+        return self._mean
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("exponential", {"mean_s": self._mean.to_seconds()})
+
+    def __repr__(self) -> str:
+        return f"ExponentialLatency(mean={self._mean!r})"
+
+
+class UniformLatency(LatencyDistribution):
+    """Uniform delay in [low, high]."""
+
+    def __init__(self, low: Duration | float, high: Duration | float, seed: Optional[int] = None):
+        self._low = as_duration(low)
+        self._high = as_duration(high)
+        if self._high < self._low:
+            raise ValueError("UniformLatency requires low <= high")
+        self._rng = random.Random(seed)
+
+    def get_latency(self, time: Instant) -> Duration:
+        return Duration(self._rng.randint(self._low.nanoseconds, self._high.nanoseconds))
+
+    def mean(self) -> Duration:
+        return Duration((self._low.nanoseconds + self._high.nanoseconds) // 2)
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return (
+            "uniform",
+            {"low_s": self._low.to_seconds(), "high_s": self._high.to_seconds()},
+        )
+
+
+class PercentileFittedLatency(LatencyDistribution):
+    """Exponential fit through observed percentile points.
+
+    Given ``{0.50: 10ms, 0.99: 60ms}`` fits the exponential mean by least
+    squares on v_i = m * (-ln(1 - p_i)) and samples from the fitted law
+    (reference percentile_fitted.py's approach, re-derived).
+    """
+
+    def __init__(self, percentiles: dict[float, Duration | float], seed: Optional[int] = None):
+        if not percentiles:
+            raise ValueError("PercentileFittedLatency requires at least one point")
+        xs: list[float] = []
+        vs: list[float] = []
+        for p, v in percentiles.items():
+            if not 0.0 < p < 1.0:
+                raise ValueError(f"Percentile {p} must be in (0, 1)")
+            xs.append(-math.log1p(-p))
+            vs.append(as_duration(v).to_seconds())
+        self._fitted_mean_s = sum(x * v for x, v in zip(xs, vs)) / sum(x * x for x in xs)
+        if self._fitted_mean_s <= 0:
+            raise ValueError("Fitted mean is non-positive; check percentile points")
+        self._rng = random.Random(seed)
+
+    @property
+    def fitted_mean_seconds(self) -> float:
+        return self._fitted_mean_s
+
+    def get_latency(self, time: Instant) -> Duration:
+        return Duration.from_seconds(self._rng.expovariate(1.0 / self._fitted_mean_s))
+
+    def mean(self) -> Duration:
+        return Duration.from_seconds(self._fitted_mean_s)
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("exponential", {"mean_s": self._fitted_mean_s})

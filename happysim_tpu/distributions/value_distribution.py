@@ -1,0 +1,88 @@
+"""Value distributions (key popularity, payload fields).
+
+Parity target: ``happysimulator/distributions/value_distribution.py`` (generic
+``ValueDistribution[T]`` ABC), ``zipf.py`` (inverse-transform with precomputed
+CDF + bisect), ``uniform.py`` (seeded choice). All streams are seeded per
+instance. The Zipf CDF precompute is exactly what the TPU path turns into a
+``jnp.searchsorted`` over uniform draws.
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+from abc import ABC, abstractmethod
+from typing import Generic, Optional, Sequence, TypeVar, Union
+
+T = TypeVar("T")
+
+
+class ValueDistribution(ABC, Generic[T]):
+    """Samples values of type T."""
+
+    @abstractmethod
+    def sample(self) -> T: ...
+
+
+class UniformDistribution(ValueDistribution[T]):
+    """Uniform choice over items, or uniform float in [low, high)."""
+
+    def __init__(
+        self,
+        items: Optional[Sequence[T]] = None,
+        low: Optional[float] = None,
+        high: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        if items is None and (low is None or high is None):
+            raise ValueError("Provide items, or both low and high")
+        self._items = list(items) if items is not None else None
+        self._low = low
+        self._high = high
+        self._rng = random.Random(seed)
+
+    def sample(self) -> T:
+        if self._items is not None:
+            return self._rng.choice(self._items)
+        return self._rng.uniform(self._low, self._high)  # type: ignore[return-value]
+
+
+class ZipfDistribution(ValueDistribution[T]):
+    """Zipf-like popularity over a finite item set.
+
+    P(rank k) ∝ 1 / k^exponent. Sampling is inverse-transform: one uniform
+    draw + binary search over the precomputed CDF.
+    """
+
+    def __init__(
+        self,
+        items: Union[int, Sequence[T]],
+        exponent: float = 1.0,
+        seed: Optional[int] = None,
+    ):
+        if isinstance(items, int):
+            if items <= 0:
+                raise ValueError("ZipfDistribution needs at least one item")
+            self._items: list = list(range(items))
+        else:
+            self._items = list(items)
+            if not self._items:
+                raise ValueError("ZipfDistribution needs at least one item")
+        self.exponent = exponent
+        weights = [1.0 / (rank ** exponent) for rank in range(1, len(self._items) + 1)]
+        total = sum(weights)
+        cumulative = 0.0
+        self._cdf: list[float] = []
+        for w in weights:
+            cumulative += w / total
+            self._cdf.append(cumulative)
+        self._cdf[-1] = 1.0  # guard fp drift
+        self._rng = random.Random(seed)
+
+    @property
+    def cdf(self) -> list[float]:
+        return list(self._cdf)
+
+    def sample(self) -> T:
+        u = self._rng.random()
+        return self._items[bisect.bisect_left(self._cdf, u)]

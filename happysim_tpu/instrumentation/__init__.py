@@ -1,0 +1,29 @@
+from happysim_tpu.instrumentation.collectors import LatencyTracker, ThroughputTracker
+from happysim_tpu.instrumentation.data import BucketedData, Data
+from happysim_tpu.instrumentation.probe import Probe
+from happysim_tpu.instrumentation.recorder import (
+    InMemoryTraceRecorder,
+    NullTraceRecorder,
+    TraceRecord,
+    TraceRecorder,
+)
+from happysim_tpu.instrumentation.summary import (
+    EntitySummary,
+    QueueStats,
+    SimulationSummary,
+)
+
+__all__ = [
+    "BucketedData",
+    "Data",
+    "EntitySummary",
+    "LatencyTracker",
+    "Probe",
+    "ThroughputTracker",
+    "InMemoryTraceRecorder",
+    "NullTraceRecorder",
+    "QueueStats",
+    "SimulationSummary",
+    "TraceRecord",
+    "TraceRecorder",
+]

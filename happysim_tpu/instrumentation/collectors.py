@@ -1,0 +1,65 @@
+"""Event-stream trackers.
+
+Parity target: ``happysimulator/instrumentation/collectors.py``
+(``LatencyTracker`` :18 — latency = event.time − context['created_at'];
+``ThroughputTracker`` :64).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.instrumentation.data import Data
+
+
+class LatencyTracker(Entity):
+    """Records end-to-end latency of events flowing through, then forwards."""
+
+    def __init__(self, name: str = "LatencyTracker", downstream: Optional[Entity] = None):
+        super().__init__(name)
+        self.downstream = downstream
+        self.latencies = Data(f"{name}.latency_s")
+        self.events_received = 0
+
+    def handle_event(self, event: Event):
+        self.events_received += 1
+        created_at = event.context.get("created_at")
+        if created_at is not None:
+            self.latencies.add(event.time, (event.time - created_at).to_seconds())
+        if self.downstream is not None:
+            return [self.forward(event, self.downstream)]
+        return None
+
+    def downstream_entities(self):
+        return [self.downstream] if self.downstream is not None else []
+
+
+class ThroughputTracker(Entity):
+    """Counts events per window into a rate series, then forwards."""
+
+    def __init__(
+        self,
+        name: str = "ThroughputTracker",
+        window_s: float = 1.0,
+        downstream: Optional[Entity] = None,
+    ):
+        super().__init__(name)
+        self.window_s = window_s
+        self.downstream = downstream
+        self.arrivals = Data(f"{name}.arrivals")
+        self.events_received = 0
+
+    def handle_event(self, event: Event):
+        self.events_received += 1
+        self.arrivals.add(event.time, 1.0)
+        if self.downstream is not None:
+            return [self.forward(event, self.downstream)]
+        return None
+
+    def throughput(self) -> Data:
+        return self.arrivals.rate(self.window_s)
+
+    def downstream_entities(self):
+        return [self.downstream] if self.downstream is not None else []

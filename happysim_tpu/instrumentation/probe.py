@@ -1,0 +1,79 @@
+"""Periodic metric polling as daemon events.
+
+Parity target: ``happysimulator/instrumentation/probe.py:81`` (``Probe`` —
+getattr-based polling at a fixed interval; ``Probe.on`` :128,
+``Probe.on_many`` :144). Probes schedule daemon ticks so they never block
+auto-termination.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.instrumentation.data import Data
+
+
+class Probe(Entity):
+    """Samples ``fn(now)`` every ``interval_s`` seconds into a Data series."""
+
+    def __init__(
+        self,
+        name: str,
+        interval_s: float,
+        fn: Callable[[Instant], Any],
+        *,
+        stop_after: Optional[Instant] = None,
+    ):
+        super().__init__(name)
+        self.interval_s = interval_s
+        self._fn = fn
+        self._stop_after = stop_after
+        self.data = Data(name)
+
+    def start(self, start_time: Instant) -> list[Event]:
+        return [Event(start_time, f"{self.name}.probe", target=self, daemon=True)]
+
+    def handle_event(self, event: Event) -> list[Event]:
+        now = event.time
+        if self._stop_after is not None and now > self._stop_after:
+            return []
+        value = self._fn(now)
+        if value is not None:
+            self.data.add(now, float(value))
+        return [Event(now + self.interval_s, f"{self.name}.probe", target=self, daemon=True)]
+
+    def reset(self) -> None:
+        self.data = Data(self.name)
+
+    # -- factories ---------------------------------------------------------
+    @classmethod
+    def on(
+        cls,
+        entity: Any,
+        attr: str,
+        interval_s: float = 0.01,
+        *,
+        name: Optional[str] = None,
+    ) -> "Probe":
+        """Poll ``entity.attr`` (called if callable) every interval."""
+
+        def sample(now: Instant) -> Any:
+            value = getattr(entity, attr, None)
+            if callable(value):
+                value = value()
+            return value
+
+        entity_name = getattr(entity, "name", type(entity).__name__)
+        return cls(name or f"{entity_name}.{attr}", interval_s, sample)
+
+    @classmethod
+    def on_many(
+        cls,
+        entities: Sequence[Any],
+        attr: str,
+        interval_s: float = 0.01,
+    ) -> list["Probe"]:
+        return [cls.on(entity, attr, interval_s) for entity in entities]

@@ -1,0 +1,71 @@
+"""Engine-level trace recording.
+
+Parity target: ``happysimulator/instrumentation/recorder.py`` (``TraceRecorder``
+protocol :16, ``InMemoryTraceRecorder`` :44 with kind/event filters,
+``NullTraceRecorder`` :91). The loop and heap emit ``simulation.*`` and
+``heap.*`` spans when a real recorder is attached.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Optional, Protocol, runtime_checkable
+
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.event import Event
+
+
+@dataclass
+class TraceRecord:
+    kind: str
+    time: Instant
+    event_id: Optional[int]
+    event_type: Optional[str]
+    data: dict[str, Any] = field(default_factory=dict)
+
+
+@runtime_checkable
+class TraceRecorder(Protocol):
+    def record(
+        self,
+        kind: str,
+        time: Instant,
+        event: "Event | None" = None,
+        data: dict[str, Any] | None = None,
+    ) -> None: ...
+
+
+class InMemoryTraceRecorder:
+    """Collects trace records for post-run analysis."""
+
+    def __init__(self):
+        self.records: list[TraceRecord] = []
+
+    def record(self, kind, time, event=None, data=None) -> None:
+        self.records.append(
+            TraceRecord(
+                kind=kind,
+                time=time,
+                event_id=event._id if event is not None else None,
+                event_type=event.event_type if event is not None else None,
+                data=data or {},
+            )
+        )
+
+    def filter_by_kind(self, kind: str) -> list[TraceRecord]:
+        return [r for r in self.records if r.kind == kind]
+
+    def filter_by_event(self, event_id: int) -> list[TraceRecord]:
+        return [r for r in self.records if r.event_id == event_id]
+
+    def clear(self) -> None:
+        self.records.clear()
+
+
+class NullTraceRecorder:
+    """No-op recorder (the default: zero overhead)."""
+
+    def record(self, kind, time, event=None, data=None) -> None:
+        pass

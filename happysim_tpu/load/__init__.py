@@ -1,0 +1,28 @@
+from happysim_tpu.load.arrival_time_provider import ArrivalTimeProvider
+from happysim_tpu.load.event_provider import EventProvider, SimpleEventProvider
+from happysim_tpu.load.profile import (
+    ConstantRateProfile,
+    LinearRampProfile,
+    Profile,
+    SpikeProfile,
+)
+from happysim_tpu.load.providers.constant_arrival import ConstantArrivalTimeProvider
+from happysim_tpu.load.providers.distributed_field import DistributedFieldProvider
+from happysim_tpu.load.providers.poisson_arrival import PoissonArrivalTimeProvider
+from happysim_tpu.load.source import Source
+from happysim_tpu.load.source_event import SourceEvent
+
+__all__ = [
+    "ArrivalTimeProvider",
+    "ConstantArrivalTimeProvider",
+    "ConstantRateProfile",
+    "DistributedFieldProvider",
+    "EventProvider",
+    "LinearRampProfile",
+    "PoissonArrivalTimeProvider",
+    "Profile",
+    "SimpleEventProvider",
+    "Source",
+    "SourceEvent",
+    "SpikeProfile",
+]

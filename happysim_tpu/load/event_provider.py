@@ -1,0 +1,67 @@
+"""Event payload generation for sources.
+
+Parity target: ``happysimulator/load/event_provider.py:15`` (``EventProvider``
+ABC) and ``load/source.py:31`` (``SimpleEventProvider``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from abc import ABC, abstractmethod
+from typing import TYPE_CHECKING, Callable, Optional
+
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.entity import Entity
+
+
+class EventProvider(ABC):
+    """Builds the payload events emitted at each source tick."""
+
+    @abstractmethod
+    def get_events(self, time: Instant) -> list[Event]: ...
+
+    def is_exhausted(self, time: Instant) -> bool:
+        """True once the provider will never emit again (stops the tick loop)."""
+        return False
+
+    def reset(self) -> None:
+        """Rewind generation state (control.reset)."""
+
+
+class SimpleEventProvider(EventProvider):
+    """One request event per tick, tagged with created_at and request_id."""
+
+    def __init__(
+        self,
+        target: "Entity",
+        event_type: str = "Request",
+        stop_after: Optional[Instant] = None,
+        context_fn: Optional[Callable[[Instant, int], dict]] = None,
+    ):
+        self._target = target
+        self._event_type = event_type
+        self._stop_after = stop_after
+        self._context_fn = context_fn
+        self._generated = 0
+
+    @property
+    def generated(self) -> int:
+        return self._generated
+
+    def get_events(self, time: Instant) -> list[Event]:
+        if self._stop_after is not None and time > self._stop_after:
+            return []
+        context = {"request_id": self._generated, "created_at": time}
+        if self._context_fn is not None:
+            context.update(self._context_fn(time, self._generated))
+        self._generated += 1
+        return [Event(time, self._event_type, target=self._target, context=context)]
+
+    def is_exhausted(self, time: Instant) -> bool:
+        return self._stop_after is not None and time > self._stop_after
+
+    def reset(self) -> None:
+        self._generated = 0

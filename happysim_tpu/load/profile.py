@@ -1,0 +1,98 @@
+"""Rate profiles — arrival rate as a pure function of time.
+
+Parity target: ``happysimulator/load/profile.py`` (``Profile`` :14,
+``ConstantRateProfile`` :38, ``LinearRampProfile`` :52, ``SpikeProfile`` :78).
+
+Profiles are pure functions of t (seconds) → rate (events/sec), which makes
+them trivially jittable for the TPU executor's thinning sampler.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from happysim_tpu.core.temporal import Instant
+
+
+class Profile(ABC):
+    """rate(t): instantaneous arrival rate in events/second."""
+
+    @abstractmethod
+    def rate(self, time: Instant) -> float: ...
+
+    def rate_at_seconds(self, t_s: float) -> float:
+        return self.rate(Instant.from_seconds(t_s))
+
+    def max_rate(self) -> float:
+        """Upper bound on rate (for thinning samplers); override if known."""
+        raise NotImplementedError
+
+    def is_constant(self) -> bool:
+        return False
+
+
+class ConstantRateProfile(Profile):
+    def __init__(self, rate: float):
+        if rate < 0:
+            raise ValueError("rate must be non-negative")
+        self._rate = rate
+
+    def rate(self, time: Instant) -> float:
+        return self._rate
+
+    def max_rate(self) -> float:
+        return self._rate
+
+    def is_constant(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return f"ConstantRateProfile({self._rate}/s)"
+
+
+class LinearRampProfile(Profile):
+    """Rate ramps linearly from start_rate to end_rate over ramp_duration."""
+
+    def __init__(self, start_rate: float, end_rate: float, ramp_duration_s: float):
+        if ramp_duration_s <= 0:
+            raise ValueError("ramp_duration_s must be positive")
+        self.start_rate = start_rate
+        self.end_rate = end_rate
+        self.ramp_duration_s = ramp_duration_s
+
+    def rate(self, time: Instant) -> float:
+        t = time.to_seconds()
+        if t <= 0:
+            return self.start_rate
+        if t >= self.ramp_duration_s:
+            return self.end_rate
+        frac = t / self.ramp_duration_s
+        return self.start_rate + (self.end_rate - self.start_rate) * frac
+
+    def max_rate(self) -> float:
+        return max(self.start_rate, self.end_rate)
+
+
+class SpikeProfile(Profile):
+    """Baseline rate with a rectangular spike window."""
+
+    def __init__(
+        self,
+        base_rate: float,
+        spike_rate: float,
+        spike_start_s: float,
+        spike_duration_s: float,
+    ):
+        self.base_rate = base_rate
+        self.spike_rate = spike_rate
+        self.spike_start_s = spike_start_s
+        self.spike_duration_s = spike_duration_s
+
+    def rate(self, time: Instant) -> float:
+        t = time.to_seconds()
+        if self.spike_start_s <= t < self.spike_start_s + self.spike_duration_s:
+            return self.spike_rate
+        return self.base_rate
+
+    def max_rate(self) -> float:
+        return max(self.base_rate, self.spike_rate)

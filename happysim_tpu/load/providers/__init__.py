@@ -1,0 +1,9 @@
+from happysim_tpu.load.providers.constant_arrival import ConstantArrivalTimeProvider
+from happysim_tpu.load.providers.distributed_field import DistributedFieldProvider
+from happysim_tpu.load.providers.poisson_arrival import PoissonArrivalTimeProvider
+
+__all__ = [
+    "ConstantArrivalTimeProvider",
+    "DistributedFieldProvider",
+    "PoissonArrivalTimeProvider",
+]

@@ -1,0 +1,22 @@
+"""Deterministic arrivals: unit integrated-rate spacing.
+
+Parity target: ``happysimulator/load/providers/constant_arrival.py`` (target
+integral = 1.0, :23).
+"""
+
+from __future__ import annotations
+
+from happysim_tpu.load.arrival_time_provider import ArrivalTimeProvider
+from happysim_tpu.load.profile import ConstantRateProfile, Profile
+
+
+class ConstantArrivalTimeProvider(ArrivalTimeProvider):
+    """Evenly spaced arrivals: each consumes exactly 1.0 of integrated rate."""
+
+    def __init__(self, profile: Profile | float):
+        if isinstance(profile, (int, float)):
+            profile = ConstantRateProfile(float(profile))
+        super().__init__(profile)
+
+    def _target_integral(self) -> float:
+        return 1.0

@@ -1,0 +1,49 @@
+"""Payloads with fields sampled from value distributions.
+
+Parity target: ``happysimulator/load/providers/distributed_field.py``
+(``DistributedFieldProvider``) — e.g. cache keys drawn from a Zipf law.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.distributions.value_distribution import ValueDistribution
+from happysim_tpu.load.event_provider import EventProvider
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.entity import Entity
+
+
+class DistributedFieldProvider(EventProvider):
+    """One event per tick with context fields drawn from distributions."""
+
+    def __init__(
+        self,
+        target: "Entity",
+        event_type: str = "Request",
+        fields: Optional[dict[str, ValueDistribution]] = None,
+        stop_after: Optional[Instant] = None,
+    ):
+        self._target = target
+        self._event_type = event_type
+        self._fields = fields or {}
+        self._stop_after = stop_after
+        self._generated = 0
+
+    def get_events(self, time: Instant) -> list[Event]:
+        if self.is_exhausted(time):
+            return []
+        context = {"request_id": self._generated, "created_at": time}
+        for key, dist in self._fields.items():
+            context[key] = dist.sample()
+        self._generated += 1
+        return [Event(time, self._event_type, target=self._target, context=context)]
+
+    def is_exhausted(self, time: Instant) -> bool:
+        return self._stop_after is not None and time > self._stop_after
+
+    def reset(self) -> None:
+        self._generated = 0

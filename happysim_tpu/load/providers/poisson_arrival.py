@@ -1,0 +1,32 @@
+"""Poisson arrivals: Exp(1) integrated-rate spacing.
+
+Parity target: ``happysimulator/load/providers/poisson_arrival.py:29-31``.
+The reference samples from the GLOBAL numpy RNG; this rebuild gives every
+provider its own seeded stream so ensembles are reproducible — the same
+fix the TPU executor gets for free from per-replica ``jax.random`` keys.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from happysim_tpu.load.arrival_time_provider import ArrivalTimeProvider
+from happysim_tpu.load.profile import ConstantRateProfile, Profile
+
+
+class PoissonArrivalTimeProvider(ArrivalTimeProvider):
+    """Exponential inter-arrival targets → (possibly non-homogeneous) Poisson."""
+
+    def __init__(self, profile: Profile | float, seed: Optional[int] = None):
+        if isinstance(profile, (int, float)):
+            profile = ConstantRateProfile(float(profile))
+        super().__init__(profile)
+        self._seed = seed
+        self._rng = random.Random(seed)
+
+    def _target_integral(self) -> float:
+        return self._rng.expovariate(1.0)
+
+    def reset(self) -> None:
+        self._rng = random.Random(self._seed)

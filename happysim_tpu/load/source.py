@@ -1,0 +1,145 @@
+"""Load generators.
+
+Parity target: ``happysimulator/load/source.py`` (``Source`` :93 with the
+self-perpetuating tick loop; factories ``.constant`` :182, ``.poisson`` :226,
+``.with_profile`` :270).
+
+On the TPU backend a Source collapses to a per-replica "next arrival time"
+register advanced by ``jax.random.exponential`` draws — the object form here
+is the host-path twin and the builder for that register's parameters (see
+``tpu_spec``).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Optional, Union
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant, as_instant
+from happysim_tpu.load.arrival_time_provider import ArrivalTimeProvider
+from happysim_tpu.load.event_provider import EventProvider, SimpleEventProvider
+from happysim_tpu.load.profile import ConstantRateProfile, Profile
+from happysim_tpu.load.providers.constant_arrival import ConstantArrivalTimeProvider
+from happysim_tpu.load.providers.poisson_arrival import PoissonArrivalTimeProvider
+from happysim_tpu.load.source_event import SourceEvent
+
+
+class Source(Entity):
+    """Emits payload events on a schedule set by its arrival-time provider."""
+
+    def __init__(
+        self,
+        name: str,
+        event_provider: EventProvider,
+        arrival_time_provider: ArrivalTimeProvider,
+        *,
+        daemon: bool = False,
+    ):
+        super().__init__(name)
+        self._event_provider = event_provider
+        self._time_provider = arrival_time_provider
+        self._daemon = daemon
+        self._generated_count = 0
+
+    @property
+    def generated_count(self) -> int:
+        return self._generated_count
+
+    @property
+    def event_provider(self) -> EventProvider:
+        return self._event_provider
+
+    @property
+    def arrival_time_provider(self) -> ArrivalTimeProvider:
+        return self._time_provider
+
+    def start(self, start_time: Instant) -> list[Event]:
+        """Bootstrap: schedule the first tick (called by Simulation)."""
+        first = self._time_provider.next_arrival_time(start_time)
+        if first.is_infinite():
+            return []
+        return [SourceEvent(first, self, daemon=self._daemon)]
+
+    def handle_event(self, event: Event) -> list[Event]:
+        now = event.time
+        if self._event_provider.is_exhausted(now):
+            return []  # stop ticking; lets the simulation auto-terminate
+        payload = self._event_provider.get_events(now)
+        self._generated_count += len(payload)
+        next_time = self._time_provider.next_arrival_time(now)
+        if next_time.is_infinite():
+            return payload
+        return [*payload, SourceEvent(next_time, self, daemon=self._daemon)]
+
+    def reset(self) -> None:
+        self._generated_count = 0
+        self._event_provider.reset()
+        self._time_provider.reset()
+
+    # -- factories ---------------------------------------------------------
+    @classmethod
+    def constant(
+        cls,
+        rate: float,
+        target: Optional[Entity] = None,
+        event_type: str = "Request",
+        *,
+        name: str = "Source",
+        stop_after: Union[float, Instant, None] = None,
+        event_provider: Optional[EventProvider] = None,
+    ) -> "Source":
+        """Deterministic arrivals at ``rate`` events/second."""
+        provider = cls._payload_provider(target, event_type, stop_after, event_provider)
+        return cls(name, provider, ConstantArrivalTimeProvider(rate))
+
+    @classmethod
+    def poisson(
+        cls,
+        rate: float,
+        target: Optional[Entity] = None,
+        event_type: str = "Request",
+        *,
+        name: str = "Source",
+        stop_after: Union[float, Instant, None] = None,
+        event_provider: Optional[EventProvider] = None,
+        seed: Optional[int] = None,
+    ) -> "Source":
+        """Poisson arrivals with mean ``rate`` events/second (seedable)."""
+        provider = cls._payload_provider(target, event_type, stop_after, event_provider)
+        return cls(name, provider, PoissonArrivalTimeProvider(rate, seed=seed))
+
+    @classmethod
+    def with_profile(
+        cls,
+        profile: Profile,
+        target: Optional[Entity] = None,
+        event_type: str = "Request",
+        *,
+        poisson: bool = True,
+        name: str = "Source",
+        stop_after: Union[float, Instant, None] = None,
+        event_provider: Optional[EventProvider] = None,
+        seed: Optional[int] = None,
+    ) -> "Source":
+        """Time-varying arrival rate from a :class:`Profile`."""
+        provider = cls._payload_provider(target, event_type, stop_after, event_provider)
+        if poisson:
+            time_provider: ArrivalTimeProvider = PoissonArrivalTimeProvider(profile, seed=seed)
+        else:
+            time_provider = ConstantArrivalTimeProvider(profile)
+        return cls(name, provider, time_provider)
+
+    @staticmethod
+    def _payload_provider(
+        target: Optional[Entity],
+        event_type: str,
+        stop_after: Union[float, Instant, None],
+        event_provider: Optional[EventProvider],
+    ) -> EventProvider:
+        if event_provider is not None:
+            return event_provider
+        if target is None:
+            raise ValueError("Provide a target entity or an event_provider")
+        stop = as_instant(stop_after) if stop_after is not None else None
+        return SimpleEventProvider(target, event_type, stop_after=stop)

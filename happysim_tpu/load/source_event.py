@@ -1,0 +1,23 @@
+"""The self-perpetuating source tick event.
+
+Parity target: ``happysimulator/load/source_event.py:13``.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.load.source import Source
+
+
+class SourceEvent(Event):
+    """Tick addressed to the Source itself; produces payload + next tick."""
+
+    __slots__ = ()
+
+    def __init__(self, time: Instant, source: "Source", *, daemon: bool = False):
+        super().__init__(time, f"{source.name}.tick", target=source, daemon=daemon)

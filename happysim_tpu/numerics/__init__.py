@@ -1,0 +1,4 @@
+from happysim_tpu.numerics.integration import integrate_adaptive_simpson
+from happysim_tpu.numerics.root_finding import brentq
+
+__all__ = ["brentq", "integrate_adaptive_simpson"]

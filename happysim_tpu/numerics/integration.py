@@ -1,0 +1,45 @@
+"""Adaptive Simpson quadrature (pure-Python scipy replacement).
+
+Parity target: ``happysimulator/numerics/integration.py:10``. Used by the
+arrival-time solver for non-homogeneous rate profiles; host-side only.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def _simpson(f: Callable[[float], float], a: float, fa: float, b: float, fb: float):
+    m = 0.5 * (a + b)
+    fm = f(m)
+    return m, fm, (b - a) / 6.0 * (fa + 4.0 * fm + fb)
+
+
+def _adaptive(f, a, fa, b, fb, m, fm, whole, tol, depth):
+    lm, flm, left = _simpson(f, a, fa, m, fm)
+    rm, frm, right = _simpson(f, m, fm, b, fb)
+    delta = left + right - whole
+    if depth <= 0 or abs(delta) <= 15.0 * tol:
+        return left + right + delta / 15.0
+    return _adaptive(f, a, fa, m, fm, lm, flm, left, tol / 2.0, depth - 1) + _adaptive(
+        f, m, fm, b, fb, rm, frm, right, tol / 2.0, depth - 1
+    )
+
+
+def integrate_adaptive_simpson(
+    f: Callable[[float], float],
+    a: float,
+    b: float,
+    tol: float = 1e-9,
+    max_depth: int = 50,
+) -> float:
+    """∫_a^b f(x) dx with adaptive interval refinement."""
+    if a == b:
+        return 0.0
+    sign = 1.0
+    if b < a:
+        a, b = b, a
+        sign = -1.0
+    fa, fb = f(a), f(b)
+    m, fm, whole = _simpson(f, a, fa, b, fb)
+    return sign * _adaptive(f, a, fa, b, fb, m, fm, whole, tol, max_depth)

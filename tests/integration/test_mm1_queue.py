@@ -1,0 +1,105 @@
+"""E2E milestone: M/M/1 through Source → Server → Sink matches theory.
+
+Mirrors the reference's queue-theory oracle
+(``/root/reference/examples/queuing/m_m_1_queue.py:66-78``): with λ arrivals
+and service rate μ, ρ = λ/μ, E[wait in queue] = ρ/(μ−λ) − 1/μ, E[sojourn] =
+1/(μ−λ). This is the correctness baseline the TPU executor is also validated
+against (tests/integration/test_tpu_mm1.py).
+"""
+
+import pytest
+
+from happysim_tpu import (
+    ExponentialLatency,
+    Instant,
+    Probe,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+
+
+def run_mm1(lam=8.0, mu=10.0, horizon_s=400.0, seed=42):
+    sink = Sink()
+    server = Server(
+        "server",
+        concurrency=1,
+        service_time=ExponentialLatency(1.0 / mu, seed=seed + 1),
+        downstream=sink,
+    )
+    source = Source.poisson(rate=lam, target=server, stop_after=horizon_s, seed=seed)
+    sim = Simulation(
+        sources=[source],
+        entities=[server, sink],
+        end_time=Instant.from_seconds(horizon_s * 2),  # let queue drain
+    )
+    summary = sim.run()
+    return sim, summary, sink, server
+
+
+class TestMM1:
+    def test_sojourn_time_matches_theory(self):
+        lam, mu = 8.0, 10.0
+        _, _, sink, server = run_mm1(lam, mu)
+        # E[T] = 1/(mu - lam) = 0.5s
+        expected = 1.0 / (mu - lam)
+        observed = sum(sink.latencies_s) / len(sink.latencies_s)
+        assert observed == pytest.approx(expected, rel=0.15)
+
+    def test_all_requests_complete(self):
+        _, _, sink, server = run_mm1(horizon_s=50.0)
+        assert server.requests_completed == sink.events_received
+        assert sink.events_received > 300  # ~8/s * 50s
+
+    def test_utilization_matches_rho(self):
+        lam, mu = 8.0, 10.0
+        _, summary, sink, server = run_mm1(lam, mu)
+        busy_fraction = server.busy_seconds / max(t.to_seconds() for t in sink.completion_times)
+        assert busy_fraction == pytest.approx(lam / mu, rel=0.1)
+
+    def test_probe_queue_depth(self):
+        sink = Sink()
+        server = Server(
+            "server",
+            service_time=ExponentialLatency(0.095, seed=2),
+            downstream=sink,
+        )
+        source = Source.poisson(rate=8.0, target=server, stop_after=100.0, seed=3)
+        probe = Probe.on(server, "queue_depth", interval_s=0.1)
+        sim = Simulation(
+            sources=[source],
+            entities=[server, sink],
+            probes=[probe],
+            end_time=Instant.from_seconds(150),
+        )
+        sim.run()
+        assert probe.data.count() > 900
+        # Mean queue length for M/M/1: rho^2/(1-rho); rho=0.76 → ~2.4.
+        # Loose bound: positive and below 4x theory.
+        rho = 8.0 * 0.095
+        theory = rho * rho / (1 - rho)
+        assert 0 < probe.data.mean() < theory * 4
+
+
+class TestMMC:
+    def test_mmc_multiserver_faster_than_mm1(self):
+        lam, mu = 16.0, 10.0  # needs c >= 2
+        sink = Sink()
+        server = Server(
+            "mmc",
+            concurrency=3,
+            service_time=ExponentialLatency(1.0 / mu, seed=11),
+            downstream=sink,
+        )
+        source = Source.poisson(rate=lam, target=server, stop_after=200.0, seed=12)
+        sim = Simulation(
+            sources=[source],
+            entities=[server, sink],
+            end_time=Instant.from_seconds(400),
+        )
+        sim.run()
+        assert sink.events_received > 2800
+        mean_latency = sum(sink.latencies_s) / len(sink.latencies_s)
+        # With c=3, rho = 16/30 ≈ 0.53 → sojourn close to service mean 0.1
+        assert mean_latency < 0.2

@@ -1,0 +1,80 @@
+"""Unit tests: event ordering, cancellation, heap invariants."""
+
+from happysim_tpu import Entity, Event, EventHeap, Instant
+from happysim_tpu.core.event import reset_event_counter
+
+
+class Collector(Entity):
+    def __init__(self, name="collector"):
+        super().__init__(name)
+        self.seen = []
+
+    def handle_event(self, event):
+        self.seen.append(event.event_type)
+
+
+def test_events_pop_in_time_order():
+    reset_event_counter()
+    target = Collector()
+    heap = EventHeap()
+    heap.push(Event(Instant.from_seconds(3), "c", target))
+    heap.push(Event(Instant.from_seconds(1), "a", target))
+    heap.push(Event(Instant.from_seconds(2), "b", target))
+    assert [heap.pop().event_type for _ in range(3)] == ["a", "b", "c"]
+
+
+def test_same_time_is_fifo_by_insertion():
+    reset_event_counter()
+    target = Collector()
+    heap = EventHeap()
+    t = Instant.from_seconds(1)
+    for name in ["first", "second", "third"]:
+        heap.push(Event(t, name, target))
+    assert [heap.pop().event_type for _ in range(3)] == ["first", "second", "third"]
+
+
+def test_primary_count_excludes_daemons():
+    target = Collector()
+    heap = EventHeap()
+    heap.push(Event(Instant.Epoch, "d", target, daemon=True))
+    assert heap.has_events()
+    assert not heap.has_primary_events()
+    heap.push(Event(Instant.Epoch, "p", target))
+    assert heap.has_primary_events()
+    popped = [heap.pop(), heap.pop()]
+    assert not heap.has_primary_events()
+    assert not heap.has_events()
+
+
+def test_cancellation_is_lazy():
+    target = Collector()
+    heap = EventHeap()
+    event = Event(Instant.Epoch, "x", target)
+    heap.push(event)
+    event.cancel()
+    assert heap.size() == 1  # still in heap
+    assert heap.pop().cancelled
+
+
+def test_event_requires_target():
+    import pytest
+
+    with pytest.raises(ValueError):
+        Event(Instant.Epoch, "orphan")
+
+
+def test_completion_hooks_run_once():
+    target = Collector()
+    calls = []
+    event = Event(Instant.Epoch, "x", target)
+    event.add_completion_hook(lambda t: calls.append(t))
+    event.invoke()
+    event._run_completion_hooks(Instant.Epoch)
+    assert len(calls) == 1
+
+
+def test_event_context_defaults():
+    target = Collector()
+    event = Event(Instant.from_seconds(2), "x", target)
+    assert event.context["created_at"] == Instant.from_seconds(2)
+    assert "id" in event.context

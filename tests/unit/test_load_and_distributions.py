@@ -1,0 +1,147 @@
+"""Unit tests: sources, profiles, arrival solvers, distributions."""
+
+import math
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    ConstantRateProfile,
+    Duration,
+    ExponentialLatency,
+    Instant,
+    LinearRampProfile,
+    PercentileFittedLatency,
+    Simulation,
+    Sink,
+    Source,
+    SpikeProfile,
+    UniformDistribution,
+    ZipfDistribution,
+)
+from happysim_tpu.load.providers.poisson_arrival import PoissonArrivalTimeProvider
+from happysim_tpu.numerics import brentq, integrate_adaptive_simpson
+
+
+class TestNumerics:
+    def test_simpson_polynomial(self):
+        result = integrate_adaptive_simpson(lambda x: 3 * x**2, 0.0, 2.0)
+        assert result == pytest.approx(8.0, rel=1e-9)
+
+    def test_simpson_reversed_bounds(self):
+        assert integrate_adaptive_simpson(lambda x: x, 2.0, 0.0) == pytest.approx(-2.0)
+
+    def test_brentq_finds_root(self):
+        root = brentq(lambda x: x**2 - 4, 0.0, 10.0)
+        assert root == pytest.approx(2.0, abs=1e-10)
+
+    def test_brentq_requires_bracket(self):
+        with pytest.raises(ValueError):
+            brentq(lambda x: x**2 + 1, -1, 1)
+
+
+class TestProfiles:
+    def test_linear_ramp(self):
+        profile = LinearRampProfile(0.0, 100.0, 10.0)
+        assert profile.rate(Instant.Epoch) == 0.0
+        assert profile.rate(Instant.from_seconds(5)) == 50.0
+        assert profile.rate(Instant.from_seconds(20)) == 100.0
+
+    def test_spike(self):
+        profile = SpikeProfile(10.0, 1000.0, spike_start_s=5.0, spike_duration_s=1.0)
+        assert profile.rate(Instant.from_seconds(4.9)) == 10.0
+        assert profile.rate(Instant.from_seconds(5.5)) == 1000.0
+        assert profile.rate(Instant.from_seconds(6.1)) == 10.0
+
+
+class TestArrivals:
+    def test_constant_arrivals_evenly_spaced(self):
+        sink = Sink()
+        source = Source.constant(rate=4.0, target=sink, stop_after=1.0)
+        sim = Simulation(sources=[source], entities=[sink])
+        sim.run()
+        assert sink.events_received == 4
+        times = [t.to_seconds() for t in sink.completion_times]
+        assert times == pytest.approx([0.25, 0.5, 0.75, 1.0])
+
+    def test_poisson_seeded_reproducible(self):
+        provider_a = PoissonArrivalTimeProvider(10.0, seed=42)
+        provider_b = PoissonArrivalTimeProvider(10.0, seed=42)
+        times_a = []
+        times_b = []
+        t = Instant.Epoch
+        for _ in range(20):
+            t = provider_a.next_arrival_time(t)
+            times_a.append(t.nanoseconds)
+        t = Instant.Epoch
+        for _ in range(20):
+            t = provider_b.next_arrival_time(t)
+            times_b.append(t.nanoseconds)
+        assert times_a == times_b
+
+    def test_poisson_mean_rate(self):
+        provider = PoissonArrivalTimeProvider(100.0, seed=7)
+        t = Instant.Epoch
+        n = 5000
+        for _ in range(n):
+            t = provider.next_arrival_time(t)
+        observed_rate = n / t.to_seconds()
+        assert observed_rate == pytest.approx(100.0, rel=0.05)
+
+    def test_ramp_profile_arrivals_integrate_rate(self):
+        # rate(t) = 10t over [0,2]; expected arrivals = ∫ = 20
+        profile = LinearRampProfile(0.0, 20.0, 2.0)
+        sink = Sink()
+        source = Source.with_profile(profile, target=sink, poisson=False, stop_after=2.0)
+        sim = Simulation(sources=[source], entities=[sink], end_time=Instant.from_seconds(2))
+        sim.run()
+        assert sink.events_received == pytest.approx(20, abs=2)
+
+
+class TestLatencyDistributions:
+    def test_constant(self):
+        dist = ConstantLatency(0.1)
+        assert dist.get_latency(Instant.Epoch) == Duration.from_seconds(0.1)
+        assert dist.mean() == Duration.from_seconds(0.1)
+
+    def test_exponential_mean(self):
+        dist = ExponentialLatency(0.05, seed=3)
+        samples = [dist.get_latency(Instant.Epoch).to_seconds() for _ in range(20000)]
+        assert sum(samples) / len(samples) == pytest.approx(0.05, rel=0.05)
+
+    def test_mean_shift(self):
+        shifted = ConstantLatency(0.1) + 0.05
+        assert shifted.get_latency(Instant.Epoch) == Duration.from_seconds(0.15)
+        clamped = ConstantLatency(0.1) - 0.2
+        assert clamped.get_latency(Instant.Epoch) == Duration.ZERO
+
+    def test_percentile_fitted_recovers_exponential(self):
+        mean = 0.1
+        points = {p: -mean * math.log1p(-p) for p in (0.5, 0.9, 0.99)}
+        dist = PercentileFittedLatency(points, seed=1)
+        assert dist.fitted_mean_seconds == pytest.approx(mean, rel=1e-6)
+
+
+class TestValueDistributions:
+    def test_zipf_rank_ordering(self):
+        dist = ZipfDistribution(100, exponent=1.2, seed=5)
+        counts = {}
+        for _ in range(20000):
+            key = dist.sample()
+            counts[key] = counts.get(key, 0) + 1
+        assert counts[0] > counts.get(10, 0) > counts.get(90, 0)
+
+    def test_zipf_cdf_monotone(self):
+        cdf = ZipfDistribution(10, exponent=1.0).cdf
+        assert cdf == sorted(cdf)
+        assert cdf[-1] == pytest.approx(1.0)
+
+    def test_uniform_choice_seeded(self):
+        a = UniformDistribution(items=list(range(10)), seed=9)
+        b = UniformDistribution(items=list(range(10)), seed=9)
+        assert [a.sample() for _ in range(50)] == [b.sample() for _ in range(50)]
+
+    def test_uniform_range(self):
+        dist = UniformDistribution(low=5.0, high=6.0, seed=2)
+        for _ in range(100):
+            assert 5.0 <= dist.sample() < 6.0

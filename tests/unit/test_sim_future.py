@@ -1,0 +1,126 @@
+"""Unit tests: SimFuture park/resolve and combinators."""
+
+import pytest
+
+from happysim_tpu import Entity, Event, Instant, SimFuture, Simulation, all_of, any_of
+
+
+class Requester(Entity):
+    """Sends a request, awaits the response future."""
+
+    def __init__(self, name, responder):
+        super().__init__(name)
+        self.responder = responder
+        self.result = None
+        self.resolved_at = None
+
+    def handle_event(self, event):
+        future = SimFuture()
+        request = Event(self.now, "request", target=self.responder)
+        request.context["reply_to"] = future
+        value = yield future, [request]  # park + send the request
+        self.result = value
+        self.resolved_at = self.now.to_seconds()
+
+
+class Responder(Entity):
+    def __init__(self, name, delay_s=1.0):
+        super().__init__(name)
+        self.delay_s = delay_s
+
+    def handle_event(self, event):
+        future = event.context["reply_to"]
+        yield self.delay_s
+        future.resolve("pong")
+
+
+class FanOut(Entity):
+    """Awaits a combinator over two futures resolved at different times."""
+
+    def __init__(self, name, combinator):
+        super().__init__(name)
+        self.combinator = combinator
+        self.result = None
+        self.when = None
+
+    def handle_event(self, event):
+        f1, f2 = SimFuture(), SimFuture()
+        resolver1 = Event.once(self.now + 1.0, lambda: f1.resolve("one"))
+        resolver2 = Event.once(self.now + 2.0, lambda: f2.resolve("two"))
+        value = yield self.combinator(f1, f2), [resolver1, resolver2]
+        self.result = value
+        self.when = self.now.to_seconds()
+
+
+def _request_response_world():
+    responder = Responder("responder", delay_s=1.5)
+    requester = Requester("requester", responder)
+    sim = Simulation(entities=[requester, responder])
+    sim.schedule(Event(Instant.Epoch, "go", target=requester))
+    return sim, requester
+
+
+def test_request_response_roundtrip():
+    sim, requester = _request_response_world()
+    sim.run()
+    assert requester.result == "pong"
+    assert requester.resolved_at == 1.5
+
+
+def test_any_of_resolves_with_first():
+    entity = FanOut("fan", any_of)
+    sim = Simulation(entities=[entity])
+    sim.schedule(Event(Instant.Epoch, "go", target=entity))
+    sim.run()
+    assert entity.result == (0, "one")
+    assert entity.when == 1.0
+
+
+def test_all_of_waits_for_all():
+    entity = FanOut("fan", all_of)
+    sim = Simulation(entities=[entity])
+    sim.schedule(Event(Instant.Epoch, "go", target=entity))
+    sim.run()
+    assert entity.result == ["one", "two"]
+    assert entity.when == 2.0
+
+
+def test_double_park_raises():
+    future = SimFuture()
+
+    class Fake:
+        pass
+
+    future._continuation = object()
+    with pytest.raises(RuntimeError):
+        future._park(object())
+
+
+def test_resolve_outside_sim_raises():
+    future = SimFuture()
+    future._continuation = object()
+    future._resolved = True
+
+    with pytest.raises(RuntimeError):
+        future._resume()
+
+
+def test_pre_resolved_future_resumes_immediately():
+    class Immediate(Entity):
+        def __init__(self):
+            super().__init__("imm")
+            self.value = None
+            self.when = None
+
+        def handle_event(self, event):
+            future = SimFuture()
+            future.resolve(42)
+            self.value = yield future
+            self.when = self.now.to_seconds()
+
+    entity = Immediate()
+    sim = Simulation(entities=[entity])
+    sim.schedule(Event(Instant.from_seconds(3), "go", target=entity))
+    sim.run()
+    assert entity.value == 42
+    assert entity.when == 3.0

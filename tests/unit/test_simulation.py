@@ -1,0 +1,158 @@
+"""Unit tests: the Simulation engine loop."""
+
+import pytest
+
+from happysim_tpu import (
+    CallbackEntity,
+    Entity,
+    Event,
+    Instant,
+    Simulation,
+    Sink,
+    Source,
+)
+
+
+class Echo(Entity):
+    """Re-emits n follow-up events with a delay via plain returns."""
+
+    def __init__(self, name, delay_s=0.0, hops=0):
+        super().__init__(name)
+        self.delay_s = delay_s
+        self.hops = hops
+        self.received = []
+
+    def handle_event(self, event):
+        self.received.append((event.time, event.event_type))
+        if self.hops > 0:
+            self.hops -= 1
+            return [Event(self.now + self.delay_s, event.event_type, target=self)]
+        return None
+
+
+class Yielder(Entity):
+    """Generator behavior: two yields then a final event."""
+
+    def __init__(self, name, sink):
+        super().__init__(name)
+        self.sink = sink
+        self.steps = []
+
+    def handle_event(self, event):
+        self.steps.append(("start", self.now.to_seconds()))
+        yield 0.5
+        self.steps.append(("mid", self.now.to_seconds()))
+        yield 0.25
+        self.steps.append(("end", self.now.to_seconds()))
+        return [self.forward(event, self.sink)]
+
+
+def test_run_processes_events_in_order():
+    echo = Echo("echo", delay_s=1.0, hops=3)
+    sim = Simulation(entities=[echo])
+    sim.schedule(Event(Instant.Epoch, "ping", target=echo))
+    summary = sim.run()
+    assert summary.events_processed == 4
+    times = [t.to_seconds() for t, _ in echo.received]
+    assert times == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_end_time_bounds_run():
+    echo = Echo("echo", delay_s=1.0, hops=100)
+    sim = Simulation(entities=[echo], end_time=Instant.from_seconds(5))
+    sim.schedule(Event(Instant.Epoch, "ping", target=echo))
+    summary = sim.run()
+    assert summary.end_time == Instant.from_seconds(5)
+    assert summary.events_processed == 6  # t=0..5
+
+
+def test_duration_arg():
+    echo = Echo("echo", delay_s=1.0, hops=100)
+    sim = Simulation(entities=[echo], duration=3.0)
+    sim.schedule(Event(Instant.Epoch, "ping", target=echo))
+    assert sim.run().events_processed == 4
+
+
+def test_duration_and_end_time_mutually_exclusive():
+    with pytest.raises(ValueError):
+        Simulation(end_time=Instant.from_seconds(1), duration=1.0)
+
+
+def test_generator_yields_advance_time():
+    sink = Sink()
+    y = Yielder("y", sink)
+    sim = Simulation(entities=[y, sink])
+    sim.schedule(Event(Instant.Epoch, "job", target=y))
+    sim.run()
+    assert y.steps == [("start", 0.0), ("mid", 0.5), ("end", 0.75)]
+    assert sink.events_received == 1
+    assert sink.latencies_s == [0.75]
+
+
+def test_auto_terminates_on_daemon_only_heap():
+    seen = []
+    recorder = CallbackEntity("cb", lambda e: seen.append(e.time.to_seconds()))
+
+    class DaemonLoop(Entity):
+        def handle_event(self, event):
+            return [Event(self.now + 1.0, "tick", target=self, daemon=True)]
+
+    loop = DaemonLoop("daemon")
+    sim = Simulation(entities=[loop, recorder])
+    sim.schedule(Event(Instant.Epoch, "tick", target=loop, daemon=True))
+    sim.schedule(Event(Instant.from_seconds(2.5), "real", target=recorder))
+    summary = sim.run()
+    # Runs until the only primary event is done, then stops despite daemons.
+    assert seen == [2.5]
+    assert summary.events_processed <= 5
+
+
+def test_cancelled_events_are_skipped():
+    echo = Echo("echo")
+    sim = Simulation(entities=[echo])
+    event = Event(Instant.from_seconds(1), "x", target=echo)
+    keep = Event(Instant.from_seconds(2), "y", target=echo)
+    sim.schedule([event, keep])
+    event.cancel()
+    sim.run()
+    assert [t for _, t in echo.received] == ["y"]
+
+
+def test_source_feeds_sink_constant_rate():
+    sink = Sink()
+    source = Source.constant(rate=10.0, target=sink, stop_after=1.0)
+    sim = Simulation(sources=[source], entities=[sink], end_time=Instant.from_seconds(5))
+    sim.run()
+    # 10/s for 1s: ticks at 0.1..1.0
+    assert sink.events_received == 10
+
+
+def test_summary_harvests_entities():
+    sink = Sink("the-sink")
+    source = Source.constant(rate=5.0, target=sink, stop_after=1.0)
+    sim = Simulation(sources=[source], entities=[sink])
+    summary = sim.run()
+    names = {e.name for e in summary.entities}
+    assert "the-sink" in names
+    sink_summary = next(e for e in summary.entities if e.name == "the-sink")
+    assert sink_summary.events_received == 5
+
+
+def test_time_travel_event_skipped(caplog):
+    class BadEntity(Entity):
+        def __init__(self):
+            super().__init__("bad")
+            self.count = 0
+
+        def handle_event(self, event):
+            self.count += 1
+            if self.count == 1:
+                # schedules into the past
+                return [Event(Instant.Epoch, "past", target=self)]
+            return None
+
+    bad = BadEntity()
+    sim = Simulation(entities=[bad])
+    sim.schedule(Event(Instant.from_seconds(1), "start", target=bad))
+    sim.run()
+    assert bad.count == 1  # past event skipped

@@ -1,0 +1,73 @@
+"""Unit tests: Instant/Duration integer-nanosecond time."""
+
+import pytest
+
+from happysim_tpu import Duration, Instant
+
+
+class TestDuration:
+    def test_from_seconds_roundtrip(self):
+        d = Duration.from_seconds(1.5)
+        assert d.nanoseconds == 1_500_000_000
+        assert d.to_seconds() == 1.5
+
+    def test_arithmetic_with_numbers_is_seconds(self):
+        d = Duration.from_seconds(1.0) + 0.5
+        assert d == Duration.from_seconds(1.5)
+        assert Duration.from_seconds(2.0) - 1 == Duration.from_seconds(1.0)
+
+    def test_scaling(self):
+        assert Duration.from_seconds(2.0) * 3 == Duration.from_seconds(6.0)
+        assert 3 * Duration.from_seconds(2.0) == Duration.from_seconds(6.0)
+        assert Duration.from_seconds(6.0) / 3 == Duration.from_seconds(2.0)
+        assert Duration.from_seconds(6.0) / Duration.from_seconds(2.0) == 3.0
+
+    def test_comparisons(self):
+        assert Duration.from_seconds(1) < Duration.from_seconds(2)
+        assert Duration.from_seconds(2) >= Duration.from_seconds(2)
+        assert Duration.from_millis(1) == Duration.from_micros(1000)
+
+    def test_hashable(self):
+        assert hash(Duration(5)) == hash(Duration(5))
+
+
+class TestInstant:
+    def test_add_duration(self):
+        t = Instant.from_seconds(1.0) + Duration.from_seconds(0.5)
+        assert t == Instant.from_seconds(1.5)
+
+    def test_add_float_seconds(self):
+        assert Instant.Epoch + 2.5 == Instant.from_seconds(2.5)
+
+    def test_subtract_instant_gives_duration(self):
+        d = Instant.from_seconds(3.0) - Instant.from_seconds(1.0)
+        assert isinstance(d, Duration)
+        assert d == Duration.from_seconds(2.0)
+
+    def test_subtract_duration_gives_instant(self):
+        t = Instant.from_seconds(3.0) - Duration.from_seconds(1.0)
+        assert isinstance(t, Instant)
+        assert t == Instant.from_seconds(2.0)
+
+    def test_ordering(self):
+        assert Instant.Epoch < Instant.from_seconds(1)
+        assert Instant.from_seconds(1) <= Instant.from_seconds(1)
+
+
+class TestInfinity:
+    def test_after_everything(self):
+        assert Instant.Infinity > Instant.from_seconds(1e18)
+        assert Instant.from_seconds(1e18) < Instant.Infinity
+        assert Instant.Infinity >= Instant.Infinity
+        assert not (Instant.Infinity < Instant.Infinity)
+
+    def test_arithmetic_saturates(self):
+        assert (Instant.Infinity + 100).is_infinite()
+        assert (Instant.Infinity - Duration.from_seconds(5)).is_infinite()
+
+    def test_equality(self):
+        assert Instant.Infinity == Instant.Infinity
+        assert Instant.Infinity != Instant.from_seconds(0)
+
+    def test_to_seconds_is_inf(self):
+        assert Instant.Infinity.to_seconds() == float("inf")
