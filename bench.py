@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Headline benchmark: 65k-replica M/M/1 ensemble on the TPU executor.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Baseline: the reference's single-core heap executor does ~134,580 events/s
+on its M/M/1 throughput scenario (BASELINE.md); the BASELINE.json north-star
+target is >=10M simulated events/sec/chip with mean wait within 1% of
+rho/(mu-lambda).
+"""
+
+import json
+import sys
+
+REFERENCE_EVENTS_PER_SEC = 134_580.0  # BASELINE.md throughput checkpoint
+
+
+def main() -> int:
+    import jax
+
+    from happysim_tpu.tpu import run_mm1_ensemble
+
+    result = run_mm1_ensemble(
+        lam=8.0,
+        mu=10.0,
+        n_replicas=65536,
+        n_customers=4096,
+        seed=0,
+    )
+    devices = jax.devices()
+    record = {
+        "metric": "simulated-events/sec/chip (65k-replica M/M/1 ensemble)",
+        "value": round(result.events_per_second, 0),
+        "unit": "events/sec",
+        "vs_baseline": round(result.events_per_second / REFERENCE_EVENTS_PER_SEC, 2),
+        "mean_wait_s": round(result.mean_wait_s, 6),
+        "analytic_wait_s": result.analytic_wait_s,
+        "wait_error_rel": round(result.wait_error_rel, 6),
+        "accuracy_ok": bool(result.wait_error_rel < 0.01),
+        "n_replicas": result.n_replicas,
+        "customers_per_replica": result.customers_per_replica,
+        "simulated_events": result.simulated_events,
+        "wall_seconds": round(result.wall_seconds, 6),
+        "device": str(devices[0]),
+        "n_devices": len(devices),
+    }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,7 @@ class Queue(Entity):
         self.enqueued = 0
         self.dequeued = 0
         self.dropped = 0
+        self.requeued = 0
 
     # -- wiring ------------------------------------------------------------
     def connect_driver(self, driver: Entity) -> None:
@@ -85,6 +86,22 @@ class Queue(Entity):
         deliver = Event(self.now, QUEUE_DELIVER, target=self.driver)
         deliver.context["payload"] = payload
         return [deliver]
+
+    def requeue(self, payload: Event) -> None:
+        """Return a popped-but-undeliverable item to the head of the queue.
+
+        Used by the driver when the worker filled up between poll and
+        delivery (same-instant burst arrivals). FIFO puts it back at the
+        front; other policies re-push (priority order is recomputed).
+        """
+        from happysim_tpu.components.queue_policy import FIFOQueue
+
+        self.dequeued -= 1
+        self.requeued += 1
+        if isinstance(self.policy, FIFOQueue):
+            self.policy._items.appendleft(payload)
+        else:
+            self.policy.push(payload)
 
     def downstream_entities(self):
         return [self.driver] if self.driver is not None else []

@@ -40,6 +40,11 @@ class QueueDriver(Entity):
 
     def _handle_delivery(self, event: Event):
         payload: Event = event.context["payload"]
+        # The worker may have filled up between our poll and this delivery
+        # (same-instant bursts): give the item back rather than overflow.
+        if not self.worker.has_capacity():
+            self.queue.requeue(payload)
+            return None
         work = Event(
             time=self.now,
             event_type=payload.event_type,
@@ -48,10 +53,16 @@ class QueueDriver(Entity):
             context=payload.context,
         )
         work.on_complete.extend(payload.on_complete)
-        # When the worker finishes this item, pull the next one; multi-slot
-        # workers drain via the notify-per-enqueue path plus these hooks.
+        # When the worker finishes this item, pull the next one.
         work.add_completion_hook(self._on_worker_done)
-        return [work]
+        out = [work]
+        if self.queue.depth > 0:
+            # Chain another poll so multi-slot workers drain same-instant
+            # backlogs: `work` runs before the chained poll's delivery (FIFO
+            # at equal timestamps), so the capacity check above stays
+            # accurate and the chain stops via the requeue branch.
+            out.append(Event(self.now, QUEUE_POLL, target=self.queue))
+        return out
 
     def _on_worker_done(self, time) -> list[Event]:
         if self.queue.depth > 0 and self.worker.has_capacity():

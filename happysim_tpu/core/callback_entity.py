@@ -33,7 +33,10 @@ class CallbackEntity(Entity):
             return fn()
         if arity == 1:
             return fn(event)
-        return fn(event, self.now)
+        # Event.once targets are never registered with the Simulation, so a
+        # clock may not be injected; the event's own time IS "now" at invoke.
+        now = self._clock.now if self._clock is not None else event.time
+        return fn(event, now)
 
 
 class _NullEntity(Entity):

@@ -85,7 +85,10 @@ class Simulation:
         self._pause_requested = False
         self._events_processed = 0
         self._wall_seconds = 0.0
-        self._pre_run_events: list[Event] = []
+        # Construction specs of pre-run scheduled events, captured at
+        # schedule() time so control.reset() can replay them faithfully
+        # (context and hooks are snapshotted before the run mutates them).
+        self._pre_run_specs: list[dict] = []
         self._time_travel_warned = False
 
         self._bootstrap()
@@ -141,10 +144,17 @@ class Simulation:
         """Inject events from outside the loop (pre-run events replay on reset)."""
         self._event_heap.push(events)
         if not self._is_running:
-            if isinstance(events, Event):
-                self._pre_run_events.append(events)
-            else:
-                self._pre_run_events.extend(events)
+            for event in [events] if isinstance(events, Event) else events:
+                self._pre_run_specs.append(
+                    {
+                        "time": event.time,
+                        "event_type": event.event_type,
+                        "target": event.target,
+                        "daemon": event.daemon,
+                        "on_complete": list(event.on_complete),
+                        "context": dict(event.context),
+                    }
+                )
 
     def find_entity(self, name: str):
         for entity in self.entities:
@@ -258,6 +268,7 @@ class Simulation:
             time_advanced = event.time.nanoseconds > clock._now.nanoseconds
             clock.update(event.time)
             if recorder is not None:
+                heap.set_current_time(event.time)
                 recorder.record("simulation.dequeue", time=event.time, event=event)
             self._events_processed += 1
             new_events = event.invoke()
@@ -311,13 +322,15 @@ class Simulation:
             self._event_heap.push(probe.start(self._start))
         if self.fault_schedule is not None:
             self._event_heap.push(self.fault_schedule.start(self._start))
-        replay, self._pre_run_events = self._pre_run_events, []
+        replay, self._pre_run_specs = self._pre_run_specs, []
         for spec in replay:
             clone = Event(
-                time=spec.time,
-                event_type=spec.event_type,
-                target=spec.target,
-                daemon=spec.daemon,
+                time=spec["time"],
+                event_type=spec["event_type"],
+                target=spec["target"],
+                daemon=spec["daemon"],
+                on_complete=list(spec["on_complete"]),
+                context=dict(spec["context"]),
             )
             self.schedule(clone)
 

@@ -1,0 +1,27 @@
+"""TPU ensemble executor — the compiled/native tier of the framework.
+
+Restricted simulation models compile to a single XLA program:
+``lax.scan`` over per-replica state, vmapped over Monte-Carlo replica lanes,
+sharded over a ``jax.sharding.Mesh`` (metrics reduce via psum over ICI).
+The host executor (:mod:`happysim_tpu.core`) is the general-purpose twin and
+correctness oracle.
+"""
+
+from happysim_tpu.tpu.mesh import (
+    REPLICA_AXIS,
+    pad_to_multiple,
+    replica_mesh,
+    replica_sharding,
+    replicated_sharding,
+)
+from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
+
+__all__ = [
+    "MM1Result",
+    "REPLICA_AXIS",
+    "pad_to_multiple",
+    "replica_mesh",
+    "replica_sharding",
+    "replicated_sharding",
+    "run_mm1_ensemble",
+]

@@ -1,0 +1,38 @@
+"""Device-mesh helpers for the ensemble executor.
+
+The replica axis is the one big data-parallel dimension of a DES ensemble
+(SURVEY.md §2.5: ParallelRunner replicas → vmap lanes → chips). We shard it
+over a 1-D mesh named "replicas"; metric reductions then ride the ICI as
+``psum``-style collectives inserted by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+REPLICA_AXIS = "replicas"
+
+
+def replica_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name "replicas"."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (REPLICA_AXIS,))
+
+
+def replica_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (replica) dimension across the mesh."""
+    return NamedSharding(mesh, P(REPLICA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, devices: int) -> int:
+    """Round replica count up so it divides evenly across devices."""
+    return ((n + devices - 1) // devices) * devices

@@ -1,0 +1,166 @@
+"""M/M/1 ensemble kernel — the TPU executor's proof-of-capability.
+
+Replaces the reference's ``ParallelRunner.run_replicas`` for the M/M/1
+workload (``/root/reference/happysimulator/parallel/runner.py:115`` farms
+replicas to a ProcessPoolExecutor; here replicas are vmapped lanes of ONE
+XLA program sharded over the chip mesh).
+
+The kernel simulates the FIFO single-server queue by the Lindley recursion:
+
+    W_{n+1} = max(0, W_n + S_n - A_{n+1})
+
+where W is the queue wait of customer n, S ~ Exp(mu), A ~ Exp(lambda).
+One scan step = one customer = 2 simulated events (arrival + departure) —
+the same accounting as the heap executor's primary events for this model.
+This is exact M/M/1 dynamics, not an approximation: the event heap of a
+single-server FIFO queue IS the Lindley recursion, so burning a general
+priority queue on it would waste the MXU-adjacent vector units on bookkeeping.
+The general array-heap engine (happysim_tpu/tpu/engine.py) covers models
+that genuinely need a queue.
+
+Statistics: per-replica Welford-free accumulation (sum, sum of squares,
+count) after a warmup cutoff; cross-replica reduction is a ``jnp.mean`` over
+the sharded replica axis, which XLA lowers to a psum over ICI on a
+multi-chip mesh. Analytic oracle: E[Wq] = rho/(mu-lambda), the *queue wait*
+(BASELINE.json's rho/(mu-lambda); NOT sojourn W = Wq + 1/mu).
+"""
+
+from __future__ import annotations
+
+import time as _wall
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from happysim_tpu.tpu.mesh import (
+    REPLICA_AXIS,
+    pad_to_multiple,
+    replica_mesh,
+    replica_sharding,
+)
+
+
+@dataclass(frozen=True)
+class MM1Result:
+    """Ensemble statistics for the M/M/1 run."""
+
+    mean_wait_s: float  # E[Wq] across replicas, post-warmup
+    std_wait_s: float
+    mean_sojourn_s: float  # Wq + service
+    analytic_wait_s: float  # rho/(mu-lambda)
+    wait_error_rel: float
+    n_replicas: int
+    customers_per_replica: int
+    simulated_events: int  # 2 per customer (arrival + departure)
+    wall_seconds: float
+    events_per_second: float
+
+
+def _mm1_scan(
+    key: jax.Array,
+    zeros: jax.Array,
+    lam: float,
+    mu: float,
+    n_customers: int,
+    warmup: int,
+):
+    """Scan the Lindley recursion for a batch of replica lanes.
+
+    ``zeros`` is the (R,)-shaped, replica-sharded initial carry — it anchors
+    the SPMD partitioning of every per-replica array in the scan. One
+    counter-based PRNG call per step produces draws for ALL lanes (threefry
+    is deterministic under sharding, so lane streams are stable regardless
+    of the mesh layout). Returns per-replica (sum_wait, sum_sq, sum_service).
+    """
+    n_replicas = zeros.shape[0]
+
+    def step(carry, i):
+        w, sum_w, sum_sq, sum_s = carry
+        step_key = jax.random.fold_in(key, i)
+        draws = jax.random.uniform(
+            step_key, (2, n_replicas), dtype=jnp.float32, minval=1e-12, maxval=1.0
+        )
+        interarrival = -jnp.log(draws[0]) / lam
+        service = -jnp.log(draws[1]) / mu
+        w_next = jnp.maximum(0.0, w + service - interarrival)
+        live = (i >= warmup).astype(jnp.float32)
+        sum_w = sum_w + live * w_next
+        sum_sq = sum_sq + live * w_next * w_next
+        sum_s = sum_s + live * service
+        return (w_next, sum_w, sum_sq, sum_s), None
+
+    (w, sum_w, sum_sq, sum_s), _ = lax.scan(
+        step, (zeros, zeros, zeros, zeros), jnp.arange(n_customers, dtype=jnp.uint32)
+    )
+    return sum_w, sum_sq, sum_s
+
+
+@partial(jax.jit, static_argnames=("lam", "mu", "n_customers", "warmup"))
+def _mm1_stats(key, zeros, lam, mu, n_customers, warmup):
+    sum_w, sum_sq, sum_s = _mm1_scan(key, zeros, lam, mu, n_customers, warmup)
+    count = jnp.float32(n_customers - warmup)
+    mean_per_replica = sum_w / count
+    # Cross-replica reduction: lowers to psum over ICI when sharded.
+    mean = jnp.mean(mean_per_replica)
+    var = jnp.mean(sum_sq / count) - mean * mean
+    mean_service = jnp.mean(sum_s / count)
+    return mean, jnp.sqrt(jnp.maximum(var, 0.0)), mean + mean_service
+
+
+def run_mm1_ensemble(
+    lam: float = 8.0,
+    mu: float = 10.0,
+    n_replicas: int = 65536,
+    n_customers: int = 4096,
+    warmup: Optional[int] = None,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+) -> MM1Result:
+    """Run the vmapped/sharded M/M/1 ensemble and return aggregate stats.
+
+    ``n_replicas`` is padded to a multiple of the mesh size; the replica axis
+    is sharded over the mesh so each chip owns an equal slab of lanes.
+    """
+    if lam >= mu:
+        raise ValueError(f"Unstable queue: lambda={lam} >= mu={mu}")
+    if warmup is None:
+        warmup = n_customers // 4
+    if mesh is None:
+        mesh = replica_mesh()
+    n_replicas = pad_to_multiple(n_replicas, mesh.size)
+
+    key = jax.random.PRNGKey(seed)
+    zeros = jax.device_put(
+        jnp.zeros((n_replicas,), jnp.float32), replica_sharding(mesh)
+    )
+
+    # Warm the compile cache before timing. Timing brackets a device->host
+    # transfer of the scalar result: on experimental PJRT platforms
+    # block_until_ready can return before execution finishes, so the fetch
+    # is the only trustworthy completion barrier.
+    stats = _mm1_stats(key, zeros, lam, mu, n_customers, warmup)
+    float(stats[0])
+    start = _wall.perf_counter()
+    mean, std, sojourn = _mm1_stats(key, zeros, lam, mu, n_customers, warmup)
+    mean_f = float(mean)
+    wall = _wall.perf_counter() - start
+
+    analytic = (lam / mu) / (mu - lam)
+    events = 2 * n_replicas * n_customers
+    return MM1Result(
+        mean_wait_s=mean_f,
+        std_wait_s=float(std),
+        mean_sojourn_s=float(sojourn),
+        analytic_wait_s=analytic,
+        wait_error_rel=abs(mean_f - analytic) / analytic,
+        n_replicas=n_replicas,
+        customers_per_replica=n_customers,
+        simulated_events=events,
+        wall_seconds=wall,
+        events_per_second=events / wall,
+    )

@@ -21,3 +21,21 @@ import pytest  # noqa: E402
 @pytest.fixture
 def test_output_dir(tmp_path):
     return tmp_path
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    """8 virtual CPU devices (JAX_PLATFORMS may be pinned to a TPU platform
+    by the environment, so request the cpu backend explicitly)."""
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "xla_force_host_platform_device_count not applied"
+    return devices
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh(cpu_devices):
+    from happysim_tpu.tpu.mesh import replica_mesh
+
+    return replica_mesh(cpu_devices[:8])

@@ -1,0 +1,107 @@
+"""TPU ensemble executor vs analytic theory and vs the Python host executor.
+
+The cross-backend equivalence oracle (SURVEY.md §4): because the two
+backends use different RNGs (Python `random` vs threefry), parity is
+statistical — both must agree with the analytic M/M/1 law and with each
+other within Monte-Carlo tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from happysim_tpu import ExponentialLatency, Instant, Server, Simulation, Sink, Source
+from happysim_tpu.tpu import run_mm1_ensemble
+from happysim_tpu.tpu.mesh import replica_mesh, replica_sharding
+from happysim_tpu.tpu.mm1 import _mm1_stats
+
+
+class TestAnalytic:
+    def test_mean_wait_within_one_percent(self, cpu_mesh):
+        result = run_mm1_ensemble(
+            lam=8.0, mu=10.0, n_replicas=8192, n_customers=4096, seed=0, mesh=cpu_mesh
+        )
+        assert result.wait_error_rel < 0.01
+        assert result.analytic_wait_s == pytest.approx(0.4)
+
+    def test_sojourn_includes_service(self, cpu_mesh):
+        result = run_mm1_ensemble(
+            lam=8.0, mu=10.0, n_replicas=4096, n_customers=4096, seed=1, mesh=cpu_mesh
+        )
+        # E[T] = Wq + 1/mu = 0.5
+        assert result.mean_sojourn_s == pytest.approx(0.5, rel=0.03)
+
+    def test_different_utilization(self, cpu_mesh):
+        result = run_mm1_ensemble(
+            lam=5.0, mu=10.0, n_replicas=4096, n_customers=2048, seed=2, mesh=cpu_mesh
+        )
+        # rho=0.5 -> Wq = 0.1
+        assert result.mean_wait_s == pytest.approx(0.1, rel=0.05)
+
+    def test_unstable_queue_rejected(self, cpu_mesh):
+        with pytest.raises(ValueError):
+            run_mm1_ensemble(lam=10.0, mu=10.0, mesh=cpu_mesh)
+
+    def test_replicas_padded_to_mesh(self, cpu_mesh):
+        result = run_mm1_ensemble(
+            lam=8.0, mu=10.0, n_replicas=1001, n_customers=128, seed=3, mesh=cpu_mesh
+        )
+        assert result.n_replicas % 8 == 0
+        assert result.n_replicas >= 1001
+
+
+class TestShardingInvariance:
+    def test_single_vs_eight_device_mesh_same_result(self, cpu_devices):
+        """Threefry is counter-based: lane streams are identical regardless
+        of mesh layout, so the ensemble mean matches bit-for-bit up to
+        reduction order."""
+        mesh1 = replica_mesh(cpu_devices[:1])
+        mesh8 = replica_mesh(cpu_devices[:8])
+        r1 = run_mm1_ensemble(
+            lam=8.0, mu=10.0, n_replicas=2048, n_customers=512, seed=7, mesh=mesh1
+        )
+        r8 = run_mm1_ensemble(
+            lam=8.0, mu=10.0, n_replicas=2048, n_customers=512, seed=7, mesh=mesh8
+        )
+        assert r1.mean_wait_s == pytest.approx(r8.mean_wait_s, rel=1e-5)
+
+    def test_seed_determinism(self, cpu_mesh):
+        a = run_mm1_ensemble(n_replicas=1024, n_customers=256, seed=9, mesh=cpu_mesh)
+        b = run_mm1_ensemble(n_replicas=1024, n_customers=256, seed=9, mesh=cpu_mesh)
+        assert a.mean_wait_s == b.mean_wait_s
+
+    def test_seed_variation(self, cpu_mesh):
+        a = run_mm1_ensemble(n_replicas=1024, n_customers=256, seed=1, mesh=cpu_mesh)
+        b = run_mm1_ensemble(n_replicas=1024, n_customers=256, seed=2, mesh=cpu_mesh)
+        assert a.mean_wait_s != b.mean_wait_s
+
+
+class TestCrossBackendEquivalence:
+    """Python heap executor and XLA ensemble executor agree statistically."""
+
+    def test_mean_queue_wait_matches_host_executor(self, cpu_mesh):
+        lam, mu = 8.0, 10.0
+        # Host executor: measure queue wait = sojourn - service.
+        sink = Sink()
+        server = Server(
+            "server",
+            service_time=ExponentialLatency(1.0 / mu, seed=101),
+            downstream=sink,
+        )
+        source = Source.poisson(rate=lam, target=server, stop_after=500.0, seed=100)
+        sim = Simulation(
+            sources=[source],
+            entities=[server, sink],
+            end_time=Instant.from_seconds(1000),
+        )
+        sim.run()
+        host_sojourn = sum(sink.latencies_s) / len(sink.latencies_s)
+
+        tpu = run_mm1_ensemble(
+            lam=lam, mu=mu, n_replicas=8192, n_customers=4096, seed=5, mesh=cpu_mesh
+        )
+        # Both estimate E[T]; host run is a single replica so give it slack.
+        assert tpu.mean_sojourn_s == pytest.approx(host_sojourn, rel=0.2)
+        # And both near the analytic law.
+        assert tpu.mean_sojourn_s == pytest.approx(1.0 / (mu - lam), rel=0.03)
+        assert host_sojourn == pytest.approx(1.0 / (mu - lam), rel=0.2)
