@@ -1,0 +1,27 @@
+from happysim_tpu.parallel.coordinator import CoordinatorStats, WindowedCoordinator
+from happysim_tpu.parallel.link import PartitionLink
+from happysim_tpu.parallel.partition import SimulationPartition
+from happysim_tpu.parallel.routing import RoutingError
+from happysim_tpu.parallel.runner import (
+    ParallelResult,
+    ParallelRunner,
+    RunConfig,
+)
+from happysim_tpu.parallel.simulation import ParallelSimulation
+from happysim_tpu.parallel.summary import ParallelSimulationSummary
+from happysim_tpu.parallel.validation import PartitionValidationError, validate_partitions
+
+__all__ = [
+    "CoordinatorStats",
+    "ParallelResult",
+    "ParallelRunner",
+    "ParallelSimulation",
+    "ParallelSimulationSummary",
+    "PartitionLink",
+    "PartitionValidationError",
+    "RoutingError",
+    "RunConfig",
+    "SimulationPartition",
+    "WindowedCoordinator",
+    "validate_partitions",
+]

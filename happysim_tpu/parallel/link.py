@@ -1,0 +1,54 @@
+"""Declared communication channels between partitions.
+
+Parity target: ``happysimulator/parallel/link.py:19`` — a PartitionLink
+declares ``min_latency > 0`` (the conservative-window correctness bound),
+plus optional stochastic latency and packet loss applied at exchange time.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.temporal import Duration, as_duration
+from happysim_tpu.distributions.latency_distribution import LatencyDistribution
+
+
+@dataclass
+class PartitionLink:
+    """Directed channel: events from ``source`` partition to ``dest``."""
+
+    source: str
+    dest: str
+    min_latency: Duration
+    latency: Optional[LatencyDistribution] = None
+    packet_loss: float = 0.0
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self.min_latency = as_duration(self.min_latency)
+        if self.min_latency.nanoseconds <= 0:
+            raise ValueError(
+                "PartitionLink.min_latency must be > 0: the window-barrier "
+                "correctness argument requires cross-partition events to "
+                "carry at least one window of latency"
+            )
+        if not 0.0 <= self.packet_loss < 1.0:
+            raise ValueError("packet_loss must be in [0, 1)")
+        self._rng = random.Random(self.seed)
+
+    def sample_latency(self, now) -> Duration:
+        if self.latency is None:
+            return self.min_latency
+        sampled = self.latency.get_latency(now)
+        if sampled < self.min_latency:
+            raise ValueError(
+                f"Link {self.source}->{self.dest} sampled latency "
+                f"{sampled.to_seconds()}s below min_latency "
+                f"{self.min_latency.to_seconds()}s"
+            )
+        return sampled
+
+    def drops(self) -> bool:
+        return self.packet_loss > 0.0 and self._rng.random() < self.packet_loss

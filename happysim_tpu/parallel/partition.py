@@ -1,0 +1,32 @@
+"""Partition declarations for model-parallel simulation.
+
+Parity target: ``happysimulator/parallel/partition.py:21`` — a partition owns
+its entities/sources/probes/fault_schedule; each gets its own inner
+Simulation and isolated deterministic event counter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Optional
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.protocols import Simulatable
+    from happysim_tpu.faults.schedule import FaultSchedule
+    from happysim_tpu.load.source import Source
+
+
+@dataclass
+class SimulationPartition:
+    """One shard of a partitioned simulation."""
+
+    name: str
+    entities: list = field(default_factory=list)
+    sources: list = field(default_factory=list)
+    probes: list = field(default_factory=list)
+    fault_schedule: Optional[Any] = None
+
+    def owns(self, entity: Any) -> bool:
+        return any(entity is e for e in self.entities) or any(
+            entity is s for s in self.sources
+        )

@@ -1,0 +1,52 @@
+"""Event routing between partitions.
+
+Parity target: ``happysimulator/parallel/routing.py:40-61`` — a router
+closure installed on each partition's Simulation classifies produced events
+as local (push), cross-partition (outbox), or illegal (no declared link).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+from happysim_tpu.core.event import Event
+
+if TYPE_CHECKING:
+    from happysim_tpu.parallel.partition import SimulationPartition
+
+
+class RoutingError(RuntimeError):
+    pass
+
+
+def make_router(
+    partition: "SimulationPartition",
+    entity_to_partition: dict[int, str],
+    links_from: set[str],
+    outbox: list[Event],
+) -> Callable[[list[Event]], list[Event]]:
+    """Build the router for one partition.
+
+    entity_to_partition maps id(entity) -> partition name; links_from is the
+    set of destination partition names this partition may send to.
+    """
+    local_name = partition.name
+
+    def route(events: list[Event]) -> list[Event]:
+        local: list[Event] = []
+        for event in events:
+            owner = entity_to_partition.get(id(event.target))
+            if owner is None or owner == local_name:
+                local.append(event)
+            elif owner in links_from:
+                outbox.append(event)
+            else:
+                raise RoutingError(
+                    f"Partition '{local_name}' produced an event for entity "
+                    f"'{getattr(event.target, 'name', event.target)}' in "
+                    f"partition '{owner}' but no PartitionLink "
+                    f"{local_name}->{owner} is declared"
+                )
+        return local
+
+    return route

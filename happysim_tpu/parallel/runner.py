@@ -1,0 +1,109 @@
+"""Ensemble / Monte-Carlo runner.
+
+Parity target: ``happysimulator/parallel/runner.py:82`` —
+``run_replicas(build_fn, n_replicas, base_seed)`` (:115) seeds each replica
+and farms RunConfigs to a ProcessPoolExecutor; ``run_sweep(configs)`` (:98).
+
+Rebuild extension: ``backend`` selects the execution tier —
+- "process": one OS process per batch of replicas (arbitrary models),
+- "thread": thread pool (cheap models / free-threaded Python),
+- "inline": sequential (debugging),
+- "tpu":    compiled XLA ensemble for vectorizable models (the surface the
+  BASELINE.json north star names). Build an
+  :class:`~happysim_tpu.tpu.model.EnsembleModel` and call
+  :meth:`ParallelRunner.run_ensemble`; replicas execute as lanes of one
+  program on the chip mesh instead of OS processes.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from happysim_tpu.core.simulation import Simulation
+from happysim_tpu.instrumentation.summary import SimulationSummary
+
+BuildFn = Callable[..., Simulation]
+
+
+@dataclass
+class RunConfig:
+    """One unit of ensemble work: build a simulation and run it."""
+
+    name: str
+    build_fn: BuildFn
+    seed: int = 0
+    params: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ParallelResult:
+    name: str
+    summary: SimulationSummary
+    artifacts: dict[str, Any] = field(default_factory=dict)
+    seed: int = 0
+
+
+def _execute_config(config: RunConfig) -> ParallelResult:
+    sim = config.build_fn(seed=config.seed, **config.params)
+    summary = sim.run()
+    artifacts: dict[str, Any] = {}
+    harvest = getattr(sim, "harvest_artifacts", None)
+    if callable(harvest):
+        artifacts = harvest()
+    return ParallelResult(
+        name=config.name, summary=summary, artifacts=artifacts, seed=config.seed
+    )
+
+
+class ParallelRunner:
+    """Runs many independent simulations (replicas or parameter sweeps)."""
+
+    def __init__(self, max_workers: Optional[int] = None, backend: str = "process"):
+        if backend not in ("process", "thread", "inline", "tpu"):
+            raise ValueError(f"Unknown backend {backend!r}")
+        self.max_workers = max_workers
+        self.backend = backend
+
+    def run_ensemble(self, model, n_replicas: int = 8192, **kwargs):
+        """Compiled ensemble execution of an EnsembleModel (backend="tpu").
+
+        Works from any backend setting — the model, not the runner, is what
+        must be vectorizable. Returns an
+        :class:`~happysim_tpu.tpu.engine.EnsembleResult`.
+        """
+        from happysim_tpu.tpu.engine import run_ensemble
+
+        return run_ensemble(model, n_replicas=n_replicas, **kwargs)
+
+    def run_sweep(self, configs: list[RunConfig]) -> list[ParallelResult]:
+        """Run each config once; results in input order."""
+        if self.backend == "tpu":
+            raise ValueError(
+                "backend='tpu' executes EnsembleModels, not build_fn configs — "
+                "use ParallelRunner.run_ensemble(model, ...) or pass "
+                "sweeps= to happysim_tpu.tpu.run_ensemble"
+            )
+        if self.backend == "inline" or len(configs) == 1:
+            return [_execute_config(c) for c in configs]
+        pool_cls = ProcessPoolExecutor if self.backend == "process" else ThreadPoolExecutor
+        with pool_cls(max_workers=self.max_workers) as pool:
+            return list(pool.map(_execute_config, configs))
+
+    def run_replicas(
+        self,
+        build_fn: BuildFn,
+        n_replicas: int,
+        base_seed: int = 0,
+        name: str = "replica",
+        **params: Any,
+    ) -> list[ParallelResult]:
+        """n_replicas independent runs seeded base_seed + i."""
+        configs = [
+            RunConfig(
+                name=f"{name}-{i}", build_fn=build_fn, seed=base_seed + i, params=params
+            )
+            for i in range(n_replicas)
+        ]
+        return self.run_sweep(configs)

@@ -1,0 +1,224 @@
+"""Partitioned model-parallel simulation (threads + windowed barriers).
+
+Parity target: ``happysimulator/parallel/simulation.py:31`` — partitions each
+get an inner Simulation (:94-104); without links they run independently on a
+thread pool (:170-195); with links the WindowedCoordinator drives lockstep
+windows. Per-partition contextvars keep event ordering deterministic
+regardless of thread scheduling (reference core/event.py:57-67).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import sys
+import time as _wall
+import warnings
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Union
+
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.simulation import Simulation
+from happysim_tpu.core.temporal import Duration, Instant, as_duration, as_instant
+from happysim_tpu.parallel.coordinator import WindowedCoordinator
+from happysim_tpu.parallel.link import PartitionLink
+from happysim_tpu.parallel.partition import SimulationPartition
+from happysim_tpu.parallel.routing import make_router
+from happysim_tpu.parallel.summary import ParallelSimulationSummary
+from happysim_tpu.parallel.validation import validate_partitions
+
+
+class _PartitionRuntime:
+    """A partition plus its Simulation, execution context, and outbox."""
+
+    def __init__(
+        self,
+        partition: SimulationPartition,
+        end_time: Instant,
+        entity_to_partition: dict[int, str],
+        links_from: set[str],
+    ):
+        self.partition = partition
+        self.outbox: list[Event] = []
+        self._entity_to_partition = entity_to_partition
+        # Each partition lives in its own contextvars.Context so its event
+        # sort indices are isolated and deterministic across thread schedules.
+        # (The context retains the counter installed by _build_persistent;
+        # every later sim operation runs inside the same context.)
+        self._ctx = contextvars.copy_context()
+        self.sim = self._ctx.run(self._build_persistent, end_time, links_from)
+        self.busy_seconds = 0.0
+
+    def _build_persistent(self, end_time, links_from):
+        import itertools
+
+        from happysim_tpu.core import event as event_module
+
+        event_module._sort_counter.set(itertools.count())
+        sim = Simulation(
+            end_time=end_time,
+            sources=self.partition.sources,
+            entities=self.partition.entities,
+            probes=self.partition.probes,
+            fault_schedule=self.partition.fault_schedule,
+        )
+        sim._event_router = make_router(
+            self.partition, self._entity_to_partition, links_from, self.outbox
+        )
+        return sim
+
+    def partition_of(self, entity) -> str:
+        return self._entity_to_partition[id(entity)]
+
+    def run_window(self, until: Instant) -> float:
+        start = _wall.perf_counter()
+        self._ctx.run(self.sim._run_window, until)
+        elapsed = _wall.perf_counter() - start
+        self.busy_seconds += elapsed
+        return elapsed
+
+    def run_full(self) -> None:
+        self._ctx.run(self._run_full_inner)
+
+    def _run_full_inner(self) -> None:
+        start = _wall.perf_counter()
+        self.sim.run()
+        self.busy_seconds += _wall.perf_counter() - start
+
+    def schedule_incoming(self, event: Event, arrival: Instant) -> None:
+        """Clone a cross-partition event into this partition at ``arrival``."""
+
+        def do():
+            clone = Event(
+                time=arrival,
+                event_type=event.event_type,
+                target=event.target,
+                daemon=event.daemon,
+                on_complete=list(event.on_complete),
+                context=event.context,
+            )
+            self.sim._event_heap.push(clone)
+
+        self._ctx.run(do)
+
+    def finalize(self, end_time: Instant) -> None:
+        self.sim._completed = True
+        if not end_time.is_infinite():
+            self.sim._clock.update(end_time)
+
+
+class ParallelSimulation:
+    """Runs partitions in parallel; coordinated when links are declared."""
+
+    def __init__(
+        self,
+        partitions: list[SimulationPartition],
+        links: Optional[list[PartitionLink]] = None,
+        end_time: Union[Instant, float, None] = None,
+        duration: Union[Duration, float, None] = None,
+        window: Union[Duration, float, None] = None,
+        max_workers: Optional[int] = None,
+    ):
+        if not partitions:
+            raise ValueError("Need at least one partition")
+        self.partitions = partitions
+        self.links = list(links or [])
+        if duration is not None and end_time is not None:
+            raise ValueError("Specify either 'duration' or 'end_time', not both")
+        if duration is not None:
+            end_time = Instant.Epoch + as_duration(duration).to_seconds()
+        if end_time is None:
+            if self.links:
+                raise ValueError("Coordinated (linked) runs require a finite end_time")
+            end_time = Instant.Infinity
+        self._end = as_instant(end_time) if not isinstance(end_time, Instant) else end_time
+        self._max_workers = max_workers
+
+        validate_partitions(partitions, self.links)
+
+        if self.links:
+            min_link = min(l.min_latency for l in self.links)
+            if window is None:
+                self._window = min_link
+            else:
+                self._window = as_duration(window)
+                if self._window > min_link:
+                    raise ValueError(
+                        f"Window {self._window.to_seconds()}s exceeds minimum "
+                        f"link latency {min_link.to_seconds()}s — events could "
+                        f"cross partitions inside a window"
+                    )
+        else:
+            self._window = None
+            if sys.version_info < (3, 13) or getattr(sys, "_is_gil_enabled", lambda: True)():
+                warnings.warn(
+                    "ParallelSimulation without links uses threads; with the "
+                    "GIL enabled partitions serialize. Use ParallelRunner "
+                    "(processes) or the TPU ensemble backend for true "
+                    "parallelism.",
+                    stacklevel=2,
+                )
+
+        entity_to_partition: dict[int, str] = {}
+        for partition in partitions:
+            for obj in (*partition.entities, *partition.sources):
+                entity_to_partition[id(obj)] = partition.name
+        links_by_source: dict[str, set[str]] = {}
+        for link in self.links:
+            links_by_source.setdefault(link.source, set()).add(link.dest)
+
+        self._runtimes = [
+            _PartitionRuntime(
+                partition,
+                self._end,
+                entity_to_partition,
+                links_by_source.get(partition.name, set()),
+            )
+            for partition in partitions
+        ]
+        self._coordinator_stats = None
+
+    def run(self) -> ParallelSimulationSummary:
+        start = _wall.perf_counter()
+        if self.links:
+            coordinator = WindowedCoordinator(
+                self._runtimes, self.links, self._window, self._end
+            )
+            self._coordinator_stats = coordinator.run()
+            wall = self._coordinator_stats.wall_seconds
+        else:
+            workers = self._max_workers or len(self._runtimes)
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                futures = [pool.submit(r.run_full) for r in self._runtimes]
+                for future in futures:
+                    future.result()
+            wall = _wall.perf_counter() - start
+        return self._build_summary(wall)
+
+    def _build_summary(self, wall: float) -> ParallelSimulationSummary:
+        summaries = {
+            r.partition.name: r.sim._build_summary() for r in self._runtimes
+        }
+        total_events = sum(s.events_processed for s in summaries.values())
+        busy_sum = sum(r.busy_seconds for r in self._runtimes)
+        speedup = busy_sum / wall if wall > 0 else 1.0
+        result = ParallelSimulationSummary(
+            partition_summaries=summaries,
+            total_events=total_events,
+            wall_seconds=wall,
+            speedup=speedup,
+            parallelism_efficiency=speedup / len(self._runtimes),
+        )
+        stats = self._coordinator_stats
+        if stats is not None:
+            result.total_windows = stats.total_windows
+            result.cross_partition_events = stats.cross_partition_events
+            result.dropped_events = stats.dropped_events
+            if stats.wall_seconds > 0:
+                result.barrier_overhead = max(
+                    0.0, 1.0 - stats.busy_max_seconds / stats.wall_seconds
+                )
+            if stats.busy_max_seconds > 0:
+                result.coordination_efficiency = min(
+                    1.0, stats.busy_sum_seconds / (stats.busy_max_seconds * len(self._runtimes))
+                )
+        return result

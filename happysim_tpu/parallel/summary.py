@@ -1,0 +1,63 @@
+"""Aggregate summaries for parallel runs.
+
+Parity target: ``happysimulator/parallel/summary.py`` and the aggregate
+metrics assembled in ``parallel/simulation.py:266-284`` (speedup,
+parallelism efficiency, windows, cross-partition events, barrier overhead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from happysim_tpu.instrumentation.summary import SimulationSummary
+
+
+@dataclass
+class ParallelSimulationSummary:
+    partition_summaries: dict[str, SimulationSummary]
+    total_events: int
+    wall_seconds: float
+    total_windows: int = 0
+    cross_partition_events: int = 0
+    dropped_events: int = 0
+    speedup: float = 1.0
+    parallelism_efficiency: float = 1.0
+    barrier_overhead: float = 0.0
+    coordination_efficiency: float = 1.0
+
+    @property
+    def events_per_second(self) -> float:
+        return self.total_events / self.wall_seconds if self.wall_seconds > 0 else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "total_events": self.total_events,
+            "wall_seconds": self.wall_seconds,
+            "events_per_second": self.events_per_second,
+            "total_windows": self.total_windows,
+            "cross_partition_events": self.cross_partition_events,
+            "dropped_events": self.dropped_events,
+            "speedup": self.speedup,
+            "parallelism_efficiency": self.parallelism_efficiency,
+            "barrier_overhead": self.barrier_overhead,
+            "coordination_efficiency": self.coordination_efficiency,
+            "partitions": {
+                name: summary.to_dict()
+                for name, summary in self.partition_summaries.items()
+            },
+        }
+
+    def __str__(self) -> str:
+        lines = [
+            "ParallelSimulationSummary",
+            f"  partitions: {len(self.partition_summaries)}  windows: {self.total_windows}",
+            f"  events: {self.total_events:,} in {self.wall_seconds:.3f}s "
+            f"({self.events_per_second:,.0f}/s)",
+            f"  cross-partition: {self.cross_partition_events} "
+            f"(dropped {self.dropped_events})",
+            f"  speedup: {self.speedup:.2f}x  efficiency: "
+            f"{self.parallelism_efficiency:.1%}  barrier overhead: "
+            f"{self.barrier_overhead:.1%}",
+        ]
+        return "\n".join(lines)

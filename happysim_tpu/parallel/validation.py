@@ -1,0 +1,97 @@
+"""Init-time safety checks for partitioned runs.
+
+Parity target: ``happysimulator/parallel/validation.py:19-180`` — verifies
+partition disjointness, link window bounds, and (best effort) that entities
+don't hold direct references into other partitions without a declared link
+(walking attribute graphs to bounded depth).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable
+
+from happysim_tpu.core.entity import Entity
+
+if TYPE_CHECKING:
+    from happysim_tpu.parallel.link import PartitionLink
+    from happysim_tpu.parallel.partition import SimulationPartition
+
+_WALK_DEPTH = 3
+
+
+class PartitionValidationError(ValueError):
+    pass
+
+
+def validate_partitions(
+    partitions: "list[SimulationPartition]",
+    links: "list[PartitionLink]",
+) -> None:
+    names = [p.name for p in partitions]
+    if len(set(names)) != len(names):
+        raise PartitionValidationError(f"Duplicate partition names: {names}")
+    name_set = set(names)
+
+    seen: dict[int, str] = {}
+    for partition in partitions:
+        for obj in (*partition.entities, *partition.sources):
+            if id(obj) in seen:
+                raise PartitionValidationError(
+                    f"Entity '{getattr(obj, 'name', obj)}' appears in both "
+                    f"'{seen[id(obj)]}' and '{partition.name}'"
+                )
+            seen[id(obj)] = partition.name
+
+    for link in links:
+        if link.source not in name_set or link.dest not in name_set:
+            raise PartitionValidationError(
+                f"Link {link.source}->{link.dest} references unknown partition"
+            )
+
+    linked = {(l.source, l.dest) for l in links}
+    _check_cross_references(partitions, seen, linked)
+
+
+def _check_cross_references(
+    partitions: "list[SimulationPartition]",
+    owner_of: dict[int, str],
+    linked: set[tuple[str, str]],
+) -> None:
+    """Walk entity attributes to find undeclared cross-partition references."""
+    for partition in partitions:
+        for root in partition.entities:
+            for found, path in _walk(root, _WALK_DEPTH):
+                owner = owner_of.get(id(found))
+                if owner is None or owner == partition.name:
+                    continue
+                if (partition.name, owner) not in linked:
+                    raise PartitionValidationError(
+                        f"Entity '{getattr(root, 'name', root)}' in partition "
+                        f"'{partition.name}' references "
+                        f"'{getattr(found, 'name', found)}' in partition "
+                        f"'{owner}' via {path}, but no link "
+                        f"{partition.name}->{owner} is declared"
+                    )
+
+
+def _walk(obj, depth: int, path: str = "", visited=None):
+    if visited is None:
+        visited = set()
+    if depth <= 0 or id(obj) in visited:
+        return
+    visited.add(id(obj))
+    attrs = getattr(obj, "__dict__", None)
+    if attrs is None:
+        return
+    for key, value in attrs.items():
+        here = f"{path}.{key}" if path else key
+        candidates: Iterable = ()
+        if isinstance(value, Entity):
+            candidates = (value,)
+        elif isinstance(value, (list, tuple, set)):
+            candidates = (v for v in value if isinstance(v, Entity))
+        elif isinstance(value, dict):
+            candidates = (v for v in value.values() if isinstance(v, Entity))
+        for candidate in candidates:
+            yield candidate, here
+            yield from _walk(candidate, depth - 1, here, visited)

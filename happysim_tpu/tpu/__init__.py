@@ -14,10 +14,18 @@ from happysim_tpu.tpu.mesh import (
     replica_sharding,
     replicated_sharding,
 )
+from happysim_tpu.tpu.engine import EnsembleResult, hist_percentile, run_ensemble
 from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
+from happysim_tpu.tpu.model import EnsembleModel, mm1_model
 
 __all__ = [
+    "EnsembleModel",
+    "EnsembleResult",
     "MM1Result",
+    "hist_percentile",
+    "mm1_model",
+    "run_ensemble",
+    "run_mm1_ensemble",
     "REPLICA_AXIS",
     "pad_to_multiple",
     "replica_mesh",

@@ -1,0 +1,198 @@
+"""Vectorizable model specs for the general TPU ensemble engine.
+
+This is the "vectorizable protocol" of SURVEY.md §7: a restricted component
+set (Source / Server+queue / Router / Sink) whose semantics match the host
+components (components/server/server.py, components/queue.py, ...) but are
+declared as static specs that compile to struct-of-arrays state. The
+reference's surface being replaced is `ParallelRunner.run_replicas`
+(/root/reference/happysimulator/parallel/runner.py:115) for vectorizable
+topologies.
+
+Build a model::
+
+    m = EnsembleModel(horizon_s=60.0)
+    src = m.source(rate=8.0, kind="poisson")
+    srv = m.server(concurrency=1, service_mean=0.1, queue_capacity=64)
+    snk = m.sink()
+    m.connect(src, srv)
+    m.connect(srv, snk)
+
+Then ``run_ensemble(m, n_replicas=65536)`` executes all replicas as one XLA
+program (see engine.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Union
+
+SOURCE = "source"
+SERVER = "server"
+SINK = "sink"
+ROUTER = "router"
+
+ARRIVAL_KINDS = ("poisson", "constant")
+SERVICE_KINDS = ("exponential", "constant")
+ROUTER_POLICIES = ("random", "round_robin", "least_outstanding")
+
+
+@dataclass(frozen=True)
+class NodeRef:
+    kind: str
+    index: int
+
+
+@dataclass
+class SourceSpec:
+    rate: float
+    arrival: str = "poisson"
+    stop_after_s: Optional[float] = None
+    downstream: Optional[NodeRef] = None
+
+
+@dataclass
+class ServerSpec:
+    concurrency: int = 1
+    service_mean_s: float = 0.1
+    service: str = "exponential"
+    queue_capacity: int = 64
+    downstream: Optional[NodeRef] = None
+
+
+@dataclass
+class RouterSpec:
+    policy: str = "random"
+    targets: list[NodeRef] = field(default_factory=list)
+
+
+@dataclass
+class SinkSpec:
+    pass
+
+
+class EnsembleModel:
+    """Static topology of vectorizable components."""
+
+    def __init__(self, horizon_s: float = 60.0):
+        self.horizon_s = horizon_s
+        self.sources: list[SourceSpec] = []
+        self.servers: list[ServerSpec] = []
+        self.routers: list[RouterSpec] = []
+        self.sinks: list[SinkSpec] = []
+
+    # -- builders ----------------------------------------------------------
+    def source(
+        self,
+        rate: float,
+        kind: str = "poisson",
+        stop_after_s: Optional[float] = None,
+    ) -> NodeRef:
+        if kind not in ARRIVAL_KINDS:
+            raise ValueError(f"arrival kind {kind!r} not in {ARRIVAL_KINDS}")
+        self.sources.append(SourceSpec(rate=rate, arrival=kind, stop_after_s=stop_after_s))
+        return NodeRef(SOURCE, len(self.sources) - 1)
+
+    def server(
+        self,
+        concurrency: int = 1,
+        service_mean: float = 0.1,
+        service: str = "exponential",
+        queue_capacity: int = 64,
+    ) -> NodeRef:
+        if service not in SERVICE_KINDS:
+            raise ValueError(f"service kind {service!r} not in {SERVICE_KINDS}")
+        if concurrency < 1:
+            raise ValueError("concurrency must be >= 1")
+        if queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+        self.servers.append(
+            ServerSpec(
+                concurrency=concurrency,
+                service_mean_s=service_mean,
+                service=service,
+                queue_capacity=queue_capacity,
+            )
+        )
+        return NodeRef(SERVER, len(self.servers) - 1)
+
+    def router(self, policy: str = "random", targets: Sequence[NodeRef] = ()) -> NodeRef:
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(f"router policy {policy!r} not in {ROUTER_POLICIES}")
+        targets = list(targets)
+        self.routers.append(RouterSpec(policy=policy, targets=targets))
+        return NodeRef(ROUTER, len(self.routers) - 1)
+
+    def sink(self) -> NodeRef:
+        self.sinks.append(SinkSpec())
+        return NodeRef(SINK, len(self.sinks) - 1)
+
+    # -- wiring ------------------------------------------------------------
+    def connect(self, origin: NodeRef, downstream: NodeRef) -> None:
+        if origin.kind == SOURCE:
+            self.sources[origin.index].downstream = downstream
+        elif origin.kind == SERVER:
+            self.servers[origin.index].downstream = downstream
+        elif origin.kind == ROUTER:
+            if downstream.kind == ROUTER:
+                raise ValueError("Routers cannot target routers (single hop)")
+            self.routers[origin.index].targets.append(downstream)
+        else:
+            raise ValueError("Sinks have no downstream")
+
+    def pipeline(self, *stages_args, **kwargs):
+        raise NotImplementedError  # reserved
+
+    # -- validation --------------------------------------------------------
+    def validate(self) -> None:
+        if not self.sources:
+            raise ValueError("Model needs at least one source")
+        if not self.sinks:
+            raise ValueError("Model needs at least one sink")
+        for i, source in enumerate(self.sources):
+            if source.downstream is None:
+                raise ValueError(f"source[{i}] has no downstream")
+            if source.downstream.kind == ROUTER and not self.routers[
+                source.downstream.index
+            ].targets:
+                raise ValueError(f"router targeted by source[{i}] has no targets")
+        for i, server in enumerate(self.servers):
+            if server.downstream is None:
+                raise ValueError(f"server[{i}] has no downstream")
+            if server.downstream.kind == ROUTER and not self.routers[
+                server.downstream.index
+            ].targets:
+                raise ValueError(f"router targeted by server[{i}] has no targets")
+        for i, router in enumerate(self.routers):
+            kinds = {t.kind for t in router.targets}
+            for target in router.targets:
+                if target.kind == ROUTER:
+                    raise ValueError(f"router[{i}] targets another router")
+            if len(kinds) > 1:
+                raise ValueError(
+                    f"router[{i}] targets must be all servers or all sinks"
+                )
+            if router.policy == "least_outstanding" and kinds == {SINK}:
+                raise ValueError(
+                    f"router[{i}]: least_outstanding requires server targets "
+                    "(sinks have no outstanding work)"
+                )
+
+    @property
+    def max_concurrency(self) -> int:
+        return max((s.concurrency for s in self.servers), default=1)
+
+    @property
+    def max_queue_capacity(self) -> int:
+        return max((s.queue_capacity for s in self.servers), default=1)
+
+
+def mm1_model(lam: float = 8.0, mu: float = 10.0, horizon_s: float = 60.0,
+              queue_capacity: int = 512) -> EnsembleModel:
+    """The canonical M/M/1 as a general-engine model (oracle workload)."""
+    model = EnsembleModel(horizon_s=horizon_s)
+    src = model.source(rate=lam, kind="poisson")
+    srv = model.server(concurrency=1, service_mean=1.0 / mu, queue_capacity=queue_capacity)
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    return model

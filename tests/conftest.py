@@ -8,7 +8,7 @@ jax initializes a backend, hence the top-of-conftest placement.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +16,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import pytest  # noqa: E402
+
+try:  # Force the CPU backend even when a TPU plugin self-registered
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax-less environments
+    pass
 
 
 @pytest.fixture

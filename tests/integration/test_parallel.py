@@ -1,0 +1,215 @@
+"""Parallel runtime: equivalence oracles, links, determinism, runner.
+
+Mirrors the reference's key patterns
+(``tests/integration/test_parallel_simulation.py:99,254,295``):
+single-partition ≡ plain Simulation, deterministic re-runs, and generator
+continuity across windows.
+"""
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Duration,
+    Entity,
+    Event,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.parallel import (
+    ParallelRunner,
+    ParallelSimulation,
+    PartitionLink,
+    PartitionValidationError,
+    RunConfig,
+    SimulationPartition,
+)
+
+
+class Relay(Entity):
+    """Forwards everything to a (possibly remote) target."""
+
+    def __init__(self, name, target):
+        super().__init__(name)
+        self.target = target
+        self.events_received = 0
+
+    def handle_event(self, event):
+        self.events_received += 1
+        return [self.forward(event, self.target)]
+
+
+def build_mm1(seed: int = 0, rate: float = 50.0) -> Simulation:
+    """Top-level so ProcessPoolExecutor can pickle it."""
+    sink = Sink()
+    server = Server(
+        "server", service_time=ConstantLatency(0.01), downstream=sink
+    )
+    source = Source.poisson(rate=rate, target=server, stop_after=5.0, seed=seed)
+    sim = Simulation(sources=[source], entities=[server, sink], end_time=Instant.from_seconds(20))
+    sim.harvest_artifacts = lambda: {"received": sink.events_received}
+    return sim
+
+
+class TestSinglePartitionEquivalence:
+    def _world(self):
+        sink = Sink()
+        server = Server("server", service_time=ConstantLatency(0.02), downstream=sink)
+        source = Source.constant(rate=20.0, target=server, stop_after=2.0)
+        return sink, server, source
+
+    def test_matches_plain_simulation(self):
+        sink_a, server_a, source_a = self._world()
+        plain = Simulation(
+            sources=[source_a], entities=[server_a, sink_a], end_time=Instant.from_seconds(10)
+        )
+        plain.run()
+
+        sink_b, server_b, source_b = self._world()
+        with pytest.warns(UserWarning):
+            parallel = ParallelSimulation(
+                [
+                    SimulationPartition(
+                        "only", entities=[server_b, sink_b], sources=[source_b]
+                    )
+                ],
+                end_time=Instant.from_seconds(10),
+            )
+        parallel.run()
+
+        assert sink_b.events_received == sink_a.events_received == 40
+        assert sink_b.latencies_s == sink_a.latencies_s
+
+
+class TestCoordinatedPartitions:
+    def _linked_world(self, loss=0.0, seed=None):
+        sink = Sink("remote-sink")
+        relay_target = sink
+        relay = Relay("relay", relay_target)
+        source = Source.constant(rate=10.0, target=relay, stop_after=1.95)
+        part_a = SimulationPartition("A", entities=[relay], sources=[source])
+        part_b = SimulationPartition("B", entities=[sink])
+        link = PartitionLink(
+            "A", "B", min_latency=Duration.from_seconds(0.1), packet_loss=loss, seed=seed
+        )
+        return sink, relay, ParallelSimulation(
+            [part_a, part_b], links=[link], end_time=Instant.from_seconds(10)
+        )
+
+    def test_cross_partition_events_arrive_with_link_latency(self):
+        sink, relay, parallel = self._linked_world()
+        summary = parallel.run()
+        assert relay.events_received == 19
+        assert sink.events_received == 19
+        assert summary.cross_partition_events == 19
+        # Arrival time = send time + link latency (0.1s).
+        first = min(t.to_seconds() for t in sink.completion_times)
+        assert first == pytest.approx(0.2)  # sent at 0.1, +0.1 link
+
+    def test_deterministic_rerun(self):
+        sink1, _, p1 = self._linked_world(loss=0.3, seed=7)
+        p1.run()
+        sink2, _, p2 = self._linked_world(loss=0.3, seed=7)
+        p2.run()
+        assert sink1.events_received == sink2.events_received
+        assert [t.nanoseconds for t in sink1.completion_times] == [
+            t.nanoseconds for t in sink2.completion_times
+        ]
+
+    def test_packet_loss_drops(self):
+        sink, _, parallel = self._linked_world(loss=0.5, seed=3)
+        summary = parallel.run()
+        assert 0 < sink.events_received < 19
+        assert summary.dropped_events == 19 - sink.events_received
+
+    def test_generator_spans_windows(self):
+        """A generator process sleeping longer than the window survives it."""
+        done = []
+
+        class Sleeper(Entity):
+            def handle_event(self, event):
+                yield 0.55  # > 5 windows of 0.1
+                done.append(self.now.to_seconds())
+
+        sleeper = Sleeper("sleeper")
+        sink = Sink()
+        relay = Relay("relay", sink)
+        part_a = SimulationPartition("A", entities=[sleeper, relay])
+        part_b = SimulationPartition("B", entities=[sink])
+        link = PartitionLink("A", "B", min_latency=Duration.from_seconds(0.1))
+        parallel = ParallelSimulation(
+            [part_a, part_b], links=[link], end_time=Instant.from_seconds(2)
+        )
+        parallel._runtimes[0]._ctx.run(
+            parallel._runtimes[0].sim.schedule,
+            Event(Instant.Epoch, "go", target=sleeper),
+        )
+        parallel.run()
+        assert done == [0.55]
+
+    def test_window_larger_than_min_latency_rejected(self):
+        sink = Sink()
+        part_a = SimulationPartition("A", entities=[Relay("r", sink)])
+        part_b = SimulationPartition("B", entities=[sink])
+        with pytest.raises(ValueError, match="exceeds minimum link latency"):
+            ParallelSimulation(
+                [part_a, part_b],
+                links=[PartitionLink("A", "B", min_latency=Duration.from_seconds(0.05))],
+                end_time=Instant.from_seconds(1),
+                window=0.1,
+            )
+
+    def test_undeclared_cross_reference_rejected(self):
+        sink = Sink()
+        relay = Relay("relay", sink)  # references B's sink
+        part_a = SimulationPartition("A", entities=[relay])
+        part_b = SimulationPartition("B", entities=[sink])
+        with pytest.raises(PartitionValidationError, match="no link"):
+            ParallelSimulation([part_a, part_b], end_time=Instant.from_seconds(1))
+
+    def test_duplicate_entity_rejected(self):
+        sink = Sink()
+        with pytest.raises(PartitionValidationError, match="appears in both"):
+            ParallelSimulation(
+                [
+                    SimulationPartition("A", entities=[sink]),
+                    SimulationPartition("B", entities=[sink]),
+                ],
+                end_time=Instant.from_seconds(1),
+            )
+
+
+class TestParallelRunner:
+    def test_inline_replicas(self):
+        runner = ParallelRunner(backend="inline")
+        results = runner.run_replicas(build_mm1, n_replicas=4, base_seed=100)
+        assert len(results) == 4
+        assert all(r.summary.events_processed > 0 for r in results)
+        # Different seeds -> different arrival streams.
+        counts = {r.artifacts["received"] for r in results}
+        assert len(counts) > 1
+
+    def test_same_seed_reproduces(self):
+        runner = ParallelRunner(backend="inline")
+        a = runner.run_replicas(build_mm1, n_replicas=1, base_seed=42)[0]
+        b = runner.run_replicas(build_mm1, n_replicas=1, base_seed=42)[0]
+        assert a.artifacts == b.artifacts
+
+    def test_thread_backend(self):
+        runner = ParallelRunner(backend="thread", max_workers=4)
+        results = runner.run_replicas(build_mm1, n_replicas=4, base_seed=0)
+        assert len(results) == 4
+
+    def test_process_backend(self):
+        runner = ParallelRunner(backend="process", max_workers=2)
+        results = runner.run_sweep(
+            [
+                RunConfig("lo", build_mm1, seed=1, params={"rate": 20.0}),
+                RunConfig("hi", build_mm1, seed=1, params={"rate": 80.0}),
+            ]
+        )
+        assert results[0].name == "lo"
+        assert results[1].artifacts["received"] > results[0].artifacts["received"]
