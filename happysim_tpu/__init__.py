@@ -94,6 +94,24 @@ from happysim_tpu.instrumentation import (
     SimulationSummary,
     ThroughputTracker,
 )
+from happysim_tpu.sketching import (
+    BloomFilter,
+    CountMinSketch,
+    FrequencyEstimate,
+    HyperLogLog,
+    KeyRange,
+    MerkleTree,
+    ReservoirSampler,
+    Sketch,
+    TDigest,
+    TopK,
+)
+from happysim_tpu.components.sketching import (
+    LatencyPercentiles,
+    QuantileEstimator,
+    SketchCollector,
+    TopKCollector,
+)
 from happysim_tpu.load import (
     ConstantArrivalTimeProvider,
     ConstantRateProfile,

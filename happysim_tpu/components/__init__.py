@@ -25,8 +25,18 @@ from happysim_tpu.components.server import (
     ServerStats,
     WeightedConcurrency,
 )
+from happysim_tpu.components.sketching import (
+    LatencyPercentiles,
+    QuantileEstimator,
+    SketchCollector,
+    TopKCollector,
+)
 
 __all__ = [
+    "LatencyPercentiles",
+    "QuantileEstimator",
+    "SketchCollector",
+    "TopKCollector",
     "ConcurrencyModel",
     "Counter",
     "DynamicConcurrency",

@@ -1,0 +1,15 @@
+"""Entities that feed sketches from event streams (SURVEY §2.3 wrappers)."""
+
+from happysim_tpu.components.sketching.collectors import (
+    LatencyPercentiles,
+    QuantileEstimator,
+    SketchCollector,
+    TopKCollector,
+)
+
+__all__ = [
+    "LatencyPercentiles",
+    "QuantileEstimator",
+    "SketchCollector",
+    "TopKCollector",
+]

@@ -1,0 +1,185 @@
+"""Sketch-feeding entities.
+
+Parity targets: ``happysimulator/components/sketching/quantile_estimator.py:36``
+(``QuantileEstimator`` + ``LatencyPercentiles`` :22),
+``sketch_collector.py:23`` (generic ``SketchCollector``), and
+``topk_collector.py:22`` (``TopKCollector``). All three are sinks: they
+extract a value from each event, update their sketch, and emit nothing.
+Unlike the reference's three separate files, the shared extract-update-sink
+shape lives here once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.sketching.base import FrequencyEstimate, Sketch
+from happysim_tpu.sketching.tdigest import TDigest
+from happysim_tpu.sketching.topk import TopK
+
+
+class SketchCollector(Entity):
+    """Routes extracted event values (optionally weighted) into any sketch."""
+
+    def __init__(
+        self,
+        name: str,
+        sketch: Sketch,
+        value_extractor: Callable[[Event], object],
+        weight_extractor: Optional[Callable[[Event], int]] = None,
+    ):
+        super().__init__(name)
+        self._sketch = sketch
+        self._value_extractor = value_extractor
+        self._weight_extractor = weight_extractor
+        self._events_processed = 0
+
+    @property
+    def sketch(self) -> Sketch:
+        return self._sketch
+
+    @property
+    def events_processed(self) -> int:
+        return self._events_processed
+
+    def handle_event(self, event: Event) -> list[Event]:
+        value = self._value_extractor(event)
+        if value is not None:
+            if self._weight_extractor is not None:
+                self._sketch.add(value, count=self._weight_extractor(event))
+            else:
+                self._sketch.add(value)
+        self._events_processed += 1
+        return []
+
+    def clear(self) -> None:
+        self._sketch.clear()
+        self._events_processed = 0
+
+
+@dataclass(frozen=True, slots=True)
+class LatencyPercentiles:
+    """Snapshot of a latency distribution's headline percentiles."""
+
+    count: int
+    min: float | None
+    max: float | None
+    p50: float | None
+    p90: float | None
+    p95: float | None
+    p99: float | None
+    p999: float | None
+
+    def __str__(self) -> str:
+        def fmt(v: float | None) -> str:
+            return f"{v:.6f}" if v is not None else "n/a"
+
+        return (
+            f"n={self.count} min={fmt(self.min)} p50={fmt(self.p50)} "
+            f"p90={fmt(self.p90)} p95={fmt(self.p95)} p99={fmt(self.p99)} "
+            f"p999={fmt(self.p999)} max={fmt(self.max)}"
+        )
+
+
+class QuantileEstimator(SketchCollector):
+    """T-Digest-backed latency percentile tracker."""
+
+    def __init__(
+        self,
+        name: str,
+        value_extractor: Callable[[Event], float | None],
+        compression: float = 100.0,
+        seed: int | None = None,
+    ):
+        super().__init__(
+            name, TDigest(compression=compression, seed=seed), value_extractor
+        )
+
+    @property
+    def _tdigest(self) -> TDigest:
+        return self._sketch  # type: ignore[return-value]
+
+    @property
+    def compression(self) -> float:
+        return self._tdigest.compression
+
+    @property
+    def sample_count(self) -> int:
+        return self._tdigest.item_count
+
+    def quantile(self, q: float) -> float:
+        return self._tdigest.quantile(q)
+
+    def percentile(self, p: float) -> float:
+        return self._tdigest.percentile(p)
+
+    def cdf(self, value: float) -> float:
+        return self._tdigest.cdf(value)
+
+    @property
+    def min(self) -> float | None:
+        return self._tdigest.min
+
+    @property
+    def max(self) -> float | None:
+        return self._tdigest.max
+
+    def summary(self) -> LatencyPercentiles:
+        empty = self._tdigest.item_count == 0
+        pct = (lambda p: None) if empty else self._tdigest.percentile
+        return LatencyPercentiles(
+            count=self._tdigest.item_count,
+            min=self._tdigest.min,
+            max=self._tdigest.max,
+            p50=pct(50),
+            p90=pct(90),
+            p95=pct(95),
+            p99=pct(99),
+            p999=pct(99.9),
+        )
+
+
+class TopKCollector(SketchCollector):
+    """Space-Saving-backed heavy-hitter tracker over event values."""
+
+    def __init__(
+        self,
+        name: str,
+        value_extractor: Callable[[Event], object],
+        k: int = 10,
+        weight_extractor: Optional[Callable[[Event], int]] = None,
+    ):
+        super().__init__(name, TopK(k=k), value_extractor, weight_extractor)
+
+    @property
+    def _topk(self) -> TopK:
+        return self._sketch  # type: ignore[return-value]
+
+    @property
+    def k(self) -> int:
+        return self._topk.k
+
+    @property
+    def total_count(self) -> int:
+        return self._topk.item_count
+
+    @property
+    def tracked_count(self) -> int:
+        return self._topk.tracked_count
+
+    def top(self, n: int | None = None) -> list[FrequencyEstimate]:
+        return self._topk.top(n)
+
+    def estimate(self, item) -> int:
+        return self._topk.estimate(item)
+
+    @property
+    def max_error(self) -> int:
+        return self._topk.max_error
+
+    @property
+    def guaranteed_threshold(self) -> int:
+        return self._topk.guaranteed_threshold

@@ -200,12 +200,15 @@ class Simulation:
         self._execute_until(self._end)
         return False
 
-    def _execute_until(self, end: Instant, *, window: bool = False) -> int:
+    def _execute_until(
+        self, end: Instant, *, window: bool = False, inclusive: bool = True
+    ) -> int:
         """The hot loop: pop → invoke → push. Returns events processed.
 
         With ``window=True`` (parallel runtime), daemon-only auto-termination
-        is disabled and events at exactly ``end`` are left pending, so the
-        coordinator owns the time horizon.
+        is disabled and, unless ``inclusive``, events at exactly ``end`` are
+        left pending — the coordinator owns the time horizon and marks only
+        its final window inclusive so end-boundary events match a serial run.
         """
         heap = self._event_heap
         heap_list = heap._heap
@@ -213,9 +216,9 @@ class Simulation:
         push = heap.push
         clock = self._clock
         router = self._event_router
-        # Normal runs process events at exactly `end`; windowed runs leave them
-        # for the next window (the exchange happens at the boundary).
-        limit_ns = end.nanoseconds - 1 if window else end.nanoseconds
+        # Normal runs process events at exactly `end`; non-final windowed runs
+        # leave them for the next window (the exchange happens at the boundary).
+        limit_ns = end.nanoseconds - 1 if (window and not inclusive) else end.nanoseconds
         processed = 0
         while heap_list:
             if not window and not heap.has_primary_events():
@@ -285,10 +288,11 @@ class Simulation:
                     return True
         return False
 
-    def _run_window(self, until: Instant) -> int:
-        """Execute strictly below ``until`` for the windowed coordinator."""
+    def _run_window(self, until: Instant, *, inclusive: bool = False) -> int:
+        """Execute below ``until`` (inclusive only on the final window) for
+        the windowed coordinator."""
         with _active_sim_context(self._event_heap, self._clock):
-            return self._execute_until(until, window=True)
+            return self._execute_until(until, window=True, inclusive=inclusive)
 
     def _warn_time_travel(self, event: Event) -> None:
         if not self._time_travel_warned:

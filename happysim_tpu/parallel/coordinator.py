@@ -61,9 +61,12 @@ class WindowedCoordinator:
         with ThreadPoolExecutor(max_workers=len(self._runtimes)) as pool:
             while t < self._end:
                 horizon = Instant(min(t.nanoseconds + window_ns, self._end.nanoseconds))
+                # The last window is inclusive so events at exactly end_time
+                # run, matching a serial Simulation.run.
+                final = horizon.nanoseconds >= self._end.nanoseconds
                 # EXECUTE: all partitions to the horizon, in parallel.
                 futures = [
-                    pool.submit(runtime.run_window, horizon)
+                    pool.submit(runtime.run_window, horizon, inclusive=final)
                     for runtime in self._runtimes
                 ]
                 window_busy = [f.result() for f in futures]

@@ -14,6 +14,7 @@ import sys
 import time as _wall
 import warnings
 from concurrent.futures import ThreadPoolExecutor
+from functools import partial
 from typing import Optional, Union
 
 from happysim_tpu.core.event import Event
@@ -69,9 +70,9 @@ class _PartitionRuntime:
     def partition_of(self, entity) -> str:
         return self._entity_to_partition[id(entity)]
 
-    def run_window(self, until: Instant) -> float:
+    def run_window(self, until: Instant, *, inclusive: bool = False) -> float:
         start = _wall.perf_counter()
-        self._ctx.run(self.sim._run_window, until)
+        self._ctx.run(partial(self.sim._run_window, until, inclusive=inclusive))
         elapsed = _wall.perf_counter() - start
         self.busy_seconds += elapsed
         return elapsed

@@ -42,13 +42,21 @@ def validate_partitions(
                 )
             seen[id(obj)] = partition.name
 
+    pairs: set[tuple[str, str]] = set()
     for link in links:
         if link.source not in name_set or link.dest not in name_set:
             raise PartitionValidationError(
                 f"Link {link.source}->{link.dest} references unknown partition"
             )
+        if (link.source, link.dest) in pairs:
+            # The coordinator keys links by (source, dest); a duplicate would
+            # silently shadow the first declaration's latency/loss model.
+            raise PartitionValidationError(
+                f"Duplicate link {link.source}->{link.dest}"
+            )
+        pairs.add((link.source, link.dest))
 
-    linked = {(l.source, l.dest) for l in links}
+    linked = pairs
     _check_cross_references(partitions, seen, linked)
 
 

@@ -1,0 +1,41 @@
+"""Bounded-memory streaming sketches (SURVEY §2.3).
+
+All sketches are mergeable — the designated cross-replica reduction path
+for the TPU ensemble backend's metric pipeline.
+"""
+
+from happysim_tpu.sketching.base import (
+    CardinalitySketch,
+    FrequencyEstimate,
+    FrequencySketch,
+    MembershipSketch,
+    QuantileSketch,
+    SamplingSketch,
+    Sketch,
+)
+from happysim_tpu.sketching.bloom_filter import BloomFilter
+from happysim_tpu.sketching.count_min_sketch import CountMinSketch
+from happysim_tpu.sketching.hyperloglog import HyperLogLog
+from happysim_tpu.sketching.merkle_tree import KeyRange, MerkleNode, MerkleTree
+from happysim_tpu.sketching.reservoir import ReservoirSampler
+from happysim_tpu.sketching.tdigest import TDigest
+from happysim_tpu.sketching.topk import TopK
+
+__all__ = [
+    "BloomFilter",
+    "CardinalitySketch",
+    "CountMinSketch",
+    "FrequencyEstimate",
+    "FrequencySketch",
+    "HyperLogLog",
+    "KeyRange",
+    "MembershipSketch",
+    "MerkleNode",
+    "MerkleTree",
+    "QuantileSketch",
+    "ReservoirSampler",
+    "SamplingSketch",
+    "Sketch",
+    "TDigest",
+    "TopK",
+]

@@ -1,0 +1,138 @@
+"""Abstract bases for bounded-memory streaming sketches.
+
+Parity target: ``happysimulator/sketching/base.py:23-236`` (``Sketch`` with
+merge(); ``FrequencySketch`` :99, ``QuantileSketch`` :133,
+``CardinalitySketch`` :187, ``MembershipSketch`` :205, ``SamplingSketch``
+:236). Every sketch is mergeable — merge is the cross-replica reduction op
+the TPU ensemble backend uses to combine per-lane metric state.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Any, Generic, Iterator, TypeVar
+
+T = TypeVar("T")
+
+
+class Sketch(ABC):
+    """A bounded-memory summary of a data stream.
+
+    All sketches support ``add`` (with a count), ``merge`` with a compatible
+    sketch of the same type, ``clear``, and report ``memory_bytes`` and
+    ``item_count``. Randomized sketches accept a ``seed`` for
+    reproducibility.
+    """
+
+    @abstractmethod
+    def add(self, item: Any, count: int = 1) -> None:
+        """Absorb ``count`` occurrences of ``item``."""
+
+    @abstractmethod
+    def merge(self, other: "Sketch") -> None:
+        """Fold ``other`` into this sketch (same type + configuration).
+
+        Raises TypeError on type mismatch, ValueError on incompatible
+        configuration.
+        """
+
+    @property
+    @abstractmethod
+    def memory_bytes(self) -> int:
+        """Approximate memory footprint of the sketch state."""
+
+    @property
+    @abstractmethod
+    def item_count(self) -> int:
+        """Total count of items added (sum of add() counts)."""
+
+    @abstractmethod
+    def clear(self) -> None:
+        """Reset to the empty state."""
+
+    def _check_mergeable(self, other: "Sketch") -> None:
+        if type(other) is not type(self):
+            raise TypeError(
+                f"cannot merge {type(other).__name__} into {type(self).__name__}"
+            )
+
+
+@dataclass(frozen=True, slots=True)
+class FrequencyEstimate(Generic[T]):
+    """An item's estimated count with an error upper bound."""
+
+    item: T
+    count: int
+    error: int
+
+
+class FrequencySketch(Sketch, Generic[T]):
+    """Estimates per-item frequencies / heavy hitters (CMS, Space-Saving)."""
+
+    @abstractmethod
+    def estimate(self, item: T) -> int:
+        """Estimated number of times ``item`` was added."""
+
+    @abstractmethod
+    def top(self, k: int) -> list[FrequencyEstimate[T]]:
+        """Top-k most frequent items, descending by count."""
+
+
+class QuantileSketch(Sketch):
+    """Estimates quantiles of a numeric stream (T-Digest)."""
+
+    @abstractmethod
+    def quantile(self, q: float) -> float:
+        """Value at quantile ``q`` in [0, 1]."""
+
+    @abstractmethod
+    def cdf(self, value: float) -> float:
+        """Fraction of the stream <= ``value``."""
+
+    def percentile(self, p: float) -> float:
+        """Value at percentile ``p`` in [0, 100]."""
+        if not 0 <= p <= 100:
+            raise ValueError(f"percentile must be in [0, 100], got {p}")
+        return self.quantile(p / 100.0)
+
+
+class CardinalitySketch(Sketch):
+    """Estimates the number of distinct items (HyperLogLog)."""
+
+    @abstractmethod
+    def cardinality(self) -> int:
+        """Estimated distinct-item count."""
+
+
+class MembershipSketch(Sketch, Generic[T]):
+    """Probabilistic set membership: false positives possible, false
+    negatives impossible (Bloom filter)."""
+
+    @abstractmethod
+    def contains(self, item: T) -> bool:
+        """True if ``item`` might be present; False means definitely not."""
+
+    def __contains__(self, item: T) -> bool:
+        return self.contains(item)
+
+    @property
+    @abstractmethod
+    def false_positive_rate(self) -> float:
+        """Estimated FP probability at the current fill level."""
+
+
+class SamplingSketch(Sketch, Generic[T]):
+    """Maintains a bounded uniform sample of the stream (reservoir)."""
+
+    @abstractmethod
+    def sample(self) -> list[T]:
+        """The current sample (<= capacity items)."""
+
+    @abstractmethod
+    def __iter__(self) -> Iterator[T]: ...
+
+    @property
+    @abstractmethod
+    def capacity(self) -> int:
+        """Maximum sample size."""

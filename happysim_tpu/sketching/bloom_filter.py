@@ -1,0 +1,116 @@
+"""Bloom filter membership sketch.
+
+Parity target: ``happysimulator/sketching/bloom_filter.py:59`` (size_bits,
+num_hashes, contains, false_positive_rate, fill_ratio, merge,
+``from_expected_items`` :118). Bit array stored as a Python int-backed
+bytearray; k probe positions come from double hashing (one blake2b per
+item), and merge is bitwise OR.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+
+from happysim_tpu.sketching.base import MembershipSketch
+from happysim_tpu.sketching.hashing import hash_pair
+
+
+class BloomFilter(MembershipSketch):
+    """Set-membership filter: no false negatives, tunable false positives.
+
+    Args:
+        size_bits: number of bits in the filter.
+        num_hashes: probes per item.
+        seed: hash stream seed.
+    """
+
+    def __init__(self, size_bits: int = 8192, num_hashes: int = 5, seed: int = 0):
+        if size_bits <= 0 or num_hashes <= 0:
+            raise ValueError("size_bits and num_hashes must be positive")
+        self._bits = bytearray((size_bits + 7) // 8)
+        self._size_bits = size_bits
+        self._k = num_hashes
+        self._seed = seed
+        self._items = 0
+        self._set_bits = 0
+
+    @classmethod
+    def from_expected_items(
+        cls, expected_items: int, false_positive_rate: float = 0.01, seed: int = 0
+    ) -> "BloomFilter":
+        """Size the filter for a target FP rate at ``expected_items`` fill."""
+        if expected_items <= 0:
+            raise ValueError("expected_items must be positive")
+        if not 0 < false_positive_rate < 1:
+            raise ValueError("false_positive_rate must be in (0, 1)")
+        m = math.ceil(-expected_items * math.log(false_positive_rate) / (math.log(2) ** 2))
+        k = max(1, round(m / expected_items * math.log(2)))
+        return cls(size_bits=m, num_hashes=k, seed=seed)
+
+    @property
+    def size_bits(self) -> int:
+        return self._size_bits
+
+    @property
+    def num_hashes(self) -> int:
+        return self._k
+
+    def _positions(self, item) -> list[int]:
+        h1, h2 = hash_pair(item, self._seed)
+        return [(h1 + i * h2) % self._size_bits for i in range(self._k)]
+
+    def add(self, item, count: int = 1) -> None:
+        if count <= 0:
+            raise ValueError(f"count must be positive, got {count}")
+        self._items += count
+        for pos in self._positions(item):
+            byte, bit = divmod(pos, 8)
+            mask = 1 << bit
+            if not self._bits[byte] & mask:
+                self._bits[byte] |= mask
+                self._set_bits += 1
+
+    def contains(self, item) -> bool:
+        for pos in self._positions(item):
+            byte, bit = divmod(pos, 8)
+            if not self._bits[byte] & (1 << bit):
+                return False
+        return True
+
+    @property
+    def false_positive_rate(self) -> float:
+        return self.fill_ratio**self._k
+
+    @property
+    def fill_ratio(self) -> float:
+        return self._set_bits / self._size_bits
+
+    def merge(self, other: "BloomFilter") -> None:
+        self._check_mergeable(other)
+        if (other._size_bits, other._k, other._seed) != (
+            self._size_bits,
+            self._k,
+            self._seed,
+        ):
+            raise ValueError("cannot merge BloomFilters with different shape/seed")
+        set_bits = 0
+        for i, b in enumerate(other._bits):
+            merged = self._bits[i] | b
+            self._bits[i] = merged
+            set_bits += merged.bit_count()
+        self._set_bits = set_bits
+        self._items += other._items
+
+    @property
+    def memory_bytes(self) -> int:
+        return sys.getsizeof(self._bits)
+
+    @property
+    def item_count(self) -> int:
+        return self._items
+
+    def clear(self) -> None:
+        self._bits = bytearray((self._size_bits + 7) // 8)
+        self._items = 0
+        self._set_bits = 0

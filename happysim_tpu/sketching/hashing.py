@@ -1,0 +1,44 @@
+"""Seeded hashing shared by the randomized sketches.
+
+The reference derives per-row hashes ad hoc inside each sketch; here one
+helper produces independent 64-bit hash streams from (seed, index) so every
+sketch is reproducible by construction and two sketches built with the same
+seed are merge-compatible.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+_MASK64 = (1 << 64) - 1
+
+
+def item_bytes(item: Any) -> bytes:
+    """Stable byte encoding of an arbitrary hashable item."""
+    if isinstance(item, bytes):
+        return item
+    if isinstance(item, str):
+        return item.encode("utf-8")
+    return repr(item).encode("utf-8")
+
+
+def hash64(item: Any, seed: int = 0) -> int:
+    """A 64-bit hash of ``item`` under stream ``seed``."""
+    h = hashlib.blake2b(
+        item_bytes(item), digest_size=8, key=seed.to_bytes(8, "little", signed=False)
+    )
+    return int.from_bytes(h.digest(), "little") & _MASK64
+
+
+def hash_pair(item: Any, seed: int = 0) -> tuple[int, int]:
+    """Two independent 64-bit hashes — basis for Kirsch-Mitzenmacher
+    double hashing (h1 + i*h2 simulates i independent hash functions)."""
+    h = hashlib.blake2b(
+        item_bytes(item), digest_size=16, key=seed.to_bytes(8, "little", signed=False)
+    )
+    d = h.digest()
+    return (
+        int.from_bytes(d[:8], "little") & _MASK64,
+        int.from_bytes(d[8:], "little") | 1,  # odd, so it is coprime with 2^k
+    )

@@ -1,0 +1,172 @@
+"""Merkle tree over a key-value map, for anti-entropy diffing.
+
+Parity target: ``happysimulator/sketching/merkle_tree.py:112`` (``MerkleTree``
+with build/root_hash/update/remove/get/keys/items/diff; ``KeyRange`` :35,
+``MerkleNode`` :55). Two replicas compare root hashes and, on mismatch,
+``diff()`` walks both trees to return the divergent key ranges — the
+anti-entropy primitive used by the replication components (e.g. gossip
+repair in ``CRDTStore``/``ReplicatedStore``).
+
+Design: keys kept sorted; the hash tree is rebuilt lazily on query as a
+balanced binary tree over the sorted keys (rebuild is O(n), queries amortize
+it across updates — simulation workloads read root_hash far less often than
+they write).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass(frozen=True, slots=True)
+class KeyRange:
+    """A half-open lexicographic key interval [start, end]."""
+
+    start: str
+    end: str
+
+    def contains(self, key: str) -> bool:
+        return self.start <= key <= self.end
+
+
+@dataclass(slots=True)
+class MerkleNode:
+    """A node covering ``key_range`` with a hash over its subtree."""
+
+    hash: str
+    key_range: KeyRange
+    left: Optional["MerkleNode"] = None
+    right: Optional["MerkleNode"] = None
+    keys: list[str] = field(default_factory=list)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.left is None and self.right is None
+
+
+def _hash_pair(a: str, b: str) -> str:
+    return hashlib.blake2b(f"{a}|{b}".encode(), digest_size=16).hexdigest()
+
+
+def _hash_kv(key: str, value: Any) -> str:
+    return hashlib.blake2b(
+        f"{key}={value!r}".encode(), digest_size=16
+    ).hexdigest()
+
+
+class MerkleTree:
+    """Hash tree over a sorted key-value map.
+
+    Args:
+        leaf_size: max keys per leaf node (granularity of diff() ranges).
+    """
+
+    def __init__(self, leaf_size: int = 4):
+        if leaf_size <= 0:
+            raise ValueError(f"leaf_size must be positive, got {leaf_size}")
+        self._leaf_size = leaf_size
+        self._data: dict[str, Any] = {}
+        self._root: Optional[MerkleNode] = None
+        self._dirty = True
+
+    @classmethod
+    def build(cls, data: dict[str, Any], leaf_size: int = 4) -> "MerkleTree":
+        tree = cls(leaf_size=leaf_size)
+        tree._data = dict(data)
+        return tree
+
+    def _rebuild(self) -> None:
+        if not self._dirty:
+            return
+        keys = sorted(self._data)
+        self._root = self._build_node(keys) if keys else None
+        self._dirty = False
+
+    def _build_node(self, keys: list[str]) -> MerkleNode:
+        rng = KeyRange(start=keys[0], end=keys[-1])
+        if len(keys) <= self._leaf_size:
+            h = "leaf"
+            for k in keys:
+                h = _hash_pair(h, _hash_kv(k, self._data[k]))
+            return MerkleNode(hash=h, key_range=rng, keys=list(keys))
+        mid = len(keys) // 2
+        left = self._build_node(keys[:mid])
+        right = self._build_node(keys[mid:])
+        return MerkleNode(
+            hash=_hash_pair(left.hash, right.hash),
+            key_range=rng,
+            left=left,
+            right=right,
+        )
+
+    @property
+    def root_hash(self) -> str:
+        self._rebuild()
+        return self._root.hash if self._root else ""
+
+    @property
+    def root(self) -> Optional[MerkleNode]:
+        self._rebuild()
+        return self._root
+
+    @property
+    def size(self) -> int:
+        return len(self._data)
+
+    def update(self, key: str, value: Any) -> None:
+        self._data[key] = value
+        self._dirty = True
+
+    def remove(self, key: str) -> bool:
+        if key in self._data:
+            del self._data[key]
+            self._dirty = True
+            return True
+        return False
+
+    def get(self, key: str) -> Any | None:
+        return self._data.get(key)
+
+    def keys(self) -> list[str]:
+        return sorted(self._data)
+
+    def items(self) -> list[tuple[str, Any]]:
+        return sorted(self._data.items())
+
+    def diff(self, other: "MerkleTree") -> list[KeyRange]:
+        """Key ranges where the two trees disagree (either side differs or
+        is missing keys). Equal subtree hashes are pruned without descent."""
+        self._rebuild()
+        other._rebuild()
+        ranges: list[KeyRange] = []
+        self._diff_nodes(self._root, other._root, ranges)
+        return ranges
+
+    def _diff_nodes(
+        self,
+        a: Optional[MerkleNode],
+        b: Optional[MerkleNode],
+        out: list[KeyRange],
+    ) -> None:
+        if a is None and b is None:
+            return
+        if a is None:
+            out.append(b.key_range)
+            return
+        if b is None:
+            out.append(a.key_range)
+            return
+        if a.hash == b.hash:
+            return
+        if a.is_leaf or b.is_leaf:
+            out.append(
+                KeyRange(
+                    start=min(a.key_range.start, b.key_range.start),
+                    end=max(a.key_range.end, b.key_range.end),
+                )
+            )
+            return
+        self._diff_nodes(a.left, b.left, out)
+        self._diff_nodes(a.right, b.right, out)

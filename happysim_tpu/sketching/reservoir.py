@@ -1,0 +1,100 @@
+"""Reservoir sampling sketch (Algorithm R with weighted merge).
+
+Parity target: ``happysimulator/sketching/reservoir.py:37`` (capacity, add,
+sample, is_full, merge, sample_size). Seeded ``random.Random`` so runs are
+reproducible; merge draws a hypergeometric-ish weighted subsample so the
+merged reservoir remains uniform over both streams.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+from typing import Iterator
+
+from happysim_tpu.sketching.base import SamplingSketch
+
+
+class ReservoirSampler(SamplingSketch):
+    """Uniform fixed-size sample of an unbounded stream.
+
+    Args:
+        capacity: maximum sample size.
+        seed: RNG seed for reproducibility.
+    """
+
+    def __init__(self, capacity: int = 100, seed: int | None = None):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self._capacity = capacity
+        self._rng = random.Random(seed)
+        self._sample: list = []
+        self._items = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def add(self, item, count: int = 1) -> None:
+        if count <= 0:
+            raise ValueError(f"count must be positive, got {count}")
+        for _ in range(count):
+            self._items += 1
+            if len(self._sample) < self._capacity:
+                self._sample.append(item)
+            else:
+                j = self._rng.randrange(self._items)
+                if j < self._capacity:
+                    self._sample[j] = item
+
+    def sample(self) -> list:
+        return list(self._sample)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._sample)
+
+    @property
+    def is_full(self) -> bool:
+        return len(self._sample) >= self._capacity
+
+    def merge(self, other: "ReservoirSampler") -> None:
+        self._check_mergeable(other)
+        if other._capacity != self._capacity:
+            raise ValueError("cannot merge ReservoirSamplers with different capacity")
+        total = self._items + other._items
+        if total == 0:
+            return
+        # Draw each merged slot from self or other proportionally to their
+        # stream sizes — keeps the merged sample uniform over the union.
+        pool_self = list(self._sample)
+        pool_other = list(other._sample)
+        self._rng.shuffle(pool_self)
+        self._rng.shuffle(pool_other)
+        merged: list = []
+        for _ in range(min(self._capacity, len(pool_self) + len(pool_other))):
+            take_self = (
+                pool_self
+                and (
+                    not pool_other
+                    or self._rng.random() < self._items / total
+                )
+            )
+            merged.append(pool_self.pop() if take_self else pool_other.pop())
+        self._sample = merged
+        self._items = total
+
+    @property
+    def memory_bytes(self) -> int:
+        return sys.getsizeof(self._sample)
+
+    @property
+    def item_count(self) -> int:
+        return self._items
+
+    @property
+    def sample_size(self) -> int:
+        return len(self._sample)
+
+    def clear(self) -> None:
+        self._sample.clear()
+        self._items = 0

@@ -1,0 +1,126 @@
+"""Space-Saving top-k heavy hitter sketch.
+
+Parity target: ``happysimulator/sketching/topk.py:45`` (estimate,
+estimate_with_error, top, max_error, guaranteed_threshold, merge,
+tracked_count). Metwally et al.'s Space-Saving: at most k counters; an
+unseen item evicts the minimum counter and inherits its count as error.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from happysim_tpu.sketching.base import FrequencyEstimate, FrequencySketch
+
+
+class TopK(FrequencySketch):
+    """Heavy-hitter tracker with at most ``k`` counters.
+
+    Args:
+        k: number of counters to maintain.
+        seed: unused (deterministic); accepted for uniform sketch API.
+    """
+
+    def __init__(self, k: int = 10, seed: int | None = None):
+        if k <= 0:
+            raise ValueError(f"k must be positive, got {k}")
+        self._k = k
+        self._counts: dict = {}
+        self._errors: dict = {}
+        self._items = 0
+
+    @property
+    def k(self) -> int:
+        return self._k
+
+    def add(self, item, count: int = 1) -> None:
+        if count <= 0:
+            raise ValueError(f"count must be positive, got {count}")
+        self._items += count
+        if item in self._counts:
+            self._counts[item] += count
+            return
+        if len(self._counts) < self._k:
+            self._counts[item] = count
+            self._errors[item] = 0
+            return
+        # Evict the minimum counter; new item inherits its count as error.
+        victim = min(self._counts, key=self._counts.__getitem__)
+        floor = self._counts.pop(victim)
+        self._errors.pop(victim)
+        self._counts[item] = floor + count
+        self._errors[item] = floor
+
+    def estimate(self, item) -> int:
+        return self._counts.get(item, 0)
+
+    def estimate_with_error(self, item) -> FrequencyEstimate:
+        return FrequencyEstimate(
+            item=item,
+            count=self._counts.get(item, 0),
+            error=self._errors.get(item, self.max_error),
+        )
+
+    def top(self, n: int | None = None) -> list[FrequencyEstimate]:
+        ranked = sorted(self._counts.items(), key=lambda kv: -kv[1])
+        if n is not None:
+            ranked = ranked[:n]
+        return [
+            FrequencyEstimate(item=item, count=c, error=self._errors[item])
+            for item, c in ranked
+        ]
+
+    @property
+    def max_error(self) -> int:
+        """Largest possible over-count for any tracked item."""
+        if len(self._counts) < self._k:
+            return 0
+        return min(self._counts.values())
+
+    @property
+    def guaranteed_threshold(self) -> int:
+        """Counts above this are guaranteed genuine heavy hitters
+        (count - error exceeds every untracked item's possible count)."""
+        return self.max_error
+
+    def merge(self, other: "TopK") -> None:
+        self._check_mergeable(other)
+        # Combine counter sets, summing counts and errors, then keep the
+        # top k — the standard Space-Saving merge.
+        for item, c in other._counts.items():
+            if item in self._counts:
+                self._counts[item] += c
+                self._errors[item] += other._errors[item]
+            else:
+                self._counts[item] = c
+                self._errors[item] = other._errors[item]
+        if len(self._counts) > self._k:
+            ranked = sorted(self._counts.items(), key=lambda kv: -kv[1])
+            # Items truncated away may have true counts up to the k+1-th
+            # counter's value; fold that floor into survivors' error bounds
+            # so guaranteed_threshold stays sound after the merge.
+            floor = ranked[self._k][1]
+            kept = ranked[: self._k]
+            self._counts = dict(kept)
+            self._errors = {
+                item: min(self._errors[item] + floor, self._counts[item])
+                for item, _ in kept
+            }
+        self._items += other._items
+
+    @property
+    def memory_bytes(self) -> int:
+        return sys.getsizeof(self._counts) + sys.getsizeof(self._errors)
+
+    @property
+    def item_count(self) -> int:
+        return self._items
+
+    @property
+    def tracked_count(self) -> int:
+        return len(self._counts)
+
+    def clear(self) -> None:
+        self._counts.clear()
+        self._errors.clear()
+        self._items = 0

@@ -25,6 +25,7 @@ load_balancer strategies), Sink latency accounting (components/common.py).
 
 from __future__ import annotations
 
+import logging
 import time as _wall
 from dataclasses import dataclass
 from functools import partial
@@ -35,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh
+
+logger = logging.getLogger("happysim_tpu.tpu.engine")
 
 from happysim_tpu.tpu.mesh import pad_to_multiple, replica_mesh, replica_sharding
 from happysim_tpu.tpu.model import (
@@ -97,6 +100,8 @@ class EnsembleResult:
     server_mean_queue_len: list[float]
     # raw per-replica pytree (device arrays) for power users
     raw: Any = None
+    # replicas whose event budget ran out before the horizon (bias warning)
+    truncated_replicas: int = 0
 
     def summary(self):
         from happysim_tpu.core.temporal import Instant
@@ -453,21 +458,27 @@ def _max_server_chain(model: EnsembleModel) -> int:
 
 
 def _default_max_events(model: EnsembleModel, sweeps) -> int:
-    total_rate = sum(s.rate for s in model.sources)
-    if sweeps and "source_rate" in sweeps:
-        total_rate = float(np.max(np.sum(np.atleast_2d(sweeps["source_rate"]), axis=-1)))
     horizon = model.horizon_s
-    effective = min(
-        horizon,
-        max(
-            (s.stop_after_s for s in model.sources if s.stop_after_s is not None),
-            default=horizon,
-        ),
+    rates = np.asarray([s.rate for s in model.sources], np.float64)
+    if sweeps and "source_rate" in sweeps:
+        arr = np.asarray(sweeps["source_rate"], np.float64)
+        if arr.ndim == 1:  # per-replica scalar broadcast across sources
+            arr = np.tile(arr[:, None], (1, len(model.sources)))
+        rates = np.max(arr, axis=0)
+    # Budget each source for its own emission window — a short-lived burst
+    # source must not starve an open-ended one (and vice versa).
+    windows = np.asarray(
+        [
+            min(horizon, s.stop_after_s) if s.stop_after_s is not None else horizon
+            for s in model.sources
+        ],
+        np.float64,
     )
+    total_jobs = float(np.sum(rates * windows))
     # Each job costs one source-fire plus one completion per server on its
     # path; 25% headroom covers Poisson variance and queue drain.
     events_per_job = 1 + _max_server_chain(model)
-    return int(1.25 * events_per_job * total_rate * effective) + 64
+    return int(1.25 * events_per_job * total_jobs) + 64
 
 
 def run_ensemble(
@@ -541,8 +552,17 @@ def run_ensemble(
             return state
 
         final = jax.vmap(one_replica)(keys, params)
+        # A replica is truncated if the event budget ran out while it still
+        # had work scheduled before the horizon (the engine is
+        # work-conserving, so pending work always surfaces in src_next or an
+        # occupied server slot).
+        pending = jnp.minimum(
+            jnp.min(final["src_next"], axis=-1),
+            jnp.min(final["srv_slot_done"], axis=(-2, -1)),
+        )
         # Cross-replica reduction (psum over the mesh when sharded).
         reduced = {
+            "truncated": jnp.sum((pending < horizon).astype(jnp.int32)),
             "events": jnp.sum(final["events"]),
             "sink_count": jnp.sum(final["sink_count"], axis=0),
             "sink_sum": jnp.sum(final["sink_sum"], axis=0),
@@ -564,6 +584,18 @@ def run_ensemble(
     reduced = compiled_fn(keys, params)
     events_total = int(reduced["events"])
     wall = _wall.perf_counter() - start
+
+    truncated = int(reduced["truncated"])
+    if truncated:
+        logger.warning(
+            "run_ensemble: %d/%d replicas exhausted the event budget "
+            "(max_events=%d) before the %.3fs horizon — statistics are "
+            "biased toward early sim-time; pass a larger max_events.",
+            truncated,
+            n_replicas,
+            max_events,
+            horizon,
+        )
 
     host = {k: np.asarray(v) for k, v in reduced.items()}
     nV_real = len(model.servers)
@@ -595,4 +627,5 @@ def run_ensemble(
             float(d) / denom for d in host["srv_depth_int"][:nV_real]
         ],
         raw=None,
+        truncated_replicas=truncated,
     )

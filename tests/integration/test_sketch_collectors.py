@@ -1,0 +1,69 @@
+"""Sketch collector entities inside a live simulation (SURVEY §2.3)."""
+
+import itertools
+
+from happysim_tpu import (
+    CountMinSketch,
+    ExponentialLatency,
+    QuantileEstimator,
+    Server,
+    Simulation,
+    SketchCollector,
+    Source,
+    TopKCollector,
+)
+from happysim_tpu.core.callback_entity import CallbackEntity
+from happysim_tpu.core.event import Event
+
+
+def test_quantile_estimator_tracks_service_latency():
+    est = QuantileEstimator(
+        name="lat",
+        value_extractor=lambda e: (
+            e.time.to_seconds() - e.context["created_at"].to_seconds()
+        ),
+    )
+    server = Server(
+        name="srv",
+        concurrency=1,
+        service_time=ExponentialLatency(mean=0.005, seed=42),
+        downstream=est,
+    )
+    source = Source.poisson(rate=50.0, target=server, seed=7)
+    sim = Simulation(sources=[source], entities=[server, est], duration=30.0)
+    sim.run()
+    assert est.events_processed > 1000
+    s = est.summary()
+    assert s.p50 is not None and s.p99 is not None
+    assert 0 < s.p50 < s.p99
+    # M/M/1 at rho=0.25: mean sojourn = 1/(mu-lambda) ~ 6.7ms; tail stays modest
+    assert s.p99 < 0.25
+
+
+def test_topk_and_cms_collectors_agree():
+    ids = itertools.cycle(["hot"] * 8 + ["warm"] * 3 + ["cold"])
+    tk = TopKCollector(
+        name="tk", value_extractor=lambda e: e.context["customer"], k=3
+    )
+    cms = SketchCollector(
+        name="cms",
+        sketch=CountMinSketch(width=512, depth=4, seed=1),
+        value_extractor=lambda e: e.context["customer"],
+    )
+
+    def fan(event):
+        event.context["customer"] = next(ids)
+        return [
+            Event(time=event.time, event_type="obs", target=tk, context=event.context),
+            Event(time=event.time, event_type="obs", target=cms, context=event.context),
+        ]
+
+    router = CallbackEntity("router", fan)
+    source = Source.constant(rate=100.0, target=router)
+    sim = Simulation(sources=[source], entities=[router, tk, cms], duration=12.0)
+    sim.run()
+
+    top = tk.top(1)
+    assert top[0].item == "hot"
+    assert cms.sketch.estimate("hot") >= tk.estimate("hot") * 0.9
+    assert tk.events_processed == cms.events_processed > 0

@@ -1,0 +1,286 @@
+"""Sketching package tests (SURVEY §2.3 parity: mergeable bounded-memory
+summaries with seeded reproducibility)."""
+
+import math
+import random
+
+import pytest
+
+from happysim_tpu.sketching import (
+    BloomFilter,
+    CountMinSketch,
+    HyperLogLog,
+    KeyRange,
+    MerkleTree,
+    ReservoirSampler,
+    TDigest,
+    TopK,
+)
+
+
+class TestTDigest:
+    def test_quantiles_of_uniform(self):
+        rng = random.Random(7)
+        td = TDigest(compression=100)
+        for _ in range(20_000):
+            td.add(rng.random())
+        assert td.quantile(0.5) == pytest.approx(0.5, abs=0.02)
+        assert td.quantile(0.99) == pytest.approx(0.99, abs=0.01)
+        assert td.percentile(95) == pytest.approx(0.95, abs=0.01)
+        assert td.min == pytest.approx(0.0, abs=0.01)
+        assert td.max == pytest.approx(1.0, abs=0.01)
+
+    def test_cdf_roundtrip(self):
+        rng = random.Random(3)
+        td = TDigest()
+        for _ in range(10_000):
+            td.add(rng.expovariate(1.0))
+        q = td.quantile(0.9)
+        assert td.cdf(q) == pytest.approx(0.9, abs=0.03)
+
+    def test_merge_matches_union(self):
+        rng = random.Random(11)
+        a, b, both = TDigest(), TDigest(), TDigest()
+        for _ in range(5000):
+            x, y = rng.gauss(0, 1), rng.gauss(1, 1)
+            a.add(x)
+            b.add(y)
+            both.add(x)
+            both.add(y)
+        a.merge(b)
+        assert a.item_count == both.item_count
+        for q in (0.1, 0.5, 0.9):
+            assert a.quantile(q) == pytest.approx(both.quantile(q), abs=0.15)
+
+    def test_bounded_memory(self):
+        td = TDigest(compression=50)
+        for i in range(100_000):
+            td.add(float(i))
+        assert td.centroid_count < 200
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            TDigest().quantile(0.5)
+
+    def test_weighted_add(self):
+        td = TDigest()
+        td.add(1.0, count=99)
+        td.add(100.0, count=1)
+        assert td.item_count == 100
+        assert td.quantile(0.5) == pytest.approx(1.0, abs=0.5)
+
+
+class TestHyperLogLog:
+    def test_cardinality_within_error(self):
+        hll = HyperLogLog(precision=12, seed=1)
+        n = 50_000
+        for i in range(n):
+            hll.add(f"item-{i}")
+        assert hll.cardinality() == pytest.approx(n, rel=5 * hll.standard_error)
+
+    def test_duplicates_ignored(self):
+        hll = HyperLogLog(precision=10)
+        for _ in range(1000):
+            hll.add("same")
+        assert hll.cardinality() == 1
+        assert hll.item_count == 1000
+
+    def test_merge_is_union(self):
+        a, b = HyperLogLog(precision=12, seed=2), HyperLogLog(precision=12, seed=2)
+        for i in range(10_000):
+            a.add(f"a-{i}")
+            b.add(f"b-{i}")
+        a.merge(b)
+        assert a.cardinality() == pytest.approx(20_000, rel=0.05)
+
+    def test_merge_incompatible(self):
+        with pytest.raises(ValueError):
+            HyperLogLog(precision=10).merge(HyperLogLog(precision=12))
+
+    def test_small_range_exact(self):
+        hll = HyperLogLog(precision=14)
+        for i in range(100):
+            hll.add(i)
+        assert hll.cardinality() == pytest.approx(100, abs=3)
+
+
+class TestCountMinSketch:
+    def test_never_undercounts(self):
+        cms = CountMinSketch(width=256, depth=4, seed=5)
+        rng = random.Random(5)
+        truth: dict[int, int] = {}
+        for _ in range(10_000):
+            item = rng.randrange(500)
+            truth[item] = truth.get(item, 0) + 1
+            cms.add(item)
+        for item, count in truth.items():
+            assert cms.estimate(item) >= count
+
+    def test_heavy_hitter_top(self):
+        cms = CountMinSketch(width=1024, depth=5)
+        for i in range(100):
+            cms.add("rare-%d" % i)
+        cms.add("hot", count=500)
+        top = cms.top(1)
+        assert top[0].item == "hot"
+        assert top[0].count >= 500
+
+    def test_from_error_rate(self):
+        cms = CountMinSketch.from_error_rate(epsilon=0.01, delta=0.05)
+        assert cms.epsilon <= 0.01
+        assert cms.delta <= 0.05
+
+    def test_merge_adds_counts(self):
+        a = CountMinSketch(width=128, depth=3, seed=9)
+        b = CountMinSketch(width=128, depth=3, seed=9)
+        a.add("x", 5)
+        b.add("x", 7)
+        a.merge(b)
+        assert a.estimate("x") >= 12
+        assert a.item_count == 12
+
+    def test_inner_product(self):
+        a = CountMinSketch(width=2048, depth=5, seed=1)
+        b = CountMinSketch(width=2048, depth=5, seed=1)
+        a.add("k", 10)
+        b.add("k", 3)
+        assert a.inner_product(b) >= 30
+
+
+class TestBloomFilter:
+    def test_no_false_negatives(self):
+        bf = BloomFilter.from_expected_items(1000, 0.01, seed=4)
+        for i in range(1000):
+            bf.add(f"key-{i}")
+        for i in range(1000):
+            assert bf.contains(f"key-{i}")
+            assert f"key-{i}" in bf
+
+    def test_false_positive_rate_near_target(self):
+        bf = BloomFilter.from_expected_items(2000, 0.01, seed=8)
+        for i in range(2000):
+            bf.add(f"in-{i}")
+        fps = sum(bf.contains(f"out-{i}") for i in range(10_000))
+        assert fps / 10_000 < 0.03
+        assert bf.false_positive_rate < 0.03
+
+    def test_merge_is_union(self):
+        a = BloomFilter(size_bits=4096, num_hashes=4, seed=2)
+        b = BloomFilter(size_bits=4096, num_hashes=4, seed=2)
+        a.add("only-a")
+        b.add("only-b")
+        a.merge(b)
+        assert a.contains("only-a") and a.contains("only-b")
+
+    def test_clear(self):
+        bf = BloomFilter(size_bits=512, num_hashes=3)
+        bf.add("x")
+        bf.clear()
+        assert not bf.contains("x")
+        assert bf.fill_ratio == 0.0
+
+
+class TestTopK:
+    def test_exact_when_under_k(self):
+        tk = TopK(k=10)
+        tk.add("a", 5)
+        tk.add("b", 3)
+        top = tk.top()
+        assert [(e.item, e.count, e.error) for e in top] == [("a", 5, 0), ("b", 3, 0)]
+
+    def test_space_saving_eviction(self):
+        tk = TopK(k=2)
+        tk.add("a", 10)
+        tk.add("b", 5)
+        tk.add("c")  # evicts b, inherits count 5
+        assert tk.tracked_count == 2
+        est = tk.estimate_with_error("c")
+        assert est.count == 6 and est.error == 5
+
+    def test_finds_zipf_head(self):
+        rng = random.Random(13)
+        tk = TopK(k=20)
+        for _ in range(50_000):
+            # Zipf-ish: item i with probability ~ 1/(i+1)
+            item = min(int(1 / max(rng.random(), 1e-9)) - 1, 999)
+            tk.add(item)
+        head = [e.item for e in tk.top(3)]
+        assert 0 in head and 1 in head
+
+    def test_merge(self):
+        a, b = TopK(k=5), TopK(k=5)
+        a.add("x", 10)
+        b.add("x", 7)
+        b.add("y", 3)
+        a.merge(b)
+        assert a.estimate("x") == 17
+        assert a.estimate("y") == 3
+        assert a.item_count == 20
+
+
+class TestReservoirSampler:
+    def test_uniformity(self):
+        counts = [0] * 10
+        for trial in range(300):
+            rs = ReservoirSampler(capacity=3, seed=trial)
+            for i in range(10):
+                rs.add(i)
+            for x in rs:
+                counts[x] += 1
+        # each of 10 items should appear ~ 300*3/10 = 90 times
+        assert all(50 < c < 140 for c in counts)
+
+    def test_under_capacity_keeps_all(self):
+        rs = ReservoirSampler(capacity=100, seed=1)
+        for i in range(5):
+            rs.add(i)
+        assert sorted(rs.sample()) == [0, 1, 2, 3, 4]
+        assert not rs.is_full
+
+    def test_merge_total_and_size(self):
+        a = ReservoirSampler(capacity=10, seed=1)
+        b = ReservoirSampler(capacity=10, seed=2)
+        for i in range(100):
+            a.add(("a", i))
+            b.add(("b", i))
+        a.merge(b)
+        assert a.item_count == 200
+        assert a.sample_size == 10
+
+
+class TestMerkleTree:
+    def test_identical_trees_no_diff(self):
+        data = {f"k{i}": i for i in range(20)}
+        a, b = MerkleTree.build(data), MerkleTree.build(dict(data))
+        assert a.root_hash == b.root_hash
+        assert a.diff(b) == []
+
+    def test_diff_locates_divergence(self):
+        data = {f"k{i:02d}": i for i in range(32)}
+        a, b = MerkleTree.build(data), MerkleTree.build(dict(data))
+        b.update("k07", 999)
+        assert a.root_hash != b.root_hash
+        ranges = a.diff(b)
+        assert any(r.contains("k07") for r in ranges)
+        # diff should be localized, not the whole keyspace
+        covered = sum(1 for k in data if any(r.contains(k) for r in ranges))
+        assert covered < len(data)
+
+    def test_update_remove_get(self):
+        t = MerkleTree()
+        t.update("a", 1)
+        t.update("b", 2)
+        assert t.get("a") == 1
+        assert t.remove("a") and not t.remove("a")
+        assert t.size == 1
+        assert t.keys() == ["b"]
+
+    def test_missing_key_side(self):
+        a = MerkleTree.build({"x": 1})
+        b = MerkleTree.build({})
+        ranges = a.diff(b)
+        assert ranges and ranges[0].contains("x")
+
+    def test_key_range(self):
+        r = KeyRange(start="b", end="d")
+        assert r.contains("c") and not r.contains("e")
