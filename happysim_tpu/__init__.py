@@ -94,6 +94,32 @@ from happysim_tpu.instrumentation import (
     SimulationSummary,
     ThroughputTracker,
 )
+from happysim_tpu.components.network import (
+    Network,
+    NetworkLink,
+    cross_region_network,
+    datacenter_network,
+    internet_network,
+    local_network,
+    lossy_network,
+    mobile_3g_network,
+    mobile_4g_network,
+    satellite_network,
+    slow_network,
+)
+from happysim_tpu.faults import (
+    CrashNode,
+    FaultContext,
+    FaultHandle,
+    FaultSchedule,
+    FaultStats,
+    InjectLatency,
+    InjectPacketLoss,
+    NetworkPartition,
+    PauseNode,
+    RandomPartition,
+    ReduceCapacity,
+)
 from happysim_tpu.sketching import (
     BloomFilter,
     CountMinSketch,

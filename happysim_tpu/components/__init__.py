@@ -31,8 +31,38 @@ from happysim_tpu.components.sketching import (
     SketchCollector,
     TopKCollector,
 )
+from happysim_tpu.components.network import (
+    LinkStats,
+    Network,
+    NetworkLink,
+    NetworkLinkStats,
+    Partition,
+    cross_region_network,
+    datacenter_network,
+    internet_network,
+    local_network,
+    lossy_network,
+    mobile_3g_network,
+    mobile_4g_network,
+    satellite_network,
+    slow_network,
+)
 
 __all__ = [
+    "LinkStats",
+    "Network",
+    "NetworkLink",
+    "NetworkLinkStats",
+    "Partition",
+    "cross_region_network",
+    "datacenter_network",
+    "internet_network",
+    "local_network",
+    "lossy_network",
+    "mobile_3g_network",
+    "mobile_4g_network",
+    "satellite_network",
+    "slow_network",
     "LatencyPercentiles",
     "QuantileEstimator",
     "SketchCollector",

@@ -1,0 +1,36 @@
+"""Modeled network: links, routing, partitions, condition presets."""
+
+from happysim_tpu.components.network.conditions import (
+    cross_region_network,
+    datacenter_network,
+    internet_network,
+    local_network,
+    lossy_network,
+    mobile_3g_network,
+    mobile_4g_network,
+    satellite_network,
+    slow_network,
+)
+from happysim_tpu.components.network.link import NetworkLink, NetworkLinkStats
+from happysim_tpu.components.network.network import (
+    LinkStats,
+    Network,
+    Partition,
+)
+
+__all__ = [
+    "LinkStats",
+    "Network",
+    "NetworkLink",
+    "NetworkLinkStats",
+    "Partition",
+    "cross_region_network",
+    "datacenter_network",
+    "internet_network",
+    "local_network",
+    "lossy_network",
+    "mobile_3g_network",
+    "mobile_4g_network",
+    "satellite_network",
+    "slow_network",
+]

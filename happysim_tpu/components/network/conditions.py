@@ -1,0 +1,131 @@
+"""Factory presets for common network conditions.
+
+Parity target: ``happysimulator/components/network/conditions.py:13-233``
+(9 presets ``local_network`` … ``mobile_4g_network``). Same headline
+characteristics (latency/bandwidth/loss/jitter per environment); all
+factories take a ``seed`` so loss decisions are reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from happysim_tpu.components.network.link import NetworkLink
+from happysim_tpu.distributions.latency_distribution import (
+    ConstantLatency,
+    ExponentialLatency,
+)
+
+
+def local_network(name: str = "local", seed: Optional[int] = None) -> NetworkLink:
+    """Loopback/same-machine: 0.1ms, 1 Gbps, lossless."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(0.0001),
+        bandwidth_bps=1_000_000_000,
+        seed=seed,
+    )
+
+
+def datacenter_network(name: str = "datacenter", seed: Optional[int] = None) -> NetworkLink:
+    """Same-DC fabric: 0.5ms, 10 Gbps, lossless, 0.1ms jitter."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(0.0005),
+        bandwidth_bps=10_000_000_000,
+        jitter=ConstantLatency(0.0001),
+        seed=seed,
+    )
+
+
+def cross_region_network(name: str = "cross_region", seed: Optional[int] = None) -> NetworkLink:
+    """Continental distance: 50ms, 1 Gbps, 0.01% loss, 5ms mean jitter."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(0.050),
+        bandwidth_bps=1_000_000_000,
+        packet_loss_rate=0.0001,
+        jitter=ExponentialLatency(0.005, seed=seed),
+        seed=seed,
+    )
+
+
+def internet_network(name: str = "internet", seed: Optional[int] = None) -> NetworkLink:
+    """Public WAN: 100ms, 100 Mbps, 0.1% loss, 20ms mean jitter."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(0.100),
+        bandwidth_bps=100_000_000,
+        packet_loss_rate=0.001,
+        jitter=ExponentialLatency(0.020, seed=seed),
+        seed=seed,
+    )
+
+
+def satellite_network(name: str = "satellite", seed: Optional[int] = None) -> NetworkLink:
+    """Geostationary hop: 600ms, 10 Mbps, 0.5% loss, 50ms mean jitter."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(0.600),
+        bandwidth_bps=10_000_000,
+        packet_loss_rate=0.005,
+        jitter=ExponentialLatency(0.050, seed=seed),
+        seed=seed,
+    )
+
+
+def lossy_network(
+    loss_rate: float,
+    name: str = "lossy",
+    base_latency: float = 0.010,
+    seed: Optional[int] = None,
+) -> NetworkLink:
+    """Configurable loss over a 10ms / 100 Mbps pipe (retry/fault testing)."""
+    if not 0.0 <= loss_rate <= 1.0:
+        raise ValueError(f"loss_rate must be in [0, 1], got {loss_rate}")
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(base_latency),
+        bandwidth_bps=100_000_000,
+        packet_loss_rate=loss_rate,
+        seed=seed,
+    )
+
+
+def slow_network(
+    latency_seconds: float,
+    name: str = "slow",
+    bandwidth_bps: float = 1_000_000,
+    seed: Optional[int] = None,
+) -> NetworkLink:
+    """Configurable high latency over a thin pipe (timeout testing)."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(latency_seconds),
+        bandwidth_bps=bandwidth_bps,
+        seed=seed,
+    )
+
+
+def mobile_3g_network(name: str = "mobile_3g", seed: Optional[int] = None) -> NetworkLink:
+    """3G: 100ms, 2 Mbps, 0.5% loss, 30ms mean jitter."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(0.100),
+        bandwidth_bps=2_000_000,
+        packet_loss_rate=0.005,
+        jitter=ExponentialLatency(0.030, seed=seed),
+        seed=seed,
+    )
+
+
+def mobile_4g_network(name: str = "mobile_4g", seed: Optional[int] = None) -> NetworkLink:
+    """4G/LTE: 50ms, 20 Mbps, 0.1% loss, 15ms mean jitter."""
+    return NetworkLink(
+        name=name,
+        latency=ConstantLatency(0.050),
+        bandwidth_bps=20_000_000,
+        packet_loss_rate=0.001,
+        jitter=ExponentialLatency(0.015, seed=seed),
+        seed=seed,
+    )

@@ -1,0 +1,145 @@
+"""Point-to-point network link: latency + jitter + bandwidth + loss.
+
+Parity target: ``happysimulator/components/network/link.py:37``
+(``NetworkLink`` — latency/jitter/bandwidth-delay/loss :115+,
+``NetworkLinkStats``). Unlike the reference (module-global ``random`` for
+loss decisions), each link owns a seeded RNG so packet loss is reproducible
+per link.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity, SimReturn
+from happysim_tpu.core.event import Event
+from happysim_tpu.distributions.latency_distribution import LatencyDistribution
+
+logger = logging.getLogger("happysim_tpu.components.network")
+
+
+@dataclass(frozen=True)
+class NetworkLinkStats:
+    bytes_transmitted: int = 0
+    packets_sent: int = 0
+    packets_dropped: int = 0
+
+
+class NetworkLink(Entity):
+    """One-way transmission pipe with configurable impairments.
+
+    Delay per packet = latency sample + jitter sample + payload_bits/bandwidth.
+    Payload size comes from ``event.context['metadata']['payload_size']``
+    (or ``'size'``), defaulting to 0.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        latency: LatencyDistribution,
+        bandwidth_bps: Optional[float] = None,
+        packet_loss_rate: float = 0.0,
+        jitter: Optional[LatencyDistribution] = None,
+        egress: Optional[Entity] = None,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(name)
+        if not 0.0 <= packet_loss_rate <= 1.0:
+            raise ValueError(
+                f"packet_loss_rate must be in [0, 1], got {packet_loss_rate}"
+            )
+        self.latency = latency
+        self.bandwidth_bps = bandwidth_bps
+        self.packet_loss_rate = packet_loss_rate
+        self.jitter = jitter
+        self.egress = egress
+        self.bytes_transmitted = 0
+        self.packets_sent = 0
+        self.packets_dropped = 0
+        self._bytes_in_flight = 0
+        self._seed = seed
+        self._rng = random.Random(seed)
+
+    def clone(self, name: str) -> "NetworkLink":
+        """Fresh link with the same characteristics and zeroed stats (used
+        for the reverse direction of a bidirectional route and for per-pair
+        materialization of a default link). A seeded parent yields a
+        deterministic per-clone seed derived from the clone's name, so
+        seeded simulations stay reproducible."""
+        seed = None
+        if self._seed is not None:
+            seed = self._seed ^ zlib.crc32(name.encode())
+        return NetworkLink(
+            name=name,
+            latency=self.latency,
+            bandwidth_bps=self.bandwidth_bps,
+            packet_loss_rate=self.packet_loss_rate,
+            jitter=self.jitter,
+            seed=seed,
+        )
+
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        if self.egress is not None and hasattr(self.egress, "set_clock"):
+            self.egress.set_clock(clock)
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.egress] if self.egress is not None else []
+
+    @property
+    def current_utilization(self) -> float:
+        if not self.bandwidth_bps:
+            return 0.0
+        return min(1.0, (self._bytes_in_flight * 8) / self.bandwidth_bps)
+
+    @property
+    def link_stats(self) -> NetworkLinkStats:
+        return NetworkLinkStats(
+            bytes_transmitted=self.bytes_transmitted,
+            packets_sent=self.packets_sent,
+            packets_dropped=self.packets_dropped,
+        )
+
+    def handle_event(self, event: Event) -> SimReturn:
+        if self.packet_loss_rate > 0 and self._rng.random() < self.packet_loss_rate:
+            self.packets_dropped += 1
+            return None
+        payload_size = self._payload_size(event)
+        delay = self._delay(payload_size)
+        self._bytes_in_flight += payload_size
+        yield delay
+        self._bytes_in_flight = max(0, self._bytes_in_flight - payload_size)
+        self.bytes_transmitted += payload_size
+        self.packets_sent += 1
+        if self.egress is None:
+            logger.warning(
+                "[%s] no egress configured; event %r lost", self.name, event.event_type
+            )
+            return None
+        forwarded = Event(
+            time=self.now,
+            event_type=event.event_type,
+            target=self.egress,
+            daemon=event.daemon,
+            context=dict(event.context),
+        )
+        forwarded.on_complete = list(event.on_complete)
+        return forwarded
+
+    def _delay(self, payload_size: int) -> float:
+        delay = self.latency.get_latency(self.now).to_seconds()
+        if self.jitter is not None:
+            delay += self.jitter.get_latency(self.now).to_seconds()
+        if self.bandwidth_bps:
+            delay += (payload_size * 8) / self.bandwidth_bps
+        return max(0.0, delay)
+
+    @staticmethod
+    def _payload_size(event: Event) -> int:
+        metadata = event.context.get("metadata", {})
+        return int(metadata.get("payload_size") or metadata.get("size") or 0)

@@ -1,0 +1,231 @@
+"""Network topology: routing table + partitions over NetworkLinks.
+
+Parity target: ``happysimulator/components/network/network.py:83``
+(``Network`` — routing table, ``add_(bidirectional_)link`` :128-186,
+``partition(group_a, group_b, asymmetric)`` → ``Partition`` handle :48 with
+``heal()`` :70; ``heal_partition()`` :251; ``send()`` :394;
+``traffic_matrix()``; ``LinkStats`` :28).
+
+Events routed through the network carry ``source``/``destination`` names in
+``event.context['metadata']``; the network looks up the (source, dest) link
+(falling back to ``default_link``), drops the event if the pair is
+partitioned, and otherwise retargets it to the link.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.components.network.link import NetworkLink
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+logger = logging.getLogger("happysim_tpu.components.network")
+
+
+@dataclass(frozen=True)
+class LinkStats:
+    """Per-route traffic counters for ``traffic_matrix()``."""
+
+    source: str = ""
+    destination: str = ""
+    packets_sent: int = 0
+    packets_dropped: int = 0
+    bytes_transmitted: int = 0
+
+
+@dataclass
+class Partition:
+    """Handle to one partition; ``heal()`` removes only this partition."""
+
+    pairs: frozenset[frozenset[str]]
+    directed_pairs: frozenset[tuple[str, str]]
+    _network: "Network"
+
+    @property
+    def is_active(self) -> bool:
+        return bool(
+            self.pairs & self._network._partitioned_pairs
+            or self.directed_pairs & self._network._directed_partitions
+        )
+
+    def heal(self) -> None:
+        self._network._partitioned_pairs -= self.pairs
+        self._network._directed_partitions -= self.directed_pairs
+
+
+class Network(Entity):
+    """Routes events between named entities through configured links."""
+
+    def __init__(self, name: str, default_link: Optional[NetworkLink] = None):
+        super().__init__(name)
+        self.default_link = default_link
+        self._routes: dict[tuple[str, str], NetworkLink] = {}
+        self._known_entities: dict[str, Entity] = {}
+        self._partitioned_pairs: set[frozenset[str]] = set()
+        self._directed_partitions: set[tuple[str, str]] = set()
+        self.events_routed = 0
+        self.events_dropped_no_route = 0
+        self.events_dropped_partition = 0
+
+    # -- topology ----------------------------------------------------------
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        if self.default_link is not None:
+            self.default_link.set_clock(clock)
+        for link in self._routes.values():
+            link.set_clock(clock)
+
+    def add_link(self, source: Entity, dest: Entity, link: NetworkLink) -> None:
+        """Install a one-way route source→dest over ``link``."""
+        self._known_entities[source.name] = source
+        self._known_entities[dest.name] = dest
+        link.egress = dest
+        if self._clock is not None:
+            link.set_clock(self._clock)
+        self._routes[(source.name, dest.name)] = link
+
+    def add_bidirectional_link(self, a: Entity, b: Entity, link: NetworkLink) -> None:
+        """Install a↔b using ``link`` forward and an identically configured
+        clone (independent stats) in reverse."""
+        self.add_link(a, b, link)
+        self.add_link(b, a, link.clone(f"{link.name}_reverse"))
+
+    def get_link(self, source_name: str, dest_name: str) -> Optional[NetworkLink]:
+        return self._routes.get((source_name, dest_name), self.default_link)
+
+    def ensure_link(
+        self, source_name: str, dest_name: str, dest: Optional[Entity] = None
+    ) -> Optional[NetworkLink]:
+        """The per-pair link, materializing a clone of the default link on
+        first use so per-pair mutation (fault injection) never touches the
+        shared default."""
+        link = self._routes.get((source_name, dest_name))
+        if link is not None:
+            return link
+        if self.default_link is None:
+            return None
+        if dest is None:
+            dest = self._known_entities.get(dest_name)
+        if dest is None:
+            return None
+        link = self.default_link.clone(
+            f"{self.default_link.name}:{source_name}->{dest_name}"
+        )
+        link.egress = dest
+        if self._clock is not None:
+            link.set_clock(self._clock)
+        self._routes[(source_name, dest_name)] = link
+        return link
+
+    def downstream_entities(self) -> list[Entity]:
+        seen: dict[int, Entity] = {}
+        for link in self._routes.values():
+            seen[id(link)] = link
+        return list(seen.values())
+
+    # -- partitions --------------------------------------------------------
+    def partition(
+        self,
+        group_a: list[Entity],
+        group_b: list[Entity],
+        *,
+        asymmetric: bool = False,
+    ) -> Partition:
+        """Block traffic between the groups (a→b only when asymmetric)."""
+        pairs: set[frozenset[str]] = set()
+        directed: set[tuple[str, str]] = set()
+        for ea in group_a:
+            self._known_entities[ea.name] = ea
+            for eb in group_b:
+                self._known_entities[eb.name] = eb
+                if asymmetric:
+                    directed.add((ea.name, eb.name))
+                else:
+                    pairs.add(frozenset((ea.name, eb.name)))
+        self._partitioned_pairs |= pairs
+        self._directed_partitions |= directed
+        logger.info(
+            "[%s] partition: %s %s %s",
+            self.name,
+            [e.name for e in group_a],
+            "-X->" if asymmetric else "<-X->",
+            [e.name for e in group_b],
+        )
+        return Partition(
+            pairs=frozenset(pairs),
+            directed_pairs=frozenset(directed),
+            _network=self,
+        )
+
+    def heal_partition(self) -> None:
+        """Remove every partition, restoring full connectivity."""
+        self._partitioned_pairs.clear()
+        self._directed_partitions.clear()
+
+    def is_partitioned(self, source_name: str, dest_name: str) -> bool:
+        return (
+            frozenset((source_name, dest_name)) in self._partitioned_pairs
+            or (source_name, dest_name) in self._directed_partitions
+        )
+
+    # -- traffic -----------------------------------------------------------
+    def traffic_matrix(self) -> list[LinkStats]:
+        return [
+            LinkStats(
+                source=src,
+                destination=dst,
+                packets_sent=link.packets_sent,
+                packets_dropped=link.packets_dropped,
+                bytes_transmitted=link.bytes_transmitted,
+            )
+            for (src, dst), link in self._routes.items()
+        ]
+
+    def send(
+        self,
+        source: Entity,
+        destination: Entity,
+        event_type: str,
+        payload: Optional[dict] = None,
+        daemon: bool = False,
+    ) -> Event:
+        """Build an event addressed to this network with routing metadata."""
+        metadata = {"source": source.name, "destination": destination.name}
+        if payload:
+            metadata.update(payload)
+        return Event(
+            time=self.now,
+            event_type=event_type,
+            target=self,
+            daemon=daemon,
+            context={"metadata": metadata},
+        )
+
+    def handle_event(self, event: Event):
+        metadata = event.context.get("metadata", {})
+        source_name = metadata.get("source")
+        dest_name = metadata.get("destination")
+        if source_name is None or dest_name is None:
+            logger.warning(
+                "[%s] event %r missing source/destination metadata",
+                self.name,
+                event.event_type,
+            )
+            self.events_dropped_no_route += 1
+            return None
+        if self.is_partitioned(source_name, dest_name):
+            self.events_dropped_partition += 1
+            return None
+        link = self.ensure_link(source_name, dest_name)
+        if link is None:
+            logger.warning(
+                "[%s] no route %s -> %s", self.name, source_name, dest_name
+            )
+            self.events_dropped_no_route += 1
+            return None
+        self.events_routed += 1
+        return self.forward(event, link)

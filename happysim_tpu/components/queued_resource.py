@@ -28,6 +28,12 @@ class _WorkerAdapter(Entity):
     def has_capacity(self) -> bool:
         return self._owner.worker_has_capacity()
 
+    @property
+    def _crashed(self) -> bool:
+        # Crash faults set _crashed on the owner by name; work routed through
+        # the adapter must die with it (core/event.py crash checks).
+        return getattr(self._owner, "_crashed", False)
+
     def handle_event(self, event: Event):
         return self._owner.handle_queued_event(event)
 

@@ -138,7 +138,13 @@ class Resource(Entity):
     def _release(self, amount: float) -> None:
         self._in_use = max(0.0, self._in_use - amount)
         self.total_released += 1
-        # Wake FIFO waiters that now fit (no barging past the head).
+        self._wake_waiters()
+
+    def _wake_waiters(self) -> None:
+        """Wake FIFO waiters that now fit (no barging past the head).
+
+        Also called by capacity-restoring faults (faults/resource_faults.py).
+        """
         while self._waiters:
             future, want = self._waiters[0]
             if self._in_use + want > self.capacity:

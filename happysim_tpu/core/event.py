@@ -126,6 +126,8 @@ class Event:
     ):
         if target is None:
             raise ValueError(f"Event '{event_type}' requires a target entity")
+        if type(time) is not Instant and not isinstance(time, Instant):
+            time = Instant.from_seconds(time)
         self.time = time
         self.event_type = event_type
         self.target = target
@@ -290,6 +292,11 @@ class ProcessContinuation(Event):
         self._send_value = send_value
 
     def invoke(self) -> list[Event]:
+        # A crashed target loses in-flight generator work, not just new
+        # events (CrashNode semantics: the process dies mid-service).
+        if getattr(self.target, "_crashed", False):
+            self.process.close()
+            return []
         debugger = _active_code_debugger.get(None)
         tracing = debugger is not None and debugger.wants(self.target)
         if tracing:

@@ -1,0 +1,29 @@
+"""Fault injection (SURVEY §2.2/§5.3): schedule + node/network/resource faults."""
+
+from happysim_tpu.faults.fault import Fault, FaultContext, FaultHandle, FaultStats
+from happysim_tpu.faults.network_faults import (
+    CompoundLatency,
+    InjectLatency,
+    InjectPacketLoss,
+    NetworkPartition,
+    RandomPartition,
+)
+from happysim_tpu.faults.node_faults import CrashNode, PauseNode
+from happysim_tpu.faults.resource_faults import ReduceCapacity
+from happysim_tpu.faults.schedule import FaultSchedule
+
+__all__ = [
+    "CompoundLatency",
+    "CrashNode",
+    "Fault",
+    "FaultContext",
+    "FaultHandle",
+    "FaultSchedule",
+    "FaultStats",
+    "InjectLatency",
+    "InjectPacketLoss",
+    "NetworkPartition",
+    "PauseNode",
+    "RandomPartition",
+    "ReduceCapacity",
+]

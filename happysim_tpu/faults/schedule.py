@@ -1,0 +1,107 @@
+"""FaultSchedule — bootstraps fault events like a Source.
+
+Parity target: ``happysimulator/faults/schedule.py:31`` (``add()`` → handle;
+``start()`` resolves ctx and emits activation events :68-100). The
+Simulation binds itself (``bind``) then calls ``start(t0)`` during
+bootstrap (core/simulation.py counterpart of reference
+``core/simulation.py:162-169``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.faults.fault import (
+    Fault,
+    FaultContext,
+    FaultHandle,
+    FaultStats,
+    _MutableFaultStats,
+)
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.event import Event
+    from happysim_tpu.core.simulation import Simulation
+    from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger("happysim_tpu.faults")
+
+
+class FaultSchedule(Entity):
+    """Collects faults and expands them into heap events at bootstrap.
+
+    Example::
+
+        schedule = FaultSchedule()
+        schedule.add(CrashNode("server", at=30.0, restart_at=45.0))
+        sim = Simulation(..., fault_schedule=schedule)
+    """
+
+    def __init__(self, name: str = "FaultSchedule") -> None:
+        super().__init__(name)
+        self._faults: list[Fault] = []
+        self._handles: list[FaultHandle] = []
+        self._stats = _MutableFaultStats()
+        self._sim: "Simulation | None" = None
+
+    def add(self, fault: Fault) -> FaultHandle:
+        """Register a fault; the handle can cancel it before activation."""
+        handle = FaultHandle(fault)
+        self._faults.append(fault)
+        self._handles.append(handle)
+        self._stats.faults_scheduled += 1
+        return handle
+
+    def bind(self, sim: "Simulation") -> None:
+        """Called by Simulation.__init__ before start()."""
+        self._sim = sim
+
+    def start(self, start_time: "Instant") -> "list[Event]":
+        if self._sim is None:
+            raise RuntimeError("FaultSchedule.start() before bind()")
+        ctx = self._build_context(start_time)
+        all_events: "list[Event]" = []
+        for fault, handle in zip(self._faults, self._handles):
+            events = fault.generate_events(ctx)
+            # Alias (don't copy): self-perpetuating faults append their
+            # later events to this same list so cancel() reaches them.
+            handle._events = events
+            all_events.extend(events)
+        logger.info(
+            "[%s] %d fault(s) -> %d event(s)", self.name, len(self._faults), len(all_events)
+        )
+        return all_events
+
+    @property
+    def stats(self) -> FaultStats:
+        self._stats.faults_cancelled = sum(1 for h in self._handles if h.cancelled)
+        return self._stats.freeze()
+
+    def handle_event(self, event) -> None:
+        """Fault events carry their own callbacks; nothing to do here."""
+
+    def _build_context(self, start_time: "Instant") -> FaultContext:
+        from happysim_tpu.components.network.network import Network
+        from happysim_tpu.components.resource import Resource
+
+        entities: dict = {}
+        networks: dict = {}
+        resources: dict = {}
+        sim = self._sim
+        for component in (*sim.entities, *sim.sources, *sim.probes):
+            name = getattr(component, "name", None)
+            if name is None:
+                continue
+            entities[name] = component
+            if isinstance(component, Network):
+                networks[name] = component
+            if isinstance(component, Resource):
+                resources[name] = component
+        return FaultContext(
+            entities=entities,
+            networks=networks,
+            resources=resources,
+            start_time=start_time,
+        )

@@ -128,6 +128,11 @@ class CountMinSketch(FrequencySketch):
         self._items += other._items
         for item in other._candidates:
             self._candidates[item] = self.estimate(item)
+        # Keep the candidate set bounded along reduction chains (same cap
+        # as add(); merge is the cross-replica reduction path).
+        if len(self._candidates) > 2 * self._track_top:
+            keep = sorted(self._candidates.items(), key=lambda kv: -kv[1])
+            self._candidates = dict(keep[: self._track_top])
 
     @property
     def memory_bytes(self) -> int:

@@ -1,0 +1,156 @@
+"""Network link/topology tests (SURVEY §2.4 network/)."""
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Network,
+    NetworkLink,
+    Simulation,
+    Sink,
+    Source,
+    datacenter_network,
+    local_network,
+    lossy_network,
+)
+from happysim_tpu.core.callback_entity import CallbackEntity
+from happysim_tpu.core.event import Event
+
+
+def _net_sim(network, entities, duration, sources=None):
+    return Simulation(
+        sources=sources or [], entities=[network, *entities], duration=duration
+    )
+
+
+class TestNetworkLink:
+    def test_latency_delays_delivery(self):
+        sink = Sink("sink")
+        link = NetworkLink("l", latency=ConstantLatency(0.25), egress=sink)
+        sim = Simulation(entities=[link, sink], duration=10.0)
+        sim.schedule(
+            Event(
+                time=0.0,
+                event_type="pkt",
+                target=link,
+                context={"created_at": sim.now},
+            )
+        )
+        sim.run()
+        stats = sink.latency_stats()
+        assert sink.events_received == 1
+        assert stats.mean_s == pytest.approx(0.25)
+        assert link.packets_sent == 1
+
+    def test_bandwidth_adds_transmission_time(self):
+        sink = Sink("sink")
+        # 1 Mbps link, 125_000-byte payload = 1.0s transmission
+        link = NetworkLink(
+            "l", latency=ConstantLatency(0.0), bandwidth_bps=1_000_000, egress=sink
+        )
+        sim = Simulation(entities=[link, sink], duration=10.0)
+        sim.schedule(
+            Event(
+                time=0.0,
+                event_type="pkt",
+                target=link,
+                context={
+                    "created_at": sim.now,
+                    "metadata": {"payload_size": 125_000},
+                },
+            )
+        )
+        sim.run()
+        assert sink.latency_stats().mean_s == pytest.approx(1.0)
+        assert link.bytes_transmitted == 125_000
+
+    def test_packet_loss_drops(self):
+        sink = Sink("sink")
+        link = NetworkLink(
+            "l", latency=ConstantLatency(0.001), packet_loss_rate=1.0, egress=sink
+        )
+        sim = Simulation(entities=[link, sink], duration=1.0)
+        sim.schedule(Event(time=0.0, event_type="pkt", target=link))
+        sim.run()
+        assert sink.events_received == 0
+        assert link.packets_dropped == 1
+
+    def test_seeded_loss_reproducible(self):
+        def run(seed):
+            sink = Sink("sink")
+            link = lossy_network(0.5, seed=seed)
+            link.egress = sink
+            sim = Simulation(entities=[link, sink], duration=100.0)
+            for i in range(100):
+                sim.schedule(Event(time=float(i), event_type="pkt", target=link))
+            sim.run()
+            return link.packets_dropped
+
+        assert run(7) == run(7)
+        assert 20 < run(7) < 80
+
+    def test_invalid_loss_rate(self):
+        with pytest.raises(ValueError):
+            NetworkLink("l", latency=ConstantLatency(0.0), packet_loss_rate=1.5)
+
+
+class TestNetwork:
+    def _build(self):
+        a, b = Sink("a"), Sink("b")
+        net = Network("net")
+        net.add_bidirectional_link(a, b, datacenter_network())
+        return net, a, b
+
+    def test_routing_via_metadata(self):
+        net, a, b = self._build()
+        sim = Simulation(entities=[net, a, b], duration=1.0)
+        sim.schedule(net.send(a, b, "msg"))
+        sim.run()
+        assert b.events_received == 1
+        assert net.events_routed == 1
+
+    def test_partition_drops_then_heals(self):
+        net, a, b = self._build()
+        sim = Simulation(entities=[net, a, b], duration=1.0)
+        handle = net.partition([a], [b])
+        assert net.is_partitioned("a", "b") and net.is_partitioned("b", "a")
+        sim.schedule(net.send(a, b, "msg"))
+        sim.run()
+        assert b.events_received == 0
+        assert net.events_dropped_partition == 1
+        assert handle.is_active
+        handle.heal()
+        assert not net.is_partitioned("a", "b")
+        assert not handle.is_active
+
+    def test_asymmetric_partition(self):
+        net, a, b = self._build()
+        net.partition([a], [b], asymmetric=True)
+        assert net.is_partitioned("a", "b")
+        assert not net.is_partitioned("b", "a")
+
+    def test_default_link_fallback(self):
+        a, b = Sink("a"), Sink("b")
+        net = Network("net", default_link=local_network())
+        net._known_entities["a"] = a
+        net._known_entities["b"] = b
+        sim = Simulation(entities=[net, a, b], duration=1.0)
+        sim.schedule(net.send(a, b, "msg"))
+        sim.run()
+        assert b.events_received == 1
+
+    def test_missing_metadata_dropped(self):
+        net, a, b = self._build()
+        sim = Simulation(entities=[net, a, b], duration=1.0)
+        sim.schedule(Event(time=0.0, event_type="msg", target=net))
+        sim.run()
+        assert net.events_dropped_no_route == 1
+
+    def test_traffic_matrix(self):
+        net, a, b = self._build()
+        sim = Simulation(entities=[net, a, b], duration=1.0)
+        sim.schedule(net.send(a, b, "msg"))
+        sim.run()
+        matrix = {(s.source, s.destination): s for s in net.traffic_matrix()}
+        assert matrix[("a", "b")].packets_sent == 1
+        assert matrix[("b", "a")].packets_sent == 0
