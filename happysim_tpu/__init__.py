@@ -43,6 +43,31 @@ from happysim_tpu.components import (
     Sink,
     WeightedConcurrency,
 )
+from happysim_tpu.components.client import (
+    Client,
+    ClientStats,
+    Connection,
+    ConnectionPool,
+    DecorrelatedJitter,
+    ExponentialBackoff,
+    FixedRetry,
+    NoRetry,
+    PooledClient,
+    RetryPolicy,
+)
+from happysim_tpu.components.load_balancer import (
+    ConsistentHash,
+    HealthChecker,
+    IPHash,
+    LeastConnections,
+    LeastResponseTime,
+    LoadBalancer,
+    LoadBalancingStrategy,
+    PowerOfTwoChoices,
+    RoundRobin,
+    WeightedLeastConnections,
+    WeightedRoundRobin,
+)
 from happysim_tpu.core import (
     CallbackEntity,
     Clock,

@@ -4,7 +4,16 @@ Grows sub-package by sub-package toward the reference's 22 packages
 (~150 classes); see SURVEY.md §2.4 for the inventory.
 """
 
+from happysim_tpu.components.client import (
+    Client,
+    ConnectionPool,
+    PooledClient,
+)
 from happysim_tpu.components.common import Counter, LatencyStats, Sink
+from happysim_tpu.components.load_balancer import (
+    HealthChecker,
+    LoadBalancer,
+)
 from happysim_tpu.components.queue import Queue
 from happysim_tpu.components.queue_driver import QueueDriver
 from happysim_tpu.components.queue_policy import (
@@ -49,6 +58,11 @@ from happysim_tpu.components.network import (
 )
 
 __all__ = [
+    "Client",
+    "ConnectionPool",
+    "HealthChecker",
+    "LoadBalancer",
+    "PooledClient",
     "LinkStats",
     "Network",
     "NetworkLink",

@@ -1,0 +1,31 @@
+"""Client-side components: request/response, retries, connection pooling."""
+
+from happysim_tpu.components.client.client import Client
+from happysim_tpu.components.client.connection_pool import (
+    Connection,
+    ConnectionPool,
+    ConnectionPoolStats,
+)
+from happysim_tpu.components.client.pooled_client import PooledClient
+from happysim_tpu.components.client.retry import (
+    ClientStats,
+    DecorrelatedJitter,
+    ExponentialBackoff,
+    FixedRetry,
+    NoRetry,
+    RetryPolicy,
+)
+
+__all__ = [
+    "Client",
+    "ClientStats",
+    "Connection",
+    "ConnectionPool",
+    "ConnectionPoolStats",
+    "DecorrelatedJitter",
+    "ExponentialBackoff",
+    "FixedRetry",
+    "NoRetry",
+    "PooledClient",
+    "RetryPolicy",
+]

@@ -1,0 +1,225 @@
+"""Request/response client with timeouts and retries.
+
+Parity target: ``happysimulator/components/client/client.py:45`` (in-flight
+tracking keyed by (request_id, attempt), completion-hook responses, timeout
+events, retry scheduling).
+
+Rebuild design: responses ride the target event's completion hook — when the
+full downstream processing chain of the request finishes (including generator
+service times), the hook schedules a ``_client_response`` back to this client.
+Timeout events are *cancelled* on response (lazy heap deletion) instead of
+being filtered by dict lookup alone, so an idle client leaves no stale events.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from happysim_tpu.components.client.retry import ClientStats, NoRetry, RetryPolicy
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+SuccessCallback = Callable[[Event, Event], None]
+FailureCallback = Callable[[Event, str], None]
+
+
+class Client(Entity):
+    """Sends requests to a target entity and tracks the response lifecycle."""
+
+    def __init__(
+        self,
+        name: str,
+        target: Entity,
+        timeout: Optional[float] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        on_success: Optional[SuccessCallback] = None,
+        on_failure: Optional[FailureCallback] = None,
+    ):
+        super().__init__(name)
+        if timeout is not None and timeout < 0:
+            raise ValueError("timeout must be >= 0")
+        self.target = target
+        self.timeout = timeout
+        self.retry_policy = retry_policy or NoRetry()
+        self._on_success = on_success
+        self._on_failure = on_failure
+        self._in_flight: dict[tuple[int, int], dict[str, Any]] = {}
+        self._next_request_id = 0
+        self.requests_sent = 0
+        self.responses_received = 0
+        self.timeouts = 0
+        self.retries = 0
+        self.failures = 0
+        self.response_times_s: list[float] = []
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.target]
+
+    # -- public API --------------------------------------------------------
+    def send_request(
+        self,
+        payload: Any = None,
+        event_type: str = "request",
+        at: Optional[Instant] = None,
+        on_success: Optional[SuccessCallback] = None,
+        on_failure: Optional[FailureCallback] = None,
+    ) -> Event:
+        """Build a schedulable request event routed through this client."""
+        self._next_request_id += 1
+        time = at if at is not None else (self.now if self._clock is not None else Instant.Epoch)
+        return Event(
+            time=time,
+            event_type=event_type,
+            target=self,
+            context={
+                "metadata": {
+                    "request_id": self._next_request_id,
+                    "payload": payload,
+                    "attempt": 1,
+                },
+                "_on_success": on_success or self._on_success,
+                "_on_failure": on_failure or self._on_failure,
+            },
+        )
+
+    @property
+    def in_flight_count(self) -> int:
+        return len(self._in_flight)
+
+    @property
+    def average_response_time(self) -> float:
+        if not self.response_times_s:
+            return 0.0
+        return sum(self.response_times_s) / len(self.response_times_s)
+
+    def response_time_percentile(self, percentile: float) -> float:
+        """Linear-interpolated percentile of observed response times (0..1)."""
+        if not self.response_times_s:
+            return 0.0
+        times = sorted(self.response_times_s)
+        pos = min(max(percentile, 0.0), 1.0) * (len(times) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(times) - 1)
+        return times[lo] + (times[hi] - times[lo]) * (pos - lo)
+
+    @property
+    def stats(self) -> ClientStats:
+        return ClientStats(
+            requests_sent=self.requests_sent,
+            responses_received=self.responses_received,
+            timeouts=self.timeouts,
+            retries=self.retries,
+            failures=self.failures,
+        )
+
+    # -- event flow --------------------------------------------------------
+    def handle_event(self, event: Event):
+        if event.event_type == "_client_response":
+            return self._handle_response(event)
+        if event.event_type == "_client_timeout":
+            return self._handle_timeout(event)
+        return self._dispatch(event)
+
+    def _dispatch(self, event: Event) -> list[Event]:
+        metadata = event.context["metadata"]
+        request_id = metadata["request_id"]
+        attempt = metadata.get("attempt", 1)
+        key = (request_id, attempt)
+
+        self.requests_sent += 1
+        if attempt > 1:
+            self.retries += 1
+
+        target_event = Event(
+            time=self.now,
+            event_type=event.event_type if event.event_type != "request" else f"{self.name}.request",
+            target=self.target,
+            context={
+                "metadata": {
+                    "request_id": request_id,
+                    "payload": metadata.get("payload"),
+                    "attempt": attempt,
+                    "client": self.name,
+                }
+            },
+        )
+
+        def respond(finish_time: Instant) -> Event:
+            return Event(
+                time=finish_time,
+                event_type="_client_response",
+                target=self,
+                context={"metadata": {"request_id": request_id, "attempt": attempt}},
+            )
+
+        target_event.add_completion_hook(respond)
+        produced = [target_event]
+
+        timeout_event = None
+        if self.timeout is not None:
+            timeout_event = Event(
+                time=self.now + self.timeout,
+                event_type="_client_timeout",
+                target=self,
+                daemon=True,
+                context={"metadata": {"request_id": request_id, "attempt": attempt}},
+            )
+            produced.append(timeout_event)
+
+        self._in_flight[key] = {
+            "start": self.now,
+            "request": event,
+            "timeout_event": timeout_event,
+            "on_success": event.context.get("_on_success"),
+            "on_failure": event.context.get("_on_failure"),
+        }
+        return produced
+
+    def _handle_response(self, event: Event):
+        metadata = event.context["metadata"]
+        key = (metadata["request_id"], metadata.get("attempt", 1))
+        info = self._in_flight.pop(key, None)
+        if info is None:
+            return None  # attempt already timed out
+        if info["timeout_event"] is not None:
+            info["timeout_event"].cancel()
+        self.responses_received += 1
+        self.response_times_s.append((self.now - info["start"]).to_seconds())
+        on_success = info.get("on_success")
+        if on_success is not None:
+            on_success(info["request"], event)
+        return None
+
+    def _handle_timeout(self, event: Event):
+        metadata = event.context["metadata"]
+        request_id = metadata["request_id"]
+        attempt = metadata.get("attempt", 1)
+        info = self._in_flight.pop((request_id, attempt), None)
+        if info is None:
+            return None  # response already arrived
+        self.timeouts += 1
+
+        if self.retry_policy.should_retry(attempt):
+            original = info["request"]
+            retry_event = Event(
+                time=self.now + self.retry_policy.delay(attempt),
+                event_type=original.event_type,
+                target=self,
+                context={
+                    "metadata": {
+                        "request_id": request_id,
+                        "payload": original.context["metadata"].get("payload"),
+                        "attempt": attempt + 1,
+                    },
+                    "_on_success": info.get("on_success"),
+                    "_on_failure": info.get("on_failure"),
+                },
+            )
+            return [retry_event]
+
+        self.failures += 1
+        on_failure = info.get("on_failure")
+        if on_failure is not None:
+            on_failure(info["request"], "timeout")
+        return None

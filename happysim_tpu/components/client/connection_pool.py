@@ -1,0 +1,246 @@
+"""Connection pool with setup latency, reuse, and idle reaping.
+
+Parity target: ``happysimulator/components/client/connection_pool.py:72``
+(``Connection`` :44, acquire/release :243-422, warmup :454, idle timeout
+:500).
+
+Rebuild design: ``acquire()`` returns a :class:`SimFuture` resolving to a
+``Connection`` — pre-resolved when an idle connection exists, resolved after
+``connect_latency`` when a new connection is dialed, or parked until a
+release when the pool is at ``max_connections``. This replaces the
+reference's callback+generator plumbing with the framework's native future
+combinators (timeouts compose via ``any_of``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.distributions.latency_distribution import ConstantLatency, LatencyDistribution
+
+
+@dataclass
+class Connection:
+    """A pooled connection handle."""
+
+    id: int
+    created_at: Instant
+    last_used_at: Instant
+    uses: int = 0
+    closed: bool = False
+
+
+@dataclass(frozen=True)
+class ConnectionPoolStats:
+    connections_created: int
+    connections_closed: int
+    acquisitions: int
+    reuses: int
+    waits: int
+    idle_reaped: int
+
+
+@dataclass
+class _Waiter:
+    future: SimFuture
+    cancelled: bool = field(default=False)
+
+
+class ConnectionPool(Entity):
+    """Bounded pool of reusable connections to a target."""
+
+    def __init__(
+        self,
+        name: str,
+        target: Entity,
+        max_connections: int = 10,
+        min_connections: int = 0,
+        connect_latency: Optional[LatencyDistribution] = None,
+        idle_timeout: Optional[float] = None,
+    ):
+        super().__init__(name)
+        if max_connections < 1:
+            raise ValueError("max_connections must be >= 1")
+        if min_connections < 0 or min_connections > max_connections:
+            raise ValueError("0 <= min_connections <= max_connections required")
+        self.target = target
+        self.max_connections = max_connections
+        self.min_connections = min_connections
+        self.connect_latency = connect_latency or ConstantLatency(0.0)
+        self.idle_timeout = idle_timeout
+        self._idle: list[Connection] = []
+        self._active: dict[int, Connection] = {}
+        self._dialing = 0
+        self._waiters: list[_Waiter] = []
+        self._next_id = 0
+        self.connections_created = 0
+        self.connections_closed = 0
+        self.acquisitions = 0
+        self.reuses = 0
+        self.waits = 0
+        self.idle_reaped = 0
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.target]
+
+    @property
+    def idle_connections(self) -> int:
+        return len(self._idle)
+
+    @property
+    def active_connections(self) -> int:
+        return len(self._active)
+
+    @property
+    def total_connections(self) -> int:
+        return len(self._idle) + len(self._active) + self._dialing
+
+    @property
+    def pending_requests(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def stats(self) -> ConnectionPoolStats:
+        return ConnectionPoolStats(
+            connections_created=self.connections_created,
+            connections_closed=self.connections_closed,
+            acquisitions=self.acquisitions,
+            reuses=self.reuses,
+            waits=self.waits,
+            idle_reaped=self.idle_reaped,
+        )
+
+    # -- acquire / release -------------------------------------------------
+    def acquire(self) -> tuple[SimFuture, list[Event]]:
+        """(future resolving to a Connection, events to schedule).
+
+        Usage inside a generator handler::
+
+            future, events = pool.acquire()
+            conn = yield future, events
+        """
+        self.acquisitions += 1
+        if self._idle:
+            conn = self._idle.pop()
+            conn.uses += 1
+            conn.last_used_at = self.now
+            self._active[conn.id] = conn
+            self.reuses += 1
+            future = SimFuture()
+            future.resolve(conn)
+            return future, []
+        if self.total_connections < self.max_connections:
+            return self._dial()
+        self.waits += 1
+        waiter = _Waiter(SimFuture())
+        self._waiters.append(waiter)
+        return waiter.future, []
+
+    def release(self, connection: Connection) -> list[Event]:
+        """Return a connection; hands it to a waiter or parks it idle."""
+        self._active.pop(connection.id, None)
+        if connection.closed:
+            return []
+        connection.last_used_at = self.now
+        while self._waiters:
+            waiter = self._waiters.pop(0)
+            if waiter.cancelled:
+                continue
+            connection.uses += 1
+            self._active[connection.id] = connection
+            waiter.future.resolve(connection)
+            return []
+        self._idle.append(connection)
+        if self.idle_timeout is not None:
+            return [self._idle_check_event(connection)]
+        return []
+
+    def close(self, connection: Connection) -> list[Event]:
+        """Discard a (broken) connection instead of returning it."""
+        self._active.pop(connection.id, None)
+        if not connection.closed:
+            connection.closed = True
+            self.connections_closed += 1
+        # A slot opened up; dial for the next waiter if any.
+        if self._waiters and self.total_connections < self.max_connections:
+            return self._dial_for_waiter()
+        return []
+
+    def warmup(self) -> Event:
+        """Event that pre-dials ``min_connections`` connections."""
+        return Event(self.now if self._clock else Instant.Epoch, "_pool_warmup", target=self)
+
+    # -- internals ---------------------------------------------------------
+    def _dial(self) -> tuple[SimFuture, list[Event]]:
+        future = SimFuture()
+        self._dialing += 1
+        latency = self.connect_latency.get_latency(self.now)
+
+        def finish(_: Event):
+            self._dialing -= 1
+            conn = self._new_connection()
+            conn.uses += 1
+            self._active[conn.id] = conn
+            future.resolve(conn)
+
+        return future, [Event.once(self.now + latency, finish, "_pool_dial", daemon=False)]
+
+    def _dial_for_waiter(self) -> list[Event]:
+        self._dialing += 1
+        latency = self.connect_latency.get_latency(self.now)
+
+        def finish(_: Event):
+            self._dialing -= 1
+            conn = self._new_connection()
+            while self._waiters:
+                waiter = self._waiters.pop(0)
+                if waiter.cancelled:
+                    continue
+                conn.uses += 1
+                self._active[conn.id] = conn
+                waiter.future.resolve(conn)
+                return
+            self._idle.append(conn)
+
+        return [Event.once(self.now + latency, finish, "_pool_dial", daemon=False)]
+
+    def _new_connection(self) -> Connection:
+        self._next_id += 1
+        self.connections_created += 1
+        return Connection(id=self._next_id, created_at=self.now, last_used_at=self.now)
+
+    def _idle_check_event(self, connection: Connection) -> Event:
+        last_used = connection.last_used_at
+
+        def check(_: Event):
+            # Reap only if it hasn't been used since the timer was set and is
+            # still idle, keeping min_connections warm.
+            if (
+                connection.last_used_at == last_used
+                and connection in self._idle
+                and self.total_connections > self.min_connections
+            ):
+                self._idle.remove(connection)
+                connection.closed = True
+                self.connections_closed += 1
+                self.idle_reaped += 1
+
+        return Event.once(self.now + self.idle_timeout, check, "_pool_idle_check", daemon=True)
+
+    def handle_event(self, event: Event):
+        if event.event_type == "_pool_warmup":
+            produced: list[Event] = []
+            while self.total_connections < self.min_connections:
+                future, events = self._dial()
+                # Warmed connections go idle once dialed.
+                future._add_settle_callback(
+                    lambda settled: self.release(settled._value)
+                )
+                produced.extend(events)
+            return produced
+        return None

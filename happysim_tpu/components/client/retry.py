@@ -1,0 +1,123 @@
+"""Retry policies for clients.
+
+Parity target: ``happysimulator/components/client/retry.py:31-292``
+(``RetryPolicy``/``NoRetry``/``FixedRetry``/``ExponentialBackoff``/
+``DecorrelatedJitter``).
+
+All stochastic policies own a seeded ``random.Random`` stream so retry storms
+are reproducible (the rebuild's no-global-RNG rule).
+"""
+
+from __future__ import annotations
+
+import random
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+
+class RetryPolicy(ABC):
+    """Decides whether and when attempt N+1 follows a failed attempt N."""
+
+    @abstractmethod
+    def should_retry(self, attempt: int) -> bool:
+        """True if another attempt may be made after ``attempt`` failed (1-based)."""
+
+    @abstractmethod
+    def delay(self, attempt: int) -> float:
+        """Seconds to wait before the attempt after ``attempt`` (1-based)."""
+
+
+class NoRetry(RetryPolicy):
+    """Single attempt; failures are final."""
+
+    def should_retry(self, attempt: int) -> bool:
+        return False
+
+    def delay(self, attempt: int) -> float:
+        return 0.0
+
+
+class FixedRetry(RetryPolicy):
+    """Up to ``max_attempts`` total attempts with a constant inter-try delay."""
+
+    def __init__(self, max_attempts: int = 3, delay_s: float = 0.1):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.delay_s = delay_s
+
+    def should_retry(self, attempt: int) -> bool:
+        return attempt < self.max_attempts
+
+    def delay(self, attempt: int) -> float:
+        return self.delay_s
+
+
+class ExponentialBackoff(RetryPolicy):
+    """initial * multiplier^(attempt-1), capped, with optional full jitter."""
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        initial_delay: float = 0.1,
+        max_delay: float = 10.0,
+        multiplier: float = 2.0,
+        jitter: bool = False,
+        seed: Optional[int] = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.initial_delay = initial_delay
+        self.max_delay = max_delay
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+
+    def should_retry(self, attempt: int) -> bool:
+        return attempt < self.max_attempts
+
+    def delay(self, attempt: int) -> float:
+        base = min(self.max_delay, self.initial_delay * self.multiplier ** (attempt - 1))
+        if self.jitter:
+            return self._rng.uniform(0.0, base)
+        return base
+
+
+class DecorrelatedJitter(RetryPolicy):
+    """AWS-style decorrelated jitter: sleep = U(base, prev*3), capped.
+
+    Spreads synchronized retry herds better than plain exponential backoff.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base_delay: float = 0.1,
+        max_delay: float = 10.0,
+        seed: Optional[int] = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._rng = random.Random(seed)
+        self._prev = base_delay
+
+    def should_retry(self, attempt: int) -> bool:
+        return attempt < self.max_attempts
+
+    def delay(self, attempt: int) -> float:
+        self._prev = min(self.max_delay, self._rng.uniform(self.base_delay, self._prev * 3))
+        return self._prev
+
+
+@dataclass(frozen=True)
+class ClientStats:
+    requests_sent: int
+    responses_received: int
+    timeouts: int
+    retries: int
+    failures: int

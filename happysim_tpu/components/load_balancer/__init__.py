@@ -1,0 +1,43 @@
+"""Load balancing: strategy-driven routing + health checks."""
+
+from happysim_tpu.components.load_balancer.health_check import (
+    BackendHealthState,
+    HealthChecker,
+    HealthCheckStats,
+)
+from happysim_tpu.components.load_balancer.load_balancer import (
+    LoadBalancer,
+    LoadBalancerStats,
+)
+from happysim_tpu.components.load_balancer.strategies import (
+    BackendInfo,
+    ConsistentHash,
+    IPHash,
+    LeastConnections,
+    LeastResponseTime,
+    LoadBalancingStrategy,
+    PowerOfTwoChoices,
+    Random,
+    RoundRobin,
+    WeightedLeastConnections,
+    WeightedRoundRobin,
+)
+
+__all__ = [
+    "BackendHealthState",
+    "BackendInfo",
+    "ConsistentHash",
+    "HealthCheckStats",
+    "HealthChecker",
+    "IPHash",
+    "LeastConnections",
+    "LeastResponseTime",
+    "LoadBalancer",
+    "LoadBalancerStats",
+    "LoadBalancingStrategy",
+    "PowerOfTwoChoices",
+    "Random",
+    "RoundRobin",
+    "WeightedLeastConnections",
+    "WeightedRoundRobin",
+]

@@ -1,0 +1,118 @@
+"""Periodic backend health checking for the LoadBalancer.
+
+Parity target: ``happysimulator/components/load_balancer/health_check.py:67``
+(``HealthChecker`` with check interval, healthy/unhealthy thresholds,
+``HealthCheckStats`` :45, per-backend ``BackendHealthState`` :57).
+
+Rebuild design: the checker is a self-perpetuating daemon entity (like a
+Source tick). Each round it evaluates every backend with ``check_fn`` —
+defaulting to "not crashed and has capacity" — and flips LB health after the
+configured consecutive-pass/-fail thresholds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from happysim_tpu.components.load_balancer.load_balancer import LoadBalancer
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass
+class BackendHealthState:
+    consecutive_passes: int = 0
+    consecutive_failures: int = 0
+    last_result: Optional[bool] = None
+
+
+@dataclass(frozen=True)
+class HealthCheckStats:
+    checks_performed: int
+    checks_passed: int
+    checks_failed: int
+    transitions_to_unhealthy: int
+    transitions_to_healthy: int
+
+
+def _default_check(backend: Entity) -> bool:
+    if getattr(backend, "_crashed", False):
+        return False
+    return backend.has_capacity()
+
+
+class HealthChecker(Entity):
+    """Probes backends every ``interval`` seconds and updates LB health."""
+
+    def __init__(
+        self,
+        name: str,
+        load_balancer: LoadBalancer,
+        interval: float = 1.0,
+        unhealthy_threshold: int = 3,
+        healthy_threshold: int = 2,
+        check_fn: Optional[Callable[[Entity], bool]] = None,
+    ):
+        super().__init__(name)
+        if interval <= 0:
+            raise ValueError("interval must be positive")
+        self.load_balancer = load_balancer
+        self.interval = interval
+        self.unhealthy_threshold = unhealthy_threshold
+        self.healthy_threshold = healthy_threshold
+        self.check_fn = check_fn or _default_check
+        self._state: dict[str, BackendHealthState] = {}
+        self.checks_performed = 0
+        self.checks_passed = 0
+        self.checks_failed = 0
+        self.transitions_to_unhealthy = 0
+        self.transitions_to_healthy = 0
+
+    def start(self, at: Instant) -> list[Event]:
+        """Bootstrap event; Simulation calls this like a Source."""
+        return [Event(at, "_health_check", target=self, daemon=True)]
+
+    @property
+    def stats(self) -> HealthCheckStats:
+        return HealthCheckStats(
+            checks_performed=self.checks_performed,
+            checks_passed=self.checks_passed,
+            checks_failed=self.checks_failed,
+            transitions_to_unhealthy=self.transitions_to_unhealthy,
+            transitions_to_healthy=self.transitions_to_healthy,
+        )
+
+    def state_of(self, backend: Entity | str) -> BackendHealthState:
+        name = backend if isinstance(backend, str) else backend.name
+        return self._state.setdefault(name, BackendHealthState())
+
+    def handle_event(self, event: Event):
+        if event.event_type != "_health_check":
+            return None
+        for backend in self.load_balancer.backends:
+            self._check(backend)
+        return [Event(self.now + self.interval, "_health_check", target=self, daemon=True)]
+
+    def _check(self, backend: Entity) -> None:
+        state = self.state_of(backend)
+        passed = bool(self.check_fn(backend))
+        self.checks_performed += 1
+        state.last_result = passed
+        if passed:
+            self.checks_passed += 1
+            state.consecutive_passes += 1
+            state.consecutive_failures = 0
+            info = self.load_balancer.backend_info(backend)
+            if not info.healthy and state.consecutive_passes >= self.healthy_threshold:
+                self.load_balancer.mark_healthy(backend)
+                self.transitions_to_healthy += 1
+        else:
+            self.checks_failed += 1
+            state.consecutive_failures += 1
+            state.consecutive_passes = 0
+            info = self.load_balancer.backend_info(backend)
+            if info.healthy and state.consecutive_failures >= self.unhealthy_threshold:
+                self.load_balancer.mark_unhealthy(backend)
+                self.transitions_to_unhealthy += 1

@@ -1,0 +1,144 @@
+"""Load balancer entity: pluggable strategy + health tracking.
+
+Parity target: ``happysimulator/components/load_balancer/load_balancer.py:62``
+(``BackendInfo`` :38, forward w/ in-flight tracking, health marking,
+``LoadBalancerStats`` :51).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.components.load_balancer.strategies import (
+    BackendInfo,
+    LoadBalancingStrategy,
+    RoundRobin,
+)
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass(frozen=True)
+class LoadBalancerStats:
+    requests_received: int
+    requests_forwarded: int
+    requests_rejected: int
+    no_backend_available: int
+    backends_marked_unhealthy: int
+    backends_marked_healthy: int
+
+
+class LoadBalancer(Entity):
+    """Routes each request to one backend chosen by the strategy.
+
+    Response times and in-flight counts are measured via completion hooks on
+    the forwarded event, so adaptive strategies (LeastConnections,
+    LeastResponseTime, PowerOfTwoChoices) see live load.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        backends: Optional[list[Entity]] = None,
+        strategy: Optional[LoadBalancingStrategy] = None,
+        response_time_alpha: float = 0.3,
+    ):
+        super().__init__(name)
+        self.strategy = strategy or RoundRobin()
+        self.response_time_alpha = response_time_alpha
+        self._backends: dict[str, BackendInfo] = {}
+        for backend in backends or []:
+            self.add_backend(backend)
+        self.requests_received = 0
+        self.requests_forwarded = 0
+        self.requests_rejected = 0
+        self.no_backend_available = 0
+        self.backends_marked_unhealthy = 0
+        self.backends_marked_healthy = 0
+
+    # -- backend management ------------------------------------------------
+    def add_backend(self, backend: Entity, weight: float = 1.0) -> None:
+        if backend.name in self._backends:
+            raise ValueError(f"Backend '{backend.name}' already registered")
+        self._backends[backend.name] = BackendInfo(backend=backend, weight=weight)
+        self.strategy.on_backends_changed(list(self._backends.values()))
+
+    def remove_backend(self, backend: Entity | str) -> None:
+        name = backend if isinstance(backend, str) else backend.name
+        self._backends.pop(name, None)
+        self.strategy.on_backends_changed(list(self._backends.values()))
+
+    def set_weight(self, backend: Entity | str, weight: float) -> None:
+        name = backend if isinstance(backend, str) else backend.name
+        self._backends[name].weight = weight
+
+    def mark_unhealthy(self, backend: Entity | str) -> None:
+        name = backend if isinstance(backend, str) else backend.name
+        info = self._backends.get(name)
+        if info is not None and info.healthy:
+            info.healthy = False
+            self.backends_marked_unhealthy += 1
+
+    def mark_healthy(self, backend: Entity | str) -> None:
+        name = backend if isinstance(backend, str) else backend.name
+        info = self._backends.get(name)
+        if info is not None and not info.healthy:
+            info.healthy = True
+            info.consecutive_failures = 0
+            self.backends_marked_healthy += 1
+
+    @property
+    def backends(self) -> list[Entity]:
+        return [info.backend for info in self._backends.values()]
+
+    @property
+    def healthy_backends(self) -> list[Entity]:
+        return [info.backend for info in self._backends.values() if info.healthy]
+
+    def backend_info(self, backend: Entity | str) -> BackendInfo:
+        name = backend if isinstance(backend, str) else backend.name
+        return self._backends[name]
+
+    @property
+    def stats(self) -> LoadBalancerStats:
+        return LoadBalancerStats(
+            requests_received=self.requests_received,
+            requests_forwarded=self.requests_forwarded,
+            requests_rejected=self.requests_rejected,
+            no_backend_available=self.no_backend_available,
+            backends_marked_unhealthy=self.backends_marked_unhealthy,
+            backends_marked_healthy=self.backends_marked_healthy,
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        return self.backends
+
+    # -- routing -----------------------------------------------------------
+    def handle_event(self, event: Event):
+        self.requests_received += 1
+        candidates = [info for info in self._backends.values() if info.healthy]
+        choice = self.strategy.select(candidates, event)
+        if choice is None:
+            self.no_backend_available += 1
+            self.requests_rejected += 1
+            return None
+
+        choice.in_flight += 1
+        choice.total_requests += 1
+        start = self.now
+        forwarded = self.forward(event, choice.backend)
+
+        def on_complete(finish_time: Instant):
+            choice.in_flight -= 1
+            choice.consecutive_successes += 1
+            choice.consecutive_failures = 0
+            choice.record_response_time(
+                (finish_time - start).to_seconds(), self.response_time_alpha
+            )
+            return None
+
+        forwarded.add_completion_hook(on_complete)
+        self.requests_forwarded += 1
+        return forwarded

@@ -1,0 +1,240 @@
+"""Load-balancing strategies.
+
+Parity target: ``happysimulator/components/load_balancer/strategies.py``
+(RoundRobin :50, WeightedRoundRobin :75, Random :137, LeastConnections :152,
+WeightedLeastConnections :189, LeastResponseTime :240, IPHash :294,
+ConsistentHash :336 hash-ring w/ vnodes, PowerOfTwoChoices :436).
+
+Rebuild design: strategies select from ``BackendInfo`` records maintained by
+the LoadBalancer (in-flight counts, EWMA response times, weights) instead of
+reaching into backend entity attributes — keeps strategies O(1)-stateful,
+deterministic, and independent of backend implementation details.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from abc import ABC, abstractmethod
+from bisect import bisect_right
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass
+class BackendInfo:
+    """Per-backend state the LoadBalancer maintains for strategies."""
+
+    backend: Entity
+    weight: float = 1.0
+    healthy: bool = True
+    in_flight: int = 0
+    total_requests: int = 0
+    total_failures: int = 0
+    consecutive_successes: int = 0
+    consecutive_failures: int = 0
+    response_time_ewma_s: float = 0.0
+    _ewma_initialized: bool = field(default=False, repr=False)
+
+    @property
+    def name(self) -> str:
+        return self.backend.name
+
+    def record_response_time(self, seconds: float, alpha: float = 0.3) -> None:
+        if not self._ewma_initialized:
+            self.response_time_ewma_s = seconds
+            self._ewma_initialized = True
+        else:
+            self.response_time_ewma_s += alpha * (seconds - self.response_time_ewma_s)
+
+
+class LoadBalancingStrategy(ABC):
+    """Chooses a backend for each request."""
+
+    @abstractmethod
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        """Pick a backend from the (healthy) candidates, or None."""
+
+    def on_backends_changed(self, backends: list[BackendInfo]) -> None:
+        """Notification hook for ring-building strategies."""
+
+
+class RoundRobin(LoadBalancingStrategy):
+    """Cycle through backends in order."""
+
+    def __init__(self) -> None:
+        self._index = 0
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        choice = backends[self._index % len(backends)]
+        self._index += 1
+        return choice
+
+    def reset(self) -> None:
+        self._index = 0
+
+
+class WeightedRoundRobin(LoadBalancingStrategy):
+    """Smooth weighted round-robin (nginx algorithm): each pick adds weight
+    to a running credit and selects the highest-credit backend."""
+
+    def __init__(self) -> None:
+        self._credit: dict[str, float] = {}
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        total = 0.0
+        best: Optional[BackendInfo] = None
+        for info in backends:
+            weight = max(info.weight, 0.0)
+            total += weight
+            self._credit[info.name] = self._credit.get(info.name, 0.0) + weight
+            if best is None or self._credit[info.name] > self._credit[best.name]:
+                best = info
+        if best is not None:
+            self._credit[best.name] -= total
+        return best
+
+
+class Random(LoadBalancingStrategy):
+    """Uniform random choice (seeded)."""
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        self._rng = random.Random(seed)
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        return self._rng.choice(backends)
+
+
+class LeastConnections(LoadBalancingStrategy):
+    """Backend with the fewest in-flight requests (first wins ties)."""
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        return min(backends, key=lambda info: info.in_flight)
+
+
+class WeightedLeastConnections(LoadBalancingStrategy):
+    """Minimize in_flight / weight."""
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+
+        def score(info: BackendInfo) -> float:
+            if info.weight <= 0:
+                return float("inf")
+            return info.in_flight / info.weight
+
+        return min(backends, key=score)
+
+
+class LeastResponseTime(LoadBalancingStrategy):
+    """Backend with the lowest EWMA response time; cold backends first."""
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        cold = [info for info in backends if info.total_requests == 0]
+        if cold:
+            return cold[0]
+        return min(backends, key=lambda info: info.response_time_ewma_s)
+
+
+def _default_request_key(request: Event) -> Optional[str]:
+    metadata = request.context.get("metadata", {})
+    for key in ("client_ip", "session_id", "key", "client"):
+        if key in metadata and metadata[key] is not None:
+            return str(metadata[key])
+    return None
+
+
+class IPHash(LoadBalancingStrategy):
+    """Deterministic backend per request key (session affinity)."""
+
+    def __init__(self, get_key: Optional[Callable[[Event], Optional[str]]] = None) -> None:
+        self._get_key = get_key or _default_request_key
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        key = self._get_key(request)
+        if key is None:
+            return backends[0]
+        digest = hashlib.md5(key.encode()).digest()
+        return backends[int.from_bytes(digest[:8], "big") % len(backends)]
+
+
+class ConsistentHash(LoadBalancingStrategy):
+    """Hash ring with virtual nodes: adding/removing a backend only remaps
+    ~1/n of the keyspace."""
+
+    def __init__(
+        self,
+        virtual_nodes: int = 150,
+        get_key: Optional[Callable[[Event], Optional[str]]] = None,
+    ) -> None:
+        if virtual_nodes < 1:
+            raise ValueError("virtual_nodes must be >= 1")
+        self.virtual_nodes = virtual_nodes
+        self._get_key = get_key or _default_request_key
+        self._ring: list[tuple[int, str]] = []
+        self._ring_names: set[str] = set()
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+    def on_backends_changed(self, backends: list[BackendInfo]) -> None:
+        self._ring = []
+        self._ring_names = {info.name for info in backends}
+        for info in backends:
+            for v in range(self.virtual_nodes):
+                self._ring.append((self._hash(f"{info.name}#{v}"), info.name))
+        self._ring.sort()
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        by_name = {info.name: info for info in backends}
+        if set(by_name) != self._ring_names:
+            self.on_backends_changed(backends)
+        key = self._get_key(request)
+        if key is None:
+            return backends[0]
+        point = self._hash(key)
+        # Walk clockwise from the hash point to the first *available* backend
+        # (the ring may include names filtered out by health).
+        positions = [h for h, _ in self._ring]
+        start = bisect_right(positions, point)
+        for offset in range(len(self._ring)):
+            _, name = self._ring[(start + offset) % len(self._ring)]
+            info = by_name.get(name)
+            if info is not None:
+                return info
+        return None
+
+
+class PowerOfTwoChoices(LoadBalancingStrategy):
+    """Sample two random backends, pick the less loaded — near-optimal load
+    spread at O(1) cost (Mitzenmacher)."""
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        self._rng = random.Random(seed)
+
+    def select(self, backends: list[BackendInfo], request: Event) -> Optional[BackendInfo]:
+        if not backends:
+            return None
+        if len(backends) == 1:
+            return backends[0]
+        a, b = self._rng.sample(backends, 2)
+        return a if a.in_flight <= b.in_flight else b

@@ -70,8 +70,19 @@ class Queue(Entity):
     def _handle_enqueue(self, event: Event):
         if self.capacity is not None and self.depth >= self.capacity:
             self.dropped += 1
+            # A dropped request never completes: discard its hooks so
+            # upstream clients observe a timeout, not an instant response.
+            event.on_complete = []
             return None
         was_empty = self.depth == 0
+        # Defer completion hooks until the item is actually serviced: stash
+        # them in the (shared) context so invoke()'s hook pass at enqueue
+        # time sees none; the driver re-attaches them to the work event.
+        # (The reference fires hooks at enqueue — a latency-accounting gap
+        # its own tests sidestep by only hooking non-queued entities.)
+        if event.on_complete:
+            event.context.setdefault("_deferred_hooks", []).extend(event.on_complete)
+            event.on_complete = []
         self.policy.push(event)
         self.enqueued += 1
         if was_empty and self.driver is not None:

@@ -52,6 +52,7 @@ class QueueDriver(Entity):
             daemon=payload.daemon,
             context=payload.context,
         )
+        work.on_complete.extend(payload.context.pop("_deferred_hooks", []))
         work.on_complete.extend(payload.on_complete)
         # When the worker finishes this item, pull the next one.
         work.add_completion_hook(self._on_worker_done)

@@ -1,0 +1,178 @@
+"""Unit tests: client retries/timeouts, connection pool, pooled client."""
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Event,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+)
+from happysim_tpu.components.client import (
+    Client,
+    ConnectionPool,
+    DecorrelatedJitter,
+    ExponentialBackoff,
+    FixedRetry,
+    NoRetry,
+    PooledClient,
+)
+from happysim_tpu.core.entity import Entity
+
+
+class _BlackHole(Entity):
+    """Swallows requests without completing them (forces client timeouts)."""
+
+    def __init__(self):
+        super().__init__("blackhole")
+        self.received = 0
+
+    def handle_event(self, event):
+        self.received += 1
+        yield 1e9  # never finishes within any test horizon
+
+
+class TestRetryPolicies:
+    def test_no_retry(self):
+        p = NoRetry()
+        assert not p.should_retry(1)
+
+    def test_fixed(self):
+        p = FixedRetry(max_attempts=3, delay_s=0.5)
+        assert p.should_retry(1) and p.should_retry(2) and not p.should_retry(3)
+        assert p.delay(1) == 0.5
+
+    def test_exponential(self):
+        p = ExponentialBackoff(max_attempts=5, initial_delay=0.1, max_delay=0.5)
+        assert p.delay(1) == pytest.approx(0.1)
+        assert p.delay(2) == pytest.approx(0.2)
+        assert p.delay(4) == pytest.approx(0.5)  # capped
+
+    def test_exponential_jitter_seeded(self):
+        a = ExponentialBackoff(jitter=True, seed=7)
+        b = ExponentialBackoff(jitter=True, seed=7)
+        assert [a.delay(i) for i in (1, 2)] == [b.delay(i) for i in (1, 2)]
+
+    def test_decorrelated_jitter_bounded(self):
+        p = DecorrelatedJitter(max_attempts=10, base_delay=0.1, max_delay=1.0, seed=1)
+        for attempt in range(1, 9):
+            assert 0.1 <= p.delay(attempt) <= 1.0
+
+
+class TestClient:
+    def test_success_response(self):
+        server = Server("s", concurrency=1, service_time=ConstantLatency(0.2))
+        client = Client("c", target=server, timeout=5.0)
+        sim = Simulation(entities=[server, client])
+        sim.schedule(client.send_request(payload={"k": 1}, at=Instant.Epoch))
+        sim.run()
+        assert client.responses_received == 1
+        assert client.timeouts == 0
+        assert client.in_flight_count == 0
+        assert client.average_response_time == pytest.approx(0.2)
+
+    def test_timeout_no_retry_fails(self):
+        hole = _BlackHole()
+        failures = []
+        client = Client(
+            "c",
+            target=hole,
+            timeout=1.0,
+            on_failure=lambda req, reason: failures.append(reason),
+        )
+        sim = Simulation(entities=[hole, client], duration=10.0)
+        sim.schedule(client.send_request(at=Instant.Epoch))
+        sim.run()
+        assert client.timeouts == 1
+        assert client.failures == 1
+        assert failures == ["timeout"]
+
+    def test_timeout_retries_then_fails(self):
+        hole = _BlackHole()
+        client = Client(
+            "c", target=hole, timeout=1.0, retry_policy=FixedRetry(max_attempts=3, delay_s=0.1)
+        )
+        sim = Simulation(entities=[hole, client], duration=30.0)
+        sim.schedule(client.send_request(at=Instant.Epoch))
+        sim.run()
+        assert client.requests_sent == 3
+        assert client.retries == 2
+        assert client.timeouts == 3
+        assert client.failures == 1
+        assert hole.received == 3
+
+    def test_percentiles(self):
+        server = Server("s", concurrency=10, service_time=ConstantLatency(0.1))
+        client = Client("c", target=server)
+        sim = Simulation(entities=[server, client])
+        sim.schedule([client.send_request(at=Instant.Epoch) for _ in range(10)])
+        sim.run()
+        assert client.response_time_percentile(0.5) == pytest.approx(0.1)
+
+
+class TestConnectionPool:
+    def test_dial_then_reuse(self):
+        sink = Sink()
+        pool = ConnectionPool(
+            "pool", target=sink, max_connections=2, connect_latency=ConstantLatency(0.05)
+        )
+        client = PooledClient("pc", connection_pool=pool)
+        sim = Simulation(entities=[sink, pool, client])
+        sim.schedule(client.send_request(at=Instant.Epoch))
+        sim.run()
+        assert client.responses_received == 1
+        assert pool.connections_created == 1
+        assert pool.idle_connections == 1
+        # Second request at a later time reuses the idle connection.
+        sim2_sink = Sink()
+        pool2 = ConnectionPool(
+            "pool2", target=sim2_sink, max_connections=2, connect_latency=ConstantLatency(0.05)
+        )
+        client2 = PooledClient("pc2", connection_pool=pool2)
+        sim2 = Simulation(entities=[sim2_sink, pool2, client2])
+        sim2.schedule(
+            [client2.send_request(at=Instant.Epoch), client2.send_request(at=Instant.from_seconds(1.0))]
+        )
+        sim2.run()
+        assert pool2.connections_created == 1
+        assert pool2.reuses == 1
+
+    def test_pool_exhaustion_queues_waiters(self):
+        server = Server("s", concurrency=10, service_time=ConstantLatency(0.5))
+        pool = ConnectionPool("pool", target=server, max_connections=1)
+        client = PooledClient("pc", connection_pool=pool)
+        sim = Simulation(entities=[server, pool, client])
+        sim.schedule([client.send_request(at=Instant.Epoch) for _ in range(3)])
+        sim.run()
+        # One connection serializes the three 0.5s requests.
+        assert client.responses_received == 3
+        assert pool.connections_created == 1
+        assert pool.waits == 2
+        assert sim.now.to_seconds() == pytest.approx(1.5)
+
+    def test_pooled_client_timeout_closes_connection(self):
+        hole = _BlackHole()
+        pool = ConnectionPool("pool", target=hole, max_connections=1)
+        client = PooledClient("pc", connection_pool=pool, timeout=0.5)
+        sim = Simulation(entities=[hole, pool, client], duration=5.0)
+        sim.schedule(client.send_request(at=Instant.Epoch))
+        sim.run()
+        assert client.timeouts == 1
+        assert pool.stats.connections_closed == 1
+        assert pool.total_connections == 0
+
+    def test_warmup(self):
+        sink = Sink()
+        pool = ConnectionPool(
+            "pool",
+            target=sink,
+            min_connections=3,
+            max_connections=5,
+            connect_latency=ConstantLatency(0.01),
+        )
+        sim = Simulation(entities=[sink, pool], duration=1.0)
+        sim.schedule(pool.warmup())
+        sim.run()
+        assert pool.idle_connections == 3

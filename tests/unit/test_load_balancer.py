@@ -1,0 +1,222 @@
+"""Unit tests: load-balancing strategies, LB routing, health checking."""
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Event,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+)
+from happysim_tpu.components.load_balancer import (
+    BackendInfo,
+    ConsistentHash,
+    HealthChecker,
+    IPHash,
+    LeastConnections,
+    LeastResponseTime,
+    LoadBalancer,
+    PowerOfTwoChoices,
+    Random,
+    RoundRobin,
+    WeightedLeastConnections,
+    WeightedRoundRobin,
+)
+from happysim_tpu.core.entity import Entity
+
+
+def _request(key=None, at=0.0):
+    context = {"metadata": {}}
+    if key is not None:
+        context["metadata"]["client_ip"] = key
+    return Event(Instant.from_seconds(at), "request", target=_NULL, context=context)
+
+
+class _Null(Entity):
+    def __init__(self):
+        super().__init__("null")
+
+    def handle_event(self, event):
+        return None
+
+
+_NULL = _Null()
+
+
+def _infos(n, **kwargs):
+    return [BackendInfo(backend=_NamedEntity(f"b{i}"), **kwargs) for i in range(n)]
+
+
+class _NamedEntity(Entity):
+    def handle_event(self, event):
+        return None
+
+
+class TestStrategies:
+    def test_round_robin_cycles(self):
+        s = RoundRobin()
+        infos = _infos(3)
+        picks = [s.select(infos, _request()).name for _ in range(6)]
+        assert picks == ["b0", "b1", "b2", "b0", "b1", "b2"]
+
+    def test_weighted_round_robin_proportional(self):
+        s = WeightedRoundRobin()
+        infos = _infos(2)
+        infos[0].weight = 3.0
+        infos[1].weight = 1.0
+        picks = [s.select(infos, _request()).name for _ in range(8)]
+        assert picks.count("b0") == 6
+        assert picks.count("b1") == 2
+
+    def test_random_seeded_deterministic(self):
+        infos = _infos(4)
+        a = [Random(seed=3).select(infos, _request()).name for _ in range(5)]
+        b = [Random(seed=3).select(infos, _request()).name for _ in range(5)]
+        assert a == b
+
+    def test_least_connections(self):
+        infos = _infos(3)
+        infos[0].in_flight = 5
+        infos[1].in_flight = 1
+        infos[2].in_flight = 3
+        assert LeastConnections().select(infos, _request()).name == "b1"
+
+    def test_weighted_least_connections(self):
+        infos = _infos(2)
+        infos[0].in_flight = 4
+        infos[0].weight = 4.0  # score 1.0
+        infos[1].in_flight = 2
+        infos[1].weight = 1.0  # score 2.0
+        assert WeightedLeastConnections().select(infos, _request()).name == "b0"
+
+    def test_least_response_time_prefers_cold_then_fast(self):
+        s = LeastResponseTime()
+        infos = _infos(2)
+        infos[0].total_requests = 1
+        infos[0].record_response_time(0.5)
+        assert s.select(infos, _request()).name == "b1"  # cold backend first
+        infos[1].total_requests = 1
+        infos[1].record_response_time(0.1)
+        assert s.select(infos, _request()).name == "b1"
+
+    def test_ip_hash_stable(self):
+        s = IPHash()
+        infos = _infos(5)
+        picks = {s.select(infos, _request(key="10.0.0.7")).name for _ in range(10)}
+        assert len(picks) == 1
+
+    def test_consistent_hash_minimal_remap(self):
+        s = ConsistentHash(virtual_nodes=100)
+        infos = _infos(5)
+        keys = [f"user-{i}" for i in range(200)]
+        before = {k: s.select(infos, _request(key=k)).name for k in keys}
+        # Remove one backend: only its keys should move.
+        survivors = [i for i in infos if i.name != "b2"]
+        after = {k: s.select(survivors, _request(key=k)).name for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        assert all(before[k] == "b2" for k in moved)
+        assert any(before[k] == "b2" for k in keys)
+
+    def test_power_of_two_choices_prefers_less_loaded(self):
+        s = PowerOfTwoChoices(seed=0)
+        infos = _infos(2)
+        infos[0].in_flight = 100
+        for _ in range(10):
+            assert s.select(infos, _request()).name == "b1"
+
+    def test_empty_backends(self):
+        for s in [RoundRobin(), Random(seed=0), LeastConnections(), IPHash()]:
+            assert s.select([], _request()) is None
+
+
+class TestLoadBalancer:
+    def _fleet(self, n=3, service=0.1, strategy=None):
+        sink = Sink()
+        servers = [
+            Server(f"s{i}", concurrency=4, service_time=ConstantLatency(service), downstream=sink)
+            for i in range(n)
+        ]
+        lb = LoadBalancer("lb", backends=servers, strategy=strategy or RoundRobin())
+        return sink, servers, lb
+
+    def test_round_robin_distribution(self):
+        sink, servers, lb = self._fleet()
+        sim = Simulation(entities=[lb, sink, *servers])
+        sim.schedule([
+            Event(Instant.from_seconds(i * 0.01), "request", target=lb) for i in range(9)
+        ])
+        sim.run()
+        assert sink.events_received == 9
+        assert [s.requests_completed for s in servers] == [3, 3, 3]
+        assert lb.stats.requests_forwarded == 9
+
+    def test_unhealthy_backend_skipped(self):
+        sink, servers, lb = self._fleet()
+        lb.mark_unhealthy(servers[1])
+        sim = Simulation(entities=[lb, sink, *servers])
+        sim.schedule([
+            Event(Instant.from_seconds(i * 0.01), "request", target=lb) for i in range(8)
+        ])
+        sim.run()
+        assert servers[1].requests_completed == 0
+        assert sink.events_received == 8
+
+    def test_no_backends_rejects(self):
+        lb = LoadBalancer("lb", backends=[])
+        sim = Simulation(entities=[lb])
+        sim.schedule(Event(Instant.Epoch, "request", target=lb))
+        sim.run()
+        assert lb.stats.no_backend_available == 1
+
+    def test_in_flight_tracked_through_completion(self):
+        sink, servers, lb = self._fleet(n=2, service=1.0, strategy=LeastConnections())
+        sim = Simulation(entities=[lb, sink, *servers])
+        sim.schedule([
+            Event(Instant.from_seconds(i * 0.1), "request", target=lb) for i in range(4)
+        ])
+        sim.run()
+        # LeastConnections alternates between the two idle-then-busy servers.
+        assert [s.requests_completed for s in servers] == [2, 2]
+        for s in servers:
+            assert lb.backend_info(s).in_flight == 0
+
+    def test_response_time_ewma_recorded(self):
+        sink, servers, lb = self._fleet(n=2, service=0.25)
+        sim = Simulation(entities=[lb, sink, *servers])
+        sim.schedule([
+            Event(Instant.from_seconds(i * 1.0), "request", target=lb) for i in range(4)
+        ])
+        sim.run()
+        for s in servers:
+            assert lb.backend_info(s).response_time_ewma_s == pytest.approx(0.25)
+
+
+class TestHealthChecker:
+    def test_crash_detected_and_recovers(self):
+        sink, servers, lb = (None, None, None)
+        sink = Sink()
+        servers = [
+            Server(f"s{i}", concurrency=1, service_time=ConstantLatency(0.01), downstream=sink)
+            for i in range(2)
+        ]
+        lb = LoadBalancer("lb", backends=servers)
+        checker = HealthChecker(
+            "hc", lb, interval=0.5, unhealthy_threshold=2, healthy_threshold=2
+        )
+        sim = Simulation(entities=[lb, sink, *servers, checker], probes=[checker], duration=10.0)
+        # Crash s0 at t=1, revive at t=5 (via scheduled callbacks).
+        sim.schedule(
+            [
+                Event.once(Instant.from_seconds(1.0), lambda _: setattr(servers[0], "_crashed", True), "crash"),
+                Event.once(Instant.from_seconds(5.0), lambda _: setattr(servers[0], "_crashed", False), "revive"),
+                # Keep a primary event pending so the daemon-only
+                # auto-terminate doesn't end the run right after the revive.
+                Event.once(Instant.from_seconds(9.5), lambda _: None, "keepalive"),
+            ]
+        )
+        sim.run()
+        assert checker.stats.transitions_to_unhealthy == 1
+        assert checker.stats.transitions_to_healthy == 1
+        assert lb.backend_info(servers[0]).healthy
