@@ -68,6 +68,35 @@ from happysim_tpu.components.load_balancer import (
     WeightedLeastConnections,
     WeightedRoundRobin,
 )
+from happysim_tpu.components.queue_policies import (
+    AdaptiveLIFO,
+    CoDelQueue,
+    DeadlineQueue,
+    FairQueue,
+    REDQueue,
+    WeightedFairQueue,
+)
+from happysim_tpu.components.rate_limiter import (
+    AdaptivePolicy,
+    DistributedRateLimiter,
+    FixedWindowPolicy,
+    Inductor,
+    LeakyBucketPolicy,
+    NullRateLimiter,
+    RateLimitedEntity,
+    RateLimiterPolicy,
+    SharedCounterStore,
+    SlidingWindowPolicy,
+    TokenBucketPolicy,
+)
+from happysim_tpu.components.resilience import (
+    Bulkhead,
+    CircuitBreaker,
+    CircuitState,
+    Fallback,
+    Hedge,
+    TimeoutWrapper,
+)
 from happysim_tpu.core import (
     CallbackEntity,
     Clock,

@@ -14,6 +14,31 @@ from happysim_tpu.components.load_balancer import (
     HealthChecker,
     LoadBalancer,
 )
+from happysim_tpu.components.queue_policies import (
+    AdaptiveLIFO,
+    CoDelQueue,
+    DeadlineQueue,
+    FairQueue,
+    REDQueue,
+    WeightedFairQueue,
+)
+from happysim_tpu.components.rate_limiter import (
+    AdaptivePolicy,
+    DistributedRateLimiter,
+    Inductor,
+    NullRateLimiter,
+    RateLimitedEntity,
+    SharedCounterStore,
+    TokenBucketPolicy,
+)
+from happysim_tpu.components.resilience import (
+    Bulkhead,
+    CircuitBreaker,
+    CircuitState,
+    Fallback,
+    Hedge,
+    TimeoutWrapper,
+)
 from happysim_tpu.components.queue import Queue
 from happysim_tpu.components.queue_driver import QueueDriver
 from happysim_tpu.components.queue_policy import (
@@ -58,6 +83,25 @@ from happysim_tpu.components.network import (
 )
 
 __all__ = [
+    "AdaptiveLIFO",
+    "AdaptivePolicy",
+    "Bulkhead",
+    "CircuitBreaker",
+    "CircuitState",
+    "CoDelQueue",
+    "DeadlineQueue",
+    "DistributedRateLimiter",
+    "FairQueue",
+    "Fallback",
+    "Hedge",
+    "Inductor",
+    "NullRateLimiter",
+    "RateLimitedEntity",
+    "REDQueue",
+    "SharedCounterStore",
+    "TimeoutWrapper",
+    "TokenBucketPolicy",
+    "WeightedFairQueue",
     "Client",
     "ConnectionPool",
     "HealthChecker",

@@ -150,7 +150,15 @@ class Client(Entity):
                 time=finish_time,
                 event_type="_client_response",
                 target=self,
-                context={"metadata": {"request_id": request_id, "attempt": attempt}},
+                context={
+                    "metadata": {
+                        "request_id": request_id,
+                        "attempt": attempt,
+                        # Set when the request was dropped (queue overflow,
+                        # open circuit, crash) rather than serviced.
+                        "dropped": target_event.context["metadata"].get("dropped_by"),
+                    }
+                },
             )
 
         target_event.add_completion_hook(respond)
@@ -184,6 +192,9 @@ class Client(Entity):
             return None  # attempt already timed out
         if info["timeout_event"] is not None:
             info["timeout_event"].cancel()
+        if metadata.get("dropped"):
+            # A fast failure (drop/rejection), not a response: retry or fail.
+            return self._fail_attempt(key, info, reason=str(metadata["dropped"]))
         self.responses_received += 1
         self.response_times_s.append((self.now - info["start"]).to_seconds())
         on_success = info.get("on_success")
@@ -193,13 +204,16 @@ class Client(Entity):
 
     def _handle_timeout(self, event: Event):
         metadata = event.context["metadata"]
-        request_id = metadata["request_id"]
-        attempt = metadata.get("attempt", 1)
-        info = self._in_flight.pop((request_id, attempt), None)
+        key = (metadata["request_id"], metadata.get("attempt", 1))
+        info = self._in_flight.pop(key, None)
         if info is None:
             return None  # response already arrived
         self.timeouts += 1
+        return self._fail_attempt(key, info, reason="timeout")
 
+    def _fail_attempt(self, key: tuple[int, int], info: dict, reason: str):
+        """Shared failure path for timeouts and fast drops: retry or give up."""
+        request_id, attempt = key
         if self.retry_policy.should_retry(attempt):
             original = info["request"]
             retry_event = Event(
@@ -221,5 +235,5 @@ class Client(Entity):
         self.failures += 1
         on_failure = info.get("on_failure")
         if on_failure is not None:
-            on_failure(info["request"], "timeout")
+            on_failure(info["request"], reason)
         return None

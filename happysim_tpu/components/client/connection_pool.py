@@ -76,6 +76,9 @@ class ConnectionPool(Entity):
         self._idle: list[Connection] = []
         self._active: dict[int, Connection] = {}
         self._dialing = 0
+        self._abandoned_dials: set[int] = set()  # future ids whose caller gave up
+        self._next_dial_id = 0
+        self._dial_id_of: dict[int, int] = {}  # id(future) -> dial id
         self._waiters: list[_Waiter] = []
         self._next_id = 0
         self.connections_created = 0
@@ -160,6 +163,26 @@ class ConnectionPool(Entity):
             return [self._idle_check_event(connection)]
         return []
 
+    def cancel_acquire(self, future: SimFuture) -> None:
+        """Abandon a pending acquire (e.g. the caller timed out).
+
+        Covers both queued waiters and in-progress dials: an abandoned dial
+        still completes, but its connection goes to the next waiter or the
+        idle list instead of being orphaned as active. No-op if the future
+        already resolved.
+        """
+        dial_id = self._dial_id_of.pop(id(future), None)
+        if dial_id is not None:
+            self._abandoned_dials.add(dial_id)
+            return
+        for waiter in self._waiters:
+            if waiter.future is future:
+                waiter.cancelled = True
+                return
+
+    # Backwards-compatible alias.
+    cancel_waiter = cancel_acquire
+
     def close(self, connection: Connection) -> list[Event]:
         """Discard a (broken) connection instead of returning it."""
         self._active.pop(connection.id, None)
@@ -179,14 +202,25 @@ class ConnectionPool(Entity):
     def _dial(self) -> tuple[SimFuture, list[Event]]:
         future = SimFuture()
         self._dialing += 1
+        self._next_dial_id += 1
+        dial_id = self._next_dial_id
+        self._dial_id_of[id(future)] = dial_id
         latency = self.connect_latency.get_latency(self.now)
 
         def finish(_: Event):
             self._dialing -= 1
+            self._dial_id_of.pop(id(future), None)
             conn = self._new_connection()
+            if dial_id in self._abandoned_dials:
+                # Caller gave up while we dialed: don't orphan the
+                # connection — hand it to the next waiter or park it idle.
+                self._abandoned_dials.discard(dial_id)
+                self._active[conn.id] = conn
+                return self.release(conn)
             conn.uses += 1
             self._active[conn.id] = conn
             future.resolve(conn)
+            return None
 
         return future, [Event.once(self.now + latency, finish, "_pool_dial", daemon=False)]
 

@@ -81,9 +81,33 @@ class PooledClient(Entity):
             self.retries += 1
         self.in_flight += 1
 
-        # 1. Acquire a connection (pool may dial or make us wait).
+        # The deadline covers the WHOLE request: connection acquire + send.
+        timeout_future = SimFuture()
+        timeout_event = None
+        if self.timeout is not None:
+            timeout_event = Event.once(
+                self.now + self.timeout,
+                lambda _: timeout_future.resolve("timeout"),
+                "_pooled_timeout",
+                daemon=True,
+            )
+
+        # 1. Acquire a connection (pool may dial or make us wait), racing
+        #    the deadline so an exhausted pool can't hang the request.
         acquire_future, dial_events = self.pool.acquire()
-        conn = yield acquire_future, dial_events
+        if timeout_event is not None:
+            index, value = yield (
+                any_of(acquire_future, timeout_future),
+                [*dial_events, timeout_event],
+            )
+            if index == 1:  # timed out while waiting for a connection
+                self.pool.cancel_acquire(acquire_future)
+                self.in_flight -= 1
+                self.timeouts += 1
+                return self._retry_or_fail(metadata, attempt)
+            conn = value
+        else:
+            conn = yield acquire_future, dial_events
 
         # 2. Send the request; the response future settles when the target's
         #    full processing chain completes.
@@ -96,18 +120,11 @@ class PooledClient(Entity):
         )
         target_event.add_completion_hook(lambda t: response_future.resolve(t) or None)
 
-        if self.timeout is None:
+        if timeout_event is None:
             yield response_future, [target_event]
             timed_out = False
         else:
-            timeout_future = SimFuture()
-            timeout_event = Event.once(
-                self.now + self.timeout,
-                lambda _: timeout_future.resolve("timeout"),
-                "_pooled_timeout",
-                daemon=True,
-            )
-            index, _ = yield any_of(response_future, timeout_future), [target_event, timeout_event]
+            index, _ = yield any_of(response_future, timeout_future), [target_event]
             timed_out = index == 1
             if not timed_out:
                 timeout_event.cancel()
@@ -121,15 +138,24 @@ class PooledClient(Entity):
         # 3. Timeout: the connection is suspect — close it, maybe retry.
         self.timeouts += 1
         produced = self.pool.close(conn)
+        retries = self._retry_or_fail(metadata, attempt)
+        return [*produced, *(retries or [])] or None
+
+    def _retry_or_fail(self, metadata: dict, attempt: int):
+        """Shared tail for every timeout path: schedule a retry or give up."""
         if self.retry_policy.should_retry(attempt):
-            retry = Event(
-                time=self.now + self.retry_policy.delay(attempt),
-                event_type="request",
-                target=self,
-                context={
-                    "metadata": {"payload": metadata.get("payload"), "attempt": attempt + 1}
-                },
-            )
-            return [*produced, retry]
+            return [
+                Event(
+                    time=self.now + self.retry_policy.delay(attempt),
+                    event_type="request",
+                    target=self,
+                    context={
+                        "metadata": {
+                            "payload": metadata.get("payload"),
+                            "attempt": attempt + 1,
+                        }
+                    },
+                )
+            ]
         self.failures += 1
-        return produced
+        return None

@@ -25,6 +25,7 @@ class LoadBalancerStats:
     requests_received: int
     requests_forwarded: int
     requests_rejected: int
+    requests_failed: int
     no_backend_available: int
     backends_marked_unhealthy: int
     backends_marked_healthy: int
@@ -54,6 +55,7 @@ class LoadBalancer(Entity):
         self.requests_received = 0
         self.requests_forwarded = 0
         self.requests_rejected = 0
+        self.requests_failed = 0
         self.no_backend_available = 0
         self.backends_marked_unhealthy = 0
         self.backends_marked_healthy = 0
@@ -107,6 +109,7 @@ class LoadBalancer(Entity):
             requests_received=self.requests_received,
             requests_forwarded=self.requests_forwarded,
             requests_rejected=self.requests_rejected,
+            requests_failed=self.requests_failed,
             no_backend_available=self.no_backend_available,
             backends_marked_unhealthy=self.backends_marked_unhealthy,
             backends_marked_healthy=self.backends_marked_healthy,
@@ -123,7 +126,7 @@ class LoadBalancer(Entity):
         if choice is None:
             self.no_backend_available += 1
             self.requests_rejected += 1
-            return None
+            return event.complete_as_dropped(self.now, self.name) or None
 
         choice.in_flight += 1
         choice.total_requests += 1
@@ -132,11 +135,19 @@ class LoadBalancer(Entity):
 
         def on_complete(finish_time: Instant):
             choice.in_flight -= 1
-            choice.consecutive_successes += 1
-            choice.consecutive_failures = 0
-            choice.record_response_time(
-                (finish_time - start).to_seconds(), self.response_time_alpha
-            )
+            metadata = forwarded.context.get("metadata", {})
+            failed = bool(metadata.get("dropped_by") or metadata.get("error"))
+            if failed:
+                self.requests_failed += 1
+                choice.total_failures += 1
+                choice.consecutive_failures += 1
+                choice.consecutive_successes = 0
+            else:
+                choice.consecutive_successes += 1
+                choice.consecutive_failures = 0
+                choice.record_response_time(
+                    (finish_time - start).to_seconds(), self.response_time_alpha
+                )
             return None
 
         forwarded.add_completion_hook(on_complete)

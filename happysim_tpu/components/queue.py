@@ -38,6 +38,11 @@ class Queue(Entity):
 
             policy = FIFOQueue()
         self.policy = policy
+        # Policies that drop items internally (CoDel at dequeue, expired
+        # deadlines) report each victim so its completion hooks unwind.
+        self._pending_drop_events: list[Event] = []
+        if hasattr(policy, "on_drop"):
+            policy.on_drop = self._on_policy_drop
         self.capacity = capacity
         self.driver: Optional[Entity] = None
         self.enqueued = 0
@@ -48,6 +53,12 @@ class Queue(Entity):
     # -- wiring ------------------------------------------------------------
     def connect_driver(self, driver: Entity) -> None:
         self.driver = driver
+
+    def set_clock(self, clock) -> None:
+        super().set_clock(clock)
+        # Time-aware policies (CoDel, DeadlineQueue) need the sim clock.
+        if hasattr(self.policy, "set_clock"):
+            self.policy.set_clock(lambda: clock.now)
 
     @property
     def depth(self) -> int:
@@ -70,49 +81,73 @@ class Queue(Entity):
     def _handle_enqueue(self, event: Event):
         if self.capacity is not None and self.depth >= self.capacity:
             self.dropped += 1
-            # A dropped request never completes: discard its hooks so
-            # upstream clients observe a timeout, not an instant response.
-            event.on_complete = []
-            return None
+            # A dropped request never gets serviced; unwind its hooks as a
+            # drop so upstream wrappers release permits/in-flight counts.
+            return event.complete_as_dropped(self.now, self.name)
         was_empty = self.depth == 0
+        accepted = self.policy.push(event)
+        if accepted is False:  # policy-level rejection (RED, bounded policies)
+            self.dropped += 1
+            return event.complete_as_dropped(self.now, self.name)
         # Defer completion hooks until the item is actually serviced: stash
-        # them in the (shared) context so invoke()'s hook pass at enqueue
-        # time sees none; the driver re-attaches them to the work event.
-        # (The reference fires hooks at enqueue — a latency-accounting gap
-        # its own tests sidestep by only hooking non-queued entities.)
+        # them in the context so invoke()'s hook pass at enqueue time sees
+        # none; the driver re-attaches them to the work event. (The
+        # reference fires hooks at enqueue — a latency-accounting gap its
+        # own tests sidestep by only hooking non-queued entities.)
         if event.on_complete:
             event.context.setdefault("_deferred_hooks", []).extend(event.on_complete)
             event.on_complete = []
-        self.policy.push(event)
         self.enqueued += 1
         if was_empty and self.driver is not None:
             return [Event(self.now, QUEUE_NOTIFY, target=self.driver)]
         return None
 
-    def _handle_poll(self, event: Event):
-        if self.depth == 0 or self.driver is None:
-            return None
-        payload = self.policy.pop()
-        self.dequeued += 1
-        deliver = Event(self.now, QUEUE_DELIVER, target=self.driver)
-        deliver.context["payload"] = payload
-        return [deliver]
+    def _on_policy_drop(self, item) -> None:
+        if isinstance(item, Event):
+            self.dropped += 1
+            self._pending_drop_events.extend(item.complete_as_dropped(self.now, self.name))
 
-    def requeue(self, payload: Event) -> None:
+    def _handle_poll(self, event: Event):
+        if self.driver is None:
+            return None
+        produced: list[Event] = []
+        # A policy pop may drop items internally (CoDel, expired deadlines)
+        # and return None even when the queue was non-empty before the call.
+        payload = self.policy.pop() if self.depth > 0 else None
+        produced.extend(self._pending_drop_events)
+        self._pending_drop_events = []
+        if payload is not None:
+            self.dequeued += 1
+            deliver = Event(self.now, QUEUE_DELIVER, target=self.driver)
+            deliver.context["payload"] = payload
+            produced.append(deliver)
+        return produced or None
+
+    def requeue(self, payload: Event) -> list[Event]:
         """Return a popped-but-undeliverable item to the head of the queue.
 
         Used by the driver when the worker filled up between poll and
         delivery (same-instant burst arrivals). FIFO puts it back at the
-        front; other policies re-push (priority order is recomputed).
+        front; other policies re-push (priority order is recomputed). A
+        policy that rejects the re-push (RED under congestion) turns the
+        requeue into a drop, with hooks unwound.
         """
         from happysim_tpu.components.queue_policy import FIFOQueue
 
-        self.dequeued -= 1
-        self.requeued += 1
         if isinstance(self.policy, FIFOQueue):
             self.policy._items.appendleft(payload)
         else:
-            self.policy.push(payload)
+            accepted = self.policy.push(payload)
+            if accepted is False:
+                # Undo the poll's dequeue count: the item's final fate is
+                # "dropped", not "dequeued" (keeps enqueued == dequeued +
+                # depth + dropped).
+                self.dequeued -= 1
+                self.dropped += 1
+                return payload.complete_as_dropped(self.now, self.name)
+        self.dequeued -= 1
+        self.requeued += 1
+        return []
 
     def downstream_entities(self):
         return [self.driver] if self.driver is not None else []

@@ -43,8 +43,7 @@ class QueueDriver(Entity):
         # The worker may have filled up between our poll and this delivery
         # (same-instant bursts): give the item back rather than overflow.
         if not self.worker.has_capacity():
-            self.queue.requeue(payload)
-            return None
+            return self.queue.requeue(payload) or None
         work = Event(
             time=self.now,
             event_type=payload.event_type,

@@ -1,0 +1,38 @@
+"""Active queue management and scheduling disciplines.
+
+Parity target: ``happysimulator/components/queue_policies/`` — CoDel :50,
+RED :37, FairQueue :38, WeightedFairQueue :49 (virtual time), DeadlineQueue
+:50 (EDF), AdaptiveLIFO :36.
+
+Contract extensions over the basic :class:`QueuePolicy`:
+- ``push`` may return ``False`` to reject (RED's probabilistic drop, bounded
+  capacities); ``None``/``True`` mean accepted.
+- ``pop`` may return ``None`` after internal drops (CoDel, expired
+  deadlines) even when ``len() > 0`` was true before the call.
+- Time-aware policies receive the simulation clock via
+  ``set_clock(clock_func)``, propagated by the owning ``Queue``.
+"""
+
+from happysim_tpu.components.queue_policies.adaptive_lifo import AdaptiveLIFO
+from happysim_tpu.components.queue_policies.codel import CoDelQueue, CoDelStats
+from happysim_tpu.components.queue_policies.deadline_queue import (
+    DeadlineQueue,
+    DeadlineQueueStats,
+)
+from happysim_tpu.components.queue_policies.fair_queue import (
+    FairQueue,
+    WeightedFairQueue,
+)
+from happysim_tpu.components.queue_policies.red import REDQueue, REDStats
+
+__all__ = [
+    "AdaptiveLIFO",
+    "CoDelQueue",
+    "CoDelStats",
+    "DeadlineQueue",
+    "DeadlineQueueStats",
+    "FairQueue",
+    "REDQueue",
+    "REDStats",
+    "WeightedFairQueue",
+]

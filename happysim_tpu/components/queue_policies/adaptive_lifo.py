@@ -1,0 +1,79 @@
+"""Adaptive LIFO: FIFO normally, LIFO under congestion.
+
+Parity target: ``happysimulator/components/queue_policies/adaptive_lifo.py:36``.
+
+Facebook's adaptive-LIFO insight: under overload, the newest requests are
+the ones whose clients are still waiting — serving them LIFO yields more
+useful work than draining a stale FIFO backlog. Switches to LIFO when depth
+crosses ``congestion_threshold`` and back once it drains below the
+(hysteresis) ``recovery_threshold``.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+
+
+class AdaptiveLIFO(QueuePolicy):
+    def __init__(
+        self,
+        congestion_threshold: int = 100,
+        recovery_threshold: Optional[int] = None,
+        capacity: Optional[int] = None,
+    ):
+        if congestion_threshold < 1:
+            raise ValueError("congestion_threshold must be >= 1")
+        self.congestion_threshold = congestion_threshold
+        self.recovery_threshold = (
+            recovery_threshold if recovery_threshold is not None else congestion_threshold // 2
+        )
+        self.capacity = capacity
+        self._items: deque[Any] = deque()
+        self._congested = False
+        self.mode_switches = 0
+        self.dropped = 0
+
+    @property
+    def is_congested(self) -> bool:
+        return self._congested
+
+    @property
+    def mode(self) -> str:
+        return "lifo" if self._congested else "fifo"
+
+    def _update_mode(self) -> None:
+        if not self._congested and len(self._items) >= self.congestion_threshold:
+            self._congested = True
+            self.mode_switches += 1
+        elif self._congested and len(self._items) <= self.recovery_threshold:
+            self._congested = False
+            self.mode_switches += 1
+
+    def push(self, item: Any):
+        if self.capacity is not None and len(self._items) >= self.capacity:
+            self.dropped += 1
+            return False
+        self._items.append(item)
+        self._update_mode()
+        return True
+
+    def pop(self) -> Any:
+        if not self._items:
+            return None
+        item = self._items.pop() if self._congested else self._items.popleft()
+        self._update_mode()
+        return item
+
+    def peek(self) -> Any:
+        if not self._items:
+            return None
+        return self._items[-1] if self._congested else self._items[0]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def clear(self) -> None:
+        self._items.clear()

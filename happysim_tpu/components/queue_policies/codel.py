@@ -1,0 +1,142 @@
+"""CoDel (Controlled Delay) active queue management.
+
+Parity target: ``happysimulator/components/queue_policies/codel.py:50``.
+
+Nichols & Jacobson's algorithm: track each item's sojourn time; once the
+*minimum* sojourn stays above ``target_delay`` for a full ``interval``,
+enter dropping mode and drop at a rate increasing with sqrt(drop count)
+(the control law ``interval / sqrt(n)``), until sojourn falls below target.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.core.temporal import Duration, Instant
+
+
+@dataclass(frozen=True)
+class CoDelStats:
+    pushed: int
+    popped: int
+    dropped: int
+    drop_mode_entries: int
+
+
+class CoDelQueue(QueuePolicy):
+    """FIFO with CoDel dropping at dequeue time."""
+
+    def __init__(
+        self,
+        target_delay: float = 0.005,
+        interval: float = 0.1,
+        capacity: Optional[int] = None,
+        clock_func: Optional[Callable[[], Instant]] = None,
+    ):
+        if target_delay <= 0 or interval <= 0:
+            raise ValueError("target_delay and interval must be positive")
+        self.target_delay = target_delay
+        self.interval = interval
+        self.capacity = capacity
+        self._clock_func = clock_func
+        self._items: deque[tuple[Instant, Any]] = deque()
+        self._first_above_time: Optional[Instant] = None
+        self._dropping = False
+        self._drop_next: Optional[Instant] = None
+        self._drop_count = 0
+        self.pushed = 0
+        self.popped = 0
+        self.dropped = 0
+        self.drop_mode_entries = 0
+        # Set by the owning Queue: called with each internally dropped item
+        # so its completion hooks unwind (permits, client accounting).
+        self.on_drop: Optional[Callable[[Any], None]] = None
+
+    def set_clock(self, clock_func: Callable[[], Instant]) -> None:
+        self._clock_func = clock_func
+
+    def _now(self) -> Instant:
+        if self._clock_func is None:
+            raise RuntimeError("CoDelQueue requires a clock (owning Queue sets it)")
+        return self._clock_func()
+
+    @property
+    def dropping(self) -> bool:
+        return self._dropping
+
+    @property
+    def stats(self) -> CoDelStats:
+        return CoDelStats(
+            pushed=self.pushed,
+            popped=self.popped,
+            dropped=self.dropped,
+            drop_mode_entries=self.drop_mode_entries,
+        )
+
+    def push(self, item: Any):
+        if self.capacity is not None and len(self._items) >= self.capacity:
+            self.dropped += 1
+            return False
+        self.pushed += 1
+        self._items.append((self._now(), item))
+        return True
+
+    def pop(self) -> Any:
+        while self._items:
+            now = self._now()
+            enqueue_time, item = self._items.popleft()
+            sojourn = (now - enqueue_time).to_seconds()
+            if self._should_drop(now, sojourn):
+                self.dropped += 1
+                if self.on_drop is not None:
+                    self.on_drop(item)
+                continue
+            self.popped += 1
+            return item
+        return None
+
+    def peek(self) -> Any:
+        return self._items[0][1] if self._items else None
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def clear(self) -> None:
+        self._items.clear()
+
+    # -- CoDel state machine ----------------------------------------------
+    def _should_drop(self, now: Instant, sojourn: float) -> bool:
+        if sojourn < self.target_delay or not self._items:
+            # Below target (or queue emptying): leave dropping state.
+            self._first_above_time = None
+            if self._dropping:
+                self._dropping = False
+            return False
+
+        if self._first_above_time is None:
+            self._first_above_time = now + Duration.from_seconds(self.interval)
+            return False
+
+        if self._dropping:
+            if self._drop_next is not None and now >= self._drop_next:
+                self._drop_count += 1
+                self._drop_next = now + self._control_law()
+                return True
+            return False
+
+        if now >= self._first_above_time:
+            # Sojourn exceeded target for a full interval: start dropping.
+            self._dropping = True
+            self.drop_mode_entries += 1
+            # Restart near the prior drop rate (standard CoDel refinement).
+            self._drop_count = max(self._drop_count - 2, 1) if self._drop_count > 2 else 1
+            self._drop_next = now + self._control_law()
+            return True
+        return False
+
+    def _control_law(self) -> Duration:
+        return Duration.from_seconds(self.interval / math.sqrt(self._drop_count))

@@ -1,0 +1,120 @@
+"""Earliest-deadline-first queue with expiry.
+
+Parity target: ``happysimulator/components/queue_policies/deadline_queue.py:50``
+(EDF ordering, expired items dropped at pop, ``purge_expired`` :185).
+
+Deadline extraction: ``get_deadline(item)`` if provided, else the event
+context metadata's ``deadline`` (an Instant or seconds float); items with no
+deadline sort last (infinite slack).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass(frozen=True)
+class DeadlineQueueStats:
+    pushed: int
+    popped: int
+    expired: int
+
+
+def _default_deadline(item: Any) -> Optional[float]:
+    if isinstance(item, Event):
+        deadline = item.context.get("metadata", {}).get("deadline")
+        if isinstance(deadline, Instant):
+            return deadline.to_seconds()
+        if deadline is not None:
+            return float(deadline)
+    return None
+
+
+class DeadlineQueue(QueuePolicy):
+    def __init__(
+        self,
+        get_deadline: Optional[Callable[[Any], Optional[float]]] = None,
+        drop_expired: bool = True,
+        clock_func: Optional[Callable[[], Instant]] = None,
+    ):
+        self._get_deadline = get_deadline or _default_deadline
+        self.drop_expired = drop_expired
+        self._clock_func = clock_func
+        self._heap: list[tuple[float, int, Any]] = []
+        self._tiebreak = itertools.count()
+        self.pushed = 0
+        self.popped = 0
+        self.expired = 0
+        # Set by the owning Queue: called with each expired item so its
+        # completion hooks unwind.
+        self.on_drop: Optional[Callable[[Any], None]] = None
+
+    def set_clock(self, clock_func: Callable[[], Instant]) -> None:
+        self._clock_func = clock_func
+
+    @property
+    def stats(self) -> DeadlineQueueStats:
+        return DeadlineQueueStats(pushed=self.pushed, popped=self.popped, expired=self.expired)
+
+    def _deadline_of(self, item: Any) -> float:
+        deadline = self._get_deadline(item)
+        return float("inf") if deadline is None else deadline
+
+    def _now_s(self) -> Optional[float]:
+        return self._clock_func().to_seconds() if self._clock_func is not None else None
+
+    def push(self, item: Any) -> None:
+        self.pushed += 1
+        heapq.heappush(self._heap, (self._deadline_of(item), next(self._tiebreak), item))
+
+    def pop(self) -> Any:
+        now_s = self._now_s()
+        while self._heap:
+            deadline, _, item = heapq.heappop(self._heap)
+            if self.drop_expired and now_s is not None and deadline < now_s:
+                self.expired += 1
+                if self.on_drop is not None:
+                    self.on_drop(item)
+                continue
+            self.popped += 1
+            return item
+        return None
+
+    def peek(self) -> Any:
+        return self._heap[0][2] if self._heap else None
+
+    def purge_expired(self) -> int:
+        """Drop every already-expired item; returns how many were dropped."""
+        now_s = self._now_s()
+        if now_s is None:
+            return 0
+        kept = [(d, t, i) for (d, t, i) in self._heap if d >= now_s]
+        purged = len(self._heap) - len(kept)
+        if purged:
+            if self.on_drop is not None:
+                for d, _, item in self._heap:
+                    if d < now_s:
+                        self.on_drop(item)
+            heapq.heapify(kept)
+            self._heap = kept
+            self.expired += purged
+        return purged
+
+    def count_expired(self) -> int:
+        now_s = self._now_s()
+        if now_s is None:
+            return 0
+        return sum(1 for (d, _, _) in self._heap if d < now_s)
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def clear(self) -> None:
+        self._heap.clear()

@@ -1,0 +1,136 @@
+"""Fair queueing disciplines.
+
+Parity target: ``happysimulator/components/queue_policies/fair_queue.py:38``
+(round-robin across flows) and ``weighted_fair_queue.py:49`` (virtual-time
+WFQ).
+
+Flow classification: ``flow_key(item)`` if provided, else the event context
+metadata's ``flow``/``client_ip``/``client`` field, else a single default
+flow.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict, deque
+from typing import Any, Callable, Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.core.event import Event
+
+
+def _default_flow_key(item: Any) -> str:
+    if isinstance(item, Event):
+        metadata = item.context.get("metadata", {})
+        for key in ("flow", "client_ip", "client"):
+            if metadata.get(key) is not None:
+                return str(metadata[key])
+    return "_default"
+
+
+class FairQueue(QueuePolicy):
+    """Per-flow FIFO lanes served round-robin — one greedy flow can't starve
+    the rest."""
+
+    def __init__(self, flow_key: Optional[Callable[[Any], str]] = None):
+        self._flow_key = flow_key or _default_flow_key
+        self._flows: "OrderedDict[str, deque]" = OrderedDict()
+        self._size = 0
+
+    def push(self, item: Any) -> None:
+        key = self._flow_key(item)
+        if key not in self._flows:
+            self._flows[key] = deque()
+        self._flows[key].append(item)
+        self._size += 1
+
+    def pop(self) -> Any:
+        if self._size == 0:
+            return None
+        # Serve the first flow, then rotate it to the back.
+        key, lane = next(iter(self._flows.items()))
+        item = lane.popleft()
+        self._size -= 1
+        del self._flows[key]
+        if lane:
+            self._flows[key] = lane  # re-append at the end (round robin)
+        return item
+
+    def peek(self) -> Any:
+        if self._size == 0:
+            return None
+        return next(iter(self._flows.values()))[0]
+
+    def __len__(self) -> int:
+        return self._size
+
+    def clear(self) -> None:
+        self._flows.clear()
+        self._size = 0
+
+    @property
+    def active_flows(self) -> int:
+        return len(self._flows)
+
+
+class WeightedFairQueue(QueuePolicy):
+    """Virtual-time WFQ: each item gets a virtual finish time
+
+        finish = max(virtual_now, last_finish[flow]) + cost / weight[flow]
+
+    and the smallest finish time is served first. Higher-weight flows drain
+    proportionally faster; within a flow, order is FIFO.
+    """
+
+    def __init__(
+        self,
+        weights: Optional[dict[str, float]] = None,
+        default_weight: float = 1.0,
+        flow_key: Optional[Callable[[Any], str]] = None,
+        cost: Optional[Callable[[Any], float]] = None,
+    ):
+        if default_weight <= 0:
+            raise ValueError("default_weight must be positive")
+        self.weights = dict(weights or {})
+        self.default_weight = default_weight
+        self._flow_key = flow_key or _default_flow_key
+        self._cost = cost or (lambda item: 1.0)
+        self._heap: list[tuple[float, int, Any]] = []
+        self._tiebreak = itertools.count()
+        self._virtual_now = 0.0
+        self._last_finish: dict[str, float] = {}
+
+    def set_weight(self, flow: str, weight: float) -> None:
+        if weight <= 0:
+            raise ValueError("weight must be positive")
+        self.weights[flow] = weight
+
+    def push(self, item: Any) -> None:
+        import heapq
+
+        key = self._flow_key(item)
+        weight = self.weights.get(key, self.default_weight)
+        start = max(self._virtual_now, self._last_finish.get(key, 0.0))
+        finish = start + self._cost(item) / weight
+        self._last_finish[key] = finish
+        heapq.heappush(self._heap, (finish, next(self._tiebreak), item))
+
+    def pop(self) -> Any:
+        import heapq
+
+        if not self._heap:
+            return None
+        finish, _, item = heapq.heappop(self._heap)
+        self._virtual_now = finish
+        return item
+
+    def peek(self) -> Any:
+        return self._heap[0][2] if self._heap else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def clear(self) -> None:
+        self._heap.clear()
+        self._last_finish.clear()
+        self._virtual_now = 0.0

@@ -1,0 +1,101 @@
+"""RED (Random Early Detection) queue.
+
+Parity target: ``happysimulator/components/queue_policies/red.py:37``.
+
+Drops arrivals probabilistically as the EWMA queue depth climbs between
+``min_threshold`` and ``max_threshold`` (probability ramps 0 → max_p), and
+always beyond ``max_threshold`` — signaling congestion before the buffer
+overflows.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+
+
+@dataclass(frozen=True)
+class REDStats:
+    pushed: int
+    popped: int
+    early_drops: int
+    forced_drops: int
+    avg_depth: float
+
+
+class REDQueue(QueuePolicy):
+    def __init__(
+        self,
+        min_threshold: int = 5,
+        max_threshold: int = 15,
+        max_p: float = 0.1,
+        weight: float = 0.2,
+        capacity: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        if not 0 < min_threshold < max_threshold:
+            raise ValueError("need 0 < min_threshold < max_threshold")
+        if not 0 < max_p <= 1 or not 0 < weight <= 1:
+            raise ValueError("max_p and weight must be in (0, 1]")
+        self.min_threshold = min_threshold
+        self.max_threshold = max_threshold
+        self.max_p = max_p
+        self.weight = weight
+        self.capacity = capacity
+        self._rng = random.Random(seed)
+        self._items: deque[Any] = deque()
+        self._avg = 0.0
+        self.pushed = 0
+        self.popped = 0
+        self.early_drops = 0
+        self.forced_drops = 0
+
+    @property
+    def average_depth(self) -> float:
+        return self._avg
+
+    @property
+    def stats(self) -> REDStats:
+        return REDStats(
+            pushed=self.pushed,
+            popped=self.popped,
+            early_drops=self.early_drops,
+            forced_drops=self.forced_drops,
+            avg_depth=self._avg,
+        )
+
+    def push(self, item: Any):
+        self._avg += self.weight * (len(self._items) - self._avg)
+        if self.capacity is not None and len(self._items) >= self.capacity:
+            self.forced_drops += 1
+            return False
+        if self._avg >= self.max_threshold:
+            self.forced_drops += 1
+            return False
+        if self._avg > self.min_threshold:
+            ramp = (self._avg - self.min_threshold) / (self.max_threshold - self.min_threshold)
+            if self._rng.random() < ramp * self.max_p:
+                self.early_drops += 1
+                return False
+        self.pushed += 1
+        self._items.append(item)
+        return True
+
+    def pop(self) -> Any:
+        if not self._items:
+            return None
+        self.popped += 1
+        return self._items.popleft()
+
+    def peek(self) -> Any:
+        return self._items[0] if self._items else None
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def clear(self) -> None:
+        self._items.clear()

@@ -1,0 +1,40 @@
+"""Rate limiting: policies, limiter entities, the Inductor, distributed limiting."""
+
+from happysim_tpu.components.rate_limiter.distributed import (
+    DistributedRateLimiter,
+    DistributedRateLimiterStats,
+    SharedCounterStore,
+)
+from happysim_tpu.components.rate_limiter.inductor import Inductor, InductorStats
+from happysim_tpu.components.rate_limiter.policy import (
+    AdaptivePolicy,
+    FixedWindowPolicy,
+    LeakyBucketPolicy,
+    RateLimiterPolicy,
+    RateSnapshot,
+    SlidingWindowPolicy,
+    TokenBucketPolicy,
+)
+from happysim_tpu.components.rate_limiter.rate_limited_entity import (
+    NullRateLimiter,
+    RateLimitedEntity,
+    RateLimiterStats,
+)
+
+__all__ = [
+    "AdaptivePolicy",
+    "DistributedRateLimiter",
+    "DistributedRateLimiterStats",
+    "FixedWindowPolicy",
+    "Inductor",
+    "InductorStats",
+    "LeakyBucketPolicy",
+    "NullRateLimiter",
+    "RateLimitedEntity",
+    "RateLimiterPolicy",
+    "RateLimiterStats",
+    "RateSnapshot",
+    "SharedCounterStore",
+    "SlidingWindowPolicy",
+    "TokenBucketPolicy",
+]

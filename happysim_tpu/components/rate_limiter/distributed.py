@@ -1,0 +1,133 @@
+"""Distributed rate limiting with a shared backing counter.
+
+Parity target: ``happysimulator/components/rate_limiter/distributed.py:67``
+(global windowed limit, local cache synced every ``sync_interval`` requests,
+round-trip latency to the backing store modeled as a generator delay).
+
+Multiple limiter nodes share one logical counter (e.g. Redis INCR). Each
+node batches ``sync_interval`` local admissions before paying the store
+round-trip, trading enforcement accuracy for latency — the classic
+distributed-limiter design tension this component exists to demonstrate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.distributions.latency_distribution import ConstantLatency, LatencyDistribution
+
+
+class SharedCounterStore:
+    """The logical shared counter (one per limiter group), windowed by time."""
+
+    def __init__(self) -> None:
+        self._windows: dict[int, int] = {}
+
+    def add(self, window_id: int, count: int) -> int:
+        """Add ``count`` to the window and return the new global total."""
+        self._windows[window_id] = self._windows.get(window_id, 0) + count
+        return self._windows[window_id]
+
+    def get(self, window_id: int) -> int:
+        return self._windows.get(window_id, 0)
+
+
+@dataclass(frozen=True)
+class DistributedRateLimiterStats:
+    received: int
+    admitted: int
+    rejected: int
+    store_syncs: int
+
+
+class DistributedRateLimiter(Entity):
+    """One node of a distributed limiter enforcing a global windowed limit."""
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        store: SharedCounterStore,
+        global_limit: int = 100,
+        window_size: float = 1.0,
+        sync_interval: int = 10,
+        store_latency: LatencyDistribution | None = None,
+    ):
+        super().__init__(name)
+        if global_limit < 1 or window_size <= 0 or sync_interval < 1:
+            raise ValueError("invalid limiter parameters")
+        self.downstream = downstream
+        self.store = store
+        self.global_limit = global_limit
+        self.window_size = window_size
+        self.sync_interval = sync_interval
+        self.store_latency = store_latency or ConstantLatency(0.001)
+        self._window_id: int | None = None
+        self._local_pending = 0  # admissions not yet pushed to the store
+        self._known_global = 0
+        self.received = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.store_syncs = 0
+
+    @property
+    def stats(self) -> DistributedRateLimiterStats:
+        return DistributedRateLimiterStats(
+            received=self.received,
+            admitted=self.admitted,
+            rejected=self.rejected,
+            store_syncs=self.store_syncs,
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    def _window_of(self, now: Instant) -> int:
+        return int(now.to_seconds() // self.window_size)
+
+    def _roll(self, now: Instant) -> None:
+        window = self._window_of(now)
+        if window != self._window_id:
+            self._window_id = window
+            self._local_pending = 0
+            self._known_global = self.store.get(window)
+
+    def handle_event(self, event: Event):
+        self.received += 1
+        self._roll(self.now)
+        window_id = self._window_id
+
+        if self._known_global + self._local_pending >= self.global_limit:
+            self.rejected += 1
+            event.context["metadata"]["rejected_by"] = self.name
+            return None
+
+        self._local_pending += 1
+        if self._local_pending < self.sync_interval:
+            # Admit on cached knowledge; no store round-trip.
+            self.admitted += 1
+            return [self.forward(event, self.downstream)]
+
+        # Sync point: pay the store round-trip, reconcile the global count.
+        delay = self.store_latency.get_latency(self.now).to_seconds()
+        pending = self._local_pending
+        yield delay
+        self.store_syncs += 1
+        new_total = self.store.add(window_id, pending)
+        if self._window_id != window_id:
+            # The window rolled during the round-trip: the pushed counts
+            # belong to the old window — don't poison the new window's view.
+            self.admitted += 1
+            return [self.forward(event, self.downstream)]
+        self._known_global = new_total
+        self._local_pending = 0
+        if new_total > self.global_limit:
+            # The fleet overshot while we batched: reject this request.
+            self.rejected += 1
+            event.context["metadata"]["rejected_by"] = self.name
+            return event.complete_as_dropped(self.now, self.name) or None
+        self.admitted += 1
+        return [self.forward(event, self.downstream)]

@@ -1,0 +1,153 @@
+"""Digital Inductor: configuration-free burst suppression.
+
+Parity target: ``happysimulator/components/rate_limiter/inductor.py:52``.
+
+The Inductor resists rapid *changes* in event rate rather than enforcing a
+cap — the electrical-inductor analogy from the reference README. It keeps an
+EWMA of inter-arrival intervals with a time-aware smoothing factor
+
+    alpha = 1 - exp(-dt / tau)
+
+(short gaps → small alpha → heavy smoothing; long gaps → fast adaptation).
+Arrivals are forwarded when at least the smoothed interval has elapsed since
+the last forward; the excess buffers in a bounded FIFO drained by
+self-scheduled polls.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass(frozen=True)
+class InductorStats:
+    received: int
+    forwarded: int
+    queued: int
+    dropped: int
+
+
+class Inductor(Entity):
+    """Smooths bursty traffic via EWMA inter-arrival estimation."""
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        time_constant: float,
+        queue_capacity: int = 10_000,
+    ):
+        super().__init__(name)
+        if time_constant <= 0:
+            raise ValueError("time_constant must be positive")
+        self.downstream = downstream
+        self.time_constant = time_constant
+        self.queue_capacity = queue_capacity
+        self._buffer: deque[Event] = deque()
+        self._smoothed_interval_s: Optional[float] = None
+        self._last_arrival: Optional[Instant] = None
+        self._last_forward: Optional[Instant] = None
+        self._poll_scheduled = False
+        self.received = 0
+        self.forwarded = 0
+        self.queued = 0
+        self.dropped = 0
+
+    @property
+    def estimated_rate(self) -> float:
+        """Current smoothed throughput estimate (events/sec)."""
+        if not self._smoothed_interval_s:
+            return 0.0
+        return 1.0 / self._smoothed_interval_s
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._buffer)
+
+    @property
+    def stats(self) -> InductorStats:
+        return InductorStats(
+            received=self.received,
+            forwarded=self.forwarded,
+            queued=self.queued,
+            dropped=self.dropped,
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    def handle_event(self, event: Event):
+        if event.event_type == "_inductor_poll":
+            return self._handle_poll()
+        return self._handle_arrival(event)
+
+    def _handle_arrival(self, event: Event):
+        self.received += 1
+        now = self.now
+        self._update_estimate(now)
+        self._last_arrival = now
+        if self._can_forward(now) and not self._buffer:
+            return self._forward(event, now)
+        if len(self._buffer) >= self.queue_capacity:
+            self.dropped += 1
+            event.context["metadata"]["rejected_by"] = self.name
+            return event.complete_as_dropped(now, self.name) or None
+        if event.on_complete:  # hooks wait with the buffered item
+            event.context.setdefault("_deferred_hooks", []).extend(event.on_complete)
+            event.on_complete = []
+        self._buffer.append(event)
+        self.queued += 1
+        return self._ensure_poll(now)
+
+    def _handle_poll(self):
+        self._poll_scheduled = False
+        now = self.now
+        produced: list[Event] = []
+        if self._buffer and self._can_forward(now):
+            produced.extend(self._forward(self._buffer.popleft(), now))
+        if self._buffer:
+            produced.extend(self._ensure_poll(now))
+        return produced
+
+    # -- mechanics ---------------------------------------------------------
+    def _update_estimate(self, now: Instant) -> None:
+        if self._last_arrival is None:
+            return
+        dt = (now - self._last_arrival).to_seconds()
+        if self._smoothed_interval_s is None:
+            self._smoothed_interval_s = dt
+            return
+        alpha = 1.0 - math.exp(-dt / self.time_constant)
+        self._smoothed_interval_s += alpha * (dt - self._smoothed_interval_s)
+
+    def _can_forward(self, now: Instant) -> bool:
+        if self._last_forward is None or not self._smoothed_interval_s:
+            return True
+        return (now - self._last_forward).to_seconds() >= self._smoothed_interval_s
+
+    def _forward(self, event: Event, now: Instant) -> list[Event]:
+        self._last_forward = now
+        self.forwarded += 1
+        deferred = event.context.pop("_deferred_hooks", None)
+        if deferred:
+            event.on_complete = deferred + event.on_complete
+        return [self.forward(event, self.downstream)]
+
+    def _ensure_poll(self, now: Instant) -> list[Event]:
+        if self._poll_scheduled:
+            return []
+        self._poll_scheduled = True
+        wait = self._smoothed_interval_s or 0.001
+        if self._last_forward is not None:
+            elapsed = (now - self._last_forward).to_seconds()
+            wait = max(wait - elapsed, 1e-6)
+        # Non-daemon: buffered requests are pending primary work — the sim
+        # must not auto-terminate while the inductor still holds them.
+        return [Event(now + wait, "_inductor_poll", target=self)]

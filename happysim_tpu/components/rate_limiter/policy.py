@@ -1,0 +1,238 @@
+"""Rate-limiting policies (pure state machines, entity-independent).
+
+Parity target: ``happysimulator/components/rate_limiter/policy.py``
+(``RateLimiterPolicy`` protocol :28 — try_acquire/time_until_available;
+``TokenBucketPolicy`` :65, ``LeakyBucketPolicy`` :130,
+``SlidingWindowPolicy`` :173, ``FixedWindowPolicy`` :225, ``AdaptivePolicy``
+AIMD w/ ``RateSnapshot`` :302).
+
+These are the components the TPU executor vectorizes most directly: a token
+bucket is two floats per replica (tokens, last_refill) updated with pure
+arithmetic — see ``happysim_tpu.tpu.engine`` for the array form.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from collections import deque
+from dataclasses import dataclass
+
+from happysim_tpu.core.temporal import Duration, Instant
+
+
+class RateLimiterPolicy(ABC):
+    """try_acquire(now) consumes one permit if available."""
+
+    @abstractmethod
+    def try_acquire(self, now: Instant) -> bool: ...
+
+    @abstractmethod
+    def time_until_available(self, now: Instant) -> Duration:
+        """How long until the next permit could be granted (0 if now)."""
+
+
+class TokenBucketPolicy(RateLimiterPolicy):
+    """Classic token bucket: burst up to ``capacity``, refill at ``refill_rate``/s."""
+
+    def __init__(self, capacity: float = 10.0, refill_rate: float = 1.0):
+        if capacity <= 0 or refill_rate <= 0:
+            raise ValueError("capacity and refill_rate must be positive")
+        self.capacity = float(capacity)
+        self.refill_rate = float(refill_rate)
+        self._tokens = float(capacity)
+        self._last_refill: Instant | None = None
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+    def _refill(self, now: Instant) -> None:
+        if self._last_refill is not None:
+            elapsed = (now - self._last_refill).to_seconds()
+            self._tokens = min(self.capacity, self._tokens + elapsed * self.refill_rate)
+        self._last_refill = now
+
+    def try_acquire(self, now: Instant) -> bool:
+        self._refill(now)
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+    def time_until_available(self, now: Instant) -> Duration:
+        self._refill(now)
+        if self._tokens >= 1.0:
+            return Duration.ZERO
+        return Duration.from_seconds((1.0 - self._tokens) / self.refill_rate)
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("token_bucket", {"capacity": self.capacity, "refill_rate": self.refill_rate})
+
+
+class LeakyBucketPolicy(RateLimiterPolicy):
+    """Leaky bucket as a meter: admits at most ``leak_rate``/s, no bursts."""
+
+    def __init__(self, leak_rate: float = 1.0):
+        if leak_rate <= 0:
+            raise ValueError("leak_rate must be positive")
+        self.leak_rate = float(leak_rate)
+        self._next_slot: Instant | None = None
+
+    def try_acquire(self, now: Instant) -> bool:
+        if self._next_slot is None or now >= self._next_slot:
+            self._next_slot = now + Duration.from_seconds(1.0 / self.leak_rate)
+            return True
+        return False
+
+    def time_until_available(self, now: Instant) -> Duration:
+        if self._next_slot is None or now >= self._next_slot:
+            return Duration.ZERO
+        return self._next_slot - now
+
+
+class SlidingWindowPolicy(RateLimiterPolicy):
+    """At most ``max_requests`` in any trailing ``window_size`` seconds."""
+
+    def __init__(self, window_size_seconds: float = 1.0, max_requests: int = 10):
+        if window_size_seconds <= 0 or max_requests < 1:
+            raise ValueError("window must be positive, max_requests >= 1")
+        self.window_size_seconds = window_size_seconds
+        self.max_requests = max_requests
+        self._admitted: deque[Instant] = deque()
+
+    def _prune(self, now: Instant) -> None:
+        cutoff = now - self.window_size_seconds
+        while self._admitted and self._admitted[0] <= cutoff:
+            self._admitted.popleft()
+
+    def try_acquire(self, now: Instant) -> bool:
+        self._prune(now)
+        if len(self._admitted) < self.max_requests:
+            self._admitted.append(now)
+            return True
+        return False
+
+    def time_until_available(self, now: Instant) -> Duration:
+        self._prune(now)
+        if len(self._admitted) < self.max_requests:
+            return Duration.ZERO
+        oldest = self._admitted[0]
+        return (oldest + self.window_size_seconds) - now
+
+
+class FixedWindowPolicy(RateLimiterPolicy):
+    """At most N per aligned window; resets at window boundaries."""
+
+    def __init__(self, requests_per_window: int = 10, window_size: float = 1.0):
+        if requests_per_window < 1 or window_size <= 0:
+            raise ValueError("requests_per_window >= 1 and positive window required")
+        self.requests_per_window = requests_per_window
+        self.window_size = window_size
+        self._window_id: int | None = None
+        self._count = 0
+
+    def _window_of(self, now: Instant) -> int:
+        return int(now.to_seconds() // self.window_size)
+
+    def _roll(self, now: Instant) -> None:
+        window = self._window_of(now)
+        if window != self._window_id:
+            self._window_id = window
+            self._count = 0
+
+    def try_acquire(self, now: Instant) -> bool:
+        self._roll(now)
+        if self._count < self.requests_per_window:
+            self._count += 1
+            return True
+        return False
+
+    def time_until_available(self, now: Instant) -> Duration:
+        self._roll(now)
+        if self._count < self.requests_per_window:
+            return Duration.ZERO
+        next_window_start = (self._window_of(now) + 1) * self.window_size
+        return Duration.from_seconds(next_window_start) - (now - Instant.Epoch)
+
+
+@dataclass(frozen=True)
+class RateSnapshot:
+    time: Instant
+    rate: float
+    accepted: int
+    rejected: int
+
+
+class AdaptivePolicy(RateLimiterPolicy):
+    """AIMD rate adaptation driven by explicit success/backpressure signals.
+
+    ``record_success``/``record_backpressure`` move the admitted rate between
+    ``min_rate`` and ``max_rate`` (additive increase per success window,
+    multiplicative decrease on backpressure). Admission itself is a token
+    bucket at the current rate.
+    """
+
+    def __init__(
+        self,
+        initial_rate: float = 10.0,
+        min_rate: float = 1.0,
+        max_rate: float = 1000.0,
+        increase_per_second: float = 1.0,
+        decrease_factor: float = 0.5,
+    ):
+        if not (0 < min_rate <= initial_rate <= max_rate):
+            raise ValueError("need 0 < min_rate <= initial_rate <= max_rate")
+        if not 0 < decrease_factor < 1:
+            raise ValueError("decrease_factor must be in (0, 1)")
+        self.min_rate = min_rate
+        self.max_rate = max_rate
+        self.increase_per_second = increase_per_second
+        self.decrease_factor = decrease_factor
+        self._rate = initial_rate
+        self._tokens = 1.0
+        self._last: Instant | None = None
+        self._accepted = 0
+        self._rejected = 0
+        self.history: list[RateSnapshot] = []
+
+    @property
+    def current_rate(self) -> float:
+        return self._rate
+
+    def record_success(self, now: Instant) -> None:
+        self._rate = min(self.max_rate, self._rate + self.increase_per_second)
+        self._snapshot(now)
+
+    def record_backpressure(self, now: Instant) -> None:
+        self._rate = max(self.min_rate, self._rate * self.decrease_factor)
+        # Shed accumulated burst allowance so the clamp bites immediately.
+        self._tokens = min(self._tokens, 1.0)
+        self._snapshot(now)
+
+    def _snapshot(self, now: Instant) -> None:
+        self.history.append(
+            RateSnapshot(time=now, rate=self._rate, accepted=self._accepted, rejected=self._rejected)
+        )
+
+    def _refill(self, now: Instant) -> None:
+        if self._last is not None:
+            self._tokens = min(
+                self._rate,  # burst bounded by one second of rate
+                self._tokens + (now - self._last).to_seconds() * self._rate,
+            )
+        self._last = now
+
+    def try_acquire(self, now: Instant) -> bool:
+        self._refill(now)
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            self._accepted += 1
+            return True
+        self._rejected += 1
+        return False
+
+    def time_until_available(self, now: Instant) -> Duration:
+        self._refill(now)
+        if self._tokens >= 1.0:
+            return Duration.ZERO
+        return Duration.from_seconds((1.0 - self._tokens) / self._rate)

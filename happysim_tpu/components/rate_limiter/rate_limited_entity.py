@@ -1,0 +1,102 @@
+"""Rate-limiting entities wrapping a downstream.
+
+Parity target: ``happysimulator/components/rate_limiter/rate_limited_entity.py:40``
+(policy-driven admission; drop or delay rejected requests) and ``null.py:13``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from happysim_tpu.components.rate_limiter.policy import RateLimiterPolicy
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class RateLimiterStats:
+    received: int
+    admitted: int
+    rejected: int
+    delayed: int
+
+
+class RateLimitedEntity(Entity):
+    """Admits requests per the policy; rejects or reschedules the excess.
+
+    mode="drop": rejected requests are discarded (marked in metadata).
+    mode="delay": rejected requests are rescheduled at the policy's next
+    available slot (an unbounded shaper — pair with a queue capacity
+    upstream for realism).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        policy: RateLimiterPolicy,
+        mode: str = "drop",
+    ):
+        super().__init__(name)
+        if mode not in ("drop", "delay"):
+            raise ValueError("mode must be 'drop' or 'delay'")
+        self.downstream = downstream
+        self.policy = policy
+        self.mode = mode
+        self.received = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.delayed = 0
+
+    @property
+    def stats(self) -> RateLimiterStats:
+        return RateLimiterStats(
+            received=self.received,
+            admitted=self.admitted,
+            rejected=self.rejected,
+            delayed=self.delayed,
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    def handle_event(self, event: Event):
+        is_redelivery = event.context["metadata"].pop("_rl_redelivery", False)
+        if not is_redelivery:
+            self.received += 1
+        if self.policy.try_acquire(self.now):
+            self.admitted += 1
+            return [self.forward(event, self.downstream)]
+        if self.mode == "drop":
+            self.rejected += 1
+            event.context["metadata"]["rejected_by"] = self.name
+            return event.complete_as_dropped(self.now, self.name) or None
+        self.delayed += 1
+        wait = self.policy.time_until_available(self.now)
+        event.context["metadata"]["_rl_redelivery"] = True
+        redelivery = Event(
+            self.now + wait,
+            event.event_type,
+            target=self,
+            daemon=event.daemon,
+            context=event.context,
+        )
+        # Hooks ride the redelivery so they fire at eventual completion.
+        redelivery.on_complete, event.on_complete = event.on_complete, []
+        return [redelivery]
+
+
+class NullRateLimiter(Entity):
+    """Pass-through (the null object for A/B-ing limiter impact)."""
+
+    def __init__(self, name: str, downstream: Entity):
+        super().__init__(name)
+        self.downstream = downstream
+        self.forwarded = 0
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    def handle_event(self, event: Event):
+        self.forwarded += 1
+        return [self.forward(event, self.downstream)]

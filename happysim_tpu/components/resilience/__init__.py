@@ -1,0 +1,25 @@
+"""Resilience patterns: circuit breaking, bulkheads, hedging, timeouts, fallbacks."""
+
+from happysim_tpu.components.resilience.bulkhead import Bulkhead, BulkheadStats
+from happysim_tpu.components.resilience.circuit_breaker import (
+    CircuitBreaker,
+    CircuitBreakerStats,
+    CircuitState,
+)
+from happysim_tpu.components.resilience.fallback import Fallback, FallbackStats
+from happysim_tpu.components.resilience.hedge import Hedge, HedgeStats
+from happysim_tpu.components.resilience.timeout import TimeoutStats, TimeoutWrapper
+
+__all__ = [
+    "Bulkhead",
+    "BulkheadStats",
+    "CircuitBreaker",
+    "CircuitBreakerStats",
+    "CircuitState",
+    "Fallback",
+    "FallbackStats",
+    "Hedge",
+    "HedgeStats",
+    "TimeoutStats",
+    "TimeoutWrapper",
+]

@@ -1,0 +1,146 @@
+"""Bulkhead: bounded concurrency + bounded waiting room.
+
+Parity target: ``happysimulator/components/resilience/bulkhead.py:57``
+(max_concurrent permits, max_wait_queue, optional max_wait_time eviction,
+``BulkheadStats`` :36).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass(frozen=True)
+class BulkheadStats:
+    requests_received: int
+    requests_forwarded: int
+    requests_rejected: int
+    requests_evicted: int
+    max_active_seen: int
+    max_queue_seen: int
+
+
+class Bulkhead(Entity):
+    """Isolates a downstream behind a concurrency limit and a wait queue."""
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        max_concurrent: int = 10,
+        max_wait_queue: int = 0,
+        max_wait_time: Optional[float] = None,
+    ):
+        super().__init__(name)
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self.downstream = downstream
+        self.max_concurrent = max_concurrent
+        self.max_wait_queue = max_wait_queue
+        self.max_wait_time = max_wait_time
+        self._active = 0
+        self._queue: list[Event] = []
+        self.requests_received = 0
+        self.requests_forwarded = 0
+        self.requests_rejected = 0
+        self.requests_evicted = 0
+        self.max_active_seen = 0
+        self.max_queue_seen = 0
+
+    @property
+    def active_count(self) -> int:
+        return self._active
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def available_permits(self) -> int:
+        return self.max_concurrent - self._active
+
+    @property
+    def stats(self) -> BulkheadStats:
+        return BulkheadStats(
+            requests_received=self.requests_received,
+            requests_forwarded=self.requests_forwarded,
+            requests_rejected=self.requests_rejected,
+            requests_evicted=self.requests_evicted,
+            max_active_seen=self.max_active_seen,
+            max_queue_seen=self.max_queue_seen,
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    def handle_event(self, event: Event):
+        if event.event_type == "_bh_evict":
+            return self._handle_evict(event)
+        self.requests_received += 1
+        if self._active < self.max_concurrent:
+            return self._forward(event)
+        if len(self._queue) < self.max_wait_queue:
+            # Stash hooks while the request waits; they move onto the
+            # forwarded event when a permit frees (or unwind on eviction).
+            if event.on_complete:
+                event.context.setdefault("_deferred_hooks", []).extend(event.on_complete)
+                event.on_complete = []
+            self._queue.append(event)
+            self.max_queue_seen = max(self.max_queue_seen, len(self._queue))
+            event.context["metadata"]["_bh_enqueued_at"] = self.now
+            if self.max_wait_time is not None:
+                return [
+                    Event(
+                        self.now + self.max_wait_time,
+                        "_bh_evict",
+                        target=self,
+                        daemon=True,
+                        context={"metadata": {"victim_id": event._id}},
+                    )
+                ]
+            return None
+        self.requests_rejected += 1
+        event.context["metadata"]["rejected_by"] = self.name
+        return event.complete_as_dropped(self.now, self.name) or None
+
+    def _forward(self, event: Event) -> list[Event]:
+        self._active += 1
+        self.max_active_seen = max(self.max_active_seen, self._active)
+        self.requests_forwarded += 1
+        forwarded = self.forward(event, self.downstream)
+        forwarded.add_completion_hook(self._on_done)
+        return [forwarded]
+
+    def _on_done(self, time: Instant):
+        self._active -= 1
+        released: list[Event] = []
+        while self._queue and self._active < self.max_concurrent:
+            waiting = self._queue.pop(0)
+            self._active += 1
+            self.requests_forwarded += 1
+            forwarded = Event(
+                time,
+                waiting.event_type,
+                target=self.downstream,
+                daemon=waiting.daemon,
+                context=waiting.context,
+            )
+            forwarded.on_complete.extend(waiting.context.pop("_deferred_hooks", []))
+            forwarded.add_completion_hook(self._on_done)
+            released.append(forwarded)
+        return released
+
+    def _handle_evict(self, event: Event):
+        victim_id = event.context["metadata"]["victim_id"]
+        for i, waiting in enumerate(self._queue):
+            if waiting._id == victim_id:
+                self._queue.pop(i)
+                self.requests_evicted += 1
+                waiting.context["metadata"]["rejected_by"] = self.name
+                return waiting.complete_as_dropped(self.now, self.name) or None
+        return None

@@ -1,0 +1,231 @@
+"""Circuit breaker: fail fast when a downstream is unhealthy.
+
+Parity target: ``happysimulator/components/resilience/circuit_breaker.py:57``
+(``CircuitState`` CLOSED/OPEN/HALF_OPEN :36, failure/success thresholds,
+recovery timeout, forced transitions :415-423, ``CircuitBreakerStats`` :45).
+
+Failure signal: a request "fails" if its downstream completion does not
+happen within ``call_timeout`` seconds (or if the downstream marks
+``metadata["error"]``). Success/failure counting is attributed to the state
+the circuit was in when the request was *sent* — a late failure from the
+CLOSED era can't re-open a freshly HALF_OPEN circuit.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+class CircuitState(enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+@dataclass(frozen=True)
+class CircuitBreakerStats:
+    requests_allowed: int
+    requests_rejected: int
+    successes: int
+    failures: int
+    state_transitions: int
+
+
+class CircuitBreaker(Entity):
+    """Wraps a downstream entity with CLOSED → OPEN → HALF_OPEN protection."""
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        failure_threshold: int = 5,
+        success_threshold: int = 2,
+        recovery_timeout: float = 30.0,
+        call_timeout: Optional[float] = 1.0,
+        half_open_max_probes: int = 1,
+    ):
+        super().__init__(name)
+        if failure_threshold < 1 or success_threshold < 1:
+            raise ValueError("thresholds must be >= 1")
+        self.downstream = downstream
+        self.failure_threshold = failure_threshold
+        self.success_threshold = success_threshold
+        self.recovery_timeout = recovery_timeout
+        self.call_timeout = call_timeout
+        self.half_open_max_probes = half_open_max_probes
+        self._state = CircuitState.CLOSED
+        self._failure_count = 0
+        self._success_count = 0
+        self._opened_at: Optional[Instant] = None
+        self._half_open_in_flight = 0
+        self.requests_allowed = 0
+        self.requests_rejected = 0
+        self.successes = 0
+        self.failures = 0
+        self.state_transitions = 0
+        self._next_call_id = 0
+        self._in_flight: dict[int, dict] = {}
+
+    # -- state surface -----------------------------------------------------
+    @property
+    def state(self) -> CircuitState:
+        # OPEN lazily becomes HALF_OPEN after the recovery timeout; checked
+        # on access so no timer event is needed.
+        if (
+            self._state is CircuitState.OPEN
+            and self._clock is not None
+            and self._opened_at is not None
+            and (self.now - self._opened_at).to_seconds() >= self.recovery_timeout
+        ):
+            self._transition(CircuitState.HALF_OPEN)
+        return self._state
+
+    @property
+    def failure_count(self) -> int:
+        return self._failure_count
+
+    @property
+    def stats(self) -> CircuitBreakerStats:
+        return CircuitBreakerStats(
+            requests_allowed=self.requests_allowed,
+            requests_rejected=self.requests_rejected,
+            successes=self.successes,
+            failures=self.failures,
+            state_transitions=self.state_transitions,
+        )
+
+    def force_open(self) -> None:
+        self._transition(CircuitState.OPEN)
+
+    def force_close(self) -> None:
+        self._transition(CircuitState.CLOSED)
+
+    def reset(self) -> None:
+        self._transition(CircuitState.CLOSED)
+        self._failure_count = 0
+        self._success_count = 0
+
+    def record_success(self) -> None:
+        """Manual success signal (for custom wiring)."""
+        self._on_outcome(True, self._state)
+
+    def record_failure(self) -> None:
+        self._on_outcome(False, self._state)
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    # -- event flow --------------------------------------------------------
+    def handle_event(self, event: Event):
+        if event.event_type == "_cb_timeout":
+            return self._handle_timeout(event)
+        if event.event_type == "_cb_response":
+            return self._handle_response(event)
+        return self._forward(event)
+
+    def _forward(self, event: Event):
+        state = self.state  # may lazily half-open
+        if state is CircuitState.OPEN:
+            self.requests_rejected += 1
+            event.context["metadata"]["rejected_by"] = self.name
+            return event.complete_as_dropped(self.now, self.name) or None
+        if (
+            state is CircuitState.HALF_OPEN
+            and self._half_open_in_flight >= self.half_open_max_probes
+        ):
+            self.requests_rejected += 1
+            event.context["metadata"]["rejected_by"] = self.name
+            return event.complete_as_dropped(self.now, self.name) or None
+
+        self.requests_allowed += 1
+        if state is CircuitState.HALF_OPEN:
+            self._half_open_in_flight += 1
+        self._next_call_id += 1
+        call_id = self._next_call_id
+        forwarded = self.forward(event, self.downstream)
+
+        def respond(finish_time: Instant) -> Event:
+            metadata = forwarded.context["metadata"]
+            failed = bool(metadata.get("error") or metadata.get("dropped_by"))
+            return Event(
+                finish_time,
+                "_cb_response",
+                target=self,
+                context={"metadata": {"call_id": call_id, "error": failed}},
+            )
+
+        forwarded.add_completion_hook(respond)
+        produced = [forwarded]
+        timeout_event = None
+        if self.call_timeout is not None:
+            timeout_event = Event(
+                self.now + self.call_timeout,
+                "_cb_timeout",
+                target=self,
+                daemon=True,
+                context={"metadata": {"call_id": call_id}},
+            )
+            produced.append(timeout_event)
+        self._in_flight[call_id] = {"state": state, "timeout_event": timeout_event}
+        return produced
+
+    def _handle_response(self, event: Event):
+        call_id = event.context["metadata"]["call_id"]
+        info = self._in_flight.pop(call_id, None)
+        if info is None:
+            return None  # already timed out
+        if info["timeout_event"] is not None:
+            info["timeout_event"].cancel()
+        failed = bool(event.context["metadata"].get("error"))
+        self._on_outcome(not failed, info["state"])
+        return None
+
+    def _handle_timeout(self, event: Event):
+        call_id = event.context["metadata"]["call_id"]
+        info = self._in_flight.pop(call_id, None)
+        if info is None:
+            return None
+        self._on_outcome(False, info["state"])
+        return None
+
+    # -- bookkeeping -------------------------------------------------------
+    def _on_outcome(self, success: bool, state_when_sent: CircuitState) -> None:
+        if state_when_sent is CircuitState.HALF_OPEN:
+            self._half_open_in_flight = max(0, self._half_open_in_flight - 1)
+        if success:
+            self.successes += 1
+            if state_when_sent is CircuitState.HALF_OPEN:
+                self._success_count += 1
+                if self._success_count >= self.success_threshold:
+                    self._transition(CircuitState.CLOSED)
+            else:
+                self._failure_count = 0
+        else:
+            self.failures += 1
+            if state_when_sent is CircuitState.HALF_OPEN:
+                self._transition(CircuitState.OPEN)
+            elif state_when_sent is CircuitState.CLOSED:
+                self._failure_count += 1
+                if self._failure_count >= self.failure_threshold:
+                    self._transition(CircuitState.OPEN)
+
+    def _transition(self, new_state: CircuitState) -> None:
+        if new_state is self._state:
+            return
+        self._state = new_state
+        self.state_transitions += 1
+        if new_state is CircuitState.OPEN:
+            self._opened_at = self.now if self._clock is not None else None
+            self._success_count = 0
+        elif new_state is CircuitState.HALF_OPEN:
+            self._success_count = 0
+            self._half_open_in_flight = 0
+        elif new_state is CircuitState.CLOSED:
+            self._failure_count = 0
+            self._success_count = 0

@@ -1,0 +1,186 @@
+"""Fallback: try the primary; on timeout, route to a backup.
+
+Parity target: ``happysimulator/components/resilience/fallback.py:44``
+(primary + fallback entity-or-callable, timeout-triggered failover,
+``FallbackStats`` :33).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Union
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass(frozen=True)
+class FallbackStats:
+    requests: int
+    primary_successes: int
+    fallback_attempts: int
+    fallback_successes: int
+
+
+class Fallback(Entity):
+    """Primary-with-backup: requests that miss the deadline go to the backup.
+
+    ``fallback`` is either an Entity (the request is re-sent there) or a
+    callable ``(request) -> Event | None`` producing a synthetic response
+    (e.g. a cached default).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        primary: Entity,
+        fallback: Union[Entity, Callable[[Event], Optional[Event]]],
+        timeout: float = 1.0,
+    ):
+        super().__init__(name)
+        if timeout <= 0:
+            raise ValueError("timeout must be positive")
+        self.primary = primary
+        self.fallback = fallback
+        self.timeout = timeout
+        self._next_id = 0
+        self._pending: dict[int, dict] = {}
+        self._fallback_hooks: dict[int, dict] = {}
+        self.requests = 0
+        self.primary_successes = 0
+        self.fallback_attempts = 0
+        self.fallback_successes = 0
+
+    @property
+    def stats(self) -> FallbackStats:
+        return FallbackStats(
+            requests=self.requests,
+            primary_successes=self.primary_successes,
+            fallback_attempts=self.fallback_attempts,
+            fallback_successes=self.fallback_successes,
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        out = [self.primary]
+        if isinstance(self.fallback, Entity):
+            out.append(self.fallback)
+        return out
+
+    def handle_event(self, event: Event):
+        dispatch = {
+            "_fb_primary_done": self._handle_primary_done,
+            "_fb_fallback_done": self._handle_fallback_done,
+            "_fb_deadline": self._handle_deadline,
+        }.get(event.event_type)
+        if dispatch is not None:
+            return dispatch(event)
+
+        self.requests += 1
+        self._next_id += 1
+        call_id = self._next_id
+        # Upstream completion hooks fire on whichever path delivers first
+        # (primary success or fallback completion) — held here, not moved
+        # onto the primary attempt.
+        hooks = event.on_complete
+        event.on_complete = []
+        forwarded = self.forward(event, self.primary)
+
+        def primary_done(t: Instant) -> Event:
+            metadata = forwarded.context.get("metadata", {})
+            return Event(
+                t,
+                "_fb_primary_done",
+                target=self,
+                context={
+                    "metadata": {
+                        "call_id": call_id,
+                        "dropped": metadata.get("dropped_by"),
+                    }
+                },
+            )
+
+        forwarded.add_completion_hook(primary_done)
+        deadline = Event(
+            self.now + self.timeout,
+            "_fb_deadline",
+            target=self,
+            daemon=True,
+            context={"metadata": {"call_id": call_id}},
+        )
+        self._pending[call_id] = {
+            "request": event,
+            "deadline_event": deadline,
+            "hooks": hooks,
+        }
+        return [forwarded, deadline]
+
+    def _fire_hooks(self, info: dict) -> list[Event]:
+        from happysim_tpu.core.event import _normalize_events
+
+        produced: list[Event] = []
+        for hook in info["hooks"]:
+            produced.extend(_normalize_events(hook(self.now)))
+        info["hooks"] = []
+        return produced
+
+    def _handle_primary_done(self, event: Event):
+        call_id = event.context["metadata"]["call_id"]
+        info = self._pending.get(call_id)
+        if info is None:
+            return None  # deadline already fired; fallback owns it now
+        if event.context["metadata"].get("dropped"):
+            # The primary fast-failed (queue overflow, crash, open circuit):
+            # don't wait out the deadline — go to the backup immediately.
+            info["deadline_event"].cancel()
+            del self._pending[call_id]
+            return self._go_fallback(call_id, info)
+        del self._pending[call_id]
+        info["deadline_event"].cancel()
+        self.primary_successes += 1
+        return self._fire_hooks(info) or None
+
+    def _handle_deadline(self, event: Event):
+        call_id = event.context["metadata"]["call_id"]
+        info = self._pending.pop(call_id, None)
+        if info is None:
+            return None
+        return self._go_fallback(call_id, info)
+
+    def _go_fallback(self, call_id: int, info: dict):
+        self.fallback_attempts += 1
+        request = info["request"]
+        if isinstance(self.fallback, Entity):
+            redirected = Event(
+                self.now,
+                request.event_type,
+                target=self.fallback,
+                context={
+                    "created_at": request.context.get("created_at"),
+                    "metadata": dict(request.context.get("metadata", {})),
+                },
+            )
+            self._fallback_hooks[call_id] = info
+            redirected.add_completion_hook(
+                lambda t: Event(
+                    t,
+                    "_fb_fallback_done",
+                    target=self,
+                    context={"metadata": {"call_id": call_id}},
+                )
+            )
+            return [redirected]
+        synthetic = self.fallback(request)
+        self.fallback_successes += 1
+        produced = self._fire_hooks(info)
+        if synthetic is not None:
+            produced.append(synthetic)
+        return produced or None
+
+    def _handle_fallback_done(self, event: Event):
+        call_id = event.context["metadata"]["call_id"]
+        info = self._fallback_hooks.pop(call_id, None)
+        self.fallback_successes += 1
+        if info is not None:
+            return self._fire_hooks(info) or None
+        return None

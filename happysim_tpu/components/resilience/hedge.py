@@ -1,0 +1,176 @@
+"""Hedged requests: duplicate slow requests, first response wins.
+
+Parity target: ``happysimulator/components/resilience/hedge.py:45``
+(hedge_delay, max_hedges, first-completion-wins, ``HedgeStats`` :35).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass(frozen=True)
+class HedgeStats:
+    requests: int
+    hedges_sent: int
+    primary_wins: int
+    hedge_wins: int
+
+
+class Hedge(Entity):
+    """If the primary hasn't completed after ``hedge_delay``, send a copy.
+
+    Late duplicate completions are ignored (first response is the result);
+    tail latency collapses at the cost of extra downstream load.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        hedge_delay: float = 0.1,
+        max_hedges: int = 1,
+    ):
+        super().__init__(name)
+        if hedge_delay < 0:
+            raise ValueError("hedge_delay must be >= 0")
+        self.downstream = downstream
+        self.hedge_delay = hedge_delay
+        self.max_hedges = max_hedges
+        self._next_id = 0
+        # request_id -> {"done": bool, "hedges": int, "original": Event}
+        self._in_flight: dict[int, dict] = {}
+        self.requests = 0
+        self.hedges_sent = 0
+        self.primary_wins = 0
+        self.hedge_wins = 0
+
+    @property
+    def in_flight_count(self) -> int:
+        return len(self._in_flight)
+
+    @property
+    def stats(self) -> HedgeStats:
+        return HedgeStats(
+            requests=self.requests,
+            hedges_sent=self.hedges_sent,
+            primary_wins=self.primary_wins,
+            hedge_wins=self.hedge_wins,
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    def handle_event(self, event: Event):
+        if event.event_type == "_hedge_fire":
+            return self._handle_fire(event)
+        if event.event_type == "_hedge_done":
+            return self._handle_done(event)
+        return self._dispatch(event)
+
+    def _dispatch(self, event: Event) -> list[Event]:
+        self.requests += 1
+        self._next_id += 1
+        request_id = self._next_id
+        # Upstream completion hooks fire on the FIRST attempt to finish
+        # (primary or hedge) — held here, not on any single attempt event.
+        self._in_flight[request_id] = {
+            "hedges": 0,
+            "original": event,
+            "hooks": event.on_complete,
+            "outstanding": 1,
+        }
+        event.on_complete = []
+        produced = [self._attempt(event, request_id, attempt=0, at=self.now)]
+        if self.max_hedges > 0:
+            produced.append(self._fire_event(request_id, hedge_number=1))
+        return produced
+
+    def _attempt(self, original: Event, request_id: int, attempt: int, at: Instant) -> Event:
+        # Hedge copies get a *copied* context so duplicated downstream work
+        # doesn't share mutable metadata with the primary.
+        context = (
+            original.context
+            if attempt == 0
+            else {
+                "created_at": original.context.get("created_at"),
+                "metadata": dict(original.context.get("metadata", {})),
+            }
+        )
+        copy = Event(at, original.event_type, target=self.downstream, context=context)
+
+        def done(t, a=attempt, sent=copy):
+            return Event(
+                t,
+                "_hedge_done",
+                target=self,
+                context={
+                    "metadata": {
+                        "request_id": request_id,
+                        "attempt": a,
+                        "dropped": sent.context.get("metadata", {}).get("dropped_by"),
+                    }
+                },
+            )
+
+        copy.add_completion_hook(done)
+        return copy
+
+    def _fire_event(self, request_id: int, hedge_number: int) -> Event:
+        return Event(
+            self.now + self.hedge_delay * hedge_number,
+            "_hedge_fire",
+            target=self,
+            daemon=True,
+            context={"metadata": {"request_id": request_id, "hedge_number": hedge_number}},
+        )
+
+    def _handle_fire(self, event: Event):
+        metadata = event.context["metadata"]
+        request_id = metadata["request_id"]
+        info = self._in_flight.get(request_id)
+        if info is None:
+            return None  # already completed
+        hedge_number = metadata["hedge_number"]
+        self.hedges_sent += 1
+        info["hedges"] = hedge_number
+        info["outstanding"] += 1
+        produced = [self._attempt(info["original"], request_id, attempt=hedge_number, at=self.now)]
+        if hedge_number < self.max_hedges:
+            produced.append(self._fire_event(request_id, hedge_number + 1))
+        return produced
+
+    def _handle_done(self, event: Event):
+        metadata = event.context["metadata"]
+        request_id = metadata["request_id"]
+        info = self._in_flight.get(request_id)
+        if info is None:
+            return None  # a slower duplicate finished; ignore
+        info["outstanding"] -= 1
+        if metadata.get("dropped"):
+            # This attempt fast-failed; keep waiting if another attempt is
+            # still running or another hedge will fire — only give up when
+            # every attempt has terminated and no more can launch.
+            if info["outstanding"] > 0 or info["hedges"] < self.max_hedges:
+                return None
+            self._in_flight.pop(request_id)
+            return self._fire_hooks(info) or None
+        self._in_flight.pop(request_id)
+        if metadata["attempt"] == 0:
+            self.primary_wins += 1
+        else:
+            self.hedge_wins += 1
+        return self._fire_hooks(info) or None
+
+    def _fire_hooks(self, info: dict) -> list[Event]:
+        from happysim_tpu.core.event import _normalize_events
+
+        produced: list[Event] = []
+        for hook in info["hooks"]:
+            produced.extend(_normalize_events(hook(self.now)))
+        info["hooks"] = []
+        return produced

@@ -1,0 +1,102 @@
+"""Timeout wrapper: bound how long a request may take downstream.
+
+Parity target: ``happysimulator/components/resilience/timeout.py:41``
+(``TimeoutWrapper`` — deadline per request, timed-out requests counted and
+marked; on_timeout callback).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass(frozen=True)
+class TimeoutStats:
+    requests: int
+    completions: int
+    timeouts: int
+
+
+class TimeoutWrapper(Entity):
+    """Forwards requests and reports whether they finished within deadline.
+
+    The downstream work is not revoked on timeout (as in real systems, the
+    backend keeps burning); the wrapper just records the miss and notifies
+    ``on_timeout`` so upstream logic (fallbacks, retries) can react.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        timeout: float,
+        on_timeout: Optional[Callable[[Event], None]] = None,
+    ):
+        super().__init__(name)
+        if timeout <= 0:
+            raise ValueError("timeout must be positive")
+        self.downstream = downstream
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self._next_id = 0
+        self._pending: dict[int, dict] = {}
+        self.requests = 0
+        self.completions = 0
+        self.timeouts = 0
+
+    @property
+    def stats(self) -> TimeoutStats:
+        return TimeoutStats(
+            requests=self.requests, completions=self.completions, timeouts=self.timeouts
+        )
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self.downstream]
+
+    def handle_event(self, event: Event):
+        if event.event_type == "_to_done":
+            return self._handle_done(event)
+        if event.event_type == "_to_deadline":
+            return self._handle_deadline(event)
+
+        self.requests += 1
+        self._next_id += 1
+        call_id = self._next_id
+        forwarded = self.forward(event, self.downstream)
+        forwarded.add_completion_hook(
+            lambda t: Event(
+                t, "_to_done", target=self, context={"metadata": {"call_id": call_id}}
+            )
+        )
+        deadline = Event(
+            self.now + self.timeout,
+            "_to_deadline",
+            target=self,
+            daemon=True,
+            context={"metadata": {"call_id": call_id}},
+        )
+        self._pending[call_id] = {"request": event, "deadline_event": deadline}
+        return [forwarded, deadline]
+
+    def _handle_done(self, event: Event):
+        info = self._pending.pop(event.context["metadata"]["call_id"], None)
+        if info is None:
+            return None  # already timed out
+        info["deadline_event"].cancel()
+        self.completions += 1
+        return None
+
+    def _handle_deadline(self, event: Event):
+        info = self._pending.pop(event.context["metadata"]["call_id"], None)
+        if info is None:
+            return None
+        self.timeouts += 1
+        info["request"].context["metadata"]["timed_out_by"] = self.name
+        if self.on_timeout is not None:
+            self.on_timeout(info["request"])
+        return None

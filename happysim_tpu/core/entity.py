@@ -54,14 +54,23 @@ class Entity(ABC):
 
     def forward(self, event: Event, target: "Entity", event_type: str | None = None) -> Event:
         """Re-address an event to ``target`` at the current time, preserving
-        context (so created_at survives for latency accounting)."""
-        return Event(
+        context (so created_at survives for latency accounting).
+
+        Completion hooks MOVE onto the forwarded event: the inbound event's
+        processing is a pass-through, so "complete" means the downstream
+        chain finished — not that this hop returned. This is what makes
+        wrapper entities (load balancers, circuit breakers, rate limiters)
+        composable with clients that hook their requests.
+        """
+        forwarded = Event(
             time=self.now,
             event_type=event_type or event.event_type,
             target=target,
             daemon=event.daemon,
             context=event.context,
         )
+        forwarded.on_complete, event.on_complete = event.on_complete, []
+        return forwarded
 
     def has_capacity(self) -> bool:
         """Back-pressure signal consumed by queue drivers. Default: always."""

@@ -171,8 +171,12 @@ class Event:
         """Dispatch to the target; returns newly produced events."""
         target = self.target
         if getattr(target, "_crashed", False):
-            # Crashed nodes silently drop events (reference :261-262).
-            return []
+            # Crashed nodes drop the work (reference :261-262) — but any
+            # attached completion hooks still unwind as a drop so upstream
+            # accounting (permits, in-flight counters) doesn't leak.
+            return self.complete_as_dropped(
+                self.time, f"crashed:{getattr(target, 'name', '?')}"
+            )
         if _TRACING_ENABLED:
             self._trace_invoke()
         result = target.handle_event(self)
@@ -192,6 +196,20 @@ class Event:
         for hook in hooks:
             produced.extend(_normalize_events(hook(time)))
         return produced
+
+    def complete_as_dropped(self, time: Instant, reason: str) -> list["Event"]:
+        """Terminal unwind for an event that will never be serviced.
+
+        Marks ``metadata["dropped_by"]`` and fires all completion hooks
+        (including hooks a queue stashed in ``_deferred_hooks``) so wrapper
+        entities holding permits/in-flight counts can release them. Hook
+        implementations distinguish drops from successes via the marker.
+        """
+        self.context.setdefault("metadata", {})["dropped_by"] = reason
+        deferred = self.context.pop("_deferred_hooks", None)
+        if deferred:
+            self.on_complete = deferred + self.on_complete
+        return self._run_completion_hooks(time)
 
     def _start_process(self, gen: Generator) -> list["Event"]:
         continuation = ProcessContinuation(
@@ -293,10 +311,13 @@ class ProcessContinuation(Event):
 
     def invoke(self) -> list[Event]:
         # A crashed target loses in-flight generator work, not just new
-        # events (CrashNode semantics: the process dies mid-service).
+        # events (CrashNode semantics: the process dies mid-service). Hooks
+        # unwind as a drop so upstream wrappers don't leak accounting.
         if getattr(self.target, "_crashed", False):
             self.process.close()
-            return []
+            return self.origin.complete_as_dropped(
+                self.time, f"crashed:{getattr(self.target, 'name', '?')}"
+            )
         debugger = _active_code_debugger.get(None)
         tracing = debugger is not None and debugger.wants(self.target)
         if tracing:

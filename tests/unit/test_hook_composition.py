@@ -1,0 +1,190 @@
+"""Completion-hook composability across wrapper chains.
+
+These are the invariants the framework guarantees (beyond the reference,
+which fires hooks at enqueue/forward time): a completion hook attached to a
+request fires EXACTLY ONCE — at true downstream completion, or at the moment
+the request is terminally dropped (with ``metadata["dropped_by"]`` set).
+"""
+
+import pytest
+
+from happysim_tpu import (
+    Client,
+    ConstantLatency,
+    Event,
+    FixedRetry,
+    Instant,
+    LoadBalancer,
+    Server,
+    Simulation,
+    Sink,
+)
+from happysim_tpu.components.resilience import Bulkhead, CircuitBreaker
+from happysim_tpu.core.entity import Entity
+
+
+def t(seconds):
+    return Instant.from_seconds(seconds)
+
+
+class TestForwardMovesHooks:
+    def test_client_through_load_balancer_sees_real_latency(self):
+        sink = Sink()
+        servers = [
+            Server(f"s{i}", concurrency=1, service_time=ConstantLatency(0.3), downstream=sink)
+            for i in range(2)
+        ]
+        lb = LoadBalancer("lb", backends=servers)
+        client = Client("c", target=lb, timeout=5.0)
+        sim = Simulation(entities=[sink, lb, client, *servers])
+        sim.schedule([client.send_request(at=t(0)), client.send_request(at=t(1))])
+        sim.run()
+        assert client.responses_received == 2
+        # Response time must include the 0.3s service, not fire at forward.
+        assert client.average_response_time == pytest.approx(0.3)
+
+    def test_hook_fires_once_through_wrapper_chain(self):
+        fired = []
+        server = Server("s", concurrency=1, service_time=ConstantLatency(0.1))
+        cb = CircuitBreaker("cb", server, call_timeout=None)
+        bh = Bulkhead("bh", cb, max_concurrent=4)
+        sim = Simulation(entities=[server, cb, bh])
+        request = Event(t(0), "req", target=bh)
+        request.add_completion_hook(lambda time: fired.append(time.to_seconds()) or None)
+        sim.schedule(request)
+        sim.run()
+        assert fired == [pytest.approx(0.1)]
+
+
+class TestDropUnwind:
+    def test_queue_drop_releases_bulkhead_permit(self):
+        """A downstream queue drop must not leak bulkhead permits."""
+        server = Server(
+            "s", concurrency=1, service_time=ConstantLatency(1.0), queue_capacity=1
+        )
+        bh = Bulkhead("bh", server, max_concurrent=3)
+        sim = Simulation(entities=[server, bh], duration=30.0)
+        # Burst of 3 permits: same-instant enqueues land before the first
+        # delivery, so 1 is accepted and 2 drop at the full queue.
+        sim.schedule([Event(t(0), "req", target=bh) for _ in range(3)])
+        # Later wave must find ALL permits free again if the drop unwound.
+        sim.schedule([Event(t(10.0), "req", target=bh) for _ in range(3)])
+        sim.run()
+        assert bh.active_count == 0
+        assert bh.stats.requests_forwarded == 6  # nothing rejected at bulkhead
+        assert bh.stats.requests_rejected == 0
+        assert server.queue.dropped == 4
+
+    def test_client_fast_fails_on_queue_drop_and_retries(self):
+        server = Server(
+            "s", concurrency=1, service_time=ConstantLatency(2.0), queue_capacity=1
+        )
+        failures = []
+        client = Client(
+            "c",
+            target=server,
+            timeout=10.0,
+            retry_policy=FixedRetry(max_attempts=2, delay_s=0.5),
+            on_failure=lambda req, reason: failures.append(reason),
+        )
+        sim = Simulation(entities=[server, client], duration=60.0)
+        # #1 occupies the server, #2 fills the queue, #3 gets dropped fast.
+        sim.schedule(
+            [
+                client.send_request(at=t(0)),
+                client.send_request(at=t(0.1)),
+                client.send_request(at=t(0.2)),
+            ]
+        )
+        sim.run()
+        # The third request dropped fast, retried per policy at t=0.7 (queue
+        # still full), and failed fast again — no 10s timeout wait.
+        assert client.retries >= 1
+        assert len(failures) == 1
+        assert "s.queue" in failures[0]
+        assert client.responses_received == 2
+
+    def test_crashed_target_unwinds_hooks(self):
+        class Crashed(Entity):
+            _crashed = True
+
+            def handle_event(self, event):
+                return None
+
+        dead = Crashed("dead")
+        lb = LoadBalancer("lb", backends=[dead])
+        sim = Simulation(entities=[lb, dead], duration=5.0)
+        sim.schedule([Event(t(i * 0.1), "req", target=lb) for i in range(3)])
+        sim.run()
+        info = lb.backend_info(dead)
+        assert info.in_flight == 0  # unwound, not leaked
+        assert info.total_failures == 3
+        assert info.consecutive_successes == 0
+        assert lb.stats.requests_failed == 3
+
+    def test_fallback_goes_to_backup_on_primary_drop(self):
+        from happysim_tpu.components.resilience import Fallback
+
+        sink = Sink()
+        # Primary whose queue is always full after the first occupant.
+        primary = Server("p", concurrency=1, service_time=ConstantLatency(5.0), queue_capacity=1)
+        backup = Server("b", concurrency=4, service_time=ConstantLatency(0.01), downstream=sink)
+        fb = Fallback("fb", primary=primary, fallback=backup, timeout=2.0)
+        sim = Simulation(entities=[sink, primary, backup, fb], duration=30.0)
+        sim.schedule([Event(t(i * 0.1), "req", target=fb) for i in range(4)])
+        sim.run()
+        # Requests 3+4 drop at the primary's queue and fail over IMMEDIATELY
+        # (not after the 2s deadline); 1 is served slow (deadline fallback),
+        # 2 sits in queue past deadline (deadline fallback).
+        assert fb.stats.fallback_attempts == 4
+        assert backup.requests_completed == 4
+        drop_failovers = [s for s in sink.latencies_s if s < 1.0]
+        assert len(drop_failovers) == 2
+
+    def test_fallback_fires_upstream_hooks_on_backup_success(self):
+        from happysim_tpu.components.resilience import Fallback
+
+        fired = []
+        slow = Server("slow", concurrency=1, service_time=ConstantLatency(50.0))
+        backup = Server("b", concurrency=4, service_time=ConstantLatency(0.01))
+        fb = Fallback("fb", primary=slow, fallback=backup, timeout=1.0)
+        sim = Simulation(entities=[slow, backup, fb], duration=10.0)
+        request = Event(t(0), "req", target=fb)
+        request.add_completion_hook(lambda time: fired.append(time.to_seconds()) or None)
+        sim.schedule(request)
+        sim.run()
+        # Upstream hook fires when the BACKUP completes (t≈1.01), not never
+        # (hooks parked on the hung primary) and not at primary finish.
+        assert fired == [pytest.approx(1.01)]
+
+    def test_pool_dial_timeout_does_not_orphan_connection(self):
+        from happysim_tpu import ConnectionPool, PooledClient
+
+        hole = Server("hole", concurrency=1, service_time=ConstantLatency(100.0))
+        pool = ConnectionPool(
+            "pool", target=hole, max_connections=1, connect_latency=ConstantLatency(1.0)
+        )
+        client = PooledClient("pc", connection_pool=pool, timeout=0.5)
+        sim = Simulation(entities=[hole, pool, client], duration=10.0)
+        sim.schedule(client.send_request(at=t(0)))
+        sim.run()
+        assert client.timeouts == 1
+        assert client.stats.failures == 1
+        # The dial completed after the caller gave up: the connection must be
+        # parked idle, not orphaned active.
+        assert pool.active_connections == 0
+        assert pool.idle_connections == 1
+
+    def test_load_balancer_failure_vs_success_tracking(self):
+        sink = Sink()
+        good = Server("good", concurrency=4, service_time=ConstantLatency(0.05), downstream=sink)
+        bad = Server("bad", concurrency=1, service_time=ConstantLatency(0.05), queue_capacity=0)
+        bad._crashed = True
+        lb = LoadBalancer("lb", backends=[good, bad])
+        sim = Simulation(entities=[sink, good, bad, lb], duration=10.0)
+        sim.schedule([Event(t(i * 0.5), "req", target=lb) for i in range(6)])
+        sim.run()
+        assert lb.backend_info(good).total_failures == 0
+        assert lb.backend_info(good).consecutive_successes == 3
+        assert lb.backend_info(bad).total_failures == 3
+        assert lb.backend_info(bad).consecutive_failures == 3

@@ -1,0 +1,191 @@
+"""Unit tests: CoDel, RED, fair queues, deadline queue, adaptive LIFO."""
+
+import pytest
+
+from happysim_tpu import ConstantLatency, Event, Instant, Server, Simulation, Sink
+from happysim_tpu.components.queue_policies import (
+    AdaptiveLIFO,
+    CoDelQueue,
+    DeadlineQueue,
+    FairQueue,
+    REDQueue,
+    WeightedFairQueue,
+)
+
+
+def t(seconds: float) -> Instant:
+    return Instant.from_seconds(seconds)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = Instant.Epoch
+
+    def __call__(self):
+        return self.now
+
+    def set(self, seconds):
+        self.now = t(seconds)
+
+
+class TestCoDel:
+    def test_no_drops_when_fast(self):
+        clock = _FakeClock()
+        q = CoDelQueue(target_delay=0.1, interval=0.5, clock_func=clock)
+        for i in range(5):
+            q.push(i)
+        assert [q.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert q.stats.dropped == 0
+
+    def test_drops_under_sustained_delay(self):
+        clock = _FakeClock()
+        q = CoDelQueue(target_delay=0.01, interval=0.1, clock_func=clock)
+        for i in range(50):
+            q.push(i)
+        popped = []
+        # Pop slowly: sojourn grows far beyond target for over an interval.
+        for step in range(50):
+            clock.set(0.5 + step * 0.05)
+            item = q.pop()
+            if item is not None:
+                popped.append(item)
+        assert q.stats.dropped > 0
+        assert q.stats.drop_mode_entries >= 1
+        assert len(popped) + q.stats.dropped == 50
+
+    def test_integrated_with_server(self):
+        sink = Sink()
+        server = Server(
+            "s",
+            concurrency=1,
+            service_time=ConstantLatency(0.2),
+            queue_policy=CoDelQueue(target_delay=0.05, interval=0.2),
+            downstream=sink,
+        )
+        sim = Simulation(entities=[server, sink], duration=60.0)
+        sim.schedule([Event(t(i * 0.05), "req", target=server) for i in range(100)])
+        sim.run()
+        # Offered 20/s vs capacity 5/s: CoDel must shed load.
+        assert server.queue.policy.stats.dropped > 0
+        assert sink.events_received + server.queue.policy.stats.dropped + server.queue.depth + 1 >= 100
+
+
+class TestRED:
+    def test_no_drops_below_min_threshold(self):
+        q = REDQueue(min_threshold=5, max_threshold=15, seed=0)
+        for i in range(4):
+            assert q.push(i) is True
+        assert q.stats.early_drops == 0
+
+    def test_probabilistic_drops_between_thresholds(self):
+        q = REDQueue(min_threshold=2, max_threshold=10, max_p=1.0, weight=1.0, seed=42)
+        accepted = sum(1 for i in range(50) if q.push(i))
+        assert 0 < accepted < 50
+        assert q.stats.early_drops + q.stats.forced_drops == 50 - accepted
+
+    def test_forced_drops_above_max(self):
+        q = REDQueue(min_threshold=1, max_threshold=3, weight=1.0, seed=0)
+        for i in range(20):
+            q.push(i)
+        assert q.stats.forced_drops > 0
+
+
+class TestFairQueue:
+    def _event(self, flow, seconds=0.0):
+        return Event(
+            t(seconds), "req", target=_SINK, context={"metadata": {"flow": flow}}
+        )
+
+    def test_round_robin_across_flows(self):
+        q = FairQueue()
+        for i in range(3):
+            q.push(self._event("a", i * 0.01))
+        q.push(self._event("b"))
+        order = [q.pop().context["metadata"]["flow"] for _ in range(4)]
+        # b must not wait behind all three a's.
+        assert order.index("b") <= 1
+
+    def test_single_flow_fifo(self):
+        q = FairQueue()
+        events = [self._event("a", i * 0.01) for i in range(3)]
+        for e in events:
+            q.push(e)
+        assert [q.pop() for _ in range(3)] == events
+
+    def test_weighted_fair_queue_proportional(self):
+        q = WeightedFairQueue(weights={"heavy": 3.0, "light": 1.0})
+        for i in range(12):
+            q.push(self._event("heavy", i * 0.001))
+        for i in range(12):
+            q.push(self._event("light", i * 0.001))
+        first_eight = [q.pop().context["metadata"]["flow"] for _ in range(8)]
+        # Weight 3:1 → roughly 6 heavy / 2 light among the first 8.
+        assert first_eight.count("heavy") >= 5
+
+
+class TestDeadlineQueue:
+    def _event(self, deadline, label):
+        e = Event(t(0), "req", target=_SINK, context={"metadata": {"deadline": deadline}})
+        e.context["metadata"]["label"] = label
+        return e
+
+    def test_edf_order(self):
+        clock = _FakeClock()
+        q = DeadlineQueue(clock_func=clock)
+        q.push(self._event(3.0, "late"))
+        q.push(self._event(1.0, "urgent"))
+        q.push(self._event(2.0, "middle"))
+        labels = [q.pop().context["metadata"]["label"] for _ in range(3)]
+        assert labels == ["urgent", "middle", "late"]
+
+    def test_expired_dropped_at_pop(self):
+        clock = _FakeClock()
+        q = DeadlineQueue(clock_func=clock)
+        q.push(self._event(0.5, "expired"))
+        q.push(self._event(5.0, "ok"))
+        clock.set(1.0)
+        assert q.pop().context["metadata"]["label"] == "ok"
+        assert q.stats.expired == 1
+
+    def test_purge(self):
+        clock = _FakeClock()
+        q = DeadlineQueue(clock_func=clock)
+        for i in range(5):
+            q.push(self._event(0.1 * (i + 1), str(i)))
+        clock.set(0.35)
+        assert q.count_expired() == 3
+        assert q.purge_expired() == 3
+        assert len(q) == 2
+
+
+class TestAdaptiveLIFO:
+    def test_fifo_normally(self):
+        q = AdaptiveLIFO(congestion_threshold=100)
+        for i in range(5):
+            q.push(i)
+        assert [q.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert q.mode == "fifo"
+
+    def test_switches_to_lifo_under_congestion(self):
+        q = AdaptiveLIFO(congestion_threshold=5, recovery_threshold=2)
+        for i in range(6):
+            q.push(i)
+        assert q.mode == "lifo"
+        assert q.pop() == 5  # newest first under congestion
+        assert q.pop() == 4
+
+    def test_recovers_to_fifo(self):
+        q = AdaptiveLIFO(congestion_threshold=4, recovery_threshold=1)
+        for i in range(5):
+            q.push(i)
+        while len(q) > 1:
+            q.pop()
+        assert q.mode == "fifo"
+        assert q.mode_switches == 2
+
+
+class _Sink:
+    name = "sink"
+
+
+_SINK = _Sink()
