@@ -22,6 +22,12 @@ import logging
 logging.getLogger("happysim_tpu").addHandler(logging.NullHandler())
 
 from happysim_tpu.components import (
+    Barrier,
+    BrokenBarrierError,
+    Condition,
+    Mutex,
+    RWLock,
+    Semaphore,
     ConcurrencyModel,
     Counter,
     DynamicConcurrency,
@@ -99,6 +105,7 @@ from happysim_tpu.components.resilience import (
 )
 from happysim_tpu.core import (
     CallbackEntity,
+    CancelledError,
     Clock,
     ConditionBreakpoint,
     Duration,

@@ -39,6 +39,19 @@ from happysim_tpu.components.resilience import (
     Hedge,
     TimeoutWrapper,
 )
+from happysim_tpu.components.sync import (
+    Barrier,
+    BarrierStats,
+    BrokenBarrierError,
+    Condition,
+    ConditionStats,
+    Mutex,
+    MutexStats,
+    RWLock,
+    RWLockStats,
+    Semaphore,
+    SemaphoreStats,
+)
 from happysim_tpu.components.queue import Queue
 from happysim_tpu.components.queue_driver import QueueDriver
 from happysim_tpu.components.queue_policy import (
@@ -125,6 +138,17 @@ __all__ = [
     "QuantileEstimator",
     "SketchCollector",
     "TopKCollector",
+    "Barrier",
+    "BarrierStats",
+    "BrokenBarrierError",
+    "Condition",
+    "ConditionStats",
+    "Mutex",
+    "MutexStats",
+    "RWLock",
+    "RWLockStats",
+    "Semaphore",
+    "SemaphoreStats",
     "ConcurrencyModel",
     "Counter",
     "DynamicConcurrency",

@@ -163,22 +163,28 @@ class ConnectionPool(Entity):
             return [self._idle_check_event(connection)]
         return []
 
-    def cancel_acquire(self, future: SimFuture) -> None:
+    def cancel_acquire(self, future: SimFuture) -> list[Event]:
         """Abandon a pending acquire (e.g. the caller timed out).
 
-        Covers both queued waiters and in-progress dials: an abandoned dial
-        still completes, but its connection goes to the next waiter or the
-        idle list instead of being orphaned as active. No-op if the future
-        already resolved.
+        Covers queued waiters, in-progress dials, AND the same-instant race
+        where a release already handed this future a connection before the
+        cancel ran — that connection is recycled (to the next waiter or the
+        idle list) instead of being orphaned as active forever. Returns any
+        events to schedule (idle-timeout checks from the recycle path).
         """
         dial_id = self._dial_id_of.pop(id(future), None)
         if dial_id is not None:
             self._abandoned_dials.add(dial_id)
-            return
+            return []
         for waiter in self._waiters:
             if waiter.future is future:
                 waiter.cancelled = True
-                return
+                return []
+        if future.is_resolved and not future.is_cancelled:
+            conn = future.value
+            if isinstance(conn, Connection) and conn.id in self._active:
+                return self.release(conn)
+        return []
 
     # Backwards-compatible alias.
     cancel_waiter = cancel_acquire

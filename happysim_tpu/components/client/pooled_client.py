@@ -101,10 +101,10 @@ class PooledClient(Entity):
                 [*dial_events, timeout_event],
             )
             if index == 1:  # timed out while waiting for a connection
-                self.pool.cancel_acquire(acquire_future)
+                recycled = self.pool.cancel_acquire(acquire_future)
                 self.in_flight -= 1
                 self.timeouts += 1
-                return self._retry_or_fail(metadata, attempt)
+                return [*recycled, *(self._retry_or_fail(metadata, attempt) or [])] or None
             conn = value
         else:
             conn = yield acquire_future, dial_events

@@ -13,6 +13,7 @@ from typing import TYPE_CHECKING, Optional
 
 from happysim_tpu.core.entity import Entity
 from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import _get_active_heap
 from happysim_tpu.instrumentation.summary import QueueStats
 
 if TYPE_CHECKING:
@@ -105,7 +106,17 @@ class Queue(Entity):
     def _on_policy_drop(self, item) -> None:
         if isinstance(item, Event):
             self.dropped += 1
-            self._pending_drop_events.extend(item.complete_as_dropped(self.now, self.name))
+            produced = item.complete_as_dropped(self.now, self.name)
+            # Schedule the unwind NOW: a user-invoked purge_expired() may
+            # happen far from any poll, and parking these until the next
+            # poll would both delay the unwind indefinitely and eventually
+            # push past-timestamped events (time travel).
+            heap = _get_active_heap()
+            if heap is not None:
+                for produced_event in produced:
+                    heap.push(produced_event)
+            else:
+                self._pending_drop_events.extend(produced)
 
     def _handle_poll(self, event: Event):
         if self.driver is None:

@@ -103,7 +103,7 @@ class DistributedRateLimiter(Entity):
         if self._known_global + self._local_pending >= self.global_limit:
             self.rejected += 1
             event.context["metadata"]["rejected_by"] = self.name
-            return None
+            return event.complete_as_dropped(self.now, self.name) or None
 
         self._local_pending += 1
         if self._local_pending < self.sync_interval:
@@ -112,8 +112,11 @@ class DistributedRateLimiter(Entity):
             return [self.forward(event, self.downstream)]
 
         # Sync point: pay the store round-trip, reconcile the global count.
+        # Capture-and-reset BEFORE yielding: a second request arriving during
+        # the round-trip must start a fresh pending count, otherwise two
+        # overlapping syncs both push the same admissions (double counting).
         delay = self.store_latency.get_latency(self.now).to_seconds()
-        pending = self._local_pending
+        pending, self._local_pending = self._local_pending, 0
         yield delay
         self.store_syncs += 1
         new_total = self.store.add(window_id, pending)
@@ -123,7 +126,6 @@ class DistributedRateLimiter(Entity):
             self.admitted += 1
             return [self.forward(event, self.downstream)]
         self._known_global = new_total
-        self._local_pending = 0
         if new_total > self.global_limit:
             # The fleet overshot while we batched: reject this request.
             self.rejected += 1

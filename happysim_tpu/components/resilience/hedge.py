@@ -83,24 +83,24 @@ class Hedge(Entity):
             "original": event,
             "hooks": event.on_complete,
             "outstanding": 1,
+            "pending_fire": None,
         }
         event.on_complete = []
         produced = [self._attempt(event, request_id, attempt=0, at=self.now)]
         if self.max_hedges > 0:
-            produced.append(self._fire_event(request_id, hedge_number=1))
+            fire = self._fire_event(request_id, hedge_number=1)
+            self._in_flight[request_id]["pending_fire"] = fire
+            produced.append(fire)
         return produced
 
     def _attempt(self, original: Event, request_id: int, attempt: int, at: Instant) -> Event:
-        # Hedge copies get a *copied* context so duplicated downstream work
-        # doesn't share mutable metadata with the primary.
-        context = (
-            original.context
-            if attempt == 0
-            else {
-                "created_at": original.context.get("created_at"),
-                "metadata": dict(original.context.get("metadata", {})),
-            }
-        )
+        # EVERY attempt (primary included) gets a copied context: a dropped
+        # primary writes dropped_by into its own copy, so a later hedge win
+        # doesn't read as a drop through the original's shared metadata.
+        context = {
+            "created_at": original.context.get("created_at"),
+            "metadata": dict(original.context.get("metadata", {})),
+        }
         copy = Event(at, original.event_type, target=self.downstream, context=context)
 
         def done(t, a=attempt, sent=copy):
@@ -121,11 +121,13 @@ class Hedge(Entity):
         return copy
 
     def _fire_event(self, request_id: int, hedge_number: int) -> Event:
+        # NOT a daemon: a fast-failed primary would otherwise leave only
+        # this event in the heap and auto-termination would kill the hedge
+        # the request is waiting on. Cancelled explicitly on completion.
         return Event(
             self.now + self.hedge_delay * hedge_number,
             "_hedge_fire",
             target=self,
-            daemon=True,
             context={"metadata": {"request_id": request_id, "hedge_number": hedge_number}},
         )
 
@@ -139,9 +141,12 @@ class Hedge(Entity):
         self.hedges_sent += 1
         info["hedges"] = hedge_number
         info["outstanding"] += 1
+        info["pending_fire"] = None
         produced = [self._attempt(info["original"], request_id, attempt=hedge_number, at=self.now)]
         if hedge_number < self.max_hedges:
-            produced.append(self._fire_event(request_id, hedge_number + 1))
+            fire = self._fire_event(request_id, hedge_number + 1)
+            info["pending_fire"] = fire
+            produced.append(fire)
         return produced
 
     def _handle_done(self, event: Event):
@@ -158,13 +163,26 @@ class Hedge(Entity):
             if info["outstanding"] > 0 or info["hedges"] < self.max_hedges:
                 return None
             self._in_flight.pop(request_id)
+            self._cancel_fire(info)
+            # Every attempt dropped: since attempts use isolated contexts,
+            # the original must be marked so upstream hooks see the drop.
+            info["original"].context.setdefault("metadata", {})["dropped_by"] = metadata.get(
+                "dropped"
+            )
             return self._fire_hooks(info) or None
         self._in_flight.pop(request_id)
+        self._cancel_fire(info)
         if metadata["attempt"] == 0:
             self.primary_wins += 1
         else:
             self.hedge_wins += 1
         return self._fire_hooks(info) or None
+
+    @staticmethod
+    def _cancel_fire(info: dict) -> None:
+        if info.get("pending_fire") is not None:
+            info["pending_fire"].cancel()
+            info["pending_fire"] = None
 
     def _fire_hooks(self, info: dict) -> list[Event]:
         from happysim_tpu.core.event import _normalize_events

@@ -1,0 +1,37 @@
+"""Synchronization primitives — simulation-aware concurrency control.
+
+Parity target: ``happysimulator/components/sync/`` (mutex, semaphore, rwlock,
+barrier, condition). The reference implements waiting with busy-wait
+``yield 0.0`` loops; here every primitive parks waiters on
+:class:`~happysim_tpu.core.sim_future.SimFuture` instead — one heap event per
+wakeup rather than one per spin — which is both faster and composable with
+``any_of``/``all_of`` (e.g. lock acquisition with timeout).
+
+Usage from a generator handler::
+
+    yield mutex.acquire()
+    try:
+        yield 0.01                      # critical section
+    finally:
+        mutex.release()
+"""
+
+from happysim_tpu.components.sync.barrier import Barrier, BarrierStats, BrokenBarrierError
+from happysim_tpu.components.sync.condition import Condition, ConditionStats
+from happysim_tpu.components.sync.mutex import Mutex, MutexStats
+from happysim_tpu.components.sync.rwlock import RWLock, RWLockStats
+from happysim_tpu.components.sync.semaphore import Semaphore, SemaphoreStats
+
+__all__ = [
+    "Barrier",
+    "BarrierStats",
+    "BrokenBarrierError",
+    "Condition",
+    "ConditionStats",
+    "Mutex",
+    "MutexStats",
+    "RWLock",
+    "RWLockStats",
+    "Semaphore",
+    "SemaphoreStats",
+]

@@ -1,0 +1,20 @@
+"""Shared base for sync primitives."""
+
+from __future__ import annotations
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.sim_future import _get_active_clock
+
+
+class SyncPrimitive(Entity):
+    """Entity that can read time without being registered in a Simulation.
+
+    Sync primitives are often plain shared objects (never event targets), so
+    they fall back to the running simulation's ambient clock for wait-time
+    accounting; 0 when called outside any simulation (stats then under-count,
+    they never crash).
+    """
+
+    def _now_ns(self) -> int:
+        clock = self._clock or _get_active_clock()
+        return clock.now.nanoseconds if clock is not None else 0

@@ -1,0 +1,145 @@
+"""Cyclic barrier — parties rendezvous, then all proceed together.
+
+Parity target: ``happysimulator/components/sync/barrier.py:51`` (``wait``
+:124, ``_break_barrier`` :189, ``reset`` :205, ``abort`` :239,
+``BarrierStats`` :34). The reference raises RuntimeError inside spinning
+waiters when the barrier breaks; here ``abort()``/``reset()`` reject the
+parked futures with :class:`BrokenBarrierError`, which is thrown into each
+waiting generator at its ``yield`` — same observable behavior, no spinning.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+from happysim_tpu.components.sync._base import SyncPrimitive
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+class BrokenBarrierError(RuntimeError):
+    """Raised in waiters when the barrier is aborted or reset under them."""
+
+
+@dataclass(frozen=True)
+class BarrierStats:
+    """Frozen snapshot of barrier statistics."""
+
+    wait_calls: int = 0
+    barrier_breaks: int = 0
+    resets: int = 0
+    total_wait_time_ns: int = 0
+
+
+@dataclass
+class _BarrierWaiter:
+    future: SimFuture
+    enqueue_time_ns: int
+
+
+class Barrier(SyncPrimitive):
+    """``parties`` processes call ``wait()``; the last arrival releases all.
+
+    ``wait()`` returns a SimFuture resolving to the caller's arrival index —
+    the last arrival (the "leader") gets index 0, matching the reference's
+    convention — and the barrier advances a generation for reuse.
+    """
+
+    def __init__(self, name: str, parties: int):
+        super().__init__(name)
+        if parties < 1:
+            raise ValueError(f"parties must be >= 1, got {parties}")
+        self._parties = parties
+        self._waiters: deque[_BarrierWaiter] = deque()
+        self._generation = 0
+        self._broken = False
+        self._wait_calls = 0
+        self._barrier_breaks = 0
+        self._resets = 0
+        self._total_wait_time_ns = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def parties(self) -> int:
+        return self._parties
+
+    @property
+    def waiting(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def broken(self) -> bool:
+        return self._broken
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    @property
+    def stats(self) -> BarrierStats:
+        return BarrierStats(
+            wait_calls=self._wait_calls,
+            barrier_breaks=self._barrier_breaks,
+            resets=self._resets,
+            total_wait_time_ns=self._total_wait_time_ns,
+        )
+
+    # -- protocol ----------------------------------------------------------
+    def wait(self) -> SimFuture:
+        """Future resolving to this party's arrival index when all arrive.
+
+        Raises BrokenBarrierError immediately (synchronously) if the barrier
+        is already broken.
+        """
+        if self._broken:
+            raise BrokenBarrierError(f"Barrier {self.name} is broken")
+        # Drop parties that cancelled their wait so they don't count toward
+        # the rendezvous.
+        if any(w.future.is_resolved for w in self._waiters):
+            self._waiters = deque(w for w in self._waiters if not w.future.is_resolved)
+        self._wait_calls += 1
+        future: SimFuture = SimFuture()
+        if len(self._waiters) + 1 >= self._parties:
+            # Last arrival trips the barrier: release everyone, lead with 0.
+            self._trip()
+            future.resolve(0)
+            return future
+        self._waiters.append(_BarrierWaiter(future, self._now_ns()))
+        return future
+
+    def _trip(self) -> None:
+        # "barrier_breaks" counts successful trips — the reference's naming
+        # (its _break_barrier is the last-arrival release path, :150-189),
+        # kept for stats parity. Aborts are visible via `broken` + resets.
+        self._barrier_breaks += 1
+        now = self._now_ns()
+        index = self._parties - len(self._waiters)
+        while self._waiters:
+            waiter = self._waiters.popleft()
+            self._total_wait_time_ns += now - waiter.enqueue_time_ns
+            waiter.future.resolve(index)
+            index += 1
+        self._generation += 1
+
+    def reset(self) -> None:
+        """Break the current cycle (waiters see BrokenBarrierError), then
+        return to a clean, usable state at the next generation."""
+        self._resets += 1
+        self._reject_all()
+        self._broken = False
+        self._generation += 1
+
+    def abort(self) -> None:
+        """Permanently break the barrier until ``reset()`` is called."""
+        self._reject_all()
+
+    def _reject_all(self) -> None:
+        self._broken = True
+        while self._waiters:
+            waiter = self._waiters.popleft()
+            waiter.future.reject(BrokenBarrierError(f"Barrier {self.name} is broken"))
+
+    def handle_event(self, event: Event) -> None:
+        """Barrier is passive — it never receives events directly."""
+        return None

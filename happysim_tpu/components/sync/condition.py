@@ -1,0 +1,163 @@
+"""Condition variable bound to a Mutex (monitor pattern).
+
+Parity target: ``happysimulator/components/sync/condition.py:63`` (``wait``
+:126, ``wait_for`` :176, ``notify`` :211, ``notify_all`` :234,
+``ConditionStats`` :45).
+
+``wait()`` atomically releases the mutex and parks; on ``notify`` the woken
+waiter re-queues for the mutex, and its future resolves only once the mutex
+is re-held — exactly the monitor contract. ``wait_for`` is a generator helper
+(use ``yield from``) that loops wait-and-recheck around a predicate.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Generator, Optional
+
+from happysim_tpu.components.sync._base import SyncPrimitive
+from happysim_tpu.components.sync.mutex import Mutex
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+@dataclass(frozen=True)
+class ConditionStats:
+    """Frozen snapshot of condition-variable statistics."""
+
+    waits: int = 0
+    notifies: int = 0
+    notify_alls: int = 0
+    wakeups: int = 0
+    total_wait_time_ns: int = 0
+
+
+@dataclass
+class _Waiter:
+    future: SimFuture
+    owner: Optional[str]
+    enqueue_time_ns: int
+
+
+class Condition(SyncPrimitive):
+    """Wait/notify over a shared Mutex."""
+
+    def __init__(self, name: str, lock: Mutex):
+        super().__init__(name)
+        self._lock = lock
+        self._waiters: deque[_Waiter] = deque()
+        self._waits = 0
+        self._notifies = 0
+        self._notify_alls = 0
+        self._wakeups = 0
+        self._total_wait_time_ns = 0
+
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        # Condition may be registered without its mutex; share the clock so
+        # wait-time accounting works either way.
+        if self._lock._clock is None:
+            self._lock.set_clock(clock)
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self._lock]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def lock(self) -> Mutex:
+        return self._lock
+
+    @property
+    def waiters(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def stats(self) -> ConditionStats:
+        return ConditionStats(
+            waits=self._waits,
+            notifies=self._notifies,
+            notify_alls=self._notify_alls,
+            wakeups=self._wakeups,
+            total_wait_time_ns=self._total_wait_time_ns,
+        )
+
+    # -- protocol ----------------------------------------------------------
+    def wait(self, owner: Optional[str] = None) -> SimFuture:
+        """Release the mutex, park until notified, re-acquire, then resolve.
+
+        The returned future resolves with None once the caller holds the
+        mutex again. Spurious wakeups don't occur, but the monitored
+        condition may have changed by re-acquisition time — callers should
+        still loop over their predicate (or use ``wait_for``).
+        """
+        if not self._lock.is_locked:
+            raise RuntimeError(f"Condition {self.name}: wait() called without holding mutex")
+        self._waits += 1
+        waiter = _Waiter(SimFuture(), owner, self._now_ns())
+        self._waiters.append(waiter)
+        self._lock.release()
+        return waiter.future
+
+    def wait_for(
+        self,
+        predicate: Callable[[], bool],
+        timeout: Optional[float] = None,
+        owner: Optional[str] = None,
+    ) -> Generator[SimFuture, None, bool]:
+        """Loop ``wait()`` until ``predicate()`` holds. Use with ``yield from``.
+
+        Returns True when the predicate held, False when ``timeout`` seconds
+        of simulated time elapsed first (checked at each wakeup, like the
+        reference — a never-notified wait with a timeout still parks forever).
+        """
+        if not self._lock.is_locked:
+            raise RuntimeError(
+                f"Condition {self.name}: wait_for() called without holding mutex"
+            )
+        start_ns = self._now_ns()
+        while not predicate():
+            if timeout is not None:
+                elapsed_s = (self._now_ns() - start_ns) / 1e9
+                if elapsed_s >= timeout:
+                    return False
+            yield self.wait(owner)
+        return True
+
+    def notify(self, n: int = 1) -> list[Event]:
+        """Wake up to ``n`` waiters; each re-queues for the mutex."""
+        self._notifies += 1
+        self._wake(n)
+        return []
+
+    def notify_all(self) -> list[Event]:
+        """Wake every waiter; they contend for the mutex in FIFO order."""
+        self._notify_alls += 1
+        self._wake(len(self._waiters))
+        return []
+
+    def _wake(self, n: int) -> None:
+        woken = 0
+        while self._waiters and woken < n:
+            waiter = self._waiters.popleft()
+            if waiter.future.is_resolved:  # cancelled — doesn't consume a notify
+                continue
+            woken += 1
+
+            def on_reacquired(_f: SimFuture, w: _Waiter = waiter) -> None:
+                if w.future.is_resolved:
+                    # Waiter cancelled between notify and re-acquisition; we
+                    # were just handed the mutex — give it straight back.
+                    self._lock.release()
+                    return
+                self._total_wait_time_ns += self._now_ns() - w.enqueue_time_ns
+                w.future.resolve(None)
+
+            self._lock.acquire(waiter.owner)._add_settle_callback(on_reacquired)
+        self._wakeups += woken
+
+    def handle_event(self, event: Event) -> None:
+        """Condition is passive — it never receives events directly."""
+        return None

@@ -1,0 +1,128 @@
+"""Mutual-exclusion lock with FIFO waiter queue.
+
+Parity target: ``happysimulator/components/sync/mutex.py:49`` (``try_acquire``
+:106, ``acquire`` :123, ``release`` :170, ``MutexStats`` :31). Waiting is
+future-based rather than the reference's spin loop: ``acquire()`` returns a
+:class:`SimFuture` that resolves (possibly immediately) once the caller holds
+the lock, so handlers write ``yield mutex.acquire()``.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.components.sync._base import SyncPrimitive
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+@dataclass(frozen=True)
+class MutexStats:
+    """Frozen snapshot of mutex statistics."""
+
+    acquisitions: int = 0
+    releases: int = 0
+    contentions: int = 0
+    total_wait_time_ns: int = 0
+
+
+@dataclass
+class _Waiter:
+    future: SimFuture
+    owner: Optional[str]
+    enqueue_time_ns: int
+
+
+class Mutex(SyncPrimitive):
+    """Only one holder at a time; waiters wake in FIFO order on release.
+
+    On release the lock transfers directly to the next waiter (no barging):
+    its future resolves at the releasing event's timestamp.
+    """
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._locked = False
+        self._owner: Optional[str] = None
+        self._waiters: deque[_Waiter] = deque()
+        self._acquisitions = 0
+        self._releases = 0
+        self._contentions = 0
+        self._total_wait_time_ns = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def is_locked(self) -> bool:
+        return self._locked
+
+    @property
+    def owner(self) -> Optional[str]:
+        return self._owner
+
+    @property
+    def waiters(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def stats(self) -> MutexStats:
+        return MutexStats(
+            acquisitions=self._acquisitions,
+            releases=self._releases,
+            contentions=self._contentions,
+            total_wait_time_ns=self._total_wait_time_ns,
+        )
+
+    # -- protocol ----------------------------------------------------------
+    def try_acquire(self, owner: Optional[str] = None) -> bool:
+        """Non-blocking attempt; True iff the lock was free."""
+        if self._locked:
+            return False
+        self._locked = True
+        self._owner = owner
+        self._acquisitions += 1
+        return True
+
+    def acquire(self, owner: Optional[str] = None) -> SimFuture:
+        """Future resolving once the caller holds the lock.
+
+        Resolves immediately (pre-resolved) when uncontended; otherwise the
+        caller joins the FIFO queue and wakes when the lock transfers to it.
+        """
+        future: SimFuture = SimFuture()
+        if self.try_acquire(owner):
+            future.resolve(None)
+            return future
+        self._contentions += 1
+        self._waiters.append(_Waiter(future, owner, self._now_ns()))
+        return future
+
+    def release(self) -> list[Event]:
+        """Release; lock transfers to the next waiter if any.
+
+        Returns an empty list for drop-in use as a handler return value —
+        wakeups self-schedule through future resolution.
+        """
+        if not self._locked:
+            raise RuntimeError(f"Mutex {self.name} released when not locked")
+        self._releases += 1
+        self._owner = None
+        while self._waiters:
+            waiter = self._waiters.popleft()
+            if waiter.future.is_resolved:
+                # Cancelled (e.g. lost an any_of timeout race) — skip, don't
+                # strand the lock on a process that moved on.
+                continue
+            # Lock transfers directly: stays locked, new owner recorded.
+            self._owner = waiter.owner
+            self._acquisitions += 1
+            self._total_wait_time_ns += self._now_ns() - waiter.enqueue_time_ns
+            waiter.future.resolve(None)
+            return []
+        self._locked = False
+        return []
+
+    def handle_event(self, event: Event) -> None:
+        """Mutex is passive — it never receives events directly."""
+        return None

@@ -1,0 +1,213 @@
+"""Reader-writer lock with writer preference.
+
+Parity target: ``happysimulator/components/sync/rwlock.py:73``
+(``try_acquire_read`` :158, ``try_acquire_write`` :180, ``acquire_read`` :193,
+``acquire_write`` :230, ``_wake_waiters`` :303, ``RWLockStats`` :50).
+
+Semantics match the reference: many concurrent readers (optionally capped by
+``max_readers``), one exclusive writer; a *waiting* writer blocks new readers
+from barging (anti-starvation); on wake, a writer at the queue front goes
+alone, otherwise consecutive readers are woken as a batch up to the cap.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+from happysim_tpu.components.sync._base import SyncPrimitive
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+class _WaiterType(Enum):
+    READER = "reader"
+    WRITER = "writer"
+
+
+@dataclass(frozen=True)
+class RWLockStats:
+    """Frozen snapshot of read-write lock statistics."""
+
+    read_acquisitions: int = 0
+    write_acquisitions: int = 0
+    read_releases: int = 0
+    write_releases: int = 0
+    read_contentions: int = 0
+    write_contentions: int = 0
+    total_read_wait_ns: int = 0
+    total_write_wait_ns: int = 0
+    peak_readers: int = 0
+
+
+@dataclass
+class _Waiter:
+    waiter_type: _WaiterType
+    future: SimFuture
+    enqueue_time_ns: int
+
+
+class RWLock(SyncPrimitive):
+    """Shared-read / exclusive-write lock with FIFO queue + writer preference."""
+
+    def __init__(self, name: str, max_readers: Optional[int] = None):
+        super().__init__(name)
+        if max_readers is not None and max_readers < 1:
+            raise ValueError(f"max_readers must be >= 1, got {max_readers}")
+        self._max_readers = max_readers
+        self._active_readers = 0
+        self._write_locked = False
+        self._waiters: deque[_Waiter] = deque()
+        self._waiting_writers = 0  # unsettled WRITER entries in _waiters
+        self._read_acquisitions = 0
+        self._write_acquisitions = 0
+        self._read_releases = 0
+        self._write_releases = 0
+        self._read_contentions = 0
+        self._write_contentions = 0
+        self._total_read_wait_ns = 0
+        self._total_write_wait_ns = 0
+        self._peak_readers = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def active_readers(self) -> int:
+        return self._active_readers
+
+    @property
+    def is_write_locked(self) -> bool:
+        return self._write_locked
+
+    @property
+    def max_readers(self) -> Optional[int]:
+        return self._max_readers
+
+    @property
+    def waiters(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def stats(self) -> RWLockStats:
+        return RWLockStats(
+            read_acquisitions=self._read_acquisitions,
+            write_acquisitions=self._write_acquisitions,
+            read_releases=self._read_releases,
+            write_releases=self._write_releases,
+            read_contentions=self._read_contentions,
+            write_contentions=self._write_contentions,
+            total_read_wait_ns=self._total_read_wait_ns,
+            total_write_wait_ns=self._total_write_wait_ns,
+            peak_readers=self._peak_readers,
+        )
+
+    def _has_waiting_writer(self) -> bool:
+        return self._waiting_writers > 0
+
+    # -- protocol ----------------------------------------------------------
+    def try_acquire_read(self) -> bool:
+        """Non-blocking read acquire; respects writer preference and cap."""
+        if self._write_locked or self._has_waiting_writer():
+            return False
+        if self._max_readers is not None and self._active_readers >= self._max_readers:
+            return False
+        self._active_readers += 1
+        self._read_acquisitions += 1
+        self._peak_readers = max(self._peak_readers, self._active_readers)
+        return True
+
+    def try_acquire_write(self) -> bool:
+        """Non-blocking write acquire; needs zero readers and no writer."""
+        if self._write_locked or self._active_readers > 0:
+            return False
+        self._write_locked = True
+        self._write_acquisitions += 1
+        return True
+
+    def acquire_read(self) -> SimFuture:
+        """Future resolving once a shared read hold is granted."""
+        future: SimFuture = SimFuture()
+        if self.try_acquire_read():
+            future.resolve(None)
+            return future
+        self._read_contentions += 1
+        self._waiters.append(_Waiter(_WaiterType.READER, future, self._now_ns()))
+        future._add_settle_callback(self._on_reader_settled)
+        return future
+
+    def _on_reader_settled(self, future: SimFuture) -> None:
+        if future.is_cancelled:
+            self._wake_waiters()
+
+    def acquire_write(self) -> SimFuture:
+        """Future resolving once the exclusive write hold is granted."""
+        future: SimFuture = SimFuture()
+        if self.try_acquire_write():
+            future.resolve(None)
+            return future
+        self._write_contentions += 1
+        self._waiters.append(_Waiter(_WaiterType.WRITER, future, self._now_ns()))
+        # Settles on grant OR cancel, so the count tracks live writer waits
+        # exactly — cancelled writers stop blocking new readers immediately.
+        self._waiting_writers += 1
+        future._add_settle_callback(self._writer_settled)
+        return future
+
+    def _writer_settled(self, future: SimFuture) -> None:
+        self._waiting_writers -= 1
+        if future.is_cancelled:
+            # Queued readers behind this writer may now be able to share.
+            self._wake_waiters()
+
+    def release_read(self) -> list[Event]:
+        if self._active_readers == 0:
+            raise RuntimeError(f"RWLock {self.name}: release_read with no active readers")
+        self._active_readers -= 1
+        self._read_releases += 1
+        self._wake_waiters()
+        return []
+
+    def release_write(self) -> list[Event]:
+        if not self._write_locked:
+            raise RuntimeError(f"RWLock {self.name}: release_write when not write-locked")
+        self._write_locked = False
+        self._write_releases += 1
+        self._wake_waiters()
+        return []
+
+    def _wake_waiters(self) -> None:
+        while self._waiters and self._waiters[0].future.is_resolved:
+            self._waiters.popleft()  # cancelled — drop from the queue
+        if not self._waiters or self._write_locked:
+            return
+        front = self._waiters[0]
+        if front.waiter_type is _WaiterType.WRITER:
+            if self._active_readers == 0:
+                self._waiters.popleft()
+                self._write_locked = True
+                self._write_acquisitions += 1
+                self._total_write_wait_ns += self._now_ns() - front.enqueue_time_ns
+                front.future.resolve(None)
+            return
+        # Wake consecutive readers up to the cap; stop at the first live
+        # writer (cancelled entries of either type are dropped in passing).
+        while self._waiters:
+            waiter = self._waiters[0]
+            if waiter.future.is_resolved:
+                self._waiters.popleft()
+                continue
+            if waiter.waiter_type is not _WaiterType.READER:
+                break
+            if self._max_readers is not None and self._active_readers >= self._max_readers:
+                break
+            self._waiters.popleft()
+            self._active_readers += 1
+            self._read_acquisitions += 1
+            self._peak_readers = max(self._peak_readers, self._active_readers)
+            self._total_read_wait_ns += self._now_ns() - waiter.enqueue_time_ns
+            waiter.future.resolve(None)
+
+    def handle_event(self, event: Event) -> None:
+        """RWLock is passive — it never receives events directly."""
+        return None

@@ -1,0 +1,148 @@
+"""Counting semaphore with FIFO waiter queue.
+
+Parity target: ``happysimulator/components/sync/semaphore.py:52``
+(``try_acquire`` :115, ``acquire`` :134, ``release`` :185, ``_wake_waiters``
+:216, ``SemaphoreStats`` :33). Future-based waiting; multi-permit requests
+block the queue head-of-line (FIFO, no barging past a large request).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+from happysim_tpu.components.sync._base import SyncPrimitive
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+@dataclass(frozen=True)
+class SemaphoreStats:
+    """Frozen snapshot of semaphore statistics."""
+
+    acquisitions: int = 0
+    releases: int = 0
+    contentions: int = 0
+    total_wait_time_ns: int = 0
+    peak_waiters: int = 0
+
+
+@dataclass
+class _Waiter:
+    count: int
+    future: SimFuture
+    enqueue_time_ns: int
+
+
+class Semaphore(SyncPrimitive):
+    """``initial_count`` permits; ``acquire(n)`` waits until n are free."""
+
+    def __init__(self, name: str, initial_count: int):
+        super().__init__(name)
+        if initial_count < 1:
+            # Matches the reference (:74-75): capacity == initial permits, so
+            # a 0-permit signaling semaphore is not expressible — permits can
+            # never accumulate past the initial count (see release()).
+            raise ValueError(f"initial_count must be >= 1, got {initial_count}")
+        self._capacity = initial_count
+        self._available = initial_count
+        self._waiters: deque[_Waiter] = deque()
+        self._acquisitions = 0
+        self._releases = 0
+        self._contentions = 0
+        self._total_wait_time_ns = 0
+        self._peak_waiters = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def available(self) -> int:
+        return self._available
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def waiters(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def stats(self) -> SemaphoreStats:
+        return SemaphoreStats(
+            acquisitions=self._acquisitions,
+            releases=self._releases,
+            contentions=self._contentions,
+            total_wait_time_ns=self._total_wait_time_ns,
+            peak_waiters=self._peak_waiters,
+        )
+
+    # -- protocol ----------------------------------------------------------
+    def try_acquire(self, count: int = 1) -> bool:
+        """Non-blocking; True iff ``count`` permits were available."""
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        if count > self._capacity:
+            raise ValueError(
+                f"count {count} exceeds semaphore capacity {self._capacity}; "
+                "this could never be satisfied"
+            )
+        # Queued waiters go first — barging would starve multi-permit waits.
+        if self._waiters or self._available < count:
+            return False
+        self._available -= count
+        self._acquisitions += 1
+        return True
+
+    def acquire(self, count: int = 1) -> SimFuture:
+        """Future resolving once ``count`` permits are held."""
+        future: SimFuture = SimFuture()
+        if self.try_acquire(count):
+            future.resolve(None)
+            return future
+        self._contentions += 1
+        self._waiters.append(_Waiter(count, future, self._now_ns()))
+        self._peak_waiters = max(self._peak_waiters, len(self._waiters))
+        # A cancelled head-of-line waiter must not block eligible waiters
+        # behind it until the next release.
+        future._add_settle_callback(self._on_waiter_settled)
+        return future
+
+    def _on_waiter_settled(self, future: SimFuture) -> None:
+        if future.is_cancelled:
+            self._wake_waiters()
+
+    def release(self, count: int = 1) -> list[Event]:
+        """Return permits and wake satisfiable waiters in FIFO order.
+
+        Raises ValueError on over-release (exceeding capacity) — a silent
+        clamp would hide double-release bugs in the model under test.
+        """
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        if self._available + count > self._capacity:
+            raise ValueError(
+                f"releasing {count} would exceed capacity "
+                f"({self._available} + {count} > {self._capacity})"
+            )
+        self._available += count
+        self._releases += count
+        self._wake_waiters()
+        return []
+
+    def _wake_waiters(self) -> None:
+        while self._waiters:
+            front = self._waiters[0]
+            if front.future.is_resolved:  # cancelled — drop from the queue
+                self._waiters.popleft()
+                continue
+            if front.count > self._available:
+                break
+            self._waiters.popleft()
+            self._available -= front.count
+            self._acquisitions += 1
+            self._total_wait_time_ns += self._now_ns() - front.enqueue_time_ns
+            front.future.resolve(None)
+
+    def handle_event(self, event: Event) -> None:
+        """Semaphore is passive — it never receives events directly."""
+        return None

@@ -30,7 +30,7 @@ from happysim_tpu.core.logical_clocks import (
 )
 from happysim_tpu.core.node_clock import ClockModel, FixedSkew, LinearDrift, NodeClock
 from happysim_tpu.core.protocols import HasCapacity, Simulatable
-from happysim_tpu.core.sim_future import SimFuture, all_of, any_of
+from happysim_tpu.core.sim_future import CancelledError, SimFuture, all_of, any_of
 from happysim_tpu.core.simulation import Simulation
 from happysim_tpu.core.temporal import Duration, Instant, as_duration, as_instant
 
@@ -58,6 +58,7 @@ __all__ = [
     "NodeClock",
     "NullEntity",
     "ProcessContinuation",
+    "CancelledError",
     "SimFuture",
     "SimReturn",
     "SimYield",

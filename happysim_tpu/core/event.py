@@ -293,7 +293,7 @@ class ProcessContinuation(Event):
     latency trackers see the original ``created_at``.
     """
 
-    __slots__ = ("process", "origin", "_send_value")
+    __slots__ = ("process", "origin", "_send_value", "_throw_value")
 
     def __init__(
         self,
@@ -303,11 +303,13 @@ class ProcessContinuation(Event):
         process: Generator,
         origin: Event,
         send_value: Any = None,
+        throw_value: Optional[BaseException] = None,
     ):
         super().__init__(time, event_type, target, daemon=origin.daemon, context=origin.context)
         self.process = process
         self.origin = origin
         self._send_value = send_value
+        self._throw_value = throw_value
 
     def invoke(self) -> list[Event]:
         # A crashed target loses in-flight generator work, not just new
@@ -324,7 +326,10 @@ class ProcessContinuation(Event):
             debugger.attach(self.target, self.process)
         try:
             try:
-                yielded = self.process.send(self._send_value)
+                if self._throw_value is not None:
+                    yielded = self.process.throw(self._throw_value)
+                else:
+                    yielded = self.process.send(self._send_value)
             except StopIteration as stop:
                 # Hooks fire at the time the PROCESS finished, not when it began.
                 return self.origin._finish(stop.value, at_time=self.time)
@@ -354,7 +359,9 @@ class ProcessContinuation(Event):
             if tracing:
                 debugger.detach(self.process)
 
-    def resume_at(self, time: Instant, send_value: Any) -> "ProcessContinuation":
+    def resume_at(
+        self, time: Instant, send_value: Any, throw: Optional[BaseException] = None
+    ) -> "ProcessContinuation":
         """Clone of this continuation scheduled at ``time`` (future resolution)."""
         return ProcessContinuation(
             time=time,
@@ -363,6 +370,7 @@ class ProcessContinuation(Event):
             process=self.process,
             origin=self.origin,
             send_value=send_value,
+            throw_value=throw,
         )
 
     @staticmethod

@@ -45,16 +45,29 @@ def _get_active_clock() -> Optional["Clock"]:
     return _active_clock.get()
 
 
+class CancelledError(RuntimeError):
+    """Thrown into a generator parked on a future that gets ``cancel()``ed."""
+
+
 class SimFuture:
     """A one-shot resolvable value that a generator can wait on."""
 
     __sim_future__ = True  # duck-type marker checked by ProcessContinuation
 
-    __slots__ = ("_resolved", "_value", "_continuation", "_callbacks")
+    __slots__ = (
+        "_resolved",
+        "_value",
+        "_error",
+        "_cancelled",
+        "_continuation",
+        "_callbacks",
+    )
 
     def __init__(self) -> None:
         self._resolved = False
         self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
         self._continuation: Optional["ProcessContinuation"] = None
         self._callbacks: list[Callable[["SimFuture"], None]] = []
 
@@ -63,9 +76,20 @@ class SimFuture:
         return self._resolved
 
     @property
+    def error(self) -> Optional[BaseException]:
+        """The rejection error, or None if resolved normally / pending."""
+        return self._error
+
+    @property
+    def is_cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
     def value(self) -> Any:
         if not self._resolved:
             raise RuntimeError("SimFuture value read before resolution")
+        if self._error is not None:
+            raise self._error
         return self._value
 
     # -- engine-side -------------------------------------------------------
@@ -93,6 +117,42 @@ class SimFuture:
         if self._continuation is not None:
             self._resume()
 
+    def cancel(self) -> None:
+        """Withdraw interest in a pending future.
+
+        The canonical use is abandoning a queued acquisition after losing an
+        ``any_of`` race (e.g. lock acquisition with timeout): waiter queues in
+        the sync primitives skip cancelled futures at hand-off time, so the
+        resource is not granted to a process that moved on. If a generator is
+        parked on the future, CancelledError is thrown into it. No-op if
+        already settled.
+        """
+        if self._resolved:
+            return
+        self._resolved = True
+        self._cancelled = True
+        self._error = CancelledError("SimFuture cancelled")
+        self._fire_callbacks()
+        if self._continuation is not None:
+            self._resume()
+
+    def reject(self, error: BaseException) -> None:
+        """Settle the future with an error; the awaiting generator sees it
+        raised at the ``yield`` expression (via ``generator.throw``).
+
+        Used for cancellation-style semantics (e.g. a broken Barrier). A
+        process that does not catch the error dies, propagating the error to
+        the simulation loop — mirroring the reference's raise-in-waiter
+        behavior for aborted sync primitives.
+        """
+        if self._resolved:
+            return
+        self._resolved = True
+        self._error = error
+        self._fire_callbacks()
+        if self._continuation is not None:
+            self._resume()
+
     def _add_settle_callback(self, fn: Callable[["SimFuture"], None]) -> None:
         if self._resolved:
             fn(self)
@@ -113,7 +173,7 @@ class SimFuture:
                 "only be resolved from event handlers"
             )
         continuation, self._continuation = self._continuation, None
-        heap.push(continuation.resume_at(clock.now, self._value))
+        heap.push(continuation.resume_at(clock.now, self._value, throw=self._error))
 
     def __repr__(self) -> str:
         state = f"resolved={self._value!r}" if self._resolved else "pending"
@@ -128,7 +188,10 @@ def any_of(*futures: SimFuture) -> SimFuture:
     combined = SimFuture()
     for index, future in enumerate(futures):
         def on_settle(settled: SimFuture, index: int = index) -> None:
-            combined.resolve((index, settled._value))
+            if settled._error is not None:
+                combined.reject(settled._error)
+            else:
+                combined.resolve((index, settled._value))
         future._add_settle_callback(on_settle)
     return combined
 
@@ -144,6 +207,9 @@ def all_of(*futures: SimFuture) -> SimFuture:
 
     for future in futures:
         def on_settle(settled: SimFuture) -> None:
+            if settled._error is not None:
+                combined.reject(settled._error)
+                return
             state["remaining"] -= 1
             if state["remaining"] == 0:
                 combined.resolve([f._value for f in futures])

@@ -2,7 +2,7 @@
 
 import pytest
 
-from happysim_tpu import Event, Instant, Simulation, Sink
+from happysim_tpu import ConstantLatency, Event, Instant, Simulation, Sink
 from happysim_tpu.components.rate_limiter import (
     AdaptivePolicy,
     DistributedRateLimiter,
@@ -168,3 +168,51 @@ class TestDistributedRateLimiter:
         assert total_admitted <= 20 + 2 * 5
         assert sum(n.stats.rejected for n in nodes) >= 30 - (20 + 2 * 5)
         assert all(n.stats.store_syncs >= 1 for n in nodes)
+
+
+    def test_overlapping_syncs_do_not_double_count(self):
+        """Two sync round-trips in flight at once must not push overlapping
+        pending counts into the shared store."""
+        sink = Sink()
+        store = SharedCounterStore()
+        node = DistributedRateLimiter(
+            "node0",
+            sink,
+            store,
+            global_limit=1000,  # high limit: isolate the accounting
+            window_size=100.0,
+            sync_interval=3,
+            store_latency=ConstantLatency(0.5),  # long round-trip
+        )
+        sim = Simulation(entities=[sink, node], duration=50.0)
+        # 12 rapid requests: syncs overlap because the store is slow.
+        sim.schedule([Event(t(0.01 * i), "req", target=node) for i in range(12)])
+        sim.run()
+        window = node._window_of(t(0.2))
+        # The store total must equal exactly the admissions that synced
+        # (multiples of sync_interval), never more than total admissions.
+        assert store.get(window) <= node.stats.admitted
+        assert store.get(window) == 12  # 4 syncs x 3 pending, no overlap
+
+    def test_cached_rejection_unwinds_hooks_as_drop(self):
+        sink = Sink()
+        store = SharedCounterStore()
+        node = DistributedRateLimiter(
+            "node0", sink, store, global_limit=2, window_size=100.0, sync_interval=1
+        )
+        sim = Simulation(entities=[sink, node], duration=10.0)
+        outcomes = []
+        events = []
+        for i in range(6):
+            req = Event(t(0.1 + i * 0.1), "req", target=node)
+            req.add_completion_hook(
+                lambda at, r=req: outcomes.append(r.context["metadata"].get("dropped_by"))
+                or None
+            )
+            events.append(req)
+        sim.schedule(events)
+        sim.run()
+        drops = [o for o in outcomes if o is not None]
+        assert len(outcomes) == 6  # every request's hooks fired exactly once
+        assert len(drops) == node.stats.rejected
+        assert node.stats.rejected >= 1

@@ -199,3 +199,55 @@ class TestFallback:
         sim.run()
         assert len(produced) == 2
         assert fb.stats.fallback_successes == 2
+
+
+class TestHedgeDropIsolation:
+    def test_dropped_primary_does_not_poison_hedge_win(self):
+        """A primary that fast-fails must not mark the ORIGINAL event as
+        dropped when a hedge later succeeds (upstream hooks would
+        misclassify the success as a drop)."""
+
+        class DropFirstServeSecond(Entity):
+            def __init__(self):
+                super().__init__("dfss")
+                self.calls = 0
+
+            def handle_event(self, event):
+                self.calls += 1
+                if self.calls == 1:
+                    return event.complete_as_dropped(self.now, self.name)
+                yield 0.05
+
+        backend = DropFirstServeSecond()
+        hedge = Hedge("h", backend, hedge_delay=0.2, max_hedges=1)
+        sim = Simulation(entities=[backend, hedge], duration=5.0)
+        req = Event(Instant.from_seconds(0.0), "req", target=hedge)
+        outcome = {}
+        req.add_completion_hook(
+            lambda at: outcome.update(
+                dropped=req.context["metadata"].get("dropped_by"), at=at.to_seconds()
+            )
+            or None
+        )
+        sim.schedule([req])
+        sim.run()
+        assert hedge.stats.hedge_wins == 1
+        assert outcome["dropped"] is None  # success, not a drop
+        assert outcome["at"] == pytest.approx(0.25)
+
+    def test_all_attempts_dropped_marks_original(self):
+        class AlwaysDrop(Entity):
+            def handle_event(self, event):
+                return event.complete_as_dropped(self.now, self.name)
+
+        backend = AlwaysDrop("ad")
+        hedge = Hedge("h", backend, hedge_delay=0.1, max_hedges=1)
+        sim = Simulation(entities=[backend, hedge], duration=5.0)
+        req = Event(Instant.from_seconds(0.0), "req", target=hedge)
+        outcome = {}
+        req.add_completion_hook(
+            lambda at: outcome.update(dropped=req.context["metadata"].get("dropped_by")) or None
+        )
+        sim.schedule([req])
+        sim.run()
+        assert outcome["dropped"] == "ad"  # total failure IS reported as a drop

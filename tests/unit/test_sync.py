@@ -1,0 +1,587 @@
+"""Unit tests: sync primitives (Mutex/Semaphore/RWLock/Barrier/Condition).
+
+Mirrors the reference's coverage (tests/unit/components/sync/) using tiny
+real simulations, per the unit≈micro-integration strategy (SURVEY.md §4).
+"""
+
+import pytest
+
+from happysim_tpu import Entity, Event, Instant, Simulation
+from happysim_tpu.components.sync import (
+    Barrier,
+    BrokenBarrierError,
+    Condition,
+    Mutex,
+    RWLock,
+    Semaphore,
+)
+
+
+class CriticalWorker(Entity):
+    """Acquires a mutex, holds it for hold_s, records entry/exit times."""
+
+    def __init__(self, name, mutex, hold_s):
+        super().__init__(name)
+        self.mutex = mutex
+        self.hold_s = hold_s
+        self.entered_at = None
+        self.exited_at = None
+
+    def handle_event(self, event):
+        yield self.mutex.acquire(owner=self.name)
+        self.entered_at = self.now.to_seconds()
+        yield self.hold_s
+        self.exited_at = self.now.to_seconds()
+        self.mutex.release()
+
+
+def _kickoff(sim_entities, *starts):
+    sim = Simulation(entities=sim_entities)
+    for t, entity in starts:
+        sim.schedule(Event(Instant.Epoch + t, "go", target=entity))
+    return sim
+
+
+# ---------------------------------------------------------------- Mutex ----
+def test_mutex_serializes_critical_sections():
+    mutex = Mutex("m")
+    a = CriticalWorker("a", mutex, hold_s=1.0)
+    b = CriticalWorker("b", mutex, hold_s=1.0)
+    sim = _kickoff([mutex, a, b], (0.0, a), (0.1, b))
+    sim.run()
+    # b waits until a releases at t=1.0
+    assert a.entered_at == 0.0
+    assert b.entered_at == 1.0
+    assert mutex.stats.contentions == 1
+    assert mutex.stats.acquisitions == 2
+    assert mutex.stats.releases == 2
+    assert mutex.stats.total_wait_time_ns == int(0.9e9)
+    assert not mutex.is_locked
+
+
+def test_mutex_try_acquire_and_owner():
+    mutex = Mutex("m")
+    assert mutex.try_acquire(owner="me")
+    assert mutex.owner == "me"
+    assert not mutex.try_acquire()
+    mutex.release()
+    assert not mutex.is_locked
+    with pytest.raises(RuntimeError):
+        mutex.release()
+
+
+def test_mutex_fifo_handoff():
+    mutex = Mutex("m")
+    workers = [CriticalWorker(f"w{i}", mutex, hold_s=0.5) for i in range(4)]
+    sim = _kickoff([mutex, *workers], *((i * 0.01, w) for i, w in enumerate(workers)))
+    sim.run()
+    entries = [w.entered_at for w in workers]
+    assert entries == sorted(entries)  # FIFO order preserved
+    assert entries == [0.0, 0.5, 1.0, 1.5]
+
+
+# ------------------------------------------------------------ Semaphore ----
+class PermitWorker(Entity):
+    def __init__(self, name, sem, count, hold_s):
+        super().__init__(name)
+        self.sem = sem
+        self.count = count
+        self.hold_s = hold_s
+        self.entered_at = None
+
+    def handle_event(self, event):
+        yield self.sem.acquire(self.count)
+        self.entered_at = self.now.to_seconds()
+        yield self.hold_s
+        self.sem.release(self.count)
+
+
+def test_semaphore_limits_concurrency():
+    sem = Semaphore("s", initial_count=2)
+    workers = [PermitWorker(f"w{i}", sem, 1, hold_s=1.0) for i in range(4)]
+    sim = _kickoff([sem, *workers], *((0.0, w) for w in workers))
+    sim.run()
+    entries = sorted(w.entered_at for w in workers)
+    assert entries == [0.0, 0.0, 1.0, 1.0]
+    assert sem.available == 2
+    assert sem.stats.peak_waiters == 2
+
+
+def test_semaphore_multi_permit_no_barging():
+    sem = Semaphore("s", initial_count=2)
+    big = PermitWorker("big", sem, 2, hold_s=1.0)       # takes both
+    bigger = PermitWorker("bigger", sem, 2, hold_s=1.0)  # queues for both
+    small = PermitWorker("small", sem, 1, hold_s=1.0)    # must NOT barge past
+    sim = _kickoff([sem, big, bigger, small], (0.0, big), (0.1, bigger), (0.2, small))
+    sim.run()
+    assert big.entered_at == 0.0
+    assert bigger.entered_at == 1.0
+    assert small.entered_at == 2.0  # FIFO: waits behind bigger
+
+
+def test_semaphore_try_acquire():
+    sem = Semaphore("s", initial_count=1)
+    assert sem.try_acquire()
+    assert not sem.try_acquire()
+    sem.release()
+    assert sem.available == 1
+    with pytest.raises(ValueError):
+        sem.try_acquire(0)
+
+
+# --------------------------------------------------------------- RWLock ----
+class Reader(Entity):
+    def __init__(self, name, lock, hold_s):
+        super().__init__(name)
+        self.lock = lock
+        self.hold_s = hold_s
+        self.entered_at = None
+
+    def handle_event(self, event):
+        yield self.lock.acquire_read()
+        self.entered_at = self.now.to_seconds()
+        yield self.hold_s
+        self.lock.release_read()
+
+
+class Writer(Entity):
+    def __init__(self, name, lock, hold_s):
+        super().__init__(name)
+        self.lock = lock
+        self.hold_s = hold_s
+        self.entered_at = None
+
+    def handle_event(self, event):
+        yield self.lock.acquire_write()
+        self.entered_at = self.now.to_seconds()
+        yield self.hold_s
+        self.lock.release_write()
+
+
+def test_rwlock_concurrent_readers_exclusive_writer():
+    lock = RWLock("rw")
+    r1 = Reader("r1", lock, hold_s=1.0)
+    r2 = Reader("r2", lock, hold_s=1.0)
+    w = Writer("w", lock, hold_s=1.0)
+    sim = _kickoff([lock, r1, r2, w], (0.0, r1), (0.0, r2), (0.1, w))
+    sim.run()
+    assert r1.entered_at == 0.0 and r2.entered_at == 0.0  # shared
+    assert w.entered_at == 1.0  # waits for both readers
+    assert lock.stats.peak_readers == 2
+    assert lock.stats.write_contentions == 1
+
+
+def test_rwlock_writer_preference_blocks_new_readers():
+    lock = RWLock("rw")
+    r1 = Reader("r1", lock, hold_s=1.0)
+    w = Writer("w", lock, hold_s=1.0)
+    r2 = Reader("r2", lock, hold_s=1.0)
+    # r1 holds; w queues at 0.1; r2 arrives at 0.2 and must NOT overtake w.
+    sim = _kickoff([lock, r1, w, r2], (0.0, r1), (0.1, w), (0.2, r2))
+    sim.run()
+    assert r1.entered_at == 0.0
+    assert w.entered_at == 1.0
+    assert r2.entered_at == 2.0
+
+
+def test_rwlock_max_readers_cap():
+    lock = RWLock("rw", max_readers=1)
+    r1 = Reader("r1", lock, hold_s=1.0)
+    r2 = Reader("r2", lock, hold_s=1.0)
+    sim = _kickoff([lock, r1, r2], (0.0, r1), (0.0, r2))
+    sim.run()
+    assert sorted([r1.entered_at, r2.entered_at]) == [0.0, 1.0]
+
+
+def test_rwlock_release_errors():
+    lock = RWLock("rw")
+    with pytest.raises(RuntimeError):
+        lock.release_read()
+    with pytest.raises(RuntimeError):
+        lock.release_write()
+
+
+# -------------------------------------------------------------- Barrier ----
+class Party(Entity):
+    def __init__(self, name, barrier, arrive_after_s):
+        super().__init__(name)
+        self.barrier = barrier
+        self.arrive_after_s = arrive_after_s
+        self.released_at = None
+        self.index = None
+        self.error = None
+
+    def handle_event(self, event):
+        yield self.arrive_after_s
+        try:
+            self.index = yield self.barrier.wait()
+        except BrokenBarrierError as exc:
+            self.error = exc
+            return
+        self.released_at = self.now.to_seconds()
+
+
+def test_barrier_releases_all_on_last_arrival():
+    barrier = Barrier("b", parties=3)
+    parties = [Party(f"p{i}", barrier, arrive_after_s=float(i)) for i in range(3)]
+    sim = _kickoff([barrier, *parties], *((0.0, p) for p in parties))
+    sim.run()
+    # All released when the last (t=2.0) arrives.
+    assert [p.released_at for p in parties] == [2.0, 2.0, 2.0]
+    # Last arrival is the leader (index 0); earlier arrivals get 1..n-1.
+    assert parties[2].index == 0
+    assert sorted(p.index for p in parties) == [0, 1, 2]
+    assert barrier.generation == 1
+    assert barrier.waiting == 0
+
+
+def test_barrier_reusable_across_generations():
+    barrier = Barrier("b", parties=2)
+
+    class Repeater(Entity):
+        def __init__(self, name, barrier, delay_s):
+            super().__init__(name)
+            self.barrier = barrier
+            self.delay_s = delay_s
+            self.release_times = []
+
+        def handle_event(self, event):
+            for _ in range(2):
+                yield self.delay_s
+                yield self.barrier.wait()
+                self.release_times.append(self.now.to_seconds())
+
+    fast = Repeater("fast", barrier, 1.0)
+    slow = Repeater("slow", barrier, 2.0)
+    sim = _kickoff([barrier, fast, slow], (0.0, fast), (0.0, slow))
+    sim.run()
+    assert fast.release_times == [2.0, 4.0]
+    assert slow.release_times == [2.0, 4.0]
+    assert barrier.generation == 2
+
+
+def test_barrier_abort_rejects_waiters():
+    barrier = Barrier("b", parties=3)
+    p1 = Party("p1", barrier, 0.0)
+    p2 = Party("p2", barrier, 0.0)
+
+    class Aborter(Entity):
+        def handle_event(self, event):
+            barrier.abort()
+
+    aborter = Aborter("aborter")
+    sim = _kickoff([barrier, p1, p2, aborter], (0.0, p1), (0.0, p2), (1.0, aborter))
+    sim.run()
+    assert isinstance(p1.error, BrokenBarrierError)
+    assert isinstance(p2.error, BrokenBarrierError)
+    assert barrier.broken
+    with pytest.raises(BrokenBarrierError):
+        barrier.wait()
+    barrier.reset()
+    assert not barrier.broken
+
+
+# ------------------------------------------------------------ Condition ----
+class Consumer(Entity):
+    def __init__(self, name, mutex, cond, buffer):
+        super().__init__(name)
+        self.mutex = mutex
+        self.cond = cond
+        self.buffer = buffer
+        self.consumed = []
+        self.consumed_at = []
+
+    def handle_event(self, event):
+        yield self.mutex.acquire(owner=self.name)
+        while not self.buffer:
+            yield self.cond.wait(owner=self.name)
+        self.consumed.append(self.buffer.pop(0))
+        self.consumed_at.append(self.now.to_seconds())
+        self.mutex.release()
+
+
+class Producer(Entity):
+    def __init__(self, name, mutex, cond, buffer, item):
+        super().__init__(name)
+        self.mutex = mutex
+        self.cond = cond
+        self.buffer = buffer
+        self.item = item
+
+    def handle_event(self, event):
+        yield self.mutex.acquire(owner=self.name)
+        self.buffer.append(self.item)
+        self.cond.notify()
+        self.mutex.release()
+
+
+def test_condition_producer_consumer():
+    mutex = Mutex("m")
+    cond = Condition("c", mutex)
+    buffer = []
+    consumer = Consumer("consumer", mutex, cond, buffer)
+    producer = Producer("producer", mutex, cond, buffer, item="x")
+    sim = _kickoff([mutex, cond, consumer, producer], (0.0, consumer), (1.0, producer))
+    sim.run()
+    assert consumer.consumed == ["x"]
+    assert consumer.consumed_at == [1.0]
+    assert not mutex.is_locked
+    assert cond.stats.waits == 1
+    assert cond.stats.wakeups == 1
+
+
+def test_condition_wait_requires_lock():
+    mutex = Mutex("m")
+    cond = Condition("c", mutex)
+    with pytest.raises(RuntimeError):
+        cond.wait()
+
+
+def test_condition_notify_all_wakes_everyone():
+    mutex = Mutex("m")
+    cond = Condition("c", mutex)
+    buffer = []
+
+    class GreedyConsumer(Consumer):
+        pass
+
+    consumers = [GreedyConsumer(f"c{i}", mutex, cond, buffer) for i in range(2)]
+
+    class BatchProducer(Entity):
+        def handle_event(self, event):
+            yield mutex.acquire(owner=self.name)
+            buffer.extend(["a", "b"])
+            cond.notify_all()
+            mutex.release()
+
+    producer = BatchProducer("producer")
+    sim = _kickoff(
+        [mutex, cond, *consumers, producer],
+        (0.0, consumers[0]),
+        (0.0, consumers[1]),
+        (1.0, producer),
+    )
+    sim.run()
+    assert sorted(consumers[0].consumed + consumers[1].consumed) == ["a", "b"]
+    assert not mutex.is_locked
+
+
+def test_condition_wait_for_predicate():
+    mutex = Mutex("m")
+    cond = Condition("c", mutex)
+    state = {"ready": False}
+
+    class WaiterEntity(Entity):
+        def __init__(self, name):
+            super().__init__(name)
+            self.result = None
+            self.done_at = None
+
+        def handle_event(self, event):
+            yield mutex.acquire(owner=self.name)
+            self.result = yield from cond.wait_for(lambda: state["ready"])
+            self.done_at = self.now.to_seconds()
+            mutex.release()
+
+    class Setter(Entity):
+        def handle_event(self, event):
+            yield mutex.acquire(owner=self.name)
+            state["ready"] = True
+            cond.notify_all()
+            mutex.release()
+
+    waiter = WaiterEntity("waiter")
+    setter = Setter("setter")
+    sim = _kickoff([mutex, cond, waiter, setter], (0.0, waiter), (2.0, setter))
+    sim.run()
+    assert waiter.result is True
+    assert waiter.done_at == 2.0
+
+
+# ---------------------------------------------------- cancellation races ----
+def test_acquire_timeout_cancel_does_not_strand_lock():
+    """Losing an any_of race + cancel() must not leave the lock stranded."""
+    from happysim_tpu import SimFuture, any_of
+
+    mutex = Mutex("m")
+
+    class Holder(Entity):
+        def handle_event(self, event):
+            yield mutex.acquire(owner="holder")
+            yield 2.0
+            mutex.release()
+
+    class ImpatientWaiter(Entity):
+        def __init__(self, name):
+            super().__init__(name)
+            self.timed_out = None
+
+        def handle_event(self, event):
+            acq = mutex.acquire(owner=self.name)
+            timer = SimFuture()
+            fire = Event.once(self.now + 0.5, lambda: timer.resolve("timeout"))
+            index, _ = yield any_of(acq, timer), [fire]
+            self.timed_out = index == 1
+            if self.timed_out:
+                acq.cancel()
+
+    class LateWaiter(CriticalWorker):
+        pass
+
+    holder = Holder("holder")
+    impatient = ImpatientWaiter("impatient")
+    late = LateWaiter("late", mutex, hold_s=0.1)
+    sim = _kickoff([mutex, holder, impatient, late], (0.0, holder), (0.1, impatient), (0.2, late))
+    sim.run()
+    assert impatient.timed_out is True
+    # Holder releases at 2.0; the cancelled waiter is skipped; late gets it.
+    assert late.entered_at == 2.0
+    assert late.exited_at == 2.1
+    assert not mutex.is_locked
+
+
+def test_semaphore_cancelled_waiter_skipped():
+    sem = Semaphore("s", initial_count=1)
+    assert sem.try_acquire()
+    abandoned = sem.acquire()  # queued
+
+    class Releaser(Entity):
+        def handle_event(self, event):
+            abandoned.cancel()
+            sem.release()
+
+    class Late(PermitWorker):
+        pass
+
+    releaser = Releaser("releaser")
+    late = Late("late", sem, 1, hold_s=0.1)
+    sim = _kickoff([sem, releaser, late], (1.0, releaser), (0.5, late))
+    sim.run()
+    assert late.entered_at == 1.0
+    assert sem.available == 1
+
+
+def test_rwlock_cancelled_writer_unblocks_readers():
+    lock = RWLock("rw")
+    assert lock.try_acquire_read()  # a reader holds
+    w = lock.acquire_write()        # writer queues -> blocks new readers
+    assert not lock.try_acquire_read()
+    w.cancel()                      # writer gives up
+    assert lock.try_acquire_read()  # readers no longer blocked
+
+
+def test_semaphore_acquire_over_capacity_raises():
+    sem = Semaphore("s", initial_count=2)
+    with pytest.raises(ValueError):
+        sem.acquire(3)
+    with pytest.raises(ValueError):
+        sem.try_acquire(3)
+
+
+def test_semaphore_cancel_unblocks_queue_immediately():
+    """Cancelling a head-of-line waiter wakes eligible waiters NOW, not at
+    the next release."""
+    sem = Semaphore("s", initial_count=2)
+
+    class Hog(Entity):
+        def handle_event(self, event):
+            yield sem.acquire(1)   # holds one permit forever
+            yield 100.0
+            sem.release(1)
+
+    class BigWaiter(Entity):
+        def __init__(self, name):
+            super().__init__(name)
+            self.fut = None
+
+        def handle_event(self, event):
+            self.fut = sem.acquire(2)   # can't be satisfied while Hog holds
+            yield 0.5                   # ...waits a bit, then gives up
+            self.fut.cancel()
+
+    class SmallWaiter(PermitWorker):
+        pass
+
+    hog = Hog("hog")
+    big = BigWaiter("big")
+    small = SmallWaiter("small", sem, 1, hold_s=0.1)
+    sim = _kickoff([sem, hog, big, small], (0.0, hog), (0.1, big), (0.2, small))
+    sim.run()
+    # small is unblocked at big's cancel (t=0.6), NOT at hog's release (t=100)
+    assert small.entered_at == 0.6
+
+
+def test_rwlock_cancelled_writer_releases_queued_readers():
+    """A QUEUED reader behind a cancelled writer wakes immediately."""
+    lock = RWLock("rw")
+
+    class HoldingReader(Entity):
+        def handle_event(self, event):
+            yield lock.acquire_read()
+            yield 100.0
+            lock.release_read()
+
+    class GivingUpWriter(Entity):
+        def __init__(self, name):
+            super().__init__(name)
+            self.fut = None
+
+        def handle_event(self, event):
+            self.fut = lock.acquire_write()
+            yield 0.5
+            self.fut.cancel()
+
+    class QueuedReader(Reader):
+        pass
+
+    r1 = HoldingReader("r1")
+    w = GivingUpWriter("w")
+    r2 = QueuedReader("r2", lock, hold_s=0.1)
+    sim = _kickoff([lock, r1, w, r2], (0.0, r1), (0.1, w), (0.2, r2))
+    sim.run()
+    # r2 shares with r1 as soon as the writer cancels at t=0.6.
+    assert r2.entered_at == 0.6
+
+
+def test_condition_waiter_cancelled_mid_reacquire_returns_mutex():
+    """Cancel between notify() and mutex re-acquisition must not strand the
+    mutex on the departed waiter."""
+    from happysim_tpu import SimFuture, any_of
+
+    mutex = Mutex("m")
+    cond = Condition("c", mutex)
+
+    class ImpatientWaiter(Entity):
+        def __init__(self, name):
+            super().__init__(name)
+            self.timed_out = None
+
+        def handle_event(self, event):
+            yield mutex.acquire(owner=self.name)
+            wait_fut = cond.wait(owner=self.name)
+            timer = SimFuture()
+            fire = Event.once(self.now + 1.5, lambda: timer.resolve("timeout"))
+            index, _ = yield any_of(wait_fut, timer), [fire]
+            self.timed_out = index == 1
+            if self.timed_out:
+                wait_fut.cancel()
+
+    class SlowNotifier(Entity):
+        def handle_event(self, event):
+            yield mutex.acquire(owner=self.name)
+            cond.notify()
+            yield 2.0          # holds mutex past the waiter's timeout (1.5)
+            mutex.release()
+
+    class LateLocker(CriticalWorker):
+        pass
+
+    waiter = ImpatientWaiter("waiter")
+    notifier = SlowNotifier("notifier")
+    late = LateLocker("late", mutex, hold_s=0.1)
+    sim = _kickoff([mutex, cond, waiter, notifier, late], (0.0, waiter), (1.0, notifier), (2.0, late))
+    sim.run()
+    assert waiter.timed_out is True
+    # Notifier releases at 3.0; cancelled waiter's re-acquire hands back; late runs.
+    assert late.entered_at == 3.0
+    assert not mutex.is_locked
