@@ -22,6 +22,14 @@ import logging
 logging.getLogger("happysim_tpu").addHandler(logging.NullHandler())
 
 from happysim_tpu.components import (
+    CachedStore,
+    Database,
+    KVStore,
+    ReplicatedStore,
+    ShardedStore,
+    DeadLetterQueue,
+    MessageQueue,
+    Topic,
     Barrier,
     BrokenBarrierError,
     Condition,

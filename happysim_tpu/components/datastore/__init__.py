@@ -1,0 +1,99 @@
+"""Datastore components — KV stores, caches, sharding, replication, DB.
+
+Parity target: ``happysimulator/components/datastore/`` (see SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.datastore.cache_warming import CacheWarmer, CacheWarmerStats
+from happysim_tpu.components.datastore.cached_store import CachedStore, CachedStoreStats
+from happysim_tpu.components.datastore.database import (
+    Connection,
+    Database,
+    DatabaseStats,
+    Transaction,
+    TransactionState,
+)
+from happysim_tpu.components.datastore.eviction_policies import (
+    CacheEvictionPolicy,
+    ClockEviction,
+    FIFOEviction,
+    LFUEviction,
+    LRUEviction,
+    RandomEviction,
+    SampledLRUEviction,
+    SLRUEviction,
+    TTLEviction,
+    TwoQueueEviction,
+)
+from happysim_tpu.components.datastore.kv_store import KVStore, KVStoreStats
+from happysim_tpu.components.datastore.multi_tier_cache import (
+    MultiTierCache,
+    MultiTierCacheStats,
+    PromotionPolicy,
+)
+from happysim_tpu.components.datastore.replicated_store import (
+    ConsistencyLevel,
+    ReplicatedStore,
+    ReplicatedStoreStats,
+)
+from happysim_tpu.components.datastore.sharded_store import (
+    ConsistentHashSharding,
+    HashSharding,
+    RangeSharding,
+    ShardedStore,
+    ShardedStoreStats,
+    ShardingStrategy,
+)
+from happysim_tpu.components.datastore.soft_ttl_cache import (
+    CacheEntry,
+    SoftTTLCache,
+    SoftTTLCacheStats,
+)
+from happysim_tpu.components.datastore.write_policies import (
+    WriteAround,
+    WriteBack,
+    WritePolicy,
+    WriteThrough,
+)
+
+__all__ = [
+    "CacheEntry",
+    "CacheEvictionPolicy",
+    "CacheWarmer",
+    "CacheWarmerStats",
+    "CachedStore",
+    "CachedStoreStats",
+    "ClockEviction",
+    "Connection",
+    "ConsistencyLevel",
+    "ConsistentHashSharding",
+    "Database",
+    "DatabaseStats",
+    "FIFOEviction",
+    "HashSharding",
+    "KVStore",
+    "KVStoreStats",
+    "LFUEviction",
+    "LRUEviction",
+    "MultiTierCache",
+    "MultiTierCacheStats",
+    "PromotionPolicy",
+    "RandomEviction",
+    "RangeSharding",
+    "ReplicatedStore",
+    "ReplicatedStoreStats",
+    "SLRUEviction",
+    "SampledLRUEviction",
+    "ShardedStore",
+    "ShardedStoreStats",
+    "ShardingStrategy",
+    "SoftTTLCache",
+    "SoftTTLCacheStats",
+    "TTLEviction",
+    "Transaction",
+    "TransactionState",
+    "TwoQueueEviction",
+    "WriteAround",
+    "WriteBack",
+    "WritePolicy",
+    "WriteThrough",
+]

@@ -1,0 +1,443 @@
+"""Cache eviction policies — pluggable victim selection.
+
+Parity target: ``happysimulator/components/datastore/eviction_policies.py``
+(``CacheEvictionPolicy`` :24; LRU :68, LFU :106, TTL :154, FIFO :244,
+Random :279, SLRU :318, SampledLRU :407, Clock :487, 2Q :585).
+
+Policies track key metadata only; the cache owns the values. The cache calls
+``on_access``/``on_insert``/``on_remove`` and asks ``evict()`` for a victim.
+"""
+
+from __future__ import annotations
+
+import random
+from abc import ABC, abstractmethod
+from collections import OrderedDict, deque
+from typing import Callable, Optional
+
+
+class CacheEvictionPolicy(ABC):
+    """Victim-selection strategy for a bounded cache."""
+
+    @abstractmethod
+    def on_access(self, key: str) -> None:
+        """A cached key was read."""
+
+    @abstractmethod
+    def on_insert(self, key: str) -> None:
+        """A key was added to the cache."""
+
+    @abstractmethod
+    def on_remove(self, key: str) -> None:
+        """A key was removed (eviction already accounted separately)."""
+
+    @abstractmethod
+    def evict(self) -> Optional[str]:
+        """Choose and forget a victim key; None if nothing to evict."""
+
+    @abstractmethod
+    def clear(self) -> None:
+        """Forget all tracking state."""
+
+
+class LRUEviction(CacheEvictionPolicy):
+    """Least-recently-used: evict the key untouched the longest."""
+
+    def __init__(self):
+        self._order: OrderedDict[str, None] = OrderedDict()
+
+    def on_access(self, key: str) -> None:
+        if key in self._order:
+            self._order.move_to_end(key)
+
+    def on_insert(self, key: str) -> None:
+        self._order[key] = None
+        self._order.move_to_end(key)
+
+    def on_remove(self, key: str) -> None:
+        self._order.pop(key, None)
+
+    def evict(self) -> Optional[str]:
+        if not self._order:
+            return None
+        key, _ = self._order.popitem(last=False)
+        return key
+
+    def clear(self) -> None:
+        self._order.clear()
+
+
+class LFUEviction(CacheEvictionPolicy):
+    """Least-frequently-used; FIFO insertion order breaks frequency ties."""
+
+    def __init__(self):
+        self._counts: dict[str, int] = {}
+        self._insertion: dict[str, int] = {}
+        self._seq = 0
+
+    def on_access(self, key: str) -> None:
+        if key in self._counts:
+            self._counts[key] += 1
+
+    def on_insert(self, key: str) -> None:
+        self._counts.setdefault(key, 0)
+        self._seq += 1
+        self._insertion.setdefault(key, self._seq)
+
+    def on_remove(self, key: str) -> None:
+        self._counts.pop(key, None)
+        self._insertion.pop(key, None)
+
+    def evict(self) -> Optional[str]:
+        if not self._counts:
+            return None
+        victim = min(self._counts, key=lambda k: (self._counts[k], self._insertion[k]))
+        self.on_remove(victim)
+        return victim
+
+    def clear(self) -> None:
+        self._counts.clear()
+        self._insertion.clear()
+
+
+class TTLEviction(CacheEvictionPolicy):
+    """Time-to-live: evict expired keys first, else the oldest-inserted.
+
+    ``clock_func`` supplies current time in seconds; the owning cache wires
+    the simulation clock in (see CachedStore.set_clock).
+    """
+
+    def __init__(self, ttl: float, clock_func: Optional[Callable[[], float]] = None):
+        if ttl <= 0:
+            raise ValueError(f"ttl must be > 0, got {ttl}")
+        self._ttl = ttl
+        self._clock_func = clock_func
+        self._inserted_at: OrderedDict[str, float] = OrderedDict()
+
+    @property
+    def ttl(self) -> float:
+        return self._ttl
+
+    def set_clock_func(self, clock_func: Callable[[], float]) -> None:
+        self._clock_func = clock_func
+
+    def _now(self) -> float:
+        return self._clock_func() if self._clock_func is not None else 0.0
+
+    def on_access(self, key: str) -> None:
+        pass  # TTL is insertion-based, not access-based
+
+    def on_insert(self, key: str) -> None:
+        self._inserted_at.pop(key, None)
+        self._inserted_at[key] = self._now()
+
+    def on_remove(self, key: str) -> None:
+        self._inserted_at.pop(key, None)
+
+    def is_expired(self, key: str) -> bool:
+        at = self._inserted_at.get(key)
+        return at is not None and self._now() - at > self._ttl
+
+    def get_expired_keys(self) -> list[str]:
+        now = self._now()
+        return [k for k, at in self._inserted_at.items() if now - at > self._ttl]
+
+    def evict(self) -> Optional[str]:
+        if not self._inserted_at:
+            return None
+        expired = self.get_expired_keys()
+        victim = expired[0] if expired else next(iter(self._inserted_at))
+        self._inserted_at.pop(victim, None)
+        return victim
+
+    def clear(self) -> None:
+        self._inserted_at.clear()
+
+
+class FIFOEviction(CacheEvictionPolicy):
+    """First-in-first-out: evict the oldest-inserted regardless of use."""
+
+    def __init__(self):
+        self._order: OrderedDict[str, None] = OrderedDict()
+
+    def on_access(self, key: str) -> None:
+        pass
+
+    def on_insert(self, key: str) -> None:
+        self._order.setdefault(key, None)
+
+    def on_remove(self, key: str) -> None:
+        self._order.pop(key, None)
+
+    def evict(self) -> Optional[str]:
+        if not self._order:
+            return None
+        key, _ = self._order.popitem(last=False)
+        return key
+
+    def clear(self) -> None:
+        self._order.clear()
+
+
+class RandomEviction(CacheEvictionPolicy):
+    """Uniform random victim (seeded for reproducibility)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._keys: list[str] = []
+        self._positions: dict[str, int] = {}
+        self._rng = random.Random(seed)
+
+    def on_access(self, key: str) -> None:
+        pass
+
+    def on_insert(self, key: str) -> None:
+        if key not in self._positions:
+            self._positions[key] = len(self._keys)
+            self._keys.append(key)
+
+    def on_remove(self, key: str) -> None:
+        pos = self._positions.pop(key, None)
+        if pos is None:
+            return
+        last = self._keys.pop()
+        if last != key:
+            self._keys[pos] = last
+            self._positions[last] = pos
+
+    def evict(self) -> Optional[str]:
+        if not self._keys:
+            return None
+        victim = self._keys[self._rng.randrange(len(self._keys))]
+        self.on_remove(victim)
+        return victim
+
+    def clear(self) -> None:
+        self._keys.clear()
+        self._positions.clear()
+
+
+class SLRUEviction(CacheEvictionPolicy):
+    """Segmented LRU: probationary + protected segments.
+
+    New keys enter probationary; a re-access promotes to protected (demoting
+    protected-LRU back to probationary when the protected segment exceeds
+    ``protected_ratio`` of tracked keys). Victims come from probationary
+    first — scan-resistant, one-touch keys never displace the working set.
+    """
+
+    def __init__(self, protected_ratio: float = 0.8):
+        if not 0.0 < protected_ratio < 1.0:
+            raise ValueError(f"protected_ratio must be in (0,1), got {protected_ratio}")
+        self._protected_ratio = protected_ratio
+        self._probationary: OrderedDict[str, None] = OrderedDict()
+        self._protected: OrderedDict[str, None] = OrderedDict()
+
+    @property
+    def protected_ratio(self) -> float:
+        return self._protected_ratio
+
+    @property
+    def probationary_size(self) -> int:
+        return len(self._probationary)
+
+    @property
+    def protected_size(self) -> int:
+        return len(self._protected)
+
+    def _max_protected(self) -> int:
+        total = len(self._probationary) + len(self._protected)
+        return max(1, int(total * self._protected_ratio))
+
+    def on_access(self, key: str) -> None:
+        if key in self._protected:
+            self._protected.move_to_end(key)
+        elif key in self._probationary:
+            del self._probationary[key]
+            self._protected[key] = None
+            while len(self._protected) > self._max_protected():
+                demoted, _ = self._protected.popitem(last=False)
+                self._probationary[demoted] = None
+
+    def on_insert(self, key: str) -> None:
+        if key not in self._protected:
+            self._probationary[key] = None
+            self._probationary.move_to_end(key)
+
+    def on_remove(self, key: str) -> None:
+        self._probationary.pop(key, None)
+        self._protected.pop(key, None)
+
+    def evict(self) -> Optional[str]:
+        if self._probationary:
+            key, _ = self._probationary.popitem(last=False)
+            return key
+        if self._protected:
+            key, _ = self._protected.popitem(last=False)
+            return key
+        return None
+
+    def clear(self) -> None:
+        self._probationary.clear()
+        self._protected.clear()
+
+
+class SampledLRUEviction(CacheEvictionPolicy):
+    """Approximate LRU (Redis-style): sample K keys, evict the stalest.
+
+    O(1) bookkeeping with near-LRU quality at large sizes.
+    """
+
+    def __init__(self, sample_size: int = 5, seed: Optional[int] = None):
+        if sample_size < 1:
+            raise ValueError(f"sample_size must be >= 1, got {sample_size}")
+        self._sample_size = sample_size
+        self._rng = random.Random(seed)
+        self._last_access: dict[str, int] = {}
+        self._tick = 0
+
+    @property
+    def sample_size(self) -> int:
+        return self._sample_size
+
+    def on_access(self, key: str) -> None:
+        if key in self._last_access:
+            self._tick += 1
+            self._last_access[key] = self._tick
+
+    def on_insert(self, key: str) -> None:
+        self._tick += 1
+        self._last_access[key] = self._tick
+
+    def on_remove(self, key: str) -> None:
+        self._last_access.pop(key, None)
+
+    def evict(self) -> Optional[str]:
+        if not self._last_access:
+            return None
+        keys = list(self._last_access)
+        sample = keys if len(keys) <= self._sample_size else self._rng.sample(
+            keys, self._sample_size
+        )
+        victim = min(sample, key=lambda k: self._last_access[k])
+        self.on_remove(victim)
+        return victim
+
+    def clear(self) -> None:
+        self._last_access.clear()
+        self._tick = 0
+
+
+class ClockEviction(CacheEvictionPolicy):
+    """CLOCK (second-chance): ring of keys with reference bits.
+
+    The hand sweeps, clearing set bits; the first unreferenced key found is
+    the victim — LRU-like behavior at FIFO cost.
+    """
+
+    def __init__(self):
+        self._keys: list[str] = []
+        self._ref_bits: dict[str, bool] = {}
+        self._hand = 0
+
+    @property
+    def size(self) -> int:
+        return len(self._keys)
+
+    def on_access(self, key: str) -> None:
+        if key in self._ref_bits:
+            self._ref_bits[key] = True
+
+    def on_insert(self, key: str) -> None:
+        if key not in self._ref_bits:
+            self._keys.insert(self._hand, key)
+            if self._keys[self._hand] == key and len(self._keys) > 1:
+                self._hand = (self._hand + 1) % len(self._keys)
+        self._ref_bits[key] = True
+
+    def on_remove(self, key: str) -> None:
+        if key not in self._ref_bits:
+            return
+        idx = self._keys.index(key)
+        self._keys.pop(idx)
+        del self._ref_bits[key]
+        if self._keys:
+            if idx < self._hand:
+                self._hand -= 1
+            self._hand %= len(self._keys)
+        else:
+            self._hand = 0
+
+    def evict(self) -> Optional[str]:
+        if not self._keys:
+            return None
+        # At most two sweeps: all bits get cleared on the first pass.
+        for _ in range(2 * len(self._keys)):
+            key = self._keys[self._hand]
+            if self._ref_bits[key]:
+                self._ref_bits[key] = False
+                self._hand = (self._hand + 1) % len(self._keys)
+            else:
+                self.on_remove(key)
+                return key
+        key = self._keys[self._hand]
+        self.on_remove(key)
+        return key
+
+    def clear(self) -> None:
+        self._keys.clear()
+        self._ref_bits.clear()
+        self._hand = 0
+
+
+class TwoQueueEviction(CacheEvictionPolicy):
+    """2Q: FIFO admission queue (Kin) + LRU main queue (Am).
+
+    First touch lands in Kin (bounded to ``kin_ratio`` of tracked keys);
+    a second access promotes to the LRU main queue. One-hit-wonders wash out
+    of Kin without disturbing the main queue.
+    """
+
+    def __init__(self, kin_ratio: float = 0.25):
+        if not 0.0 < kin_ratio < 1.0:
+            raise ValueError(f"kin_ratio must be in (0,1), got {kin_ratio}")
+        self._kin_ratio = kin_ratio
+        self._kin: OrderedDict[str, None] = OrderedDict()  # FIFO admission
+        self._am: OrderedDict[str, None] = OrderedDict()  # LRU main
+
+    @property
+    def kin_ratio(self) -> float:
+        return self._kin_ratio
+
+    def on_access(self, key: str) -> None:
+        if key in self._am:
+            self._am.move_to_end(key)
+        elif key in self._kin:
+            del self._kin[key]
+            self._am[key] = None
+
+    def on_insert(self, key: str) -> None:
+        if key not in self._am and key not in self._kin:
+            self._kin[key] = None
+
+    def on_remove(self, key: str) -> None:
+        self._kin.pop(key, None)
+        self._am.pop(key, None)
+
+    def evict(self) -> Optional[str]:
+        total = len(self._kin) + len(self._am)
+        if total == 0:
+            return None
+        max_kin = max(1, int(total * self._kin_ratio))
+        if len(self._kin) >= max_kin or not self._am:
+            if self._kin:
+                key, _ = self._kin.popitem(last=False)
+                return key
+        if self._am:
+            key, _ = self._am.popitem(last=False)
+            return key
+        key, _ = self._kin.popitem(last=False)
+        return key
+
+    def clear(self) -> None:
+        self._kin.clear()
+        self._am.clear()

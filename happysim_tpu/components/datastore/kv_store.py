@@ -1,0 +1,146 @@
+"""In-memory key-value store with modeled latency.
+
+Parity target: ``happysimulator/components/datastore/kv_store.py:43``
+(``get`` :133, ``put`` :167, ``delete`` :206, sync variants :156/:191/:228,
+FIFO eviction at capacity :267, ``KVStoreStats`` :32).
+
+Operations are generator helpers used with ``yield from`` inside a handler;
+``*_sync`` variants skip latency for internal composition.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Generator, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class KVStoreStats:
+    reads: int = 0
+    writes: int = 0
+    deletes: int = 0
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class KVStore(Entity):
+    """Dict with read/write/delete latencies and FIFO capacity eviction."""
+
+    def __init__(
+        self,
+        name: str,
+        read_latency: float = 0.001,
+        write_latency: float = 0.005,
+        delete_latency: Optional[float] = None,
+        capacity: Optional[int] = None,
+    ):
+        if read_latency < 0:
+            raise ValueError(f"read_latency must be >= 0, got {read_latency}")
+        if write_latency < 0:
+            raise ValueError(f"write_latency must be >= 0, got {write_latency}")
+        if capacity is not None and capacity < 1:
+            raise ValueError(f"capacity must be >= 1 or None, got {capacity}")
+        super().__init__(name)
+        self._read_latency = read_latency
+        self._write_latency = write_latency
+        self._delete_latency = delete_latency if delete_latency is not None else write_latency
+        self._capacity = capacity
+        self._data: OrderedDict[str, Any] = OrderedDict()  # insertion order = FIFO
+        self._reads = 0
+        self._writes = 0
+        self._deletes = 0
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> KVStoreStats:
+        return KVStoreStats(
+            reads=self._reads,
+            writes=self._writes,
+            deletes=self._deletes,
+            hits=self._hits,
+            misses=self._misses,
+            evictions=self._evictions,
+        )
+
+    @property
+    def read_latency(self) -> float:
+        return self._read_latency
+
+    @property
+    def write_latency(self) -> float:
+        return self._write_latency
+
+    @property
+    def capacity(self) -> Optional[int]:
+        return self._capacity
+
+    @property
+    def size(self) -> int:
+        return len(self._data)
+
+    def contains(self, key: str) -> bool:
+        return key in self._data
+
+    def keys(self) -> list[str]:
+        return list(self._data.keys())
+
+    def clear(self) -> None:
+        self._data.clear()
+
+    # -- latency API (yield from) ------------------------------------------
+    def get(self, key: str) -> Generator[float, None, Optional[Any]]:
+        yield self._read_latency
+        self._reads += 1
+        if key in self._data:
+            self._hits += 1
+            return self._data[key]
+        self._misses += 1
+        return None
+
+    def put(self, key: str, value: Any) -> Generator[float, None, None]:
+        yield self._write_latency
+        self._writes += 1
+        self._store(key, value)
+
+    def delete(self, key: str) -> Generator[float, None, bool]:
+        yield self._delete_latency
+        self._deletes += 1
+        return self._data.pop(key, _MISSING) is not _MISSING
+
+    # -- sync API (internal composition) -----------------------------------
+    def get_sync(self, key: str) -> Optional[Any]:
+        return self._data.get(key)
+
+    def put_sync(self, key: str, value: Any) -> None:
+        self._store(key, value)
+
+    def delete_sync(self, key: str) -> bool:
+        return self._data.pop(key, _MISSING) is not _MISSING
+
+    # -- internals ---------------------------------------------------------
+    def _store(self, key: str, value: Any) -> None:
+        if self._capacity is not None and key not in self._data:
+            while len(self._data) >= self._capacity:
+                self._data.popitem(last=False)
+                self._evictions += 1
+        self._data[key] = value
+
+    def handle_event(self, event: Event) -> None:
+        """KVStore is passive — accessed via its method API."""
+        return None
+
+
+_MISSING = object()

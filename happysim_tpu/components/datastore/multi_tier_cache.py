@@ -1,0 +1,182 @@
+"""Multi-tier cache (L1/L2/...) over a backing store, with promotion.
+
+Parity target: ``happysimulator/components/datastore/multi_tier_cache.py:65``
+(``PromotionPolicy`` :45, ``get`` :165, ``put`` :206, ``delete`` :233,
+``_maybe_promote`` :288, ``get_tier_stats`` :310).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Generator, Optional
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+class PromotionPolicy(Enum):
+    ALWAYS = "always"  # promote on every lower-tier hit
+    ON_SECOND_ACCESS = "on_second_access"  # promote once a key proves hot
+    NEVER = "never"  # tiers are independent
+
+
+@dataclass(frozen=True)
+class MultiTierCacheStats:
+    reads: int = 0
+    writes: int = 0
+    tier_hits: dict = None  # type: ignore[assignment]
+    backing_store_hits: int = 0
+    misses: int = 0
+    promotions: int = 0
+
+
+class MultiTierCache(Entity):
+    """Checks tiers in order (L1 first); misses read through the backing
+    store and populate L1. Lower-tier hits optionally promote to L1."""
+
+    def __init__(
+        self,
+        name: str,
+        tiers: list[Entity],
+        backing_store: Entity,
+        promotion_policy: PromotionPolicy = PromotionPolicy.ALWAYS,
+    ):
+        if not tiers:
+            raise ValueError("At least one cache tier is required")
+        super().__init__(name)
+        self._tiers = tiers
+        self._backing_store = backing_store
+        self._promotion_policy = promotion_policy
+        self._access_counts: dict[str, int] = {}
+        self._reads = 0
+        self._writes = 0
+        self._tier_hits: dict[int, int] = {}
+        self._backing_store_hits = 0
+        self._misses = 0
+        self._promotions = 0
+
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        for tier in [*self._tiers, self._backing_store]:
+            if getattr(tier, "_clock", None) is None:
+                tier.set_clock(clock)
+
+    def downstream_entities(self) -> list[Entity]:
+        return [*self._tiers, self._backing_store]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> MultiTierCacheStats:
+        return MultiTierCacheStats(
+            reads=self._reads,
+            writes=self._writes,
+            tier_hits=dict(self._tier_hits),
+            backing_store_hits=self._backing_store_hits,
+            misses=self._misses,
+            promotions=self._promotions,
+        )
+
+    @property
+    def num_tiers(self) -> int:
+        return len(self._tiers)
+
+    @property
+    def tiers(self) -> list[Entity]:
+        return self._tiers
+
+    @property
+    def backing_store(self) -> Entity:
+        return self._backing_store
+
+    @property
+    def promotion_policy(self) -> PromotionPolicy:
+        return self._promotion_policy
+
+    @property
+    def hit_rate(self) -> float:
+        hits = sum(self._tier_hits.values())
+        total = self._reads
+        return hits / total if total else 0.0
+
+    def get_tier_stats(self) -> dict[int, dict]:
+        return {
+            idx: {"hits": self._tier_hits.get(idx, 0), "tier": getattr(t, "name", str(idx))}
+            for idx, t in enumerate(self._tiers)
+        }
+
+    # -- operations --------------------------------------------------------
+    def get(self, key: str) -> Generator[float, None, Optional[Any]]:
+        self._reads += 1
+        self._access_counts[key] = self._access_counts.get(key, 0) + 1
+        for tier_idx, tier in enumerate(self._tiers):
+            if hasattr(tier, "contains_cached") and tier.contains_cached(key):
+                value = yield from tier.get(key)
+                if value is not None:
+                    self._tier_hits[tier_idx] = self._tier_hits.get(tier_idx, 0) + 1
+                    if tier_idx > 0:
+                        self._maybe_promote(key, value, tier_idx)
+                    return value
+        value = yield from self._backing_store.get(key)
+        if value is not None:
+            self._backing_store_hits += 1
+            self._cache_value(key, value)
+        else:
+            self._misses += 1
+        return value
+
+    def put(self, key: str, value: Any) -> Generator[float, None, None]:
+        """Write through to the store; invalidate all tiers, refill L1."""
+        self._writes += 1
+        yield from self._backing_store.put(key, value)
+        for tier in self._tiers:
+            if hasattr(tier, "invalidate"):
+                tier.invalidate(key)
+        yield from self._tiers[0].put(key, value)
+
+    def delete(self, key: str) -> Generator[float, None, bool]:
+        existed = False
+        for tier in self._tiers:
+            if hasattr(tier, "contains_cached") and tier.contains_cached(key):
+                existed = True
+            if hasattr(tier, "invalidate"):
+                tier.invalidate(key)
+        store_existed = yield from self._backing_store.delete(key)
+        self._access_counts.pop(key, None)
+        return existed or store_existed
+
+    def invalidate(self, key: str) -> None:
+        for tier in self._tiers:
+            if hasattr(tier, "invalidate"):
+                tier.invalidate(key)
+
+    def invalidate_all(self) -> None:
+        for tier in self._tiers:
+            if hasattr(tier, "invalidate_all"):
+                tier.invalidate_all()
+        self._access_counts.clear()
+
+    # -- internals ---------------------------------------------------------
+    def _should_promote(self, key: str) -> bool:
+        if self._promotion_policy is PromotionPolicy.NEVER:
+            return False
+        if self._promotion_policy is PromotionPolicy.ALWAYS:
+            return True
+        return self._access_counts.get(key, 0) >= 2
+
+    def _maybe_promote(self, key: str, value: Any, from_tier: int) -> None:
+        if from_tier <= 0 or not self._should_promote(key):
+            return
+        target = self._tiers[0]
+        if hasattr(target, "_cache_put"):
+            target._cache_put(key, value)
+            self._promotions += 1
+
+    def _cache_value(self, key: str, value: Any) -> None:
+        target = self._tiers[0]
+        if hasattr(target, "_cache_put"):
+            target._cache_put(key, value)
+
+    def handle_event(self, event: Event) -> None:
+        return None

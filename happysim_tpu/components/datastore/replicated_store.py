@@ -1,0 +1,213 @@
+"""Replicated store with tunable consistency (ONE/QUORUM/ALL).
+
+Parity target: ``happysimulator/components/datastore/replicated_store.py:94``
+(``ConsistencyLevel`` :35, ``get`` :215, ``put`` :280, quorum math :207-213,
+``ReplicatedStoreStats`` :44).
+
+Reads stop early once enough replicas answered; writes go to every replica
+(read-repair-free model) and succeed when enough acked. Like the reference,
+replica calls run serially inside the caller's process — the latencies model
+a coordinator awaiting responses one by one. A replica whose individual
+latency exceeds read_timeout/write_timeout does not count toward the
+consistency requirement (counted in ``replica_timeouts``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Generator, Optional
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.utils.stats import percentile_nearest_rank
+from happysim_tpu.core.event import Event
+
+
+class ConsistencyLevel(Enum):
+    ONE = "one"
+    QUORUM = "quorum"
+    ALL = "all"
+
+
+@dataclass(frozen=True)
+class ReplicatedStoreStats:
+    reads: int = 0
+    writes: int = 0
+    read_successes: int = 0
+    read_failures: int = 0
+    write_successes: int = 0
+    write_failures: int = 0
+    replica_timeouts: int = 0
+    read_latencies: tuple[float, ...] = ()
+    write_latencies: tuple[float, ...] = ()
+
+    @property
+    def read_latency_p50(self) -> float:
+        return percentile_nearest_rank(list(self.read_latencies), 0.50)
+
+    @property
+    def read_latency_p99(self) -> float:
+        return percentile_nearest_rank(list(self.read_latencies), 0.99)
+
+    @property
+    def write_latency_p50(self) -> float:
+        return percentile_nearest_rank(list(self.write_latencies), 0.50)
+
+    @property
+    def write_latency_p99(self) -> float:
+        return percentile_nearest_rank(list(self.write_latencies), 0.99)
+
+
+class ReplicatedStore(Entity):
+    """N replicas; R/W consistency levels. R + W > N ⇒ read-your-writes."""
+
+    def __init__(
+        self,
+        name: str,
+        replicas: list[Entity],
+        read_consistency: ConsistencyLevel = ConsistencyLevel.QUORUM,
+        write_consistency: ConsistencyLevel = ConsistencyLevel.QUORUM,
+        read_timeout: float = 1.0,
+        write_timeout: float = 2.0,
+    ):
+        if not replicas:
+            raise ValueError("At least one replica is required")
+        super().__init__(name)
+        self._replicas = replicas
+        self._read_consistency = read_consistency
+        self._write_consistency = write_consistency
+        self._read_timeout = read_timeout
+        self._write_timeout = write_timeout
+        self._reads = 0
+        self._writes = 0
+        self._read_successes = 0
+        self._read_failures = 0
+        self._write_successes = 0
+        self._write_failures = 0
+        self._replica_timeouts = 0
+        self._read_latencies: list[float] = []
+        self._write_latencies: list[float] = []
+
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        for replica in self._replicas:
+            if getattr(replica, "_clock", None) is None:
+                replica.set_clock(clock)
+
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._replicas)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> ReplicatedStoreStats:
+        return ReplicatedStoreStats(
+            reads=self._reads,
+            writes=self._writes,
+            read_successes=self._read_successes,
+            read_failures=self._read_failures,
+            write_successes=self._write_successes,
+            write_failures=self._write_failures,
+            replica_timeouts=self._replica_timeouts,
+            read_latencies=tuple(self._read_latencies),
+            write_latencies=tuple(self._write_latencies),
+        )
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def replicas(self) -> list[Entity]:
+        return self._replicas
+
+    @property
+    def quorum_size(self) -> int:
+        return len(self._replicas) // 2 + 1
+
+    @property
+    def read_consistency(self) -> ConsistencyLevel:
+        return self._read_consistency
+
+    @property
+    def write_consistency(self) -> ConsistencyLevel:
+        return self._write_consistency
+
+    def _required(self, consistency: ConsistencyLevel) -> int:
+        if consistency is ConsistencyLevel.ONE:
+            return 1
+        if consistency is ConsistencyLevel.QUORUM:
+            return self.quorum_size
+        return len(self._replicas)
+
+    # -- operations --------------------------------------------------------
+    def get(self, key: str) -> Generator[float, None, Optional[Any]]:
+        """Query replicas until ``required`` answered; first non-None wins."""
+        self._reads += 1
+        required = self._required(self._read_consistency)
+        responses: list[Any] = []
+        latencies: list[float] = []
+        for replica in self._replicas:
+            try:
+                replica_latency = 0.0
+                gen = replica.get(key)
+                value = None
+                try:
+                    while True:
+                        delay = next(gen)
+                        replica_latency += delay
+                        yield delay
+                except StopIteration as stop:
+                    value = stop.value
+                if replica_latency > self._read_timeout:
+                    self._replica_timeouts += 1
+                    continue
+                latencies.append(replica_latency)
+                responses.append(value)
+                if len(responses) >= required:
+                    self._read_successes += 1
+                    self._read_latencies.append(sum(latencies))
+                    for resp in responses:
+                        if resp is not None:
+                            return resp
+                    return None
+            except (TimeoutError, RuntimeError, OSError):
+                self._replica_timeouts += 1
+                continue
+        self._read_failures += 1
+        return None
+
+    def put(self, key: str, value: Any) -> Generator[float, None, bool]:
+        """Write every replica; success when ``required`` replicas acked."""
+        self._writes += 1
+        required = self._required(self._write_consistency)
+        acks = 0
+        latencies: list[float] = []
+        for replica in self._replicas:
+            try:
+                replica_latency = 0.0
+                gen = replica.put(key, value)
+                try:
+                    while True:
+                        delay = next(gen)
+                        replica_latency += delay
+                        yield delay
+                except StopIteration:
+                    pass
+                if replica_latency > self._write_timeout:
+                    self._replica_timeouts += 1
+                    continue
+                latencies.append(replica_latency)
+                acks += 1
+            except (TimeoutError, RuntimeError, OSError):
+                self._replica_timeouts += 1
+                continue
+        if acks >= required:
+            self._write_successes += 1
+            self._write_latencies.append(sum(latencies))
+            return True
+        self._write_failures += 1
+        return False
+
+    def handle_event(self, event: Event) -> None:
+        return None

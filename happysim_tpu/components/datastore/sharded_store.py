@@ -1,0 +1,192 @@
+"""Sharded store: route keys to shards by pluggable strategy.
+
+Parity target: ``happysimulator/components/datastore/sharded_store.py:180``
+(``ShardingStrategy`` :33, ``HashSharding`` :53, ``RangeSharding`` :66,
+``ConsistentHashSharding`` :104, ``ShardedStoreStats`` :159).
+
+Hashes use sha1 rather than the reference's md5 (same distribution
+properties; md5 trips FIPS-restricted environments).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Generator, Optional, Protocol
+
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+def _hash_int(text: str) -> int:
+    return int(hashlib.sha1(text.encode()).hexdigest(), 16)
+
+
+class ShardingStrategy(Protocol):
+    def get_shard(self, key: str, num_shards: int) -> int:
+        """Map key -> shard index in [0, num_shards)."""
+        ...
+
+
+class HashSharding:
+    """hash(key) mod n — uniform, but a shard-count change remaps ~all keys."""
+
+    def get_shard(self, key: str, num_shards: int) -> int:
+        return _hash_int(key) % num_shards
+
+
+class RangeSharding:
+    """Alphabetical ranges — range-query friendly, hot-spot prone.
+
+    With explicit ``boundaries`` ([b0, b1, ...]), key < b0 → shard 0, etc.
+    Without, the first character spreads a-z across shards.
+    """
+
+    def __init__(self, boundaries: Optional[list[str]] = None):
+        self._boundaries = boundaries
+
+    def get_shard(self, key: str, num_shards: int) -> int:
+        if self._boundaries:
+            for i, boundary in enumerate(self._boundaries):
+                if key < boundary:
+                    return i
+            return len(self._boundaries)
+        if not key:
+            return 0
+        first = ord(key[0].lower())
+        if first < ord("a"):
+            return 0
+        if first > ord("z"):
+            return num_shards - 1
+        return (first - ord("a")) * num_shards // 26
+
+
+class ConsistentHashSharding:
+    """Hash ring with virtual nodes — shard-count changes remap ~1/n keys."""
+
+    def __init__(self, virtual_nodes: int = 100, seed: Optional[int] = None):
+        self._virtual_nodes = virtual_nodes
+        self._seed = seed
+        self._ring_hashes: list[int] = []
+        self._ring_shards: list[int] = []
+        self._built_for = 0
+
+    def _build_ring(self, num_shards: int) -> None:
+        if self._built_for == num_shards:
+            return
+        ring: list[tuple[int, int]] = []
+        for shard_idx in range(num_shards):
+            for vnode in range(self._virtual_nodes):
+                vnode_key = f"shard{shard_idx}:vnode{vnode}"
+                if self._seed is not None:
+                    vnode_key = f"{self._seed}:{vnode_key}"
+                ring.append((_hash_int(vnode_key), shard_idx))
+        ring.sort()
+        self._ring_hashes = [h for h, _ in ring]
+        self._ring_shards = [s for _, s in ring]
+        self._built_for = num_shards
+
+    def get_shard(self, key: str, num_shards: int) -> int:
+        self._build_ring(num_shards)
+        if not self._ring_hashes:
+            return 0
+        idx = bisect.bisect_left(self._ring_hashes, _hash_int(key))
+        if idx >= len(self._ring_hashes):
+            idx = 0
+        return self._ring_shards[idx]
+
+
+@dataclass(frozen=True)
+class ShardedStoreStats:
+    reads: int = 0
+    writes: int = 0
+    deletes: int = 0
+    shard_reads: dict[int, int] = field(default_factory=dict)
+    shard_writes: dict[int, int] = field(default_factory=dict)
+
+    def get_shard_distribution(self) -> dict[int, float]:
+        total = sum(self.shard_reads.values())
+        if total == 0:
+            return {}
+        return {k: v / total for k, v in self.shard_reads.items()}
+
+
+class ShardedStore(Entity):
+    """Each key lives on exactly one shard (KVStore-like entity)."""
+
+    def __init__(
+        self,
+        name: str,
+        shards: list[Entity],
+        sharding_strategy: Optional[ShardingStrategy] = None,
+    ):
+        if not shards:
+            raise ValueError("At least one shard is required")
+        super().__init__(name)
+        self._shards = shards
+        self._sharding_strategy = sharding_strategy or HashSharding()
+        self._reads = 0
+        self._writes = 0
+        self._deletes = 0
+        self._shard_reads: dict[int, int] = dict.fromkeys(range(len(shards)), 0)
+        self._shard_writes: dict[int, int] = dict.fromkeys(range(len(shards)), 0)
+
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        for shard in self._shards:
+            if getattr(shard, "_clock", None) is None:
+                shard.set_clock(clock)
+
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._shards)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> ShardedStoreStats:
+        return ShardedStoreStats(
+            reads=self._reads,
+            writes=self._writes,
+            deletes=self._deletes,
+            shard_reads=dict(self._shard_reads),
+            shard_writes=dict(self._shard_writes),
+        )
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._shards)
+
+    @property
+    def shards(self) -> list[Entity]:
+        return self._shards
+
+    @property
+    def sharding_strategy(self) -> ShardingStrategy:
+        return self._sharding_strategy
+
+    def get_shard_for_key(self, key: str) -> int:
+        return self._sharding_strategy.get_shard(key, len(self._shards))
+
+    # -- operations --------------------------------------------------------
+    def get(self, key: str) -> Generator[float, None, Optional[Any]]:
+        self._reads += 1
+        idx = self.get_shard_for_key(key)
+        self._shard_reads[idx] = self._shard_reads.get(idx, 0) + 1
+        value = yield from self._shards[idx].get(key)
+        return value
+
+    def put(self, key: str, value: Any) -> Generator[float, None, None]:
+        self._writes += 1
+        idx = self.get_shard_for_key(key)
+        self._shard_writes[idx] = self._shard_writes.get(idx, 0) + 1
+        yield from self._shards[idx].put(key, value)
+
+    def delete(self, key: str) -> Generator[float, None, bool]:
+        self._deletes += 1
+        idx = self.get_shard_for_key(key)
+        existed = yield from self._shards[idx].delete(key)
+        return existed
+
+    def handle_event(self, event: Event) -> None:
+        return None

@@ -1,0 +1,230 @@
+"""Soft-TTL cache: stale-while-revalidate with request coalescing.
+
+Parity target: ``happysimulator/components/datastore/soft_ttl_cache.py:132``
+(``CacheEntry`` :41, ``get`` :254 — fresh hit / stale hit + background
+refresh / hard miss, coalescing :295-305; ``_maybe_start_refresh`` :400,
+LRU eviction :446-461, ``SoftTTLCacheStats`` :80).
+
+Entries younger than ``soft_ttl`` are fresh (served directly); between soft
+and ``hard_ttl`` they're stale (served immediately while a background
+refresh re-fetches); past hard TTL the read blocks on the backing store.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Generator, Optional, Union
+
+from happysim_tpu.components.datastore.kv_store import KVStore
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Duration, Instant, as_duration
+
+
+@dataclass
+class CacheEntry:
+    value: Any
+    cached_at: Instant
+
+    def is_fresh(self, now: Instant, soft_ttl: Duration) -> bool:
+        return now - self.cached_at <= soft_ttl
+
+    def is_valid(self, now: Instant, hard_ttl: Duration) -> bool:
+        return now - self.cached_at <= hard_ttl
+
+
+@dataclass(frozen=True)
+class SoftTTLCacheStats:
+    reads: int = 0
+    fresh_hits: int = 0
+    stale_hits: int = 0
+    hard_misses: int = 0
+    background_refreshes: int = 0
+    refresh_successes: int = 0
+    coalesced_requests: int = 0
+    evictions: int = 0
+
+    @property
+    def fresh_hit_rate(self) -> float:
+        return self.fresh_hits / self.reads if self.reads else 0.0
+
+    @property
+    def stale_hit_rate(self) -> float:
+        return self.stale_hits / self.reads if self.reads else 0.0
+
+    @property
+    def total_hit_rate(self) -> float:
+        return (self.fresh_hits + self.stale_hits) / self.reads if self.reads else 0.0
+
+    @property
+    def miss_rate(self) -> float:
+        return self.hard_misses / self.reads if self.reads else 0.0
+
+
+class SoftTTLCache(Entity):
+    """Two-threshold TTL cache over a KVStore."""
+
+    def __init__(
+        self,
+        name: str,
+        backing_store: KVStore,
+        soft_ttl: Union[float, Duration],
+        hard_ttl: Union[float, Duration],
+        cache_read_latency: float = 0.0001,
+        cache_capacity: Optional[int] = None,
+    ):
+        super().__init__(name)
+        self._backing_store = backing_store
+        self._soft_ttl = as_duration(soft_ttl)
+        self._hard_ttl = as_duration(hard_ttl)
+        if self._hard_ttl < self._soft_ttl:
+            raise ValueError("hard_ttl must be >= soft_ttl")
+        self._cache_read_latency = cache_read_latency
+        self._cache_capacity = cache_capacity
+        self._cache: OrderedDict[str, CacheEntry] = OrderedDict()  # LRU order
+        self._refreshing_keys: set[str] = set()
+        self._reads = 0
+        self._fresh_hits = 0
+        self._stale_hits = 0
+        self._hard_misses = 0
+        self._background_refreshes = 0
+        self._refresh_successes = 0
+        self._coalesced_requests = 0
+        self._evictions = 0
+
+    def set_clock(self, clock: Clock) -> None:
+        super().set_clock(clock)
+        if self._backing_store._clock is None:
+            self._backing_store.set_clock(clock)
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self._backing_store]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> SoftTTLCacheStats:
+        return SoftTTLCacheStats(
+            reads=self._reads,
+            fresh_hits=self._fresh_hits,
+            stale_hits=self._stale_hits,
+            hard_misses=self._hard_misses,
+            background_refreshes=self._background_refreshes,
+            refresh_successes=self._refresh_successes,
+            coalesced_requests=self._coalesced_requests,
+            evictions=self._evictions,
+        )
+
+    @property
+    def backing_store(self) -> KVStore:
+        return self._backing_store
+
+    @property
+    def soft_ttl(self) -> Duration:
+        return self._soft_ttl
+
+    @property
+    def hard_ttl(self) -> Duration:
+        return self._hard_ttl
+
+    @property
+    def cache_capacity(self) -> Optional[int]:
+        return self._cache_capacity
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    def contains_cached(self, key: str) -> bool:
+        return key in self._cache
+
+    def is_refreshing(self, key: str) -> bool:
+        return key in self._refreshing_keys
+
+    def get_cached_keys(self) -> list[str]:
+        return list(self._cache.keys())
+
+    # -- operations --------------------------------------------------------
+    def get(self, key: str):
+        """Fresh hit: serve now. Stale hit: serve now AND refresh in the
+        background. Hard miss: block on the backing store."""
+        self._reads += 1
+        now = self.now
+        entry = self._cache.get(key)
+        if entry is not None:
+            if entry.is_fresh(now, self._soft_ttl):
+                self._cache.move_to_end(key)
+                self._fresh_hits += 1
+                yield self._cache_read_latency
+                return entry.value
+            if entry.is_valid(now, self._hard_ttl):
+                self._cache.move_to_end(key)
+                self._stale_hits += 1
+                side_effects = self._maybe_start_refresh(key)
+                if side_effects:
+                    yield self._cache_read_latency, side_effects
+                else:
+                    yield self._cache_read_latency
+                return entry.value
+            # Hard-expired: purge the corpse so it can't pin a cache slot
+            # (or get MRU-promoted) while the backing store is re-read.
+            self._cache.pop(key, None)
+        self._hard_misses += 1
+        if key in self._refreshing_keys:
+            # Coalesce: a refresh is already fetching this key — model the
+            # wait as one backing-store read time, then read its result.
+            self._coalesced_requests += 1
+            yield self._backing_store.read_latency
+            refreshed = self._cache.get(key)
+            return refreshed.value if refreshed is not None else None
+        value = yield from self._backing_store.get(key)
+        if value is not None:
+            self._store(key, value)
+        return value
+
+    def put(self, key: str, value: Any) -> Generator[float, None, None]:
+        yield from self._backing_store.put(key, value)
+        self._store(key, value)
+
+    def invalidate(self, key: str) -> None:
+        self._cache.pop(key, None)
+
+    def invalidate_all(self) -> None:
+        self._cache.clear()
+
+    # -- internals ---------------------------------------------------------
+    def _maybe_start_refresh(self, key: str) -> Optional[list[Event]]:
+        if key in self._refreshing_keys:
+            return None
+        self._refreshing_keys.add(key)
+        self._background_refreshes += 1
+        return [
+            Event(
+                self.now,
+                "_sttl_refresh",
+                target=self,
+                daemon=True,  # a refresh alone shouldn't hold the sim open
+                context={"metadata": {"key": key}},
+            )
+        ]
+
+    def _store(self, key: str, value: Any) -> None:
+        if self._cache_capacity is not None and key not in self._cache:
+            while len(self._cache) >= self._cache_capacity:
+                self._cache.popitem(last=False)
+                self._evictions += 1
+        self._cache.pop(key, None)
+        self._cache[key] = CacheEntry(value=value, cached_at=self.now)
+
+    def handle_event(self, event: Event):
+        if event.event_type == "_sttl_refresh":
+            key = event.context["metadata"]["key"]
+            try:
+                value = yield from self._backing_store.get(key)
+                if value is not None:
+                    self._store(key, value)
+                    self._refresh_successes += 1
+            finally:
+                self._refreshing_keys.discard(key)
+        return None

@@ -1,0 +1,142 @@
+"""Cache write policies: write-through, write-back, write-around.
+
+Parity target: ``happysimulator/components/datastore/write_policies.py``
+(``WritePolicy`` :20, ``WriteThrough`` :70, ``WriteBack`` :96,
+``WriteAround`` :172).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Optional
+
+
+class WritePolicy(ABC):
+    """Decides when a cache write reaches the backing store."""
+
+    @abstractmethod
+    def should_write_through(self) -> bool:
+        """True if writes go synchronously to the backing store."""
+
+    @abstractmethod
+    def on_write(self, key: str, value: Any) -> None:
+        """A write happened (track dirtiness for deferred flushes)."""
+
+    @abstractmethod
+    def should_flush(self) -> bool:
+        """True when accumulated dirty state should be flushed now."""
+
+    @abstractmethod
+    def get_keys_to_flush(self) -> list[str]:
+        """Dirty keys to write to the backing store."""
+
+    @abstractmethod
+    def on_flush(self, keys: list[str]) -> None:
+        """The listed keys were flushed."""
+
+
+class WriteThrough(WritePolicy):
+    """Every write goes to cache AND backing store synchronously."""
+
+    def should_write_through(self) -> bool:
+        return True
+
+    def on_write(self, key: str, value: Any) -> None:
+        pass
+
+    def should_flush(self) -> bool:
+        return False
+
+    def get_keys_to_flush(self) -> list[str]:
+        return []
+
+    def on_flush(self, keys: list[str]) -> None:
+        pass
+
+
+class WriteBack(WritePolicy):
+    """Writes land in cache only; dirty keys flush in batches.
+
+    Flush triggers when ``max_dirty`` keys accumulate or ``flush_interval``
+    seconds pass since the last flush (``clock_func`` wired by the cache).
+    """
+
+    def __init__(
+        self,
+        flush_interval: float = 5.0,
+        max_dirty: int = 100,
+        clock_func: Optional[Callable[[], float]] = None,
+    ):
+        if flush_interval <= 0:
+            raise ValueError(f"flush_interval must be > 0, got {flush_interval}")
+        if max_dirty < 1:
+            raise ValueError(f"max_dirty must be >= 1, got {max_dirty}")
+        self._flush_interval = flush_interval
+        self._max_dirty = max_dirty
+        self._clock_func = clock_func
+        self._dirty: dict[str, None] = {}
+        self._last_flush = 0.0
+
+    @property
+    def flush_interval(self) -> float:
+        return self._flush_interval
+
+    @property
+    def max_dirty(self) -> int:
+        return self._max_dirty
+
+    @property
+    def dirty_count(self) -> int:
+        return len(self._dirty)
+
+    def set_clock_func(self, clock_func: Callable[[], float]) -> None:
+        self._clock_func = clock_func
+
+    def _now(self) -> float:
+        return self._clock_func() if self._clock_func is not None else 0.0
+
+    def should_write_through(self) -> bool:
+        return False
+
+    def on_write(self, key: str, value: Any) -> None:
+        self._dirty[key] = None
+
+    def should_flush(self) -> bool:
+        if len(self._dirty) >= self._max_dirty:
+            return True
+        return bool(self._dirty) and self._now() - self._last_flush >= self._flush_interval
+
+    def get_keys_to_flush(self) -> list[str]:
+        return list(self._dirty)
+
+    def on_flush(self, keys: list[str]) -> None:
+        for key in keys:
+            self._dirty.pop(key, None)
+        self._last_flush = self._now()
+
+
+class WriteAround(WritePolicy):
+    """Writes bypass the cache entirely (go straight to the store);
+    the cached copy is invalidated so reads refetch."""
+
+    def __init__(self):
+        self._to_invalidate: list[str] = []
+
+    def should_write_through(self) -> bool:
+        return True
+
+    def on_write(self, key: str, value: Any) -> None:
+        self._to_invalidate.append(key)
+
+    def should_flush(self) -> bool:
+        return False
+
+    def get_keys_to_flush(self) -> list[str]:
+        return []
+
+    def on_flush(self, keys: list[str]) -> None:
+        pass
+
+    def get_keys_to_invalidate(self) -> list[str]:
+        keys, self._to_invalidate = self._to_invalidate, []
+        return keys

@@ -1,0 +1,37 @@
+"""Messaging components — queues with acks, dead-lettering, pub/sub.
+
+Parity target: ``happysimulator/components/messaging/`` (message_queue.py,
+dlq.py, topic.py). Differences from the reference, by design:
+
+- Delivery is push-based: ``publish`` kicks a delivery cycle immediately when
+  consumers are subscribed; the reference requires explicit "poll" events.
+  ``poll()`` is still available for pull-style consumers.
+- Unacked messages redeliver automatically after ``redelivery_delay`` via a
+  visibility-timeout timer (cancelled on ack); the reference requires the
+  model to call ``schedule_redelivery`` manually (also kept, for parity).
+- Topic fan-out is concurrent: every subscriber's copy arrives at
+  ``now + delivery_latency``. The reference's serial per-subscriber yield
+  loop creates delivery events timestamped *before* the yields it performs,
+  which would schedule into the past.
+"""
+
+from happysim_tpu.components.messaging.dlq import DeadLetterQueue, DeadLetterStats
+from happysim_tpu.components.messaging.message_queue import (
+    Message,
+    MessageQueue,
+    MessageQueueStats,
+    MessageState,
+)
+from happysim_tpu.components.messaging.topic import Subscription, Topic, TopicStats
+
+__all__ = [
+    "DeadLetterQueue",
+    "DeadLetterStats",
+    "Message",
+    "MessageQueue",
+    "MessageQueueStats",
+    "MessageState",
+    "Subscription",
+    "Topic",
+    "TopicStats",
+]

@@ -1,0 +1,178 @@
+"""Dead-letter queue: terminal parking lot for failed messages.
+
+Parity target: ``happysimulator/components/messaging/dlq.py:51``
+(``add_message`` :120, ``_cleanup_expired`` :144, ``peek``/``pop``/``clear``
+:175-206, ``reprocess``/``reprocess_all`` :208-269, filters :271-301).
+
+One fix over the reference: ``reprocess``/``reprocess_all`` emit
+``republish`` events that our MessageQueue actually handles (the reference
+emits them at a queue with no republish handler, so they were dropped).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.components.messaging.message_queue import Message, MessageQueue
+
+
+@dataclass(frozen=True)
+class DeadLetterStats:
+    messages_received: int = 0
+    messages_reprocessed: int = 0
+    messages_discarded: int = 0
+
+
+class DeadLetterQueue(Entity):
+    """Bounded, optionally time-retained store of dead-lettered messages.
+
+    At capacity the OLDEST message is evicted (discarded) to admit the new
+    one; retention expiry is cleaned lazily on access.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        capacity: Optional[int] = None,
+        retention_period: Optional[float] = None,
+    ):
+        super().__init__(name)
+        self._capacity = capacity
+        self._retention_period = retention_period
+        self._messages: deque["Message"] = deque()
+        self._message_times: deque[Instant] = deque()
+        self._messages_received = 0
+        self._messages_reprocessed = 0
+        self._messages_discarded = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> DeadLetterStats:
+        return DeadLetterStats(
+            messages_received=self._messages_received,
+            messages_reprocessed=self._messages_reprocessed,
+            messages_discarded=self._messages_discarded,
+        )
+
+    @property
+    def message_count(self) -> int:
+        return len(self._messages)
+
+    @property
+    def messages(self) -> list["Message"]:
+        return list(self._messages)
+
+    @property
+    def capacity(self) -> Optional[int]:
+        return self._capacity
+
+    @property
+    def is_full(self) -> bool:
+        return self._capacity is not None and len(self._messages) >= self._capacity
+
+    def _now(self) -> Instant:
+        return self._clock.now if self._clock else Instant.Epoch
+
+    # -- storage -----------------------------------------------------------
+    def add_message(self, message: "Message") -> bool:
+        """Store a failed message; evicts the oldest when at capacity."""
+        self._cleanup_expired()
+        if self.is_full and self._messages:
+            self._messages.popleft()
+            self._message_times.popleft()
+            self._messages_discarded += 1
+        self._messages.append(message)
+        self._message_times.append(self._now())
+        self._messages_received += 1
+        return True
+
+    def _cleanup_expired(self) -> None:
+        if self._retention_period is None:
+            return
+        now_s = self._now().to_seconds()
+        while self._messages and now_s - self._message_times[0].to_seconds() > self._retention_period:
+            self._messages.popleft()
+            self._message_times.popleft()
+            self._messages_discarded += 1
+
+    def get_message(self, index: int) -> Optional["Message"]:
+        if 0 <= index < len(self._messages):
+            return self._messages[index]
+        return None
+
+    def peek(self) -> Optional["Message"]:
+        return self._messages[0] if self._messages else None
+
+    def pop(self) -> Optional["Message"]:
+        if not self._messages:
+            return None
+        self._message_times.popleft()
+        return self._messages.popleft()
+
+    def clear(self) -> int:
+        count = len(self._messages)
+        self._messages.clear()
+        self._message_times.clear()
+        self._messages_discarded += count
+        return count
+
+    # -- reprocessing ------------------------------------------------------
+    def reprocess(self, message: "Message", target_queue: "MessageQueue") -> Optional[Event]:
+        """Send one message back through a queue (as a fresh publish)."""
+        try:
+            idx = list(self._messages).index(message)
+        except ValueError:
+            return None
+        del self._messages[idx]
+        del self._message_times[idx]
+        self._messages_reprocessed += 1
+        return self._republish_event(message, target_queue)
+
+    def reprocess_all(self, target_queue: "MessageQueue") -> list[Event]:
+        events = []
+        while self._messages:
+            message = self._messages.popleft()
+            self._message_times.popleft()
+            self._messages_reprocessed += 1
+            events.append(self._republish_event(message, target_queue))
+        return events
+
+    def _republish_event(self, message: "Message", target_queue: "MessageQueue") -> Event:
+        return Event(
+            self._now(),
+            "republish",
+            target=target_queue,
+            context={
+                "payload": message.payload,
+                "metadata": {
+                    "original_message_id": message.id,
+                    "delivery_count": message.delivery_count,
+                },
+            },
+        )
+
+    # -- filters -----------------------------------------------------------
+    def get_messages_by_age(self, max_age: float) -> list["Message"]:
+        now_s = self._now().to_seconds()
+        return [
+            msg
+            for msg, t in zip(self._messages, self._message_times)
+            if now_s - t.to_seconds() <= max_age
+        ]
+
+    def get_messages_by_delivery_count(self, min_count: int) -> list["Message"]:
+        return [m for m in self._messages if m.delivery_count >= min_count]
+
+    def handle_event(self, event: Event):
+        if event.event_type == "clear":
+            self.clear()
+        elif event.event_type == "cleanup":
+            self._cleanup_expired()
+        return None

@@ -1,0 +1,390 @@
+"""Message queue with at-least-once delivery, acks, and dead-lettering.
+
+Parity target: ``happysimulator/components/messaging/message_queue.py:103``
+(``publish`` :234, ``_deliver_message`` :280, ``acknowledge`` :340,
+``reject`` :359, ``poll`` :388, ``schedule_redelivery`` :405,
+``MessageQueueStats`` :76, ``Message``/``MessageState`` :53-73).
+
+Messages are wrapped with an id + delivery state; consumers are chosen
+round-robin. A delivered message sits in-flight until ``acknowledge`` (done,
+removed) or ``reject`` (requeued until ``max_redeliveries``, then
+dead-lettered). Unlike the reference, delivery is push-based and unacked
+messages auto-redeliver after ``redelivery_delay`` (visibility timeout).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from dataclasses import dataclass
+from enum import Enum
+from typing import TYPE_CHECKING, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import _get_active_heap
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.components.messaging.dlq import DeadLetterQueue
+
+logger = logging.getLogger(__name__)
+
+_DELIVER = "_mq_deliver"
+_VISIBILITY = "_mq_visibility"
+
+
+class MessageState(Enum):
+    PENDING = "pending"  # waiting to be delivered
+    DELIVERED = "delivered"  # sent to consumer, awaiting ack
+    ACKNOWLEDGED = "acknowledged"  # successfully processed
+    REJECTED = "rejected"  # failed processing
+
+
+@dataclass
+class Message:
+    """A queued payload plus its delivery bookkeeping."""
+
+    id: str
+    payload: Event
+    created_at: Instant
+    state: MessageState = MessageState.PENDING
+    delivery_count: int = 0
+    last_delivered_at: Optional[Instant] = None
+    consumer: Optional[Entity] = None
+
+
+@dataclass(frozen=True)
+class MessageQueueStats:
+    messages_published: int = 0
+    messages_delivered: int = 0
+    messages_acknowledged: int = 0
+    messages_rejected: int = 0
+    messages_redelivered: int = 0
+    messages_dead_lettered: int = 0
+    delivery_latencies: tuple[float, ...] = ()
+
+    @property
+    def avg_delivery_latency(self) -> float:
+        if not self.delivery_latencies:
+            return 0.0
+        return sum(self.delivery_latencies) / len(self.delivery_latencies)
+
+    @property
+    def ack_rate(self) -> float:
+        total = self.messages_acknowledged + self.messages_rejected
+        return self.messages_acknowledged / total if total else 0.0
+
+
+class MessageQueue(Entity):
+    """At-least-once queue: round-robin consumers, acks, redelivery, DLQ.
+
+    Consumers receive ``message_delivery`` events whose context carries
+    ``message_id`` / ``payload`` / ``delivery_count`` / ``queue``, and must
+    call ``acknowledge(message_id)`` or ``reject(message_id)``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        delivery_latency: float = 0.001,
+        redelivery_delay: float = 30.0,
+        max_redeliveries: int = 3,
+        capacity: Optional[int] = None,
+        dead_letter_queue: Optional["DeadLetterQueue"] = None,
+        auto_redelivery: bool = True,
+    ):
+        if redelivery_delay <= 0:
+            raise ValueError(f"redelivery_delay must be > 0, got {redelivery_delay}")
+        if max_redeliveries < 0:
+            raise ValueError(f"max_redeliveries must be >= 0, got {max_redeliveries}")
+        super().__init__(name)
+        self._delivery_latency = delivery_latency
+        self._redelivery_delay = redelivery_delay
+        self._max_redeliveries = max_redeliveries
+        self._capacity = capacity
+        self._dead_letter_queue = dead_letter_queue
+        self._auto_redelivery = auto_redelivery
+
+        self._messages: dict[str, Message] = {}
+        self._pending_queue: deque[str] = deque()
+        self._in_flight: dict[str, Message] = {}
+        self._consumers: list[Entity] = []
+        self._consumer_index = 0
+        self._next_message_seq = 0
+        # message_id -> pending visibility/redelivery timer (cancelled on ack)
+        self._visibility_timers: dict[str, Event] = {}
+        self._redelivery_scheduled: set[str] = set()
+
+        self._messages_published = 0
+        self._messages_delivered = 0
+        self._messages_acknowledged = 0
+        self._messages_rejected = 0
+        self._messages_redelivered = 0
+        self._messages_dead_lettered = 0
+        self._delivery_latencies: list[float] = []
+
+    # -- introspection -----------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        result = list(self._consumers)
+        if self._dead_letter_queue is not None:
+            result.append(self._dead_letter_queue)
+        return result
+
+    @property
+    def stats(self) -> MessageQueueStats:
+        return MessageQueueStats(
+            messages_published=self._messages_published,
+            messages_delivered=self._messages_delivered,
+            messages_acknowledged=self._messages_acknowledged,
+            messages_rejected=self._messages_rejected,
+            messages_redelivered=self._messages_redelivered,
+            messages_dead_lettered=self._messages_dead_lettered,
+            delivery_latencies=tuple(self._delivery_latencies),
+        )
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending_queue)
+
+    @property
+    def in_flight_count(self) -> int:
+        return len(self._in_flight)
+
+    @property
+    def consumer_count(self) -> int:
+        return len(self._consumers)
+
+    @property
+    def capacity(self) -> Optional[int]:
+        return self._capacity
+
+    @property
+    def is_full(self) -> bool:
+        return self._capacity is not None and len(self._messages) >= self._capacity
+
+    def get_message(self, message_id: str) -> Optional[Message]:
+        return self._messages.get(message_id)
+
+    # -- subscription ------------------------------------------------------
+    def subscribe(self, consumer: Entity) -> None:
+        if consumer not in self._consumers:
+            self._consumers.append(consumer)
+
+    def unsubscribe(self, consumer: Entity) -> None:
+        if consumer in self._consumers:
+            self._consumers.remove(consumer)
+
+    # -- producer side -----------------------------------------------------
+    def publish(self, message: Event) -> list[Event]:
+        """Enqueue; returns events that kick the delivery cycle.
+
+        Deterministic sequential ids (``<queue>-<n>``) rather than the
+        reference's uuid4 — reproducibility is a framework invariant.
+
+        Raises RuntimeError at capacity (matching the reference's strictness
+        — producers are expected to model back-pressure explicitly).
+        """
+        if self.is_full:
+            raise RuntimeError(f"Queue {self.name} is at capacity")
+        self._next_message_seq += 1
+        message_id = f"{self.name}-{self._next_message_seq}"
+        now = self._clock.now if self._clock else Instant.Epoch
+        self._messages[message_id] = Message(id=message_id, payload=message, created_at=now)
+        self._pending_queue.append(message_id)
+        self._messages_published += 1
+        if self._consumers:
+            return self._kick()
+        return []
+
+    # -- consumer side -----------------------------------------------------
+    def acknowledge(self, message_id: str) -> None:
+        """Mark successfully processed; removes it and cancels redelivery."""
+        msg = self._messages.get(message_id)
+        if msg is None:
+            return
+        msg.state = MessageState.ACKNOWLEDGED
+        self._in_flight.pop(message_id, None)
+        self._messages.pop(message_id, None)
+        self._cancel_visibility(message_id)
+        self._redelivery_scheduled.discard(message_id)
+        self._messages_acknowledged += 1
+
+    def reject(self, message_id: str, requeue: bool = True) -> list[Event]:
+        """Fail a message: requeue for redelivery, or dead-letter/discard.
+
+        Self-driving inside a running simulation (the redelivery kick is
+        scheduled directly); outside one, schedule the returned events.
+        """
+        msg = self._messages.get(message_id)
+        if msg is None:
+            return []
+        msg.state = MessageState.REJECTED
+        self._messages_rejected += 1
+        self._in_flight.pop(message_id, None)
+        self._cancel_visibility(message_id)
+        if requeue and msg.delivery_count < self._max_redeliveries:
+            msg.state = MessageState.PENDING
+            self._pending_queue.append(message_id)
+            return self._kick()
+        self._dead_letter(msg)
+        return []
+
+    def poll(self) -> Optional[Event]:
+        """Pull-style: deliver the head pending message now, if any."""
+        if not self._pending_queue or not self._consumers:
+            return None
+        return self._deliver(self._pending_queue[0])
+
+    def schedule_redelivery(self, message_id: str) -> Optional[Event]:
+        """Manually requeue an in-flight message for redelivery after
+        ``redelivery_delay`` (reference parity; automatic visibility timers
+        make this unnecessary when ``auto_redelivery`` is on)."""
+        if message_id not in self._in_flight or message_id in self._redelivery_scheduled:
+            return None
+        msg = self._in_flight[message_id]
+        if msg.delivery_count >= self._max_redeliveries:
+            self.reject(message_id, requeue=False)
+            return None
+        self._redelivery_scheduled.add(message_id)
+        msg.state = MessageState.PENDING
+        self._in_flight.pop(message_id, None)
+        self._pending_queue.appendleft(message_id)
+        self._cancel_visibility(message_id)
+        now = self._clock.now if self._clock else Instant.Epoch
+        return Event(
+            now + self._redelivery_delay,
+            "message_redelivery",
+            target=self,
+            context={"metadata": {"message_id": message_id}},
+        )
+
+    # -- internals ---------------------------------------------------------
+    def _get_next_consumer(self) -> Optional[Entity]:
+        if not self._consumers:
+            return None
+        consumer = self._consumers[self._consumer_index % len(self._consumers)]
+        self._consumer_index += 1
+        return consumer
+
+    def _kick(self) -> list[Event]:
+        """Delivery-cycle kick: self-scheduled when a simulation is running
+        (so callers can't lose it), returned for scheduling otherwise."""
+        now = self._clock.now if self._clock else Instant.Epoch
+        kick = Event(now, _DELIVER, target=self)
+        heap = _get_active_heap()
+        if heap is not None:
+            heap.push(kick)
+            return []
+        return [kick]
+
+    def _deliver(self, message_id: str) -> Optional[Event]:
+        msg = self._messages.get(message_id)
+        if msg is None or msg.state is not MessageState.PENDING:
+            # Already delivered (e.g. a kick beat a redelivery timer) or
+            # acked/dead-lettered — never hand out a duplicate copy.
+            return None
+        consumer = self._get_next_consumer()
+        if consumer is None:
+            return None
+        now = self._clock.now if self._clock else Instant.Epoch
+        msg.state = MessageState.DELIVERED
+        msg.delivery_count += 1
+        msg.last_delivered_at = now
+        msg.consumer = consumer
+        if self._pending_queue and self._pending_queue[0] == message_id:
+            self._pending_queue.popleft()
+        else:
+            try:
+                self._pending_queue.remove(message_id)
+            except ValueError:
+                pass
+        self._in_flight[message_id] = msg
+        self._delivery_latencies.append(now.to_seconds() - msg.created_at.to_seconds())
+        if msg.delivery_count > 1:
+            self._messages_redelivered += 1
+        else:
+            self._messages_delivered += 1
+        self._arm_visibility(message_id)
+        return Event(
+            now + self._delivery_latency,
+            "message_delivery",
+            target=consumer,
+            context={
+                "metadata": {
+                    "message_id": message_id,
+                    "delivery_count": msg.delivery_count,
+                    "queue": self.name,
+                },
+                "payload": msg.payload,
+            },
+        )
+
+    def _arm_visibility(self, message_id: str) -> None:
+        """Arm the unacked-redelivery timer on every delivery path (push
+        cycle AND direct ``poll()``), self-scheduled on the running sim."""
+        if not self._auto_redelivery:
+            return
+        heap = _get_active_heap()
+        if heap is None:
+            return  # outside a running simulation there is nothing to time
+        now = self._clock.now if self._clock else Instant.Epoch
+        # NOT a daemon: redelivery of an unacked message is real pending
+        # work (auto-termination would silently drop it). Bounded — after
+        # max_redeliveries the message dead-letters and the timers stop;
+        # an ack cancels the timer immediately.
+        timer = Event(
+            now + self._redelivery_delay,
+            _VISIBILITY,
+            target=self,
+            context={"metadata": {"message_id": message_id}},
+        )
+        self._visibility_timers[message_id] = timer
+        heap.push(timer)
+
+    def _cancel_visibility(self, message_id: str) -> None:
+        timer = self._visibility_timers.pop(message_id, None)
+        if timer is not None:
+            timer.cancel()
+
+    def _dead_letter(self, msg: Message) -> None:
+        if self._dead_letter_queue is not None:
+            self._dead_letter_queue.add_message(msg)
+            self._messages_dead_lettered += 1
+        self._messages.pop(msg.id, None)
+        self._redelivery_scheduled.discard(msg.id)
+
+    def handle_event(self, event: Event):
+        event_type = event.event_type
+        if event_type == _DELIVER or event_type == "poll":
+            produced: list[Event] = []
+            delivery = self.poll()
+            if delivery is not None:
+                produced.append(delivery)
+                if self._pending_queue and self._consumers:
+                    # More pending work: keep the delivery cycle going.
+                    produced.append(Event(self.now, _DELIVER, target=self))
+            return produced or None
+        if event_type == _VISIBILITY:
+            message_id = event.context["metadata"]["message_id"]
+            self._visibility_timers.pop(message_id, None)
+            if message_id not in self._in_flight:
+                return None  # acked/rejected in the meantime
+            msg = self._in_flight[message_id]
+            if msg.delivery_count >= self._max_redeliveries:
+                self._in_flight.pop(message_id, None)
+                self._dead_letter(msg)
+                return None
+            msg.state = MessageState.PENDING
+            self._in_flight.pop(message_id, None)
+            self._pending_queue.append(message_id)
+            return [Event(self.now, _DELIVER, target=self)]
+        if event_type == "republish":
+            # DLQ reprocessing path: re-enter the payload as a fresh message.
+            return self.publish(event.context["payload"]) or None
+        if event_type == "message_redelivery":
+            message_id = event.context["metadata"]["message_id"]
+            self._redelivery_scheduled.discard(message_id)
+            delivery = self._deliver(message_id)
+            return [delivery] if delivery is not None else None
+        return None

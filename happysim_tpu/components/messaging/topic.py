@@ -1,0 +1,176 @@
+"""Pub/sub topic: broadcast to all active subscribers.
+
+Parity target: ``happysimulator/components/messaging/topic.py:61``
+(``subscribe`` :138 with history replay, ``unsubscribe`` :188, ``publish``
+:198, ``publish_sync`` :243, ``set_retain_messages`` :278,
+``Subscription``/``TopicStats`` :34-58).
+
+Fan-out is concurrent: each subscriber's ``topic_message`` arrives at
+``now + delivery_latency``. (The reference yields per subscriber but stamps
+delivery events with the pre-yield time — events scheduled into the past.)
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass
+class Subscription:
+    subscriber: Entity
+    subscribed_at: Instant
+    messages_received: int = 0
+    active: bool = True
+
+
+@dataclass(frozen=True)
+class TopicStats:
+    messages_published: int = 0
+    messages_delivered: int = 0
+    subscribers_added: int = 0
+    subscribers_removed: int = 0
+    delivery_latencies: tuple[float, ...] = ()
+
+    @property
+    def avg_delivery_latency(self) -> float:
+        if not self.delivery_latencies:
+            return 0.0
+        return sum(self.delivery_latencies) / len(self.delivery_latencies)
+
+
+class Topic(Entity):
+    """Every active subscriber gets a copy of every published message."""
+
+    def __init__(
+        self,
+        name: str,
+        delivery_latency: float = 0.001,
+        max_subscribers: Optional[int] = None,
+    ):
+        if delivery_latency < 0:
+            raise ValueError(f"delivery_latency must be >= 0, got {delivery_latency}")
+        super().__init__(name)
+        self._delivery_latency = delivery_latency
+        self._max_subscribers = max_subscribers
+        self._subscriptions: dict[Entity, Subscription] = {}
+        self._message_history: deque[Event] = deque(maxlen=100)
+        self._retain_messages = False
+        self._messages_published = 0
+        self._messages_delivered = 0
+        self._subscribers_added = 0
+        self._subscribers_removed = 0
+        self._delivery_latencies: list[float] = []
+
+    # -- introspection -----------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._subscriptions.keys())
+
+    @property
+    def stats(self) -> TopicStats:
+        return TopicStats(
+            messages_published=self._messages_published,
+            messages_delivered=self._messages_delivered,
+            subscribers_added=self._subscribers_added,
+            subscribers_removed=self._subscribers_removed,
+            delivery_latencies=tuple(self._delivery_latencies),
+        )
+
+    @property
+    def subscriber_count(self) -> int:
+        return sum(1 for s in self._subscriptions.values() if s.active)
+
+    @property
+    def subscribers(self) -> list[Entity]:
+        return [s.subscriber for s in self._subscriptions.values() if s.active]
+
+    @property
+    def max_subscribers(self) -> Optional[int]:
+        return self._max_subscribers
+
+    def _now(self) -> Instant:
+        return self._clock.now if self._clock else Instant.Epoch
+
+    # -- subscription ------------------------------------------------------
+    def subscribe(self, subscriber: Entity, replay_history: bool = False) -> list[Event]:
+        """Add (or reactivate) a subscriber; optionally replay retained
+        history as immediate ``topic_message`` events marked ``is_replay``."""
+        if self._max_subscribers is not None and self.subscriber_count >= self._max_subscribers:
+            raise RuntimeError(f"Topic {self.name} at max subscribers")
+        now = self._now()
+        if subscriber in self._subscriptions:
+            self._subscriptions[subscriber].active = True
+        else:
+            self._subscriptions[subscriber] = Subscription(
+                subscriber=subscriber, subscribed_at=now
+            )
+            self._subscribers_added += 1
+        events = []
+        if replay_history and self._retain_messages:
+            for msg in self._message_history:
+                events.append(self._delivery(subscriber, msg, now, is_replay=True))
+        return events
+
+    def unsubscribe(self, subscriber: Entity) -> None:
+        if subscriber in self._subscriptions:
+            self._subscriptions[subscriber].active = False
+            self._subscribers_removed += 1
+
+    def set_retain_messages(self, retain: bool, max_history: int = 100) -> None:
+        self._retain_messages = retain
+        self._message_history = deque(self._message_history, maxlen=max_history)
+
+    def get_subscription(self, subscriber: Entity) -> Optional[Subscription]:
+        return self._subscriptions.get(subscriber)
+
+    # -- publishing --------------------------------------------------------
+    def publish(self, message: Event) -> list[Event]:
+        """Fan out to all active subscribers at ``now + delivery_latency``."""
+        return self._publish(message, self._delivery_latency)
+
+    def publish_sync(self, message: Event) -> list[Event]:
+        """Fan out with zero latency (same-instant delivery)."""
+        return self._publish(message, 0.0)
+
+    def _publish(self, message: Event, latency: float) -> list[Event]:
+        now = self._now()
+        self._messages_published += 1
+        if self._retain_messages:
+            self._message_history.append(message)
+        events = []
+        for subscription in self._subscriptions.values():
+            if not subscription.active:
+                continue
+            subscription.messages_received += 1
+            self._messages_delivered += 1
+            self._delivery_latencies.append(latency)
+            events.append(
+                self._delivery(
+                    subscription.subscriber, message, now + latency, is_replay=False
+                )
+            )
+        return events
+
+    def _delivery(
+        self, subscriber: Entity, message: Event, at: Instant, is_replay: bool
+    ) -> Event:
+        return Event(
+            at,
+            "topic_message",
+            target=subscriber,
+            context={
+                "payload": message,
+                "metadata": {"topic": self.name, "is_replay": is_replay},
+            },
+        )
+
+    def handle_event(self, event: Event):
+        # Publishing by sending an event TO the topic: fan out its payload
+        # (or the event itself) to subscribers.
+        payload = event.context.get("payload", event)
+        return self.publish(payload) or None

@@ -1,0 +1,14 @@
+"""Tiny statistics helpers shared across components."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def percentile_nearest_rank(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0,1]); 0.0 for an empty sequence."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(int(len(ordered) * q), len(ordered) - 1)
+    return ordered[idx]

@@ -1,0 +1,429 @@
+"""Unit tests: datastore (KVStore, caches, sharding, replication, Database)."""
+
+import pytest
+
+from happysim_tpu import Entity, Event, Instant, Simulation
+from happysim_tpu.components.datastore import (
+    CacheWarmer,
+    CachedStore,
+    ClockEviction,
+    ConsistencyLevel,
+    ConsistentHashSharding,
+    Database,
+    FIFOEviction,
+    HashSharding,
+    KVStore,
+    LFUEviction,
+    LRUEviction,
+    MultiTierCache,
+    PromotionPolicy,
+    RandomEviction,
+    RangeSharding,
+    ReplicatedStore,
+    SLRUEviction,
+    SampledLRUEviction,
+    ShardedStore,
+    SoftTTLCache,
+    TTLEviction,
+    TwoQueueEviction,
+    WriteBack,
+)
+
+
+def t(seconds):
+    return Instant.from_seconds(seconds)
+
+
+class Driver(Entity):
+    """Runs a scripted generator against stores inside a real simulation."""
+
+    def __init__(self, name, script):
+        super().__init__(name)
+        self.script = script
+        self.results = []
+        self.done_at = None
+
+    def handle_event(self, event):
+        result = yield from self.script(self)
+        self.results.append(result)
+        self.done_at = self.now.to_seconds()
+
+
+def run_script(script, entities, at=0.0, duration=300.0):
+    driver = Driver("driver", script)
+    sim = Simulation(entities=[driver, *entities], duration=duration)
+    sim.schedule([Event(t(at), "go", target=driver)])
+    sim.run()
+    return driver
+
+
+# ---------------------------------------------------------------- KVStore ----
+class TestKVStore:
+    def test_put_get_delete_with_latency(self):
+        store = KVStore("kv", read_latency=0.001, write_latency=0.005)
+
+        def script(self):
+            yield from store.put("a", 1)
+            value = yield from store.get("a")
+            missing = yield from store.get("b")
+            deleted = yield from store.delete("a")
+            return (value, missing, deleted)
+
+        driver = run_script(script, [store])
+        assert driver.results == [(1, None, True)]
+        # 0.005 (put) + 0.001*2 (gets) + 0.005 (delete)
+        assert driver.done_at == pytest.approx(0.012)
+        assert store.stats.hits == 1
+        assert store.stats.misses == 1
+
+    def test_capacity_fifo_eviction(self):
+        store = KVStore("kv", capacity=2)
+        store.put_sync("a", 1)
+        store.put_sync("b", 2)
+        store.put_sync("c", 3)
+        assert store.size == 2
+        assert not store.contains("a")  # FIFO: oldest out
+        assert store.stats.evictions == 1
+
+
+# ----------------------------------------------------- eviction policies ----
+class TestEvictionPolicies:
+    def _fill(self, policy, keys):
+        for k in keys:
+            policy.on_insert(k)
+
+    def test_lru(self):
+        p = LRUEviction()
+        self._fill(p, ["a", "b", "c"])
+        p.on_access("a")
+        assert p.evict() == "b"
+
+    def test_lfu_ties_break_fifo(self):
+        p = LFUEviction()
+        self._fill(p, ["a", "b", "c"])
+        p.on_access("a")
+        p.on_access("a")
+        p.on_access("b")
+        assert p.evict() == "c"  # least frequent
+        assert p.evict() == "b"
+
+    def test_fifo(self):
+        p = FIFOEviction()
+        self._fill(p, ["a", "b"])
+        p.on_access("a")  # access is irrelevant
+        assert p.evict() == "a"
+
+    def test_ttl_prefers_expired(self):
+        clock = {"t": 0.0}
+        p = TTLEviction(ttl=10.0, clock_func=lambda: clock["t"])
+        p.on_insert("old")
+        clock["t"] = 20.0
+        p.on_insert("new")
+        assert p.is_expired("old")
+        assert not p.is_expired("new")
+        assert p.evict() == "old"
+
+    def test_random_seeded(self):
+        p1 = RandomEviction(seed=7)
+        p2 = RandomEviction(seed=7)
+        for p in (p1, p2):
+            self._fill(p, [f"k{i}" for i in range(10)])
+        assert [p1.evict() for _ in range(10)] == [p2.evict() for _ in range(10)]
+
+    def test_slru_protects_reaccessed(self):
+        p = SLRUEviction(protected_ratio=0.5)
+        self._fill(p, ["a", "b", "c", "d"])
+        p.on_access("a")  # a -> protected
+        assert p.protected_size == 1
+        assert p.evict() == "b"  # probationary first
+
+    def test_sampled_lru_full_sample_is_exact(self):
+        p = SampledLRUEviction(sample_size=100, seed=1)
+        self._fill(p, ["a", "b", "c"])
+        p.on_access("a")
+        p.on_access("b")
+        assert p.evict() == "c"
+
+    def test_clock_second_chance(self):
+        p = ClockEviction()
+        self._fill(p, ["a", "b", "c"])
+        # All bits set at insert; first sweep clears, second evicts in order.
+        victim = p.evict()
+        assert victim in {"a", "b", "c"}
+        assert p.size == 2
+
+    def test_two_queue_promotion(self):
+        p = TwoQueueEviction(kin_ratio=0.5)
+        self._fill(p, ["a", "b"])
+        p.on_access("a")  # a -> main queue
+        assert p.evict() == "b"  # one-hit-wonder washes out of kin
+        assert p.evict() == "a"
+
+
+# ------------------------------------------------------------ CachedStore ----
+class TestCachedStore:
+    def test_read_through_and_hit(self):
+        backing = KVStore("kv", read_latency=0.010)
+        cache = CachedStore("c", backing, cache_capacity=10,
+                            eviction_policy=LRUEviction(), cache_read_latency=0.001)
+        backing.put_sync("a", "val")
+
+        def script(self):
+            miss = yield from cache.get("a")  # reads through at 0.010
+            hit = yield from cache.get("a")  # cache hit at 0.001
+            return (miss, hit)
+
+        driver = run_script(script, [backing, cache])
+        assert driver.results == [("val", "val")]
+        assert driver.done_at == pytest.approx(0.011)
+        assert cache.stats.hits == 1
+        assert cache.stats.misses == 1
+
+    def test_lru_eviction_at_capacity(self):
+        backing = KVStore("kv")
+        cache = CachedStore("c", backing, cache_capacity=2, eviction_policy=LRUEviction())
+
+        def script(self):
+            yield from cache.put("a", 1)
+            yield from cache.put("b", 2)
+            yield from cache.get("a")  # a now MRU
+            yield from cache.put("c", 3)  # evicts b
+            return cache.get_cached_keys()
+
+        driver = run_script(script, [backing, cache])
+        assert sorted(driver.results[0]) == ["a", "c"]
+        assert cache.stats.evictions == 1
+
+    def test_write_back_flush(self):
+        backing = KVStore("kv")
+        cache = CachedStore("c", backing, cache_capacity=10,
+                            eviction_policy=LRUEviction(), write_through=False)
+
+        def script(self):
+            yield from cache.put("a", 1)
+            assert backing.get_sync("a") is None  # not yet written
+            flushed = yield from cache.flush()
+            return flushed
+
+        driver = run_script(script, [backing, cache])
+        assert driver.results == [1]
+        assert backing.get_sync("a") == 1
+        assert cache.stats.writebacks == 1
+
+
+# --------------------------------------------------------- MultiTierCache ----
+class TestMultiTierCache:
+    def _build(self, promotion=PromotionPolicy.ALWAYS):
+        backing = KVStore("kv", read_latency=0.100)
+        l1_store = KVStore("l1kv", read_latency=0.0)
+        l2_store = KVStore("l2kv", read_latency=0.0)
+        l1 = CachedStore("l1", l1_store, cache_capacity=2,
+                         eviction_policy=LRUEviction(), cache_read_latency=0.001)
+        l2 = CachedStore("l2", l2_store, cache_capacity=10,
+                         eviction_policy=LRUEviction(), cache_read_latency=0.010)
+        mtc = MultiTierCache("mtc", [l1, l2], backing, promotion_policy=promotion)
+        return mtc, l1, l2, backing, [l1_store, l2_store]
+
+    def test_miss_populates_l1_then_hits(self):
+        mtc, l1, l2, backing, extras = self._build()
+        backing.put_sync("a", "v")
+
+        def script(self):
+            first = yield from mtc.get("a")  # backing: 0.100
+            second = yield from mtc.get("a")  # l1: 0.001
+            return (first, second)
+
+        driver = run_script(script, [mtc, l1, l2, backing, *extras])
+        assert driver.results == [("v", "v")]
+        assert driver.done_at == pytest.approx(0.101)
+        assert mtc.stats.tier_hits.get(0) == 1
+        assert mtc.stats.backing_store_hits == 1
+
+    def test_l2_hit_promotes_to_l1(self):
+        mtc, l1, l2, backing, extras = self._build()
+        l2._cache_put("a", "v")
+
+        def script(self):
+            value = yield from mtc.get("a")
+            return value
+
+        driver = run_script(script, [mtc, l1, l2, backing, *extras])
+        assert driver.results == ["v"]
+        assert l1.contains_cached("a")  # promoted
+        assert mtc.stats.promotions == 1
+
+
+# ------------------------------------------------------------ SoftTTLCache ----
+class TestSoftTTLCache:
+    def test_fresh_stale_hard_transitions(self):
+        backing = KVStore("kv", read_latency=0.010)
+        cache = SoftTTLCache("sttl", backing, soft_ttl=1.0, hard_ttl=5.0,
+                             cache_read_latency=0.001)
+        backing.put_sync("a", "v1")
+
+        events = []
+
+        class Reader(Entity):
+            def handle_event(self, event):
+                value = yield from cache.get("a")
+                events.append((round(self.now.to_seconds(), 3), value))
+
+        reader = Reader("reader")
+        sim = Simulation(entities=[reader, cache, backing], duration=60.0)
+        # t=0: hard miss; t=0.5: fresh; t=2: stale (refresh); t=10: hard miss
+        for at in (0.0, 0.5, 2.0, 10.0):
+            sim.schedule([Event(t(at), "go", target=reader)])
+        sim.run()
+        assert [v for _, v in events] == ["v1"] * 4
+        assert cache.stats.hard_misses == 2
+        assert cache.stats.fresh_hits == 1
+        assert cache.stats.stale_hits == 1
+        assert cache.stats.background_refreshes == 1
+        assert cache.stats.refresh_successes == 1
+
+
+# ------------------------------------------------------------ CacheWarmer ----
+class TestCacheWarmer:
+    def test_warms_at_rate(self):
+        backing = KVStore("kv", read_latency=0.001)
+        cache = CachedStore("c", backing, cache_capacity=100,
+                            eviction_policy=LRUEviction())
+        for i in range(5):
+            backing.put_sync(f"k{i}", i)
+        warmer = CacheWarmer("w", cache, [f"k{i}" for i in range(5)], warmup_rate=10.0)
+        sim = Simulation(entities=[warmer, cache, backing], duration=60.0)
+        sim.schedule([warmer.start_warming(at=t(0.0))])
+        sim.run()
+        assert warmer.is_complete
+        assert warmer.stats.keys_warmed == 5
+        assert cache.cache_size == 5
+        # 5 keys at 10/s -> ~0.5s (plus fetch latencies)
+        assert warmer.stats.warmup_time_seconds == pytest.approx(0.505, abs=0.01)
+
+
+# ------------------------------------------------------------ ShardedStore ----
+class TestShardedStore:
+    def test_keys_route_consistently(self):
+        shards = [KVStore(f"s{i}") for i in range(4)]
+        store = ShardedStore("sharded", shards, HashSharding())
+
+        def script(self):
+            for i in range(20):
+                yield from store.put(f"key{i}", i)
+            values = []
+            for i in range(20):
+                v = yield from store.get(f"key{i}")
+                values.append(v)
+            return values
+
+        driver = run_script(script, [store, *shards])
+        assert driver.results == [list(range(20))]
+        # All shards touched (20 hashed keys over 4 shards)
+        assert sum(1 for v in store.stats.shard_writes.values() if v > 0) >= 3
+        total_stored = sum(s.size for s in shards)
+        assert total_stored == 20
+
+    def test_range_sharding_with_boundaries(self):
+        strategy = RangeSharding(boundaries=["g", "p"])
+        assert strategy.get_shard("apple", 3) == 0
+        assert strategy.get_shard("mango", 3) == 1
+        assert strategy.get_shard("zebra", 3) == 2
+
+    def test_consistent_hash_minimal_remap(self):
+        strategy = ConsistentHashSharding(virtual_nodes=100, seed=1)
+        keys = [f"key{i}" for i in range(200)]
+        before = {k: strategy.get_shard(k, 4) for k in keys}
+        strategy2 = ConsistentHashSharding(virtual_nodes=100, seed=1)
+        after = {k: strategy2.get_shard(k, 5) for k in keys}
+        moved = sum(1 for k in keys if before[k] != after[k])
+        # Consistent hashing moves ~1/5 of keys; mod-hash would move ~4/5.
+        assert moved < len(keys) * 0.45
+
+
+# --------------------------------------------------------- ReplicatedStore ----
+class TestReplicatedStore:
+    def test_quorum_read_write(self):
+        replicas = [KVStore(f"r{i}", read_latency=0.001, write_latency=0.002)
+                    for i in range(3)]
+        store = ReplicatedStore("repl", replicas,
+                                read_consistency=ConsistencyLevel.QUORUM,
+                                write_consistency=ConsistencyLevel.QUORUM)
+        assert store.quorum_size == 2
+
+        def script(self):
+            ok = yield from store.put("a", "v")
+            value = yield from store.get("a")
+            return (ok, value)
+
+        driver = run_script(script, [store, *replicas])
+        assert driver.results == [(True, "v")]
+        assert all(r.get_sync("a") == "v" for r in replicas)
+        assert store.stats.write_successes == 1
+        assert store.stats.read_successes == 1
+
+    def test_read_one_stops_early(self):
+        replicas = [KVStore(f"r{i}", read_latency=0.010) for i in range(3)]
+        replicas[0].put_sync("a", "v")
+        store = ReplicatedStore("repl", replicas,
+                                read_consistency=ConsistencyLevel.ONE)
+
+        def script(self):
+            value = yield from store.get("a")
+            return value
+
+        driver = run_script(script, [store, *replicas])
+        assert driver.results == ["v"]
+        assert driver.done_at == pytest.approx(0.010)  # only one replica read
+        assert replicas[1].stats.reads == 0
+
+
+# ---------------------------------------------------------------- Database ----
+class TestDatabase:
+    def test_execute_and_latency(self):
+        db = Database("db", query_latency=0.005, connection_latency=0.001)
+
+        def script(self):
+            rows = yield from db.execute("SELECT * FROM users")
+            result = yield from db.execute("INSERT INTO users VALUES (1)")
+            return (rows, result)
+
+        driver = run_script(script, [db])
+        assert driver.results == [([], {"affected_rows": 1})]
+        assert db.stats.queries_executed == 2
+        assert driver.done_at == pytest.approx(0.012)
+
+    def test_transaction_commit_and_rollback(self):
+        db = Database("db")
+
+        def script(self):
+            tx = yield from db.begin_transaction()
+            yield from tx.execute("INSERT INTO t VALUES (1)")
+            yield from tx.commit()
+            tx2 = yield from db.begin_transaction()
+            yield from tx2.execute("UPDATE t SET x=2")
+            yield from tx2.rollback()
+            return (tx.state.value, tx2.state.value)
+
+        driver = run_script(script, [db])
+        assert driver.results == [("committed", "rolled_back")]
+        assert db.stats.transactions_committed == 1
+        assert db.stats.transactions_rolled_back == 1
+        assert db.active_connections == 0  # all released
+
+    def test_connection_pool_exhaustion_waits(self):
+        db = Database("db", max_connections=1, query_latency=1.0,
+                      connection_latency=0.0)
+        done = []
+
+        class Querier(Entity):
+            def handle_event(self, event):
+                yield from db.execute("SELECT 1")
+                done.append((self.name, round(self.now.to_seconds(), 3)))
+
+        q1, q2 = Querier("q1"), Querier("q2")
+        sim = Simulation(entities=[db, q1, q2], duration=60.0)
+        sim.schedule([Event(t(0.0), "go", target=q1), Event(t(0.0), "go", target=q2)])
+        sim.run()
+        assert done == [("q1", 1.0), ("q2", 2.0)]  # serialized on 1 conn
+        assert db.stats.connection_wait_count == 1
+        assert db.stats.connection_wait_time_total == pytest.approx(1.0)

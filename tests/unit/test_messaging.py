@@ -1,0 +1,423 @@
+"""Unit tests: messaging (MessageQueue/DeadLetterQueue/Topic).
+
+Mirrors the reference's coverage for messaging components using tiny real
+simulations (SURVEY.md §4).
+"""
+
+import pytest
+
+from happysim_tpu import Entity, Event, Instant, Simulation
+from happysim_tpu.components.messaging import (
+    DeadLetterQueue,
+    MessageQueue,
+    MessageState,
+    Topic,
+)
+
+
+def t(seconds):
+    return Instant.from_seconds(seconds)
+
+
+class AckingConsumer(Entity):
+    """Processes each delivery for work_s, then acks."""
+
+    def __init__(self, name, queue, work_s=0.01):
+        super().__init__(name)
+        self.queue = queue
+        self.work_s = work_s
+        self.received = []
+
+    def handle_event(self, event):
+        if event.event_type != "message_delivery":
+            return None
+        meta = event.context["metadata"]
+        self.received.append((meta["message_id"], self.now.to_seconds()))
+        yield self.work_s
+        self.queue.acknowledge(meta["message_id"])
+
+
+class NackingConsumer(Entity):
+    """Rejects the first fail_times deliveries of each message, then acks."""
+
+    def __init__(self, name, queue, fail_times=1, requeue=True):
+        super().__init__(name)
+        self.queue = queue
+        self.fail_times = fail_times
+        self.requeue = requeue
+        self.attempts = {}
+
+    def handle_event(self, event):
+        if event.event_type != "message_delivery":
+            return None
+        meta = event.context["metadata"]
+        mid = meta["message_id"]
+        self.attempts[mid] = self.attempts.get(mid, 0) + 1
+        if self.attempts[mid] <= self.fail_times:
+            return self.queue.reject(mid, requeue=self.requeue)
+        self.queue.acknowledge(mid)
+        return None
+
+
+class Producer(Entity):
+    def __init__(self, name, queue, n=1):
+        super().__init__(name)
+        self.queue = queue
+        self.n = n
+        self.ids = []
+
+    def handle_event(self, event):
+        produced = []
+        for i in range(self.n):
+            payload = Event(self.now, "order", target=self.queue)
+            produced.extend(self.queue.publish(payload))
+        return produced or None
+
+
+def _run(entities, starts, duration=60.0):
+    sim = Simulation(entities=entities, duration=duration)
+    sim.schedule([Event(t(at), "go", target=e) for at, e in starts])
+    sim.run()
+    return sim
+
+
+# ----------------------------------------------------------- MessageQueue ----
+class TestMessageQueue:
+    def test_publish_deliver_ack_roundtrip(self):
+        mq = MessageQueue("orders", delivery_latency=0.005)
+        consumer = AckingConsumer("c", mq)
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=3)
+        _run([mq, consumer, producer], [(0.0, producer)])
+        assert len(consumer.received) == 3
+        assert mq.stats.messages_published == 3
+        assert mq.stats.messages_delivered == 3
+        assert mq.stats.messages_acknowledged == 3
+        assert mq.pending_count == 0
+        assert mq.in_flight_count == 0
+        assert mq.stats.ack_rate == 1.0
+
+    def test_round_robin_across_consumers(self):
+        mq = MessageQueue("orders")
+        c1 = AckingConsumer("c1", mq)
+        c2 = AckingConsumer("c2", mq)
+        mq.subscribe(c1)
+        mq.subscribe(c2)
+        producer = Producer("p", mq, n=4)
+        _run([mq, c1, c2, producer], [(0.0, producer)])
+        assert len(c1.received) == 2
+        assert len(c2.received) == 2
+
+    def test_reject_requeues_until_max_then_dlq(self):
+        dlq = DeadLetterQueue("dlq")
+        mq = MessageQueue("orders", max_redeliveries=2, dead_letter_queue=dlq)
+        consumer = NackingConsumer("c", mq, fail_times=99)  # always fails
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=1)
+        _run([mq, dlq, consumer, producer], [(0.0, producer)])
+        # Delivered twice (max_redeliveries=2), then dead-lettered.
+        mid = next(iter(consumer.attempts))
+        assert consumer.attempts[mid] == 2
+        assert mq.stats.messages_dead_lettered == 1
+        assert dlq.message_count == 1
+        assert dlq.peek().delivery_count == 2
+
+    def test_reject_then_success(self):
+        mq = MessageQueue("orders", max_redeliveries=3)
+        consumer = NackingConsumer("c", mq, fail_times=1)
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=1)
+        _run([mq, consumer, producer], [(0.0, producer)])
+        assert mq.stats.messages_acknowledged == 1
+        assert mq.stats.messages_rejected == 1
+        assert mq.stats.messages_redelivered == 1
+
+    def test_visibility_timeout_redelivers_unacked(self):
+        """A consumer that never acks gets the message redelivered after
+        redelivery_delay, automatically."""
+        mq = MessageQueue("orders", redelivery_delay=1.0, max_redeliveries=3)
+
+        class SilentConsumer(Entity):
+            def __init__(self):
+                super().__init__("silent")
+                self.delivery_times = []
+
+            def handle_event(self, event):
+                if event.event_type == "message_delivery":
+                    self.delivery_times.append(round(self.now.to_seconds(), 3))
+                return None  # never acks
+
+        consumer = SilentConsumer()
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=1)
+        _run([mq, consumer, producer], [(0.0, producer)], duration=10.0)
+        # Initial delivery + redeliveries spaced ~1s apart.
+        assert len(consumer.delivery_times) >= 2
+        assert consumer.delivery_times[1] - consumer.delivery_times[0] == pytest.approx(
+            1.0, abs=0.1
+        )
+
+    def test_ack_cancels_visibility_timer(self):
+        mq = MessageQueue("orders", redelivery_delay=1.0)
+        consumer = AckingConsumer("c", mq)
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=1)
+        _run([mq, consumer, producer], [(0.0, producer)], duration=10.0)
+        assert len(consumer.received) == 1  # no spurious redelivery
+        assert mq.stats.messages_redelivered == 0
+
+    def test_capacity_limit(self):
+        mq = MessageQueue("orders", capacity=2)
+        payload = Event(t(0), "x", target=mq)
+        mq.publish(payload)
+        mq.publish(payload)
+        assert mq.is_full
+        with pytest.raises(RuntimeError):
+            mq.publish(payload)
+
+    def test_no_consumers_messages_wait(self):
+        mq = MessageQueue("orders")
+        producer = Producer("p", mq, n=2)
+        _run([mq, producer], [(0.0, producer)], duration=5.0)
+        assert mq.pending_count == 2
+        assert mq.stats.messages_delivered == 0
+
+
+# ------------------------------------------------------------------- DLQ ----
+class TestDeadLetterQueue:
+    def _dead_letter_one(self, dlq):
+        mq = MessageQueue("orders", max_redeliveries=1, dead_letter_queue=dlq)
+        consumer = NackingConsumer("c", mq, fail_times=99)
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=1)
+        _run([mq, dlq, consumer, producer], [(0.0, producer)])
+        return mq
+
+    def test_capacity_evicts_oldest(self):
+        dlq = DeadLetterQueue("dlq", capacity=2)
+        mq = MessageQueue("orders", max_redeliveries=1, dead_letter_queue=dlq)
+        consumer = NackingConsumer("c", mq, fail_times=99)
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=3)
+        _run([mq, dlq, consumer, producer], [(0.0, producer)])
+        assert dlq.message_count == 2
+        assert dlq.stats.messages_received == 3
+        assert dlq.stats.messages_discarded == 1
+
+    def test_reprocess_republishes(self):
+        dlq = DeadLetterQueue("dlq")
+        mq = self._dead_letter_one(dlq)
+        assert dlq.message_count == 1
+
+        # Second phase: consumer now succeeds; reprocess the dead letter.
+        fixed_consumer = AckingConsumer("fixed", mq)
+        mq._consumers = []
+        mq.subscribe(fixed_consumer)
+
+        class Operator(Entity):
+            def handle_event(self, event):
+                return dlq.reprocess_all(mq)
+
+        operator = Operator("op")
+        _run([mq, dlq, fixed_consumer, operator], [(0.0, operator)])
+        assert dlq.message_count == 0
+        assert dlq.stats.messages_reprocessed == 1
+        assert len(fixed_consumer.received) == 1
+
+    def test_pop_peek_clear(self):
+        dlq = DeadLetterQueue("dlq")
+        self._dead_letter_one(dlq)
+        assert dlq.peek() is not None
+        msg = dlq.pop()
+        assert msg.state == MessageState.REJECTED
+        assert dlq.message_count == 0
+        assert dlq.pop() is None
+        self._dead_letter_one(DeadLetterQueue("other"))  # unrelated
+        assert dlq.clear() == 0
+
+
+# ----------------------------------------------------------------- Topic ----
+class TestTopic:
+    def test_broadcast_to_all_subscribers(self):
+        topic = Topic("events", delivery_latency=0.01)
+
+        class Listener(Entity):
+            def __init__(self, name):
+                super().__init__(name)
+                self.got = []
+
+            def handle_event(self, event):
+                if event.event_type == "topic_message":
+                    self.got.append(round(self.now.to_seconds(), 4))
+                return None
+
+        l1, l2 = Listener("l1"), Listener("l2")
+        topic.subscribe(l1)
+        topic.subscribe(l2)
+
+        class Publisher(Entity):
+            def handle_event(self, event):
+                return topic.publish(Event(self.now, "news", target=topic))
+
+        pub = Publisher("pub")
+        _run([topic, l1, l2, pub], [(1.0, pub)])
+        assert l1.got == [1.01]
+        assert l2.got == [1.01]
+        assert topic.stats.messages_published == 1
+        assert topic.stats.messages_delivered == 2
+
+    def test_unsubscribe_stops_delivery(self):
+        topic = Topic("events")
+        sink_counts = {"a": 0}
+
+        class L(Entity):
+            def handle_event(self, event):
+                sink_counts["a"] += 1
+                return None
+
+        listener = L("l")
+        topic.subscribe(listener)
+        topic.unsubscribe(listener)
+
+        class Publisher(Entity):
+            def handle_event(self, event):
+                return topic.publish(Event(self.now, "news", target=topic)) or None
+
+        pub = Publisher("pub")
+        _run([topic, listener, pub], [(0.0, pub)])
+        assert sink_counts["a"] == 0
+        assert topic.subscriber_count == 0
+
+    def test_history_replay_for_late_subscriber(self):
+        topic = Topic("events")
+        topic.set_retain_messages(True)
+
+        class Listener(Entity):
+            def __init__(self, name):
+                super().__init__(name)
+                self.replays = 0
+
+            def handle_event(self, event):
+                if event.event_type == "topic_message":
+                    if event.context["metadata"]["is_replay"]:
+                        self.replays += 1
+                return None
+
+        early_payloads = [Event(t(0), f"m{i}", target=topic) for i in range(3)]
+        for p in early_payloads:
+            topic.publish(p)  # outside sim: history only
+        late = Listener("late")
+
+        class Joiner(Entity):
+            def handle_event(self, event):
+                return topic.subscribe(late, replay_history=True) or None
+
+        joiner = Joiner("joiner")
+        _run([topic, late, joiner], [(5.0, joiner)])
+        assert late.replays == 3
+
+    def test_max_subscribers(self):
+        topic = Topic("events", max_subscribers=1)
+        topic.subscribe(Entity.__new__(Entity) if False else _dummy("a"))
+        with pytest.raises(RuntimeError):
+            topic.subscribe(_dummy("b"))
+
+
+def _dummy(name):
+    class D(Entity):
+        def handle_event(self, event):
+            return None
+
+    return D(name)
+
+
+class TestMessageQueueReviewRegressions:
+    def test_reject_with_dropped_return_still_redelivers(self):
+        """A consumer that calls reject() and drops the returned events must
+        not stall the message (kick is self-scheduled in-sim)."""
+        mq = MessageQueue("orders", max_redeliveries=5, redelivery_delay=1.0)
+
+        class DropReturnConsumer(Entity):
+            def __init__(self):
+                super().__init__("drc")
+                self.deliveries = 0
+
+            def handle_event(self, event):
+                if event.event_type != "message_delivery":
+                    return None
+                self.deliveries += 1
+                mid = event.context["metadata"]["message_id"]
+                if self.deliveries < 3:
+                    mq.reject(mid)  # return value dropped on the floor
+                    return None
+                mq.acknowledge(mid)
+                return None
+
+        consumer = DropReturnConsumer()
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=1)
+        _run([mq, consumer, producer], [(0.0, producer)], duration=30.0)
+        assert consumer.deliveries == 3
+        assert mq.stats.messages_acknowledged == 1
+        assert mq.pending_count == 0
+
+    def test_redelivery_timer_after_kick_does_not_duplicate(self):
+        """schedule_redelivery + a later publish-kick must deliver the
+        requeued message exactly once."""
+        mq = MessageQueue("orders", redelivery_delay=5.0, auto_redelivery=False)
+        seen = []
+
+        class Recorder(Entity):
+            def handle_event(self, event):
+                if event.event_type == "message_delivery":
+                    seen.append(
+                        (event.context["metadata"]["message_id"],
+                         round(self.now.to_seconds(), 3))
+                    )
+                return None
+
+        consumer = Recorder("rec")
+        mq.subscribe(consumer)
+
+        class Script(Entity):
+            def handle_event(self, event):
+                produced = list(mq.publish(Event(self.now, "m1", target=mq)))
+                yield 0.1
+                # m1 delivered; manually requeue it with a 5s timer...
+                mid = seen[0][0]
+                redeliver = mq.schedule_redelivery(mid)
+                # ...then publish m2, whose kick would poll m1 early.
+                produced2 = list(mq.publish(Event(self.now, "m2", target=mq)))
+                return [*produced, *( [redeliver] if redeliver else [] ), *produced2]
+
+        script = Script("script")
+        _run([mq, consumer, script], [(0.0, script)], duration=30.0)
+        m1_deliveries = [s for s in seen if s[0].endswith("-1")]
+        # m1: initial delivery + exactly ONE redelivery (no timer duplicate).
+        assert len(m1_deliveries) == 2
+
+    def test_direct_poll_arms_visibility_timer(self):
+        """Pull-style consumption also gets unacked-redelivery protection."""
+        mq = MessageQueue("orders", redelivery_delay=1.0, max_redeliveries=2)
+        deliveries = []
+
+        class Sink(Entity):
+            def handle_event(self, event):
+                if event.event_type == "message_delivery":
+                    deliveries.append(round(self.now.to_seconds(), 3))
+                return None
+
+        sink = Sink("sink")
+        mq.subscribe(sink)
+        mq.unsubscribe  # noqa: B018 — keep subscribed; pull still uses consumer list
+
+        class Puller(Entity):
+            def handle_event(self, event):
+                mq.publish(Event(self.now, "m", target=mq))
+                delivery = mq.poll()
+                return [delivery] if delivery else None
+
+        puller = Puller("puller")
+        _run([mq, sink, puller], [(0.0, puller)], duration=10.0)
+        # Never acked -> redelivered via the timer armed by poll().
+        assert len(deliveries) >= 2
