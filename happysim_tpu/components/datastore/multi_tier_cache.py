@@ -127,13 +127,18 @@ class MultiTierCache(Entity):
         return value
 
     def put(self, key: str, value: Any) -> Generator[float, None, None]:
-        """Write through to the store; invalidate all tiers, refill L1."""
+        """Write through to the store; invalidate all tiers, refill L1.
+
+        The refill goes into L1's cache dict only (like the miss-fill
+        path) — NOT through L1's own ``put``, which would write-through to
+        L1's private backing store and double-pay write latency.
+        """
         self._writes += 1
         yield from self._backing_store.put(key, value)
         for tier in self._tiers:
             if hasattr(tier, "invalidate"):
                 tier.invalidate(key)
-        yield from self._tiers[0].put(key, value)
+        self._cache_value(key, value)
 
     def delete(self, key: str) -> Generator[float, None, bool]:
         existed = False

@@ -199,13 +199,18 @@ class MessageQueue(Entity):
 
     # -- consumer side -----------------------------------------------------
     def acknowledge(self, message_id: str) -> None:
-        """Mark successfully processed; removes it and cancels redelivery."""
+        """Mark successfully processed; removes it and cancels redelivery.
+
+        A late ack (after a visibility timeout already requeued the
+        message) still wins: the queued copy is withdrawn.
+        """
         msg = self._messages.get(message_id)
         if msg is None:
             return
         msg.state = MessageState.ACKNOWLEDGED
         self._in_flight.pop(message_id, None)
         self._messages.pop(message_id, None)
+        self._remove_pending(message_id)
         self._cancel_visibility(message_id)
         self._redelivery_scheduled.discard(message_id)
         self._messages_acknowledged += 1
@@ -217,7 +222,9 @@ class MessageQueue(Entity):
         scheduled directly); outside one, schedule the returned events.
         """
         msg = self._messages.get(message_id)
-        if msg is None:
+        if msg is None or msg.state is not MessageState.DELIVERED:
+            # Only an in-flight delivery can be rejected; a second reject
+            # (or one racing a visibility requeue) must not double-queue.
             return []
         msg.state = MessageState.REJECTED
         self._messages_rejected += 1
@@ -231,10 +238,21 @@ class MessageQueue(Entity):
         return []
 
     def poll(self) -> Optional[Event]:
-        """Pull-style: deliver the head pending message now, if any."""
-        if not self._pending_queue or not self._consumers:
-            return None
-        return self._deliver(self._pending_queue[0])
+        """Pull-style: deliver the head pending message now, if any.
+
+        Stale head ids (acked/dead-lettered/already-delivered copies) are
+        dropped in passing so they can never wedge the queue.
+        """
+        while self._pending_queue:
+            head = self._pending_queue[0]
+            msg = self._messages.get(head)
+            if msg is None or msg.state is not MessageState.PENDING:
+                self._pending_queue.popleft()
+                continue
+            if not self._consumers:
+                return None
+            return self._deliver(head)
+        return None
 
     def schedule_redelivery(self, message_id: str) -> Optional[Event]:
         """Manually requeue an in-flight message for redelivery after
@@ -247,9 +265,11 @@ class MessageQueue(Entity):
             self.reject(message_id, requeue=False)
             return None
         self._redelivery_scheduled.add(message_id)
+        # PENDING but deliberately NOT queued: the message sits out the
+        # delay invisibly, so an unrelated publish kick can't pick it up
+        # early. The timer's handler delivers it by id directly.
         msg.state = MessageState.PENDING
         self._in_flight.pop(message_id, None)
-        self._pending_queue.appendleft(message_id)
         self._cancel_visibility(message_id)
         now = self._clock.now if self._clock else Instant.Epoch
         return Event(
@@ -347,11 +367,18 @@ class MessageQueue(Entity):
         if timer is not None:
             timer.cancel()
 
+    def _remove_pending(self, message_id: str) -> None:
+        try:
+            self._pending_queue.remove(message_id)
+        except ValueError:
+            pass
+
     def _dead_letter(self, msg: Message) -> None:
         if self._dead_letter_queue is not None:
             self._dead_letter_queue.add_message(msg)
             self._messages_dead_lettered += 1
         self._messages.pop(msg.id, None)
+        self._remove_pending(msg.id)
         self._redelivery_scheduled.discard(msg.id)
 
     def handle_event(self, event: Event):

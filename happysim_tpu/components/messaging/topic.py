@@ -117,8 +117,9 @@ class Topic(Entity):
         return events
 
     def unsubscribe(self, subscriber: Entity) -> None:
-        if subscriber in self._subscriptions:
-            self._subscriptions[subscriber].active = False
+        subscription = self._subscriptions.get(subscriber)
+        if subscription is not None and subscription.active:
+            subscription.active = False
             self._subscribers_removed += 1
 
     def set_retain_messages(self, retain: bool, max_history: int = 100) -> None:

@@ -1,0 +1,59 @@
+"""Storage-engine components — LSM tree, B-tree, WAL, transactions.
+
+Parity target: ``happysimulator/components/storage/`` (SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.storage.btree import BTree, BTreeStats
+from happysim_tpu.components.storage.lsm_tree import (
+    CompactionStrategy,
+    FIFOCompaction,
+    LSMTree,
+    LSMTreeStats,
+    LeveledCompaction,
+    SizeTieredCompaction,
+)
+from happysim_tpu.components.storage.memtable import Memtable, MemtableStats
+from happysim_tpu.components.storage.sstable import SSTable, SSTableStats
+from happysim_tpu.components.storage.transaction_manager import (
+    IsolationLevel,
+    StorageEngine,
+    StorageTransaction,
+    TransactionManager,
+    TransactionStats,
+)
+from happysim_tpu.components.storage.wal import (
+    SyncEveryWrite,
+    SyncOnBatch,
+    SyncPeriodic,
+    SyncPolicy,
+    WALEntry,
+    WALStats,
+    WriteAheadLog,
+)
+
+__all__ = [
+    "BTree",
+    "BTreeStats",
+    "CompactionStrategy",
+    "FIFOCompaction",
+    "IsolationLevel",
+    "LSMTree",
+    "LSMTreeStats",
+    "LeveledCompaction",
+    "Memtable",
+    "MemtableStats",
+    "SSTable",
+    "SSTableStats",
+    "SizeTieredCompaction",
+    "StorageEngine",
+    "StorageTransaction",
+    "SyncEveryWrite",
+    "SyncOnBatch",
+    "SyncPeriodic",
+    "SyncPolicy",
+    "TransactionManager",
+    "TransactionStats",
+    "WALEntry",
+    "WALStats",
+    "WriteAheadLog",
+]

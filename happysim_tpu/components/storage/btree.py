@@ -1,0 +1,231 @@
+"""B-tree index with page-I/O latency model.
+
+Parity target: ``happysimulator/components/storage/btree.py:65`` (order-k
+nodes, traversal costs depth page reads, writes add a page write, splits
+add write amplification; ``BTreeStats`` :31).
+
+A classic top-down-search/bottom-up-split B-tree. Deletes remove the key
+from its leaf without rebalancing (the reference models read/write cost,
+not occupancy invariants under deletion).
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import Any, Generator, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class BTreeStats:
+    reads: int = 0
+    writes: int = 0
+    deletes: int = 0
+    hits: int = 0
+    misses: int = 0
+    node_splits: int = 0
+    depth: int = 0
+    size: int = 0
+
+
+class _Node:
+    __slots__ = ("leaf", "keys", "values", "children")
+
+    def __init__(self, leaf: bool = True):
+        self.leaf = leaf
+        self.keys: list[str] = []
+        self.values: list[Any] = []  # leaf payloads (parallel to keys)
+        self.children: list["_Node"] = []
+
+
+class BTree(Entity):
+    """Each traversal costs depth × page_read_latency; writes add page
+    writes (plus one per node split)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        order: int = 128,
+        page_read_latency: float = 0.001,
+        page_write_latency: float = 0.002,
+    ):
+        if order < 3:
+            raise ValueError(f"order must be >= 3, got {order}")
+        super().__init__(name)
+        self._order = order
+        self._page_read_latency = page_read_latency
+        self._page_write_latency = page_write_latency
+        self._root = _Node(leaf=True)
+        self._depth = 1
+        self._size = 0
+        self._total_reads = 0
+        self._total_writes = 0
+        self._total_deletes = 0
+        self._total_hits = 0
+        self._total_misses = 0
+        self._total_splits = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def order(self) -> int:
+        return self._order
+
+    @property
+    def stats(self) -> BTreeStats:
+        return BTreeStats(
+            reads=self._total_reads,
+            writes=self._total_writes,
+            deletes=self._total_deletes,
+            hits=self._total_hits,
+            misses=self._total_misses,
+            node_splits=self._total_splits,
+            depth=self._depth,
+            size=self._size,
+        )
+
+    # -- operations --------------------------------------------------------
+    def get(self, key: str) -> Generator[float, None, Optional[Any]]:
+        yield self._depth * self._page_read_latency
+        return self.get_sync(key)
+
+    def get_sync(self, key: str) -> Optional[Any]:
+        self._total_reads += 1
+        node = self._root
+        while True:
+            idx = bisect.bisect_left(node.keys, key)
+            if node.leaf:
+                if idx < len(node.keys) and node.keys[idx] == key:
+                    self._total_hits += 1
+                    return node.values[idx]
+                self._total_misses += 1
+                return None
+            if idx < len(node.keys) and node.keys[idx] == key:
+                idx += 1  # equal separator: key lives in the right subtree
+            node = node.children[idx]
+
+    def put(self, key: str, value: Any) -> Generator[float, None, None]:
+        yield self._depth * self._page_read_latency
+        splits_before = self._total_splits
+        self.put_sync(key, value)
+        new_splits = self._total_splits - splits_before
+        yield (1 + new_splits) * self._page_write_latency
+
+    def put_sync(self, key: str, value: Any) -> None:
+        self._total_writes += 1
+        root = self._root
+        if len(root.keys) >= self._order - 1:
+            new_root = _Node(leaf=False)
+            new_root.children.append(root)
+            self._split_child(new_root, 0)
+            self._root = new_root
+            self._depth += 1
+        self._insert_nonfull(self._root, key, value)
+
+    def delete(self, key: str) -> Generator[float, None, bool]:
+        yield self._depth * self._page_read_latency
+        existed = self.delete_sync(key)
+        if existed:
+            yield self._page_write_latency
+        return existed
+
+    def delete_sync(self, key: str) -> bool:
+        self._total_deletes += 1
+        node = self._root
+        while True:
+            idx = bisect.bisect_left(node.keys, key)
+            if node.leaf:
+                if idx < len(node.keys) and node.keys[idx] == key:
+                    node.keys.pop(idx)
+                    node.values.pop(idx)
+                    self._size -= 1
+                    return True
+                return False
+            if idx < len(node.keys) and node.keys[idx] == key:
+                idx += 1
+            node = node.children[idx]
+
+    def scan(
+        self, start_key: Optional[str] = None, end_key: Optional[str] = None
+    ) -> Generator[float, None, list[tuple[str, Any]]]:
+        """In-order range scan; costs one page read per visited leaf."""
+        result: list[tuple[str, Any]] = []
+        leaves = [0]
+
+        def visit(node: _Node) -> None:
+            if node.leaf:
+                leaves[0] += 1
+                for k, v in zip(node.keys, node.values):
+                    if (start_key is None or k >= start_key) and (
+                        end_key is None or k < end_key
+                    ):
+                        result.append((k, v))
+                return
+            for i, child in enumerate(node.children):
+                lo_ok = start_key is None or i >= bisect.bisect_left(node.keys, start_key)
+                hi_ok = end_key is None or i <= bisect.bisect_right(node.keys, end_key)
+                if lo_ok and hi_ok:
+                    visit(child)
+
+        visit(self._root)
+        yield (self._depth + leaves[0]) * self._page_read_latency
+        return sorted(result)
+
+    # -- internals ---------------------------------------------------------
+    def _split_child(self, parent: _Node, child_idx: int) -> None:
+        child = parent.children[child_idx]
+        mid = len(child.keys) // 2
+        sibling = _Node(leaf=child.leaf)
+        if child.leaf:
+            # Leaf split: separator is COPIED up (B+-style), both halves
+            # keep their payloads.
+            separator = child.keys[mid]
+            sibling.keys = child.keys[mid:]
+            sibling.values = child.values[mid:]
+            child.keys = child.keys[:mid]
+            child.values = child.values[:mid]
+        else:
+            separator = child.keys[mid]
+            sibling.keys = child.keys[mid + 1 :]
+            sibling.children = child.children[mid + 1 :]
+            child.keys = child.keys[:mid]
+            child.children = child.children[: mid + 1]
+        parent.keys.insert(child_idx, separator)
+        parent.children.insert(child_idx + 1, sibling)
+        self._total_splits += 1
+
+    def _insert_nonfull(self, node: _Node, key: str, value: Any) -> None:
+        while not node.leaf:
+            idx = bisect.bisect_left(node.keys, key)
+            if idx < len(node.keys) and node.keys[idx] == key:
+                idx += 1
+            child = node.children[idx]
+            if len(child.keys) >= self._order - 1:
+                self._split_child(node, idx)
+                if key >= node.keys[idx]:
+                    idx += 1
+            node = node.children[idx]
+        idx = bisect.bisect_left(node.keys, key)
+        if idx < len(node.keys) and node.keys[idx] == key:
+            node.values[idx] = value  # update in place
+        else:
+            node.keys.insert(idx, key)
+            node.values.insert(idx, value)
+            self._size += 1
+
+    def handle_event(self, event: Event) -> None:
+        return None
+
+    def __repr__(self) -> str:
+        return f"BTree('{self.name}', size={self._size}, depth={self._depth})"

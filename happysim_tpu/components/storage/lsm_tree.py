@@ -1,0 +1,453 @@
+"""Log-structured merge tree: WAL + memtable + leveled SSTables.
+
+Parity target: ``happysimulator/components/storage/lsm_tree.py:204``
+(compaction strategies :57-162, ``put`` :335, ``get`` :370 with bloom
+skips, ``scan`` :463, ``_flush_memtable`` :495, ``_compact`` :559,
+``crash``/``recover_from_crash`` :650-706, amplification stats :286).
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Any, Generator, Optional
+
+from happysim_tpu.components.storage.memtable import Memtable
+from happysim_tpu.components.storage.sstable import SSTable
+from happysim_tpu.components.storage.wal import WriteAheadLog
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+logger = logging.getLogger(__name__)
+
+_BYTES_PER_ENTRY = 64
+
+
+class _Tombstone:
+    """Sentinel marking a deleted key until compaction drops it."""
+
+    def __repr__(self) -> str:
+        return "<TOMBSTONE>"
+
+
+_TOMBSTONE = _Tombstone()
+
+
+# ---------------------------------------------------------- compaction ----
+class CompactionStrategy(ABC):
+    @abstractmethod
+    def should_compact(self, levels: list[list[SSTable]]) -> bool: ...
+
+    @abstractmethod
+    def select_compaction(
+        self, levels: list[list[SSTable]]
+    ) -> tuple[int, list[SSTable]]:
+        """(source_level, sstables_to_merge)."""
+
+
+class SizeTieredCompaction(CompactionStrategy):
+    """Compact the most populated level once any level has ≥ min_sstables."""
+
+    def __init__(self, min_sstables: int = 4):
+        self.min_sstables = min_sstables
+
+    def should_compact(self, levels: list[list[SSTable]]) -> bool:
+        return any(len(level) >= self.min_sstables for level in levels)
+
+    def select_compaction(self, levels: list[list[SSTable]]) -> tuple[int, list[SSTable]]:
+        best = max(range(len(levels)), key=lambda i: len(levels[i]), default=0)
+        return best, list(levels[best])
+
+
+class LeveledCompaction(CompactionStrategy):
+    """L0 by sstable count; deeper levels by key budget base·ratio^level."""
+
+    def __init__(self, level_0_max: int = 4, size_ratio: int = 10, base_size_keys: int = 1000):
+        self.level_0_max = level_0_max
+        self.size_ratio = size_ratio
+        self.base_size_keys = base_size_keys
+
+    def _over_budget(self, levels: list[list[SSTable]]) -> Optional[int]:
+        if levels and len(levels[0]) >= self.level_0_max:
+            return 0
+        for i in range(1, len(levels)):
+            limit = self.base_size_keys * (self.size_ratio**i)
+            if sum(s.key_count for s in levels[i]) > limit:
+                return i
+        return None
+
+    def should_compact(self, levels: list[list[SSTable]]) -> bool:
+        return self._over_budget(levels) is not None
+
+    def select_compaction(self, levels: list[list[SSTable]]) -> tuple[int, list[SSTable]]:
+        level = self._over_budget(levels)
+        if level is None:
+            level = 0
+        return level, list(levels[level]) if levels else []
+
+
+class FIFOCompaction(CompactionStrategy):
+    """Time-series style: when total sstables exceed the cap, drop the
+    oldest (deepest) level."""
+
+    def __init__(self, max_total_sstables: int = 100):
+        self.max_total_sstables = max_total_sstables
+
+    def should_compact(self, levels: list[list[SSTable]]) -> bool:
+        return sum(len(level) for level in levels) > self.max_total_sstables
+
+    def select_compaction(self, levels: list[list[SSTable]]) -> tuple[int, list[SSTable]]:
+        for i in range(len(levels) - 1, -1, -1):
+            if levels[i]:
+                return i, list(levels[i])
+        return 0, []
+
+
+# --------------------------------------------------------------- stats ----
+@dataclass(frozen=True)
+class LSMTreeStats:
+    writes: int = 0
+    reads: int = 0
+    read_hits: int = 0
+    read_misses: int = 0
+    wal_writes: int = 0
+    memtable_flushes: int = 0
+    compactions: int = 0
+    total_sstables: int = 0
+    levels: int = 0
+    read_amplification: float = 0.0
+    write_amplification: float = 1.0
+    space_amplification: float = 1.0
+    bloom_filter_saves: int = 0
+
+
+# ---------------------------------------------------------------- tree ----
+class LSMTree(Entity):
+    """Write path: WAL → memtable → L0 flush → compaction down-levels.
+    Read path: memtable → immutables → levels (bloom-guarded)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        memtable_size: int = 1000,
+        compaction_strategy: Optional[CompactionStrategy] = None,
+        wal: Optional[WriteAheadLog] = None,
+        sstable_read_latency: float = 0.001,
+        sstable_write_latency: float = 0.002,
+        max_levels: int = 7,
+    ):
+        super().__init__(name)
+        self._compaction_strategy = compaction_strategy or SizeTieredCompaction()
+        self._wal = wal
+        self._sstable_read_latency = sstable_read_latency
+        self._sstable_write_latency = sstable_write_latency
+        self._max_levels = max_levels
+        self._memtable = Memtable(f"{name}_memtable", size_threshold=memtable_size)
+        self._immutable_memtables: list[Memtable] = []
+        self._levels: list[list[SSTable]] = [[] for _ in range(max_levels)]
+        self._logical_data: dict[str, Any] = {}
+        self._user_bytes_written = 0
+        self._sstable_bytes_written = 0
+        self._total_writes = 0
+        self._total_reads = 0
+        self._total_read_hits = 0
+        self._total_read_misses = 0
+        self._total_wal_writes = 0
+        self._total_memtable_flushes = 0
+        self._total_compactions = 0
+        self._total_sstables_checked = 0
+        self._total_bloom_saves = 0
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self._wal] if self._wal is not None else []
+
+    def set_clock(self, clock) -> None:
+        super().set_clock(clock)
+        self._memtable.set_clock(clock)
+        if self._wal is not None and self._wal._clock is None:
+            self._wal.set_clock(clock)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> LSMTreeStats:
+        total_sst = sum(len(level) for level in self._levels)
+        logical_bytes = len(self._logical_data) * _BYTES_PER_ENTRY
+        total_stored = sum(s.size_bytes for level in self._levels for s in level)
+        return LSMTreeStats(
+            writes=self._total_writes,
+            reads=self._total_reads,
+            read_hits=self._total_read_hits,
+            read_misses=self._total_read_misses,
+            wal_writes=self._total_wal_writes,
+            memtable_flushes=self._total_memtable_flushes,
+            compactions=self._total_compactions,
+            total_sstables=total_sst,
+            levels=sum(1 for level in self._levels if level),
+            read_amplification=(
+                self._total_sstables_checked / self._total_reads if self._total_reads else 0.0
+            ),
+            write_amplification=(
+                self._sstable_bytes_written / self._user_bytes_written
+                if self._user_bytes_written
+                else 1.0
+            ),
+            space_amplification=(total_stored / logical_bytes if logical_bytes else 1.0),
+            bloom_filter_saves=self._total_bloom_saves,
+        )
+
+    @property
+    def level_summary(self) -> list[dict]:
+        return [
+            {
+                "level": i,
+                "sstables": len(level),
+                "total_keys": sum(s.key_count for s in level),
+                "total_bytes": sum(s.size_bytes for s in level),
+            }
+            for i, level in enumerate(self._levels)
+            if level
+        ]
+
+    @property
+    def memtable(self) -> Memtable:
+        return self._memtable
+
+    # -- write path --------------------------------------------------------
+    def put(self, key: str, value: Any) -> Generator[float, None, None]:
+        self._account_write(key, value)
+        if self._wal is not None:
+            yield from self._wal.append(key, value)
+            self._total_wal_writes += 1
+        is_full = yield from self._memtable.put(key, value)
+        if is_full:
+            yield from self._flush_memtable()
+
+    def put_sync(self, key: str, value: Any) -> None:
+        self._account_write(key, value)
+        if self._wal is not None:
+            self._wal.append_sync(key, value)
+            self._total_wal_writes += 1
+        if self._memtable.put_sync(key, value):
+            self._flush_memtable_sync()
+
+    def delete(self, key: str) -> Generator[float, None, None]:
+        """Writes a tombstone; the key disappears at read + compaction."""
+        self._total_writes += 1
+        self._user_bytes_written += _BYTES_PER_ENTRY
+        self._logical_data.pop(key, None)
+        if self._wal is not None:
+            yield from self._wal.append(key, _TOMBSTONE)
+            self._total_wal_writes += 1
+        is_full = yield from self._memtable.put(key, _TOMBSTONE)
+        if is_full:
+            yield from self._flush_memtable()
+
+    def _account_write(self, key: str, value: Any) -> None:
+        self._total_writes += 1
+        self._user_bytes_written += _BYTES_PER_ENTRY
+        self._logical_data[key] = value
+
+    # -- read path ---------------------------------------------------------
+    def get(self, key: str) -> Generator[float, None, Optional[Any]]:
+        self._total_reads += 1
+        found, value = self._get_memory(key)
+        if found:
+            return value
+        for level in self._levels:
+            for sstable in reversed(level):  # newest first
+                self._total_sstables_checked += 1
+                if not sstable.contains(key):
+                    self._total_bloom_saves += 1
+                    continue
+                page_reads = sstable.page_reads_for_get(key)
+                if page_reads > 0:
+                    yield page_reads * self._sstable_read_latency
+                result = sstable.get(key)
+                if result is not None:
+                    self._total_read_hits += 1
+                    return None if result is _TOMBSTONE else result
+        self._total_read_misses += 1
+        return None
+
+    def get_sync(self, key: str) -> Optional[Any]:
+        self._total_reads += 1
+        found, value = self._get_memory(key)
+        if found:
+            return value
+        for level in self._levels:
+            for sstable in reversed(level):
+                self._total_sstables_checked += 1
+                if not sstable.contains(key):
+                    self._total_bloom_saves += 1
+                    continue
+                result = sstable.get(key)
+                if result is not None:
+                    self._total_read_hits += 1
+                    return None if result is _TOMBSTONE else result
+        self._total_read_misses += 1
+        return None
+
+    def _get_memory(self, key: str) -> tuple[bool, Optional[Any]]:
+        """(found, value) checking active then immutable memtables."""
+        value = self._memtable.get_sync(key)
+        if value is not None:
+            self._total_read_hits += 1
+            return True, (None if value is _TOMBSTONE else value)
+        for imm in reversed(self._immutable_memtables):
+            value = imm.get_sync(key)
+            if value is not None:
+                self._total_read_hits += 1
+                return True, (None if value is _TOMBSTONE else value)
+        return False, None
+
+    def scan(
+        self, start_key: str, end_key: str
+    ) -> Generator[float, None, list[tuple[str, Any]]]:
+        """Merged [start_key, end_key) snapshot, newest value per key."""
+        merged: dict[str, Any] = {
+            k: v for k, v in self._memtable._data.items() if start_key <= k < end_key
+        }
+        for imm in reversed(self._immutable_memtables):
+            for k, v in imm._data.items():
+                if start_key <= k < end_key and k not in merged:
+                    merged[k] = v
+        for level in self._levels:
+            for sstable in reversed(level):
+                page_reads = sstable.page_reads_for_scan(start_key, end_key)
+                if page_reads > 0:
+                    yield page_reads * self._sstable_read_latency
+                for k, v in sstable.scan(start_key, end_key):
+                    if k not in merged:
+                        merged[k] = v
+        return [(k, v) for k, v in sorted(merged.items()) if v is not _TOMBSTONE]
+
+    # -- flush & compaction ------------------------------------------------
+    def _flush_memtable(self) -> Generator[float, None, None]:
+        if self._memtable.size == 0:
+            return
+        old = self._rotate_memtable()
+        sstable = old.flush()
+        self._sstable_bytes_written += sstable.size_bytes
+        pages = max(1, sstable.key_count // 16)
+        yield pages * self._sstable_write_latency
+        self._levels[0].append(sstable)
+        self._total_memtable_flushes += 1
+        self._immutable_memtables.remove(old)
+        if self._wal is not None:
+            self._wal.truncate(self._wal._next_sequence - 1)
+        if self._compaction_strategy.should_compact(self._levels):
+            yield from self._compact()
+
+    def _flush_memtable_sync(self) -> None:
+        if self._memtable.size == 0:
+            return
+        sstable = self._memtable.flush()
+        self._sstable_bytes_written += sstable.size_bytes
+        self._levels[0].append(sstable)
+        self._total_memtable_flushes += 1
+        if self._wal is not None:
+            self._wal.truncate(self._wal._next_sequence - 1)
+        if self._compaction_strategy.should_compact(self._levels):
+            self._apply_compaction()
+
+    def _rotate_memtable(self) -> Memtable:
+        old = self._memtable
+        self._immutable_memtables.append(old)
+        self._memtable = Memtable(
+            f"{self.name}_memtable", size_threshold=old._size_threshold
+        )
+        if self._clock is not None:
+            self._memtable.set_clock(self._clock)
+        return old
+
+    def _compact(self) -> Generator[float, None, None]:
+        new_sst = self._apply_compaction()
+        if new_sst is not None:
+            pages = max(1, new_sst.key_count // 16)
+            yield pages * self._sstable_write_latency
+
+    def _apply_compaction(self) -> Optional[SSTable]:
+        """Merge the selected run into the next level; returns the new
+        SSTable (None if the selection was empty/all-tombstone)."""
+        source_level, sstables = self._compaction_strategy.select_compaction(self._levels)
+        if not sstables:
+            return None
+        target_level = min(source_level + 1, self._max_levels - 1)
+        merged: dict[str, Any] = {}
+        # Newest first so the freshest value wins each key.
+        for sst in reversed(sstables):
+            for k, v in sst.scan():
+                merged.setdefault(k, v)
+        overlapping: list[SSTable] = []
+        if target_level != source_level:
+            for sst in self._levels[target_level]:
+                if any(sst.overlaps(s) for s in sstables):
+                    overlapping.append(sst)
+                    for k, v in sst.scan():
+                        merged.setdefault(k, v)
+        if target_level == self._max_levels - 1:
+            # Bottom level: tombstones have shadowed everything below — drop.
+            merged = {k: v for k, v in merged.items() if v is not _TOMBSTONE}
+        self._total_compactions += 1
+        new_sst: Optional[SSTable] = None
+        data_list = sorted(merged.items())
+        if data_list:
+            new_sst = SSTable(data_list, level=target_level, sequence=self._total_compactions)
+            self._sstable_bytes_written += new_sst.size_bytes
+        for sst in sstables:
+            if sst in self._levels[source_level]:
+                self._levels[source_level].remove(sst)
+        for sst in overlapping:
+            self._levels[target_level].remove(sst)
+        if new_sst is not None:
+            self._levels[target_level].append(new_sst)
+        return new_sst
+
+    # -- crash / recovery --------------------------------------------------
+    def crash(self) -> dict:
+        """Volatile state (memtables, unsynced WAL) is lost; SSTables
+        survive. Returns loss counts."""
+        memtable_lost = self._memtable.size
+        immutable_lost = sum(m.size for m in self._immutable_memtables)
+        self._memtable = Memtable(
+            f"{self.name}_memtable", size_threshold=self._memtable._size_threshold
+        )
+        if self._clock is not None:
+            self._memtable.set_clock(self._clock)
+        self._immutable_memtables.clear()
+        wal_lost = self._wal.crash() if self._wal is not None else 0
+        return {
+            "memtable_entries_lost": memtable_lost,
+            "immutable_memtable_entries_lost": immutable_lost,
+            "wal_entries_lost": wal_lost,
+        }
+
+    def recover_from_crash(self) -> dict:
+        """Replay surviving WAL entries into a fresh memtable."""
+        wal_recovered = 0
+        if self._wal is not None:
+            for entry in self._wal.recover():
+                self._memtable.put_sync(entry.key, entry.value)
+            wal_recovered = self._wal.stats.entries_recovered
+        sstable_keys = sum(s.key_count for level in self._levels for s in level)
+        return {
+            "wal_entries_replayed": wal_recovered,
+            "sstable_keys": sstable_keys,
+            "total_keys_recovered": self._memtable.size + sstable_keys,
+        }
+
+    def handle_event(self, event: Event):
+        if event.event_type == "CompactionTrigger" and self._compaction_strategy.should_compact(
+            self._levels
+        ):
+            return self._compact()
+        return None
+
+    def __repr__(self) -> str:
+        total_sst = sum(len(level) for level in self._levels)
+        return (
+            f"LSMTree('{self.name}', memtable={self._memtable.size}, "
+            f"sstables={total_sst}, compactions={self._total_compactions})"
+        )

@@ -1,0 +1,242 @@
+"""Optimistic transactions over a storage engine (MVCC-style validation).
+
+Parity target: ``happysimulator/components/storage/transaction_manager.py``
+(``StorageEngine`` protocol :37, ``IsolationLevel`` :51,
+``StorageTransaction`` :109 with buffered read/write sets,
+first-committer-wins conflict check :367, ``TransactionManager`` :249).
+
+READ_COMMITTED never aborts; SNAPSHOT_ISOLATION aborts on write-write
+conflicts with transactions committed after this one's snapshot;
+SERIALIZABLE additionally aborts on read-write and write-read overlap.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Generator, Optional, Protocol, runtime_checkable
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+logger = logging.getLogger(__name__)
+
+
+@runtime_checkable
+class StorageEngine(Protocol):
+    def get(self, key: str) -> Generator: ...
+    def put(self, key: str, value: Any) -> Generator: ...
+    def get_sync(self, key: str) -> Optional[Any]: ...
+    def put_sync(self, key: str, value: Any) -> None: ...
+
+
+class IsolationLevel(Enum):
+    READ_COMMITTED = "read_committed"
+    SNAPSHOT_ISOLATION = "snapshot_isolation"
+    SERIALIZABLE = "serializable"
+
+
+@dataclass(frozen=True)
+class TransactionStats:
+    transactions_started: int = 0
+    transactions_committed: int = 0
+    transactions_aborted: int = 0
+    conflicts_detected: int = 0
+    deadlocks_detected: int = 0
+    reads: int = 0
+    writes: int = 0
+    avg_transaction_duration_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class _CommitLogEntry:
+    tx_id: int
+    version: int
+    keys_written: frozenset[str]
+    keys_read: frozenset[str]
+
+
+class StorageTransaction:
+    """Buffers writes locally; commit validates against the commit log."""
+
+    def __init__(
+        self,
+        tx_id: int,
+        manager: "TransactionManager",
+        isolation: IsolationLevel,
+        snapshot_version: int,
+    ):
+        self._tx_id = tx_id
+        self._manager = manager
+        self._isolation = isolation
+        self._snapshot_version = snapshot_version
+        self._start_time_s = 0.0
+        self._read_set: set[str] = set()
+        self._write_set: dict[str, Any] = {}
+        self._committed = False
+        self._aborted = False
+
+    @property
+    def tx_id(self) -> int:
+        return self._tx_id
+
+    @property
+    def is_active(self) -> bool:
+        return not self._committed and not self._aborted
+
+    def read(self, key: str) -> Generator[float, None, Optional[Any]]:
+        """Own writes first, then the store."""
+        if not self.is_active:
+            raise RuntimeError(f"Transaction {self._tx_id} is not active")
+        self._read_set.add(key)
+        self._manager._total_reads += 1
+        if key in self._write_set:
+            return self._write_set[key]
+        value = yield from self._manager._store.get(key)
+        return value
+
+    def write(self, key: str, value: Any) -> Generator[float, None, None]:
+        """Buffered locally until commit."""
+        if not self.is_active:
+            raise RuntimeError(f"Transaction {self._tx_id} is not active")
+        self._write_set[key] = value
+        self._manager._total_writes += 1
+        yield 0.000001
+
+    def commit(self) -> Generator[float, None, bool]:
+        """Validate + apply; returns False if aborted on conflict."""
+        if not self.is_active:
+            raise RuntimeError(f"Transaction {self._tx_id} is not active")
+        if self._manager._check_conflict(self):
+            self._aborted = True
+            self._manager._total_conflicts += 1
+            self._manager._finish(self)
+            return False
+        for key, value in self._write_set.items():
+            self._manager._store.put_sync(key, value)
+        self._manager._version += 1
+        self._manager._commit_log.append(
+            _CommitLogEntry(
+                tx_id=self._tx_id,
+                version=self._manager._version,
+                keys_written=frozenset(self._write_set),
+                keys_read=frozenset(self._read_set),
+            )
+        )
+        self._committed = True
+        self._manager._finish(self)
+        yield 0.00001
+        return True
+
+    def abort(self) -> None:
+        if not self.is_active:
+            return
+        self._aborted = True
+        self._manager._finish(self)
+
+
+class TransactionManager(Entity):
+    """Hands out transactions over one StorageEngine (LSMTree, BTree, KV…)."""
+
+    def __init__(
+        self,
+        name: str,
+        store: StorageEngine,
+        isolation: IsolationLevel = IsolationLevel.SNAPSHOT_ISOLATION,
+    ):
+        super().__init__(name)
+        self._store = store
+        self._default_isolation = isolation
+        self._next_tx_id = 1
+        self._version = 0
+        self._commit_log: list[_CommitLogEntry] = []
+        self._active_txns: dict[int, StorageTransaction] = {}
+        self._total_started = 0
+        self._total_committed = 0
+        self._total_aborted = 0
+        self._total_conflicts = 0
+        self._total_reads = 0
+        self._total_writes = 0
+        self._total_duration_s = 0.0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> TransactionStats:
+        finished = self._total_committed + self._total_aborted
+        return TransactionStats(
+            transactions_started=self._total_started,
+            transactions_committed=self._total_committed,
+            transactions_aborted=self._total_aborted,
+            conflicts_detected=self._total_conflicts,
+            deadlocks_detected=0,
+            reads=self._total_reads,
+            writes=self._total_writes,
+            avg_transaction_duration_s=(
+                self._total_duration_s / finished if finished else 0.0
+            ),
+        )
+
+    @property
+    def active_transactions(self) -> int:
+        return len(self._active_txns)
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    # -- lifecycle ---------------------------------------------------------
+    def begin(
+        self, isolation: Optional[IsolationLevel] = None
+    ) -> Generator[float, None, StorageTransaction]:
+        tx = self.begin_sync(isolation)
+        yield 0.000001
+        return tx
+
+    def begin_sync(self, isolation: Optional[IsolationLevel] = None) -> StorageTransaction:
+        tx_id = self._next_tx_id
+        self._next_tx_id += 1
+        self._total_started += 1
+        tx = StorageTransaction(
+            tx_id=tx_id,
+            manager=self,
+            isolation=isolation or self._default_isolation,
+            snapshot_version=self._version,
+        )
+        if self._clock is not None:
+            tx._start_time_s = self.now.to_seconds()
+        self._active_txns[tx_id] = tx
+        return tx
+
+    def _finish(self, tx: StorageTransaction) -> None:
+        if tx._committed:
+            self._total_committed += 1
+        else:
+            self._total_aborted += 1
+        if self._clock is not None:
+            self._total_duration_s += self.now.to_seconds() - tx._start_time_s
+        self._active_txns.pop(tx._tx_id, None)
+
+    def _check_conflict(self, tx: StorageTransaction) -> bool:
+        if tx._isolation is IsolationLevel.READ_COMMITTED:
+            return False
+        for entry in self._commit_log:
+            if entry.version <= tx._snapshot_version or entry.tx_id == tx._tx_id:
+                continue
+            if tx._write_set.keys() & entry.keys_written:
+                return True  # write-write: both SI and SERIALIZABLE abort
+            if tx._isolation is IsolationLevel.SERIALIZABLE:
+                if tx._read_set & entry.keys_written:
+                    return True  # we read something they overwrote
+                if tx._write_set.keys() & entry.keys_read:
+                    return True  # they depended on something we overwrite
+        return False
+
+    def handle_event(self, event: Event) -> None:
+        return None
+
+    def __repr__(self) -> str:
+        return (
+            f"TransactionManager('{self.name}', active={len(self._active_txns)}, "
+            f"committed={self._total_committed}, aborted={self._total_aborted})"
+        )

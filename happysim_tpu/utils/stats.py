@@ -2,13 +2,18 @@
 
 from __future__ import annotations
 
+import math
 from typing import Sequence
 
 
 def percentile_nearest_rank(values: Sequence[float], q: float) -> float:
-    """Nearest-rank percentile (q in [0,1]); 0.0 for an empty sequence."""
+    """Nearest-rank percentile (q in [0,1]); 0.0 for an empty sequence.
+
+    Uses the standard nearest-rank definition ``ceil(n*q)``-th smallest
+    (a floor index would report one rank high — the max for small n).
+    """
     if not values:
         return 0.0
     ordered = sorted(values)
-    idx = min(int(len(ordered) * q), len(ordered) - 1)
-    return ordered[idx]
+    rank = math.ceil(len(ordered) * q)
+    return ordered[min(max(rank - 1, 0), len(ordered) - 1)]

@@ -421,3 +421,104 @@ class TestMessageQueueReviewRegressions:
         _run([mq, sink, puller], [(0.0, puller)], duration=10.0)
         # Never acked -> redelivered via the timer armed by poll().
         assert len(deliveries) >= 2
+
+
+class TestMessageQueueStateMachineRegressions:
+    def test_late_ack_after_requeue_withdraws_queued_copy(self):
+        """Visibility timeout requeues; a late ack must remove the queued
+        copy so the head can never wedge delivery for later messages."""
+        mq = MessageQueue("orders", redelivery_delay=1.0, max_redeliveries=5)
+        log = []
+
+        class SlowAcker(Entity):
+            def __init__(self):
+                super().__init__("slow")
+                self.first = True
+
+            def handle_event(self, event):
+                if event.event_type != "message_delivery":
+                    return None
+                mid = event.context["metadata"]["message_id"]
+                log.append(mid)
+                if self.first:
+                    self.first = False
+                    yield 1.5  # ack AFTER the 1.0s visibility timeout
+                    mq.acknowledge(mid)
+                else:
+                    mq.acknowledge(mid)
+
+        consumer = SlowAcker()
+        mq.subscribe(consumer)
+
+        class LateProducer(Producer):
+            pass
+
+        p1 = Producer("p1", mq, n=1)
+        p2 = LateProducer("p2", mq, n=1)
+        _run([mq, consumer, p1, p2], [(0.0, p1), (3.0, p2)], duration=30.0)
+        # The second message MUST get through (no wedged head).
+        assert any(m.endswith("-2") for m in log)
+        assert mq.pending_count == 0
+        assert mq.in_flight_count == 0
+
+    def test_schedule_redelivery_honors_delay_despite_kicks(self):
+        mq = MessageQueue("orders", redelivery_delay=2.0, auto_redelivery=False)
+        deliveries = []
+
+        class Recorder(Entity):
+            def handle_event(self, event):
+                if event.event_type == "message_delivery":
+                    deliveries.append(
+                        (event.context["metadata"]["message_id"],
+                         round(self.now.to_seconds(), 2))
+                    )
+                return None
+
+        consumer = Recorder("rec")
+        mq.subscribe(consumer)
+
+        class Script(Entity):
+            def handle_event(self, event):
+                out = list(mq.publish(Event(self.now, "m1", target=mq)))
+                yield 0.1
+                mid = deliveries[0][0]
+                timer = mq.schedule_redelivery(mid)
+                # Kick the cycle with another publish before the delay ends.
+                out2 = list(mq.publish(Event(self.now, "m2", target=mq)))
+                return [*out, *([timer] if timer else []), *out2]
+
+        script = Script("script")
+        _run([mq, consumer, script], [(0.0, script)], duration=30.0)
+        m1_times = [at for mid, at in deliveries if mid.endswith("-1")]
+        # m1 redelivered at ~2.1 (0.1 + 2.0 delay), not at the m2 kick (~0.1).
+        assert len(m1_times) == 2
+        assert m1_times[1] == pytest.approx(2.1, abs=0.05)
+
+    def test_double_reject_no_duplicate(self):
+        mq = MessageQueue("orders", max_redeliveries=5)
+        seen = []
+
+        class OneShot(Entity):
+            def __init__(self):
+                super().__init__("os")
+                self.count = 0
+
+            def handle_event(self, event):
+                if event.event_type != "message_delivery":
+                    return None
+                mid = event.context["metadata"]["message_id"]
+                seen.append(mid)
+                self.count += 1
+                if self.count == 1:
+                    mq.reject(mid)
+                    mq.reject(mid)  # double reject must be a no-op
+                    return None
+                mq.acknowledge(mid)
+                return None
+
+        consumer = OneShot()
+        mq.subscribe(consumer)
+        producer = Producer("p", mq, n=1)
+        _run([mq, consumer, producer], [(0.0, producer)], duration=30.0)
+        assert len(seen) == 2  # initial + exactly one redelivery
+        assert mq.stats.messages_rejected == 1
