@@ -22,6 +22,13 @@ import logging
 logging.getLogger("happysim_tpu").addHandler(logging.NullHandler())
 
 from happysim_tpu.components import (
+    BTree,
+    ConsumerGroup,
+    EventLog,
+    LSMTree,
+    StreamProcessor,
+    TransactionManager,
+    WriteAheadLog,
     CachedStore,
     Database,
     KVStore,

@@ -88,8 +88,10 @@ class LeveledCompaction(CompactionStrategy):
 
 
 class FIFOCompaction(CompactionStrategy):
-    """Time-series style: when total sstables exceed the cap, drop the
-    oldest (deepest) level."""
+    """Time-series style: when total sstables exceed the cap, DISCARD the
+    oldest (deepest) level outright — retention, not merging."""
+
+    discard_selected = True  # _apply_compaction drops instead of merging
 
     def __init__(self, max_total_sstables: int = 100):
         self.max_total_sstables = max_total_sstables
@@ -98,10 +100,15 @@ class FIFOCompaction(CompactionStrategy):
         return sum(len(level) for level in levels) > self.max_total_sstables
 
     def select_compaction(self, levels: list[list[SSTable]]) -> tuple[int, list[SSTable]]:
+        total = sum(len(level) for level in levels)
+        excess = total - self.max_total_sstables
+        if excess <= 0:
+            return 0, []
+        # Oldest first: deepest level, then lowest flush sequence.
+        candidates: list[SSTable] = []
         for i in range(len(levels) - 1, -1, -1):
-            if levels[i]:
-                return i, list(levels[i])
-        return 0, []
+            candidates.extend(sorted(levels[i], key=lambda s: s.sequence))
+        return 0, candidates[:excess]
 
 
 # --------------------------------------------------------------- stats ----
@@ -146,6 +153,7 @@ class LSMTree(Entity):
         self._max_levels = max_levels
         self._memtable = Memtable(f"{name}_memtable", size_threshold=memtable_size)
         self._immutable_memtables: list[Memtable] = []
+        self._next_flush_seq = 0
         self._levels: list[list[SSTable]] = [[] for _ in range(max_levels)]
         self._logical_data: dict[str, Any] = {}
         self._user_bytes_written = 0
@@ -328,27 +336,37 @@ class LSMTree(Entity):
         if self._memtable.size == 0:
             return
         old = self._rotate_memtable()
-        sstable = old.flush()
-        self._sstable_bytes_written += sstable.size_bytes
-        pages = max(1, sstable.key_count // 16)
+        # Everything being flushed is already in the WAL; capture the
+        # durable frontier NOW — writes that interleave during the flush
+        # yield append newer WAL entries that must survive the truncate.
+        flushed_up_to = self._wal._next_sequence - 1 if self._wal is not None else 0
+        pages = max(1, old.size // 16)
         yield pages * self._sstable_write_latency
+        # Freeze AFTER the I/O yield: concurrent reads during the flush
+        # window are served by the immutable memtable (old keeps its data
+        # until here).
+        sstable = old.flush(sequence=self._next_flush_seq)
+        self._next_flush_seq += 1
+        self._sstable_bytes_written += sstable.size_bytes
         self._levels[0].append(sstable)
         self._total_memtable_flushes += 1
         self._immutable_memtables.remove(old)
         if self._wal is not None:
-            self._wal.truncate(self._wal._next_sequence - 1)
+            self._wal.truncate(flushed_up_to)
         if self._compaction_strategy.should_compact(self._levels):
             yield from self._compact()
 
     def _flush_memtable_sync(self) -> None:
         if self._memtable.size == 0:
             return
-        sstable = self._memtable.flush()
+        flushed_up_to = self._wal._next_sequence - 1 if self._wal is not None else 0
+        sstable = self._memtable.flush(sequence=self._next_flush_seq)
+        self._next_flush_seq += 1
         self._sstable_bytes_written += sstable.size_bytes
         self._levels[0].append(sstable)
         self._total_memtable_flushes += 1
         if self._wal is not None:
-            self._wal.truncate(self._wal._next_sequence - 1)
+            self._wal.truncate(flushed_up_to)
         if self._compaction_strategy.should_compact(self._levels):
             self._apply_compaction()
 
@@ -373,6 +391,19 @@ class LSMTree(Entity):
         SSTable (None if the selection was empty/all-tombstone)."""
         source_level, sstables = self._compaction_strategy.select_compaction(self._levels)
         if not sstables:
+            return None
+        if getattr(self._compaction_strategy, "discard_selected", False):
+            # Retention-style compaction (FIFO): old data is dropped, not
+            # merged — reclaims space like TTL'd time-series storage. The
+            # selection may span levels; remove each from wherever it lives.
+            for sst in sstables:
+                for level in self._levels:
+                    if sst in level:
+                        level.remove(sst)
+                        break
+                for key, _ in sst.scan():
+                    self._logical_data.pop(key, None)
+            self._total_compactions += 1
             return None
         target_level = min(source_level + 1, self._max_levels - 1)
         merged: dict[str, Any] = {}

@@ -216,6 +216,16 @@ class TransactionManager(Entity):
         if self._clock is not None:
             self._total_duration_s += self.now.to_seconds() - tx._start_time_s
         self._active_txns.pop(tx._tx_id, None)
+        # Prune commit-log entries no active transaction can conflict with
+        # (version ≤ every active snapshot) — keeps validation O(recent),
+        # not O(all transactions ever).
+        min_snapshot = (
+            min(t._snapshot_version for t in self._active_txns.values())
+            if self._active_txns
+            else self._version
+        )
+        if self._commit_log and self._commit_log[0].version <= min_snapshot:
+            self._commit_log = [e for e in self._commit_log if e.version > min_snapshot]
 
     def _check_conflict(self, tx: StorageTransaction) -> bool:
         if tx._isolation is IsolationLevel.READ_COMMITTED:

@@ -1,0 +1,56 @@
+"""Streaming components — partitioned log, consumer groups, windowing.
+
+Parity target: ``happysimulator/components/streaming/`` (SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.streaming.consumer_group import (
+    ConsumerGroup,
+    ConsumerGroupStats,
+    PartitionAssignment,
+    RangeAssignment,
+    RoundRobinAssignment,
+    StickyAssignment,
+)
+from happysim_tpu.components.streaming.event_log import (
+    EventLog,
+    EventLogStats,
+    Partition,
+    Record,
+    RetentionPolicy,
+    SizeRetention,
+    TimeRetention,
+)
+from happysim_tpu.components.streaming.stream_processor import (
+    LateEventPolicy,
+    SessionWindow,
+    SlidingWindow,
+    StreamProcessor,
+    StreamProcessorStats,
+    TumblingWindow,
+    WindowState,
+    WindowType,
+)
+
+__all__ = [
+    "ConsumerGroup",
+    "ConsumerGroupStats",
+    "EventLog",
+    "EventLogStats",
+    "LateEventPolicy",
+    "Partition",
+    "PartitionAssignment",
+    "RangeAssignment",
+    "Record",
+    "RetentionPolicy",
+    "RoundRobinAssignment",
+    "SessionWindow",
+    "SizeRetention",
+    "SlidingWindow",
+    "StickyAssignment",
+    "StreamProcessor",
+    "StreamProcessorStats",
+    "TimeRetention",
+    "TumblingWindow",
+    "WindowState",
+    "WindowType",
+]

@@ -1,0 +1,279 @@
+"""Consumer group over an EventLog: assignment, offsets, rebalancing.
+
+Parity target: ``happysimulator/components/streaming/consumer_group.py:185``
+(``RangeAssignment`` :65, ``RoundRobinAssignment`` :94, ``StickyAssignment``
+:115, ``join``/``leave``/``poll``/``commit`` generators :313-417, lag :273,
+``ConsumerGroupStats`` :165).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional, Protocol
+
+from happysim_tpu.components.streaming.event_log import EventLog, Record
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+class PartitionAssignment(Protocol):
+    def assign(self, partitions: list[int], consumers: list[str]) -> dict[str, list[int]]: ...
+
+
+class RangeAssignment:
+    """Contiguous partition ranges per consumer (Kafka default)."""
+
+    def assign(self, partitions: list[int], consumers: list[str]) -> dict[str, list[int]]:
+        if not consumers:
+            return {}
+        result: dict[str, list[int]] = {c: [] for c in consumers}
+        n, k = len(partitions), len(consumers)
+        per, extra = divmod(n, k)
+        start = 0
+        for i, consumer in enumerate(consumers):
+            count = per + (1 if i < extra else 0)
+            result[consumer] = partitions[start : start + count]
+            start += count
+        return result
+
+
+class RoundRobinAssignment:
+    """Deal partitions one at a time across consumers."""
+
+    def assign(self, partitions: list[int], consumers: list[str]) -> dict[str, list[int]]:
+        if not consumers:
+            return {}
+        result: dict[str, list[int]] = {c: [] for c in consumers}
+        for i, pid in enumerate(partitions):
+            result[consumers[i % len(consumers)]].append(pid)
+        return result
+
+
+class StickyAssignment:
+    """Keep prior owners where possible; deal only orphans/overflow.
+
+    Minimizes partition movement across rebalances (consumer state like
+    caches survives).
+    """
+
+    def __init__(self):
+        self._previous: dict[str, list[int]] = {}
+
+    def assign(self, partitions: list[int], consumers: list[str]) -> dict[str, list[int]]:
+        if not consumers:
+            self._previous = {}
+            return {}
+        target = -(-len(partitions) // len(consumers))  # ceil(n/k): balanced cap
+        result: dict[str, list[int]] = {c: [] for c in consumers}
+        unassigned = set(partitions)
+        # Phase 1: surviving consumers keep prior partitions (capped).
+        for consumer in consumers:
+            for pid in self._previous.get(consumer, []):
+                if pid in unassigned and len(result[consumer]) < target:
+                    result[consumer].append(pid)
+                    unassigned.discard(pid)
+        # Phase 2: deal the rest to the least-loaded consumers.
+        for pid in sorted(unassigned):
+            least = min(consumers, key=lambda c: len(result[c]))
+            result[least].append(pid)
+        self._previous = {c: list(p) for c, p in result.items()}
+        return result
+
+
+class ConsumerState(Enum):
+    ACTIVE = "active"
+    LEFT = "left"
+
+
+@dataclass(frozen=True)
+class ConsumerGroupStats:
+    joins: int = 0
+    leaves: int = 0
+    rebalances: int = 0
+    polls: int = 0
+    commits: int = 0
+    records_polled: int = 0
+
+
+class ConsumerGroup(Entity):
+    """Tracks membership + per-consumer committed offsets; rebalances on
+    join/leave with a modeled delay."""
+
+    def __init__(
+        self,
+        name: str,
+        event_log: EventLog,
+        assignment_strategy: Optional[PartitionAssignment] = None,
+        rebalance_delay: float = 0.5,
+        poll_latency: float = 0.001,
+    ):
+        super().__init__(name)
+        self._event_log = event_log
+        self._strategy = assignment_strategy or RangeAssignment()
+        self._rebalance_delay = rebalance_delay
+        self._poll_latency = poll_latency
+        self._consumers: dict[str, Entity] = {}
+        self._assignments: dict[str, list[int]] = {}
+        self._committed_offsets: dict[str, dict[int, int]] = {}
+        self._generation = 0
+        self._joins = 0
+        self._leaves = 0
+        self._rebalances = 0
+        self._polls = 0
+        self._commits = 0
+        self._records_polled = 0
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self._event_log, *self._consumers.values()]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> ConsumerGroupStats:
+        return ConsumerGroupStats(
+            joins=self._joins,
+            leaves=self._leaves,
+            rebalances=self._rebalances,
+            polls=self._polls,
+            commits=self._commits,
+            records_polled=self._records_polled,
+        )
+
+    @property
+    def consumer_count(self) -> int:
+        return len(self._consumers)
+
+    @property
+    def consumers(self) -> list[str]:
+        return sorted(self._consumers)
+
+    @property
+    def assignments(self) -> dict[str, list[int]]:
+        return {k: list(v) for k, v in self._assignments.items()}
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def consumer_lag(self, consumer_name: str) -> dict[int, int]:
+        """Per-partition lag = high watermark − committed offset."""
+        if consumer_name not in self._assignments:
+            return {}
+        offsets = self._committed_offsets.get(consumer_name, {})
+        return {
+            pid: self._event_log.high_watermark(pid) - offsets.get(pid, 0)
+            for pid in self._assignments[consumer_name]
+        }
+
+    def total_lag(self) -> int:
+        return sum(sum(self.consumer_lag(name).values()) for name in self._consumers)
+
+    # -- yield-from API ----------------------------------------------------
+    def join(self, consumer_name: str, consumer_entity: Entity):
+        """Join the group; returns assigned partition ids after rebalance."""
+        reply: SimFuture = SimFuture()
+        event = Event(
+            self.now,
+            "Join",
+            target=self,
+            context={
+                "metadata": {"consumer_name": consumer_name},
+                "consumer_entity": consumer_entity,
+                "reply_future": reply,
+            },
+        )
+        assigned = yield reply, [event]
+        return assigned
+
+    def leave(self, consumer_name: str):
+        reply: SimFuture = SimFuture()
+        event = Event(
+            self.now,
+            "Leave",
+            target=self,
+            context={"metadata": {"consumer_name": consumer_name}, "reply_future": reply},
+        )
+        yield reply, [event]
+
+    def poll(self, consumer_name: str, max_records: int = 100):
+        """Fetch records past committed offsets from assigned partitions."""
+        reply: SimFuture = SimFuture()
+        event = Event(
+            self.now,
+            "Poll",
+            target=self,
+            context={
+                "metadata": {"consumer_name": consumer_name, "max_records": max_records},
+                "reply_future": reply,
+            },
+        )
+        records = yield reply, [event]
+        return records
+
+    def commit(self, consumer_name: str, offsets: dict[int, int]):
+        event = Event(
+            self.now,
+            "Commit",
+            target=self,
+            context={"metadata": {"consumer_name": consumer_name, "offsets": offsets}},
+        )
+        yield 0.0, [event]
+
+    # -- internals ---------------------------------------------------------
+    def _rebalance(self) -> None:
+        self._generation += 1
+        self._assignments = self._strategy.assign(
+            list(range(self._event_log.num_partitions)), sorted(self._consumers)
+        )
+        self._rebalances += 1
+
+    def handle_event(self, event: Event):
+        event_type = event.event_type
+        meta = event.context.get("metadata", {})
+        if event_type == "Join":
+            name = meta["consumer_name"]
+            self._consumers[name] = event.context["consumer_entity"]
+            self._committed_offsets.setdefault(name, {})
+            self._joins += 1
+            yield self._rebalance_delay
+            self._rebalance()
+            reply: Optional[SimFuture] = event.context.get("reply_future")
+            if reply is not None:
+                reply.resolve(self._assignments.get(name, []))
+            return None
+        if event_type == "Leave":
+            name = meta["consumer_name"]
+            self._consumers.pop(name, None)
+            self._assignments.pop(name, None)
+            # Committed offsets survive for a potential rejoin.
+            self._leaves += 1
+            yield self._rebalance_delay
+            self._rebalance()
+            reply = event.context.get("reply_future")
+            if reply is not None:
+                reply.resolve(None)
+            return None
+        if event_type == "Poll":
+            name = meta["consumer_name"]
+            max_records = meta["max_records"]
+            yield self._poll_latency
+            offsets = self._committed_offsets.get(name, {})
+            records: list[Record] = []
+            for pid in self._assignments.get(name, []):
+                remaining = max_records - len(records)
+                if remaining <= 0:
+                    break
+                records.extend(self._event_log._do_read(pid, offsets.get(pid, 0), remaining))
+            self._polls += 1
+            self._records_polled += len(records)
+            reply = event.context.get("reply_future")
+            if reply is not None:
+                reply.resolve(records)
+            return None
+        if event_type == "Commit":
+            name = meta["consumer_name"]
+            self._committed_offsets.setdefault(name, {}).update(meta["offsets"])
+            self._commits += 1
+            return None
+        return None

@@ -1,0 +1,267 @@
+"""Partitioned append-only event log (Kafka-style).
+
+Parity target: ``happysimulator/components/streaming/event_log.py:162``
+(``Record``/``Partition`` :58-90, ``TimeRetention``/``SizeRetention``
+:92-134, ``append``/``read`` generators :266-327, retention sweep :365,
+``EventLogStats`` :138).
+
+Keys route to partitions via a sharding strategy (default HashSharding,
+shared with the datastore tier); each partition holds ordered records with
+a monotone high watermark. Retention runs as a periodic daemon sweep.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Protocol
+
+from happysim_tpu.components.datastore.sharded_store import HashSharding
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+
+@dataclass(frozen=True)
+class Record:
+    offset: int
+    key: str
+    value: Any
+    timestamp: float
+    partition: int
+
+
+@dataclass
+class Partition:
+    id: int
+    records: list[Record] = field(default_factory=list)
+    high_watermark: int = 0
+
+
+class RetentionPolicy(Protocol):
+    def should_retain(self, record: Record, current_time_s: float) -> bool: ...
+
+
+class TimeRetention:
+    """Expire records older than ``max_age_s``."""
+
+    def __init__(self, max_age_s: float):
+        if max_age_s <= 0:
+            raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
+        self._max_age_s = max_age_s
+
+    @property
+    def max_age_s(self) -> float:
+        return self._max_age_s
+
+    def should_retain(self, record: Record, current_time_s: float) -> bool:
+        return current_time_s - record.timestamp <= self._max_age_s
+
+
+class SizeRetention:
+    """Keep at most ``max_records`` per partition (oldest dropped)."""
+
+    def __init__(self, max_records: int):
+        if max_records < 1:
+            raise ValueError(f"max_records must be >= 1, got {max_records}")
+        self._max_records = max_records
+
+    @property
+    def max_records(self) -> int:
+        return self._max_records
+
+    def should_retain(self, record: Record, current_time_s: float) -> bool:
+        return True  # enforced per-partition by count, not per-record
+
+
+@dataclass(frozen=True)
+class EventLogStats:
+    records_appended: int = 0
+    records_read: int = 0
+    records_expired: int = 0
+    per_partition_appends: dict = None  # type: ignore[assignment]
+    append_latency: float = 0.0  # configured constant (no per-append list)
+
+    @property
+    def avg_append_latency(self) -> float:
+        return self.append_latency if self.records_appended else 0.0
+
+
+class EventLog(Entity):
+    """Produce with ``yield from log.append(k, v)``; consume via
+    ``read(partition, offset)`` or a :class:`ConsumerGroup`."""
+
+    def __init__(
+        self,
+        name: str,
+        num_partitions: int = 4,
+        sharding_strategy: Any = None,
+        retention_policy: Optional[RetentionPolicy] = None,
+        append_latency: float = 0.001,
+        read_latency: float = 0.0005,
+        retention_check_interval: float = 60.0,
+    ):
+        if num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        super().__init__(name)
+        self._num_partitions = num_partitions
+        self._sharding = sharding_strategy or HashSharding()
+        self._retention_policy = retention_policy
+        self._append_latency = append_latency
+        self._read_latency = read_latency
+        self._retention_check_interval = retention_check_interval
+        self._partitions = [Partition(id=i) for i in range(num_partitions)]
+        self._retention_scheduled = False
+        self._records_appended = 0
+        self._records_read = 0
+        self._records_expired = 0
+        self._per_partition_appends = dict.fromkeys(range(num_partitions), 0)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> EventLogStats:
+        return EventLogStats(
+            records_appended=self._records_appended,
+            records_read=self._records_read,
+            records_expired=self._records_expired,
+            per_partition_appends=dict(self._per_partition_appends),
+            append_latency=self._append_latency,
+        )
+
+    @property
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    @property
+    def partitions(self) -> list[Partition]:
+        return list(self._partitions)
+
+    @property
+    def total_records(self) -> int:
+        return sum(len(p.records) for p in self._partitions)
+
+    def high_watermark(self, partition_id: int) -> int:
+        return self._partitions[partition_id].high_watermark
+
+    def high_watermarks(self) -> dict[int, int]:
+        return {p.id: p.high_watermark for p in self._partitions}
+
+    def _get_partition_for_key(self, key: str) -> int:
+        return self._sharding.get_shard(key, self._num_partitions)
+
+    # -- yield-from API ----------------------------------------------------
+    def append(self, key: str, value: Any):
+        """Generator: append through the log's own event queue (so
+        concurrent producers serialize at the log), returns the Record."""
+        reply: SimFuture = SimFuture()
+        event = Event(
+            self.now,
+            "Append",
+            target=self,
+            context={"metadata": {"key": key, "value": value}, "reply_future": reply},
+        )
+        record = yield reply, [event]
+        return record
+
+    def read(self, partition_id: int, offset: int = 0, max_records: int = 100):
+        """Generator: read records from one partition starting at offset."""
+        reply: SimFuture = SimFuture()
+        event = Event(
+            self.now,
+            "Read",
+            target=self,
+            context={
+                "metadata": {
+                    "partition": partition_id,
+                    "offset": offset,
+                    "max_records": max_records,
+                },
+                "reply_future": reply,
+            },
+        )
+        records = yield reply, [event]
+        return records
+
+    # -- internals ---------------------------------------------------------
+    def _do_append(self, key: str, value: Any) -> Record:
+        pid = self._get_partition_for_key(key)
+        partition = self._partitions[pid]
+        record = Record(
+            offset=partition.high_watermark,
+            key=key,
+            value=value,
+            timestamp=self.now.to_seconds(),
+            partition=pid,
+        )
+        partition.records.append(record)
+        partition.high_watermark += 1
+        self._records_appended += 1
+        self._per_partition_appends[pid] += 1
+        return record
+
+    def _do_read(self, partition_id: int, offset: int, max_records: int) -> list[Record]:
+        if not 0 <= partition_id < self._num_partitions:
+            return []
+        partition = self._partitions[partition_id]
+        result = [r for r in partition.records if r.offset >= offset][:max_records]
+        self._records_read += len(result)
+        return result
+
+    def _apply_retention(self) -> int:
+        if self._retention_policy is None:
+            return 0
+        now_s = self.now.to_seconds()
+        expired = 0
+        if isinstance(self._retention_policy, SizeRetention):
+            for partition in self._partitions:
+                excess = len(partition.records) - self._retention_policy.max_records
+                if excess > 0:
+                    partition.records = partition.records[excess:]
+                    expired += excess
+        else:
+            for partition in self._partitions:
+                before = len(partition.records)
+                partition.records = [
+                    r
+                    for r in partition.records
+                    if self._retention_policy.should_retain(r, now_s)
+                ]
+                expired += before - len(partition.records)
+        self._records_expired += expired
+        return expired
+
+    def _retention_tick(self) -> Event:
+        # Daemon: a retention sweep alone must not hold the sim open.
+        return Event(
+            self.now + self._retention_check_interval,
+            "RetentionCheck",
+            target=self,
+            daemon=True,
+        )
+
+    def handle_event(self, event: Event):
+        event_type = event.event_type
+        if event_type == "Append":
+            meta = event.context["metadata"]
+            reply: Optional[SimFuture] = event.context.get("reply_future")
+            yield self._append_latency
+            record = self._do_append(meta["key"], meta["value"])
+            if reply is not None:
+                reply.resolve(record)
+            if not self._retention_scheduled and self._retention_policy is not None:
+                self._retention_scheduled = True
+                return [self._retention_tick()]
+            return None
+        if event_type == "Read":
+            meta = event.context["metadata"]
+            reply = event.context.get("reply_future")
+            yield self._read_latency
+            records = self._do_read(
+                meta["partition"], meta["offset"], meta["max_records"]
+            )
+            if reply is not None:
+                reply.resolve(records)
+            return None
+        if event_type == "RetentionCheck":
+            self._apply_retention()
+            return [self._retention_tick()]
+        return None

@@ -1,0 +1,372 @@
+"""Windowed stream processing with watermarks and late-event policies.
+
+Parity target: ``happysimulator/components/streaming/stream_processor.py:212``
+(``TumblingWindow`` :72, ``SlidingWindow`` :98, ``SessionWindow`` :140 with
+gap-merge :308-366, ``LateEventPolicy`` :166, watermark loop + window
+emission :371-540).
+
+Events carry an event-time; windows close when the watermark passes their
+end (+allowed lateness). Late events are dropped, update-and-re-emit, or
+diverted to a side output. The watermark tick is a daemon here (the
+reference's non-daemon tick holds every simulation open to end_time).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Optional, Protocol
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+class WindowType(Protocol):
+    def assign_windows(self, event_time_s: float) -> list[tuple[float, float]]: ...
+
+    def should_close(self, window_end: float, watermark_s: float) -> bool: ...
+
+
+class TumblingWindow:
+    """Fixed, non-overlapping windows of ``size_s``."""
+
+    def __init__(self, size_s: float):
+        if size_s <= 0:
+            raise ValueError(f"size_s must be > 0, got {size_s}")
+        self._size_s = size_s
+
+    @property
+    def size_s(self) -> float:
+        return self._size_s
+
+    def assign_windows(self, event_time_s: float) -> list[tuple[float, float]]:
+        start = (event_time_s // self._size_s) * self._size_s
+        return [(start, start + self._size_s)]
+
+    def should_close(self, window_end: float, watermark_s: float) -> bool:
+        return watermark_s >= window_end
+
+
+class SlidingWindow:
+    """Overlapping windows of ``size_s`` sliding every ``slide_s``."""
+
+    def __init__(self, size_s: float, slide_s: float):
+        if size_s <= 0 or slide_s <= 0:
+            raise ValueError("size_s and slide_s must be > 0")
+        if slide_s > size_s:
+            raise ValueError("slide_s must be <= size_s")
+        self._size_s = size_s
+        self._slide_s = slide_s
+
+    @property
+    def size_s(self) -> float:
+        return self._size_s
+
+    @property
+    def slide_s(self) -> float:
+        return self._slide_s
+
+    def assign_windows(self, event_time_s: float) -> list[tuple[float, float]]:
+        windows = []
+        # The latest window starting at or before the event.
+        last_start = (event_time_s // self._slide_s) * self._slide_s
+        start = last_start
+        while start > event_time_s - self._size_s:
+            windows.append((start, start + self._size_s))
+            start -= self._slide_s
+        return sorted(windows)
+
+    def should_close(self, window_end: float, watermark_s: float) -> bool:
+        return watermark_s >= window_end
+
+
+class SessionWindow:
+    """Activity sessions separated by ≥ ``gap_s`` of silence (merge-based;
+    handled specially by the processor)."""
+
+    def __init__(self, gap_s: float):
+        if gap_s <= 0:
+            raise ValueError(f"gap_s must be > 0, got {gap_s}")
+        self._gap_s = gap_s
+
+    @property
+    def gap_s(self) -> float:
+        return self._gap_s
+
+    def assign_windows(self, event_time_s: float) -> list[tuple[float, float]]:
+        return [(event_time_s, event_time_s + self._gap_s)]
+
+    def should_close(self, window_end: float, watermark_s: float) -> bool:
+        return watermark_s >= window_end
+
+
+class LateEventPolicy(Enum):
+    DROP = "drop"
+    UPDATE = "update"
+    SIDE_OUTPUT = "side_output"
+
+
+@dataclass
+class WindowState:
+    start: float
+    end: float
+    records: list[Any] = field(default_factory=list)
+    emitted: bool = False
+
+
+@dataclass(frozen=True)
+class StreamProcessorStats:
+    events_processed: int = 0
+    windows_emitted: int = 0
+    late_events: int = 0
+    late_events_dropped: int = 0
+    late_events_updated: int = 0
+    late_events_side_output: int = 0
+
+
+class StreamProcessor(Entity):
+    """Send ``Process`` events with context metadata ``key``/``value``/
+    ``event_time_s``; aggregated ``WindowResult`` events go downstream."""
+
+    def __init__(
+        self,
+        name: str,
+        window_type: WindowType,
+        aggregate_fn: Callable[[list[Any]], Any],
+        downstream: Entity,
+        allowed_lateness_s: float = 0.0,
+        late_event_policy: LateEventPolicy = LateEventPolicy.DROP,
+        side_output: Optional[Entity] = None,
+        watermark_interval_s: float = 1.0,
+    ):
+        super().__init__(name)
+        self._window_type = window_type
+        self._aggregate_fn = aggregate_fn
+        self._downstream = downstream
+        self._allowed_lateness_s = allowed_lateness_s
+        self._late_event_policy = late_event_policy
+        self._side_output = side_output
+        self._watermark_interval_s = watermark_interval_s
+        self._windows: dict[str, list[WindowState]] = {}
+        self._watermark_s = 0.0
+        self._watermark_scheduled = False
+        self._pending_tick: Optional[Event] = None
+        self._events_processed = 0
+        self._windows_emitted = 0
+        self._late_events = 0
+        self._late_events_dropped = 0
+        self._late_events_updated = 0
+        self._late_events_side_output = 0
+
+    def downstream_entities(self) -> list[Entity]:
+        result: list[Entity] = [self._downstream]
+        if self._side_output is not None:
+            result.append(self._side_output)
+        return result
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> StreamProcessorStats:
+        return StreamProcessorStats(
+            events_processed=self._events_processed,
+            windows_emitted=self._windows_emitted,
+            late_events=self._late_events,
+            late_events_dropped=self._late_events_dropped,
+            late_events_updated=self._late_events_updated,
+            late_events_side_output=self._late_events_side_output,
+        )
+
+    @property
+    def watermark_s(self) -> float:
+        return self._watermark_s
+
+    @property
+    def active_windows(self) -> int:
+        return sum(
+            sum(1 for w in windows if not w.emitted) for windows in self._windows.values()
+        )
+
+    @property
+    def total_windows_emitted(self) -> int:
+        return self._windows_emitted
+
+    # -- session windows ---------------------------------------------------
+    def _add_to_session_window(self, key: str, event_time_s: float, value: Any) -> None:
+        gap = self._window_type.gap_s  # type: ignore[union-attr]
+        windows = self._windows.setdefault(key, [])
+        for w in windows:
+            if not w.emitted and w.start - gap <= event_time_s <= w.end:
+                w.records.append(value)
+                w.end = max(w.end, event_time_s + gap)
+                w.start = min(w.start, event_time_s)
+                break
+        else:
+            windows.append(
+                WindowState(start=event_time_s, end=event_time_s + gap, records=[value])
+            )
+        self._merge_sessions(key)
+
+    def _merge_sessions(self, key: str) -> None:
+        windows = self._windows[key]
+        active = sorted((w for w in windows if not w.emitted), key=lambda w: w.start)
+        if len(active) <= 1:
+            return
+        merged = [active[0]]
+        for w in active[1:]:
+            last = merged[-1]
+            if w.start <= last.end:
+                last.end = max(last.end, w.end)
+                last.records.extend(w.records)
+            else:
+                merged.append(w)
+        self._windows[key] = [w for w in windows if w.emitted] + merged
+
+    # -- core --------------------------------------------------------------
+    def _is_late(self, event_time_s: float) -> bool:
+        return event_time_s < self._watermark_s - self._allowed_lateness_s
+
+    def _assign(self, key: str, event_time_s: float, value: Any) -> None:
+        if isinstance(self._window_type, SessionWindow):
+            self._add_to_session_window(key, event_time_s, value)
+            return
+        windows = self._windows.setdefault(key, [])
+        for w_start, w_end in self._window_type.assign_windows(event_time_s):
+            for w in windows:
+                if w.start == w_start and w.end == w_end:
+                    if not w.emitted:
+                        w.records.append(value)
+                        break
+                    if self._late_event_policy is LateEventPolicy.UPDATE:
+                        # Re-open the emitted window for re-emission.
+                        w.records.append(value)
+                        w.emitted = False
+                        break
+            else:
+                windows.append(WindowState(start=w_start, end=w_end, records=[value]))
+
+    def _emit_closed_windows(self) -> list[Event]:
+        events = []
+        for key, windows in self._windows.items():
+            for window in windows:
+                # Allowed lateness delays closure (Flink-style): the window
+                # stays open to absorb in-lateness stragglers, so each span
+                # emits once instead of once-plus-a-duplicate.
+                if window.emitted or not self._window_type.should_close(
+                    window.end + self._allowed_lateness_s, self._watermark_s
+                ):
+                    continue
+                window.emitted = True
+                self._windows_emitted += 1
+                events.append(
+                    Event(
+                        self.now,
+                        "WindowResult",
+                        target=self._downstream,
+                        context={
+                            "metadata": {
+                                "key": key,
+                                "window_start": window.start,
+                                "window_end": window.end,
+                                "result": self._aggregate_fn(window.records),
+                                "record_count": len(window.records),
+                            }
+                        },
+                    )
+                )
+        # Purge emitted windows past the lateness horizon: for DROP and
+        # SIDE_OUTPUT they're unreachable (older events are late), so
+        # keeping them would leak memory and make per-event scans O(all
+        # windows ever). UPDATE keeps them — arbitrarily-late re-emission
+        # is that policy's contract.
+        if self._late_event_policy is not LateEventPolicy.UPDATE:
+            horizon = self._watermark_s - self._allowed_lateness_s
+            for key in list(self._windows):
+                kept = [
+                    w for w in self._windows[key] if not (w.emitted and w.end <= horizon)
+                ]
+                if kept:
+                    self._windows[key] = kept
+                else:
+                    del self._windows[key]
+        return events
+
+    def _watermark_tick(self) -> Event:
+        # Unemitted windows are real pending work: the tick holds the sim
+        # open until they close. Once drained it degrades to a daemon so
+        # an idle processor never prevents auto-termination. (The
+        # reference's always-non-daemon tick pins every sim to end_time.)
+        tick = Event(
+            self.now + self._watermark_interval_s,
+            "Watermark",
+            target=self,
+            daemon=self.active_windows == 0,
+            context={"metadata": {"watermark_s": None}},
+        )
+        self._pending_tick = tick
+        return tick
+
+    def handle_event(self, event: Event):
+        event_type = event.event_type
+        if event_type == "Process":
+            meta = event.context.get("metadata", event.context)
+            key = meta.get("key", "default")
+            value = meta.get("value")
+            event_time_s = meta.get("event_time_s")
+            if event_time_s is None:
+                event_time = meta.get("event_time")
+                if event_time is not None:
+                    event_time_s = (
+                        event_time.to_seconds()
+                        if hasattr(event_time, "to_seconds")
+                        else float(event_time)
+                    )
+                else:
+                    event_time_s = self.now.to_seconds()
+            self._events_processed += 1
+            if self._is_late(event_time_s):
+                self._late_events += 1
+                if self._late_event_policy is LateEventPolicy.DROP:
+                    self._late_events_dropped += 1
+                    return None
+                if self._late_event_policy is LateEventPolicy.SIDE_OUTPUT:
+                    self._late_events_side_output += 1
+                    if self._side_output is None:
+                        return None
+                    return [
+                        Event(
+                            self.now,
+                            "LateEvent",
+                            target=self._side_output,
+                            context={
+                                "metadata": {
+                                    "key": key,
+                                    "value": value,
+                                    "event_time_s": event_time_s,
+                                }
+                            },
+                        )
+                    ]
+                self._late_events_updated += 1  # UPDATE: fall through
+            self._assign(key, event_time_s, value)
+            if not self._watermark_scheduled:
+                self._watermark_scheduled = True
+                return [self._watermark_tick()]
+            if (
+                self._pending_tick is not None
+                and self._pending_tick.daemon
+                and self.active_windows > 0
+            ):
+                # The in-flight tick was scheduled while idle (daemon) and
+                # would let the sim terminate before this new window closes
+                # — replace it with a work-holding tick.
+                self._pending_tick.cancel()
+                return [self._watermark_tick()]
+            return None
+        if event_type == "Watermark":
+            # Watermark follows processing (arrival) time: by now+interval,
+            # anything with an older event-time is late.
+            self._watermark_s = max(self._watermark_s, self.now.to_seconds())
+            produced = self._emit_closed_windows()
+            produced.append(self._watermark_tick())
+            return produced
+        return None

@@ -1,0 +1,339 @@
+"""Unit tests: streaming (EventLog, ConsumerGroup, StreamProcessor)."""
+
+import pytest
+
+from happysim_tpu import Entity, Event, Instant, Simulation
+from happysim_tpu.components.streaming import (
+    ConsumerGroup,
+    EventLog,
+    LateEventPolicy,
+    RangeAssignment,
+    RoundRobinAssignment,
+    SessionWindow,
+    SizeRetention,
+    SlidingWindow,
+    StickyAssignment,
+    StreamProcessor,
+    TimeRetention,
+    TumblingWindow,
+)
+
+
+def t(seconds):
+    return Instant.from_seconds(seconds)
+
+
+class Driver(Entity):
+    def __init__(self, name, script):
+        super().__init__(name)
+        self.script = script
+        self.results = []
+
+    def handle_event(self, event):
+        result = yield from self.script(self)
+        self.results.append(result)
+
+
+def run_script(script, entities, duration=600.0, at=0.0):
+    driver = Driver("driver", script)
+    sim = Simulation(entities=[driver, *entities], duration=duration)
+    sim.schedule([Event(t(at), "go", target=driver)])
+    sim.run()
+    return driver
+
+
+# ---------------------------------------------------------------- EventLog ----
+class TestEventLog:
+    def test_append_read_roundtrip(self):
+        log = EventLog("log", num_partitions=2)
+
+        def script(self):
+            records = []
+            for i in range(6):
+                rec = yield from log.append(f"key{i}", f"v{i}")
+                records.append(rec)
+            read_back = []
+            for pid in range(2):
+                recs = yield from log.read(pid, offset=0)
+                read_back.extend(recs)
+            return (len(records), len(read_back))
+
+        driver = run_script(script, [log])
+        assert driver.results == [(6, 6)]
+        assert log.total_records == 6
+        assert sum(log.high_watermarks().values()) == 6
+        # Offsets are per-partition monotone from 0.
+        for p in log.partitions:
+            assert [r.offset for r in p.records] == list(range(len(p.records)))
+
+    def test_same_key_same_partition(self):
+        log = EventLog("log", num_partitions=4)
+
+        def script(self):
+            partitions = set()
+            for _ in range(5):
+                rec = yield from log.append("stable-key", "v")
+                partitions.add(rec.partition)
+            return partitions
+
+        driver = run_script(script, [log])
+        assert len(driver.results[0]) == 1  # same key -> same partition
+
+    def test_size_retention(self):
+        log = EventLog("log", num_partitions=1,
+                       retention_policy=SizeRetention(max_records=3),
+                       retention_check_interval=1.0)
+
+        def script(self):
+            for i in range(10):
+                yield from log.append("k", i)
+            yield 2.0  # let the retention daemon sweep
+            return log.total_records
+
+        driver = run_script(script, [log], duration=30.0)
+        assert driver.results[0] <= 3
+        assert log.stats.records_expired >= 7
+
+    def test_time_retention(self):
+        log = EventLog("log", num_partitions=1,
+                       retention_policy=TimeRetention(max_age_s=1.0),
+                       retention_check_interval=0.5)
+
+        def script(self):
+            yield from log.append("k", "old")
+            yield 5.0
+            yield from log.append("k", "new")
+            yield 0.6  # sweep happens
+            return [r.value for p in log.partitions for r in p.records]
+
+        driver = run_script(script, [log], duration=30.0)
+        assert driver.results[0] == ["new"]
+
+    def test_read_from_offset(self):
+        log = EventLog("log", num_partitions=1)
+
+        def script(self):
+            for i in range(5):
+                yield from log.append("k", i)
+            recs = yield from log.read(0, offset=3)
+            return [r.value for r in recs]
+
+        driver = run_script(script, [log])
+        assert driver.results == [[3, 4]]
+
+
+# ----------------------------------------------------------- assignments ----
+class TestAssignmentStrategies:
+    def test_range(self):
+        a = RangeAssignment().assign([0, 1, 2, 3, 4], ["c1", "c2"])
+        assert a == {"c1": [0, 1, 2], "c2": [3, 4]}
+
+    def test_round_robin(self):
+        a = RoundRobinAssignment().assign([0, 1, 2, 3, 4], ["c1", "c2"])
+        assert a == {"c1": [0, 2, 4], "c2": [1, 3]}
+
+    def test_sticky_minimizes_movement(self):
+        sticky = StickyAssignment()
+        first = sticky.assign([0, 1, 2, 3], ["c1", "c2"])
+        second = sticky.assign([0, 1, 2, 3], ["c1", "c2", "c3"])
+        # c1 and c2 keep some of their prior partitions.
+        kept = sum(len(set(first[c]) & set(second[c])) for c in ("c1", "c2"))
+        assert kept >= 2
+        assert sorted(p for parts in second.values() for p in parts) == [0, 1, 2, 3]
+
+    def test_empty_consumers(self):
+        assert RangeAssignment().assign([0, 1], []) == {}
+
+
+# ------------------------------------------------------------ ConsumerGroup ----
+class TestConsumerGroup:
+    def test_join_poll_commit_lag(self):
+        log = EventLog("log", num_partitions=2)
+        group = ConsumerGroup("group", log, rebalance_delay=0.1)
+
+        class NullConsumer(Entity):
+            def handle_event(self, event):
+                return None
+
+        c1 = NullConsumer("c1")
+
+        def script(self):
+            for i in range(8):
+                yield from log.append(f"key{i}", i)
+            assigned = yield from group.join("c1", c1)
+            records = yield from group.poll("c1", max_records=100)
+            # Commit the consumed offsets per partition.
+            commits = {}
+            for rec in records:
+                commits[rec.partition] = max(commits.get(rec.partition, 0), rec.offset + 1)
+            yield from group.commit("c1", commits)
+            return (sorted(assigned), len(records), group.total_lag())
+
+        driver = run_script(script, [log, group, c1])
+        assigned, polled, lag = driver.results[0]
+        assert assigned == [0, 1]
+        assert polled == 8
+        assert lag == 0
+        assert group.stats.polls == 1
+        assert group.stats.commits == 1
+
+    def test_rebalance_on_join_and_leave(self):
+        log = EventLog("log", num_partitions=4)
+        group = ConsumerGroup("group", log, rebalance_delay=0.05)
+
+        class NullConsumer(Entity):
+            def handle_event(self, event):
+                return None
+
+        c1, c2 = NullConsumer("c1"), NullConsumer("c2")
+
+        def script(self):
+            a1 = yield from group.join("c1", c1)
+            a2 = yield from group.join("c2", c2)
+            gen_after_joins = group.generation
+            yield from group.leave("c2")
+            a1_after = group.assignments.get("c1", [])
+            return (len(a1), sorted(group.assignments), gen_after_joins, sorted(a1_after))
+
+        driver = run_script(script, [log, group, c1, c2])
+        n_first, consumers_after, gen, c1_parts = driver.results[0]
+        assert n_first == 4  # sole consumer gets everything
+        assert consumers_after == ["c1"]
+        assert gen == 2
+        assert c1_parts == [0, 1, 2, 3]  # back to everything after leave
+        assert group.stats.rebalances == 3
+
+    def test_poll_respects_committed_offsets(self):
+        log = EventLog("log", num_partitions=1)
+        group = ConsumerGroup("group", log, rebalance_delay=0.01)
+
+        class NullConsumer(Entity):
+            def handle_event(self, event):
+                return None
+
+        c1 = NullConsumer("c1")
+
+        def script(self):
+            for i in range(5):
+                yield from log.append("k", i)
+            yield from group.join("c1", c1)
+            first = yield from group.poll("c1")
+            yield from group.commit("c1", {0: 3})
+            second = yield from group.poll("c1")
+            return ([r.value for r in first], [r.value for r in second])
+
+        driver = run_script(script, [log, group, c1])
+        first, second = driver.results[0]
+        assert first == [0, 1, 2, 3, 4]
+        assert second == [3, 4]  # from committed offset
+
+
+# ---------------------------------------------------------- StreamProcessor ----
+class ResultSink(Entity):
+    def __init__(self, name="sink"):
+        super().__init__(name)
+        self.windows = []
+        self.late = []
+
+    def handle_event(self, event):
+        meta = event.context["metadata"]
+        if event.event_type == "WindowResult":
+            self.windows.append(
+                (meta["key"], meta["window_start"], meta["window_end"], meta["result"])
+            )
+        elif event.event_type == "LateEvent":
+            self.late.append(meta["value"])
+        return None
+
+
+def _process_event(processor, at, key, value, event_time_s=None):
+    return Event(
+        t(at),
+        "Process",
+        target=processor,
+        context={
+            "metadata": {
+                "key": key,
+                "value": value,
+                "event_time_s": event_time_s if event_time_s is not None else at,
+            }
+        },
+    )
+
+
+class TestStreamProcessor:
+    def test_tumbling_window_aggregation(self):
+        sink = ResultSink()
+        proc = StreamProcessor("proc", TumblingWindow(10.0), sum, sink,
+                               watermark_interval_s=1.0)
+        sim = Simulation(entities=[proc, sink], duration=60.0)
+        # Two windows: [0,10) gets 1+2+3, [10,20) gets 10
+        for at, v in ((1.0, 1), (5.0, 2), (9.0, 3), (12.0, 10)):
+            sim.schedule([_process_event(proc, at, "k", v)])
+        sim.run()
+        results = {(s, e): r for _, s, e, r in sink.windows}
+        assert results[(0.0, 10.0)] == 6
+        assert results[(10.0, 20.0)] == 10
+
+    def test_sliding_window_overlap(self):
+        sink = ResultSink()
+        proc = StreamProcessor("proc", SlidingWindow(size_s=10.0, slide_s=5.0),
+                               len, sink, watermark_interval_s=1.0)
+        sim = Simulation(entities=[proc, sink], duration=60.0)
+        sim.schedule([_process_event(proc, 7.0, "k", "x")])  # in [0,10) and [5,15)
+        sim.run()
+        spans = sorted((s, e) for _, s, e, _ in sink.windows)
+        assert spans == [(0.0, 10.0), (5.0, 15.0)]
+
+    def test_session_window_merges_on_gap(self):
+        sink = ResultSink()
+        proc = StreamProcessor("proc", SessionWindow(gap_s=5.0), len, sink,
+                               watermark_interval_s=1.0)
+        sim = Simulation(entities=[proc, sink], duration=120.0)
+        # Burst (1,3,6) merges into one session; 30 starts another.
+        for at in (1.0, 3.0, 6.0, 30.0):
+            sim.schedule([_process_event(proc, at, "user", at)])
+        sim.run()
+        counts = sorted(r for _, _, _, r in sink.windows)
+        assert counts == [1, 3]
+
+    def test_late_event_dropped(self):
+        sink = ResultSink()
+        proc = StreamProcessor("proc", TumblingWindow(5.0), sum, sink,
+                               late_event_policy=LateEventPolicy.DROP,
+                               watermark_interval_s=1.0)
+        sim = Simulation(entities=[proc, sink], duration=60.0)
+        sim.schedule([_process_event(proc, 1.0, "k", 1)])
+        # Arrives at t=20 with event time 2.0 — far behind the watermark.
+        sim.schedule([_process_event(proc, 20.0, "k", 100, event_time_s=2.0)])
+        sim.run()
+        assert proc.stats.late_events_dropped == 1
+        results = {(s, e): r for _, s, e, r in sink.windows}
+        assert results[(0.0, 5.0)] == 1  # late value not included
+
+    def test_late_event_side_output(self):
+        sink = ResultSink()
+        side = ResultSink("side")
+        proc = StreamProcessor("proc", TumblingWindow(5.0), sum, sink,
+                               late_event_policy=LateEventPolicy.SIDE_OUTPUT,
+                               side_output=side, watermark_interval_s=1.0)
+        sim = Simulation(entities=[proc, sink, side], duration=60.0)
+        sim.schedule([_process_event(proc, 1.0, "k", 1)])
+        sim.schedule([_process_event(proc, 20.0, "k", 100, event_time_s=2.0)])
+        sim.run()
+        assert side.late == [100]
+        assert proc.stats.late_events_side_output == 1
+
+    def test_late_event_update_reemits(self):
+        sink = ResultSink()
+        proc = StreamProcessor("proc", TumblingWindow(5.0), sum, sink,
+                               late_event_policy=LateEventPolicy.UPDATE,
+                               watermark_interval_s=1.0)
+        sim = Simulation(entities=[proc, sink], duration=60.0)
+        sim.schedule([_process_event(proc, 1.0, "k", 1)])
+        sim.schedule([_process_event(proc, 20.0, "k", 100, event_time_s=2.0)])
+        sim.run()
+        # Window emitted twice: once with 1, re-emitted with 101.
+        window_results = [r for _, s, e, r in sink.windows if (s, e) == (0.0, 5.0)]
+        assert window_results == [1, 101]
+        assert proc.stats.late_events_updated == 1
