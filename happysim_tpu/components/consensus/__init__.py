@@ -1,0 +1,68 @@
+"""Consensus components — Paxos family, Raft, elections, membership, locks.
+
+Parity target: ``happysimulator/components/consensus/`` (SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.consensus.distributed_lock import (
+    DistributedLock,
+    DistributedLockStats,
+    LockGrant,
+)
+from happysim_tpu.components.consensus.election_strategies import (
+    BullyStrategy,
+    ElectionStrategy,
+    RandomizedStrategy,
+    RingStrategy,
+)
+from happysim_tpu.components.consensus.flexible_paxos import (
+    FlexiblePaxosNode,
+    FlexiblePaxosStats,
+)
+from happysim_tpu.components.consensus.leader_election import ElectionStats, LeaderElection
+from happysim_tpu.components.consensus.log import Log, LogEntry
+from happysim_tpu.components.consensus.membership import (
+    MemberInfo,
+    MemberState,
+    MembershipProtocol,
+    MembershipStats,
+)
+from happysim_tpu.components.consensus.multi_paxos import MultiPaxosNode, MultiPaxosStats
+from happysim_tpu.components.consensus.paxos import Ballot, PaxosNode, PaxosStats
+from happysim_tpu.components.consensus.phi_accrual_detector import (
+    PhiAccrualDetector,
+    PhiAccrualStats,
+)
+from happysim_tpu.components.consensus.raft import RaftNode, RaftState, RaftStats
+from happysim_tpu.components.consensus.raft_state_machine import KVStateMachine, StateMachine
+
+__all__ = [
+    "Ballot",
+    "BullyStrategy",
+    "DistributedLock",
+    "DistributedLockStats",
+    "ElectionStats",
+    "ElectionStrategy",
+    "FlexiblePaxosNode",
+    "FlexiblePaxosStats",
+    "KVStateMachine",
+    "LeaderElection",
+    "LockGrant",
+    "Log",
+    "LogEntry",
+    "MemberInfo",
+    "MemberState",
+    "MembershipProtocol",
+    "MembershipStats",
+    "MultiPaxosNode",
+    "MultiPaxosStats",
+    "PaxosNode",
+    "PaxosStats",
+    "PhiAccrualDetector",
+    "PhiAccrualStats",
+    "RaftNode",
+    "RaftState",
+    "RaftStats",
+    "RandomizedStrategy",
+    "RingStrategy",
+    "StateMachine",
+]

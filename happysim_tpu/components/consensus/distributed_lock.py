@@ -1,0 +1,223 @@
+"""Distributed lock service with leases and fencing tokens.
+
+Parity target: ``happysimulator/components/consensus/distributed_lock.py:69``
+(``acquire`` returning SimFuture[LockGrant] :94, reentrancy, waiter queue
+with ``max_waiters`` rejection, lease expiry :178, monotone fencing tokens).
+
+One fix over the reference: lease-expiry events are actually scheduled
+(pushed onto the running simulation's heap) — the reference builds them
+and parks them on an attribute nothing ever reads, so leases never expire.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture, _get_active_heap
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class LockGrant:
+    lock_name: str
+    fencing_token: int
+    holder: str
+    granted_at: float
+    lease_duration: float
+
+    @property
+    def expires_at(self) -> float:
+        return self.granted_at + self.lease_duration
+
+
+@dataclass(frozen=True)
+class DistributedLockStats:
+    acquires: int = 0
+    releases: int = 0
+    expirations: int = 0
+    rejections: int = 0
+
+
+@dataclass
+class _LockState:
+    holder: Optional[str] = None
+    fencing_token: int = 0
+    granted_at: float = 0.0
+    lease_duration: float = 0.0
+    waiters: list[tuple[str, SimFuture]] = field(default_factory=list)
+    lease_event: Optional[Event] = None
+
+
+class DistributedLock(Entity):
+    """Named locks with bounded leases; every grant carries a strictly
+    increasing fencing token (stale holders can be rejected downstream)."""
+
+    def __init__(self, name: str, lease_duration: float = 10.0, max_waiters: int = 0):
+        super().__init__(name)
+        self._lease_duration = lease_duration
+        self._max_waiters = max_waiters
+        self._locks: dict[str, _LockState] = {}
+        self._next_token = 1
+        self._total_acquires = 0
+        self._total_releases = 0
+        self._total_expirations = 0
+        self._total_rejections = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def active_locks(self) -> int:
+        return sum(1 for s in self._locks.values() if s.holder is not None)
+
+    @property
+    def total_waiters(self) -> int:
+        return sum(len(s.waiters) for s in self._locks.values())
+
+    def get_holder(self, lock_name: str) -> Optional[str]:
+        state = self._locks.get(lock_name)
+        return state.holder if state else None
+
+    def get_fencing_token(self, lock_name: str) -> Optional[int]:
+        state = self._locks.get(lock_name)
+        return state.fencing_token if state and state.holder else None
+
+    @property
+    def stats(self) -> DistributedLockStats:
+        return DistributedLockStats(
+            acquires=self._total_acquires,
+            releases=self._total_releases,
+            expirations=self._total_expirations,
+            rejections=self._total_rejections,
+        )
+
+    # -- API ---------------------------------------------------------------
+    def acquire(self, lock_name: str, requester: str) -> SimFuture:
+        """Future resolving with a LockGrant (or None if waiter-queue full).
+        Reentrant for the current holder."""
+        future: SimFuture = SimFuture()
+        state = self._get_or_create(lock_name)
+        if state.holder is None:
+            future.resolve(self._grant_lock(state, lock_name, requester))
+        elif state.holder == requester:
+            future.resolve(self._current_grant(state, lock_name))
+        elif self._max_waiters > 0 and len(state.waiters) >= self._max_waiters:
+            self._total_rejections += 1
+            future.resolve(None)
+        else:
+            state.waiters.append((requester, future))
+        return future
+
+    def try_acquire(self, lock_name: str, requester: str) -> Optional[LockGrant]:
+        state = self._get_or_create(lock_name)
+        if state.holder is None:
+            return self._grant_lock(state, lock_name, requester)
+        if state.holder == requester:
+            return self._current_grant(state, lock_name)
+        return None
+
+    def release(self, lock_name: str, fencing_token: int) -> bool:
+        """Release iff the token matches (stale releases are rejected)."""
+        state = self._locks.get(lock_name)
+        if state is None or state.holder is None or state.fencing_token != fencing_token:
+            return False
+        self._release_lock(state, lock_name)
+        return True
+
+    # -- events ------------------------------------------------------------
+    def handle_event(self, event: Event):
+        if event.event_type == "LockLeaseExpiry":
+            return self._handle_lease_expiry(event)
+        if event.event_type == "LockAcquireRequest":
+            meta = event.context.get("metadata", {})
+            reply = event.context.get("reply_future")
+            future = self.acquire(meta["lock_name"], meta["requester"])
+            if isinstance(reply, SimFuture):
+                future._add_settle_callback(lambda f: reply.resolve(f._value))
+            return None
+        if event.event_type == "LockReleaseRequest":
+            meta = event.context.get("metadata", {})
+            self.release(meta["lock_name"], meta["fencing_token"])
+            return None
+        return None
+
+    def _handle_lease_expiry(self, event: Event) -> None:
+        if event.cancelled:
+            return None
+        meta = event.context.get("metadata", {})
+        lock_name = meta.get("lock_name")
+        state = self._locks.get(lock_name)
+        if state is None or state.holder is None:
+            return None
+        if state.fencing_token != meta.get("fencing_token"):
+            return None  # lock was re-granted since; stale expiry
+        logger.debug(
+            "[%s] lock '%s' lease expired (holder=%s)", self.name, lock_name, state.holder
+        )
+        self._total_expirations += 1
+        state.holder = None
+        state.lease_event = None
+        self._wake_next_waiter(state, lock_name)
+        return None
+
+    # -- internals ---------------------------------------------------------
+    def _get_or_create(self, lock_name: str) -> _LockState:
+        return self._locks.setdefault(lock_name, _LockState())
+
+    def _current_grant(self, state: _LockState, lock_name: str) -> LockGrant:
+        return LockGrant(
+            lock_name=lock_name,
+            fencing_token=state.fencing_token,
+            holder=state.holder or "",
+            granted_at=state.granted_at,
+            lease_duration=state.lease_duration,
+        )
+
+    def _grant_lock(self, state: _LockState, lock_name: str, requester: str) -> LockGrant:
+        token = self._next_token
+        self._next_token += 1
+        now_s = self.now.to_seconds() if self._clock else 0.0
+        state.holder = requester
+        state.fencing_token = token
+        state.granted_at = now_s
+        state.lease_duration = self._lease_duration
+        self._total_acquires += 1
+        if state.lease_event is not None:
+            state.lease_event.cancel()
+            state.lease_event = None
+        heap = _get_active_heap()
+        if self._clock is not None and heap is not None:
+            expiry = Event(
+                self.now + self._lease_duration,
+                "LockLeaseExpiry",
+                target=self,
+                daemon=True,
+                context={"metadata": {"lock_name": lock_name, "fencing_token": token}},
+            )
+            state.lease_event = expiry
+            heap.push(expiry)
+        return self._current_grant(state, lock_name)
+
+    def _release_lock(self, state: _LockState, lock_name: str) -> None:
+        self._total_releases += 1
+        state.holder = None
+        if state.lease_event is not None:
+            state.lease_event.cancel()
+            state.lease_event = None
+        self._wake_next_waiter(state, lock_name)
+
+    def _wake_next_waiter(self, state: _LockState, lock_name: str) -> None:
+        while state.waiters:
+            requester, future = state.waiters.pop(0)
+            if not future.is_resolved:  # skip cancelled waiters
+                future.resolve(self._grant_lock(state, lock_name, requester))
+                break
+
+    def __repr__(self) -> str:
+        return (
+            f"DistributedLock({self.name}, active={self.active_locks}, "
+            f"waiters={self.total_waiters})"
+        )

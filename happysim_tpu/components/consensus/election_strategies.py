@@ -1,0 +1,245 @@
+"""Leader-election strategies: Bully, Ring, Randomized.
+
+Parity target: ``happysimulator/components/consensus/election_strategies.py``
+(``BullyStrategy`` :57, ``RingStrategy`` :129, ``RandomizedStrategy`` :218).
+
+A strategy is pure message logic: ``get_election_messages`` starts a round,
+``handle_election_message`` reacts. The :class:`LeaderElection` entity
+does the transport. Randomized ballots are seeded (the reference draws
+from the global stream).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Optional, Protocol
+
+
+class ElectionStrategy(Protocol):
+    def should_start_election(self, node_id: str, alive_members: list[str]) -> bool: ...
+
+    def get_election_messages(
+        self, node_id: str, alive_members: list[str], term: int
+    ) -> list[dict[str, Any]]: ...
+
+    def handle_election_message(
+        self,
+        node_id: str,
+        message_type: str,
+        payload: dict[str, Any],
+        alive_members: list[str],
+    ) -> dict[str, Any]: ...
+
+
+def _result(
+    response_messages: Optional[list[dict]] = None,
+    leader: Optional[str] = None,
+    suppress_election: bool = False,
+    start_own_election: bool = False,
+) -> dict[str, Any]:
+    return {
+        "response_messages": response_messages or [],
+        "leader": leader,
+        "suppress_election": suppress_election,
+        "start_own_election": start_own_election,
+    }
+
+
+class BullyStrategy:
+    """Highest ID wins: challenge everyone above you; silence ⇒ victory."""
+
+    def should_start_election(self, node_id: str, alive_members: list[str]) -> bool:
+        return True
+
+    def get_election_messages(
+        self, node_id: str, alive_members: list[str], term: int
+    ) -> list[dict[str, Any]]:
+        higher = [m for m in alive_members if m > node_id]
+        if not higher:
+            return [
+                {
+                    "target": m,
+                    "event_type": "ElectionVictory",
+                    "payload": {"leader": node_id, "term": term},
+                }
+                for m in alive_members
+                if m != node_id
+            ]
+        return [
+            {
+                "target": m,
+                "event_type": "ElectionChallenge",
+                "payload": {"challenger": node_id, "term": term},
+            }
+            for m in higher
+        ]
+
+    def handle_election_message(
+        self,
+        node_id: str,
+        message_type: str,
+        payload: dict[str, Any],
+        alive_members: list[str],
+    ) -> dict[str, Any]:
+        if message_type == "ElectionChallenge":
+            challenger = payload.get("challenger", "")
+            if node_id > challenger:
+                # Bully: suppress the lower node, run our own election.
+                return _result(
+                    response_messages=[
+                        {
+                            "target": challenger,
+                            "event_type": "ElectionSuppress",
+                            "payload": {"from": node_id},
+                        }
+                    ],
+                    start_own_election=True,
+                )
+            return _result()
+        if message_type == "ElectionSuppress":
+            return _result(suppress_election=True)
+        if message_type == "ElectionVictory":
+            return _result(leader=payload.get("leader"), suppress_election=True)
+        return _result()
+
+
+class RingStrategy:
+    """Token circulates the sorted ring collecting candidates; the
+    initiator crowns the max when it comes back around."""
+
+    def should_start_election(self, node_id: str, alive_members: list[str]) -> bool:
+        return True
+
+    @staticmethod
+    def _next_in_ring(node_id: str, alive_members: list[str]) -> str:
+        ring = sorted(set(alive_members) | {node_id})
+        return ring[(ring.index(node_id) + 1) % len(ring)]
+
+    def get_election_messages(
+        self, node_id: str, alive_members: list[str], term: int
+    ) -> list[dict[str, Any]]:
+        return [
+            {
+                "target": self._next_in_ring(node_id, alive_members),
+                "event_type": "ElectionToken",
+                "payload": {"initiator": node_id, "candidates": [node_id], "term": term},
+            }
+        ]
+
+    def handle_election_message(
+        self,
+        node_id: str,
+        message_type: str,
+        payload: dict[str, Any],
+        alive_members: list[str],
+    ) -> dict[str, Any]:
+        if message_type == "ElectionToken":
+            initiator = payload["initiator"]
+            candidates = list(payload["candidates"])
+            if initiator == node_id:
+                leader = max(candidates)
+                return _result(
+                    response_messages=[
+                        {
+                            "target": m,
+                            "event_type": "ElectionVictory",
+                            "payload": {"leader": leader, "term": payload.get("term", 0)},
+                        }
+                        for m in alive_members
+                        if m != node_id
+                    ],
+                    leader=leader,
+                    suppress_election=True,
+                )
+            candidates.append(node_id)
+            return _result(
+                response_messages=[
+                    {
+                        "target": self._next_in_ring(node_id, alive_members),
+                        "event_type": "ElectionToken",
+                        "payload": {
+                            "initiator": initiator,
+                            "candidates": candidates,
+                            "term": payload.get("term", 0),
+                        },
+                    }
+                ]
+            )
+        if message_type == "ElectionVictory":
+            return _result(leader=payload.get("leader"), suppress_election=True)
+        return _result()
+
+
+class RandomizedStrategy:
+    """Each node draws a ballot; the initiator compares responses and the
+    highest ballot's owner wins (initiator announces)."""
+
+    def __init__(self, ballot_range: int = 1_000_000, seed: Optional[int] = None):
+        self._ballot_range = ballot_range
+        self._rng = random.Random(seed)
+        self._ballots: dict[int, dict[str, int]] = {}  # term -> {node: ballot}
+
+    def should_start_election(self, node_id: str, alive_members: list[str]) -> bool:
+        return True
+
+    def get_election_messages(
+        self, node_id: str, alive_members: list[str], term: int
+    ) -> list[dict[str, Any]]:
+        ballot = self._rng.randint(1, self._ballot_range)
+        self._ballots[term] = {node_id: ballot}
+        others = [m for m in alive_members if m != node_id]
+        if not others:
+            return []
+        return [
+            {
+                "target": m,
+                "event_type": "ElectionBallot",
+                "payload": {"from": node_id, "ballot": ballot, "term": term},
+            }
+            for m in others
+        ]
+
+    def handle_election_message(
+        self,
+        node_id: str,
+        message_type: str,
+        payload: dict[str, Any],
+        alive_members: list[str],
+    ) -> dict[str, Any]:
+        term = payload.get("term", 0)
+        if message_type == "ElectionBallot":
+            sender = payload.get("from")
+            my_ballot = self._rng.randint(1, self._ballot_range)
+            if sender is None:
+                return _result()
+            return _result(
+                response_messages=[
+                    {
+                        "target": sender,
+                        "event_type": "ElectionBallotResponse",
+                        "payload": {"from": node_id, "ballot": my_ballot, "term": term},
+                    }
+                ]
+            )
+        if message_type == "ElectionBallotResponse":
+            collected = self._ballots.setdefault(term, {})
+            collected[payload.get("from", "?")] = payload.get("ballot", 0)
+            if len(collected) >= len(alive_members):
+                leader = max(collected, key=lambda n: (collected[n], n))
+                return _result(
+                    response_messages=[
+                        {
+                            "target": m,
+                            "event_type": "ElectionVictory",
+                            "payload": {"leader": leader, "term": term},
+                        }
+                        for m in alive_members
+                        if m != node_id
+                    ],
+                    leader=leader,
+                    suppress_election=True,
+                )
+            return _result()
+        if message_type == "ElectionVictory":
+            return _result(leader=payload.get("leader"), suppress_election=True)
+        return _result()

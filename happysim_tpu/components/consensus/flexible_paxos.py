@@ -1,0 +1,102 @@
+"""Flexible Paxos: independent Phase 1 / Phase 2 quorum sizes.
+
+Parity target: ``happysimulator/components/consensus/flexible_paxos.py:47``
+(Howard et al. 2016: safety needs only Q1 + Q2 > N, so a deployment can
+make the common path cheap — e.g. Q2=2 of 5 with Q1=4 — at the cost of
+more expensive leader election).
+
+Implemented over the Multi-Paxos machinery: same messages and slot
+pipeline, with the quorum checks split per phase.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.components.consensus.multi_paxos import MultiPaxosNode
+from happysim_tpu.components.consensus.raft_state_machine import StateMachine
+
+
+@dataclass(frozen=True)
+class FlexiblePaxosStats:
+    is_leader: bool = False
+    leader: Optional[str] = None
+    ballot_number: int = 0
+    slots_decided: int = 0
+    commands_applied: int = 0
+    phase1_quorum: int = 0
+    phase2_quorum: int = 0
+
+
+class FlexiblePaxosNode(MultiPaxosNode):
+    """MultiPaxos with explicit Q1/Q2; validates Q1 + Q2 > N."""
+
+    def __init__(
+        self,
+        name: str,
+        network: Any,
+        peers: Optional[list["FlexiblePaxosNode"]] = None,
+        state_machine: Optional[StateMachine] = None,
+        heartbeat_interval: float = 0.5,
+        phase1_quorum: Optional[int] = None,
+        phase2_quorum: Optional[int] = None,
+    ):
+        super().__init__(
+            name,
+            network,
+            peers=peers,
+            state_machine=state_machine,
+            heartbeat_interval=heartbeat_interval,
+        )
+        total = len(self._peers) + 1
+        majority = total // 2 + 1
+        self._phase1_quorum_n = phase1_quorum if phase1_quorum is not None else majority
+        self._phase2_quorum_n = phase2_quorum if phase2_quorum is not None else majority
+        self._validate_quorums()
+
+    def _validate_quorums(self) -> None:
+        total = len(self._peers) + 1
+        if self._phase1_quorum_n + self._phase2_quorum_n <= total:
+            raise ValueError(
+                "Flexible Paxos safety requires Q1 + Q2 > N: "
+                f"{self._phase1_quorum_n} + {self._phase2_quorum_n} <= {total}"
+            )
+        if self._phase1_quorum_n < 1 or self._phase2_quorum_n < 1:
+            raise ValueError("Quorums must be >= 1")
+        # Upper bound only checkable once peers are wired (set_peers).
+        if self._peers and (self._phase1_quorum_n > total or self._phase2_quorum_n > total):
+            raise ValueError(
+                f"Quorums must be <= cluster size {total}: "
+                f"got Q1={self._phase1_quorum_n}, Q2={self._phase2_quorum_n}"
+            )
+
+    def set_peers(self, peers: list["MultiPaxosNode"]) -> None:
+        super().set_peers(peers)
+        self._validate_quorums()
+
+    @property
+    def phase1_quorum(self) -> int:
+        return self._phase1_quorum_n
+
+    @property
+    def phase2_quorum(self) -> int:
+        return self._phase2_quorum_n
+
+    @property
+    def stats(self) -> FlexiblePaxosStats:  # type: ignore[override]
+        return FlexiblePaxosStats(
+            is_leader=self._is_leader,
+            leader=self._leader,
+            ballot_number=self._ballot.number,
+            slots_decided=self._slots_decided,
+            commands_applied=self._commands_applied,
+            phase1_quorum=self._phase1_quorum_n,
+            phase2_quorum=self._phase2_quorum_n,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"FlexiblePaxosNode({self.name}, q1={self._phase1_quorum_n}, "
+            f"q2={self._phase2_quorum_n}, leader={self._leader})"
+        )

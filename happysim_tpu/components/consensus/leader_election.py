@@ -1,0 +1,218 @@
+"""Leader election over pluggable strategies + heartbeat liveness.
+
+Parity target: ``happysimulator/components/consensus/leader_election.py:36``
+(heartbeat-gap triggers an election :121-156, strategy drives messages
+:170-260, ``ElectionStats`` :20).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.components.consensus.election_strategies import BullyStrategy, ElectionStrategy
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ElectionStats:
+    current_leader: Optional[str] = None
+    current_term: int = 0
+    elections_started: int = 0
+    elections_won: int = 0
+    elections_participated: int = 0
+
+
+class LeaderElection(Entity):
+    """One instance per node; missing leader heartbeats start an election
+    run by the configured strategy (Bully by default)."""
+
+    def __init__(
+        self,
+        name: str,
+        network: Any,
+        members: Optional[dict[str, Entity]] = None,
+        strategy: Optional[ElectionStrategy] = None,
+        election_timeout: float = 2.0,
+        heartbeat_interval: float = 0.5,
+    ):
+        super().__init__(name)
+        self._network = network
+        self._members: dict[str, Entity] = dict(members) if members else {}
+        self._strategy = strategy or BullyStrategy()
+        self._election_timeout = election_timeout
+        self._heartbeat_interval = heartbeat_interval
+        self._current_leader: Optional[str] = None
+        self._current_term = 0
+        self._election_in_progress = False
+        self._last_leader_heartbeat = 0.0
+        self._timeout_event: Optional[Event] = None
+        self._elections_started = 0
+        self._elections_won = 0
+        self._elections_participated = 0
+
+    # -- wiring ------------------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._members.values())
+
+    def add_member(self, entity: Entity) -> None:
+        self._members[entity.name] = entity
+
+    @property
+    def current_leader(self) -> Optional[str]:
+        return self._current_leader
+
+    @property
+    def current_term(self) -> int:
+        return self._current_term
+
+    @property
+    def is_leader(self) -> bool:
+        return self._current_leader == self.name
+
+    @property
+    def stats(self) -> ElectionStats:
+        return ElectionStats(
+            current_leader=self._current_leader,
+            current_term=self._current_term,
+            elections_started=self._elections_started,
+            elections_won=self._elections_won,
+            elections_participated=self._elections_participated,
+        )
+
+    def start(self) -> list[Event]:
+        self._last_leader_heartbeat = self.now.to_seconds() if self._clock else 0.0
+        return [self._schedule_check(self._election_timeout)]
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        if event.event_type == "ElectionTimeoutCheck":
+            return self._handle_timeout_check(event)
+        if event.event_type == "LeaderHeartbeat":
+            return self._handle_leader_heartbeat(event)
+        if event.event_type in (
+            "ElectionChallenge",
+            "ElectionSuppress",
+            "ElectionVictory",
+            "ElectionToken",
+            "ElectionBallot",
+            "ElectionBallotResponse",
+        ):
+            return self._handle_election_message(event)
+        return None
+
+    # -- liveness loop -----------------------------------------------------
+    def _schedule_check(self, delay: float) -> Event:
+        if self._timeout_event is not None:
+            self._timeout_event.cancel()
+        evt = Event(self.now + delay, "ElectionTimeoutCheck", target=self)  # primary: live cluster work
+        self._timeout_event = evt
+        return evt
+
+    def _handle_timeout_check(self, event: Event) -> list[Event]:
+        if event.cancelled:
+            return []
+        events: list[Event] = []
+        now_s = self.now.to_seconds()
+        if self.is_leader:
+            for member_name, member in self._members.items():
+                if member_name == self.name:
+                    continue
+                events.append(
+                    self._network.send(
+                        source=self,
+                        destination=member,
+                        event_type="LeaderHeartbeat",
+                        payload={"leader": self.name, "term": self._current_term},
+                        daemon=True,
+                    )
+                )
+        elif (
+            not self._election_in_progress
+            and now_s - self._last_leader_heartbeat > self._election_timeout
+        ):
+            events.extend(self._start_election())
+        interval = self._heartbeat_interval if self.is_leader else self._election_timeout
+        events.append(self._schedule_check(interval))
+        return events
+
+    def _handle_leader_heartbeat(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        if meta.get("term", 0) >= self._current_term:
+            self._current_leader = meta.get("leader")
+            self._current_term = meta.get("term", 0)
+            self._last_leader_heartbeat = self.now.to_seconds()
+            self._election_in_progress = False
+        return None
+
+    # -- elections ---------------------------------------------------------
+    def _strategy_messages_to_events(self, messages: list[dict]) -> list[Event]:
+        events = []
+        for msg in messages:
+            member = self._members.get(msg["target"])
+            if member is not None:
+                events.append(
+                    self._network.send(
+                        source=self,
+                        destination=member,
+                        event_type=msg["event_type"],
+                        payload=msg["payload"],
+                        daemon=True,
+                    )
+                )
+        return events
+
+    def _handle_election_message(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        self._elections_participated += 1
+        result = self._strategy.handle_election_message(
+            node_id=self.name,
+            message_type=event.event_type,
+            payload=meta,
+            alive_members=list(self._members.keys()),
+        )
+        events = self._strategy_messages_to_events(result.get("response_messages", []))
+        leader = result.get("leader")
+        if leader is not None:
+            self._current_leader = leader
+            # Adopt the winner's term (don't blindly increment past it —
+            # a contested follower would out-term the leader and reject
+            # its heartbeats forever, re-electing in a permanent livelock).
+            self._current_term = max(self._current_term, meta.get("term", 0))
+            self._last_leader_heartbeat = self.now.to_seconds()
+            self._election_in_progress = False
+            if leader == self.name:
+                self._elections_won += 1
+        if result.get("start_own_election") and not self._election_in_progress:
+            events.extend(self._start_election())
+        if result.get("suppress_election"):
+            self._election_in_progress = False
+        return events
+
+    def _start_election(self) -> list[Event]:
+        self._election_in_progress = True
+        self._elections_started += 1
+        self._current_term += 1
+        messages = self._strategy.get_election_messages(
+            node_id=self.name,
+            alive_members=list(self._members.keys()),
+            term=self._current_term,
+        )
+        events = self._strategy_messages_to_events(messages)
+        # No messages (no higher peers) or pure victory broadcast ⇒ we win.
+        if not messages or all(m["event_type"] == "ElectionVictory" for m in messages):
+            self._current_leader = self.name
+            self._elections_won += 1
+            self._election_in_progress = False
+            self._last_leader_heartbeat = self.now.to_seconds()
+        return events
+
+    def __repr__(self) -> str:
+        return (
+            f"LeaderElection({self.name}, leader={self._current_leader}, "
+            f"term={self._current_term})"
+        )

@@ -1,0 +1,99 @@
+"""Replicated log — shared by Raft and Multi-Paxos nodes.
+
+Parity target: ``happysimulator/components/consensus/log.py:28`` (1-based
+indexing, append/truncate/commit-advance, ``LogEntry``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    index: int  # 1-based position
+    term: int  # leader term (or ballot) at creation
+    command: Any
+
+
+class Log:
+    """Append-only command log with a commit frontier."""
+
+    def __init__(self):
+        self._entries: list[LogEntry] = []
+        self.commit_index = 0
+
+    def append(self, term: int, command: Any) -> LogEntry:
+        entry = LogEntry(index=len(self._entries) + 1, term=term, command=command)
+        self._entries.append(entry)
+        return entry
+
+    def append_entry(self, entry: LogEntry) -> None:
+        """Append re-indexed to the next slot (replication path)."""
+        self._entries.append(
+            LogEntry(index=len(self._entries) + 1, term=entry.term, command=entry.command)
+        )
+
+    def set_at(self, index: int, term: int, command: Any) -> LogEntry:
+        """Place an entry at an explicit 1-based slot (Paxos slot decide),
+        padding gaps with no-ops."""
+        while len(self._entries) < index - 1:
+            self._entries.append(LogEntry(index=len(self._entries) + 1, term=0, command=None))
+        entry = LogEntry(index=index, term=term, command=command)
+        if index <= len(self._entries):
+            self._entries[index - 1] = entry
+        else:
+            self._entries.append(entry)
+        return entry
+
+    def get(self, index: int) -> Optional[LogEntry]:
+        if 1 <= index <= len(self._entries):
+            return self._entries[index - 1]
+        return None
+
+    def truncate_from(self, index: int) -> int:
+        """Remove entries at/after ``index``; returns how many."""
+        if index < 1 or index > len(self._entries):
+            return 0
+        removed = len(self._entries) - (index - 1)
+        self._entries = self._entries[: index - 1]
+        if self.commit_index >= index:
+            self.commit_index = index - 1
+        return removed
+
+    def entries_after(self, index: int) -> list[LogEntry]:
+        return list(self._entries[max(index, 0):])
+
+    def entries_from(self, index: int) -> list[LogEntry]:
+        return list(self._entries[max(index, 1) - 1:])
+
+    def advance_commit(self, new_commit: int) -> list[LogEntry]:
+        """Move the commit frontier; returns the newly committed entries."""
+        new_commit = min(new_commit, len(self._entries))
+        if new_commit <= self.commit_index:
+            return []
+        newly = self._entries[self.commit_index : new_commit]
+        self.commit_index = new_commit
+        return newly
+
+    def committed_entries(self) -> list[LogEntry]:
+        return list(self._entries[: self.commit_index])
+
+    @property
+    def last_index(self) -> int:
+        return len(self._entries)
+
+    @property
+    def last_term(self) -> int:
+        return self._entries[-1].term if self._entries else 0
+
+    @property
+    def last_entry(self) -> Optional[LogEntry]:
+        return self._entries[-1] if self._entries else None
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __repr__(self) -> str:
+        return f"Log(len={len(self._entries)}, commit={self.commit_index})"

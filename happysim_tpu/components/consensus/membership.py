@@ -1,0 +1,386 @@
+"""SWIM-style membership protocol with phi-accrual suspicion.
+
+Parity target: ``happysimulator/components/consensus/membership.py:72``
+(probe tick → direct ping → ack-timeout → indirect pings via delegates →
+suspicion timeout → DEAD; piggybacked state updates; per-member
+``PhiAccrualDetector``). Probe order and delegate choice are seeded.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass, field
+from enum import Enum, auto
+from typing import Any, Optional
+
+from happysim_tpu.components.consensus.phi_accrual_detector import PhiAccrualDetector
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+logger = logging.getLogger(__name__)
+
+
+class MemberState(Enum):
+    ALIVE = auto()
+    SUSPECT = auto()
+    DEAD = auto()
+
+
+@dataclass
+class MemberInfo:
+    name: str
+    entity: Entity
+    state: MemberState = MemberState.ALIVE
+    incarnation: int = 0
+    detector: PhiAccrualDetector = field(
+        default_factory=lambda: PhiAccrualDetector(threshold=8.0)
+    )
+    state_change_time: float = 0.0
+
+
+@dataclass(frozen=True)
+class MembershipStats:
+    alive_count: int = 0
+    suspect_count: int = 0
+    dead_count: int = 0
+    probes_sent: int = 0
+    indirect_probes_sent: int = 0
+    acks_received: int = 0
+    updates_disseminated: int = 0
+
+
+class MembershipProtocol(Entity):
+    """One instance per node; probes peers round-robin, gossips state."""
+
+    def __init__(
+        self,
+        name: str,
+        network: Any,
+        probe_interval: float = 1.0,
+        suspicion_timeout: float = 5.0,
+        indirect_probe_count: int = 3,
+        phi_threshold: float = 8.0,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(name)
+        self._network = network
+        self._probe_interval = probe_interval
+        self._suspicion_timeout = suspicion_timeout
+        self._indirect_probe_count = indirect_probe_count
+        self._phi_threshold = phi_threshold
+        self._rng = random.Random(seed if seed is not None else hash(name) & 0xFFFF)
+        self._members: dict[str, MemberInfo] = {}
+        self._incarnation = 0
+        self._pending_updates: list[dict[str, Any]] = []
+        self._probe_order: list[str] = []
+        self._probe_index = 0
+        self._pending_acks: dict[str, Event] = {}
+        self._probes_sent = 0
+        self._indirect_probes_sent = 0
+        self._acks_received = 0
+        self._updates_disseminated = 0
+
+    # -- wiring ------------------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return [info.entity for info in self._members.values()]
+
+    def add_member(self, entity: Entity) -> None:
+        if entity.name == self.name:
+            return
+        self._members[entity.name] = MemberInfo(
+            name=entity.name,
+            entity=entity,
+            detector=PhiAccrualDetector(
+                threshold=self._phi_threshold, initial_interval=self._probe_interval
+            ),
+        )
+        self._probe_order.append(entity.name)
+
+    def start(self) -> list[Event]:
+        self._rng.shuffle(self._probe_order)
+        return [self._probe_tick()]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def alive_members(self) -> list[str]:
+        return [n for n, i in self._members.items() if i.state is MemberState.ALIVE]
+
+    @property
+    def suspected_members(self) -> list[str]:
+        return [n for n, i in self._members.items() if i.state is MemberState.SUSPECT]
+
+    @property
+    def dead_members(self) -> list[str]:
+        return [n for n, i in self._members.items() if i.state is MemberState.DEAD]
+
+    def get_member_state(self, name: str) -> Optional[MemberState]:
+        info = self._members.get(name)
+        return info.state if info else None
+
+    @property
+    def stats(self) -> MembershipStats:
+        return MembershipStats(
+            alive_count=len(self.alive_members),
+            suspect_count=len(self.suspected_members),
+            dead_count=len(self.dead_members),
+            probes_sent=self._probes_sent,
+            indirect_probes_sent=self._indirect_probes_sent,
+            acks_received=self._acks_received,
+            updates_disseminated=self._updates_disseminated,
+        )
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        handlers = {
+            "MembershipProbeTick": self._handle_probe_tick,
+            "MembershipPing": self._handle_ping,
+            "MembershipAck": self._handle_ack,
+            "MembershipIndirectPing": self._handle_indirect_ping,
+            "MembershipSuspicionTimeout": self._handle_suspicion_timeout,
+        }
+        handler = handlers.get(event.event_type)
+        return handler(event) if handler else None
+
+    # -- probe loop --------------------------------------------------------
+    def _probe_tick(self) -> Event:
+        # Primary: the probe loop is the protocol's live work.
+        return Event(self.now + self._probe_interval, "MembershipProbeTick", target=self)
+
+    def _handle_probe_tick(self, event: Event) -> list[Event]:
+        events: list[Event] = []
+        now_s = self.now.to_seconds()
+        for info in self._members.values():
+            if info.state is MemberState.ALIVE and not info.detector.is_available(now_s):
+                self._suspect_member(info, now_s)
+        target = self._next_probe_target()
+        if target is not None:
+            info = self._members[target]
+            events.append(
+                self._network.send(
+                    source=self,
+                    destination=info.entity,
+                    event_type="MembershipPing",
+                    payload={
+                        "from": self.name,
+                        "incarnation": self._incarnation,
+                        "updates": self._drain_updates(),
+                    },
+                    daemon=True,
+                )
+            )
+            self._probes_sent += 1
+            pending = self._pending_acks.get(target)
+            if pending is not None and pending.event_type == "MembershipSuspicionTimeout":
+                # A suspicion clock is already running for this member —
+                # re-probing must NOT reset it, or a dead member whose
+                # probe cadence is shorter than suspicion_timeout would
+                # stay SUSPECT forever.
+                pass
+            else:
+                # Ack timeout → escalate to indirect probing.
+                timeout = Event(
+                    self.now + self._probe_interval * 0.5,
+                    "MembershipIndirectPing",
+                    target=self,
+                    daemon=True,
+                    context={"metadata": {"probe_target": target}},
+                )
+                if pending is not None:
+                    pending.cancel()
+                self._pending_acks[target] = timeout
+                events.append(timeout)
+        events.append(self._probe_tick())
+        return events
+
+    def _next_probe_target(self) -> Optional[str]:
+        candidates = [
+            n for n in self._probe_order if self._members[n].state is not MemberState.DEAD
+        ]
+        if not candidates:
+            return None
+        target = candidates[self._probe_index % len(candidates)]
+        self._probe_index += 1
+        if self._probe_index % len(candidates) == 0:
+            self._rng.shuffle(self._probe_order)  # SWIM round-robin reshuffle
+        return target
+
+    # -- message handlers --------------------------------------------------
+    def _handle_ping(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        sender = meta.get("from")
+        self._apply_updates(meta.get("updates", []))
+        if sender is None or sender not in self._members:
+            return []
+        self._record_alive(sender)
+        events = [
+            self._network.send(
+                source=self,
+                destination=self._members[sender].entity,
+                event_type="MembershipAck",
+                payload={
+                    "from": self.name,
+                    "ack_for": sender,
+                    "incarnation": self._incarnation,
+                    "updates": self._drain_updates(),
+                },
+                daemon=True,
+            )
+        ]
+        # SWIM delegation: as a delegate, actually probe the suspect and
+        # ask it to ack the ORIGINAL prober directly — otherwise indirect
+        # probing is a no-op and reachable members get declared dead.
+        indirect_for = meta.get("indirect_for")
+        if indirect_for and indirect_for in self._members:
+            events.append(
+                self._network.send(
+                    source=self,
+                    destination=self._members[indirect_for].entity,
+                    event_type="MembershipPing",
+                    payload={
+                        "from": self.name,
+                        "relay_ack_to": sender,
+                        "incarnation": self._incarnation,
+                        "updates": [],
+                    },
+                    daemon=True,
+                )
+            )
+        relay_to = meta.get("relay_ack_to")
+        if relay_to and relay_to in self._members:
+            # We are the suspect being probed on someone's behalf: ack the
+            # original prober directly so it cancels its suspicion timer.
+            events.append(
+                self._network.send(
+                    source=self,
+                    destination=self._members[relay_to].entity,
+                    event_type="MembershipAck",
+                    payload={
+                        "from": self.name,
+                        "ack_for": relay_to,
+                        "incarnation": self._incarnation,
+                        "updates": [],
+                    },
+                    daemon=True,
+                )
+            )
+        return events
+
+    def _handle_ack(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        sender = meta.get("from")
+        self._apply_updates(meta.get("updates", []))
+        self._acks_received += 1
+        if sender and sender in self._members:
+            self._record_alive(sender)
+            pending = self._pending_acks.pop(sender, None)
+            if pending is not None:
+                pending.cancel()
+        return None
+
+    def _handle_indirect_ping(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        target_name = meta.get("probe_target")
+        if (
+            target_name is None
+            or target_name not in self._members
+            or target_name not in self._pending_acks  # ack arrived in time
+        ):
+            return []
+        delegates = [
+            n
+            for n in self._members
+            if n != target_name and self._members[n].state is not MemberState.DEAD
+        ]
+        self._rng.shuffle(delegates)
+        events: list[Event] = []
+        for delegate_name in delegates[: self._indirect_probe_count]:
+            events.append(
+                self._network.send(
+                    source=self,
+                    destination=self._members[delegate_name].entity,
+                    event_type="MembershipPing",
+                    payload={
+                        "from": self.name,
+                        "indirect_for": target_name,
+                        "incarnation": self._incarnation,
+                        "updates": self._drain_updates(),
+                    },
+                    daemon=True,
+                )
+            )
+            self._indirect_probes_sent += 1
+        suspicion = Event(
+            self.now + self._suspicion_timeout,
+            "MembershipSuspicionTimeout",
+            target=self,
+            daemon=True,
+            context={"metadata": {"suspect": target_name}},
+        )
+        self._pending_acks[target_name].cancel()
+        self._pending_acks[target_name] = suspicion
+        events.append(suspicion)
+        return events
+
+    def _handle_suspicion_timeout(self, event: Event) -> None:
+        suspect_name = event.context.get("metadata", {}).get("suspect")
+        if suspect_name and suspect_name in self._members:
+            info = self._members[suspect_name]
+            if info.state is MemberState.SUSPECT or (
+                info.state is MemberState.ALIVE
+                and not info.detector.is_available(self.now.to_seconds())
+            ):
+                info.state = MemberState.DEAD
+                info.state_change_time = self.now.to_seconds()
+                self._pending_updates.append(
+                    {"member": suspect_name, "state": "dead", "incarnation": info.incarnation}
+                )
+                logger.debug("[%s] Member %s declared DEAD", self.name, suspect_name)
+            self._pending_acks.pop(suspect_name, None)
+        return None
+
+    # -- state transitions -------------------------------------------------
+    def _record_alive(self, member_name: str) -> None:
+        info = self._members[member_name]
+        info.detector.heartbeat(self.now.to_seconds())
+        if info.state is MemberState.SUSPECT:
+            info.state = MemberState.ALIVE
+            self._pending_updates.append(
+                {"member": member_name, "state": "alive", "incarnation": info.incarnation}
+            )
+
+    def _suspect_member(self, info: MemberInfo, now_s: float) -> None:
+        if info.state is not MemberState.ALIVE:
+            return
+        info.state = MemberState.SUSPECT
+        info.state_change_time = now_s
+        self._pending_updates.append(
+            {"member": info.name, "state": "suspect", "incarnation": info.incarnation}
+        )
+
+    # -- gossip ------------------------------------------------------------
+    def _drain_updates(self) -> list[dict[str, Any]]:
+        updates, self._pending_updates = self._pending_updates, []
+        self._updates_disseminated += len(updates)
+        return updates
+
+    def _apply_updates(self, updates: list[dict[str, Any]]) -> None:
+        for update in updates:
+            member = update.get("member")
+            if member == self.name or member not in self._members:
+                continue
+            info = self._members[member]
+            state_str = update.get("state")
+            if state_str == "suspect" and info.state is MemberState.ALIVE:
+                info.state = MemberState.SUSPECT
+            elif state_str == "dead" and info.state is not MemberState.DEAD:
+                info.state = MemberState.DEAD
+            elif state_str == "alive" and info.state is MemberState.SUSPECT:
+                info.state = MemberState.ALIVE
+
+    def __repr__(self) -> str:
+        s = self.stats
+        return (
+            f"MembershipProtocol({self.name}, alive={s.alive_count}, "
+            f"suspect={s.suspect_count}, dead={s.dead_count})"
+        )

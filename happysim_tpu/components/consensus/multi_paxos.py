@@ -1,0 +1,431 @@
+"""Multi-Paxos: a stable leader decides a SEQUENCE of log slots.
+
+Parity target: ``happysimulator/components/consensus/multi_paxos.py:41``
+(one Phase 1 elects the leader for all future slots; Phase 2 per slot;
+leader heartbeats suppress rival prepares; follower ``submit`` forwards
+to the leader).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.components.consensus.log import Log, LogEntry
+from happysim_tpu.components.consensus.paxos import Ballot
+from happysim_tpu.components.consensus.raft_state_machine import KVStateMachine, StateMachine
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class MultiPaxosStats:
+    is_leader: bool = False
+    leader: Optional[str] = None
+    ballot_number: int = 0
+    slots_decided: int = 0
+    commands_applied: int = 0
+    prepares_sent: int = 0
+    forwards: int = 0
+
+
+class MultiPaxosNode(Entity):
+    """Call ``start()`` on ONE node to run Phase 1 and lead; followers
+    forward submissions to the leader."""
+
+    def __init__(
+        self,
+        name: str,
+        network: Any,
+        peers: Optional[list["MultiPaxosNode"]] = None,
+        state_machine: Optional[StateMachine] = None,
+        heartbeat_interval: float = 0.5,
+    ):
+        super().__init__(name)
+        self._network = network
+        self._peers: list[MultiPaxosNode] = [p for p in (peers or []) if p.name != name]
+        self._state_machine = state_machine or KVStateMachine()
+        self._heartbeat_interval = heartbeat_interval
+        # Acceptor state
+        self._promised_ballot: Optional[Ballot] = None
+        # slot -> (ballot, value)
+        self._accepted: dict[int, tuple[Ballot, Any]] = {}
+        # Leader state
+        self._ballot = Ballot(0, name)
+        self._leader: Optional[str] = None
+        self._is_leader = False
+        self._phase1_responses: list[dict] = []
+        self._next_slot = 1
+        # slot -> accept count
+        self._slot_acks: dict[int, int] = {}
+        # slot -> (value, future)
+        self._slot_values: dict[int, Any] = {}
+        self._slot_futures: dict[int, SimFuture] = {}
+        self._heartbeat_event: Optional[Event] = None
+        self._log = Log()
+        self._last_applied = 0
+        self._slots_decided = 0
+        self._commands_applied = 0
+        self._prepares_sent = 0
+        self._forwards = 0
+
+    # -- wiring ------------------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._peers)
+
+    def set_peers(self, peers: list["MultiPaxosNode"]) -> None:
+        self._peers = [p for p in peers if p.name != self.name]
+
+    @property
+    def quorum_size(self) -> int:
+        return (len(self._peers) + 1) // 2 + 1
+
+    @property
+    def phase1_quorum(self) -> int:
+        return self.quorum_size
+
+    @property
+    def phase2_quorum(self) -> int:
+        return self.quorum_size
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    @property
+    def leader(self) -> Optional[str]:
+        return self._leader
+
+    @property
+    def log(self) -> Log:
+        return self._log
+
+    @property
+    def state_machine(self) -> StateMachine:
+        return self._state_machine
+
+    @property
+    def stats(self) -> MultiPaxosStats:
+        return MultiPaxosStats(
+            is_leader=self._is_leader,
+            leader=self._leader,
+            ballot_number=self._ballot.number,
+            slots_decided=self._slots_decided,
+            commands_applied=self._commands_applied,
+            prepares_sent=self._prepares_sent,
+            forwards=self._forwards,
+        )
+
+    # -- client API --------------------------------------------------------
+    def submit(self, command: Any) -> SimFuture:
+        """Future resolves (slot, result) on commit. Followers forward to
+        the known leader through the network (extra hop, like reality);
+        the reply future rides the forward event's context."""
+        future: SimFuture = SimFuture()
+        if self._is_leader:
+            self._assign_slot(command, future)
+            return future
+        leader = self._find_peer(self._leader)
+        if leader is None:
+            future.resolve(None)  # no known leader
+            return future
+        self._forwards += 1
+        forward = self._network.send(
+            source=self,
+            destination=leader,
+            event_type="MultiPaxosForward",
+            payload={"command": command},
+            daemon=False,
+        )
+        forward.context["reply_future"] = future
+        from happysim_tpu.core.sim_future import _get_active_heap
+
+        heap = _get_active_heap()
+        if heap is not None:
+            heap.push(forward)
+        return future
+
+    def start(self) -> list[Event]:
+        """Run Phase 1 to become the stable leader."""
+        self._ballot = Ballot(self._ballot.number + 1, self.name)
+        self._phase1_responses = [{"from": self.name, "accepted": dict(self._accepted)}]
+        self._promised_ballot = self._ballot
+        self._prepares_sent += 1
+        events = [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="MultiPaxosPrepare",
+                payload={"ballot_number": self._ballot.number, "ballot_node": self.name},
+                daemon=False,
+            )
+            for peer in self._peers
+        ]
+        if len(self._phase1_responses) >= self.phase1_quorum:
+            events.extend(self._become_leader())
+        return events
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        handlers = {
+            "MultiPaxosPrepare": self._handle_prepare,
+            "MultiPaxosPromise": self._handle_promise,
+            "MultiPaxosAccept": self._handle_accept,
+            "MultiPaxosAccepted": self._handle_accepted,
+            "MultiPaxosHeartbeat": self._handle_heartbeat,
+            "MultiPaxosForward": self._handle_forward,
+            "MultiPaxosDecided": self._handle_slot_decided,
+            "MultiPaxosHeartbeatTick": self._handle_heartbeat_tick,
+        }
+        handler = handlers.get(event.event_type)
+        return handler(event) if handler else None
+
+    # -- phase 1 -----------------------------------------------------------
+    def _handle_prepare(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        ballot = Ballot(meta["ballot_number"], meta["ballot_node"])
+        sender = self._find_peer(meta.get("source"))
+        if sender is None:
+            return []
+        if self._promised_ballot is not None and ballot < self._promised_ballot:
+            return [
+                self._network.send(
+                    source=self,
+                    destination=sender,
+                    event_type="MultiPaxosNack",
+                    payload={"highest_ballot_number": self._promised_ballot.number},
+                    daemon=False,
+                )
+            ]
+        self._promised_ballot = ballot
+        self._is_leader = False
+        return [
+            self._network.send(
+                source=self,
+                destination=sender,
+                event_type="MultiPaxosPromise",
+                payload={
+                    "ballot_number": ballot.number,
+                    "from": self.name,
+                    "accepted": {
+                        str(slot): (b.number, b.node_id, v)
+                        for slot, (b, v) in self._accepted.items()
+                    },
+                },
+                daemon=False,
+            )
+        ]
+
+    def _handle_promise(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        if meta["ballot_number"] != self._ballot.number or self._is_leader:
+            return []
+        accepted = {
+            int(slot): (Ballot(b_num, b_node), value)
+            for slot, (b_num, b_node, value) in meta.get("accepted", {}).items()
+        }
+        self._phase1_responses.append({"from": meta.get("from"), "accepted": accepted})
+        if len(self._phase1_responses) >= self.phase1_quorum:
+            return self._become_leader()
+        return []
+
+    def _become_leader(self) -> list[Event]:
+        self._is_leader = True
+        self._leader = self.name
+        # Re-propose the highest-ballot accepted value for every known slot.
+        merged: dict[int, tuple[Ballot, Any]] = {}
+        for resp in self._phase1_responses:
+            for slot, (ballot, value) in resp.get("accepted", {}).items():
+                if slot not in merged or ballot > merged[slot][0]:
+                    merged[slot] = (ballot, value)
+        events: list[Event] = []
+        for slot, (_b, value) in sorted(merged.items()):
+            self._slot_values[slot] = value
+            self._slot_acks[slot] = 0
+            self._next_slot = max(self._next_slot, slot + 1)
+            events.extend(self._replicate_slot(slot))
+        events.extend(self._send_heartbeat())
+        events.append(self._heartbeat_tick())
+        return events
+
+    # -- phase 2 -----------------------------------------------------------
+    def _assign_slot(self, command: Any, future: SimFuture) -> list[Event]:
+        slot = self._next_slot
+        self._next_slot += 1
+        self._slot_values[slot] = command
+        self._slot_futures[slot] = future
+        # Self-accept
+        self._accepted[slot] = (self._ballot, command)
+        self._slot_acks[slot] = 1
+        events = self._replicate_slot(slot)
+        from happysim_tpu.core.sim_future import _get_active_heap
+
+        heap = _get_active_heap()
+        if heap is not None:
+            for e in events:
+                heap.push(e)
+            return []
+        return events
+
+    def _replicate_slot(self, slot: int) -> list[Event]:
+        return [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="MultiPaxosAccept",
+                payload={
+                    "ballot_number": self._ballot.number,
+                    "ballot_node": self._ballot.node_id,
+                    "slot": slot,
+                    "value": self._slot_values[slot],
+                },
+                daemon=False,
+            )
+            for peer in self._peers
+        ]
+
+    def _handle_accept(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        ballot = Ballot(meta["ballot_number"], meta["ballot_node"])
+        sender = self._find_peer(meta.get("source"))
+        if sender is None:
+            return []
+        if self._promised_ballot is not None and ballot < self._promised_ballot:
+            return [
+                self._network.send(
+                    source=self,
+                    destination=sender,
+                    event_type="MultiPaxosNack",
+                    payload={"highest_ballot_number": self._promised_ballot.number},
+                    daemon=False,
+                )
+            ]
+        self._promised_ballot = ballot
+        self._leader = ballot.node_id
+        slot = meta["slot"]
+        self._accepted[slot] = (ballot, meta["value"])
+        return [
+            self._network.send(
+                source=self,
+                destination=sender,
+                event_type="MultiPaxosAccepted",
+                payload={"slot": slot, "from": self.name},
+                daemon=False,
+            )
+        ]
+
+    def _handle_accepted(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        slot = meta["slot"]
+        if not self._is_leader or slot not in self._slot_values:
+            return []
+        self._slot_acks[slot] = self._slot_acks.get(slot, 0) + 1
+        if self._slot_acks[slot] == self.phase2_quorum:
+            return self._decide_slot(slot)
+        return []
+
+    def _decide_slot(self, slot: int) -> list[Event]:
+        value = self._slot_values[slot]
+        self._log.set_at(slot, self._ballot.number, value)
+        self._slots_decided += 1
+        self._advance_applied(slot)
+        events = [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="MultiPaxosDecided",
+                payload={"slot": slot, "value": value},
+                daemon=False,
+            )
+            for peer in self._peers
+        ]
+        return events
+
+    def _handle_slot_decided(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        slot, value = meta["slot"], meta["value"]
+        self._log.set_at(slot, self._ballot.number, value)
+        self._slots_decided += 1
+        self._advance_applied(slot)
+        return None
+
+    def _advance_applied(self, decided_slot: int) -> None:
+        # Apply in order; stop at the first gap.
+        while True:
+            entry = self._log.get(self._last_applied + 1)
+            if entry is None or entry.command is None and entry.term == 0:
+                break
+            result = self._state_machine.apply(entry.command)
+            self._last_applied = entry.index
+            self._commands_applied += 1
+            self._log.advance_commit(entry.index)
+            future = self._slot_futures.pop(entry.index, None)
+            if future is not None:
+                future.resolve((entry.index, result))
+
+    # -- leadership maintenance --------------------------------------------
+    def _heartbeat_tick(self) -> Event:
+        if self._heartbeat_event is not None:
+            self._heartbeat_event.cancel()
+        # Primary: leadership maintenance is live work (see raft.py note).
+        tick = Event(
+            self.now + self._heartbeat_interval, "MultiPaxosHeartbeatTick", target=self
+        )
+        self._heartbeat_event = tick
+        return tick
+
+    def _handle_heartbeat_tick(self, event: Event) -> list[Event]:
+        if event.cancelled or not self._is_leader:
+            return []
+        events = self._send_heartbeat()
+        events.append(self._heartbeat_tick())
+        return events
+
+    def _send_heartbeat(self) -> list[Event]:
+        return [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="MultiPaxosHeartbeat",
+                payload={"leader": self.name, "ballot_number": self._ballot.number},
+                daemon=False,
+            )
+            for peer in self._peers
+        ]
+
+    def _handle_heartbeat(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        if meta.get("ballot_number", 0) >= (
+            self._promised_ballot.number if self._promised_ballot else 0
+        ):
+            self._leader = meta.get("leader")
+        return None
+
+    def _handle_forward(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        reply: Optional[SimFuture] = event.context.get("reply_future")
+        if not self._is_leader:
+            if reply is not None:
+                reply.resolve(None)  # stale forward: reject, don't hang
+            return []
+        future: SimFuture = SimFuture()
+        if reply is not None:
+            future._add_settle_callback(lambda f: reply.resolve(f._value))
+        self._assign_slot(meta.get("command"), future)
+        return []
+
+    def _find_peer(self, source_name: Optional[str]) -> Optional[Entity]:
+        for peer in self._peers:
+            if peer.name == source_name:
+                return peer
+        return None
+
+    def __repr__(self) -> str:
+        return (
+            f"MultiPaxosNode({self.name}, leader={self._leader}, "
+            f"slots={self._slots_decided})"
+        )

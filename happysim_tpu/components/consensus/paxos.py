@@ -1,0 +1,392 @@
+"""Single-decree Paxos: every node is proposer + acceptor + learner.
+
+Parity target: ``happysimulator/components/consensus/paxos.py:66``
+(``Ballot`` :29 ordered (number, node_id); Phase 1 Prepare/Promise/Nack
+:169-305, Phase 2 Accept/Accepted :333-420, decide + learn broadcast
+:438-470, nack-retry with jittered backoff :283-330).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True, order=True)
+class Ballot:
+    """Ordered by (number, node_id) — node id breaks ties."""
+
+    number: int
+    node_id: str
+
+
+@dataclass(frozen=True)
+class PaxosStats:
+    proposals_started: int = 0
+    proposals_succeeded: int = 0
+    proposals_failed: int = 0
+    promises_received: int = 0
+    nacks_received: int = 0
+    accepts_received: int = 0
+    decided_value: Any = None
+
+
+class PaxosNode(Entity):
+    """Classic two-phase Paxos for one decision."""
+
+    def __init__(
+        self,
+        name: str,
+        network: Any,
+        peers: Optional[list["PaxosNode"]] = None,
+        retry_delay: float = 0.5,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(name)
+        self._network = network
+        self._peers: list[PaxosNode] = [p for p in (peers or []) if p.name != name]
+        self._retry_delay = retry_delay
+        self._rng = random.Random(seed if seed is not None else hash(name) & 0xFFFF)
+        # Acceptor state
+        self._promised_ballot: Optional[Ballot] = None
+        self._accepted_ballot: Optional[Ballot] = None
+        self._accepted_value: Any = None
+        # Proposer state
+        self._current_ballot = Ballot(0, name)
+        self._proposal_futures: dict[int, SimFuture] = {}
+        self._phase1_responses: dict[int, list[dict]] = {}
+        self._phase2_responses: dict[int, int] = {}
+        self._phase2_started: set[int] = set()
+        self._proposed_values: dict[int, Any] = {}
+        # Learner state
+        self._decided = False
+        self._decided_value: Any = None
+        self._proposals_started = 0
+        self._proposals_succeeded = 0
+        self._proposals_failed = 0
+        self._promises_received = 0
+        self._nacks_received = 0
+        self._accepts_received = 0
+
+    # -- wiring ------------------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._peers)
+
+    def set_peers(self, peers: list["PaxosNode"]) -> None:
+        self._peers = [p for p in peers if p.name != self.name]
+
+    @property
+    def quorum_size(self) -> int:
+        return (len(self._peers) + 1) // 2 + 1
+
+    @property
+    def is_decided(self) -> bool:
+        return self._decided
+
+    @property
+    def decided_value(self) -> Any:
+        return self._decided_value
+
+    @property
+    def stats(self) -> PaxosStats:
+        return PaxosStats(
+            proposals_started=self._proposals_started,
+            proposals_succeeded=self._proposals_succeeded,
+            proposals_failed=self._proposals_failed,
+            promises_received=self._promises_received,
+            nacks_received=self._nacks_received,
+            accepts_received=self._accepts_received,
+            decided_value=self._decided_value,
+        )
+
+    # -- proposer ----------------------------------------------------------
+    def propose(self, value: Any) -> SimFuture:
+        """Stage a proposal; call ``start_phase1()`` to emit the messages.
+        The future resolves with the DECIDED value (which may differ)."""
+        future: SimFuture = SimFuture()
+        if self._decided:
+            future.resolve(self._decided_value)
+            return future
+        self._proposals_started += 1
+        max_seen = self._current_ballot.number
+        if self._promised_ballot is not None:
+            max_seen = max(max_seen, self._promised_ballot.number)
+        new_number = max_seen + 1
+        self._current_ballot = Ballot(new_number, self.name)
+        self._proposal_futures[new_number] = future
+        self._proposed_values[new_number] = value
+        self._phase1_responses[new_number] = []
+        self._phase2_responses[new_number] = 0
+        return future
+
+    def start_phase1(self) -> list[Event]:
+        ballot = self._current_ballot
+        events = [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="PaxosPrepare",
+                payload={"ballot_number": ballot.number, "ballot_node": ballot.node_id},
+                daemon=False,
+            )
+            for peer in self._peers
+        ]
+        # Self-promise
+        if self._promised_ballot is None or ballot >= self._promised_ballot:
+            self._promised_ballot = ballot
+            if ballot.number in self._phase1_responses:
+                self._phase1_responses[ballot.number].append(
+                    {
+                        "from": self.name,
+                        "accepted_ballot": (
+                            (self._accepted_ballot.number, self._accepted_ballot.node_id)
+                            if self._accepted_ballot
+                            else None
+                        ),
+                        "accepted_value": self._accepted_value,
+                    }
+                )
+                self._promises_received += 1
+                if len(self._phase1_responses[ballot.number]) >= self.quorum_size:
+                    events.extend(self._start_phase2(ballot.number))
+        return events
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        handlers = {
+            "PaxosPrepare": self._handle_prepare,
+            "PaxosPromise": self._handle_promise,
+            "PaxosNack": self._handle_nack,
+            "PaxosAccept": self._handle_accept,
+            "PaxosAccepted": self._handle_accepted,
+            "PaxosDecided": self._handle_decided,
+            "PaxosRetry": self._handle_retry,
+        }
+        handler = handlers.get(event.event_type)
+        return handler(event) if handler else None
+
+    # -- acceptor ----------------------------------------------------------
+    def _nack(self, sender: Entity, ballot: Ballot) -> Event:
+        return self._network.send(
+            source=self,
+            destination=sender,
+            event_type="PaxosNack",
+            payload={
+                "ballot_number": ballot.number,
+                "ballot_node": ballot.node_id,
+                "highest_ballot_number": self._promised_ballot.number,
+                "highest_ballot_node": self._promised_ballot.node_id,
+            },
+            daemon=False,
+        )
+
+    def _handle_prepare(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        ballot = Ballot(meta["ballot_number"], meta["ballot_node"])
+        sender = self._find_peer(meta.get("source"))
+        if sender is None:
+            return []
+        if self._promised_ballot is not None and ballot < self._promised_ballot:
+            return [self._nack(sender, ballot)]
+        self._promised_ballot = ballot
+        return [
+            self._network.send(
+                source=self,
+                destination=sender,
+                event_type="PaxosPromise",
+                payload={
+                    "ballot_number": ballot.number,
+                    "ballot_node": ballot.node_id,
+                    "from": self.name,
+                    "accepted_ballot_number": (
+                        self._accepted_ballot.number if self._accepted_ballot else None
+                    ),
+                    "accepted_ballot_node": (
+                        self._accepted_ballot.node_id if self._accepted_ballot else None
+                    ),
+                    "accepted_value": self._accepted_value,
+                },
+                daemon=False,
+            )
+        ]
+
+    def _handle_accept(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        ballot = Ballot(meta["ballot_number"], meta["ballot_node"])
+        sender = self._find_peer(meta.get("source"))
+        if sender is None:
+            return []
+        if self._promised_ballot is not None and ballot < self._promised_ballot:
+            return [self._nack(sender, ballot)]
+        self._promised_ballot = ballot
+        self._accepted_ballot = ballot
+        self._accepted_value = meta["value"]
+        return [
+            self._network.send(
+                source=self,
+                destination=sender,
+                event_type="PaxosAccepted",
+                payload={
+                    "ballot_number": ballot.number,
+                    "ballot_node": ballot.node_id,
+                    "from": self.name,
+                },
+                daemon=False,
+            )
+        ]
+
+    # -- proposer responses ------------------------------------------------
+    def _handle_promise(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        ballot_number = meta["ballot_number"]
+        if ballot_number not in self._phase1_responses:
+            return []
+        if ballot_number in self._phase2_started:
+            # Phase 2 already launched for this ballot: a late promise must
+            # not recompute the chosen value and re-send Accept with a
+            # DIFFERENT value under the same ballot (value-choice safety).
+            return []
+        accepted_ballot = None
+        if meta.get("accepted_ballot_number") is not None:
+            accepted_ballot = (meta["accepted_ballot_number"], meta["accepted_ballot_node"])
+        self._phase1_responses[ballot_number].append(
+            {
+                "from": meta.get("from"),
+                "accepted_ballot": accepted_ballot,
+                "accepted_value": meta.get("accepted_value"),
+            }
+        )
+        self._promises_received += 1
+        if len(self._phase1_responses[ballot_number]) >= self.quorum_size:
+            return self._start_phase2(ballot_number)
+        return []
+
+    def _handle_nack(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        ballot_number = meta.get("ballot_number")
+        self._nacks_received += 1
+        highest = meta.get("highest_ballot_number", 0)
+        if highest > self._current_ballot.number:
+            self._current_ballot = Ballot(highest, self.name)
+        if ballot_number in self._proposed_values:
+            return [
+                Event(
+                    self.now + self._retry_delay * (1 + self._rng.random()),
+                    "PaxosRetry",
+                    target=self,
+                    daemon=False,
+                    context={"metadata": {"original_ballot": ballot_number}},
+                )
+            ]
+        return []
+
+    def _handle_retry(self, event: Event) -> list[Event]:
+        original = event.context.get("metadata", {}).get("original_ballot")
+        if self._decided or original not in self._proposed_values:
+            return []
+        value = self._proposed_values.pop(original)
+        future = self._proposal_futures.pop(original, None)
+        new_number = self._current_ballot.number + 1
+        self._current_ballot = Ballot(new_number, self.name)
+        if future is not None:
+            self._proposal_futures[new_number] = future
+        self._proposed_values[new_number] = value
+        self._phase1_responses[new_number] = []
+        self._phase2_responses[new_number] = 0
+        return self.start_phase1()
+
+    def _start_phase2(self, ballot_number: int) -> list[Event]:
+        self._phase2_started.add(ballot_number)
+        responses = self._phase1_responses[ballot_number]
+        # Paxos invariant: adopt the value of the highest accepted ballot
+        # among the promises (we may only choose freely if none exists).
+        highest = None
+        chosen_value = self._proposed_values.get(ballot_number)
+        for resp in responses:
+            ab = resp.get("accepted_ballot")
+            if ab is not None and (highest is None or ab > highest):
+                highest = ab
+                chosen_value = resp["accepted_value"]
+        self._proposed_values[ballot_number] = chosen_value
+        ballot = Ballot(ballot_number, self.name)
+        # Self-accept
+        if self._promised_ballot is None or ballot >= self._promised_ballot:
+            self._accepted_ballot = ballot
+            self._accepted_value = chosen_value
+            self._phase2_responses[ballot_number] = 1
+        events = [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="PaxosAccept",
+                payload={
+                    "ballot_number": ballot_number,
+                    "ballot_node": self.name,
+                    "value": chosen_value,
+                },
+                daemon=False,
+            )
+            for peer in self._peers
+        ]
+        if self._phase2_responses.get(ballot_number, 0) >= self.quorum_size:
+            events.extend(self._decide(ballot_number, chosen_value))
+        return events
+
+    def _handle_accepted(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        ballot_number = meta["ballot_number"]
+        self._accepts_received += 1
+        self._phase2_responses[ballot_number] = self._phase2_responses.get(ballot_number, 0) + 1
+        if self._phase2_responses[ballot_number] >= self.quorum_size and not self._decided:
+            return self._decide(ballot_number, self._proposed_values.get(ballot_number))
+        return []
+
+    # -- learner -----------------------------------------------------------
+    def _handle_decided(self, event: Event) -> None:
+        value = event.context.get("metadata", {}).get("value")
+        if not self._decided:
+            self._decided = True
+            self._decided_value = value
+            # A proposal still in flight has lost: its future resolves with
+            # the actually-decided value.
+            for future in self._proposal_futures.values():
+                future.resolve(value)
+            self._proposal_futures.clear()
+        return None
+
+    def _decide(self, ballot_number: int, value: Any) -> list[Event]:
+        if self._decided:
+            return []
+        self._decided = True
+        self._decided_value = value
+        self._proposals_succeeded += 1
+        future = self._proposal_futures.pop(ballot_number, None)
+        if future is not None:
+            future.resolve(value)
+        return [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="PaxosDecided",
+                payload={"value": value},
+                daemon=False,
+            )
+            for peer in self._peers
+        ]
+
+    def _find_peer(self, source_name: Optional[str]) -> Optional[Entity]:
+        for peer in self._peers:
+            if peer.name == source_name:
+                return peer
+        return None
+
+    def __repr__(self) -> str:
+        return f"PaxosNode({self.name}, decided={self._decided}, value={self._decided_value!r})"

@@ -1,0 +1,61 @@
+"""Deterministic state machines driven by a replicated log.
+
+Parity target: ``happysimulator/components/consensus/raft_state_machine.py``
+(``StateMachine`` protocol :14, ``KVStateMachine`` :50 with
+set/get/delete/cas commands).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class StateMachine(Protocol):
+    """Must be deterministic: same command sequence ⇒ same state."""
+
+    def apply(self, command: Any) -> Any: ...
+
+    def snapshot(self) -> Any: ...
+
+    def restore(self, snapshot: Any) -> None: ...
+
+
+class KVStateMachine:
+    """Dict store; commands are ``{"op": set|get|delete|cas, ...}``."""
+
+    def __init__(self):
+        self._data: dict[str, Any] = {}
+
+    def apply(self, command: Any) -> Any:
+        if not isinstance(command, dict) or "op" not in command:
+            raise ValueError(f"Invalid command format: {command!r}")
+        op = command["op"]
+        key = command.get("key")
+        if op == "set":
+            value = command.get("value")
+            self._data[key] = value
+            return value
+        if op == "get":
+            return self._data.get(key)
+        if op == "delete":
+            return self._data.pop(key, None)
+        if op == "cas":
+            if self._data.get(key) == command.get("expected"):
+                self._data[key] = command.get("value")
+                return True
+            return False
+        raise ValueError(f"Unknown op: {op!r}")
+
+    def snapshot(self) -> Any:
+        return dict(self._data)
+
+    def restore(self, snapshot: Any) -> None:
+        self._data = dict(snapshot)
+
+    def get(self, key: str) -> Any:
+        """Direct read for assertions/inspection (not via the log)."""
+        return self._data.get(key)
+
+    def __len__(self) -> int:
+        return len(self._data)

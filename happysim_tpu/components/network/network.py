@@ -194,6 +194,10 @@ class Network(Entity):
         daemon: bool = False,
     ) -> Event:
         """Build an event addressed to this network with routing metadata."""
+        # Register both endpoints so default-link routing can materialize
+        # the per-pair link at delivery time (no explicit add_link needed).
+        self._known_entities[source.name] = source
+        self._known_entities[destination.name] = destination
         metadata = {"source": source.name, "destination": destination.name}
         if payload:
             metadata.update(payload)

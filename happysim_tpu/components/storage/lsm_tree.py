@@ -401,8 +401,12 @@ class LSMTree(Entity):
                     if sst in level:
                         level.remove(sst)
                         break
+            for sst in sstables:
                 for key, _ in sst.scan():
-                    self._logical_data.pop(key, None)
+                    # Only forget keys with no surviving newer copy —
+                    # space-amplification stats must track live data.
+                    if not self._key_still_stored(key):
+                        self._logical_data.pop(key, None)
             self._total_compactions += 1
             return None
         target_level = min(source_level + 1, self._max_levels - 1)
@@ -435,6 +439,16 @@ class LSMTree(Entity):
         if new_sst is not None:
             self._levels[target_level].append(new_sst)
         return new_sst
+
+    def _key_still_stored(self, key: str) -> bool:
+        """Stats-only existence probe (no read counters)."""
+        if self._memtable.contains(key):
+            return True
+        if any(imm.contains(key) for imm in self._immutable_memtables):
+            return True
+        return any(
+            sst.get(key) is not None for level in self._levels for sst in level
+        )
 
     # -- crash / recovery --------------------------------------------------
     def crash(self) -> dict:

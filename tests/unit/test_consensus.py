@@ -1,0 +1,615 @@
+"""Unit tests: consensus (Raft, Paxos family, elections, membership, locks).
+
+Multi-node protocols run inside real simulations over a simulated Network
+with latency — the DES itself is the test cluster (SURVEY.md §4).
+"""
+
+import pytest
+
+from happysim_tpu import ConstantLatency, Entity, Event, Instant, Network, NetworkLink, Simulation
+from happysim_tpu.components.consensus import (
+    Ballot,
+    BullyStrategy,
+    DistributedLock,
+    FlexiblePaxosNode,
+    KVStateMachine,
+    LeaderElection,
+    Log,
+    MemberState,
+    MembershipProtocol,
+    MultiPaxosNode,
+    PaxosNode,
+    PhiAccrualDetector,
+    RaftNode,
+    RaftState,
+    RandomizedStrategy,
+    RingStrategy,
+)
+
+
+def t(seconds):
+    return Instant.from_seconds(seconds)
+
+
+def make_network(latency=0.01):
+    return Network("net", default_link=NetworkLink("link", latency=ConstantLatency(latency)))
+
+
+def wire(nodes):
+    for node in nodes:
+        node.set_peers(nodes)
+
+
+# -------------------------------------------------------------------- Log ----
+class TestLog:
+    def test_append_get_truncate(self):
+        log = Log()
+        log.append(1, "a")
+        log.append(1, "b")
+        log.append(2, "c")
+        assert log.last_index == 3
+        assert log.last_term == 2
+        assert log.get(2).command == "b"
+        assert log.truncate_from(2) == 2
+        assert log.last_index == 1
+
+    def test_advance_commit(self):
+        log = Log()
+        for i in range(5):
+            log.append(1, i)
+        newly = log.advance_commit(3)
+        assert [e.command for e in newly] == [0, 1, 2]
+        assert log.advance_commit(2) == []  # no regress
+        assert log.commit_index == 3
+
+
+# ---------------------------------------------------------- PhiAccrual ----
+class TestPhiAccrual:
+    def test_phi_grows_with_silence(self):
+        det = PhiAccrualDetector(threshold=3.0)
+        for i in range(10):
+            det.heartbeat(float(i))  # steady 1s heartbeats
+        assert det.phi(9.5) < 1.0  # mid-interval: on schedule
+        assert det.phi(15.0) > 3.0  # 5s of silence
+        assert det.is_available(9.5)
+        assert not det.is_available(15.0)
+
+    def test_insufficient_data(self):
+        det = PhiAccrualDetector()
+        assert det.phi(10.0) == 0.0
+
+
+# --------------------------------------------------------------- Raft ----
+def _raft_cluster(n=3, seed_base=100):
+    network = make_network(0.01)
+    nodes = [
+        RaftNode(
+            f"node{chr(ord('a') + i)}",
+            network,
+            election_timeout_min=1.0 + 0.3 * i,  # staggered: node-a wins
+            election_timeout_max=1.1 + 0.3 * i,
+            heartbeat_interval=0.3,
+            seed=seed_base + i,
+        )
+        for i in range(n)
+    ]
+    wire(nodes)
+    return network, nodes
+
+
+class TestRaft:
+    def test_elects_exactly_one_leader(self):
+        network, nodes = _raft_cluster(3)
+        sim = Simulation(entities=[network, *nodes], duration=10.0)
+        for node in nodes:
+            sim.schedule(node.start())
+        sim.run()
+        leaders = [n for n in nodes if n.is_leader]
+        assert len(leaders) == 1
+        leader = leaders[0]
+        assert all(n.current_leader == leader.name for n in nodes)
+        assert all(n.current_term == leader.current_term for n in nodes)
+
+    def test_replicates_and_commits_commands(self):
+        network, nodes = _raft_cluster(3)
+        results = {}
+
+        class Client(Entity):
+            def handle_event(self, event):
+                leader = next((n for n in nodes if n.is_leader), None)
+                if leader is None:
+                    return None
+                future = leader.submit({"op": "set", "key": "x", "value": 42})
+                outcome = yield future
+                results["outcome"] = outcome
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=30.0)
+        for node in nodes:
+            sim.schedule(node.start())
+        sim.schedule(Event(t(5.0), "go", target=client))
+        sim.run()
+        index, value = results["outcome"]
+        assert value == 42
+        # The command reached every node's state machine.
+        committed = [n for n in nodes if n.state_machine.get("x") == 42]
+        assert len(committed) == 3
+        assert all(n.log.commit_index >= index for n in nodes)
+
+    def test_submit_to_follower_rejects(self):
+        network, nodes = _raft_cluster(3)
+        sim = Simulation(entities=[network, *nodes], duration=8.0)
+        for node in nodes:
+            sim.schedule(node.start())
+        sim.run()
+        follower = next(n for n in nodes if not n.is_leader)
+        future = follower.submit({"op": "set", "key": "y", "value": 1})
+        assert future.is_resolved and future.value is None
+
+    def test_reelection_after_leader_crash(self):
+        network, nodes = _raft_cluster(3)
+
+        class Crasher(Entity):
+            def handle_event(self, event):
+                leader = next((n for n in nodes if n.is_leader), None)
+                if leader is not None:
+                    leader._crashed = True  # CrashNode semantics
+                return None
+
+        crasher = Crasher("crasher")
+        sim = Simulation(entities=[network, crasher, *nodes], duration=30.0)
+        for node in nodes:
+            sim.schedule(node.start())
+        sim.schedule(Event(t(6.0), "crash", target=crasher))
+        sim.run()
+        alive = [n for n in nodes if not getattr(n, "_crashed", False)]
+        live_leaders = [n for n in alive if n.is_leader]
+        assert len(live_leaders) == 1  # survivors elected a new leader
+
+
+# -------------------------------------------------------------- Paxos ----
+class TestPaxos:
+    def test_single_proposer_decides(self):
+        network = make_network(0.01)
+        nodes = [PaxosNode(f"p{i}", network, seed=i) for i in range(3)]
+        wire(nodes)
+
+        class Proposer(Entity):
+            def handle_event(self, event):
+                future = nodes[0].propose("value-A")
+                decided = yield future, nodes[0].start_phase1()
+                self.decided = decided
+
+        proposer = Proposer("proposer")
+        sim = Simulation(entities=[network, proposer, *nodes], duration=10.0)
+        sim.schedule(Event(t(0.0), "go", target=proposer))
+        sim.run()
+        assert proposer.decided == "value-A"
+        assert all(n.is_decided for n in nodes)
+        assert all(n.decided_value == "value-A" for n in nodes)
+
+    def test_competing_proposers_agree(self):
+        network = make_network(0.01)
+        nodes = [PaxosNode(f"p{i}", network, retry_delay=0.2, seed=i) for i in range(3)]
+        wire(nodes)
+        outcomes = []
+
+        class Proposer(Entity):
+            def __init__(self, name, node, value):
+                super().__init__(name)
+                self.node = node
+                self.value = value
+
+            def handle_event(self, event):
+                future = self.node.propose(self.value)
+                decided = yield future, self.node.start_phase1()
+                outcomes.append(decided)
+
+        p1 = Proposer("pr1", nodes[0], "A")
+        p2 = Proposer("pr2", nodes[1], "B")
+        sim = Simulation(entities=[network, p1, p2, *nodes], duration=30.0)
+        sim.schedule(Event(t(0.0), "go", target=p1))
+        sim.schedule(Event(t(0.001), "go", target=p2))
+        sim.run()
+        # Safety: everyone decided the SAME value.
+        decided_values = {n.decided_value for n in nodes if n.is_decided}
+        assert len(decided_values) == 1
+        assert decided_values.pop() in {"A", "B"}
+        assert len(outcomes) == 2
+        assert outcomes[0] == outcomes[1]
+
+    def test_ballot_ordering(self):
+        assert Ballot(2, "a") > Ballot(1, "z")
+        assert Ballot(1, "b") > Ballot(1, "a")
+
+
+# --------------------------------------------------------- Multi-Paxos ----
+class TestMultiPaxos:
+    def _cluster(self, n=3):
+        network = make_network(0.01)
+        nodes = [MultiPaxosNode(f"mp{i}", network) for i in range(n)]
+        wire(nodes)
+        return network, nodes
+
+    def test_leader_decides_slot_sequence(self):
+        network, nodes = self._cluster()
+        results = []
+
+        class Client(Entity):
+            def handle_event(self, event):
+                for i in range(3):
+                    future = nodes[0].submit({"op": "set", "key": f"k{i}", "value": i})
+                    outcome = yield future
+                    results.append(outcome)
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=30.0)
+        sim.schedule(nodes[0].start())
+        sim.schedule(Event(t(1.0), "go", target=client))
+        sim.run()
+        assert [slot for slot, _ in results] == [1, 2, 3]
+        assert nodes[0].stats.slots_decided == 3
+        # All nodes learned and applied.
+        for node in nodes:
+            assert node.state_machine.get("k2") == 2
+
+    def test_follower_forwards_to_leader(self):
+        network, nodes = self._cluster()
+        results = []
+
+        class Client(Entity):
+            def handle_event(self, event):
+                future = nodes[1].submit({"op": "set", "key": "fwd", "value": "ok"})
+                outcome = yield future
+                results.append(outcome)
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=30.0)
+        sim.schedule(nodes[0].start())
+        sim.schedule(Event(t(2.0), "go", target=client))
+        sim.run()
+        assert results and results[0] is not None
+        assert nodes[1].stats.forwards == 1
+        assert nodes[0].state_machine.get("fwd") == "ok"
+
+
+# ------------------------------------------------------ Flexible Paxos ----
+class TestFlexiblePaxos:
+    def test_quorum_invariant_enforced(self):
+        network = make_network()
+        nodes = [FlexiblePaxosNode(f"f{i}", network) for i in range(3)]
+        with pytest.raises(ValueError):
+            bad = FlexiblePaxosNode("bad", network, phase1_quorum=1, phase2_quorum=1)
+            bad.set_peers(nodes)
+
+    def test_small_phase2_quorum_commits(self):
+        network = make_network(0.01)
+        nodes = [
+            FlexiblePaxosNode(f"f{i}", network, phase1_quorum=4, phase2_quorum=2)
+            for i in range(5)
+        ]
+        wire(nodes)
+        results = []
+
+        class Client(Entity):
+            def handle_event(self, event):
+                future = nodes[0].submit({"op": "set", "key": "k", "value": 7})
+                outcome = yield future
+                results.append(outcome)
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=30.0)
+        sim.schedule(nodes[0].start())
+        sim.schedule(Event(t(1.0), "go", target=client))
+        sim.run()
+        assert results and results[0][1] == 7
+        assert nodes[0].phase2_quorum == 2
+
+
+# ----------------------------------------------------- Leader election ----
+class TestLeaderElection:
+    def _cluster(self, strategy_factory, n=3):
+        network = make_network(0.01)
+        electors = [
+            LeaderElection(
+                f"n{i}",
+                network,
+                strategy=strategy_factory(i),
+                election_timeout=1.0,
+                heartbeat_interval=0.3,
+            )
+            for i in range(n)
+        ]
+        for elector in electors:
+            for other in electors:
+                if other is not elector:
+                    elector.add_member(other)
+        return network, electors
+
+    def test_bully_highest_id_wins(self):
+        network, electors = self._cluster(lambda i: BullyStrategy())
+        sim = Simulation(entities=[network, *electors], duration=15.0)
+        for e in electors:
+            sim.schedule(e.start())
+        sim.run()
+        # n2 (highest name) must be the agreed leader.
+        assert all(e.current_leader == "n2" for e in electors)
+
+    def test_ring_elects_max(self):
+        network, electors = self._cluster(lambda i: RingStrategy())
+        sim = Simulation(entities=[network, *electors], duration=15.0)
+        for e in electors:
+            sim.schedule(e.start())
+        sim.run()
+        leaders = {e.current_leader for e in electors}
+        assert leaders == {"n2"}
+
+    def test_randomized_converges(self):
+        network, electors = self._cluster(lambda i: RandomizedStrategy(seed=i))
+        sim = Simulation(entities=[network, *electors], duration=20.0)
+        for e in electors:
+            sim.schedule(e.start())
+        sim.run()
+        leaders = {e.current_leader for e in electors}
+        assert len(leaders) == 1 and None not in leaders
+
+
+# --------------------------------------------------------- Membership ----
+class TestMembership:
+    def test_all_alive_under_steady_probing(self):
+        network = make_network(0.005)
+        protos = [
+            MembershipProtocol(f"m{i}", network, probe_interval=0.5, seed=i)
+            for i in range(3)
+        ]
+        for p in protos:
+            for other in protos:
+                p.add_member(other)
+        sim = Simulation(entities=[network, *protos], duration=20.0)
+        for p in protos:
+            sim.schedule(p.start())
+        sim.run()
+        for p in protos:
+            assert len(p.alive_members) == 2
+            assert p.stats.dead_count == 0
+            assert p.stats.probes_sent > 10
+
+    def test_crashed_member_declared_dead(self):
+        network = make_network(0.005)
+        protos = [
+            MembershipProtocol(
+                f"m{i}", network, probe_interval=0.5, suspicion_timeout=2.0,
+                phi_threshold=3.0, seed=i,
+            )
+            for i in range(3)
+        ]
+        for p in protos:
+            for other in protos:
+                p.add_member(other)
+
+        class Crasher(Entity):
+            def handle_event(self, event):
+                protos[2]._crashed = True
+                return None
+
+        crasher = Crasher("crasher")
+        sim = Simulation(entities=[network, crasher, *protos], duration=60.0)
+        for p in protos:
+            sim.schedule(p.start())
+        sim.schedule(Event(t(10.0), "crash", target=crasher))
+        sim.run()
+        # The two survivors eventually declare m2 dead.
+        assert protos[0].get_member_state("m2") == MemberState.DEAD
+        assert protos[1].get_member_state("m2") == MemberState.DEAD
+
+
+# ---------------------------------------------------- Distributed lock ----
+class TestDistributedLock:
+    def test_fencing_tokens_increase(self):
+        lock = DistributedLock("locks", lease_duration=10.0)
+
+        class Worker(Entity):
+            def __init__(self, name):
+                super().__init__(name)
+                self.tokens = []
+
+            def handle_event(self, event):
+                grant = yield lock.acquire("resource", self.name)
+                self.tokens.append(grant.fencing_token)
+                yield 0.5
+                lock.release("resource", grant.fencing_token)
+
+        w1, w2 = Worker("w1"), Worker("w2")
+        sim = Simulation(entities=[lock, w1, w2], duration=30.0)
+        sim.schedule(Event(t(0.0), "go", target=w1))
+        sim.schedule(Event(t(0.1), "go", target=w2))
+        sim.run()
+        assert w1.tokens == [1]
+        assert w2.tokens == [2]  # strictly increasing across grants
+
+    def test_lease_expiry_hands_over(self):
+        lock = DistributedLock("locks", lease_duration=1.0)
+        grants = {}
+
+        class Hog(Entity):
+            def handle_event(self, event):
+                grant = yield lock.acquire("resource", self.name)
+                grants["hog"] = grant
+                yield 60.0  # never releases — lease must expire
+                return None
+
+        class Waiter(Entity):
+            def handle_event(self, event):
+                grant = yield lock.acquire("resource", self.name)
+                grants["waiter"] = (grant, round(self.now.to_seconds(), 2))
+
+        hog, waiter = Hog("hog"), Waiter("waiter")
+        sim = Simulation(entities=[lock, hog, waiter], duration=120.0)
+        sim.schedule(Event(t(0.0), "go", target=hog))
+        sim.schedule(Event(t(0.1), "go", target=waiter))
+        sim.run()
+        grant, at = grants["waiter"]
+        assert at == pytest.approx(1.0, abs=0.01)  # handover at lease expiry
+        assert grant.fencing_token > grants["hog"].fencing_token
+        # Hog's lease expired (handover), and the waiter's own unreleased
+        # lease expires later too.
+        assert lock.stats.expirations >= 1
+
+    def test_reentrant_and_stale_release(self):
+        lock = DistributedLock("locks", lease_duration=100.0)
+        g1 = lock.try_acquire("r", "me")
+        g2 = lock.try_acquire("r", "me")  # reentrant: same token
+        assert g1.fencing_token == g2.fencing_token
+        assert lock.try_acquire("r", "other") is None
+        assert not lock.release("r", 999)  # stale token rejected
+        assert lock.release("r", g1.fencing_token)
+
+    def test_max_waiters_rejection(self):
+        lock = DistributedLock("locks", max_waiters=1)
+        lock.try_acquire("r", "holder")
+        f1 = lock.acquire("r", "w1")  # queued
+        f2 = lock.acquire("r", "w2")  # rejected
+        assert not f1.is_resolved
+        assert f2.is_resolved and f2.value is None
+        assert lock.stats.rejections == 1
+
+
+class TestConsensusSafetyRegressions:
+    def test_raft_no_double_vote_same_term(self):
+        """An AppendEntries at the CURRENT term must not clear voted_for
+        (a node could otherwise vote for two candidates in one term)."""
+        network = make_network(0.01)
+        node = RaftNode("n", network, seed=1)
+        node._current_term = 5
+        node._voted_for = "candidate_a"
+        node._step_down(5)  # same term: heartbeat from the term-5 leader
+        assert node._voted_for == "candidate_a"
+        node._step_down(6)  # term advance: vote resets
+        assert node._voted_for is None
+
+    def test_raft_match_index_excludes_stale_suffix(self):
+        """A follower with stale extra entries must not report them as
+        matched — the leader would commit entries the follower lacks."""
+        network = make_network(0.01)
+        nodes = [RaftNode(f"n{i}", network, seed=i) for i in range(2)]
+        wire(nodes)
+        follower = nodes[0]
+        leader_peer = nodes[1]
+        # Follower has 3 entries; 2-3 from a stale term.
+        follower._log.append(1, "a")
+        follower._log.append(2, "stale1")
+        follower._log.append(2, "stale2")
+        follower._current_term = 3
+        # Leader (term 4) sends an empty heartbeat consistent at prefix 1.
+        event = Event(
+            t(0.0),
+            "RaftAppendEntries",
+            target=follower,
+            context={
+                "metadata": {
+                    "term": 4,
+                    "leader_id": "n1",
+                    "source": "n1",
+                    "prev_log_index": 1,
+                    "prev_log_term": 1,
+                    "entries": [],
+                    "leader_commit": 0,
+                }
+            },
+        )
+        sim = Simulation(entities=[network, *nodes], duration=1.0)
+        sim.schedule(event)
+        sim.run()
+        # The response's match_index must be 1 (verified prefix), not 3.
+        # We can't intercept the message easily; assert via leader's view:
+        # replay the handler directly for a white-box check.
+        produced = follower._handle_append_entries(event)
+        response = [e for e in produced if e.event_type == "RaftAppendEntriesResponse"]
+        assert response
+        assert response[0].context["metadata"]["match_index"] == 1
+
+    def test_paxos_late_promise_does_not_change_value(self):
+        """A promise arriving after Phase 2 started must not rewrite the
+        proposed value for that ballot."""
+        from happysim_tpu.core.clock import Clock
+
+        network = make_network(0.01)
+        nodes = [PaxosNode(f"p{i}", network, seed=i) for i in range(5)]
+        wire(nodes)
+        clock = Clock()
+        for entity in (network, *nodes):
+            entity.set_clock(clock)
+        proposer = nodes[0]
+        future = proposer.propose("X")
+        ballot_number = proposer._current_ballot.number
+        # Simulate quorum of empty promises -> phase 2 starts with X.
+        proposer._phase1_responses[ballot_number] = [
+            {"from": f"p{i}", "accepted_ballot": None, "accepted_value": None}
+            for i in range(3)
+        ]
+        proposer._start_phase2(ballot_number)
+        assert proposer._proposed_values[ballot_number] == "X"
+        # Late promise reports a previously accepted value Y.
+        late = Event(
+            t(0.0),
+            "PaxosPromise",
+            target=proposer,
+            context={
+                "metadata": {
+                    "ballot_number": ballot_number,
+                    "from": "p4",
+                    "accepted_ballot_number": 99,
+                    "accepted_ballot_node": "p4",
+                    "accepted_value": "Y",
+                }
+            },
+        )
+        produced = proposer._handle_promise(late)
+        assert produced == []  # ignored
+        assert proposer._proposed_values[ballot_number] == "X"  # unchanged
+
+    def test_swim_indirect_probe_saves_reachable_member(self):
+        """A member unreachable directly but reachable via delegates must
+        NOT be declared dead (indirect probing actually works)."""
+        network = make_network(0.005)
+        protos = [
+            MembershipProtocol(
+                f"m{i}", network, probe_interval=0.5, suspicion_timeout=2.0,
+                phi_threshold=8.0, seed=i,
+            )
+            for i in range(3)
+        ]
+        for p in protos:
+            for other in protos:
+                p.add_member(other)
+        # Partition ONLY the m0 <-> m2 path; m1 can reach both.
+        network.partition([protos[0]], [protos[2]])
+        sim = Simulation(entities=[network, *protos], duration=40.0)
+        for p in protos:
+            sim.schedule(p.start())
+        sim.run()
+        # m0 cannot ping m2 directly, but delegate m1 relays: m2 stays alive.
+        assert protos[0].get_member_state("m2") != MemberState.DEAD
+        assert protos[0].stats.indirect_probes_sent > 0
+
+    def test_bully_contested_startup_converges_on_heartbeats(self):
+        """Simultaneous elections must not leave a follower with a term
+        above the leader's (it would reject heartbeats forever)."""
+        network = make_network(0.01)
+        electors = [
+            LeaderElection(f"n{i}", network, strategy=BullyStrategy(),
+                           election_timeout=1.0, heartbeat_interval=0.3)
+            for i in range(3)
+        ]
+        for e in electors:
+            for o in electors:
+                if o is not e:
+                    e.add_member(o)
+        sim = Simulation(entities=[network, *electors], duration=30.0)
+        for e in electors:
+            sim.schedule(e.start())
+        sim.run()
+        assert all(e.current_leader == "n2" for e in electors)
+        # Followers stay in sync with the leader's term (no runaway).
+        leader_term = next(e.current_term for e in electors if e.is_leader)
+        assert all(abs(e.current_term - leader_term) <= 1 for e in electors)
