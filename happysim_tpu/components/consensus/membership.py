@@ -16,6 +16,7 @@ from typing import Any, Optional
 
 from happysim_tpu.components.consensus.phi_accrual_detector import PhiAccrualDetector
 from happysim_tpu.core.entity import Entity
+from happysim_tpu.utils.stats import stable_seed
 from happysim_tpu.core.event import Event
 
 logger = logging.getLogger(__name__)
@@ -69,7 +70,7 @@ class MembershipProtocol(Entity):
         self._suspicion_timeout = suspicion_timeout
         self._indirect_probe_count = indirect_probe_count
         self._phi_threshold = phi_threshold
-        self._rng = random.Random(seed if seed is not None else hash(name) & 0xFFFF)
+        self._rng = random.Random(seed if seed is not None else stable_seed(name))
         self._members: dict[str, MemberInfo] = {}
         self._incarnation = 0
         self._pending_updates: list[dict[str, Any]] = []

@@ -245,7 +245,10 @@ class MultiPaxosNode(Entity):
         events: list[Event] = []
         for slot, (_b, value) in sorted(merged.items()):
             self._slot_values[slot] = value
-            self._slot_acks[slot] = 0
+            # Self-accept the recovered value: the new leader counts toward
+            # its own phase-2 quorum, same as freshly assigned slots.
+            self._accepted[slot] = (self._ballot, value)
+            self._slot_acks[slot] = 1
             self._next_slot = max(self._next_slot, slot + 1)
             events.extend(self._replicate_slot(slot))
         events.extend(self._send_heartbeat())

@@ -14,6 +14,7 @@ from dataclasses import dataclass
 from typing import Any, Optional
 
 from happysim_tpu.core.entity import Entity
+from happysim_tpu.utils.stats import stable_seed
 from happysim_tpu.core.event import Event
 from happysim_tpu.core.sim_future import SimFuture
 
@@ -54,7 +55,7 @@ class PaxosNode(Entity):
         self._network = network
         self._peers: list[PaxosNode] = [p for p in (peers or []) if p.name != name]
         self._retry_delay = retry_delay
-        self._rng = random.Random(seed if seed is not None else hash(name) & 0xFFFF)
+        self._rng = random.Random(seed if seed is not None else stable_seed(name))
         # Acceptor state
         self._promised_ballot: Optional[Ballot] = None
         self._accepted_ballot: Optional[Ballot] = None

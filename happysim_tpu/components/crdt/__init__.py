@@ -1,0 +1,21 @@
+"""CRDT components — convergent replicated data types + gossip store.
+
+Parity target: ``happysimulator/components/crdt/`` (SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.crdt.crdt_store import CRDTStore, CRDTStoreStats
+from happysim_tpu.components.crdt.g_counter import GCounter
+from happysim_tpu.components.crdt.lww_register import LWWRegister
+from happysim_tpu.components.crdt.or_set import ORSet
+from happysim_tpu.components.crdt.pn_counter import PNCounter
+from happysim_tpu.components.crdt.protocol import CRDT
+
+__all__ = [
+    "CRDT",
+    "CRDTStore",
+    "CRDTStoreStats",
+    "GCounter",
+    "LWWRegister",
+    "ORSet",
+    "PNCounter",
+]

@@ -1,0 +1,247 @@
+"""CRDT store entity: keyed CRDTs synced by periodic gossip.
+
+Parity target: ``happysimulator/components/crdt/crdt_store.py:68``
+(Write/Read events, gossip tick → push state to a random peer → peer
+merges and responds with its state, convergence via state hashes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.components.crdt.g_counter import GCounter
+from happysim_tpu.components.crdt.lww_register import LWWRegister
+from happysim_tpu.components.crdt.or_set import ORSet
+from happysim_tpu.components.crdt.pn_counter import PNCounter
+from happysim_tpu.components.crdt.protocol import CRDT
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.utils.stats import stable_seed
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+from happysim_tpu.core.temporal import Instant
+
+_CRDT_TYPES = {
+    "g_counter": GCounter,
+    "pn_counter": PNCounter,
+    "lww_register": LWWRegister,
+    "or_set": ORSet,
+}
+
+
+@dataclass(frozen=True)
+class CRDTStoreStats:
+    writes: int = 0
+    reads: int = 0
+    gossip_rounds: int = 0
+    merges: int = 0
+    gossip_bytes: int = 0
+
+
+class CRDTStore(Entity):
+    """Node-local CRDT map; ``crdt_factory`` decides each key's type
+    (default PNCounter)."""
+
+    def __init__(
+        self,
+        name: str,
+        network: Any,
+        peers: Optional[list[Entity]] = None,
+        crdt_factory: Any = None,
+        gossip_interval: float = 1.0,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(name)
+        self._network = network
+        self._peers: list[Entity] = list(peers or [])
+        self._crdt_factory = crdt_factory or (lambda node_id: PNCounter(node_id))
+        self._gossip_interval = gossip_interval
+        self._rng = random.Random(seed if seed is not None else stable_seed(name))
+        self._crdts: dict[str, CRDT] = {}
+        self._writes = 0
+        self._reads = 0
+        self._gossip_rounds = 0
+        self._merges = 0
+        self._gossip_bytes = 0
+
+    # -- wiring ------------------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._peers)
+
+    def add_peers(self, peers: list[Entity]) -> None:
+        for peer in peers:
+            if peer.name != self.name and peer not in self._peers:
+                self._peers.append(peer)
+
+    @property
+    def crdts(self) -> dict[str, CRDT]:
+        return dict(self._crdts)
+
+    @property
+    def stats(self) -> CRDTStoreStats:
+        return CRDTStoreStats(
+            writes=self._writes,
+            reads=self._reads,
+            gossip_rounds=self._gossip_rounds,
+            merges=self._merges,
+            gossip_bytes=self._gossip_bytes,
+        )
+
+    def state_hash(self) -> str:
+        """Convergence check: equal hashes ⇒ replicas agree.
+
+        The local replica's ``node_id`` is stripped — it identifies the
+        holder, not the (convergent) state.
+        """
+
+        def strip(obj):
+            if isinstance(obj, dict):
+                return {k: strip(v) for k, v in sorted(obj.items()) if k != "node_id"}
+            return obj
+
+        payload = json.dumps(
+            {k: strip(c.to_dict()) for k, c in sorted(self._crdts.items())},
+            sort_keys=True,
+            default=str,
+        )
+        return hashlib.sha1(payload.encode()).hexdigest()
+
+    def get_or_create(self, key: str) -> CRDT:
+        if key not in self._crdts:
+            self._crdts[key] = self._crdt_factory(self.name)
+        return self._crdts[key]
+
+    def get_gossip_event(self) -> Optional[Event]:
+        """Kick the periodic gossip loop (schedule on the sim)."""
+        if not self._peers:
+            return None
+        at = self.now if self._clock else Instant.Epoch
+        return Event(at, "CRDTGossipTick", target=self, daemon=True)
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        event_type = event.event_type
+        if event_type == "Write":
+            return self._handle_write(event)
+        if event_type == "Read":
+            return self._handle_read(event)
+        if event_type == "CRDTGossipTick":
+            return self._handle_gossip_tick(event)
+        if event_type == "CRDTGossipPush":
+            return self._handle_gossip_push(event)
+        if event_type == "CRDTGossipResponse":
+            return self._handle_gossip_response(event)
+        return None
+
+    # -- client ops --------------------------------------------------------
+    def _handle_write(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        crdt = self.get_or_create(meta["key"])
+        self._apply_operation(crdt, meta.get("operation", "increment"), meta.get("value"))
+        self._writes += 1
+        reply: Optional[SimFuture] = meta.get("reply_future") or event.context.get(
+            "reply_future"
+        )
+        if reply is not None:
+            reply.resolve({"status": "ok"})
+        return None
+
+    def _handle_read(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        self._reads += 1
+        crdt = self._crdts.get(meta["key"])
+        reply = meta.get("reply_future") or event.context.get("reply_future")
+        if reply is not None:
+            reply.resolve(crdt.value if crdt is not None else None)
+        return None
+
+    def _apply_operation(self, crdt: CRDT, operation: str, value: Any) -> None:
+        if operation == "increment":
+            crdt.increment(value if value is not None else 1)
+        elif operation == "decrement":
+            crdt.decrement(value if value is not None else 1)
+        elif operation == "set":
+            crdt.set(value, self.now.to_seconds() if self._clock else 0.0)
+        elif operation == "add":
+            crdt.add(value)
+        elif operation == "remove":
+            crdt.remove(value)
+        else:
+            raise ValueError(f"Unknown CRDT operation: {operation!r}")
+
+    # -- gossip ------------------------------------------------------------
+    def _handle_gossip_tick(self, event: Event) -> list[Event]:
+        events: list[Event] = []
+        if self._peers and self._crdts:
+            peer = self._rng.choice(self._peers)
+            state = self._serialize_state()
+            self._gossip_rounds += 1
+            self._gossip_bytes += len(json.dumps(state, default=str))
+            events.append(
+                self._network.send(
+                    source=self,
+                    destination=peer,
+                    event_type="CRDTGossipPush",
+                    payload={"state": state},
+                    daemon=True,
+                )
+            )
+        events.append(
+            Event(
+                self.now + self._gossip_interval, "CRDTGossipTick", target=self, daemon=True
+            )
+        )
+        return events
+
+    def _handle_gossip_push(self, event: Event) -> list[Event]:
+        meta = event.context.get("metadata", {})
+        self._merge_remote_state(meta.get("state", {}))
+        sender = meta.get("source")
+        peer = next((p for p in self._peers if p.name == sender), None)
+        if peer is None:
+            return []
+        return [
+            self._network.send(
+                source=self,
+                destination=peer,
+                event_type="CRDTGossipResponse",
+                payload={"state": self._serialize_state()},
+                daemon=True,
+            )
+        ]
+
+    def _handle_gossip_response(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        self._merge_remote_state(meta.get("state", {}))
+        return None
+
+    def _serialize_state(self) -> dict:
+        return {key: crdt.to_dict() for key, crdt in self._crdts.items()}
+
+    def _merge_remote_state(self, remote_state: dict) -> None:
+        for key, data in remote_state.items():
+            remote = self._reconstruct(data)
+            if remote is None:
+                continue
+            if key in self._crdts:
+                self._crdts[key].merge(remote)
+            else:
+                # Rebase onto our own node id, then merge the remote state.
+                local = self._crdt_factory(self.name)
+                if type(local) is type(remote):
+                    local.merge(remote)
+                    self._crdts[key] = local
+                else:
+                    self._crdts[key] = remote
+            self._merges += 1
+
+    @staticmethod
+    def _reconstruct(data: dict) -> Optional[CRDT]:
+        crdt_cls = _CRDT_TYPES.get(data.get("type", ""))
+        return crdt_cls.from_dict(data) if crdt_cls else None
+
+    def __repr__(self) -> str:
+        return f"CRDTStore({self.name}, keys={len(self._crdts)})"

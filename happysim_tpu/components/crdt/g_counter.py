@@ -1,0 +1,53 @@
+"""Grow-only counter CRDT.
+
+Parity target: ``happysimulator/components/crdt/g_counter.py:26``
+(per-node counts, value = sum, merge = element-wise max).
+"""
+
+from __future__ import annotations
+
+
+class GCounter:
+    """Increment-only; total = sum of per-node counts."""
+
+    __slots__ = ("_node_id", "_counts")
+
+    def __init__(self, node_id: str):
+        self._node_id = node_id
+        self._counts: dict[str, int] = {}
+
+    @property
+    def node_id(self) -> str:
+        return self._node_id
+
+    @property
+    def value(self) -> int:
+        return sum(self._counts.values())
+
+    def increment(self, n: int = 1) -> None:
+        if n < 1:
+            raise ValueError(f"Increment must be positive, got {n}")
+        self._counts[self._node_id] = self._counts.get(self._node_id, 0) + n
+
+    def node_value(self, node_id: str) -> int:
+        return self._counts.get(node_id, 0)
+
+    def merge(self, other: "GCounter") -> None:
+        for node, count in other._counts.items():
+            if count > self._counts.get(node, 0):
+                self._counts[node] = count
+
+    def to_dict(self) -> dict:
+        return {"type": "g_counter", "node_id": self._node_id, "counts": dict(self._counts)}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "GCounter":
+        counter = cls(data["node_id"])
+        counter._counts = dict(data.get("counts", {}))
+        return counter
+
+    def __repr__(self) -> str:
+        return f"GCounter({self._node_id}, value={self.value})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, GCounter) and self._counts == other._counts

@@ -1,0 +1,99 @@
+"""Last-writer-wins register CRDT.
+
+Parity target: ``happysimulator/components/crdt/lww_register.py:23``
+(HLC or float timestamps; merge keeps the newest, node_id breaks ties).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from happysim_tpu.core.logical_clocks import HLCTimestamp
+
+Timestamp = Union[float, HLCTimestamp]
+
+
+def _order_key(ts: Optional[Timestamp], node_id: str) -> tuple:
+    if ts is None:
+        return (-1, -1, node_id)
+    if isinstance(ts, HLCTimestamp):
+        return (ts.wall, ts.logical, node_id)
+    return (ts, 0, node_id)
+
+
+class LWWRegister:
+    """Single value with a write timestamp; highest timestamp wins."""
+
+    __slots__ = ("_node_id", "_value", "_timestamp", "_writer")
+
+    def __init__(self, node_id: str, value: Any = None, timestamp: Optional[Timestamp] = None):
+        self._node_id = node_id
+        self._value = value
+        self._timestamp = timestamp
+        self._writer = node_id
+
+    @property
+    def node_id(self) -> str:
+        return self._node_id
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    @property
+    def timestamp(self) -> Optional[Timestamp]:
+        return self._timestamp
+
+    def get(self) -> Any:
+        return self._value
+
+    def set(self, value: Any, timestamp: Timestamp) -> None:
+        if self._timestamp is None or _order_key(timestamp, self._node_id) >= _order_key(
+            self._timestamp, self._writer
+        ):
+            self._value = value
+            self._timestamp = timestamp
+            self._writer = self._node_id
+
+    def merge(self, other: "LWWRegister") -> None:
+        if _order_key(other._timestamp, other._writer) > _order_key(
+            self._timestamp, self._writer
+        ):
+            self._value = other._value
+            self._timestamp = other._timestamp
+            self._writer = other._writer
+
+    def to_dict(self) -> dict:
+        ts = self._timestamp
+        if isinstance(ts, HLCTimestamp):
+            ts_data = {"kind": "hlc", "wall": ts.wall, "logical": ts.logical}
+        else:
+            ts_data = {"kind": "float", "value": ts}
+        return {
+            "type": "lww_register",
+            "node_id": self._node_id,
+            "value": self._value,
+            "timestamp": ts_data,
+            "writer": self._writer,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "LWWRegister":
+        ts_data = data.get("timestamp", {"kind": "float", "value": None})
+        if ts_data.get("kind") == "hlc":
+            ts: Optional[Timestamp] = HLCTimestamp(ts_data["wall"], ts_data["logical"])
+        else:
+            ts = ts_data.get("value")
+        register = cls(data["node_id"], value=data.get("value"), timestamp=ts)
+        register._writer = data.get("writer", data["node_id"])
+        return register
+
+    def __repr__(self) -> str:
+        return f"LWWRegister({self._node_id}, value={self._value!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, LWWRegister)
+            and self._value == other._value
+            and self._timestamp == other._timestamp
+        )

@@ -1,0 +1,104 @@
+"""Observed-remove set CRDT.
+
+Parity target: ``happysimulator/components/crdt/or_set.py:26``
+(unique tags per add; remove tombstones only OBSERVED tags, so a
+concurrent re-add survives — add-wins semantics).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterator
+
+
+class ORSet:
+    """Set supporting concurrent add/remove with add-wins bias."""
+
+    __slots__ = ("_node_id", "_adds", "_removes", "_tag_counter")
+
+    def __init__(self, node_id: str):
+        self._node_id = node_id
+        # element -> set of unique add-tags
+        self._adds: dict[Any, set[str]] = {}
+        # element -> set of removed (observed) tags
+        self._removes: dict[Any, set[str]] = {}
+        self._tag_counter = itertools.count()
+
+    @property
+    def node_id(self) -> str:
+        return self._node_id
+
+    def _live_tags(self, element: Any) -> set[str]:
+        return self._adds.get(element, set()) - self._removes.get(element, set())
+
+    @property
+    def value(self) -> frozenset:
+        return frozenset(e for e in self._adds if self._live_tags(e))
+
+    @property
+    def elements(self) -> frozenset:
+        return self.value
+
+    def add(self, element: Any) -> None:
+        tag = f"{self._node_id}:{next(self._tag_counter)}"
+        self._adds.setdefault(element, set()).add(tag)
+
+    def remove(self, element: Any) -> None:
+        """Tombstone the tags observed NOW; a concurrent add's unseen tag
+        survives the merge (add wins)."""
+        observed = self._adds.get(element)
+        if observed:
+            self._removes.setdefault(element, set()).update(observed)
+
+    def contains(self, element: Any) -> bool:
+        return bool(self._live_tags(element))
+
+    def merge(self, other: "ORSet") -> None:
+        for element, tags in other._adds.items():
+            self._adds.setdefault(element, set()).update(tags)
+        for element, tags in other._removes.items():
+            self._removes.setdefault(element, set()).update(tags)
+
+    def to_dict(self) -> dict:
+        return {
+            "type": "or_set",
+            "node_id": self._node_id,
+            "adds": {repr(e): sorted(tags) for e, tags in self._adds.items()},
+            "elements": {repr(e): e for e in self._adds},
+            "removes": {repr(e): sorted(tags) for e, tags in self._removes.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ORSet":
+        or_set = cls(data["node_id"])
+        elements = data.get("elements", {})
+        for key, tags in data.get("adds", {}).items():
+            or_set._adds[elements.get(key, key)] = set(tags)
+        for key, tags in data.get("removes", {}).items():
+            or_set._removes[elements.get(key, key)] = set(tags)
+        # Resume the tag counter PAST any of our own tags already present —
+        # restarting at 0 would mint tags colliding with tombstoned ones,
+        # making fresh adds invisible.
+        max_idx = -1
+        for tags in list(or_set._adds.values()) + list(or_set._removes.values()):
+            for tag in tags:
+                node, _, idx = tag.rpartition(":")
+                if node == or_set._node_id and idx.isdigit():
+                    max_idx = max(max_idx, int(idx))
+        or_set._tag_counter = itertools.count(max_idx + 1)
+        return or_set
+
+    def __contains__(self, element: Any) -> bool:
+        return self.contains(element)
+
+    def __len__(self) -> int:
+        return len(self.value)
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self.value)
+
+    def __repr__(self) -> str:
+        return f"ORSet({self._node_id}, {set(self.value)!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, ORSet) and self.value == other.value

@@ -1,0 +1,70 @@
+"""Positive-negative counter CRDT (two G-Counters).
+
+Parity target: ``happysimulator/components/crdt/pn_counter.py:22``.
+"""
+
+from __future__ import annotations
+
+from happysim_tpu.components.crdt.g_counter import GCounter
+
+
+class PNCounter:
+    """Increment/decrement; value = increments − decrements."""
+
+    __slots__ = ("_node_id", "_pos", "_neg")
+
+    def __init__(self, node_id: str):
+        self._node_id = node_id
+        self._pos = GCounter(node_id)
+        self._neg = GCounter(node_id)
+
+    @property
+    def node_id(self) -> str:
+        return self._node_id
+
+    @property
+    def value(self) -> int:
+        return self._pos.value - self._neg.value
+
+    @property
+    def increments(self) -> int:
+        return self._pos.value
+
+    @property
+    def decrements(self) -> int:
+        return self._neg.value
+
+    def increment(self, n: int = 1) -> None:
+        self._pos.increment(n)
+
+    def decrement(self, n: int = 1) -> None:
+        self._neg.increment(n)
+
+    def merge(self, other: "PNCounter") -> None:
+        self._pos.merge(other._pos)
+        self._neg.merge(other._neg)
+
+    def to_dict(self) -> dict:
+        return {
+            "type": "pn_counter",
+            "node_id": self._node_id,
+            "pos": self._pos.to_dict(),
+            "neg": self._neg.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PNCounter":
+        counter = cls(data["node_id"])
+        counter._pos = GCounter.from_dict(data["pos"])
+        counter._neg = GCounter.from_dict(data["neg"])
+        return counter
+
+    def __repr__(self) -> str:
+        return f"PNCounter({self._node_id}, value={self.value})"
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, PNCounter)
+            and self._pos == other._pos
+            and self._neg == other._neg
+        )

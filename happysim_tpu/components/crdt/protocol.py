@@ -1,0 +1,23 @@
+"""CRDT protocol: state-based (CvRDT) merge contract.
+
+Parity target: ``happysimulator/components/crdt/protocol.py:21``.
+Merge must be commutative, associative, and idempotent — replicas
+converge regardless of delivery order or duplication.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class CRDT(Protocol):
+    @property
+    def value(self) -> Any: ...
+
+    def merge(self, other: "CRDT") -> None: ...
+
+    def to_dict(self) -> dict: ...
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "CRDT": ...

@@ -1,0 +1,43 @@
+"""Replication components — primary-backup, chain, multi-leader.
+
+Parity target: ``happysimulator/components/replication/`` (SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.replication.chain_replication import (
+    ChainNode,
+    ChainNodeRole,
+    ChainReplicationStats,
+)
+from happysim_tpu.components.replication.conflict_resolver import (
+    ConflictResolver,
+    CustomResolver,
+    LastWriterWins,
+    VectorClockMerge,
+    VersionedValue,
+)
+from happysim_tpu.components.replication.multi_leader import LeaderNode, MultiLeaderStats
+from happysim_tpu.components.replication.primary_backup import (
+    BackupNode,
+    BackupStats,
+    PrimaryBackupStats,
+    PrimaryNode,
+    ReplicationMode,
+)
+
+__all__ = [
+    "BackupNode",
+    "BackupStats",
+    "ChainNode",
+    "ChainNodeRole",
+    "ChainReplicationStats",
+    "ConflictResolver",
+    "CustomResolver",
+    "LastWriterWins",
+    "LeaderNode",
+    "MultiLeaderStats",
+    "PrimaryBackupStats",
+    "PrimaryNode",
+    "ReplicationMode",
+    "VectorClockMerge",
+    "VersionedValue",
+]

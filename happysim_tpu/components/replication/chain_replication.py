@@ -1,0 +1,261 @@
+"""Chain replication (van Renesse & Schneider) with optional CRAQ reads.
+
+Parity target: ``happysimulator/components/replication/chain_replication.py:73``
+(writes enter at HEAD, propagate down the chain, TAIL acks back to HEAD;
+reads at TAIL for strong consistency; CRAQ mode lets intermediate nodes
+serve clean keys locally and forward dirty-key reads to the tail).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+from happysim_tpu.components.datastore.kv_store import KVStore
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+
+logger = logging.getLogger(__name__)
+
+
+class ChainNodeRole(Enum):
+    HEAD = "head"
+    MIDDLE = "middle"
+    TAIL = "tail"
+
+
+@dataclass(frozen=True)
+class ChainReplicationStats:
+    writes_received: int = 0
+    propagations_sent: int = 0
+    propagations_received: int = 0
+    acks_sent: int = 0
+    reads: int = 0
+    dirty_reads_forwarded: int = 0
+
+
+class ChainNode(Entity):
+    """One link of the chain. Wire with ``link_chain([head, ..., tail])``."""
+
+    def __init__(
+        self,
+        name: str,
+        store: KVStore,
+        network: Entity,
+        role: ChainNodeRole = ChainNodeRole.MIDDLE,
+        craq_enabled: bool = False,
+    ):
+        super().__init__(name)
+        self._store = store
+        self._network = network
+        self._role = role
+        self._craq_enabled = craq_enabled
+        self.next_node: Optional[ChainNode] = None
+        self.prev_node: Optional[ChainNode] = None
+        self.head_node: Optional[ChainNode] = None
+        self._next_seq = 0
+        self._pending_writes: dict[int, SimFuture] = {}
+        # CRAQ: per-key count of in-flight (uncommitted) writes — a key is
+        # dirty while ANY write to it is uncommitted; a set would mark it
+        # clean when an OLDER write completes under a newer in-flight one.
+        self._dirty_counts: dict[str, int] = {}
+        self._key_seq: dict[str, int] = {}  # per-key ordering guard
+        self._writes_received = 0
+        self._propagations_sent = 0
+        self._propagations_received = 0
+        self._acks_sent = 0
+        self._reads = 0
+        self._dirty_reads_forwarded = 0
+
+    # -- wiring ------------------------------------------------------------
+    @staticmethod
+    def link_chain(nodes: list["ChainNode"]) -> None:
+        """Assign roles + next/prev/head pointers along ``nodes``."""
+        for i, node in enumerate(nodes):
+            node.prev_node = nodes[i - 1] if i > 0 else None
+            node.next_node = nodes[i + 1] if i < len(nodes) - 1 else None
+            node.head_node = nodes[0]
+            if len(nodes) == 1:
+                node._role = ChainNodeRole.HEAD
+            elif i == 0:
+                node._role = ChainNodeRole.HEAD
+            elif i == len(nodes) - 1:
+                node._role = ChainNodeRole.TAIL
+            else:
+                node._role = ChainNodeRole.MIDDLE
+
+    def downstream_entities(self) -> list[Entity]:
+        return [n for n in (self.next_node,) if n is not None]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> ChainReplicationStats:
+        return ChainReplicationStats(
+            writes_received=self._writes_received,
+            propagations_sent=self._propagations_sent,
+            propagations_received=self._propagations_received,
+            acks_sent=self._acks_sent,
+            reads=self._reads,
+            dirty_reads_forwarded=self._dirty_reads_forwarded,
+        )
+
+    @property
+    def role(self) -> ChainNodeRole:
+        return self._role
+
+    @property
+    def store(self) -> KVStore:
+        return self._store
+
+    @property
+    def dirty_keys(self) -> set[str]:
+        return {k for k, c in self._dirty_counts.items() if c > 0}
+
+    def _mark_dirty(self, key: str) -> None:
+        self._dirty_counts[key] = self._dirty_counts.get(key, 0) + 1
+
+    def _mark_clean(self, key: str) -> None:
+        count = self._dirty_counts.get(key, 0)
+        if count <= 1:
+            self._dirty_counts.pop(key, None)
+        else:
+            self._dirty_counts[key] = count - 1
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        event_type = event.event_type
+        if event_type == "Write":
+            return (yield from self._handle_write(event))
+        if event_type == "Propagate":
+            return (yield from self._handle_propagate(event))
+        if event_type == "Read":
+            return (yield from self._handle_read(event))
+        if event_type == "WriteAck":
+            self._handle_write_ack(event)
+        elif event_type == "CommitNotify":
+            self._handle_commit_notify(event)
+        return None
+
+    # -- write path --------------------------------------------------------
+    def _handle_write(self, event: Event):
+        meta = event.context.get("metadata", {})
+        reply: Optional[SimFuture] = meta.get("reply_future")
+        if self._role is not ChainNodeRole.HEAD:
+            logger.warning("[%s] Write received by non-HEAD node", self.name)
+            if reply is not None:
+                reply.resolve({"status": "error", "reason": "not_head"})
+            return None
+        key, value = meta.get("key"), meta.get("value")
+        self._writes_received += 1
+        self._next_seq += 1
+        seq = self._next_seq
+        yield from self._store.put(key, value)
+        self._key_seq[key] = seq
+        if self._craq_enabled:
+            self._mark_dirty(key)
+        if self.next_node is not None:
+            ack_future: SimFuture = SimFuture()
+            self._pending_writes[seq] = ack_future
+            propagate = self._network.send(
+                self, self.next_node, "Propagate",
+                payload={"key": key, "value": value, "seq": seq},
+            )
+            self._propagations_sent += 1
+            yield ack_future, [propagate]  # write acks only once tail-applied
+            self._pending_writes.pop(seq, None)
+        if self._craq_enabled:
+            self._mark_clean(key)
+        if reply is not None:
+            reply.resolve({"status": "ok", "seq": seq})
+        return None
+
+    def _handle_propagate(self, event: Event):
+        meta = event.context.get("metadata", {})
+        key, value, seq = meta.get("key"), meta.get("value"), meta.get("seq", 0)
+        self._propagations_received += 1
+        if seq >= self._key_seq.get(key, 0):
+            # Per-key ordering guard against link-jitter reordering.
+            yield from self._store.put(key, value)
+            self._key_seq[key] = seq
+        if self._craq_enabled:
+            self._mark_dirty(key)
+        if self._role is ChainNodeRole.TAIL:
+            produced = []
+            head = self.head_node or self.prev_node
+            if head is not None:
+                produced.append(
+                    self._network.send(self, head, "WriteAck", payload={"key": key, "seq": seq})
+                )
+                self._acks_sent += 1
+            if self._craq_enabled:
+                self._mark_clean(key)
+            if self._craq_enabled:
+                produced.extend(self._commit_notifications(key, seq))
+            return produced or None
+        if self.next_node is not None:
+            propagate = self._network.send(
+                self, self.next_node, "Propagate",
+                payload={"key": key, "value": value, "seq": seq},
+            )
+            self._propagations_sent += 1
+            return [propagate]
+        return None
+
+    def _handle_write_ack(self, event: Event) -> None:
+        seq = event.context.get("metadata", {}).get("seq", 0)
+        future = self._pending_writes.get(seq)
+        if future is not None:
+            future.resolve({"status": "ok", "seq": seq})
+
+    def _commit_notifications(self, key: str, seq: int) -> list[Event]:
+        """CRAQ: tell upstream nodes the key is clean again."""
+        events = []
+        node = self.prev_node
+        while node is not None:
+            events.append(
+                self._network.send(self, node, "CommitNotify", payload={"key": key, "seq": seq})
+            )
+            node = node.prev_node
+        return events
+
+    def _handle_commit_notify(self, event: Event) -> None:
+        key = event.context.get("metadata", {}).get("key")
+        if key and self._craq_enabled:
+            self._mark_clean(key)
+
+    # -- read path ---------------------------------------------------------
+    def _handle_read(self, event: Event):
+        meta = event.context.get("metadata", {})
+        key = meta.get("key")
+        reply = meta.get("reply_future")
+        self._reads += 1
+        if self._role is ChainNodeRole.TAIL or (
+            self._craq_enabled and self._dirty_counts.get(key, 0) == 0
+        ):
+            value = yield from self._store.get(key)
+            if reply is not None:
+                reply.resolve({"status": "ok", "value": value, "served_by": self.name})
+            return None
+        # Non-tail, non-CRAQ (or dirty key): forward to the tail.
+        tail = self._find_tail()
+        if tail is None or tail is self:
+            value = yield from self._store.get(key)
+            if reply is not None:
+                reply.resolve({"status": "ok", "value": value, "served_by": self.name})
+            return None
+        self._dirty_reads_forwarded += 1
+        forward = self._network.send(self, tail, "Read", payload={})
+        forward.context["metadata"].update({"key": key, "reply_future": reply})
+        return [forward]
+
+    def _find_tail(self) -> Optional["ChainNode"]:
+        node: Optional[ChainNode] = self
+        while node is not None and node.next_node is not None:
+            node = node.next_node
+        return node
+
+    def __repr__(self) -> str:
+        return f"ChainNode({self.name}, role={self._role.value})"

@@ -1,0 +1,89 @@
+"""Conflict resolution for divergent replica versions.
+
+Parity target: ``happysimulator/components/replication/conflict_resolver.py``
+(``VersionedValue`` :42, ``LastWriterWins`` :72, ``VectorClockMerge`` :101,
+``CustomResolver`` :147, vector-clock dominance :163).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Protocol, Union, runtime_checkable
+
+from happysim_tpu.core.logical_clocks import HLCTimestamp
+
+
+@dataclass(frozen=True)
+class VersionedValue:
+    value: Any
+    timestamp: Union[float, HLCTimestamp]
+    writer_id: str
+    vector_clock: Optional[dict[str, int]] = None
+
+
+@runtime_checkable
+class ConflictResolver(Protocol):
+    def resolve(self, key: str, versions: list[VersionedValue]) -> VersionedValue: ...
+
+
+class LastWriterWins:
+    """Highest timestamp wins; writer_id breaks ties (Cassandra/Dynamo
+    style — concurrent close-timestamp writes can lose data)."""
+
+    def resolve(self, key: str, versions: list[VersionedValue]) -> VersionedValue:
+        return max(versions, key=self._sort_key)
+
+    @staticmethod
+    def _sort_key(v: VersionedValue) -> tuple:
+        ts = v.timestamp
+        if isinstance(ts, HLCTimestamp):
+            return (ts.wall, ts.logical, v.writer_id)
+        return (ts, 0, v.writer_id)
+
+
+def _vc_dominates(a: dict[str, int], b: dict[str, int]) -> bool:
+    """a causally dominates b: a ≥ b everywhere, > somewhere."""
+    at_least = all(a.get(k, 0) >= v for k, v in b.items())
+    strictly = any(a.get(k, 0) > b.get(k, 0) for k in set(a) | set(b))
+    return at_least and strictly
+
+
+class VectorClockMerge:
+    """Causal dominance wins; concurrent versions go to ``merge_fn``
+    (or fall back to LWW)."""
+
+    def __init__(
+        self,
+        merge_fn: Optional[
+            Callable[[str, VersionedValue, VersionedValue], VersionedValue]
+        ] = None,
+    ):
+        self._merge_fn = merge_fn
+
+    def resolve(self, key: str, versions: list[VersionedValue]) -> VersionedValue:
+        result = versions[0]
+        for version in versions[1:]:
+            result = self._resolve_pair(key, result, version)
+        return result
+
+    def _resolve_pair(
+        self, key: str, a: VersionedValue, b: VersionedValue
+    ) -> VersionedValue:
+        vc_a, vc_b = a.vector_clock or {}, b.vector_clock or {}
+        if _vc_dominates(vc_a, vc_b):
+            return a
+        if _vc_dominates(vc_b, vc_a):
+            return b
+        if self._merge_fn is not None:
+            return self._merge_fn(key, a, b)
+        return LastWriterWins().resolve(key, [a, b])
+
+
+class CustomResolver:
+    """User-supplied ``(key, versions) -> winner``."""
+
+    def __init__(self, resolve_fn: Callable[[str, list[VersionedValue]], VersionedValue]):
+        self._resolve_fn = resolve_fn
+
+    def resolve(self, key: str, versions: list[VersionedValue]) -> VersionedValue:
+        return self._resolve_fn(key, versions)

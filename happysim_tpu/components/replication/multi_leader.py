@@ -1,0 +1,261 @@
+"""Multi-leader (active-active) replication with anti-entropy.
+
+Parity target: ``happysimulator/components/replication/multi_leader.py:76``
+(every node accepts writes; async replication to peers; divergence
+resolved by a :class:`ConflictResolver`; periodic Merkle-tree anti-entropy
+finds and repairs keys replication missed).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.components.datastore.kv_store import KVStore
+from happysim_tpu.components.replication.conflict_resolver import (
+    ConflictResolver,
+    LastWriterWins,
+    VersionedValue,
+)
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.utils.stats import stable_seed
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture
+from happysim_tpu.sketching import MerkleTree
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class MultiLeaderStats:
+    writes: int = 0
+    reads: int = 0
+    replications_sent: int = 0
+    replications_received: int = 0
+    conflicts_resolved: int = 0
+    anti_entropy_rounds: int = 0
+    anti_entropy_repairs: int = 0
+
+
+class LeaderNode(Entity):
+    """Accepts local writes; replicates async; repairs via anti-entropy."""
+
+    def __init__(
+        self,
+        name: str,
+        store: KVStore,
+        network: Entity,
+        peers: Optional[list[Entity]] = None,
+        resolver: Optional[ConflictResolver] = None,
+        anti_entropy_interval: float = 5.0,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(name)
+        self._store = store
+        self._network = network
+        self._peers: list[Entity] = list(peers or [])
+        self._resolver = resolver or LastWriterWins()
+        self._anti_entropy_interval = anti_entropy_interval
+        self._rng = random.Random(seed if seed is not None else stable_seed(name))
+        self._versions: dict[str, VersionedValue] = {}
+        self._merkle = MerkleTree()
+        self._writes = 0
+        self._reads = 0
+        self._replications_sent = 0
+        self._replications_received = 0
+        self._conflicts_resolved = 0
+        self._anti_entropy_rounds = 0
+        self._anti_entropy_repairs = 0
+
+    # -- wiring ------------------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._peers)
+
+    def add_peers(self, peers: list[Entity]) -> None:
+        for peer in peers:
+            if peer.name != self.name and peer not in self._peers:
+                self._peers.append(peer)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> MultiLeaderStats:
+        return MultiLeaderStats(
+            writes=self._writes,
+            reads=self._reads,
+            replications_sent=self._replications_sent,
+            replications_received=self._replications_received,
+            conflicts_resolved=self._conflicts_resolved,
+            anti_entropy_rounds=self._anti_entropy_rounds,
+            anti_entropy_repairs=self._anti_entropy_repairs,
+        )
+
+    @property
+    def store(self) -> KVStore:
+        return self._store
+
+    @property
+    def peers(self) -> list[Entity]:
+        return list(self._peers)
+
+    @property
+    def merkle_tree(self) -> MerkleTree:
+        return self._merkle
+
+    @property
+    def versions(self) -> dict[str, VersionedValue]:
+        return dict(self._versions)
+
+    def get_anti_entropy_event(self) -> Optional[Event]:
+        """Kick the periodic anti-entropy loop (schedule on the sim)."""
+        if not self._peers:
+            return None
+        return Event(self.now, "AntiEntropyTick", target=self, daemon=True)
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        event_type = event.event_type
+        if event_type == "Write":
+            return (yield from self._handle_write(event))
+        if event_type == "Read":
+            return (yield from self._handle_read(event))
+        if event_type == "Replicate":
+            return (yield from self._handle_replicate(event))
+        if event_type == "AntiEntropyTick":
+            return self._handle_anti_entropy_tick(event)
+        if event_type == "AntiEntropyRequest":
+            return self._handle_anti_entropy_request(event)
+        if event_type == "AntiEntropyResponse":
+            return self._handle_anti_entropy_response(event)
+        return None
+
+    # -- write / read ------------------------------------------------------
+    def _apply_version(self, key: str, version: VersionedValue) -> None:
+        self._versions[key] = version
+        self._store.put_sync(key, version.value)
+        self._merkle.update(key, (version.value, str(version.timestamp), version.writer_id))
+
+    def _handle_write(self, event: Event):
+        meta = event.context.get("metadata", {})
+        key, value = meta.get("key"), meta.get("value")
+        reply: Optional[SimFuture] = meta.get("reply_future")
+        self._writes += 1
+        version = VersionedValue(
+            value=value, timestamp=self.now.to_seconds(), writer_id=self.name
+        )
+        yield self._store.write_latency
+        self._apply_version(key, version)
+        produced = []
+        for peer in self._peers:
+            produced.append(
+                self._network.send(
+                    self,
+                    peer,
+                    "Replicate",
+                    payload={
+                        "key": key,
+                        "value": value,
+                        "timestamp": version.timestamp,
+                        "writer_id": version.writer_id,
+                    },
+                )
+            )
+            self._replications_sent += 1
+        if reply is not None:
+            reply.resolve({"status": "ok"})
+        return produced or None
+
+    def _handle_read(self, event: Event):
+        meta = event.context.get("metadata", {})
+        self._reads += 1
+        value = yield from self._store.get(meta.get("key"))
+        reply = meta.get("reply_future")
+        if reply is not None:
+            reply.resolve({"status": "ok", "value": value})
+        return None
+
+    def _handle_replicate(self, event: Event):
+        meta = event.context.get("metadata", {})
+        key = meta.get("key")
+        incoming = VersionedValue(
+            value=meta.get("value"),
+            timestamp=meta.get("timestamp", 0.0),
+            writer_id=meta.get("writer_id", "?"),
+        )
+        self._replications_received += 1
+        yield self._store.write_latency
+        current = self._versions.get(key)
+        if current is None:
+            self._apply_version(key, incoming)
+        else:
+            winner = self._resolver.resolve(key, [current, incoming])
+            if winner is not current:
+                self._conflicts_resolved += 1
+                self._apply_version(key, winner)
+        return None
+
+    # -- anti-entropy ------------------------------------------------------
+    def _handle_anti_entropy_tick(self, event: Event) -> list[Event]:
+        events: list[Event] = []
+        if self._peers:
+            peer = self._rng.choice(self._peers)
+            self._anti_entropy_rounds += 1
+            events.append(
+                self._network.send(
+                    self,
+                    peer,
+                    "AntiEntropyRequest",
+                    payload={"root_hash": self._merkle.root_hash},
+                )
+            )
+        events.append(
+            Event(
+                self.now + self._anti_entropy_interval,
+                "AntiEntropyTick",
+                target=self,
+                daemon=True,
+            )
+        )
+        return events
+
+    def _version_payload(self) -> dict[str, tuple]:
+        return {
+            k: (v.value, v.timestamp, v.writer_id) for k, v in self._versions.items()
+        }
+
+    def _handle_anti_entropy_request(self, event: Event) -> Optional[list[Event]]:
+        meta = event.context.get("metadata", {})
+        if meta.get("root_hash") == self._merkle.root_hash:
+            return None  # already in sync — O(1) common case
+        sender = next(
+            (p for p in self._peers if p.name == meta.get("source")), None
+        )
+        if sender is None:
+            return None
+        return [
+            self._network.send(
+                self,
+                sender,
+                "AntiEntropyResponse",
+                payload={"versions": self._version_payload()},
+            )
+        ]
+
+    def _handle_anti_entropy_response(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        for key, (value, timestamp, writer_id) in meta.get("versions", {}).items():
+            incoming = VersionedValue(value=value, timestamp=timestamp, writer_id=writer_id)
+            current = self._versions.get(key)
+            if current is None:
+                self._apply_version(key, incoming)
+                self._anti_entropy_repairs += 1
+            else:
+                winner = self._resolver.resolve(key, [current, incoming])
+                if winner is not current:
+                    self._apply_version(key, winner)
+                    self._anti_entropy_repairs += 1
+        return None
+
+    def __repr__(self) -> str:
+        return f"LeaderNode({self.name}, keys={len(self._versions)})"

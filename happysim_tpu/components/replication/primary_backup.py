@@ -1,0 +1,231 @@
+"""Primary-backup replication with sync / semi-sync / async modes.
+
+Parity target: ``happysimulator/components/replication/primary_backup.py:89``
+(``ReplicationMode`` :47; write applies locally then replicates — async
+fire-and-forget, semi-sync waits one ack, sync waits all; per-backup lag
+via sequence numbers; ``BackupNode`` :305 applies in-order).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+from happysim_tpu.components.datastore.kv_store import KVStore
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture, all_of, any_of
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicationMode(Enum):
+    SYNC = "sync"  # ack every backup before acking the client
+    SEMI_SYNC = "semi_sync"  # ack after the first backup acks
+    ASYNC = "async"  # ack immediately; replicate in the background
+
+
+@dataclass(frozen=True)
+class PrimaryBackupStats:
+    writes: int = 0
+    reads: int = 0
+    replications_sent: int = 0
+    acks_received: int = 0
+
+
+@dataclass(frozen=True)
+class BackupStats:
+    replications_received: int = 0
+    replications_applied: int = 0
+    reads: int = 0
+
+
+class PrimaryNode(Entity):
+    """Send ``Write``/``Read`` events with metadata {key, value,
+    reply_future}; writes replicate to backups per the configured mode."""
+
+    def __init__(
+        self,
+        name: str,
+        store: KVStore,
+        backups: list[Entity],
+        network: Entity,
+        mode: ReplicationMode = ReplicationMode.ASYNC,
+    ):
+        super().__init__(name)
+        self._store = store
+        self._backups = backups
+        self._network = network
+        self._mode = mode
+        self._seq = 0
+        self._acked_seq: dict[str, int] = {b.name: 0 for b in backups}
+        self._writes = 0
+        self._reads = 0
+        self._replications_sent = 0
+        self._acks_received = 0
+
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._backups)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def stats(self) -> PrimaryBackupStats:
+        return PrimaryBackupStats(
+            writes=self._writes,
+            reads=self._reads,
+            replications_sent=self._replications_sent,
+            acks_received=self._acks_received,
+        )
+
+    @property
+    def mode(self) -> ReplicationMode:
+        return self._mode
+
+    @property
+    def backup_lag(self) -> dict[str, int]:
+        """Writes accepted but not yet acked, per backup."""
+        return {name: self._seq - acked for name, acked in self._acked_seq.items()}
+
+    @property
+    def store(self) -> KVStore:
+        return self._store
+
+    # -- dispatch ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        if event.event_type == "Write":
+            return (yield from self._handle_write(event))
+        if event.event_type == "Read":
+            return (yield from self._handle_read(event))
+        if event.event_type == "ReplicationAck":
+            self._handle_ack(event)
+        return None
+
+    def _replicate(self, key, value, seq, with_ack: bool):
+        events, ack_futures = [], []
+        for backup in self._backups:
+            payload = {"key": key, "value": value, "seq": seq}
+            if with_ack:
+                ack_future: SimFuture = SimFuture()
+                payload["ack_future"] = ack_future
+                ack_futures.append(ack_future)
+            events.append(self._network.send(self, backup, "Replicate", payload=payload))
+            self._replications_sent += 1
+        return events, ack_futures
+
+    def _handle_write(self, event: Event):
+        meta = event.context.get("metadata", {})
+        key, value = meta.get("key"), meta.get("value")
+        reply: Optional[SimFuture] = meta.get("reply_future")
+        self._writes += 1
+        self._seq += 1
+        seq = self._seq
+        yield from self._store.put(key, value)
+        if self._mode is ReplicationMode.ASYNC:
+            events, _ = self._replicate(key, value, seq, with_ack=False)
+            if reply is not None:
+                reply.resolve({"status": "ok", "seq": seq})
+            return events or None
+        events, ack_futures = self._replicate(key, value, seq, with_ack=True)
+        if ack_futures:
+            if self._mode is ReplicationMode.SEMI_SYNC:
+                waiter = (
+                    any_of(*ack_futures) if len(ack_futures) > 1 else ack_futures[0]
+                )
+            else:  # SYNC
+                waiter = all_of(*ack_futures) if len(ack_futures) > 1 else ack_futures[0]
+            yield waiter, events
+        if reply is not None:
+            reply.resolve({"status": "ok", "seq": seq})
+        return None
+
+    def _handle_read(self, event: Event):
+        meta = event.context.get("metadata", {})
+        self._reads += 1
+        value = yield from self._store.get(meta.get("key"))
+        reply = meta.get("reply_future")
+        if reply is not None:
+            reply.resolve({"status": "ok", "value": value})
+        return None
+
+    def _handle_ack(self, event: Event) -> None:
+        meta = event.context.get("metadata", {})
+        backup_name = meta.get("source")
+        seq = meta.get("seq", 0)
+        self._acks_received += 1
+        if backup_name in self._acked_seq and seq > self._acked_seq[backup_name]:
+            self._acked_seq[backup_name] = seq
+
+
+class BackupNode(Entity):
+    """Applies replicated writes; serves (possibly stale) local reads."""
+
+    def __init__(self, name: str, store: KVStore, network: Entity, primary: Optional[Entity] = None):
+        super().__init__(name)
+        self._store = store
+        self._network = network
+        self._primary = primary
+        self._last_applied_seq = 0
+        self._key_seq: dict[str, int] = {}
+        self._replications_received = 0
+        self._replications_applied = 0
+        self._reads = 0
+
+    def set_primary(self, primary: Entity) -> None:
+        self._primary = primary
+
+    @property
+    def stats(self) -> BackupStats:
+        return BackupStats(
+            replications_received=self._replications_received,
+            replications_applied=self._replications_applied,
+            reads=self._reads,
+        )
+
+    @property
+    def store(self) -> KVStore:
+        return self._store
+
+    @property
+    def last_applied_seq(self) -> int:
+        return self._last_applied_seq
+
+    def handle_event(self, event: Event):
+        if event.event_type == "Replicate":
+            return (yield from self._handle_replicate(event))
+        if event.event_type == "Read":
+            return (yield from self._handle_read(event))
+        return None
+
+    def _handle_replicate(self, event: Event):
+        meta = event.context.get("metadata", {})
+        key, value, seq = meta.get("key"), meta.get("value"), meta.get("seq", 0)
+        self._replications_received += 1
+        # Per-key ordering guard: link jitter can reorder deliveries; an
+        # older write must never clobber a newer one (it would diverge
+        # permanently — there's no anti-entropy in primary-backup).
+        if seq >= self._key_seq.get(key, 0):
+            yield from self._store.put(key, value)
+            self._key_seq[key] = seq
+            self._replications_applied += 1
+        if seq > self._last_applied_seq:
+            self._last_applied_seq = seq
+        ack_future: Optional[SimFuture] = meta.get("ack_future")
+        if ack_future is not None:
+            ack_future.resolve({"seq": seq, "from": self.name})
+        # Lag tracking ack back to the primary.
+        if self._primary is not None:
+            return [
+                self._network.send(self, self._primary, "ReplicationAck", payload={"seq": seq})
+            ]
+        return None
+
+    def _handle_read(self, event: Event):
+        meta = event.context.get("metadata", {})
+        self._reads += 1
+        value = yield from self._store.get(meta.get("key"))
+        reply = meta.get("reply_future")
+        if reply is not None:
+            reply.resolve({"status": "ok", "value": value, "stale_seq": self._last_applied_seq})
+        return None

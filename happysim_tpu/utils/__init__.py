@@ -1,5 +1,5 @@
 """Shared small utilities (the task template's ``utils/`` tier)."""
 
-from happysim_tpu.utils.stats import percentile_nearest_rank
+from happysim_tpu.utils.stats import percentile_nearest_rank, stable_seed
 
-__all__ = ["percentile_nearest_rank"]
+__all__ = ["percentile_nearest_rank", "stable_seed"]

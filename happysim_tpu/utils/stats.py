@@ -17,3 +17,11 @@ def percentile_nearest_rank(values: Sequence[float], q: float) -> float:
     ordered = sorted(values)
     rank = math.ceil(len(ordered) * q)
     return ordered[min(max(rank - 1, 0), len(ordered) - 1)]
+
+
+def stable_seed(name: str) -> int:
+    """Deterministic per-name RNG seed (crc32 — unlike ``hash(str)``, not
+    salted per interpreter, so runs reproduce across processes)."""
+    import zlib
+
+    return zlib.crc32(name.encode())

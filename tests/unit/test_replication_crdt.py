@@ -1,0 +1,424 @@
+"""Unit tests: replication (primary-backup, chain, multi-leader) + CRDTs."""
+
+import pytest
+
+from happysim_tpu import ConstantLatency, Entity, Event, Instant, KVStore, Network, NetworkLink, Simulation, SimFuture
+from happysim_tpu.components.crdt import CRDTStore, GCounter, LWWRegister, ORSet, PNCounter
+from happysim_tpu.components.replication import (
+    BackupNode,
+    ChainNode,
+    ChainNodeRole,
+    CustomResolver,
+    LastWriterWins,
+    LeaderNode,
+    PrimaryNode,
+    ReplicationMode,
+    VectorClockMerge,
+    VersionedValue,
+)
+
+
+def t(seconds):
+    return Instant.from_seconds(seconds)
+
+
+def make_network(latency=0.01):
+    return Network("net", default_link=NetworkLink("link", latency=ConstantLatency(latency)))
+
+
+def write_event(target, key, value, reply=None, at=0.0):
+    return Event(
+        t(at), "Write", target=target,
+        context={"metadata": {"key": key, "value": value, "reply_future": reply}},
+    )
+
+
+# ------------------------------------------------------------------ CRDTs ----
+class TestCRDTs:
+    def test_g_counter_merge(self):
+        a, b = GCounter("a"), GCounter("b")
+        a.increment(5)
+        b.increment(3)
+        a.merge(b)
+        b.merge(a)
+        assert a.value == b.value == 8
+        a.merge(b)  # idempotent
+        assert a.value == 8
+
+    def test_pn_counter(self):
+        a, b = PNCounter("a"), PNCounter("b")
+        a.increment(10)
+        b.decrement(4)
+        a.merge(b)
+        assert a.value == 6
+        roundtrip = PNCounter.from_dict(a.to_dict())
+        assert roundtrip.value == 6
+
+    def test_lww_register(self):
+        a, b = LWWRegister("a"), LWWRegister("b")
+        a.set("first", 1.0)
+        b.set("second", 2.0)
+        a.merge(b)
+        assert a.value == "second"
+        b.merge(a)
+        assert b.value == "second"
+
+    def test_or_set_add_wins(self):
+        a, b = ORSet("a"), ORSet("b")
+        a.add("x")
+        b.merge(a)
+        b.remove("x")  # removes the observed tag
+        a.add("x")  # concurrent re-add with a NEW tag
+        a.merge(b)
+        assert "x" in a  # add wins
+        b.merge(a)
+        assert a.value == b.value
+
+    def test_or_set_remove_observed(self):
+        s = ORSet("a")
+        s.add("x")
+        s.remove("x")
+        assert "x" not in s
+        assert len(s) == 0
+
+    def test_crdt_store_gossip_convergence(self):
+        network = make_network(0.005)
+        stores = [
+            CRDTStore(f"s{i}", network, gossip_interval=0.5, seed=i) for i in range(3)
+        ]
+        for s in stores:
+            s.add_peers(stores)
+
+        class Writer(Entity):
+            def __init__(self, name, store, amount):
+                super().__init__(name)
+                self.store = store
+                self.amount = amount
+
+            def handle_event(self, event):
+                self.store.get_or_create("hits").increment(self.amount)
+                return self.store.get_gossip_event()
+
+        class Idle(Entity):
+            def handle_event(self, event):
+                return None
+
+        idle = Idle("idle")
+        writers = [Writer(f"w{i}", stores[i], amount=i + 1) for i in range(3)]
+        sim = Simulation(entities=[network, idle, *stores, *writers], duration=30.0)
+        for i, w in enumerate(writers):
+            sim.schedule(Event(t(0.1 * i), "go", target=w))
+        # Something primary to keep the sim alive while gossip (daemon) runs.
+        sim.schedule(Event(t(20.0), "noop", target=idle))
+        sim.run()
+        values = [s._crdts["hits"].value for s in stores]
+        assert values == [6, 6, 6]  # 1+2+3 everywhere
+        hashes = {s.state_hash() for s in stores}
+        assert len(hashes) == 1
+
+
+# -------------------------------------------------------- conflict resolvers ----
+class TestConflictResolvers:
+    def test_lww(self):
+        v1 = VersionedValue("old", 1.0, "a")
+        v2 = VersionedValue("new", 2.0, "b")
+        assert LastWriterWins().resolve("k", [v1, v2]).value == "new"
+
+    def test_lww_tie_break(self):
+        v1 = VersionedValue("a-val", 1.0, "a")
+        v2 = VersionedValue("b-val", 1.0, "b")
+        assert LastWriterWins().resolve("k", [v1, v2]).value == "b-val"
+
+    def test_vector_clock_dominance(self):
+        v1 = VersionedValue("old", 1.0, "a", vector_clock={"a": 1})
+        v2 = VersionedValue("new", 0.5, "b", vector_clock={"a": 1, "b": 1})
+        # v2 causally dominates despite the older wall timestamp.
+        assert VectorClockMerge().resolve("k", [v1, v2]).value == "new"
+
+    def test_vector_clock_concurrent_merges(self):
+        v1 = VersionedValue({"x"}, 1.0, "a", vector_clock={"a": 1})
+        v2 = VersionedValue({"y"}, 2.0, "b", vector_clock={"b": 1})
+        merged = VectorClockMerge(
+            merge_fn=lambda k, a, b: VersionedValue(
+                a.value | b.value, max(a.timestamp, b.timestamp), "merged"
+            )
+        ).resolve("k", [v1, v2])
+        assert merged.value == {"x", "y"}
+
+    def test_custom(self):
+        resolver = CustomResolver(lambda k, vs: min(vs, key=lambda v: v.timestamp))
+        v1 = VersionedValue("first", 1.0, "a")
+        v2 = VersionedValue("second", 2.0, "b")
+        assert resolver.resolve("k", [v1, v2]).value == "first"
+
+
+# -------------------------------------------------------- primary-backup ----
+class TestPrimaryBackup:
+    def _build(self, mode):
+        network = make_network(0.01)
+        backups = [
+            BackupNode(f"b{i}", KVStore(f"bs{i}", write_latency=0.002), network)
+            for i in range(2)
+        ]
+        primary = PrimaryNode("primary", KVStore("ps", write_latency=0.002),
+                              backups, network, mode=mode)
+        for b in backups:
+            b.set_primary(primary)
+        return network, primary, backups
+
+    def _run_write(self, network, primary, backups, duration=10.0):
+        done = {}
+
+        class Client(Entity):
+            def handle_event(self, event):
+                reply = SimFuture()
+                write = write_event(primary, "k", "v", reply=reply)
+                write = Event(self.now, "Write", target=primary,
+                              context=write.context)
+                result = yield reply, [write]
+                done["result"] = result
+                done["at"] = round(self.now.to_seconds(), 4)
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, primary, *backups], duration=duration)
+        sim.schedule(Event(t(0.0), "go", target=client))
+        sim.run()
+        return done
+
+    def test_async_acks_before_replication(self):
+        network, primary, backups = self._build(ReplicationMode.ASYNC)
+        done = self._run_write(network, primary, backups)
+        assert done["result"]["status"] == "ok"
+        # Ack at local write latency only (0.002), before network round trip.
+        assert done["at"] < 0.01
+        # Replication still lands eventually.
+        assert all(b.store.get_sync("k") == "v" for b in backups)
+
+    def test_sync_waits_for_all_backups(self):
+        network, primary, backups = self._build(ReplicationMode.SYNC)
+        done = self._run_write(network, primary, backups)
+        # local 0.002 + network 0.01 + backup 0.002 ≈ 0.014+
+        assert done["at"] >= 0.012
+        assert all(b.store.get_sync("k") == "v" for b in backups)
+        assert primary.backup_lag == {"b0": 0, "b1": 0}
+
+    def test_semi_sync_waits_for_first(self):
+        network, primary, backups = self._build(ReplicationMode.SEMI_SYNC)
+        done = self._run_write(network, primary, backups)
+        assert done["result"]["status"] == "ok"
+        assert done["at"] >= 0.012  # at least one backup round trip
+
+
+# ------------------------------------------------------------------ chain ----
+class TestChainReplication:
+    def _build(self, n=3, craq=False):
+        network = make_network(0.01)
+        nodes = [
+            ChainNode(f"c{i}", KVStore(f"cs{i}", write_latency=0.001), network,
+                      craq_enabled=craq)
+            for i in range(n)
+        ]
+        ChainNode.link_chain(nodes)
+        return network, nodes
+
+    def test_write_propagates_to_tail_then_acks(self):
+        network, nodes = self._build(3)
+        done = {}
+
+        class Client(Entity):
+            def handle_event(self, event):
+                reply = SimFuture()
+                write = Event(self.now, "Write", target=nodes[0],
+                              context={"metadata": {"key": "k", "value": "v",
+                                                    "reply_future": reply}})
+                result = yield reply, [write]
+                done["result"] = result
+                done["at"] = round(self.now.to_seconds(), 4)
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=10.0)
+        sim.schedule(Event(t(0.0), "go", target=client))
+        sim.run()
+        assert done["result"]["status"] == "ok"
+        assert all(n.store.get_sync("k") == "v" for n in nodes)
+        # Full chain: 2 hops down + ack back ≈ 3 network latencies minimum.
+        assert done["at"] >= 0.03
+        assert nodes[0].role == ChainNodeRole.HEAD
+        assert nodes[2].role == ChainNodeRole.TAIL
+
+    def test_reads_served_by_tail(self):
+        network, nodes = self._build(3)
+        nodes[2].store.put_sync("k", "tail-value")
+        done = {}
+
+        class Client(Entity):
+            def handle_event(self, event):
+                reply = SimFuture()
+                read = Event(self.now, "Read", target=nodes[2],
+                             context={"metadata": {"key": "k", "reply_future": reply}})
+                result = yield reply, [read]
+                done["result"] = result
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=10.0)
+        sim.schedule(Event(t(0.0), "go", target=client))
+        sim.run()
+        assert done["result"]["value"] == "tail-value"
+        assert done["result"]["served_by"] == "c2"
+
+    def test_craq_clean_reads_local_dirty_forward(self):
+        network, nodes = self._build(3, craq=True)
+        # Clean key: middle node serves locally.
+        for n in nodes:
+            n.store.put_sync("clean", 1)
+        done = {}
+
+        class Client(Entity):
+            def handle_event(self, event):
+                reply = SimFuture()
+                read = Event(self.now, "Read", target=nodes[1],
+                             context={"metadata": {"key": "clean", "reply_future": reply}})
+                result = yield reply, [read]
+                done["clean"] = result
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=10.0)
+        sim.schedule(Event(t(0.0), "go", target=client))
+        sim.run()
+        assert done["clean"]["served_by"] == "c1"  # local CRAQ read
+
+
+# ------------------------------------------------------------ multi-leader ----
+class TestMultiLeader:
+    def test_concurrent_writes_converge_via_lww(self):
+        network = make_network(0.01)
+        leaders = [
+            LeaderNode(f"L{i}", KVStore(f"ls{i}", write_latency=0.001), network, seed=i)
+            for i in range(2)
+        ]
+        for leader in leaders:
+            leader.add_peers(leaders)
+
+        class Writer(Entity):
+            def __init__(self, name, leader, value):
+                super().__init__(name)
+                self.leader = leader
+                self.value = value
+
+            def handle_event(self, event):
+                reply = SimFuture()
+                write = Event(self.now, "Write", target=self.leader,
+                              context={"metadata": {"key": "k", "value": self.value,
+                                                    "reply_future": reply}})
+                yield reply, [write]
+
+        w1 = Writer("w1", leaders[0], "from-L0")
+        w2 = Writer("w2", leaders[1], "from-L1")
+        sim = Simulation(entities=[network, w1, w2, *leaders], duration=10.0)
+        sim.schedule(Event(t(0.0), "go", target=w1))
+        sim.schedule(Event(t(0.001), "go", target=w2))  # later write wins
+        sim.run()
+        assert leaders[0].store.get_sync("k") == "from-L1"
+        assert leaders[1].store.get_sync("k") == "from-L1"
+        assert leaders[0].stats.conflicts_resolved >= 1
+
+    def test_anti_entropy_repairs_missed_replication(self):
+        network = make_network(0.01)
+        leaders = [
+            LeaderNode(f"L{i}", KVStore(f"ls{i}", write_latency=0.001), network,
+                       anti_entropy_interval=1.0, seed=i)
+            for i in range(2)
+        ]
+        for leader in leaders:
+            leader.add_peers(leaders)
+        # Simulate a missed replication: L0 has a key L1 never saw.
+        leaders[0]._apply_version(
+            "lost", VersionedValue("repaired", 1.0, "L0")
+        )
+
+        class Kicker(Entity):
+            def handle_event(self, event):
+                events = []
+                for leader in leaders:
+                    kick = leader.get_anti_entropy_event()
+                    if kick is not None:
+                        events.append(kick)
+                return events
+
+        kicker = Kicker("kicker")
+        sim = Simulation(entities=[network, kicker, *leaders], duration=20.0)
+        sim.schedule(Event(t(0.0), "go", target=kicker))
+        sim.schedule(Event(t(15.0), "noop", target=kicker))  # hold sim open
+        sim.run()
+        assert leaders[1].store.get_sync("lost") == "repaired"
+        assert leaders[1].stats.anti_entropy_repairs >= 1
+        assert leaders[0].merkle_tree.root_hash == leaders[1].merkle_tree.root_hash
+
+
+class TestReviewRegressions:
+    def test_or_set_roundtrip_counter_no_collision(self):
+        s = ORSet("a")
+        s.add("x")
+        s.remove("x")
+        restored = ORSet.from_dict(s.to_dict())
+        restored.add("x")  # must mint a FRESH tag, not collide with tombstone
+        assert "x" in restored
+
+    def test_backup_ignores_reordered_stale_write(self):
+        network = make_network(0.01)
+        backup = BackupNode("b", KVStore("bs"), network)
+        from happysim_tpu.core.clock import Clock
+
+        clock = Clock()
+        for e in (network, backup):
+            e.set_clock(clock)
+        # Deliver seq=2 then the late seq=1 for the same key.
+        for seq, value in ((2, "new"), (1, "old")):
+            gen = backup._handle_replicate(
+                Event(t(0.0), "Replicate", target=backup,
+                      context={"metadata": {"key": "k", "value": value, "seq": seq}})
+            )
+            try:
+                while True:
+                    next(gen)
+            except StopIteration:
+                pass
+        assert backup.store.get_sync("k") == "new"  # stale write ignored
+
+    def test_raft_step_down_reschedules_election_timer(self):
+        """A leader stepping down on an UNGRANTED vote keeps a live timer
+        (cluster can't go permanently leaderless)."""
+        from happysim_tpu.components.consensus import RaftNode, RaftState
+
+        network = make_network(0.01)
+        nodes = [RaftNode(f"n{i}", network, election_timeout_min=1.0,
+                          election_timeout_max=1.5, seed=i) for i in range(2)]
+        for n in nodes:
+            n.set_peers(nodes)
+
+        class Prober(Entity):
+            def handle_event(self, event):
+                leader = next((n for n in nodes if n.is_leader), None)
+                if leader is None:
+                    return None
+                # Stale-log candidate forces step-down WITHOUT vote grant.
+                leader._log.append(leader.current_term, "entry")
+                return leader._handle_request_vote(
+                    Event(self.now, "RaftRequestVote", target=leader,
+                          context={"metadata": {
+                              "term": leader.current_term + 1,
+                              "candidate_id": nodes[1].name if leader is nodes[0] else nodes[0].name,
+                              "source": nodes[1].name if leader is nodes[0] else nodes[0].name,
+                              "last_log_index": 0,
+                              "last_log_term": 0,
+                          }})
+                )
+
+        prober = Prober("prober")
+        sim = Simulation(entities=[network, prober, *nodes], duration=30.0)
+        for n in nodes:
+            sim.schedule(n.start())
+        sim.schedule(Event(t(6.0), "poke", target=prober))
+        sim.run()
+        # The cluster recovered a leader after the forced step-down.
+        assert any(n.is_leader for n in nodes)
