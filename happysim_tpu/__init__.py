@@ -22,6 +22,11 @@ import logging
 logging.getLogger("happysim_tpu").addHandler(logging.NullHandler())
 
 from happysim_tpu.components import (
+    AutoScaler,
+    CanaryDeployer,
+    JobScheduler,
+    RollingDeployer,
+    WorkStealingPool,
     DistributedLock,
     LeaderElection,
     MembershipProtocol,

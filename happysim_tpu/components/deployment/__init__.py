@@ -1,0 +1,48 @@
+"""Deployment components — auto-scaling, canary, rolling deploys.
+
+Parity target: ``happysimulator/components/deployment/`` (SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.deployment.auto_scaler import (
+    AutoScaler,
+    AutoScalerStats,
+    QueueDepthScaling,
+    ScalingEvent,
+    ScalingPolicy,
+    StepScaling,
+    TargetUtilization,
+)
+from happysim_tpu.components.deployment.canary_deployer import (
+    CanaryDeployer,
+    CanaryDeployerStats,
+    CanaryStage,
+    CanaryState,
+    ErrorRateEvaluator,
+    LatencyEvaluator,
+    MetricEvaluator,
+)
+from happysim_tpu.components.deployment.rolling_deployer import (
+    DeploymentState,
+    RollingDeployer,
+    RollingDeployerStats,
+)
+
+__all__ = [
+    "AutoScaler",
+    "AutoScalerStats",
+    "CanaryDeployer",
+    "CanaryDeployerStats",
+    "CanaryStage",
+    "CanaryState",
+    "DeploymentState",
+    "ErrorRateEvaluator",
+    "LatencyEvaluator",
+    "MetricEvaluator",
+    "QueueDepthScaling",
+    "RollingDeployer",
+    "RollingDeployerStats",
+    "ScalingEvent",
+    "ScalingPolicy",
+    "StepScaling",
+    "TargetUtilization",
+]

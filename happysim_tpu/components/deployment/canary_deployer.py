@@ -1,0 +1,275 @@
+"""Canary deployer: staged traffic shift with metric-gated promotion.
+
+Parity target: ``happysimulator/components/deployment/canary_deployer.py:159``
+(default stages 1%→5%→25%→100%, ``ErrorRateEvaluator`` :76,
+``LatencyEvaluator`` :102, rollback on failed evaluation, weight-based
+traffic splitting).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional, Protocol, runtime_checkable
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class CanaryStage:
+    traffic_percentage: float
+    evaluation_period: float = 30.0
+
+
+@dataclass
+class CanaryState:
+    status: str = "idle"  # idle | in_progress | promoting | rolled_back | completed
+    current_stage: int = 0
+    total_stages: int = 0
+    canary_traffic_pct: float = 0.0
+
+
+@runtime_checkable
+class MetricEvaluator(Protocol):
+    def is_healthy(self, canary: Entity, baseline_backends: list[Entity]) -> bool: ...
+
+
+def _error_rate(backend: Entity) -> float:
+    stats = backend.stats() if callable(getattr(backend, "stats", None)) else getattr(
+        backend, "stats", None
+    )
+    if stats is None:
+        return 0.0
+    completed = getattr(stats, "requests_completed", 0)
+    dropped = getattr(stats, "queue_dropped", 0) + getattr(stats, "requests_rejected", 0)
+    total = completed + dropped
+    return dropped / total if total else 0.0
+
+
+class ErrorRateEvaluator:
+    """Unhealthy if canary error rate exceeds the cap or ``multiplier`` ×
+    the baseline average."""
+
+    def __init__(self, max_error_rate: float = 0.05, threshold_multiplier: float = 2.0):
+        self._max_error_rate = max_error_rate
+        self._threshold_multiplier = threshold_multiplier
+
+    def is_healthy(self, canary: Entity, baseline_backends: list[Entity]) -> bool:
+        canary_rate = _error_rate(canary)
+        if canary_rate > self._max_error_rate:
+            return False
+        if baseline_backends:
+            avg = sum(_error_rate(b) for b in baseline_backends) / len(baseline_backends)
+            if avg > 0:
+                return canary_rate <= avg * self._threshold_multiplier
+        return True
+
+
+class LatencyEvaluator:
+    """Unhealthy if canary mean service busy-time per request exceeds the
+    cap or ``multiplier`` × baseline."""
+
+    def __init__(self, max_latency: float = 1.0, threshold_multiplier: float = 1.5):
+        self._max_latency = max_latency
+        self._threshold_multiplier = threshold_multiplier
+
+    @staticmethod
+    def _avg_latency(backend: Entity) -> float:
+        completed = getattr(backend, "requests_completed", 0)
+        busy = getattr(backend, "busy_seconds", 0.0)
+        return busy / completed if completed else 0.0
+
+    def is_healthy(self, canary: Entity, baseline_backends: list[Entity]) -> bool:
+        canary_latency = self._avg_latency(canary)
+        if canary_latency > self._max_latency:
+            return False
+        if baseline_backends:
+            avg = sum(self._avg_latency(b) for b in baseline_backends) / len(
+                baseline_backends
+            )
+            if avg > 0:
+                return canary_latency <= avg * self._threshold_multiplier
+        return True
+
+
+@dataclass(frozen=True)
+class CanaryDeployerStats:
+    deployments_started: int = 0
+    deployments_completed: int = 0
+    deployments_rolled_back: int = 0
+    stages_completed: int = 0
+    evaluations_performed: int = 0
+    evaluations_passed: int = 0
+    evaluations_failed: int = 0
+
+
+class CanaryDeployer(Entity):
+    """Adds one canary backend and walks it through traffic stages; a
+    failed health evaluation rolls everything back."""
+
+    DEFAULT_STAGES = (
+        CanaryStage(0.01, 30.0),
+        CanaryStage(0.05, 30.0),
+        CanaryStage(0.25, 30.0),
+        CanaryStage(1.0, 30.0),
+    )
+
+    def __init__(
+        self,
+        name: str,
+        load_balancer: Entity,
+        server_factory: Callable[[str], Entity],
+        stages: Optional[list[CanaryStage]] = None,
+        metric_evaluator: Optional[MetricEvaluator] = None,
+        evaluation_interval: float = 5.0,
+    ):
+        super().__init__(name)
+        self._load_balancer = load_balancer
+        self._server_factory = server_factory
+        self._stages = list(stages) if stages else list(self.DEFAULT_STAGES)
+        self._metric_evaluator = metric_evaluator or ErrorRateEvaluator()
+        self._evaluation_interval = evaluation_interval
+        self._canary: Optional[Entity] = None
+        self._baseline_backends: list[Entity] = []
+        self._stage_start_time: Optional[Instant] = None
+        self._deployments_started = 0
+        self._deployments_completed = 0
+        self._deployments_rolled_back = 0
+        self._stages_completed = 0
+        self._evaluations_performed = 0
+        self._evaluations_passed = 0
+        self._evaluations_failed = 0
+        self.state = CanaryState()
+
+    def downstream_entities(self) -> list[Entity]:
+        result: list[Entity] = [self._load_balancer]
+        if self._canary is not None:
+            result.append(self._canary)
+        return result
+
+    @property
+    def stats(self) -> CanaryDeployerStats:
+        return CanaryDeployerStats(
+            deployments_started=self._deployments_started,
+            deployments_completed=self._deployments_completed,
+            deployments_rolled_back=self._deployments_rolled_back,
+            stages_completed=self._stages_completed,
+            evaluations_performed=self._evaluations_performed,
+            evaluations_passed=self._evaluations_passed,
+            evaluations_failed=self._evaluations_failed,
+        )
+
+    @property
+    def canary(self) -> Optional[Entity]:
+        return self._canary
+
+    def deploy(self) -> Event:
+        at = self.now if self._clock is not None else Instant.Epoch
+        return Event(at, "_canary_deploy_start", target=self)
+
+    def handle_event(self, event: Event):
+        handlers = {
+            "_canary_deploy_start": self._start_deployment,
+            "_canary_stage_start": self._start_stage,
+            "_canary_evaluate": self._evaluate,
+            "_canary_promote": self._promote,
+            "_canary_rollback": self._do_rollback,
+            "_canary_complete": self._complete,
+        }
+        handler = handlers.get(event.event_type)
+        return handler() if handler else None
+
+    # -- phases ------------------------------------------------------------
+    def _now_event(self, event_type: str) -> Event:
+        return Event(self.now, event_type, target=self)
+
+    def _start_deployment(self) -> list[Event]:
+        self._baseline_backends = list(self._load_balancer.backends)
+        self._canary = self._server_factory(f"{self.name}_canary")
+        if self._clock is not None:
+            self._canary.set_clock(self._clock)
+        self._load_balancer.add_backend(self._canary)
+        self.state = CanaryState(status="in_progress", total_stages=len(self._stages))
+        self._deployments_started += 1
+        return [self._now_event("_canary_stage_start")]
+
+    def _start_stage(self) -> list[Event]:
+        stage_idx = self.state.current_stage
+        if stage_idx >= len(self._stages):
+            return [self._now_event("_canary_promote")]
+        stage = self._stages[stage_idx]
+        self.state.canary_traffic_pct = stage.traffic_percentage
+        self._stage_start_time = self.now
+        self._set_traffic_weight(stage.traffic_percentage)
+        return [
+            Event(self.now + self._evaluation_interval, "_canary_evaluate", target=self)
+        ]
+
+    def _evaluate(self) -> list[Event]:
+        if self.state.status != "in_progress":
+            return []
+        self._evaluations_performed += 1
+        if not self._metric_evaluator.is_healthy(self._canary, self._baseline_backends):
+            self._evaluations_failed += 1
+            return [self._now_event("_canary_rollback")]
+        self._evaluations_passed += 1
+        stage = self._stages[self.state.current_stage]
+        elapsed = (self.now - self._stage_start_time).to_seconds()
+        if elapsed >= stage.evaluation_period:
+            self._stages_completed += 1
+            self.state.current_stage += 1
+            if self.state.current_stage >= len(self._stages):
+                return [self._now_event("_canary_promote")]
+            return [self._now_event("_canary_stage_start")]
+        return [
+            Event(self.now + self._evaluation_interval, "_canary_evaluate", target=self)
+        ]
+
+    def _promote(self) -> list[Event]:
+        self.state.status = "promoting"
+        for old_backend in self._baseline_backends:
+            self._load_balancer.remove_backend(old_backend)
+        self._reset_weights()
+        return [self._now_event("_canary_complete")]
+
+    def _do_rollback(self) -> list[Event]:
+        self.state.status = "rolled_back"
+        self._deployments_rolled_back += 1
+        if self._canary is not None:
+            self._load_balancer.remove_backend(self._canary)
+        self._reset_weights()
+        return []
+
+    def _complete(self) -> list[Event]:
+        self.state.status = "completed"
+        self._deployments_completed += 1
+        return []
+
+    # -- weights -----------------------------------------------------------
+    def _set_traffic_weight(self, canary_pct: float) -> None:
+        set_weight = getattr(self._load_balancer, "set_weight", None)
+        if set_weight is None or not self._baseline_backends:
+            return
+        if canary_pct >= 1.0:
+            for backend in self._baseline_backends:
+                set_weight(backend, 1.0)
+            if self._canary is not None:
+                set_weight(self._canary, 1.0)
+            return
+        # canary gets pct of traffic; baselines split the remainder evenly.
+        if self._canary is not None:
+            set_weight(self._canary, canary_pct)
+        per_baseline = (1.0 - canary_pct) / len(self._baseline_backends)
+        for backend in self._baseline_backends:
+            set_weight(backend, per_baseline)
+
+    def _reset_weights(self) -> None:
+        set_weight = getattr(self._load_balancer, "set_weight", None)
+        if set_weight is None:
+            return
+        for backend in self._load_balancer.backends:
+            set_weight(backend, 1.0)

@@ -1,0 +1,183 @@
+"""Rolling deployer: batch-by-batch replacement with health gates.
+
+Parity target: ``happysimulator/components/deployment/rolling_deployer.py:54``
+(replace ``batch_size`` backends at a time; each new instance must answer
+a health-check request within ``health_check_timeout`` or the whole
+deployment rolls back to the original fleet).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class DeploymentState:
+    status: str = "idle"  # idle | in_progress | completed | rolled_back
+    replaced: int = 0
+    total: int = 0
+    pending_health: set = field(default_factory=set)
+
+
+@dataclass(frozen=True)
+class RollingDeployerStats:
+    deployments_started: int = 0
+    deployments_completed: int = 0
+    deployments_rolled_back: int = 0
+    instances_replaced: int = 0
+    health_checks_passed: int = 0
+    health_checks_failed: int = 0
+
+
+class RollingDeployer(Entity):
+    """Replaces a LoadBalancer's fleet in batches of ``batch_size``."""
+
+    def __init__(
+        self,
+        name: str,
+        load_balancer: Entity,
+        server_factory: Callable[[str], Entity],
+        batch_size: int = 1,
+        health_check_timeout: float = 5.0,
+        batch_delay: float = 1.0,
+    ):
+        super().__init__(name)
+        self._load_balancer = load_balancer
+        self._server_factory = server_factory
+        self._batch_size = max(1, batch_size)
+        self._health_check_timeout = health_check_timeout
+        self._batch_delay = batch_delay
+        self._initial_fleet: list[Entity] = []
+        self._old_backends: list[Entity] = []
+        self._new_backends: list[Entity] = []
+        self._next_id = 0
+        self._deployments_started = 0
+        self._deployments_completed = 0
+        self._deployments_rolled_back = 0
+        self._instances_replaced = 0
+        self._health_checks_passed = 0
+        self._health_checks_failed = 0
+        self.state = DeploymentState()
+
+    def downstream_entities(self) -> list[Entity]:
+        return [self._load_balancer]
+
+    @property
+    def stats(self) -> RollingDeployerStats:
+        return RollingDeployerStats(
+            deployments_started=self._deployments_started,
+            deployments_completed=self._deployments_completed,
+            deployments_rolled_back=self._deployments_rolled_back,
+            instances_replaced=self._instances_replaced,
+            health_checks_passed=self._health_checks_passed,
+            health_checks_failed=self._health_checks_failed,
+        )
+
+    def deploy(self) -> Event:
+        at = self.now if self._clock is not None else Instant.Epoch
+        return Event(at, "_rolling_start", target=self)
+
+    def handle_event(self, event: Event):
+        et = event.event_type
+        if et == "_rolling_start":
+            return self._start()
+        if et == "_rolling_batch":
+            return self._replace_batch()
+        if et == "_rolling_health_pass":
+            return self._health_pass(event)
+        if et == "_rolling_health_timeout":
+            return self._health_timeout(event)
+        return None
+
+    # -- phases ------------------------------------------------------------
+    def _start(self) -> list[Event]:
+        self._initial_fleet = list(self._load_balancer.backends)
+        self._old_backends = list(self._initial_fleet)
+        self.state = DeploymentState(status="in_progress", total=len(self._old_backends))
+        self._deployments_started += 1
+        return [Event(self.now, "_rolling_batch", target=self)]
+
+    def _replace_batch(self) -> list[Event]:
+        if self.state.status != "in_progress":
+            return []
+        if not self._old_backends:
+            self.state.status = "completed"
+            self._deployments_completed += 1
+            return []
+        produced: list[Event] = []
+        batch = self._old_backends[: self._batch_size]
+        self._old_backends = self._old_backends[self._batch_size :]
+        for old in batch:
+            self._load_balancer.remove_backend(old)
+            self._next_id += 1
+            server_name = f"{self.name}_v2_{self._next_id}"
+            new_server = self._server_factory(server_name)
+            if self._clock is not None:
+                new_server.set_clock(self._clock)
+            self._load_balancer.add_backend(new_server)
+            self._new_backends.append(new_server)
+            self.state.pending_health.add(server_name)
+            # Health check: the new instance must answer a request before
+            # the timeout (its completion hook races the timeout event).
+            probe = Event(self.now, "health_check", target=new_server)
+
+            def on_healthy(finish_time: Instant, name=server_name) -> Event:
+                return Event(
+                    finish_time,
+                    "_rolling_health_pass",
+                    target=self,
+                    context={"metadata": {"server": name}},
+                )
+
+            probe.add_completion_hook(on_healthy)
+            produced.append(probe)
+            produced.append(
+                Event(
+                    self.now + self._health_check_timeout,
+                    "_rolling_health_timeout",
+                    target=self,
+                    daemon=True,
+                    context={"metadata": {"server": server_name}},
+                )
+            )
+        return produced
+
+    def _health_pass(self, event: Event) -> Optional[list[Event]]:
+        name = event.context.get("metadata", {}).get("server")
+        if name not in self.state.pending_health:
+            return None
+        self.state.pending_health.discard(name)
+        self._health_checks_passed += 1
+        self._instances_replaced += 1
+        self.state.replaced += 1
+        if self.state.pending_health:
+            return None  # batch still settling
+        return [Event(self.now + self._batch_delay, "_rolling_batch", target=self)]
+
+    def _health_timeout(self, event: Event) -> Optional[list[Event]]:
+        name = event.context.get("metadata", {}).get("server")
+        if name not in self.state.pending_health:
+            return None  # passed in time
+        self._health_checks_failed += 1
+        return self._rollback()
+
+    def _rollback(self) -> list[Event]:
+        """Remove all v2 instances and restore the original fleet."""
+        self.state.status = "rolled_back"
+        self._deployments_rolled_back += 1
+        for new_server in self._new_backends:
+            self._load_balancer.remove_backend(new_server)
+        current_names = {b.name for b in self._load_balancer.backends}
+        for original in self._initial_fleet:
+            if original.name not in current_names:
+                self._load_balancer.add_backend(original)
+        self.state.pending_health.clear()
+        return []

@@ -1,0 +1,26 @@
+"""Scheduling components — DAG jobs + work-stealing pool.
+
+Parity target: ``happysimulator/components/scheduling/`` (SURVEY.md §2.4).
+"""
+
+from happysim_tpu.components.scheduling.job_scheduler import (
+    JobDefinition,
+    JobScheduler,
+    JobSchedulerStats,
+    JobState,
+)
+from happysim_tpu.components.scheduling.work_stealing_pool import (
+    WorkStealingPool,
+    WorkStealingPoolStats,
+    WorkerStats,
+)
+
+__all__ = [
+    "JobDefinition",
+    "JobScheduler",
+    "JobSchedulerStats",
+    "JobState",
+    "WorkStealingPool",
+    "WorkStealingPoolStats",
+    "WorkerStats",
+]

@@ -1,0 +1,187 @@
+"""DAG job scheduler: dependency-ordered periodic dispatch.
+
+Parity target: ``happysimulator/components/scheduling/job_scheduler.py:82``
+(``JobDefinition`` :36 with dependencies; tick loop dispatches jobs whose
+deps completed; completion hooks mark jobs done and unblock dependents).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class JobDefinition:
+    name: str
+    target: Entity
+    event_type: str = "Run"
+    dependencies: tuple[str, ...] = ()
+    repeat: bool = False  # re-run each pass once deps complete again
+    enabled: bool = True
+
+
+@dataclass
+class JobState:
+    definition: JobDefinition
+    enabled: bool = True
+    running: bool = False
+    completed: bool = False
+    runs: int = 0
+    failures: int = 0
+    last_started: Optional[Instant] = None
+    last_completed: Optional[Instant] = None
+
+
+@dataclass(frozen=True)
+class JobSchedulerStats:
+    jobs_registered: int = 0
+    jobs_dispatched: int = 0
+    jobs_completed: int = 0
+    ticks: int = 0
+
+
+class JobScheduler(Entity):
+    """Tick-driven DAG executor: a job dispatches once every dependency
+    has completed; completion hooks on the dispatched event feed back."""
+
+    def __init__(self, name: str, tick_interval: float = 1.0):
+        super().__init__(name)
+        self._tick_interval = tick_interval
+        self._jobs: dict[str, JobState] = {}
+        self._is_running = False
+        self._jobs_dispatched = 0
+        self._jobs_completed = 0
+        self._ticks = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def tick_interval(self) -> float:
+        return self._tick_interval
+
+    @property
+    def job_names(self) -> list[str]:
+        return list(self._jobs)
+
+    @property
+    def running_jobs(self) -> list[str]:
+        return [n for n, s in self._jobs.items() if s.running]
+
+    @property
+    def is_running(self) -> bool:
+        return self._is_running
+
+    @property
+    def stats(self) -> JobSchedulerStats:
+        return JobSchedulerStats(
+            jobs_registered=len(self._jobs),
+            jobs_dispatched=self._jobs_dispatched,
+            jobs_completed=self._jobs_completed,
+            ticks=self._ticks,
+        )
+
+    def get_job_state(self, name: str) -> Optional[JobState]:
+        return self._jobs.get(name)
+
+    # -- job management ----------------------------------------------------
+    def add_job(self, job: JobDefinition) -> None:
+        if job.name in self._jobs:
+            raise ValueError(f"Job {job.name!r} already registered")
+        for dep in job.dependencies:
+            if dep not in self._jobs:
+                raise ValueError(f"Job {job.name!r} depends on unknown job {dep!r}")
+        self._jobs[job.name] = JobState(definition=job, enabled=job.enabled)
+
+    def remove_job(self, name: str) -> None:
+        self._jobs.pop(name, None)
+
+    def enable_job(self, name: str) -> None:
+        if name in self._jobs:
+            self._jobs[name].enabled = True
+
+    def disable_job(self, name: str) -> None:
+        if name in self._jobs:
+            self._jobs[name].enabled = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> Event:
+        self._is_running = True
+        at = self.now if self._clock is not None else Instant.Epoch
+        return self._tick_event(at)
+
+    def _tick_event(self, at: Instant) -> Event:
+        # The tick is PRIMARY while unfinished jobs remain (they are real
+        # pending work — a daemon tick would let the sim auto-terminate at
+        # t=0); once every job completed it degrades to a daemon.
+        all_done = all(
+            s.completed or not s.enabled for s in self._jobs.values()
+        ) and bool(self._jobs)
+        return Event(at, "_scheduler_tick", target=self, daemon=all_done)
+
+    def stop(self) -> None:
+        self._is_running = False
+
+    def handle_event(self, event: Event):
+        if event.event_type == "_scheduler_tick":
+            return self._run_tick()
+        if event.event_type == "_job_complete":
+            self._mark_complete(event.context.get("metadata", {}).get("job"))
+            return None
+        return None
+
+    # -- internals ---------------------------------------------------------
+    def _deps_met(self, state: JobState) -> bool:
+        return all(
+            self._jobs[dep].completed
+            for dep in state.definition.dependencies
+            if dep in self._jobs
+        )
+
+    def _run_tick(self) -> Optional[list[Event]]:
+        if not self._is_running:
+            return None
+        self._ticks += 1
+        produced: list[Event] = []
+        for name, state in self._jobs.items():
+            if not state.enabled or state.running or state.completed:
+                continue
+            if not self._deps_met(state):
+                continue
+            state.running = True
+            state.runs += 1
+            state.last_started = self.now
+            self._jobs_dispatched += 1
+            work = Event(self.now, state.definition.event_type, target=state.definition.target)
+
+            def on_complete(finish_time: Instant, job_name=name) -> Event:
+                return Event(
+                    finish_time,
+                    "_job_complete",
+                    target=self,
+                    daemon=True,
+                    context={"metadata": {"job": job_name}},
+                )
+
+            work.add_completion_hook(on_complete)
+            produced.append(work)
+        produced.append(self._tick_event(self.now + self._tick_interval))
+        return produced
+
+    def _mark_complete(self, job_name: Optional[str]) -> None:
+        state = self._jobs.get(job_name or "")
+        if state is None:
+            return
+        state.running = False
+        state.last_completed = self.now
+        self._jobs_completed += 1
+        if state.definition.repeat:
+            state.completed = False  # eligible again next tick
+        else:
+            state.completed = True

@@ -58,6 +58,19 @@ class Server(QueuedResource):
     def worker_has_capacity(self) -> bool:
         return self.concurrency.has_capacity()
 
+    @property
+    def utilization(self) -> float:
+        """In-flight / concurrency limit; the auto-scaler's input signal."""
+        limit = getattr(self.concurrency, "limit", None)
+        if not limit:
+            return 0.0
+        return self.concurrency.active / limit
+
+    @property
+    def depth(self) -> int:
+        """Pending queue depth (QueueDepthScaling's input signal)."""
+        return self.queue_depth
+
     def handle_queued_event(self, event: Event):
         self.concurrency.acquire(event)
         self.requests_started += 1
