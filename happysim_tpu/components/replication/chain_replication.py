@@ -211,13 +211,20 @@ class ChainNode(Entity):
             future.resolve({"status": "ok", "seq": seq})
 
     def _commit_notifications(self, key: str, seq: int) -> list[Event]:
-        """CRAQ: tell upstream nodes the key is clean again."""
+        """CRAQ: tell MIDDLE nodes the key is clean again.
+
+        The head is deliberately excluded: it cleans its own dirty count
+        when the tail's WriteAck resolves the pending write, so notifying
+        it too would decrement twice per write and expose uncommitted
+        values to CRAQ reads at the head under overlapping writes.
+        """
         events = []
         node = self.prev_node
         while node is not None:
-            events.append(
-                self._network.send(self, node, "CommitNotify", payload={"key": key, "seq": seq})
-            )
+            if node._role is not ChainNodeRole.HEAD:
+                events.append(
+                    self._network.send(self, node, "CommitNotify", payload={"key": key, "seq": seq})
+                )
             node = node.prev_node
         return events
 

@@ -126,8 +126,8 @@ class LeaderNode(Entity):
             return self._handle_anti_entropy_tick(event)
         if event_type == "AntiEntropyRequest":
             return self._handle_anti_entropy_request(event)
-        if event_type == "AntiEntropyResponse":
-            return self._handle_anti_entropy_response(event)
+        if event_type == "AntiEntropySync":
+            return self._handle_anti_entropy_sync(event)
         return None
 
     # -- write / read ------------------------------------------------------
@@ -219,32 +219,63 @@ class LeaderNode(Entity):
         )
         return events
 
-    def _version_payload(self) -> dict[str, tuple]:
+    # Anti-entropy narrows divergence by exchanging range hashes (Dynamo /
+    # Cassandra style): each round compares subtree summaries and splits
+    # mismatched ranges in half, so repair traffic is O(divergence * log n)
+    # instead of shipping the whole version map on any root mismatch.
+    _SYNC_BATCH = 8  # ranges at or below this many local keys ship versions
+    _SYNC_MAX_DEPTH = 64  # bail out to direct exchange on pathological splits
+
+    @staticmethod
+    def _slice_range(
+        all_keys: list[str], start: Optional[str], end: Optional[str]
+    ) -> list[str]:
+        """Keys of the pre-sorted list in the half-open range [start, end)."""
+        import bisect
+
+        lo = 0 if start is None else bisect.bisect_left(all_keys, start)
+        hi = len(all_keys) if end is None else bisect.bisect_left(all_keys, end)
+        return all_keys[lo:hi]
+
+    def _range_hash(self, keys: list[str]) -> str:
+        from happysim_tpu.sketching.merkle_tree import hash_entries
+
+        return hash_entries(
+            (k, (v.value, str(v.timestamp), v.writer_id))
+            for k, v in ((k, self._versions[k]) for k in keys)
+        )
+
+    def _versions_for(self, keys: list[str]) -> dict[str, tuple]:
         return {
-            k: (v.value, v.timestamp, v.writer_id) for k, v in self._versions.items()
+            k: (self._versions[k].value, self._versions[k].timestamp, self._versions[k].writer_id)
+            for k in keys
         }
 
-    def _handle_anti_entropy_request(self, event: Event) -> Optional[list[Event]]:
-        meta = event.context.get("metadata", {})
-        if meta.get("root_hash") == self._merkle.root_hash:
-            return None  # already in sync — O(1) common case
-        sender = next(
-            (p for p in self._peers if p.name == meta.get("source")), None
-        )
-        if sender is None:
-            return None
-        return [
-            self._network.send(
-                self,
-                sender,
-                "AntiEntropyResponse",
-                payload={"versions": self._version_payload()},
-            )
-        ]
+    def _split_or_ship(
+        self,
+        all_keys: list[str],
+        start: Optional[str],
+        end: Optional[str],
+        depth: int,
+        out_ranges: list[tuple],
+        out_versions: dict[str, tuple],
+        out_want: list[tuple],
+    ) -> None:
+        """Divergent range [start, end): either ship + request versions
+        (small or too deep) or split at the local median and publish the
+        two sub-range hashes for the peer to compare."""
+        keys = self._slice_range(all_keys, start, end)
+        if len(keys) <= self._SYNC_BATCH or depth >= self._SYNC_MAX_DEPTH:
+            out_versions.update(self._versions_for(keys))
+            out_want.append((start, end))
+            return
+        mid_index = len(keys) // 2
+        mid = keys[mid_index]
+        out_ranges.append((start, mid, self._range_hash(keys[:mid_index])))
+        out_ranges.append((mid, end, self._range_hash(keys[mid_index:])))
 
-    def _handle_anti_entropy_response(self, event: Event) -> None:
-        meta = event.context.get("metadata", {})
-        for key, (value, timestamp, writer_id) in meta.get("versions", {}).items():
+    def _apply_incoming_versions(self, versions: dict[str, tuple]) -> None:
+        for key, (value, timestamp, writer_id) in versions.items():
             incoming = VersionedValue(value=value, timestamp=timestamp, writer_id=writer_id)
             current = self._versions.get(key)
             if current is None:
@@ -255,7 +286,79 @@ class LeaderNode(Entity):
                 if winner is not current:
                     self._apply_version(key, winner)
                     self._anti_entropy_repairs += 1
-        return None
+
+    def _handle_anti_entropy_request(self, event: Event) -> Optional[list[Event]]:
+        meta = event.context.get("metadata", {})
+        if meta.get("root_hash") == self._merkle.root_hash:
+            return None  # already in sync — O(1) common case
+        sender = next(
+            (p for p in self._peers if p.name == meta.get("source")), None
+        )
+        if sender is None:
+            return None
+        out_ranges: list[tuple] = []
+        out_versions: dict[str, tuple] = {}
+        out_want: list[tuple] = []
+        self._split_or_ship(
+            sorted(self._versions), None, None, 0, out_ranges, out_versions, out_want
+        )
+        return [
+            self._network.send(
+                self,
+                sender,
+                "AntiEntropySync",
+                payload={
+                    "ranges": out_ranges,
+                    "versions": out_versions,
+                    "want": out_want,
+                    "depth": 1,
+                },
+            )
+        ]
+
+    def _handle_anti_entropy_sync(self, event: Event) -> Optional[list[Event]]:
+        meta = event.context.get("metadata", {})
+        depth = meta.get("depth", 0)
+        sender = next(
+            (p for p in self._peers if p.name == meta.get("source")), None
+        )
+        incoming = meta.get("versions", {})
+        self._apply_incoming_versions(incoming)
+        all_keys = sorted(self._versions)
+        out_ranges: list[tuple] = []
+        out_versions: dict[str, tuple] = {}
+        out_want: list[tuple] = []
+        # Peer asked for our side of ranges it already shipped — reply with
+        # only what it doesn't already have (skip exact echoes).
+        for start, end in meta.get("want", []):
+            for key, version in self._versions_for(
+                self._slice_range(all_keys, start, end)
+            ).items():
+                if incoming.get(key) != version:
+                    out_versions[key] = version
+        # Compare the peer's sub-range hashes against our own data.
+        for start, end, their_hash in meta.get("ranges", []):
+            keys = self._slice_range(all_keys, start, end)
+            if self._range_hash(keys) == their_hash:
+                continue
+            self._split_or_ship(
+                all_keys, start, end, depth, out_ranges, out_versions, out_want
+            )
+        if sender is None or not (out_ranges or out_versions or out_want):
+            return None
+        return [
+            self._network.send(
+                self,
+                sender,
+                "AntiEntropySync",
+                payload={
+                    "ranges": out_ranges,
+                    "versions": out_versions,
+                    "want": out_want,
+                    "depth": depth + 1,
+                },
+            )
+        ]
 
     def __repr__(self) -> str:
         return f"LeaderNode({self.name}, keys={len(self._versions)})"

@@ -154,6 +154,14 @@ class LSMTree(Entity):
         self._memtable = Memtable(f"{name}_memtable", size_threshold=memtable_size)
         self._immutable_memtables: list[Memtable] = []
         self._next_flush_seq = 0
+        # WAL-truncation safety under overlapping flushes: each flush covers
+        # WAL sequences (base, frontier]; a prefix is only durable once every
+        # flush covering it has completed, so truncation stops at the oldest
+        # in-flight flush's base.
+        self._last_rotation_frontier = 0
+        self._inflight_flush_bases: dict[int, int] = {}
+        self._flush_ticket = 0
+        self._max_flushed_frontier = 0
         self._levels: list[list[SSTable]] = [[] for _ in range(max_levels)]
         self._logical_data: dict[str, Any] = {}
         self._user_bytes_written = 0
@@ -340,6 +348,7 @@ class LSMTree(Entity):
         # durable frontier NOW — writes that interleave during the flush
         # yield append newer WAL entries that must survive the truncate.
         flushed_up_to = self._wal._next_sequence - 1 if self._wal is not None else 0
+        ticket = self._begin_flush(flushed_up_to)
         pages = max(1, old.size // 16)
         yield pages * self._sstable_write_latency
         # Freeze AFTER the I/O yield: concurrent reads during the flush
@@ -351,8 +360,7 @@ class LSMTree(Entity):
         self._levels[0].append(sstable)
         self._total_memtable_flushes += 1
         self._immutable_memtables.remove(old)
-        if self._wal is not None:
-            self._wal.truncate(flushed_up_to)
+        self._finish_flush(ticket, flushed_up_to)
         if self._compaction_strategy.should_compact(self._levels):
             yield from self._compact()
 
@@ -360,15 +368,43 @@ class LSMTree(Entity):
         if self._memtable.size == 0:
             return
         flushed_up_to = self._wal._next_sequence - 1 if self._wal is not None else 0
+        ticket = self._begin_flush(flushed_up_to)
         sstable = self._memtable.flush(sequence=self._next_flush_seq)
         self._next_flush_seq += 1
         self._sstable_bytes_written += sstable.size_bytes
         self._levels[0].append(sstable)
         self._total_memtable_flushes += 1
-        if self._wal is not None:
-            self._wal.truncate(flushed_up_to)
+        self._finish_flush(ticket, flushed_up_to)
         if self._compaction_strategy.should_compact(self._levels):
             self._apply_compaction()
+
+    def _begin_flush(self, frontier: int) -> int:
+        """Register an in-flight flush covering (last rotation, frontier]."""
+        base = self._last_rotation_frontier
+        self._last_rotation_frontier = frontier
+        ticket = self._flush_ticket
+        self._flush_ticket += 1
+        self._inflight_flush_bases[ticket] = base
+        return ticket
+
+    def _finish_flush(self, ticket: int, frontier: int) -> None:
+        """Mark a flush durable and truncate the WAL as far as is safe.
+
+        Safe point: the base of the oldest flush still in flight (its WAL
+        entries are not yet in any SSTable), else the highest completed
+        frontier. Truncating to the completing flush's own frontier while
+        an older flush is pending would lose acknowledged writes on crash.
+        """
+        self._inflight_flush_bases.pop(ticket, None)
+        self._max_flushed_frontier = max(self._max_flushed_frontier, frontier)
+        if self._wal is None:
+            return
+        if self._inflight_flush_bases:
+            safe = min(self._inflight_flush_bases.values())
+        else:
+            safe = self._max_flushed_frontier
+        if safe > 0:
+            self._wal.truncate(safe)
 
     def _rotate_memtable(self) -> Memtable:
         old = self._memtable

@@ -16,7 +16,7 @@ from happysim_tpu.sketching.base import (
 from happysim_tpu.sketching.bloom_filter import BloomFilter
 from happysim_tpu.sketching.count_min_sketch import CountMinSketch
 from happysim_tpu.sketching.hyperloglog import HyperLogLog
-from happysim_tpu.sketching.merkle_tree import KeyRange, MerkleNode, MerkleTree
+from happysim_tpu.sketching.merkle_tree import KeyRange, MerkleNode, MerkleTree, hash_entries
 from happysim_tpu.sketching.reservoir import ReservoirSampler
 from happysim_tpu.sketching.tdigest import TDigest
 from happysim_tpu.sketching.topk import TopK
@@ -32,6 +32,7 @@ __all__ = [
     "MembershipSketch",
     "MerkleNode",
     "MerkleTree",
+    "hash_entries",
     "QuantileSketch",
     "ReservoirSampler",
     "SamplingSketch",

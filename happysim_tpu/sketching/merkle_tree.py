@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Iterable, Optional
 
 
 @dataclass(frozen=True, slots=True)
@@ -44,6 +44,19 @@ class MerkleNode:
     @property
     def is_leaf(self) -> bool:
         return self.left is None and self.right is None
+
+
+def hash_entries(entries: Iterable[tuple[str, Any]], seed: str = "range") -> str:
+    """Order-sensitive chain hash over (key, value) pairs.
+
+    Public building block for protocols (e.g. anti-entropy range sync) that
+    need to compare arbitrary key ranges with the same hashing scheme the
+    tree itself uses for leaves.
+    """
+    h = seed
+    for key, value in entries:
+        h = _hash_pair(h, _hash_kv(key, value))
+    return h
 
 
 def _hash_pair(a: str, b: str) -> str:

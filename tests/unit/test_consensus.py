@@ -613,3 +613,51 @@ class TestConsensusSafetyRegressions:
         # Followers stay in sync with the leader's term (no runaway).
         leader_term = next(e.current_term for e in electors if e.is_leader)
         assert all(abs(e.current_term - leader_term) <= 1 for e in electors)
+
+
+class TestAdvisorRegressions:
+    def test_deposed_leader_fails_pending_submissions(self):
+        """A leader stepping down must resolve its in-flight client futures
+        to None — never leave them to be falsely acked by a different
+        command committed at the same index by a newer leader."""
+        network, nodes = _raft_cluster(3)
+        futures = {}
+
+        class Client(Entity):
+            def handle_event(self, event):
+                leader = next((n for n in nodes if n.is_leader), None)
+                if leader is not None:
+                    futures["f"] = leader.submit(
+                        {"op": "set", "key": "z", "value": 9}
+                    )
+                    # Depose before any replication round-trip completes.
+                    leader._step_down(leader.current_term + 1)
+                return None
+
+        client = Client("client")
+        sim = Simulation(entities=[network, client, *nodes], duration=12.0)
+        for node in nodes:
+            sim.schedule(node.start())
+        sim.schedule(Event(t(5.0), "submit", target=client))
+        sim.run()
+        future = futures["f"]
+        assert future.is_resolved and future.value is None
+
+    def test_commit_with_different_term_does_not_ack_old_submitter(self):
+        """White-box: a pending future whose slot is filled by another
+        term's entry resolves None, not the new entry's result."""
+        network, nodes = _raft_cluster(1)
+        node = nodes[0]
+        sim = Simulation(entities=[network, *nodes], duration=3.0)
+        sim.schedule(node.start())
+        sim.run()  # single node elects itself leader
+        assert node.is_leader
+        future = node.submit({"op": "set", "key": "a", "value": 1})
+        index = node.log.last_index
+        # Simulate conflict truncation + a new leader's entry in the slot.
+        submit_term = node.current_term
+        node._log.truncate_from(index)
+        entry = node._log.append(submit_term + 1, {"op": "set", "key": "a", "value": 2})
+        node._current_term = submit_term + 1
+        node._apply_committed(node._log.advance_commit(entry.index))
+        assert future.is_resolved and future.value is None

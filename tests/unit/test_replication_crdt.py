@@ -422,3 +422,79 @@ class TestReviewRegressions:
         sim.run()
         # The cluster recovered a leader after the forced step-down.
         assert any(n.is_leader for n in nodes)
+
+
+class TestAdvisorRegressions:
+    def test_craq_head_stays_dirty_under_overlapping_writes(self):
+        """Two in-flight writes to one key: the head's dirty count must not
+        reach zero until BOTH commit (double-decrement regression)."""
+        network = make_network(0.01)
+        nodes = [
+            ChainNode(f"c{i}", KVStore(f"cs{i}", write_latency=0.001), network,
+                      craq_enabled=True)
+            for i in range(3)
+        ]
+        ChainNode.link_chain(nodes)
+        head = nodes[0]
+        observed = {}
+
+        class Checker(Entity):
+            def handle_event(self, event):
+                observed["dirty_mid_flight"] = set(head.dirty_keys)
+                return None
+
+        checker = Checker("checker")
+        sim = Simulation(entities=[network, checker, *nodes], duration=1.0)
+        sim.schedule(write_event(head, "k", "v1", at=0.0))
+        sim.schedule(write_event(head, "k", "v2", at=0.005))
+        # Write 1 commits at the head ~0.033s; write 2 not until ~0.038s.
+        sim.schedule(Event(t(0.035), "check", target=checker))
+        sim.run()
+        assert "k" in observed["dirty_mid_flight"]
+        assert head.dirty_keys == set()  # everything committed by the end
+
+    def test_anti_entropy_ships_only_divergent_ranges(self):
+        """Merkle sync must localize the diff, not ship the whole keyspace."""
+        network = make_network(0.01)
+        leaders = [
+            LeaderNode(f"L{i}", KVStore(f"ls{i}", write_latency=0.001), network,
+                       anti_entropy_interval=1.0, seed=i)
+            for i in range(2)
+        ]
+        for leader in leaders:
+            leader.add_peers(leaders)
+        for i in range(200):
+            version = VersionedValue(f"v{i}", 1.0, "L0")
+            leaders[0]._apply_version(f"key{i:03d}", version)
+            leaders[1]._apply_version(f"key{i:03d}", version)
+        leaders[0]._apply_version("key150x", VersionedValue("extra", 2.0, "L0"))
+
+        shipped = []
+        orig_send = network.send
+
+        def counting_send(source, destination, event_type, payload=None, **kwargs):
+            if event_type == "AntiEntropySync" and payload:
+                shipped.append(len(payload.get("versions", {})))
+            return orig_send(source, destination, event_type,
+                             payload=payload, **kwargs)
+
+        network.send = counting_send
+
+        class Kicker(Entity):
+            def handle_event(self, event):
+                events = []
+                for leader in leaders:
+                    kick = leader.get_anti_entropy_event()
+                    if kick is not None:
+                        events.append(kick)
+                return events
+
+        kicker = Kicker("kicker")
+        sim = Simulation(entities=[network, kicker, *leaders], duration=20.0)
+        sim.schedule(Event(t(0.0), "go", target=kicker))
+        sim.schedule(Event(t(15.0), "noop", target=kicker))
+        sim.run()
+        assert leaders[1].store.get_sync("key150x") == "extra"
+        assert leaders[0].merkle_tree.root_hash == leaders[1].merkle_tree.root_hash
+        # 201 keys total; the sync must ship far fewer than the full map.
+        assert shipped and sum(shipped) <= 40

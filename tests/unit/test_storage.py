@@ -466,3 +466,32 @@ class TestLSMConcurrencyRegressions:
         total_keys = sum(s.key_count for level in lsm._levels for s in level)
         assert total_keys < 40
         assert lsm.get_sync("k39") == 39  # newest survive
+
+
+class TestAdvisorRegressions:
+    def test_overlapping_flushes_truncate_only_durable_prefix(self):
+        """A later flush finishing first must not truncate WAL entries that
+        an earlier, still-in-flight flush has yet to make durable."""
+        wal = WriteAheadLog("wal", sync_policy=SyncEveryWrite())
+        lsm = LSMTree("db", memtable_size=1000, wal=wal)
+
+        def drain(gen):
+            try:
+                while True:
+                    next(gen)
+            except StopIteration:
+                pass
+
+        for i in range(5):
+            drain(lsm.put(f"a{i}", i))  # WAL seq 1-5
+        flush_a = lsm._flush_memtable()
+        next(flush_a)  # A in flight, covers seq 1-5
+        for i in range(5):
+            drain(lsm.put(f"b{i}", i))  # WAL seq 6-10
+        flush_b = lsm._flush_memtable()
+        next(flush_b)  # B in flight, covers seq 6-10
+        drain(flush_b)  # B completes FIRST
+        # A's entries (1-5) are not yet in any SSTable: nothing may go.
+        assert wal.size == 10
+        drain(flush_a)  # A completes: whole prefix is durable now
+        assert wal.size == 0
