@@ -498,6 +498,13 @@ class LSMTree(Entity):
         if self._clock is not None:
             self._memtable.set_clock(self._clock)
         self._immutable_memtables.clear()
+        # In-flight flushes died with the process: their tickets must not
+        # keep pinning the WAL truncation point after recovery. The WAL
+        # entries they covered survive (below) and are replayed on recover,
+        # so the durability frontier restarts from the post-crash WAL.
+        self._inflight_flush_bases.clear()
+        self._last_rotation_frontier = 0
+        self._max_flushed_frontier = 0
         wal_lost = self._wal.crash() if self._wal is not None else 0
         return {
             "memtable_entries_lost": memtable_lost,

@@ -52,6 +52,9 @@ class SimulationSummary:
     completed: bool = True  # False when paused by control/breakpoint
     backend: str = "python"
     replicas: int = 1
+    # Ensemble honesty flag: replicas whose event budget ran out before the
+    # horizon. Non-zero means statistics are biased toward early sim-time.
+    truncated_replicas: int = 0
 
     @property
     def simulated_seconds(self) -> float:
@@ -73,6 +76,7 @@ class SimulationSummary:
             "completed": self.completed,
             "backend": self.backend,
             "replicas": self.replicas,
+            "truncated_replicas": self.truncated_replicas,
             "entities": [e.to_dict() for e in self.entities],
         }
 
@@ -86,6 +90,11 @@ class SimulationSummary:
             + (f", replicas={self.replicas}" if self.replicas > 1 else "")
             + ")",
         ]
+        if self.truncated_replicas:
+            lines.append(
+                f"  WARNING: {self.truncated_replicas} replicas hit the event"
+                " budget before the horizon (stats biased early)"
+            )
         for entity in self.entities:
             parts = [f"    {entity.name} [{entity.kind}]"]
             if entity.events_received is not None:

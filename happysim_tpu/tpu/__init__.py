@@ -16,7 +16,7 @@ from happysim_tpu.tpu.mesh import (
 )
 from happysim_tpu.tpu.engine import EnsembleResult, hist_percentile, run_ensemble
 from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
-from happysim_tpu.tpu.model import EnsembleModel, mm1_model
+from happysim_tpu.tpu.model import EnsembleModel, mm1_model, pipeline_model
 
 __all__ = [
     "EnsembleModel",
@@ -24,6 +24,7 @@ __all__ = [
     "MM1Result",
     "hist_percentile",
     "mm1_model",
+    "pipeline_model",
     "run_ensemble",
     "run_mm1_ensemble",
     "REPLICA_AXIS",
@@ -31,5 +32,4 @@ __all__ = [
     "replica_mesh",
     "replica_sharding",
     "replicated_sharding",
-    "run_mm1_ensemble",
 ]

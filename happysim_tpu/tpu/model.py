@@ -139,9 +139,6 @@ class EnsembleModel:
         else:
             raise ValueError("Sinks have no downstream")
 
-    def pipeline(self, *stages_args, **kwargs):
-        raise NotImplementedError  # reserved
-
     # -- validation --------------------------------------------------------
     def validate(self) -> None:
         if not self.sources:
@@ -184,6 +181,40 @@ class EnsembleModel:
     @property
     def max_queue_capacity(self) -> int:
         return max((s.queue_capacity for s in self.servers), default=1)
+
+
+def pipeline_model(
+    rate: float,
+    service_means: Sequence[float],
+    horizon_s: float = 60.0,
+    queue_capacity: int = 512,
+    concurrency: int = 1,
+    kind: str = "poisson",
+) -> EnsembleModel:
+    """A tandem queueing network: source -> server chain -> sink.
+
+    The compiled counterpart of the reference's pipeline scenarios
+    (``happysimulator/mcp/tools.py:58`` builds the same shape on the host
+    executor).
+    """
+    if not service_means:
+        raise ValueError("pipeline_model needs at least one stage")
+    model = EnsembleModel(horizon_s=horizon_s)
+    src = model.source(rate=rate, kind=kind)
+    stages = [
+        model.server(
+            concurrency=concurrency,
+            service_mean=mean,
+            queue_capacity=queue_capacity,
+        )
+        for mean in service_means
+    ]
+    snk = model.sink()
+    model.connect(src, stages[0])
+    for upstream, downstream in zip(stages, stages[1:]):
+        model.connect(upstream, downstream)
+    model.connect(stages[-1], snk)
+    return model
 
 
 def mm1_model(lam: float = 8.0, mu: float = 10.0, horizon_s: float = 60.0,

@@ -159,3 +159,36 @@ class TestValidation:
         r1 = model.router(policy="random")
         with pytest.raises(ValueError):
             model.connect(r1, model.router(policy="random"))
+
+
+class TestPipeline:
+    def test_tandem_chain_matches_jackson_theory(self, mesh):
+        """Two M/M/1 stages in tandem: by Burke's theorem stage-2 arrivals
+        are Poisson(lam), so mean end-to-end sojourn is
+        1/(mu1-lam) + 1/(mu2-lam)."""
+        from happysim_tpu.tpu.model import pipeline_model
+
+        lam, mu1, mu2 = 5.0, 10.0, 8.0
+        model = pipeline_model(
+            rate=lam, service_means=[1.0 / mu1, 1.0 / mu2], horizon_s=120.0
+        )
+        result = run_ensemble(model, n_replicas=512, seed=3, mesh=mesh)
+        expected = 1.0 / (mu1 - lam) + 1.0 / (mu2 - lam)
+        assert result.sink_mean_latency_s[0] == pytest.approx(expected, rel=0.1)
+        # Both stages completed essentially everything that was started.
+        assert result.server_completed[1] == result.sink_count[0]
+        assert result.server_dropped == [0, 0]
+        assert result.truncated_replicas == 0
+
+    def test_single_stage_equals_mm1(self, mesh):
+        from happysim_tpu.tpu.model import pipeline_model
+
+        model = pipeline_model(rate=8.0, service_means=[0.1], horizon_s=120.0)
+        result = run_ensemble(model, n_replicas=256, seed=0, mesh=mesh)
+        assert result.sink_mean_latency_s[0] == pytest.approx(0.5, rel=0.1)
+
+    def test_empty_pipeline_rejected(self):
+        from happysim_tpu.tpu.model import pipeline_model
+
+        with pytest.raises(ValueError):
+            pipeline_model(rate=1.0, service_means=[])

@@ -495,3 +495,27 @@ class TestAdvisorRegressions:
         assert wal.size == 10
         drain(flush_a)  # A completes: whole prefix is durable now
         assert wal.size == 0
+
+    def test_crash_mid_flush_does_not_pin_wal_truncation(self):
+        """A flush interrupted by a crash must not leave a ticket that
+        blocks WAL truncation forever."""
+        wal = WriteAheadLog("wal", sync_policy=SyncEveryWrite())
+        lsm = LSMTree("db", memtable_size=1000, wal=wal)
+
+        def drain(gen):
+            try:
+                while True:
+                    next(gen)
+            except StopIteration:
+                pass
+
+        for i in range(4):
+            drain(lsm.put(f"a{i}", i))
+        interrupted = lsm._flush_memtable()
+        next(interrupted)  # in flight when the node dies
+        lsm.crash()
+        lsm.recover_from_crash()
+        for i in range(4):
+            drain(lsm.put(f"b{i}", i))
+        drain(lsm._flush_memtable())  # a post-recovery flush completes
+        assert wal.size == 0  # truncation advanced; nothing pinned
