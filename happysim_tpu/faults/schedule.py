@@ -18,7 +18,7 @@ from happysim_tpu.faults.fault import (
     FaultContext,
     FaultHandle,
     FaultStats,
-    _MutableFaultStats,
+    _FaultLedger,
 )
 
 if TYPE_CHECKING:
@@ -43,7 +43,7 @@ class FaultSchedule(Entity):
         super().__init__(name)
         self._faults: list[Fault] = []
         self._handles: list[FaultHandle] = []
-        self._stats = _MutableFaultStats()
+        self._ledger = _FaultLedger()
         self._sim: "Simulation | None" = None
 
     def add(self, fault: Fault) -> FaultHandle:
@@ -51,7 +51,7 @@ class FaultSchedule(Entity):
         handle = FaultHandle(fault)
         self._faults.append(fault)
         self._handles.append(handle)
-        self._stats.faults_scheduled += 1
+        self._ledger.bump("scheduled")
         return handle
 
     def bind(self, sim: "Simulation") -> None:
@@ -65,9 +65,9 @@ class FaultSchedule(Entity):
         all_events: "list[Event]" = []
         for fault, handle in zip(self._faults, self._handles):
             events = fault.generate_events(ctx)
-            # Alias (don't copy): self-perpetuating faults append their
-            # later events to this same list so cancel() reaches them.
-            handle._events = events
+            # attach() aliases the list: self-perpetuating faults append
+            # their later events to it so cancel() reaches them.
+            handle.attach(events)
             all_events.extend(events)
         logger.info(
             "[%s] %d fault(s) -> %d event(s)", self.name, len(self._faults), len(all_events)
@@ -76,8 +76,8 @@ class FaultSchedule(Entity):
 
     @property
     def stats(self) -> FaultStats:
-        self._stats.faults_cancelled = sum(1 for h in self._handles if h.cancelled)
-        return self._stats.freeze()
+        cancelled = sum(1 for h in self._handles if h.cancelled)
+        return self._ledger.freeze(cancelled)
 
     def handle_event(self, event) -> None:
         """Fault events carry their own callbacks; nothing to do here."""

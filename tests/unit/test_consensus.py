@@ -523,7 +523,7 @@ class TestConsensusSafetyRegressions:
         # The response's match_index must be 1 (verified prefix), not 3.
         # We can't intercept the message easily; assert via leader's view:
         # replay the handler directly for a white-box check.
-        produced = follower._handle_append_entries(event)
+        produced = follower._on_append_entries(event)
         response = [e for e in produced if e.event_type == "RaftAppendEntriesResponse"]
         assert response
         assert response[0].context["metadata"]["match_index"] == 1

@@ -403,7 +403,7 @@ class TestReviewRegressions:
                     return None
                 # Stale-log candidate forces step-down WITHOUT vote grant.
                 leader._log.append(leader.current_term, "entry")
-                return leader._handle_request_vote(
+                return leader._on_request_vote(
                     Event(self.now, "RaftRequestVote", target=leader,
                           context={"metadata": {
                               "term": leader.current_term + 1,

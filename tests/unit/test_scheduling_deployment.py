@@ -171,8 +171,6 @@ class TestAutoScaler:
             scale_out_threshold=5, scale_in_threshold=0),
             min_instances=1, max_instances=5,
             evaluation_interval=1.0, scale_out_cooldown=0.0, scale_in_cooldown=0.0)
-        # Pre-scale out manually, then let it idle back down.
-        scaler._try_scale_out = scaler._try_scale_out  # noqa: PLW0127
         sim = Simulation(entities=[lb, scaler, *servers], duration=20.0)
         sim.schedule(scaler.start())
         sim.run()
