@@ -1,0 +1,42 @@
+"""Microservice patterns: gateway, saga, sidecar, outbox, idempotency."""
+
+from happysim_tpu.components.microservice.api_gateway import (
+    APIGateway,
+    APIGatewayStats,
+    RouteConfig,
+)
+from happysim_tpu.components.microservice.idempotency_store import (
+    IdempotencyStore,
+    IdempotencyStoreStats,
+)
+from happysim_tpu.components.microservice.outbox_relay import (
+    OutboxEntry,
+    OutboxRelay,
+    OutboxRelayStats,
+)
+from happysim_tpu.components.microservice.saga import (
+    Saga,
+    SagaState,
+    SagaStats,
+    SagaStep,
+    SagaStepResult,
+)
+from happysim_tpu.components.microservice.sidecar import Sidecar, SidecarStats
+
+__all__ = [
+    "APIGateway",
+    "APIGatewayStats",
+    "IdempotencyStore",
+    "IdempotencyStoreStats",
+    "OutboxEntry",
+    "OutboxRelay",
+    "OutboxRelayStats",
+    "RouteConfig",
+    "Saga",
+    "SagaState",
+    "SagaStats",
+    "SagaStep",
+    "SagaStepResult",
+    "Sidecar",
+    "SidecarStats",
+]

@@ -1,0 +1,34 @@
+"""Shared in-flight call ledger for proxy-style components.
+
+Both the API gateway and the sidecar forward a request, then race a
+response completion-hook against a timeout event; whichever lands second
+must be ignored. ``PendingCalls`` centralizes that settle-once discipline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class PendingCalls:
+    """Monotonic call ids with settle-exactly-once semantics."""
+
+    def __init__(self) -> None:
+        self._serial = 0
+        self._open: dict[int, dict[str, Any]] = {}
+
+    def issue(self, **info: Any) -> int:
+        """Register a new in-flight call; returns its id."""
+        self._serial += 1
+        self._open[self._serial] = info
+        return self._serial
+
+    def settle(self, call_id: Optional[int]) -> Optional[dict[str, Any]]:
+        """Close the call and return its info — None if unknown or already
+        settled (the race loser gets None and must do nothing)."""
+        if call_id is None:
+            return None
+        return self._open.pop(call_id, None)
+
+    def __len__(self) -> int:
+        return len(self._open)

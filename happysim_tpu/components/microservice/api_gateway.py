@@ -1,0 +1,210 @@
+"""API gateway: route table, per-route rate limits, auth, backend fan-in.
+
+Role parity: ``happysimulator/components/microservice/api_gateway.py:73``.
+
+Request pipeline: extract route key -> auth (latency + probabilistic
+reject) -> per-route rate limit -> round-robin backend pick -> forward
+with optional timeout tracking.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Callable, Generator, Optional
+
+from happysim_tpu.components.microservice._tracking import PendingCalls
+from happysim_tpu.components.rate_limiter.policy import RateLimiterPolicy
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.utils.stats import stable_seed
+
+logger = logging.getLogger(__name__)
+
+_RESPONSE = "_gw_response"
+_TIMEOUT = "_gw_timeout"
+
+
+@dataclass
+class RouteConfig:
+    """One route: its backends and the policy knobs applied to it."""
+
+    name: str
+    backends: list[Entity] = field(default_factory=list)
+    rate_limit_policy: Optional[RateLimiterPolicy] = None
+    auth_required: bool = True
+    timeout: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class APIGatewayStats:
+    total_requests: int = 0
+    requests_routed: int = 0
+    requests_rejected_auth: int = 0
+    requests_rejected_rate_limit: int = 0
+    requests_no_route: int = 0
+    requests_no_backend: int = 0
+    per_route_requests: dict[str, int] = field(default_factory=dict)
+
+
+class APIGateway(Entity):
+    """Single entry point fronting per-route backend pools.
+
+    The route key comes from ``route_extractor(event)`` (default:
+    ``metadata.route``). Auth rejection is probabilistic with a seeded
+    RNG, so gateway runs are reproducible.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        routes: dict[str, RouteConfig],
+        auth_latency: float = 0.001,
+        auth_failure_rate: float = 0.0,
+        route_extractor: Optional[Callable[[Event], Optional[str]]] = None,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(name)
+        if not routes:
+            raise ValueError("APIGateway needs at least one route")
+        if auth_latency < 0:
+            raise ValueError(f"auth_latency must be >= 0, was {auth_latency}")
+        if not 0.0 <= auth_failure_rate <= 1.0:
+            raise ValueError(
+                f"auth_failure_rate outside [0, 1]: {auth_failure_rate}"
+            )
+        self._routes = dict(routes)
+        self._auth_latency = auth_latency
+        self._auth_failure_rate = auth_failure_rate
+        self._pick_route = route_extractor or (
+            lambda e: e.context.get("metadata", {}).get("route")
+        )
+        self._rng = random.Random(seed if seed is not None else stable_seed(name))
+        self._rr_cursor: Counter = Counter()
+        self._pending = PendingCalls()
+        self._tally: Counter = Counter()
+        self._route_tally: Counter = Counter()
+
+    # -- introspection -----------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        fanout: list[Entity] = []
+        seen: set[str] = set()
+        for route in self._routes.values():
+            for backend in route.backends:
+                if backend.name not in seen:
+                    seen.add(backend.name)
+                    fanout.append(backend)
+        return fanout
+
+    @property
+    def stats(self) -> APIGatewayStats:
+        return APIGatewayStats(
+            total_requests=self._tally["total"],
+            requests_routed=self._tally["routed"],
+            requests_rejected_auth=self._tally["auth_rejected"],
+            requests_rejected_rate_limit=self._tally["rate_limited"],
+            requests_no_route=self._tally["no_route"],
+            requests_no_backend=self._tally["no_backend"],
+            per_route_requests=dict(self._route_tally),
+        )
+
+    @property
+    def routes(self) -> dict[str, RouteConfig]:
+        return dict(self._routes)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    # -- pipeline ----------------------------------------------------------
+    def handle_event(self, event: Event):
+        if event.event_type == _RESPONSE or event.event_type == _TIMEOUT:
+            self._pending.settle(
+                event.context.get("metadata", {}).get("call_id")
+            )
+            return None
+        return self._admit(event)
+
+    def _admit(self, event: Event):
+        self._tally["total"] += 1
+        key = self._pick_route(event)
+        route = self._routes.get(key) if key is not None else None
+        if route is None:
+            self._tally["no_route"] += 1
+            logger.debug("[%s] no route for key=%r", self.name, key)
+            return None
+        self._route_tally[key] += 1
+        if route.auth_required:
+            return self._authenticate_then_route(event, key, route)
+        return self._route(event, key, route)
+
+    def _authenticate_then_route(
+        self, event: Event, key: str, route: RouteConfig
+    ) -> Generator[float, None, list[Event]]:
+        if self._auth_latency > 0:
+            yield self._auth_latency
+        if self._auth_failure_rate > 0 and self._rng.random() < self._auth_failure_rate:
+            self._tally["auth_rejected"] += 1
+            logger.debug("[%s] auth rejected on %s", self.name, key)
+            return []
+        return self._route(event, key, route) or []
+
+    def _route(self, event: Event, key: str, route: RouteConfig) -> Optional[list[Event]]:
+        policy = route.rate_limit_policy
+        if policy is not None and not policy.try_acquire(self.now):
+            self._tally["rate_limited"] += 1
+            return None
+        if not route.backends:
+            self._tally["no_backend"] += 1
+            return None
+        cursor = self._rr_cursor[key]
+        self._rr_cursor[key] += 1
+        backend = route.backends[cursor % len(route.backends)]
+        return self._forward(event, key, backend, route.timeout)
+
+    def _forward(
+        self, event: Event, key: str, backend: Entity, timeout: Optional[float]
+    ) -> list[Event]:
+        call_id = self._pending.issue(route=key, started=self.now)
+        self._tally["routed"] += 1
+        relay = Event(
+            self.now,
+            event.event_type,
+            target=backend,
+            context={
+                **event.context,
+                "metadata": {
+                    **event.context.get("metadata", {}),
+                    "_gw_call_id": call_id,
+                    "_gw_name": self.name,
+                    "_gw_route": key,
+                },
+            },
+        )
+
+        def acknowledge(finish_time: Instant) -> Event:
+            return Event(
+                finish_time,
+                _RESPONSE,
+                target=self,
+                context={"metadata": {"call_id": call_id}},
+            )
+
+        relay.add_completion_hook(acknowledge)
+        for hook in event.on_complete:
+            relay.add_completion_hook(hook)
+        out = [relay]
+        if timeout is not None:
+            out.append(
+                Event(
+                    self.now + timeout,
+                    _TIMEOUT,
+                    target=self,
+                    context={"metadata": {"call_id": call_id}},
+                    daemon=True,
+                )
+            )
+        return out

@@ -1,0 +1,185 @@
+"""Idempotency store: duplicate-request suppression in front of a target.
+
+Role parity: ``happysimulator/components/microservice/idempotency_store.py:49``.
+
+Each request's idempotency key (via ``key_extractor``) is checked against
+a TTL cache and the in-flight set; duplicates are dropped, unique keys
+forward and are cached when the forwarded work completes. A periodic
+sweep expires old entries; capacity overflow evicts oldest-first.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+_DONE = "_idem_response"
+_SWEEP = "_idem_cleanup"
+
+
+@dataclass(frozen=True)
+class IdempotencyStoreStats:
+    total_requests: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    entries_expired: int = 0
+    entries_stored: int = 0
+
+
+class IdempotencyStore(Entity):
+    """Forward-once filter keyed by each request's idempotency key."""
+
+    def __init__(
+        self,
+        name: str,
+        target: Entity,
+        key_extractor: Callable[[Event], Optional[str]],
+        ttl: float = 300.0,
+        max_entries: int = 10_000,
+        cleanup_interval: float = 60.0,
+    ):
+        super().__init__(name)
+        if ttl <= 0:
+            raise ValueError(f"ttl must be > 0, was {ttl}")
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, was {max_entries}")
+        if cleanup_interval <= 0:
+            raise ValueError(f"cleanup_interval must be > 0, was {cleanup_interval}")
+        self._target = target
+        self._extract_key = key_extractor
+        self._ttl = ttl
+        self._max_entries = max_entries
+        self._sweep_every = cleanup_interval
+        # key -> cached-at (dicts iterate in insertion order = oldest first)
+        self._seen: dict[str, Instant] = {}
+        self._in_flight: set[str] = set()
+        self._tally: Counter = Counter()
+
+    # -- introspection -----------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return [self._target]
+
+    @property
+    def target(self) -> Entity:
+        return self._target
+
+    @property
+    def stats(self) -> IdempotencyStoreStats:
+        return IdempotencyStoreStats(
+            total_requests=self._tally["requests"],
+            cache_hits=self._tally["hits"],
+            cache_misses=self._tally["misses"],
+            entries_expired=self._tally["expired"],
+            entries_stored=self._tally["stored"],
+        )
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._seen)
+
+    @property
+    def in_flight_count(self) -> int:
+        return len(self._in_flight)
+
+    # -- request path ------------------------------------------------------
+    def handle_event(self, event: Event):
+        kind = event.event_type
+        if kind == _SWEEP:
+            return self._sweep(event)
+        if kind == _DONE:
+            return self._settle(event)
+        return self._filter(event)
+
+    def _filter(self, event: Event) -> Optional[list[Event]]:
+        self._tally["requests"] += 1
+        key = self._extract_key(event)
+        if key is None:
+            return self._forward(event, key=None)  # opt-out: no dedup
+        if key in self._seen or key in self._in_flight:
+            self._tally["hits"] += 1
+            logger.debug("[%s] duplicate suppressed: %s", self.name, key)
+            return None
+        self._tally["misses"] += 1
+        return self._forward(event, key=key)
+
+    def _forward(self, event: Event, *, key: Optional[str]) -> list[Event]:
+        if key is not None:
+            self._in_flight.add(key)
+        relay = Event(
+            self.now,
+            event.event_type,
+            target=self._target,
+            context={
+                **event.context,
+                "metadata": {
+                    **event.context.get("metadata", {}),
+                    "_idem_name": self.name,
+                },
+            },
+        )
+        if key is not None:
+
+            def mark_done(finish_time: Instant) -> Event:
+                return Event(
+                    finish_time,
+                    _DONE,
+                    target=self,
+                    context={"metadata": {"key": key}},
+                )
+
+            relay.add_completion_hook(mark_done)
+        for hook in event.on_complete:
+            relay.add_completion_hook(hook)
+        out = [relay]
+        # First traffic through an idle store also arms the sweep loop.
+        if not self._seen and len(self._in_flight) <= 1:
+            out.append(self._arm_sweep())
+        return out
+
+    def _settle(self, event: Event) -> None:
+        key = event.context.get("metadata", {}).get("key")
+        if key is None:
+            return None
+        self._in_flight.discard(key)
+        if len(self._seen) >= self._max_entries:
+            oldest = next(iter(self._seen))
+            del self._seen[oldest]
+            self._tally["expired"] += 1
+        self._seen[key] = self.now
+        self._tally["stored"] += 1
+        return None
+
+    # -- expiry ------------------------------------------------------------
+    def _sweep(self, event: Event) -> Optional[list[Event]]:
+        stale = [
+            key
+            for key, cached_at in self._seen.items()
+            if (self.now - cached_at).to_seconds() >= self._ttl
+        ]
+        for key in stale:
+            del self._seen[key]
+            self._tally["expired"] += 1
+        if stale:
+            logger.debug(
+                "[%s] expired %d entries (%d live)",
+                self.name, len(stale), len(self._seen),
+            )
+        if self._seen or self._in_flight:
+            return [self._arm_sweep()]
+        return None  # go quiet until the next request re-arms
+
+    def _arm_sweep(self) -> Event:
+        at = (
+            self.now + self._sweep_every
+            if self._clock is not None
+            else Instant.Epoch
+        )
+        return Event(at, _SWEEP, target=self, daemon=True)

@@ -1,0 +1,163 @@
+"""Transactional outbox with a self-scheduling poll relay.
+
+Role parity: ``happysimulator/components/microservice/outbox_relay.py:62``.
+
+Business code calls ``write(payload)`` (atomically with its own state
+change, in the modeled world); a poll daemon drains unrelayed entries in
+batches to the downstream entity, tracking write->relay lag.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter, deque
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+_POLL = "_outbox_poll"
+
+
+@dataclass
+class OutboxEntry:
+    entry_id: int
+    payload: dict[str, Any]
+    written_at: Instant
+    relayed: bool = False
+
+
+@dataclass(frozen=True)
+class OutboxRelayStats:
+    entries_written: int = 0
+    entries_relayed: int = 0
+    relay_failures: int = 0
+    poll_cycles: int = 0
+    relay_lag_sum: float = 0.0
+    relay_lag_max: float = 0.0
+
+    @property
+    def avg_relay_lag(self) -> float:
+        if self.entries_relayed == 0:
+            return 0.0
+        return self.relay_lag_sum / self.entries_relayed
+
+
+class OutboxRelay(Entity):
+    """In-memory outbox drained by a periodic batch relay."""
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        poll_interval: float = 0.1,
+        batch_size: int = 100,
+        relay_latency: float = 0.001,
+    ):
+        super().__init__(name)
+        if poll_interval <= 0:
+            raise ValueError(f"poll_interval must be > 0, was {poll_interval}")
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, was {batch_size}")
+        if relay_latency < 0:
+            raise ValueError(f"relay_latency must be >= 0, was {relay_latency}")
+        self._downstream = downstream
+        self._poll_interval = poll_interval
+        self._batch_size = batch_size
+        self._relay_latency = relay_latency
+        self._backlog: deque[OutboxEntry] = deque()  # unrelayed, FIFO
+        self._serial = 0
+        self._poll_armed = False
+        self._tally: Counter = Counter()
+        self._lag_sum = 0.0
+        self._lag_max = 0.0
+
+    # -- introspection -----------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return [self._downstream]
+
+    @property
+    def stats(self) -> OutboxRelayStats:
+        return OutboxRelayStats(
+            entries_written=self._tally["written"],
+            entries_relayed=self._tally["relayed"],
+            relay_failures=self._tally["failures"],
+            poll_cycles=self._tally["polls"],
+            relay_lag_sum=self._lag_sum,
+            relay_lag_max=self._lag_max,
+        )
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._backlog)
+
+    # -- writes ------------------------------------------------------------
+    def write(self, payload: dict[str, Any]) -> int:
+        """Record an entry; returns its id. Relay happens on the next poll."""
+        self._serial += 1
+        written_at = self.now if self._clock is not None else Instant.Epoch
+        self._backlog.append(
+            OutboxEntry(entry_id=self._serial, payload=dict(payload), written_at=written_at)
+        )
+        self._tally["written"] += 1
+        return self._serial
+
+    # -- relay loop --------------------------------------------------------
+    def prime_poll(self) -> Event:
+        """First poll event — schedule this on the simulation to start."""
+        return self._arm_poll()
+
+    def handle_event(self, event: Event):
+        if event.event_type == _POLL:
+            return self._drain(event)
+        # Any other event doubles as a kick to ensure the loop is running.
+        if not self._poll_armed:
+            return [self._arm_poll()]
+        return None
+
+    def _drain(self, event: Event):
+        self._poll_armed = False
+        self._tally["polls"] += 1
+        out: list[Event] = []
+        batch = min(self._batch_size, len(self._backlog))
+        for _ in range(batch):
+            # Pay the relay latency BEFORE emitting, so every emitted event
+            # carries the (monotone) time it actually left the outbox.
+            if self._relay_latency > 0:
+                yield self._relay_latency
+            entry = self._backlog.popleft()
+            entry.relayed = True
+            self._tally["relayed"] += 1
+            lag = (self.now - entry.written_at).to_seconds()
+            self._lag_sum += lag
+            self._lag_max = max(self._lag_max, lag)
+            out.append(
+                Event(
+                    self.now,
+                    "OutboxMessage",
+                    target=self._downstream,
+                    context={
+                        "metadata": {
+                            "outbox_entry_id": entry.entry_id,
+                            "outbox_name": self.name,
+                        },
+                        "payload": entry.payload,
+                    },
+                )
+            )
+        if self._backlog or self._tally["written"]:
+            out.append(self._arm_poll())
+        return out
+
+    def _arm_poll(self) -> Event:
+        self._poll_armed = True
+        at = (
+            self.now + self._poll_interval
+            if self._clock is not None
+            else Instant.Epoch
+        )
+        return Event(at, _POLL, target=self, daemon=True)

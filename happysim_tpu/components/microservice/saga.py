@@ -1,0 +1,308 @@
+"""Saga orchestrator: forward steps, reverse compensation on failure.
+
+Role parity: ``happysimulator/components/microservice/saga.py:101``.
+
+Each saga instance walks the step list forward; a step that times out
+flips the instance into compensation, which unwinds the already-completed
+steps in reverse. One Saga entity multiplexes any number of concurrent
+instances.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+_STEP_DONE = "_saga_step_complete"
+_STEP_TIMEOUT = "_saga_step_timeout"
+_COMP_DONE = "_saga_comp_complete"
+
+
+class SagaState(Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    COMPENSATING = "compensating"
+    COMPLETED = "completed"
+    COMPENSATED = "compensated"
+    FAILED = "failed"
+
+
+@dataclass
+class SagaStep:
+    """Forward action + its compensating action."""
+
+    name: str
+    action_target: Entity
+    action_event_type: str
+    compensation_target: Entity
+    compensation_event_type: str
+    timeout: Optional[float] = None
+
+
+@dataclass
+class SagaStepResult:
+    step_name: str
+    success: bool
+    started_at: Optional[Instant] = None
+    completed_at: Optional[Instant] = None
+
+
+@dataclass(frozen=True)
+class SagaStats:
+    sagas_started: int = 0
+    sagas_completed: int = 0
+    sagas_compensated: int = 0
+    sagas_failed: int = 0
+    steps_executed: int = 0
+    steps_failed: int = 0
+    compensations_executed: int = 0
+
+
+@dataclass
+class _Instance:
+    saga_id: int
+    trigger: Event  # the original request; its hooks fire on success
+    started_at: Instant
+    state: SagaState = SagaState.RUNNING
+    cursor: int = 0  # forward: next step; compensating: next to unwind
+    results: list[SagaStepResult] = field(default_factory=list)
+
+
+class Saga(Entity):
+    """Distributed-transaction orchestrator (saga pattern)."""
+
+    def __init__(
+        self,
+        name: str,
+        steps: list[SagaStep],
+        on_complete: Optional[
+            Callable[[int, SagaState, list[SagaStepResult]], None]
+        ] = None,
+    ):
+        super().__init__(name)
+        if not steps:
+            raise ValueError("Saga needs at least one step")
+        self._steps = list(steps)
+        self._finished_callback = on_complete
+        self._instances: dict[int, _Instance] = {}
+        self._serial = 0
+        self._tally: Counter = Counter()
+
+    # -- introspection -----------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        fanout: list[Entity] = []
+        seen: set[str] = set()
+        for step in self._steps:
+            for target in (step.action_target, step.compensation_target):
+                if target.name not in seen:
+                    seen.add(target.name)
+                    fanout.append(target)
+        return fanout
+
+    @property
+    def stats(self) -> SagaStats:
+        return SagaStats(
+            sagas_started=self._tally["started"],
+            sagas_completed=self._tally["completed"],
+            sagas_compensated=self._tally["compensated"],
+            sagas_failed=self._tally["failed"],
+            steps_executed=self._tally["steps"],
+            steps_failed=self._tally["step_failures"],
+            compensations_executed=self._tally["compensations"],
+        )
+
+    @property
+    def steps(self) -> list[SagaStep]:
+        return list(self._steps)
+
+    @property
+    def active_instances(self) -> int:
+        return sum(
+            1
+            for inst in self._instances.values()
+            if inst.state in (SagaState.RUNNING, SagaState.COMPENSATING)
+        )
+
+    def get_instance_state(self, saga_id: int) -> Optional[SagaState]:
+        instance = self._instances.get(saga_id)
+        return instance.state if instance else None
+
+    # -- orchestration -----------------------------------------------------
+    def handle_event(self, event: Event):
+        kind = event.event_type
+        if kind == _STEP_DONE:
+            return self._step_finished(event)
+        if kind == _STEP_TIMEOUT:
+            return self._step_timed_out(event)
+        if kind == _COMP_DONE:
+            return self._compensation_finished(event)
+        return self._launch(event)
+
+    def _launch(self, trigger: Event) -> list[Event]:
+        self._serial += 1
+        instance = _Instance(
+            saga_id=self._serial, trigger=trigger, started_at=self.now
+        )
+        self._instances[instance.saga_id] = instance
+        self._tally["started"] += 1
+        logger.info("[%s] saga %d started", self.name, instance.saga_id)
+        return self._advance(instance)
+
+    def _notify(self, instance: _Instance, step_index: int, kind: str) -> Callable:
+        """Completion hook telling this saga a step/compensation landed."""
+
+        def hook(finish_time: Instant) -> Event:
+            return Event(
+                finish_time,
+                kind,
+                target=self,
+                context={
+                    "metadata": {
+                        "saga_id": instance.saga_id,
+                        "step_idx": step_index,
+                    }
+                },
+            )
+
+        return hook
+
+    def _advance(self, instance: _Instance) -> list[Event]:
+        """Fire the forward action of the step at the cursor."""
+        index = instance.cursor
+        step = self._steps[index]
+        self._tally["steps"] += 1
+        instance.results.append(
+            SagaStepResult(step_name=step.name, success=False, started_at=self.now)
+        )
+        action = Event(
+            self.now,
+            step.action_event_type,
+            target=step.action_target,
+            context={
+                "metadata": {
+                    "_saga_id": instance.saga_id,
+                    "_saga_step": index,
+                    "_saga_name": self.name,
+                },
+                "payload": instance.trigger.context.get("payload", {}),
+            },
+        )
+        action.add_completion_hook(self._notify(instance, index, _STEP_DONE))
+        out = [action]
+        if step.timeout is not None:
+            out.append(
+                Event(
+                    self.now + step.timeout,
+                    _STEP_TIMEOUT,
+                    target=self,
+                    context={
+                        "metadata": {
+                            "saga_id": instance.saga_id,
+                            "step_idx": index,
+                        }
+                    },
+                    daemon=True,
+                )
+            )
+        return out
+
+    def _unwind(self, instance: _Instance) -> list[Event]:
+        """Fire the compensation of the step at the cursor."""
+        index = instance.cursor
+        step = self._steps[index]
+        self._tally["compensations"] += 1
+        undo = Event(
+            self.now,
+            step.compensation_event_type,
+            target=step.compensation_target,
+            context={
+                "metadata": {
+                    "_saga_id": instance.saga_id,
+                    "_saga_step": index,
+                    "_saga_name": self.name,
+                    "_saga_compensation": True,
+                },
+                "payload": instance.trigger.context.get("payload", {}),
+            },
+        )
+        undo.add_completion_hook(self._notify(instance, index, _COMP_DONE))
+        return [undo]
+
+    def _live_instance(
+        self, event: Event, expected_state: SagaState
+    ) -> Optional[_Instance]:
+        """The instance this notification belongs to, or None when stale."""
+        meta = event.context.get("metadata", {})
+        instance = self._instances.get(meta.get("saga_id"))
+        if instance is None or instance.state is not expected_state:
+            return None
+        if meta.get("step_idx") != instance.cursor:
+            return None  # late echo from an already-advanced step
+        return instance
+
+    def _step_finished(self, event: Event) -> Optional[list[Event]]:
+        instance = self._live_instance(event, SagaState.RUNNING)
+        if instance is None:
+            return None
+        outcome = instance.results[instance.cursor]
+        outcome.success = True
+        outcome.completed_at = self.now
+        instance.cursor += 1
+        if instance.cursor >= len(self._steps):
+            return self._finish(instance, SagaState.COMPLETED)
+        return self._advance(instance)
+
+    def _step_timed_out(self, event: Event) -> Optional[list[Event]]:
+        instance = self._live_instance(event, SagaState.RUNNING)
+        if instance is None:
+            return None
+        self._tally["step_failures"] += 1
+        logger.info(
+            "[%s] saga %d: step %d (%s) timed out -> compensating",
+            self.name, instance.saga_id, instance.cursor,
+            self._steps[instance.cursor].name,
+        )
+        instance.state = SagaState.COMPENSATING
+        instance.cursor -= 1  # unwind starting at the last completed step
+        if instance.cursor < 0:
+            return self._finish(instance, SagaState.COMPENSATED)
+        return self._unwind(instance)
+
+    def _compensation_finished(self, event: Event) -> Optional[list[Event]]:
+        instance = self._live_instance(event, SagaState.COMPENSATING)
+        if instance is None:
+            return None
+        instance.cursor -= 1
+        if instance.cursor < 0:
+            return self._finish(instance, SagaState.COMPENSATED)
+        return self._unwind(instance)
+
+    def _finish(self, instance: _Instance, final: SagaState) -> list[Event]:
+        instance.state = final
+        key = {
+            SagaState.COMPLETED: "completed",
+            SagaState.COMPENSATED: "compensated",
+        }.get(final, "failed")
+        self._tally[key] += 1
+        logger.info("[%s] saga %d %s", self.name, instance.saga_id, key)
+        if self._finished_callback:
+            self._finished_callback(instance.saga_id, final, instance.results)
+        follow_ups: list[Event] = []
+        if final is SagaState.COMPLETED:
+            # The triggering request is only "done" when the saga commits.
+            for hook in instance.trigger.on_complete:
+                produced = hook(self.now)
+                if isinstance(produced, list):
+                    follow_ups.extend(produced)
+                elif produced is not None:
+                    follow_ups.append(produced)
+        return follow_ups

@@ -1,0 +1,241 @@
+"""Service-mesh sidecar: rate limit + circuit breaker + timeout + retry.
+
+Role parity: ``happysimulator/components/microservice/sidecar.py:55``.
+
+One entity inlines the whole resilience stack in front of a target:
+admission (rate limit, then circuit state), forward with a timeout race,
+and exponential-backoff retries on timeout. Reuses the framework's
+CircuitBreaker state machine semantics (CLOSED -> OPEN -> HALF_OPEN).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+from happysim_tpu.components.microservice._tracking import PendingCalls
+from happysim_tpu.components.rate_limiter.policy import RateLimiterPolicy
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+_RESPONSE = "_sc_response"
+_TIMEOUT = "_sc_timeout"
+_RETRY_FIELD = "_sc_retry_attempt"
+
+
+class _Breaker(Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+@dataclass(frozen=True)
+class SidecarStats:
+    total_requests: int = 0
+    successful_requests: int = 0
+    failed_requests: int = 0
+    retries: int = 0
+    rate_limited: int = 0
+    circuit_broken: int = 0
+    timed_out: int = 0
+
+
+class Sidecar(Entity):
+    """Proxy wrapping a target service with the standard resilience stack."""
+
+    def __init__(
+        self,
+        name: str,
+        target: Entity,
+        rate_limit_policy: Optional[RateLimiterPolicy] = None,
+        circuit_failure_threshold: int = 5,
+        circuit_success_threshold: int = 2,
+        circuit_timeout: float = 30.0,
+        request_timeout: float = 5.0,
+        max_retries: int = 3,
+        retry_base_delay: float = 0.1,
+    ):
+        super().__init__(name)
+        for label, value, floor in (
+            ("circuit_failure_threshold", circuit_failure_threshold, 1),
+            ("circuit_success_threshold", circuit_success_threshold, 1),
+            ("max_retries", max_retries, 0),
+        ):
+            if value < floor:
+                raise ValueError(f"{label} must be >= {floor}, was {value}")
+        if circuit_timeout <= 0 or request_timeout <= 0:
+            raise ValueError("circuit_timeout and request_timeout must be > 0")
+        if retry_base_delay < 0:
+            raise ValueError(f"retry_base_delay must be >= 0, was {retry_base_delay}")
+        self._target = target
+        self._limiter = rate_limit_policy
+        self._trip_after = circuit_failure_threshold
+        self._close_after = circuit_success_threshold
+        self._probe_after = circuit_timeout
+        self._request_timeout = request_timeout
+        self._max_retries = max_retries
+        self._backoff_base = retry_base_delay
+        self._breaker = _Breaker.CLOSED
+        self._consecutive_failures = 0
+        self._half_open_successes = 0
+        self._tripped_at: Optional[Instant] = None
+        self._pending = PendingCalls()
+        self._tally: Counter = Counter()
+
+    # -- introspection -----------------------------------------------------
+    def downstream_entities(self) -> list[Entity]:
+        return [self._target]
+
+    @property
+    def target(self) -> Entity:
+        return self._target
+
+    @property
+    def stats(self) -> SidecarStats:
+        return SidecarStats(
+            total_requests=self._tally["total"],
+            successful_requests=self._tally["succeeded"],
+            failed_requests=self._tally["failed"],
+            retries=self._tally["retries"],
+            rate_limited=self._tally["rate_limited"],
+            circuit_broken=self._tally["circuit_broken"],
+            timed_out=self._tally["timed_out"],
+        )
+
+    @property
+    def circuit_state(self) -> str:
+        self._maybe_enter_half_open()
+        return self._breaker.value
+
+    # -- admission + forward -----------------------------------------------
+    def handle_event(self, event: Event):
+        kind = event.event_type
+        if kind == _RESPONSE:
+            return self._on_response(event)
+        if kind == _TIMEOUT:
+            return self._on_timeout(event)
+        return self._admit(event)
+
+    def _admit(self, event: Event) -> Optional[list[Event]]:
+        self._tally["total"] += 1
+        attempt = event.context.get("metadata", {}).get(_RETRY_FIELD, 0)
+        if self._limiter is not None and not self._limiter.try_acquire(self.now):
+            self._tally["rate_limited"] += 1
+            return None
+        self._maybe_enter_half_open()
+        if self._breaker is _Breaker.OPEN:
+            self._tally["circuit_broken"] += 1
+            return None
+        return self._dispatch(event, attempt)
+
+    def _dispatch(self, event: Event, attempt: int) -> list[Event]:
+        call_id = self._pending.issue(origin=event, attempt=attempt)
+        relay = Event(
+            self.now,
+            event.event_type,
+            target=self._target,
+            context={
+                **event.context,
+                "metadata": {
+                    **event.context.get("metadata", {}),
+                    "_sc_call_id": call_id,
+                    "_sc_name": self.name,
+                },
+            },
+        )
+
+        def acknowledge(finish_time: Instant) -> Event:
+            return Event(
+                finish_time,
+                _RESPONSE,
+                target=self,
+                context={"metadata": {"call_id": call_id}},
+            )
+
+        relay.add_completion_hook(acknowledge)
+        if attempt == 0:
+            # Retries must not re-fire the caller's hooks.
+            for hook in event.on_complete:
+                relay.add_completion_hook(hook)
+        deadline = Event(
+            self.now + self._request_timeout,
+            _TIMEOUT,
+            target=self,
+            context={"metadata": {"call_id": call_id}},
+            daemon=True,
+        )
+        return [relay, deadline]
+
+    # -- settle paths ------------------------------------------------------
+    def _on_response(self, event: Event) -> None:
+        info = self._pending.settle(
+            event.context.get("metadata", {}).get("call_id")
+        )
+        if info is None:
+            return None  # lost the race against the timeout
+        self._tally["succeeded"] += 1
+        self._breaker_success()
+        return None
+
+    def _on_timeout(self, event: Event) -> Optional[list[Event]]:
+        info = self._pending.settle(
+            event.context.get("metadata", {}).get("call_id")
+        )
+        if info is None:
+            return None  # response landed first
+        self._tally["timed_out"] += 1
+        attempt = info["attempt"]
+        if attempt < self._max_retries:
+            self._tally["retries"] += 1
+            origin: Event = info["origin"]
+            backoff = self._backoff_base * (2 ** attempt)
+            retry = Event(
+                self.now + backoff,
+                origin.event_type,
+                target=self,
+                context=dict(origin.context),
+            )
+            retry.context.setdefault("metadata", {})[_RETRY_FIELD] = attempt + 1
+            return [retry]
+        self._tally["failed"] += 1
+        self._breaker_failure()
+        return None
+
+    # -- circuit breaker ---------------------------------------------------
+    def _maybe_enter_half_open(self) -> None:
+        if self._breaker is not _Breaker.OPEN:
+            return
+        if self._clock is None or self._tripped_at is None:
+            return
+        if (self.now - self._tripped_at).to_seconds() >= self._probe_after:
+            self._breaker = _Breaker.HALF_OPEN
+            self._half_open_successes = 0
+            logger.info("[%s] circuit OPEN -> HALF_OPEN", self.name)
+
+    def _breaker_success(self) -> None:
+        if self._breaker is _Breaker.HALF_OPEN:
+            self._half_open_successes += 1
+            if self._half_open_successes >= self._close_after:
+                self._breaker = _Breaker.CLOSED
+                self._consecutive_failures = 0
+                logger.info("[%s] circuit HALF_OPEN -> CLOSED", self.name)
+        elif self._breaker is _Breaker.CLOSED:
+            self._consecutive_failures = 0
+
+    def _breaker_failure(self) -> None:
+        if self._breaker is _Breaker.HALF_OPEN:
+            self._breaker = _Breaker.OPEN
+            self._tripped_at = self.now
+            logger.info("[%s] circuit HALF_OPEN -> OPEN", self.name)
+        elif self._breaker is _Breaker.CLOSED:
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self._trip_after:
+                self._breaker = _Breaker.OPEN
+                self._tripped_at = self.now
+                logger.info("[%s] circuit CLOSED -> OPEN", self.name)
