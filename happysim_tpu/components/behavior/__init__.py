@@ -1,0 +1,94 @@
+"""Behavioral simulation: agents, decision models, opinion dynamics.
+
+Role parity: ``happysimulator/components/behavior/`` — Agent, five
+decision models, trait distributions, social graphs, influence models,
+Population factories, the Environment mediator, and stimulus factories.
+Stats dataclasses live in their owning modules (agent/environment/
+population) rather than a separate stats module.
+"""
+
+from happysim_tpu.components.behavior.agent import ActionHandler, Agent, AgentStats
+from happysim_tpu.components.behavior.decision import (
+    BoundedRationalityModel,
+    Choice,
+    CompositeModel,
+    DecisionContext,
+    DecisionModel,
+    Rule,
+    RuleBasedModel,
+    SocialInfluenceModel,
+    UtilityFunction,
+    UtilityModel,
+)
+from happysim_tpu.components.behavior.environment import Environment, EnvironmentStats
+from happysim_tpu.components.behavior.influence import (
+    BoundedConfidenceModel,
+    DeGrootModel,
+    InfluenceModel,
+    VoterModel,
+)
+from happysim_tpu.components.behavior.population import (
+    DemographicSegment,
+    Population,
+    PopulationStats,
+)
+from happysim_tpu.components.behavior.social_graph import Relationship, SocialGraph
+from happysim_tpu.components.behavior.state import AgentState, Memory
+from happysim_tpu.components.behavior.stimulus import (
+    broadcast_stimulus,
+    influence_propagation,
+    policy_announcement,
+    price_change,
+    targeted_stimulus,
+)
+from happysim_tpu.components.behavior.traits import (
+    BIG_FIVE,
+    NormalTraitDistribution,
+    PersonalityTraits,
+    TraitDistribution,
+    TraitSet,
+    UniformTraitDistribution,
+)
+
+BehaviorEnvironment = Environment
+
+__all__ = [
+    "BIG_FIVE",
+    "ActionHandler",
+    "Agent",
+    "AgentState",
+    "AgentStats",
+    "BehaviorEnvironment",
+    "BoundedConfidenceModel",
+    "BoundedRationalityModel",
+    "Choice",
+    "CompositeModel",
+    "DeGrootModel",
+    "DecisionContext",
+    "DecisionModel",
+    "DemographicSegment",
+    "Environment",
+    "EnvironmentStats",
+    "InfluenceModel",
+    "Memory",
+    "NormalTraitDistribution",
+    "PersonalityTraits",
+    "Population",
+    "PopulationStats",
+    "Relationship",
+    "Rule",
+    "RuleBasedModel",
+    "SocialGraph",
+    "SocialInfluenceModel",
+    "TraitDistribution",
+    "TraitSet",
+    "UniformTraitDistribution",
+    "UtilityFunction",
+    "UtilityModel",
+    "VoterModel",
+    "broadcast_stimulus",
+    "influence_propagation",
+    "policy_announcement",
+    "price_change",
+    "targeted_stimulus",
+]

@@ -1,0 +1,211 @@
+"""Behavioral agent entity: stimulus -> decay -> memory -> decision -> action.
+
+Role parity: ``happysimulator/components/behavior/agent.py:35`` (traits +
+decision model + memory + heartbeat + per-action handlers).
+
+Event routing is a dispatch pipeline: heartbeats reschedule themselves,
+``SocialMessage`` events update beliefs/knowledge, and everything else is
+a stimulus that runs the decision pipeline.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable, Optional, Union
+
+from happysim_tpu.components.behavior.decision import (
+    Choice,
+    DecisionContext,
+    DecisionModel,
+    coerce_choices,
+)
+from happysim_tpu.components.behavior.state import AgentState, Memory
+from happysim_tpu.components.behavior.traits import PersonalityTraits, TraitSet
+from happysim_tpu.core.entity import Entity, SimReturn
+from happysim_tpu.core.event import Event
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.temporal import Instant
+
+logger = logging.getLogger(__name__)
+
+ActionHandler = Callable[["Agent", Choice, Event], Union[list[Event], Event, None]]
+
+HEARTBEAT_PREFIX = "heartbeat::"
+SOCIAL_MESSAGE = "SocialMessage"
+
+
+@dataclass(frozen=True)
+class AgentStats:
+    """Frozen per-agent counters."""
+
+    events_received: int = 0
+    decisions_made: int = 0
+    actions_by_type: dict[str, int] = field(default_factory=dict)
+    social_messages_received: int = 0
+
+
+def _as_event_list(result: Union[list[Event], Event, None]) -> Optional[list[Event]]:
+    if result is None:
+        return None
+    return [result] if isinstance(result, Event) else result
+
+
+class Agent(Entity):
+    """An actor with personality, mutable state, and a decision model.
+
+    Register per-action handlers with :meth:`on_action`; when the decision
+    model picks that action the handler runs (optionally after
+    ``action_delay`` simulated seconds) and its events are scheduled.
+
+    Args:
+        name: unique agent name.
+        traits: personality vector (defaults to neutral Big Five).
+        decision_model: strategy consulted on each stimulus carrying choices.
+        state: initial internal state.
+        seed: per-agent RNG seed for deterministic decisions.
+        heartbeat_interval: seconds between self-maintenance daemon events
+            (0 disables).
+        action_delay: simulated seconds between deciding and acting.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        traits: TraitSet | None = None,
+        decision_model: DecisionModel | None = None,
+        state: AgentState | None = None,
+        seed: int | None = None,
+        heartbeat_interval: float = 0.0,
+        action_delay: float = 0.0,
+    ):
+        super().__init__(name)
+        self.traits: TraitSet = traits if traits is not None else PersonalityTraits.big_five()
+        self.decision_model = decision_model
+        self.state = state if state is not None else AgentState()
+        self.heartbeat_interval = heartbeat_interval
+        self.action_delay = action_delay
+        self._rng = random.Random(seed)
+        self._handlers: dict[str, ActionHandler] = {}
+        self._last_seen_s: float | None = None
+        self._heartbeat_armed = False
+        self._events_received = 0
+        self._decisions_made = 0
+        self._social_messages = 0
+        self._action_tally: dict[str, int] = {}
+
+    # ------------------------------------------------------------- wiring
+    def on_action(self, action: str, handler: ActionHandler) -> None:
+        """Route decisions for *action* to *handler(agent, choice, event)*."""
+        self._handlers[action] = handler
+
+    def schedule_first_heartbeat(self, start_time: "Instant") -> Event | None:
+        """Build the initial heartbeat daemon event (schedule before run)."""
+        if self.heartbeat_interval <= 0 or self._heartbeat_armed:
+            return None
+        self._heartbeat_armed = True
+        return self._heartbeat_event(start_time)
+
+    def _heartbeat_event(self, after: "Instant") -> Event:
+        return Event(
+            time=after + self.heartbeat_interval,
+            event_type=f"{HEARTBEAT_PREFIX}{self.name}",
+            target=self,
+            daemon=True,
+        )
+
+    @property
+    def stats(self) -> AgentStats:
+        return AgentStats(
+            events_received=self._events_received,
+            decisions_made=self._decisions_made,
+            actions_by_type=dict(self._action_tally),
+            social_messages_received=self._social_messages,
+        )
+
+    # ----------------------------------------------------------- dispatch
+    def handle_event(self, event: Event) -> Union[None, list[Event], SimReturn]:
+        self._events_received += 1
+        now_s = self.now.to_seconds()
+        if self._last_seen_s is not None:
+            self.state.decay(now_s - self._last_seen_s)
+        self._last_seen_s = now_s
+
+        if event.event_type.startswith(HEARTBEAT_PREFIX):
+            return [self._heartbeat_event(self.now)] if self.heartbeat_interval > 0 else None
+        if event.event_type == SOCIAL_MESSAGE:
+            self._absorb_social_message(event)
+            return None
+        return self._run_decision_pipeline(event)
+
+    # ------------------------------------------------------------- social
+    def _absorb_social_message(self, event: Event) -> None:
+        """Shift belief toward the sender's opinion, scaled by how
+        agreeable this agent is and how credible the sender seemed."""
+        self._social_messages += 1
+        meta = event.context.get("metadata", {})
+        topic = meta.get("topic", "")
+        opinion = meta.get("opinion", 0.0)
+        credibility = meta.get("credibility", 0.5)
+
+        susceptibility = self.traits.get("agreeableness") * credibility
+        if topic:
+            held = self.state.beliefs.get(topic)
+            if held is None:
+                self.state.beliefs[topic] = susceptibility * opinion
+            else:
+                self.state.beliefs[topic] = held + susceptibility * (opinion - held)
+        for fact in meta.get("knowledge", ()):
+            self.state.knowledge.add(fact)
+
+    # ----------------------------------------------------------- stimulus
+    def _run_decision_pipeline(self, event: Event) -> Union[None, list[Event], SimReturn]:
+        meta = event.context.get("metadata", {})
+        valence = meta.get("valence", 0.0)
+        self.state.add_memory(
+            Memory(
+                time=self.now.to_seconds(),
+                event_type=event.event_type,
+                source=meta.get("source", ""),
+                valence=valence,
+                details=dict(meta),
+            )
+        )
+        if valence:
+            self.state.mood = min(1.0, max(0.0, self.state.mood + 0.1 * valence))
+
+        choices = coerce_choices(meta.get("choices", ()))
+        if not choices or self.decision_model is None:
+            return None
+
+        picked = self.decision_model.decide(
+            DecisionContext(
+                traits=self.traits,
+                state=self.state,
+                choices=choices,
+                stimulus=meta,
+                environment=meta.get("environment", {}),
+                social_context=meta.get("social_context", {}),
+            ),
+            self._rng,
+        )
+        if picked is None:
+            return None
+        self._decisions_made += 1
+        self._action_tally[picked.action] = self._action_tally.get(picked.action, 0) + 1
+        return self._act(picked, event)
+
+    def _act(self, choice: Choice, event: Event) -> Union[None, list[Event], SimReturn]:
+        handler = self._handlers.get(choice.action)
+        if handler is None:
+            logger.debug("[%s] no handler registered for action %r", self.name, choice.action)
+            return None
+        if self.action_delay > 0:
+            return self._act_later(handler, choice, event)
+        return _as_event_list(handler(self, choice, event))
+
+    def _act_later(self, handler: ActionHandler, choice: Choice, event: Event) -> SimReturn:
+        yield self.action_delay
+        return _as_event_list(handler(self, choice, event)) or []

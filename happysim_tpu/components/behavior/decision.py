@@ -1,0 +1,208 @@
+"""Decision models: how an agent picks an action from a choice set.
+
+Role parity: ``happysimulator/components/behavior/decision.py:60-231``
+(``UtilityModel``/``RuleBasedModel``/``BoundedRationalityModel``/
+``SocialInfluenceModel``/``CompositeModel``).
+
+All models implement ``decide(context, rng) -> Choice | None``. Shared
+machinery (scoring, weighted sampling) lives in module helpers so each
+model body states only its policy.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Protocol, Sequence, runtime_checkable
+
+from happysim_tpu.components.behavior.state import AgentState
+from happysim_tpu.components.behavior.traits import TraitSet
+
+
+@dataclass(frozen=True)
+class Choice:
+    """A candidate action, e.g. ``Choice("buy", {"price": 9.99})``."""
+
+    action: str
+    context: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DecisionContext:
+    """Everything visible to a decision model at choice time."""
+
+    traits: TraitSet
+    state: AgentState
+    choices: list[Choice]
+    stimulus: dict[str, Any] = field(default_factory=dict)
+    environment: dict[str, Any] = field(default_factory=dict)
+    social_context: dict[str, Any] = field(default_factory=dict)
+
+
+@runtime_checkable
+class DecisionModel(Protocol):
+    """Strategy protocol; return None to abstain."""
+
+    def decide(self, context: DecisionContext, rng: random.Random) -> Choice | None: ...
+
+
+UtilityFunction = Callable[[Choice, DecisionContext], float]
+RuleCondition = Callable[[DecisionContext], bool]
+
+
+# ---------------------------------------------------------------- helpers
+def _score_all(
+    choices: Sequence[Choice], fn: UtilityFunction, context: DecisionContext
+) -> list[float]:
+    return [fn(c, context) for c in choices]
+
+
+def _sample_weighted(
+    choices: Sequence[Choice], weights: Sequence[float], rng: random.Random
+) -> Choice:
+    """Proportional sample; uniform fallback when all mass is non-positive."""
+    total = sum(w for w in weights if w > 0)
+    if total <= 0:
+        return choices[rng.randrange(len(choices))]
+    mark = rng.random() * total
+    acc = 0.0
+    for choice, w in zip(choices, weights):
+        if w > 0:
+            acc += w
+            if mark < acc:
+                return choice
+    return choices[-1]
+
+
+def coerce_choices(raw) -> list[Choice]:
+    """Normalize Choice | dict | str items (event metadata, factory args)."""
+    out: list[Choice] = []
+    for item in raw or ():
+        if isinstance(item, Choice):
+            out.append(item)
+        elif isinstance(item, dict):
+            out.append(Choice(item.get("action", "unknown"), item.get("context", {})))
+        elif isinstance(item, str):
+            out.append(Choice(item))
+    return out
+
+
+# ----------------------------------------------------------------- models
+class UtilityModel:
+    """Rational choice: argmax utility, or softmax when temperature > 0."""
+
+    def __init__(self, utility_fn: UtilityFunction, temperature: float = 0.0):
+        self._utility_fn = utility_fn
+        self.temperature = temperature
+
+    def decide(self, context: DecisionContext, rng: random.Random) -> Choice | None:
+        if not context.choices:
+            return None
+        scores = _score_all(context.choices, self._utility_fn, context)
+        if self.temperature <= 0:
+            best = max(range(len(scores)), key=scores.__getitem__)
+            return context.choices[best]
+        peak = max(scores)
+        gibbs = [math.exp((s - peak) / self.temperature) for s in scores]
+        return _sample_weighted(context.choices, gibbs, rng)
+
+
+@dataclass
+class Rule:
+    """If ``condition(context)`` then pick ``action``; higher priority first."""
+
+    condition: RuleCondition
+    action: str
+    priority: int = 0
+
+
+class RuleBasedModel:
+    """First matching rule wins (by descending priority).
+
+    A rule that fires but names an action absent from the choice set
+    abstains — it does NOT fall through to lower-priority rules, matching
+    the reference's short-circuit semantics. ``default_action`` applies
+    only when no rule fires at all.
+    """
+
+    def __init__(self, rules: list[Rule], default_action: str | None = None):
+        self._rules = sorted(rules, key=lambda r: -r.priority)
+        self._default = default_action
+
+    def decide(self, context: DecisionContext, rng: random.Random) -> Choice | None:
+        by_action = {c.action: c for c in context.choices}
+        for rule in self._rules:
+            if rule.condition(context):
+                return by_action.get(rule.action)
+        return by_action.get(self._default) if self._default else None
+
+
+class BoundedRationalityModel:
+    """Satisficing: scan choices in random order, take the first whose
+    utility clears the aspiration level; settle for the best otherwise."""
+
+    def __init__(self, utility_fn: UtilityFunction, aspiration: float = 0.5):
+        self._utility_fn = utility_fn
+        self.aspiration = aspiration
+
+    def decide(self, context: DecisionContext, rng: random.Random) -> Choice | None:
+        if not context.choices:
+            return None
+        order = list(range(len(context.choices)))
+        rng.shuffle(order)
+        fallback_idx, fallback_score = order[0], -math.inf
+        for i in order:
+            score = self._utility_fn(context.choices[i], context)
+            if score >= self.aspiration:
+                return context.choices[i]
+            if score > fallback_score:
+                fallback_idx, fallback_score = i, score
+        return context.choices[fallback_idx]
+
+
+class SocialInfluenceModel:
+    """Blend individual utility with peer conformity, then sample.
+
+    Conformity pressure is ``conformity_weight * agreeableness``; the
+    peer signal is each action's share of ``social_context["peer_actions"]``.
+    """
+
+    def __init__(self, individual_fn: UtilityFunction, conformity_weight: float = 0.5):
+        self._individual_fn = individual_fn
+        self._conformity_weight = conformity_weight
+
+    def decide(self, context: DecisionContext, rng: random.Random) -> Choice | None:
+        if not context.choices:
+            return None
+        peer_counts: dict[str, int] = context.social_context.get("peer_actions", {})
+        pressure = self._conformity_weight * context.traits.get("agreeableness")
+        peers_total = sum(peer_counts.values()) or 1
+        blended = [
+            (1.0 - pressure) * self._individual_fn(c, context)
+            + pressure * (peer_counts.get(c.action, 0) / peers_total)
+            for c in context.choices
+        ]
+        return _sample_weighted(context.choices, blended, rng)
+
+
+class CompositeModel:
+    """Weighted vote across sub-models; the action with the most voting
+    mass wins (ties broken by first model to vote for it)."""
+
+    def __init__(self, models: list[tuple[DecisionModel, float]]):
+        self._models = list(models)
+
+    def decide(self, context: DecisionContext, rng: random.Random) -> Choice | None:
+        if not context.choices:
+            return None
+        by_action = {c.action: c for c in context.choices}
+        tally: dict[str, float] = {}
+        for model, weight in self._models:
+            vote = model.decide(context, rng)
+            if vote is not None and vote.action in by_action:
+                tally[vote.action] = tally.get(vote.action, 0.0) + weight
+        if not tally:
+            return None
+        winner = max(tally, key=tally.__getitem__)
+        return by_action[winner]

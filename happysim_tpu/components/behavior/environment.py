@@ -1,0 +1,191 @@
+"""Environment entity: routes stimuli to agents and runs influence rounds.
+
+Role parity: ``happysimulator/components/behavior/environment.py:30``.
+
+Four event types, dispatched through a handler table:
+``BroadcastStimulus`` fans out to every agent, ``TargetedStimulus`` to
+named agents, ``InfluencePropagation`` runs one opinion-dynamics round
+over the social graph, and ``StateChange`` mutates shared state.
+Outbound stimuli are enriched with the shared environment state and the
+action tallies of the agent's influencers (the peer-pressure signal
+decision models read).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
+
+from happysim_tpu.components.behavior.agent import Agent
+from happysim_tpu.components.behavior.influence import DeGrootModel, InfluenceModel
+from happysim_tpu.components.behavior.social_graph import SocialGraph
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.clock import Clock
+
+DEFAULT_TRUST = 0.5
+
+
+@dataclass(frozen=True)
+class EnvironmentStats:
+    """Frozen environment counters."""
+
+    broadcasts_sent: int = 0
+    targeted_sends: int = 0
+    influence_rounds: int = 0
+    state_changes: int = 0
+
+
+class Environment(Entity):
+    """Mediator between external stimuli and a population of agents.
+
+    Args:
+        name: entity name.
+        agents: agents to register (more can be added later).
+        social_graph: relationship graph used for peer context and
+            influence rounds; nodes are added for registered agents.
+        shared_state: world state (prices, policies, ...) copied into
+            every outbound stimulus under ``metadata["environment"]``.
+        influence_model: opinion update rule for influence rounds.
+        seed: RNG seed (stochastic influence models draw from this).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        agents: list[Agent] | None = None,
+        social_graph: SocialGraph | None = None,
+        shared_state: dict[str, Any] | None = None,
+        influence_model: InfluenceModel | None = None,
+        seed: int | None = None,
+    ):
+        super().__init__(name)
+        self._agents: dict[str, Agent] = {}
+        self.social_graph = social_graph if social_graph is not None else SocialGraph()
+        self.shared_state: dict[str, Any] = dict(shared_state) if shared_state else {}
+        self.influence_model = influence_model if influence_model is not None else DeGrootModel()
+        self._rng = random.Random(seed)
+        self._broadcasts = 0
+        self._targeted = 0
+        self._influence_rounds = 0
+        self._state_changes = 0
+        self._dispatch = {
+            "BroadcastStimulus": self._fan_out_broadcast,
+            "TargetedStimulus": self._fan_out_targeted,
+            "InfluencePropagation": self._run_influence_round,
+            "StateChange": self._apply_state_change,
+        }
+        for agent in agents or ():
+            self.register_agent(agent)
+
+    # ------------------------------------------------------------- wiring
+    def register_agent(self, agent: Agent) -> None:
+        self._agents[agent.name] = agent
+        self.social_graph.add_node(agent.name)
+        if self._clock is not None:
+            agent.set_clock(self._clock)
+
+    @property
+    def agents(self) -> list[Agent]:
+        return list(self._agents.values())
+
+    def downstream_entities(self) -> list[Entity]:
+        return list(self._agents.values())
+
+    def set_clock(self, clock: "Clock") -> None:
+        super().set_clock(clock)
+        for agent in self._agents.values():
+            agent.set_clock(clock)
+
+    @property
+    def stats(self) -> EnvironmentStats:
+        return EnvironmentStats(
+            broadcasts_sent=self._broadcasts,
+            targeted_sends=self._targeted,
+            influence_rounds=self._influence_rounds,
+            state_changes=self._state_changes,
+        )
+
+    # ----------------------------------------------------------- dispatch
+    def handle_event(self, event: Event) -> list[Event] | None:
+        handler = self._dispatch.get(event.event_type)
+        return handler(event) if handler else None
+
+    def _fan_out_broadcast(self, event: Event) -> list[Event]:
+        self._broadcasts += 1
+        meta = event.context.get("metadata", {})
+        return [self._stimulus_for(agent, meta) for agent in self._agents.values()]
+
+    def _fan_out_targeted(self, event: Event) -> list[Event]:
+        self._targeted += 1
+        meta = event.context.get("metadata", {})
+        return [
+            self._stimulus_for(self._agents[name], meta)
+            for name in meta.get("targets", ())
+            if name in self._agents
+        ]
+
+    def _stimulus_for(self, agent: Agent, meta: dict[str, Any]) -> Event:
+        enriched = dict(meta)
+        enriched["environment"] = dict(self.shared_state)
+        enriched["social_context"] = {"peer_actions": self._peer_actions(agent.name)}
+        return Event(
+            time=self.now,
+            event_type=meta.get("stimulus_type", "Stimulus"),
+            target=agent,
+            context={"metadata": enriched},
+        )
+
+    def _peer_actions(self, agent_name: str) -> dict[str, int]:
+        """Aggregate action tallies across the agents that influence this
+        one — the same in-edge set influence rounds use, so peer pressure
+        and opinion dynamics flow along the same arrows."""
+        tally: dict[str, int] = {}
+        for peer_name in self.social_graph.influencers(agent_name):
+            peer = self._agents.get(peer_name)
+            if peer is None:
+                continue
+            for action, count in peer.stats.actions_by_type.items():
+                tally[action] = tally.get(action, 0) + count
+        return tally
+
+    # ---------------------------------------------------------- influence
+    def _run_influence_round(self, event: Event) -> list[Event]:
+        """One synchronous round: every agent's new opinion is computed
+        from the CURRENT beliefs of its influencers, then delivered as a
+        SocialMessage (so the update itself is damped by susceptibility)."""
+        self._influence_rounds += 1
+        topic = event.context.get("metadata", {}).get("topic", "")
+        messages: list[Event] = []
+        for name, agent in self._agents.items():
+            sources = [s for s in self.social_graph.influencers(name) if s in self._agents]
+            if not sources:
+                continue
+            opinions = [self._agents[s].state.beliefs.get(topic, 0.0) for s in sources]
+            edges = [self.social_graph.get_edge(s, name) for s in sources]
+            weights = [e.weight if e else 0.5 for e in edges]
+            updated = self.influence_model.compute_influence(
+                agent.state.beliefs.get(topic, 0.0), opinions, weights, self._rng
+            )
+            trust = sum(e.trust if e else DEFAULT_TRUST for e in edges) / len(edges)
+            messages.append(
+                Event(
+                    time=self.now,
+                    event_type="SocialMessage",
+                    target=agent,
+                    context={
+                        "metadata": {"topic": topic, "opinion": updated, "credibility": trust}
+                    },
+                )
+            )
+        return messages
+
+    def _apply_state_change(self, event: Event) -> None:
+        self._state_changes += 1
+        meta = event.context.get("metadata", {})
+        if meta.get("key") is not None:
+            self.shared_state[meta["key"]] = meta.get("value")
+        return None

@@ -1,0 +1,152 @@
+"""Population factories: build agent cohorts plus their social graph.
+
+Role parity: ``happysimulator/components/behavior/population.py:53``
+(``Population.uniform``/``from_segments`` + ``DemographicSegment``).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable
+
+from happysim_tpu.components.behavior.agent import Agent
+from happysim_tpu.components.behavior.social_graph import SocialGraph
+from happysim_tpu.components.behavior.state import AgentState
+from happysim_tpu.components.behavior.traits import (
+    TraitDistribution,
+    UniformTraitDistribution,
+)
+
+if TYPE_CHECKING:
+    from happysim_tpu.components.behavior.decision import DecisionModel
+
+_SEED_SPACE = 2**31
+
+
+@dataclass(frozen=True)
+class PopulationStats:
+    """Aggregate counters across every agent in the population."""
+
+    size: int = 0
+    total_events: int = 0
+    total_decisions: int = 0
+    total_actions: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class DemographicSegment:
+    """One sub-population: its share of the total, and factories for the
+    traits / decision model / initial state of its members."""
+
+    name: str
+    fraction: float
+    trait_distribution: TraitDistribution | None = None
+    decision_model_factory: Callable[[], "DecisionModel"] | None = None
+    initial_state_factory: Callable[[], AgentState] | None = None
+    seed: int | None = None
+
+
+def _graph_for(names: list[str], graph_type: str, rng: random.Random) -> SocialGraph:
+    if graph_type == "complete":
+        return SocialGraph.complete(names, rng=rng)
+    if graph_type == "random":
+        return SocialGraph.random_erdos_renyi(names, p=0.1, rng=rng)
+    # default: small world; fall back to complete for tiny populations
+    k = min(4, len(names) - 1) if len(names) > 1 else 0
+    if k < 2:
+        return SocialGraph.complete(names, rng=rng)
+    return SocialGraph.small_world(names, k=k, p_rewire=0.1, rng=rng)
+
+
+class Population:
+    """Agents plus the social graph that connects them."""
+
+    def __init__(self, agents: list[Agent], social_graph: SocialGraph):
+        self.agents = agents
+        self.social_graph = social_graph
+
+    @property
+    def size(self) -> int:
+        return len(self.agents)
+
+    @property
+    def stats(self) -> PopulationStats:
+        events = decisions = 0
+        actions: dict[str, int] = {}
+        for agent in self.agents:
+            snap = agent.stats
+            events += snap.events_received
+            decisions += snap.decisions_made
+            for action, count in snap.actions_by_type.items():
+                actions[action] = actions.get(action, 0) + count
+        return PopulationStats(
+            size=self.size,
+            total_events=events,
+            total_decisions=decisions,
+            total_actions=actions,
+        )
+
+    @classmethod
+    def uniform(
+        cls,
+        size: int,
+        decision_model: "DecisionModel | None" = None,
+        graph_type: str = "small_world",
+        seed: int | None = None,
+        name_prefix: str = "agent",
+    ) -> "Population":
+        """*size* agents with uniformly random Big Five traits, sharing one
+        decision model, wired into the requested graph topology."""
+        rng = random.Random(seed)
+        dist = UniformTraitDistribution()
+        agents = [
+            Agent(
+                name=f"{name_prefix}_{i}",
+                traits=dist.sample(rng),
+                decision_model=decision_model,
+                seed=rng.randrange(_SEED_SPACE),
+            )
+            for i in range(size)
+        ]
+        names = [a.name for a in agents]
+        return cls(agents, _graph_for(names, graph_type, rng))
+
+    @classmethod
+    def from_segments(
+        cls,
+        total_size: int,
+        segments: list[DemographicSegment],
+        graph_type: str = "small_world",
+        seed: int | None = None,
+        name_prefix: str = "agent",
+    ) -> "Population":
+        """Split *total_size* across segments by fraction (floor per
+        segment; the remainder goes to the largest segment)."""
+        rng = random.Random(seed)
+        counts = [int(seg.fraction * total_size) for seg in segments]
+        shortfall = total_size - sum(counts)
+        if shortfall > 0 and counts:
+            counts[counts.index(max(counts))] += shortfall
+
+        agents: list[Agent] = []
+        for seg, count in zip(segments, counts):
+            seg_seed = seg.seed if seg.seed is not None else rng.randrange(_SEED_SPACE)
+            seg_rng = random.Random(seg_seed)
+            dist = seg.trait_distribution or UniformTraitDistribution()
+            for _ in range(count):
+                agents.append(
+                    Agent(
+                        name=f"{name_prefix}_{len(agents)}",
+                        traits=dist.sample(seg_rng),
+                        decision_model=(
+                            seg.decision_model_factory() if seg.decision_model_factory else None
+                        ),
+                        state=(
+                            seg.initial_state_factory() if seg.initial_state_factory else None
+                        ),
+                        seed=seg_rng.randrange(_SEED_SPACE),
+                    )
+                )
+        names = [a.name for a in agents]
+        return cls(agents, _graph_for(names, graph_type, rng))

@@ -1,0 +1,175 @@
+"""Directed weighted social graph between agents.
+
+Role parity: ``happysimulator/components/behavior/social_network.py:36``
+(``SocialGraph.complete/small_world/random_erdos_renyi`` + ``Relationship``).
+
+Design note: unlike the reference — which scans every adjacency list to
+answer "who influences X?" — this graph maintains a reverse index, so
+``influencers()`` and ``influence_weights()`` are O(in-degree) instead of
+O(nodes). Influence propagation touches every agent every round, so this
+matters for large populations.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+
+@dataclass
+class Relationship:
+    """A directed edge: ``source`` influences ``target``.
+
+    Every consumer reads the edge this one way: ``influencers(x)`` (the
+    in-edges of x) are the agents whose opinions and actions x is exposed
+    to. ``weight`` is tie strength, ``trust`` is how credible the target
+    finds the source; both in [0, 1].
+    """
+
+    source: str
+    target: str
+    weight: float = 0.5
+    trust: float = 0.5
+    interaction_count: int = 0
+
+
+class SocialGraph:
+    """Adjacency-indexed directed graph with a reverse index.
+
+    ``_out[src][dst]`` holds the Relationship; ``_in[dst]`` is the set of
+    sources pointing at dst. Generators (`complete`, `small_world`,
+    `random_erdos_renyi`) accept an ``rng`` for determinism.
+    """
+
+    def __init__(self) -> None:
+        self._out: dict[str, dict[str, Relationship]] = {}
+        self._in: dict[str, set[str]] = {}
+
+    # ------------------------------------------------------------ mutation
+    def add_node(self, name: str) -> None:
+        self._out.setdefault(name, {})
+        self._in.setdefault(name, set())
+
+    def add_edge(
+        self, source: str, target: str, weight: float = 0.5, trust: float = 0.5
+    ) -> Relationship:
+        self.add_node(source)
+        self.add_node(target)
+        rel = Relationship(source=source, target=target, weight=weight, trust=trust)
+        self._out[source][target] = rel
+        self._in[target].add(source)
+        return rel
+
+    def add_bidirectional_edge(
+        self, a: str, b: str, weight: float = 0.5, trust: float = 0.5
+    ) -> tuple[Relationship, Relationship]:
+        return self.add_edge(a, b, weight, trust), self.add_edge(b, a, weight, trust)
+
+    def remove_edge(self, source: str, target: str) -> None:
+        if target in self._out.get(source, {}):
+            del self._out[source][target]
+            self._in[target].discard(source)
+
+    def record_interaction(self, source: str, target: str) -> None:
+        rel = self.get_edge(source, target)
+        if rel is not None:
+            rel.interaction_count += 1
+
+    # ------------------------------------------------------------- queries
+    @property
+    def nodes(self) -> set[str]:
+        return set(self._out)
+
+    @property
+    def edge_count(self) -> int:
+        return sum(len(dsts) for dsts in self._out.values())
+
+    def get_edge(self, source: str, target: str) -> Relationship | None:
+        return self._out.get(source, {}).get(target)
+
+    def neighbors(self, name: str) -> list[str]:
+        """Nodes that *name* has outgoing edges to."""
+        return list(self._out.get(name, {}))
+
+    def influencers(self, name: str) -> list[str]:
+        """Nodes with edges pointing AT *name* (O(in-degree))."""
+        return list(self._in.get(name, ()))
+
+    def influence_weights(self, name: str) -> dict[str, float]:
+        """{influencer: edge weight} for edges pointing at *name*."""
+        return {src: self._out[src][name].weight for src in self._in.get(name, ())}
+
+    # ---------------------------------------------------------- generators
+    @classmethod
+    def complete(
+        cls,
+        names: list[str],
+        weight: float = 0.5,
+        trust: float = 0.5,
+        rng: random.Random | None = None,
+    ) -> "SocialGraph":
+        """Every distinct pair connected in both directions."""
+        g = cls()
+        for n in names:
+            g.add_node(n)
+        for i, a in enumerate(names):
+            for b in names[i + 1 :]:
+                g.add_bidirectional_edge(a, b, weight, trust)
+        return g
+
+    @classmethod
+    def random_erdos_renyi(
+        cls,
+        names: list[str],
+        p: float = 0.1,
+        weight: float = 0.5,
+        trust: float = 0.5,
+        rng: random.Random | None = None,
+    ) -> "SocialGraph":
+        """Each ordered pair gets an edge independently with probability p."""
+        rng = rng or random.Random()
+        g = cls()
+        for n in names:
+            g.add_node(n)
+        for a in names:
+            for b in names:
+                if a != b and rng.random() < p:
+                    g.add_edge(a, b, weight, trust)
+        return g
+
+    @classmethod
+    def small_world(
+        cls,
+        names: list[str],
+        k: int = 4,
+        p_rewire: float = 0.1,
+        weight: float = 0.5,
+        trust: float = 0.5,
+        rng: random.Random | None = None,
+    ) -> "SocialGraph":
+        """Watts–Strogatz: ring lattice of k nearest neighbors, each
+        forward edge rewired to a random non-neighbor with prob p_rewire."""
+        rng = rng or random.Random()
+        n = len(names)
+        if n < 3:
+            return cls.complete(names, weight, trust)
+        half = max(1, min(k, n - 1) // 2)
+
+        g = cls()
+        for name in names:
+            g.add_node(name)
+        for i in range(n):
+            for step in range(1, half + 1):
+                g.add_bidirectional_edge(names[i], names[(i + step) % n], weight, trust)
+        for i in range(n):
+            src = names[i]
+            for step in range(1, half + 1):
+                if rng.random() >= p_rewire:
+                    continue
+                ring_target = names[(i + step) % n]
+                fresh = [c for c in names if c != src and c not in g._out.get(src, {})]
+                if not fresh:
+                    continue
+                g.remove_edge(src, ring_target)
+                g.add_edge(src, rng.choice(fresh), weight, trust)
+        return g

@@ -1,0 +1,95 @@
+"""Agent internal state: needs, mood, beliefs, knowledge, episodic memory.
+
+Role parity: ``happysimulator/components/behavior/state.py:19-38``
+(``Memory``/``AgentState`` with bounded memory and passive decay).
+
+Scalar fields live in [0, 1]; belief values live in [-1, 1] (opinion
+strength). ``drift()`` applies time-based decay between events.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+MEMORY_CAPACITY = 100
+
+# Passive drift rates, per simulated second.
+NEED_GROWTH_RATE = 0.01  # needs become more urgent
+MOOD_SETTLE_RATE = 0.02  # mood returns to neutral
+ENERGY_DRAIN_RATE = 0.005  # energy depletes
+
+MOOD_NEUTRAL = 0.5
+
+
+@dataclass
+class Memory:
+    """One episodic memory: what happened, who caused it, how it felt.
+
+    ``valence`` ranges -1 (negative) to +1 (positive).
+    """
+
+    time: float
+    event_type: str
+    source: str = ""
+    valence: float = 0.0
+    details: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class AgentState:
+    """Mutable per-agent state consulted by decision models.
+
+    Attributes:
+        satisfaction: overall satisfaction, [0, 1].
+        energy: motivation reservoir, [0, 1]; drains over time.
+        mood: [0, 1] with 0.5 neutral; settles toward neutral over time.
+        beliefs: topic -> opinion in [-1, 1].
+        needs: need name -> urgency in [0, 1]; grows over time.
+        knowledge: set of known facts/topics.
+    """
+
+    satisfaction: float = 0.5
+    energy: float = 1.0
+    mood: float = MOOD_NEUTRAL
+    beliefs: dict[str, float] = field(default_factory=dict)
+    needs: dict[str, float] = field(default_factory=dict)
+    knowledge: set[str] = field(default_factory=set)
+    _memories: deque[Memory] = field(
+        default_factory=lambda: deque(maxlen=MEMORY_CAPACITY), repr=False
+    )
+
+    # ------------------------------------------------------------- memory
+    def add_memory(self, memory: Memory) -> None:
+        """Record a memory; the deque evicts the oldest at capacity."""
+        self._memories.append(memory)
+
+    def recent_memories(self, n: int = 5) -> list[Memory]:
+        """The *n* most recent memories, newest first."""
+        count = len(self._memories)
+        return [self._memories[count - 1 - i] for i in range(min(n, count))]
+
+    def average_recent_valence(self, n: int = 5) -> float:
+        """Mean valence over the *n* most recent memories (0.0 if none)."""
+        recent = self.recent_memories(n)
+        return sum(m.valence for m in recent) / len(recent) if recent else 0.0
+
+    # -------------------------------------------------------------- drift
+    def decay(self, dt_seconds: float) -> None:
+        """Apply passive drift for *dt_seconds* of elapsed simulated time.
+
+        Needs grow toward 1, mood settles toward 0.5, energy drains
+        toward 0 — all linearly, saturating at their bounds.
+        """
+        if dt_seconds <= 0:
+            return
+        for need in self.needs:
+            self.needs[need] = min(1.0, self.needs[need] + NEED_GROWTH_RATE * dt_seconds)
+        settle = MOOD_SETTLE_RATE * dt_seconds
+        gap = self.mood - MOOD_NEUTRAL
+        if abs(gap) <= settle:
+            self.mood = MOOD_NEUTRAL
+        else:
+            self.mood -= settle if gap > 0 else -settle
+        self.energy = max(0.0, self.energy - ENERGY_DRAIN_RATE * dt_seconds)

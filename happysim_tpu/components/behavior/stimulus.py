@@ -1,0 +1,122 @@
+"""Stimulus event factories targeting an Environment.
+
+Role parity: ``happysimulator/components/behavior/stimulus.py``
+(``broadcast_stimulus``/``targeted_stimulus``/``price_change``/
+``policy_announcement``/``influence_propagation``).
+
+Each factory returns a ready-to-schedule Event addressed at an
+Environment; the Environment expands it into per-agent stimuli.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Sequence
+
+from happysim_tpu.components.behavior.decision import Choice, coerce_choices
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+if TYPE_CHECKING:
+    from happysim_tpu.components.behavior.environment import Environment
+
+
+def _instant(time: "Instant | float") -> Instant:
+    return time if isinstance(time, Instant) else Instant.from_seconds(time)
+
+
+def _env_event(
+    time: "Instant | float", environment: "Environment", event_type: str, meta: dict[str, Any]
+) -> Event:
+    return Event(
+        time=_instant(time),
+        event_type=event_type,
+        target=environment,
+        context={"metadata": meta},
+    )
+
+
+def broadcast_stimulus(
+    time: "Instant | float",
+    environment: "Environment",
+    stimulus_type: str,
+    choices: "Sequence[Choice | str | dict] | None" = None,
+    **metadata: Any,
+) -> Event:
+    """A stimulus the Environment fans out to every registered agent."""
+    meta = {"stimulus_type": stimulus_type, "choices": coerce_choices(choices), **metadata}
+    return _env_event(time, environment, "BroadcastStimulus", meta)
+
+
+def targeted_stimulus(
+    time: "Instant | float",
+    environment: "Environment",
+    targets: Sequence[str],
+    stimulus_type: str,
+    choices: "Sequence[Choice | str | dict] | None" = None,
+    **metadata: Any,
+) -> Event:
+    """A stimulus delivered only to the named agents."""
+    meta = {
+        "stimulus_type": stimulus_type,
+        "targets": list(targets),
+        "choices": coerce_choices(choices),
+        **metadata,
+    }
+    return _env_event(time, environment, "TargetedStimulus", meta)
+
+
+def price_change(
+    time: "Instant | float",
+    environment: "Environment",
+    product: str,
+    old_price: float,
+    new_price: float,
+) -> Event:
+    """Broadcast a price move with canned buy/wait/switch choices.
+
+    Valence is +0.3 for a price drop, -0.3 for a rise.
+    """
+    return broadcast_stimulus(
+        time,
+        environment,
+        stimulus_type="PriceChange",
+        choices=[
+            Choice("buy", {"product": product, "price": new_price}),
+            Choice("wait", {"product": product}),
+            Choice("switch", {"product": product}),
+        ],
+        product=product,
+        old_price=old_price,
+        new_price=new_price,
+        valence=0.3 if new_price < old_price else -0.3,
+    )
+
+
+def policy_announcement(
+    time: "Instant | float",
+    environment: "Environment",
+    policy: str,
+    description: str,
+    valence: float = 0.0,
+) -> Event:
+    """Broadcast a policy with canned accept/protest/ignore choices."""
+    return broadcast_stimulus(
+        time,
+        environment,
+        stimulus_type="PolicyAnnouncement",
+        choices=[
+            Choice("accept", {"policy": policy}),
+            Choice("protest", {"policy": policy}),
+            Choice("ignore", {"policy": policy}),
+        ],
+        policy=policy,
+        description=description,
+        valence=valence,
+    )
+
+
+def influence_propagation(
+    time: "Instant | float", environment: "Environment", topic: str
+) -> Event:
+    """Trigger one opinion-dynamics round over the social graph."""
+    return _env_event(time, environment, "InfluencePropagation", {"topic": topic})

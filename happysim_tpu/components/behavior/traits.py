@@ -1,0 +1,106 @@
+"""Personality trait vectors and sampling distributions.
+
+Role parity: ``happysimulator/components/behavior/traits.py:22-104``
+(``TraitSet`` protocol, ``PersonalityTraits.big_five``, Normal/Uniform
+trait distributions).
+
+A trait set is a read-only mapping from dimension name to a value in
+[0, 1]. Distributions sample whole trait sets for population factories.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Protocol, Sequence, runtime_checkable
+
+BIG_FIVE = (
+    "openness",
+    "conscientiousness",
+    "extraversion",
+    "agreeableness",
+    "neuroticism",
+)
+
+
+def _unit(value: float) -> float:
+    """Clamp to the unit interval."""
+    return 0.0 if value < 0.0 else 1.0 if value > 1.0 else value
+
+
+@runtime_checkable
+class TraitSet(Protocol):
+    """Read access to named personality dimensions (values in [0, 1])."""
+
+    def get(self, name: str) -> float: ...
+
+    def names(self) -> Sequence[str]: ...
+
+
+@dataclass(frozen=True)
+class PersonalityTraits:
+    """Immutable trait vector keyed by dimension name.
+
+    Unknown dimensions read as the neutral midpoint 0.5, so decision
+    models can consult any trait without guarding for presence.
+    """
+
+    dimensions: Mapping[str, float] = field(default_factory=dict)
+
+    def get(self, name: str) -> float:
+        return self.dimensions.get(name, 0.5)
+
+    def names(self) -> Sequence[str]:
+        return tuple(self.dimensions)
+
+    @staticmethod
+    def big_five(
+        openness: float = 0.5,
+        conscientiousness: float = 0.5,
+        extraversion: float = 0.5,
+        agreeableness: float = 0.5,
+        neuroticism: float = 0.5,
+    ) -> "PersonalityTraits":
+        """OCEAN five-factor trait vector, each value clamped to [0, 1]."""
+        values = (openness, conscientiousness, extraversion, agreeableness, neuroticism)
+        return PersonalityTraits({k: _unit(v) for k, v in zip(BIG_FIVE, values)})
+
+
+@runtime_checkable
+class TraitDistribution(Protocol):
+    """Samples whole trait sets; used by :class:`Population` factories."""
+
+    def sample(self, rng: random.Random) -> TraitSet: ...
+
+
+class NormalTraitDistribution:
+    """Gaussian per dimension, clamped to [0, 1].
+
+    Args:
+        means: dimension -> mean.
+        stds: dimension -> standard deviation (default 0.15 everywhere).
+    """
+
+    DEFAULT_STD = 0.15
+
+    def __init__(self, means: Mapping[str, float], stds: Mapping[str, float] | None = None):
+        self._means = dict(means)
+        self._stds = dict(stds) if stds else {}
+
+    def sample(self, rng: random.Random) -> PersonalityTraits:
+        return PersonalityTraits(
+            {
+                name: _unit(rng.gauss(mean, self._stds.get(name, self.DEFAULT_STD)))
+                for name, mean in self._means.items()
+            }
+        )
+
+
+class UniformTraitDistribution:
+    """Independent U(0, 1) draw per dimension."""
+
+    def __init__(self, dimension_names: Iterable[str] = BIG_FIVE):
+        self._names = tuple(dimension_names)
+
+    def sample(self, rng: random.Random) -> PersonalityTraits:
+        return PersonalityTraits({name: rng.random() for name in self._names})

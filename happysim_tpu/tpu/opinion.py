@@ -1,0 +1,117 @@
+"""TPU-native opinion dynamics: whole-population influence as matmuls.
+
+TPU twin of :mod:`happysim_tpu.components.behavior.influence` (host role
+parity: ``happysimulator/components/behavior/influence.py:44-126``). The
+host Environment runs one agent at a time; here the entire population
+updates in a single step:
+
+- **DeGroot** is literally `x' = S x + (1-s) * (W x / W 1)` — a dense
+  matmul on the MXU. Batches of populations vmap over a leading axis.
+- **Bounded confidence** masks the weight matrix by `|x_j - x_i| <= eps`
+  each round — still one matmul after an outer-difference mask.
+- **Voter model** samples one influencer per agent per round with
+  `jax.random.categorical` over log-weights.
+
+Opinions are float32 in [-1, 1]; the weight matrix is row-indexed by the
+listener: ``weights[i, j]`` is how much agent *i* listens to agent *j*
+(0 = no edge). Self-weight is handled explicitly, so the diagonal should
+be zero.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def graph_weight_matrix(graph, names: list[str] | None = None) -> np.ndarray:
+    """Dense listener-major weight matrix from a
+    :class:`~happysim_tpu.components.behavior.social_graph.SocialGraph`.
+
+    ``out[i, j]`` = weight of the edge j -> i (j influences i), matching
+    the Environment's convention that influencers point AT the listener.
+    """
+    ordered = names if names is not None else sorted(graph.nodes)
+    index = {n: i for i, n in enumerate(ordered)}
+    out = np.zeros((len(ordered), len(ordered)), dtype=np.float32)
+    for listener in ordered:
+        for src, w in graph.influence_weights(listener).items():
+            if src in index:
+                out[index[listener], index[src]] = w
+    return out
+
+
+def _neighbor_mean(opinions: jax.Array, weights: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Row-normalized weighted neighbor mean; rows with no mass keep 0.
+
+    Returns (mean, has_neighbors_mask).
+    """
+    mass = weights.sum(axis=-1)
+    total = weights @ opinions
+    has = mass > 0
+    return jnp.where(has, total / jnp.where(has, mass, 1.0), 0.0), has
+
+
+@partial(jax.jit, static_argnames=("rounds",))
+def degroot_rounds(
+    opinions: jax.Array, weights: jax.Array, self_weight: float = 0.5, rounds: int = 1
+) -> jax.Array:
+    """Run *rounds* synchronous DeGroot updates.
+
+    One round: ``x_i' = s * x_i + (1-s) * (sum_j w_ij x_j / sum_j w_ij)``;
+    agents with no influencers keep their opinion. The scan body is a
+    single (N,N)@(N,) product, so XLA tiles it straight onto the MXU; for
+    replica ensembles vmap this function over a leading batch axis.
+    """
+
+    def one_round(x, _):
+        mean, has = _neighbor_mean(x, weights)
+        updated = self_weight * x + (1.0 - self_weight) * mean
+        return jnp.where(has, updated, x), None
+
+    final, _ = jax.lax.scan(one_round, opinions, None, length=rounds)
+    return final
+
+
+@partial(jax.jit, static_argnames=("rounds",))
+def bounded_confidence_rounds(
+    opinions: jax.Array,
+    weights: jax.Array,
+    epsilon: float = 0.3,
+    self_weight: float = 0.5,
+    rounds: int = 1,
+) -> jax.Array:
+    """Hegselmann–Krause: like DeGroot but each round masks edges whose
+    opinion gap exceeds *epsilon* (outer |x_i - x_j| test)."""
+
+    def one_round(x, _):
+        gap = jnp.abs(x[:, None] - x[None, :])
+        near = jnp.where(gap <= epsilon, weights, 0.0)
+        mean, has = _neighbor_mean(x, near)
+        updated = self_weight * x + (1.0 - self_weight) * mean
+        return jnp.where(has, updated, x), None
+
+    final, _ = jax.lax.scan(one_round, opinions, None, length=rounds)
+    return final
+
+
+@partial(jax.jit, static_argnames=("rounds",))
+def voter_rounds(
+    key: jax.Array, opinions: jax.Array, weights: jax.Array, rounds: int = 1
+) -> jax.Array:
+    """Voter model: each round every agent adopts the opinion of one
+    influencer sampled proportionally to edge weight (agents with no
+    influencers keep theirs)."""
+
+    logits = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf)
+    has = weights.sum(axis=-1) > 0
+
+    def one_round(x, round_key):
+        picks = jax.random.categorical(round_key, logits, axis=-1)
+        return jnp.where(has, x[picks], x), None
+
+    final, _ = jax.lax.scan(one_round, opinions, jax.random.split(key, rounds))
+    return final

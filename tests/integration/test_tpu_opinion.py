@@ -1,0 +1,132 @@
+"""Cross-backend equivalence: TPU opinion-dynamics kernels vs host models.
+
+The host influence models (behavior package) are the correctness oracle;
+the TPU kernels must produce the same trajectories on the same graph.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from happysim_tpu.components.behavior import (
+    BoundedConfidenceModel,
+    DeGrootModel,
+    SocialGraph,
+)
+from happysim_tpu.tpu.opinion import (
+    bounded_confidence_rounds,
+    degroot_rounds,
+    graph_weight_matrix,
+    voter_rounds,
+)
+
+
+def _ring_graph(n, weight=1.0):
+    names = [f"a{i}" for i in range(n)]
+    g = SocialGraph()
+    for i in range(n):
+        g.add_edge(names[i], names[(i + 1) % n], weight=weight)
+        g.add_edge(names[i], names[(i + 2) % n], weight=0.5 * weight)
+    return g, names
+
+
+def _host_round(model, opinions, weights):
+    """One synchronous round using the host model, listener-major weights."""
+    rng = random.Random(0)
+    out = []
+    for i in range(len(opinions)):
+        infl = [j for j in range(len(opinions)) if weights[i, j] > 0]
+        out.append(
+            model.compute_influence(
+                opinions[i],
+                [opinions[j] for j in infl],
+                [float(weights[i, j]) for j in infl],
+                rng,
+            )
+        )
+    return np.array(out, dtype=np.float32)
+
+
+def test_graph_weight_matrix_is_listener_major():
+    g = SocialGraph()
+    g.add_edge("x", "y", weight=0.7)  # x influences y
+    w = graph_weight_matrix(g, names=["x", "y"])
+    assert w[1, 0] == pytest.approx(0.7)  # row = listener y, col = source x
+    assert w[0, 1] == 0.0
+
+
+def test_degroot_kernel_matches_host_model():
+    g, names = _ring_graph(16)
+    weights = graph_weight_matrix(g, names)
+    opinions = np.linspace(-1.0, 1.0, 16).astype(np.float32)
+    host = opinions.copy()
+    model = DeGrootModel(self_weight=0.4)
+    for _ in range(5):
+        host = _host_round(model, host, weights)
+    tpu = degroot_rounds(jnp.asarray(opinions), jnp.asarray(weights), 0.4, rounds=5)
+    np.testing.assert_allclose(np.asarray(tpu), host, rtol=1e-5, atol=1e-6)
+
+
+def test_degroot_converges_to_consensus():
+    g, names = _ring_graph(32)
+    weights = jnp.asarray(graph_weight_matrix(g, names))
+    opinions = jnp.asarray(np.random.default_rng(0).uniform(-1, 1, 32), dtype=jnp.float32)
+    final = degroot_rounds(opinions, weights, 0.5, rounds=1000)
+    assert float(jnp.ptp(final)) < 1e-3  # strongly connected -> consensus
+
+
+def test_degroot_isolated_agents_keep_opinion():
+    weights = jnp.zeros((4, 4), dtype=jnp.float32)
+    opinions = jnp.array([0.1, -0.5, 0.9, 0.0])
+    out = degroot_rounds(opinions, weights, 0.5, rounds=3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(opinions))
+
+
+def test_bounded_confidence_matches_host_model():
+    g, names = _ring_graph(12)
+    weights = graph_weight_matrix(g, names)
+    opinions = np.linspace(-1.0, 1.0, 12).astype(np.float32)
+    model = BoundedConfidenceModel(epsilon=0.4, self_weight=0.5)
+    host = opinions.copy()
+    for _ in range(3):
+        host = _host_round(model, host, weights)
+    tpu = bounded_confidence_rounds(
+        jnp.asarray(opinions), jnp.asarray(weights), 0.4, 0.5, rounds=3
+    )
+    np.testing.assert_allclose(np.asarray(tpu), host, rtol=1e-5, atol=1e-6)
+
+
+def test_bounded_confidence_polarization_persists():
+    # Two camps further apart than epsilon never merge
+    opinions = jnp.array([-0.9, -0.8, 0.8, 0.9])
+    weights = jnp.ones((4, 4)) - jnp.eye(4)
+    out = bounded_confidence_rounds(opinions, weights, epsilon=0.3, rounds=50)
+    assert float(out[0]) < -0.5 and float(out[3]) > 0.5
+
+
+def test_voter_model_adopts_neighbor_opinions():
+    opinions = jnp.array([1.0, -1.0, 1.0, -1.0])
+    weights = jnp.asarray((np.ones((4, 4)) - np.eye(4)).astype(np.float32))
+    out = voter_rounds(jax.random.PRNGKey(0), opinions, weights, rounds=1)
+    assert set(np.asarray(out).tolist()) <= {1.0, -1.0}
+
+
+def test_voter_model_isolated_agent_keeps_opinion():
+    weights = jnp.zeros((3, 3))
+    opinions = jnp.array([0.2, -0.4, 0.6])
+    out = voter_rounds(jax.random.PRNGKey(1), opinions, weights, rounds=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(opinions))
+
+
+def test_degroot_vmaps_over_replica_batches():
+    g, names = _ring_graph(8)
+    weights = jnp.asarray(graph_weight_matrix(g, names))
+    batch = jnp.asarray(
+        np.random.default_rng(1).uniform(-1, 1, (16, 8)).astype(np.float32)
+    )
+    batched = jax.vmap(lambda x: degroot_rounds(x, weights, 0.5, rounds=4))(batch)
+    single = degroot_rounds(batch[3], weights, 0.5, rounds=4)
+    np.testing.assert_allclose(np.asarray(batched[3]), np.asarray(single), rtol=1e-6)

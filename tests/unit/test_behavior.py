@@ -1,0 +1,467 @@
+"""Unit tests: behavior package (agents, decisions, influence, populations).
+
+Mirrors the reference's coverage (tests/unit/components/behavior/) using
+tiny real simulations, per the unit≈micro-integration strategy
+(SURVEY.md §4).
+"""
+
+import random
+
+import pytest
+
+from happysim_tpu import Event, Instant, Simulation
+from happysim_tpu.components.behavior import (
+    Agent,
+    AgentState,
+    BoundedConfidenceModel,
+    BoundedRationalityModel,
+    Choice,
+    CompositeModel,
+    DecisionContext,
+    DeGrootModel,
+    DemographicSegment,
+    Environment,
+    Memory,
+    NormalTraitDistribution,
+    PersonalityTraits,
+    Population,
+    Rule,
+    RuleBasedModel,
+    SocialGraph,
+    SocialInfluenceModel,
+    UniformTraitDistribution,
+    UtilityModel,
+    VoterModel,
+    broadcast_stimulus,
+    influence_propagation,
+    policy_announcement,
+    price_change,
+    targeted_stimulus,
+)
+
+
+def _ctx(choices, traits=None, state=None, **kw):
+    return DecisionContext(
+        traits=traits or PersonalityTraits.big_five(),
+        state=state or AgentState(),
+        choices=[Choice(c) if isinstance(c, str) else c for c in choices],
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------- traits ----
+def test_big_five_clamps_and_defaults():
+    t = PersonalityTraits.big_five(openness=1.7, neuroticism=-0.3)
+    assert t.get("openness") == 1.0
+    assert t.get("neuroticism") == 0.0
+    assert t.get("extraversion") == 0.5
+    assert t.get("never_defined") == 0.5  # unknown dims read neutral
+    assert set(t.names()) == {
+        "openness",
+        "conscientiousness",
+        "extraversion",
+        "agreeableness",
+        "neuroticism",
+    }
+
+
+def test_trait_distributions_are_seeded_and_bounded():
+    normal = NormalTraitDistribution({"openness": 0.9}, {"openness": 5.0})
+    uniform = UniformTraitDistribution(["a", "b"])
+    for dist in (normal, uniform):
+        a = dist.sample(random.Random(7))
+        b = dist.sample(random.Random(7))
+        assert a.dimensions == b.dimensions  # same seed, same sample
+        assert all(0.0 <= v <= 1.0 for v in a.dimensions.values())
+
+
+# ----------------------------------------------------------------- state ----
+def test_state_decay_moves_toward_resting_values():
+    s = AgentState(mood=0.9, energy=1.0, needs={"hunger": 0.2})
+    s.decay(10.0)
+    assert s.mood == pytest.approx(0.7)  # settles toward 0.5 at 0.02/s
+    assert s.energy == pytest.approx(0.95)  # drains at 0.005/s
+    assert s.needs["hunger"] == pytest.approx(0.3)  # grows at 0.01/s
+    s.decay(1000.0)
+    assert s.mood == 0.5 and s.energy == 0.0 and s.needs["hunger"] == 1.0
+
+
+def test_state_decay_from_below_neutral_and_noop():
+    s = AgentState(mood=0.1)
+    s.decay(5.0)
+    assert s.mood == pytest.approx(0.2)
+    before = s.mood
+    s.decay(0.0)
+    assert s.mood == before
+
+
+def test_memory_ring_and_valence():
+    s = AgentState()
+    for i in range(150):
+        s.add_memory(Memory(time=float(i), event_type="e", valence=1.0 if i >= 145 else 0.0))
+    assert len(s.recent_memories(1000)) == 100  # bounded at capacity
+    newest = s.recent_memories(3)
+    assert [m.time for m in newest] == [149.0, 148.0, 147.0]
+    assert s.average_recent_valence(5) == 1.0
+    assert AgentState().average_recent_valence() == 0.0
+
+
+# -------------------------------------------------------------- decision ----
+def test_utility_model_argmax_and_softmax():
+    util = UtilityModel(lambda c, ctx: {"a": 0.1, "b": 0.9}[c.action])
+    assert util.decide(_ctx(["a", "b"]), random.Random(0)).action == "b"
+    # High temperature: both actions get picked over many trials
+    soft = UtilityModel(lambda c, ctx: {"a": 0.1, "b": 0.9}[c.action], temperature=5.0)
+    rng = random.Random(0)
+    picks = {soft.decide(_ctx(["a", "b"]), rng).action for _ in range(50)}
+    assert picks == {"a", "b"}
+    assert util.decide(_ctx([]), random.Random(0)) is None
+
+
+def test_rule_based_priority_and_short_circuit():
+    rules = [
+        Rule(lambda ctx: True, "low", priority=1),
+        Rule(lambda ctx: True, "high", priority=9),
+        Rule(lambda ctx: False, "never", priority=99),
+    ]
+    model = RuleBasedModel(rules)
+    assert model.decide(_ctx(["low", "high"]), random.Random(0)).action == "high"
+    # Winning rule names an absent action -> abstain (no fall-through)
+    assert model.decide(_ctx(["low"]), random.Random(0)) is None
+    # No rule fires -> default action
+    fallback = RuleBasedModel([Rule(lambda ctx: False, "x")], default_action="d")
+    assert fallback.decide(_ctx(["d"]), random.Random(0)).action == "d"
+
+
+def test_bounded_rationality_satisfices_then_settles():
+    util = lambda c, ctx: {"bad": 0.1, "ok": 0.6, "great": 0.95}[c.action]
+    model = BoundedRationalityModel(util, aspiration=0.5)
+    pick = model.decide(_ctx(["bad", "ok", "great"]), random.Random(3))
+    assert pick.action in {"ok", "great"}  # first over aspiration, order shuffled
+    # Nothing clears the bar -> best available
+    picky = BoundedRationalityModel(util, aspiration=0.99)
+    assert picky.decide(_ctx(["bad", "ok", "great"]), random.Random(3)).action == "great"
+
+
+def test_social_influence_follows_the_crowd():
+    # Individual utility is flat; highly agreeable agent + strong peer signal
+    model = SocialInfluenceModel(lambda c, ctx: 0.5, conformity_weight=1.0)
+    traits = PersonalityTraits.big_five(agreeableness=1.0)
+    rng = random.Random(1)
+    tally = {"a": 0, "b": 0}
+    for _ in range(200):
+        ctx = _ctx(
+            ["a", "b"], traits=traits, social_context={"peer_actions": {"b": 98, "a": 2}}
+        )
+        tally[model.decide(ctx, rng).action] += 1
+    assert tally["b"] > tally["a"] * 2
+
+
+def test_composite_model_weighted_vote():
+    always_a = UtilityModel(lambda c, ctx: 1.0 if c.action == "a" else 0.0)
+    always_b = UtilityModel(lambda c, ctx: 1.0 if c.action == "b" else 0.0)
+    model = CompositeModel([(always_a, 1.0), (always_b, 3.0)])
+    assert model.decide(_ctx(["a", "b"]), random.Random(0)).action == "b"
+    assert CompositeModel([]).decide(_ctx(["a"]), random.Random(0)) is None
+
+
+# ------------------------------------------------------------- influence ----
+def test_degroot_weighted_average():
+    model = DeGrootModel(self_weight=0.5)
+    out = model.compute_influence(0.0, [1.0, -1.0], [3.0, 1.0], random.Random(0))
+    assert out == pytest.approx(0.5 * 0.0 + 0.5 * 0.5)  # neighbor mean = 0.5
+    assert model.compute_influence(0.3, [], [], random.Random(0)) == 0.3
+
+
+def test_bounded_confidence_ignores_distant_opinions():
+    model = BoundedConfidenceModel(epsilon=0.2, self_weight=0.0)
+    out = model.compute_influence(0.0, [0.1, 0.9], [1.0, 100.0], random.Random(0))
+    assert out == pytest.approx(0.1)  # 0.9 is outside epsilon despite huge weight
+    assert model.compute_influence(0.0, [0.9], [1.0], random.Random(0)) == 0.0
+
+
+def test_voter_model_adopts_a_neighbor_opinion():
+    model = VoterModel()
+    rng = random.Random(5)
+    outs = {model.compute_influence(0.0, [0.7, -0.7], [1.0, 1.0], rng) for _ in range(30)}
+    assert outs <= {0.7, -0.7} and len(outs) == 2
+
+
+# ------------------------------------------------------------ social graph --
+def test_graph_edges_and_reverse_index():
+    g = SocialGraph()
+    g.add_edge("a", "b", weight=0.9, trust=0.8)
+    g.add_edge("c", "b", weight=0.2)
+    g.add_bidirectional_edge("a", "c")
+    assert g.neighbors("a") == ["b", "c"]
+    assert sorted(g.influencers("b")) == ["a", "c"]
+    assert g.influence_weights("b") == {"a": 0.9, "c": 0.2}
+    assert g.get_edge("a", "b").trust == 0.8
+    assert g.get_edge("b", "z") is None
+    g.record_interaction("a", "b")
+    assert g.get_edge("a", "b").interaction_count == 1
+    g.remove_edge("a", "b")
+    assert g.influencers("b") == ["c"]
+
+
+def test_graph_generators():
+    names = [f"n{i}" for i in range(10)]
+    complete = SocialGraph.complete(names)
+    assert complete.edge_count == 10 * 9  # directed both ways
+    er = SocialGraph.random_erdos_renyi(names, p=0.3, rng=random.Random(4))
+    er2 = SocialGraph.random_erdos_renyi(names, p=0.3, rng=random.Random(4))
+    assert er.edge_count == er2.edge_count > 0  # seeded determinism
+    sw = SocialGraph.small_world(names, k=4, p_rewire=0.2, rng=random.Random(4))
+    assert sw.nodes == set(names)
+    # Ring lattice with k=4 creates 4n directed edges; rewiring preserves count
+    assert sw.edge_count == 4 * 10
+    tiny = SocialGraph.small_world(["a", "b"], k=4)
+    assert tiny.edge_count == 2  # falls back to complete
+
+
+# ----------------------------------------------------------------- agent ----
+def _stimulus(agent, t, choices, **meta):
+    return Event(
+        time=Instant.Epoch + t,
+        event_type="Stimulus",
+        target=agent,
+        context={"metadata": {"choices": choices, **meta}},
+    )
+
+
+def test_agent_decision_pipeline_runs_action_handler():
+    acted = []
+    agent = Agent(
+        "a",
+        decision_model=UtilityModel(lambda c, ctx: 1.0 if c.action == "buy" else 0.0),
+        seed=1,
+    )
+    agent.on_action("buy", lambda ag, choice, ev: acted.append(choice.action) or None)
+    sim = Simulation(entities=[agent])
+    sim.schedule(_stimulus(agent, 0.0, ["buy", "wait"], valence=0.5))
+    sim.run()
+    assert acted == ["buy"]
+    snap = agent.stats
+    assert snap.events_received == 1 and snap.decisions_made == 1
+    assert snap.actions_by_type == {"buy": 1}
+    assert agent.state.mood == pytest.approx(0.55)  # +0.1 * valence
+    assert agent.state.recent_memories(1)[0].event_type == "Stimulus"
+
+
+def test_agent_action_delay_defers_handler():
+    when = []
+    agent = Agent(
+        "a",
+        decision_model=UtilityModel(lambda c, ctx: 1.0),
+        action_delay=2.0,
+        seed=1,
+    )
+    agent.on_action("go", lambda ag, choice, ev: when.append(ag.now.to_seconds()) or None)
+    sim = Simulation(entities=[agent])
+    sim.schedule(_stimulus(agent, 1.0, ["go"]))
+    sim.run()
+    assert when == [3.0]
+
+
+def test_agent_choices_coerced_from_str_and_dict():
+    picked = []
+    agent = Agent("a", decision_model=UtilityModel(lambda c, ctx: c.context.get("u", 0.5)))
+    agent.on_action("x", lambda ag, choice, ev: picked.append(choice) or None)
+    sim = Simulation(entities=[agent])
+    sim.schedule(_stimulus(agent, 0.0, ["y", {"action": "x", "context": {"u": 2.0}}]))
+    sim.run()
+    assert picked[0].action == "x" and picked[0].context == {"u": 2.0}
+
+
+def test_agent_heartbeat_reschedules_as_daemon():
+    agent = Agent("a", heartbeat_interval=1.0)
+    sim = Simulation(entities=[agent], end_time=Instant.Epoch + 5.5)
+    first = agent.schedule_first_heartbeat(Instant.Epoch)
+    assert first is not None and first.daemon
+    assert agent.schedule_first_heartbeat(Instant.Epoch) is None  # armed once
+    sim.schedule(first)
+    # A primary event holds the sim open; daemon heartbeats alone would not
+    sim.schedule(_stimulus(agent, 5.2, []))
+    sim.run()
+    # Heartbeats at t=1..5 plus the stimulus
+    assert agent.stats.events_received == 6
+
+
+def test_agent_social_message_updates_beliefs_and_knowledge():
+    agent = Agent("a", traits=PersonalityTraits.big_five(agreeableness=1.0))
+    agent.state.beliefs["tea"] = 0.0
+    sim = Simulation(entities=[agent])
+    sim.schedule(
+        Event(
+            time=Instant.Epoch,
+            event_type="SocialMessage",
+            target=agent,
+            context={
+                "metadata": {
+                    "topic": "tea",
+                    "opinion": 1.0,
+                    "credibility": 0.5,
+                    "knowledge": ["oolong"],
+                }
+            },
+        )
+    )
+    sim.run()
+    # belief moves susceptibility * (opinion - held) = 1.0*0.5*1.0
+    assert agent.state.beliefs["tea"] == pytest.approx(0.5)
+    assert "oolong" in agent.state.knowledge
+    assert agent.stats.social_messages_received == 1
+
+
+def test_agent_state_decays_between_events():
+    agent = Agent("a", state=AgentState(energy=1.0))
+    sim = Simulation(entities=[agent])
+    sim.schedule(_stimulus(agent, 0.0, []))
+    sim.schedule(_stimulus(agent, 10.0, []))
+    sim.run()
+    assert agent.state.energy == pytest.approx(0.95)  # 10s * 0.005/s
+
+
+# ----------------------------------------------------------- environment ----
+def _buy_model():
+    return UtilityModel(lambda c, ctx: 1.0 if c.action == "buy" else 0.0)
+
+
+def test_environment_broadcast_reaches_all_agents():
+    agents = [Agent(f"a{i}", decision_model=_buy_model(), seed=i) for i in range(3)]
+    env = Environment("env", agents=agents, shared_state={"price": 10})
+    seen_env = []
+    for a in agents:
+        a.on_action("buy", lambda ag, ch, ev: seen_env.append(ev.context["metadata"]["environment"]) or None)
+    sim = Simulation(entities=[env, *agents])
+    sim.schedule(broadcast_stimulus(0.0, env, "Sale", choices=["buy", "wait"]))
+    sim.run()
+    assert len(seen_env) == 3
+    assert all(m == {"price": 10} for m in seen_env)  # shared state enrichment
+    assert env.stats.broadcasts_sent == 1
+
+
+def test_environment_targeted_only_hits_named_agents():
+    agents = [Agent(f"a{i}", decision_model=_buy_model(), seed=i) for i in range(3)]
+    env = Environment("env", agents=agents)
+    sim = Simulation(entities=[env, *agents])
+    sim.schedule(targeted_stimulus(0.0, env, ["a1", "missing"], "Ping", choices=["buy"]))
+    sim.run()
+    received = [a.stats.events_received for a in agents]
+    assert received == [0, 1, 0]
+    assert env.stats.targeted_sends == 1
+
+
+def test_environment_influence_round_converges_opinions():
+    # Fully agreeable so social messages apply at full credibility-scaled step
+    friendly = PersonalityTraits.big_five(agreeableness=1.0)
+    agents = [Agent(f"a{i}", traits=friendly, seed=i) for i in range(2)]
+    agents[0].state.beliefs["topic"] = 1.0
+    agents[1].state.beliefs["topic"] = -1.0
+    graph = SocialGraph.complete(["a0", "a1"], weight=1.0, trust=1.0)
+    env = Environment(
+        "env", agents=agents, social_graph=graph, influence_model=DeGrootModel(0.5)
+    )
+    sim = Simulation(entities=[env, *agents])
+    sim.schedule(influence_propagation(0.0, env, "topic"))
+    sim.run()
+    # DeGroot pulls each toward the other; SocialMessage applies the damped move
+    assert abs(agents[0].state.beliefs["topic"]) < 1.0
+    assert abs(agents[1].state.beliefs["topic"]) < 1.0
+    assert env.stats.influence_rounds == 1
+
+
+def test_environment_state_change_event():
+    env = Environment("env")
+    sim = Simulation(entities=[env])
+    sim.schedule(
+        Event(
+            time=Instant.Epoch,
+            event_type="StateChange",
+            target=env,
+            context={"metadata": {"key": "tax", "value": 0.2}},
+        )
+    )
+    sim.run()
+    assert env.shared_state == {"tax": 0.2}
+    assert env.stats.state_changes == 1
+
+
+def test_environment_peer_actions_enrichment():
+    leader = Agent("leader", decision_model=_buy_model(), seed=0)
+    follower = Agent("follower", decision_model=_buy_model(), seed=1)
+    graph = SocialGraph()
+    graph.add_edge("leader", "follower")  # leader influences follower
+    env = Environment("env", agents=[leader, follower], social_graph=graph)
+    contexts = []
+    follower.on_action(
+        "buy", lambda ag, ch, ev: contexts.append(ev.context["metadata"]["social_context"]) or None
+    )
+    leader.on_action("buy", lambda ag, ch, ev: None)
+    sim = Simulation(entities=[env, leader, follower])
+    sim.schedule(targeted_stimulus(0.0, env, ["leader"], "Sale", choices=["buy"]))
+    sim.schedule(targeted_stimulus(1.0, env, ["follower"], "Sale", choices=["buy"]))
+    sim.run()
+    assert contexts == [{"peer_actions": {"buy": 1}}]  # leader's prior action visible
+
+
+# ------------------------------------------------------------- population ---
+def test_population_uniform_builds_agents_and_graph():
+    pop = Population.uniform(12, decision_model=_buy_model(), seed=9)
+    assert pop.size == 12
+    assert pop.social_graph.nodes == {a.name for a in pop.agents}
+    assert pop.agents[0].name == "agent_0"
+    # Deterministic under the same seed
+    again = Population.uniform(12, seed=9)
+    assert [a.traits.dimensions for a in again.agents] == [
+        a.traits.dimensions for a in Population.uniform(12, seed=9).agents
+    ]
+
+
+def test_population_from_segments_distributes_remainder():
+    segs = [
+        DemographicSegment("early", 0.3, decision_model_factory=_buy_model),
+        DemographicSegment("late", 0.6),
+    ]
+    pop = Population.from_segments(10, segs, seed=2, graph_type="complete")
+    assert pop.size == 10  # 3 + 6 + remainder 1 -> largest segment
+    with_model = [a for a in pop.agents if a.decision_model is not None]
+    assert len(with_model) == 3
+
+
+def test_population_stats_aggregates():
+    pop = Population.uniform(2, decision_model=_buy_model(), seed=0, graph_type="complete")
+    env = Environment("env", agents=pop.agents, social_graph=pop.social_graph)
+    for a in pop.agents:
+        a.on_action("buy", lambda ag, ch, ev: None)
+    sim = Simulation(entities=[env, *pop.agents])
+    sim.schedule(broadcast_stimulus(0.0, env, "Sale", choices=["buy"]))
+    sim.run()
+    stats = pop.stats
+    assert stats.size == 2
+    assert stats.total_events == 2
+    assert stats.total_actions == {"buy": 2}
+
+
+# ---------------------------------------------------------------- stimulus --
+def test_stimulus_factories_build_expected_metadata():
+    env = Environment("env")
+    drop = price_change(1.0, env, "widget", old_price=10.0, new_price=8.0)
+    meta = drop.context["metadata"]
+    assert drop.event_type == "BroadcastStimulus"
+    assert meta["valence"] == 0.3 and meta["new_price"] == 8.0
+    assert {c.action for c in meta["choices"]} == {"buy", "wait", "switch"}
+
+    rise = price_change(1.0, env, "widget", old_price=8.0, new_price=10.0)
+    assert rise.context["metadata"]["valence"] == -0.3
+
+    pol = policy_announcement(2.0, env, "p1", "desc", valence=-0.1)
+    assert {c.action for c in pol.context["metadata"]["choices"]} == {
+        "accept",
+        "protest",
+        "ignore",
+    }
+
+    inf = influence_propagation(3.0, env, "topic")
+    assert inf.event_type == "InfluencePropagation"
+    assert inf.time.to_seconds() == 3.0
