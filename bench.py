@@ -54,13 +54,20 @@ def bench_general_engine(devices) -> dict:
     from happysim_tpu.tpu import mm1_model, run_ensemble
 
     lam, mu = 8.0, 10.0
-    result = run_ensemble(mm1_model(lam=lam, mu=mu), n_replicas=65536, seed=0)
+    # Statistics are measured over [warmup, horizon]. The M/M/1 queue-length
+    # relaxation time at rho=0.8 is ~1/(mu*(1-sqrt(rho))^2) ~ 9s, so the 40s
+    # warmup is ~4.5 time constants (measured residual bias < 0.1% on the
+    # virtual-mesh oracle run); the general engine carries the same 1%
+    # accuracy gate as the kernel.
+    result = run_ensemble(
+        mm1_model(lam=lam, mu=mu, horizon_s=160.0, warmup_s=40.0),
+        n_replicas=65536,
+        seed=0,
+    )
     analytic = (lam / mu) / (mu - lam)
     mean_wait = result.server_mean_wait_s[0]
-    # The engine starts each replica empty, so a finite horizon biases the
-    # mean low; the accuracy gate for the general path allows the known
-    # warmup bias (the kernel benchmark above carries the tight 1% gate).
     error = abs(mean_wait - analytic) / analytic
+    accuracy_ok = bool(error < 0.01)
     return {
         "metric": "simulated-events/sec/chip (general engine, 65k-replica M/M/1)",
         "value": round(result.events_per_second, 0),
@@ -69,8 +76,8 @@ def bench_general_engine(devices) -> dict:
         "mean_wait_s": round(mean_wait, 6),
         "analytic_wait_s": analytic,
         "wait_error_rel": round(error, 6),
-        "accuracy_ok": bool(error < 0.10),
-        "north_star_ok": bool(result.events_per_second >= 10_000_000),
+        "accuracy_ok": accuracy_ok,
+        "north_star_ok": bool(result.events_per_second >= 10_000_000) and accuracy_ok,
         "truncated_replicas": result.truncated_replicas,
         "n_replicas": result.n_replicas,
         "horizon_s": result.horizon_s,

@@ -163,6 +163,9 @@ class _Compiled:
         self.nR = max(len(model.routers), 1)
         self.C = max(model.max_concurrency, 1)
         self.K = max(model.max_queue_capacity, 1)
+        # Statistics before this sim-time are masked out of every
+        # latency/wait/integral accumulator (empty-start transient removal).
+        self.warmup = float(model.warmup_s)
 
         servers = model.servers
         self.slot_valid = np.zeros((self.nV, self.C), np.bool_)
@@ -204,6 +207,7 @@ class _Compiled:
             "srv_busy_int": jnp.zeros((self.nV,), jnp.float32),
             "srv_depth_int": jnp.zeros((self.nV,), jnp.float32),
             "srv_wait_sum": jnp.zeros((self.nV,), jnp.float32),
+            "srv_wait_n": jnp.zeros((self.nV,), jnp.int32),
             "rr_next": jnp.zeros((self.nR,), jnp.int32),
             "sink_count": jnp.zeros((self.nK,), jnp.int32),
             "sink_sum": jnp.zeros((self.nK,), jnp.float32),
@@ -294,7 +298,8 @@ class _Compiled:
     def _deliver_sink(self, state, t, created, sink_index):
         """sink_index may be a static int or a traced index (router choice)."""
         latency = t - created
-        row = self._row(sink_index, self.nK)
+        measure = t >= jnp.float32(self.warmup)
+        row = self._row(sink_index, self.nK) & measure
         row_i = row.astype(jnp.int32)
         row_f = row.astype(jnp.float32)
         hist_mask = row[:, None] & (
@@ -337,13 +342,18 @@ class _Compiled:
             & enq
         )
 
+        measure = t >= jnp.float32(self.warmup)
         return {
             **state,
             "srv_slot_done": jnp.where(slot_mask, t + service, done),
             "srv_slot_created": jnp.where(slot_mask, created, state["srv_slot_created"]),
             "srv_started": state["srv_started"] + row_i * has_free.astype(jnp.int32),
+            # Zero-wait start: counts toward E[Wq] (the analytic rho/(mu-lam)
+            # averages over non-waiters too), contributes 0 to the sum.
+            "srv_wait_n": state["srv_wait_n"]
+            + row_i * (has_free & measure).astype(jnp.int32),
             "srv_busy_int": state["srv_busy_int"]
-            + row_f * jnp.where(has_free, service, 0.0),
+            + row_f * jnp.where(has_free & measure, service, 0.0),
             "srv_q_created": jnp.where(q_mask, created, state["srv_q_created"]),
             "srv_q_enq": jnp.where(q_mask, t, state["srv_q_enq"]),
             "srv_q_len": state["srv_q_len"] + row_i * enq.astype(jnp.int32),
@@ -400,6 +410,8 @@ class _Compiled:
         service = self._sample_service(u[2], v, params)
         pull_mask = slot_mask & has_queued
         row_pull = row_i * has_queued.astype(jnp.int32)
+        measure = t >= jnp.float32(self.warmup)
+        measured_pull = has_queued & measure
         return {
             **state,
             "srv_slot_done": jnp.where(pull_mask, t + service, state["srv_slot_done"]),
@@ -412,9 +424,11 @@ class _Compiled:
             "srv_q_len": state["srv_q_len"] - row_pull,
             "srv_started": state["srv_started"] + row_pull,
             "srv_busy_int": state["srv_busy_int"]
-            + row.astype(jnp.float32) * jnp.where(has_queued, service, 0.0),
+            + row.astype(jnp.float32) * jnp.where(measured_pull, service, 0.0),
             "srv_wait_sum": state["srv_wait_sum"]
-            + row.astype(jnp.float32) * jnp.where(has_queued, t - queued_enq, 0.0),
+            + row.astype(jnp.float32) * jnp.where(measured_pull, t - queued_enq, 0.0),
+            "srv_wait_n": state["srv_wait_n"]
+            + row_i * measured_pull.astype(jnp.int32),
         }
 
     # -- the step ----------------------------------------------------------
@@ -447,7 +461,10 @@ class _Compiled:
             u = jax.random.uniform(step_key, (3,), minval=1e-12, maxval=1.0)
 
             def process(state):
-                dt = t_next - state["t"]
+                # Only the post-warmup portion of the interval counts toward
+                # the depth integral (handles intervals straddling the cutoff).
+                warmup = jnp.float32(self.warmup)
+                dt = jnp.maximum(t_next - jnp.maximum(state["t"], warmup), 0.0)
                 state = {
                     **state,
                     "srv_depth_int": state["srv_depth_int"]
@@ -610,6 +627,7 @@ def run_ensemble(
             "srv_busy_int": jnp.sum(final["srv_busy_int"], axis=0),
             "srv_depth_int": jnp.sum(final["srv_depth_int"], axis=0),
             "srv_wait_sum": jnp.sum(final["srv_wait_sum"], axis=0),
+            "srv_wait_n": jnp.sum(final["srv_wait_n"], axis=0),
         }
         return reduced
 
@@ -638,9 +656,10 @@ def run_ensemble(
     sink_count = host["sink_count"].astype(np.int64)
     with np.errstate(divide="ignore", invalid="ignore"):
         sink_mean = np.where(sink_count > 0, host["sink_sum"] / sink_count, 0.0)
-        started = host["srv_started"][:nV_real].astype(np.int64)
-        wait_mean = np.where(started > 0, host["srv_wait_sum"][:nV_real] / started, 0.0)
-    denom = n_replicas * horizon
+        wait_n = host["srv_wait_n"][:nV_real].astype(np.int64)
+        wait_mean = np.where(wait_n > 0, host["srv_wait_sum"][:nV_real] / wait_n, 0.0)
+    # Integrals are accumulated only over the measured (post-warmup) window.
+    denom = n_replicas * (horizon - compiled.warmup)
     return EnsembleResult(
         n_replicas=n_replicas,
         horizon_s=horizon,

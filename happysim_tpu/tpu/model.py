@@ -71,10 +71,19 @@ class SinkSpec:
 
 
 class EnsembleModel:
-    """Static topology of vectorizable components."""
+    """Static topology of vectorizable components.
 
-    def __init__(self, horizon_s: float = 60.0):
+    ``warmup_s`` masks statistics accumulation before the cutoff: latency,
+    wait, utilization, and queue-depth integrals only measure the
+    (stationary) window [warmup_s, horizon_s], removing the empty-start
+    transient bias. Raw event/drop counts remain whole-run.
+    """
+
+    def __init__(self, horizon_s: float = 60.0, warmup_s: float = 0.0):
+        if warmup_s < 0.0 or warmup_s >= horizon_s:
+            raise ValueError("warmup_s must satisfy 0 <= warmup_s < horizon_s")
         self.horizon_s = horizon_s
+        self.warmup_s = warmup_s
         self.sources: list[SourceSpec] = []
         self.servers: list[ServerSpec] = []
         self.routers: list[RouterSpec] = []
@@ -218,9 +227,9 @@ def pipeline_model(
 
 
 def mm1_model(lam: float = 8.0, mu: float = 10.0, horizon_s: float = 60.0,
-              queue_capacity: int = 512) -> EnsembleModel:
+              queue_capacity: int = 512, warmup_s: float = 0.0) -> EnsembleModel:
     """The canonical M/M/1 as a general-engine model (oracle workload)."""
-    model = EnsembleModel(horizon_s=horizon_s)
+    model = EnsembleModel(horizon_s=horizon_s, warmup_s=warmup_s)
     src = model.source(rate=lam, kind="poisson")
     srv = model.server(concurrency=1, service_mean=1.0 / mu, queue_capacity=queue_capacity)
     snk = model.sink()
