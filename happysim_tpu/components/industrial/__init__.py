@@ -1,0 +1,88 @@
+"""Operations-research / industrial-engineering components.
+
+Parity target: ``happysimulator/components/industrial/`` (15 modules).
+"""
+
+from happysim_tpu.components.industrial.appointment import (
+    AppointmentScheduler,
+    AppointmentStats,
+)
+from happysim_tpu.components.industrial.balking import BalkingQueue
+from happysim_tpu.components.industrial.batch_processor import (
+    BatchProcessor,
+    BatchProcessorStats,
+)
+from happysim_tpu.components.industrial.breakdown import (
+    Breakable,
+    BreakdownScheduler,
+    BreakdownStats,
+)
+from happysim_tpu.components.industrial.conditional_router import (
+    ConditionalRouter,
+    RouterStats,
+)
+from happysim_tpu.components.industrial.conveyor import ConveyorBelt, ConveyorStats
+from happysim_tpu.components.industrial.gate_controller import GateController, GateStats
+from happysim_tpu.components.industrial.inspection import (
+    InspectionStation,
+    InspectionStats,
+)
+from happysim_tpu.components.industrial.inventory import InventoryBuffer, InventoryStats
+from happysim_tpu.components.industrial.perishable_inventory import (
+    PerishableInventory,
+    PerishableInventoryStats,
+)
+from happysim_tpu.components.industrial.pooled_cycle import (
+    PooledCycleResource,
+    PooledCycleStats,
+)
+from happysim_tpu.components.industrial.preemptible_resource import (
+    PreemptibleGrant,
+    PreemptibleResource,
+    PreemptibleResourceStats,
+)
+from happysim_tpu.components.industrial.reneging import (
+    RenegingQueuedResource,
+    RenegingStats,
+)
+from happysim_tpu.components.industrial.shift_schedule import (
+    Shift,
+    ShiftedServer,
+    ShiftSchedule,
+)
+from happysim_tpu.components.industrial.split_merge import SplitMerge, SplitMergeStats
+
+__all__ = [
+    "AppointmentScheduler",
+    "AppointmentStats",
+    "BalkingQueue",
+    "BatchProcessor",
+    "BatchProcessorStats",
+    "Breakable",
+    "BreakdownScheduler",
+    "BreakdownStats",
+    "ConditionalRouter",
+    "ConveyorBelt",
+    "ConveyorStats",
+    "GateController",
+    "GateStats",
+    "InspectionStation",
+    "InspectionStats",
+    "InventoryBuffer",
+    "InventoryStats",
+    "PerishableInventory",
+    "PerishableInventoryStats",
+    "PooledCycleResource",
+    "PooledCycleStats",
+    "PreemptibleGrant",
+    "PreemptibleResource",
+    "PreemptibleResourceStats",
+    "RenegingQueuedResource",
+    "RenegingStats",
+    "RouterStats",
+    "Shift",
+    "ShiftSchedule",
+    "ShiftedServer",
+    "SplitMerge",
+    "SplitMergeStats",
+]

@@ -1,0 +1,95 @@
+"""Appointment-book arrivals with no-shows.
+
+Parity target: ``happysimulator/components/industrial/appointment.py:32``
+(``AppointmentScheduler``). House difference: seeded RNG for the no-show
+draw.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+_APPOINTMENT = "Appointment.tick"
+
+
+@dataclass(frozen=True)
+class AppointmentStats:
+    total_scheduled: int = 0
+    arrivals: int = 0
+    no_shows: int = 0
+
+
+class AppointmentScheduler(Entity):
+    """Generates arrivals at fixed appointment times; some never show.
+
+    Arm with ``for e in scheduler.start_events(): sim.schedule(e)``.
+    Combine with a Poisson :class:`~happysim_tpu.load.source.Source` for
+    walk-in traffic on the same target.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        target: Entity,
+        appointments_s: list[float],
+        no_show_rate: float = 0.0,
+        event_type: str = "Appointment",
+        seed: Optional[int] = None,
+    ):
+        if not 0.0 <= no_show_rate <= 1.0:
+            raise ValueError("no_show_rate must be in [0, 1]")
+        super().__init__(name)
+        self.target = target
+        self.appointments_s = sorted(appointments_s)
+        self.no_show_rate = no_show_rate
+        self.event_type = event_type
+        self.arrivals = 0
+        self.no_shows = 0
+        self._rng = random.Random(seed)
+
+    def stats(self) -> AppointmentStats:
+        return AppointmentStats(
+            total_scheduled=len(self.appointments_s),
+            arrivals=self.arrivals,
+            no_shows=self.no_shows,
+        )
+
+    def start_events(self) -> list[Event]:
+        """One tick per appointment; schedule them all."""
+        return [
+            Event(
+                Instant.from_seconds(t),
+                _APPOINTMENT,
+                target=self,
+                context={"appointment_time_s": t},
+            )
+            for t in self.appointments_s
+        ]
+
+    def handle_event(self, event: Event):
+        if event.event_type != _APPOINTMENT:
+            return None
+        if self._rng.random() < self.no_show_rate:
+            self.no_shows += 1
+            return None
+        self.arrivals += 1
+        return [
+            Event(
+                self.now,
+                self.event_type,
+                target=self.target,
+                context={
+                    "created_at": self.now,
+                    "appointment_time_s": event.context.get("appointment_time_s"),
+                },
+            )
+        ]
+
+    def downstream_entities(self):
+        return [self.target]

@@ -1,0 +1,71 @@
+"""Balking: arrivals that see a long line and leave.
+
+Parity target: ``happysimulator/components/industrial/balking.py:21``
+(``BalkingQueue`` — a QueuePolicy decorator). House differences: seeded RNG
+(the reference uses the global ``random`` module) and rejection via the
+policy-level ``push() -> False`` contract that the house Queue already
+understands (drops unwind completion hooks).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Optional
+
+from happysim_tpu.components.queue_policy import FIFOQueue, QueuePolicy
+
+
+class BalkingQueue(QueuePolicy):
+    """Wraps an inner policy; rejects pushes when the line looks too long.
+
+    At or above ``threshold`` items, a new arrival balks with probability
+    ``balk_probability`` (1.0 = always). The house Queue counts the
+    rejection as a drop and unwinds the event's completion hooks.
+    """
+
+    def __init__(
+        self,
+        inner: Optional[QueuePolicy] = None,
+        threshold: int = 5,
+        balk_probability: float = 1.0,
+        seed: Optional[int] = None,
+    ):
+        if not 0.0 <= balk_probability <= 1.0:
+            raise ValueError("balk_probability must be in [0, 1]")
+        self.inner = inner if inner is not None else FIFOQueue()
+        self.threshold = threshold
+        self.balk_probability = balk_probability
+        self.balked = 0
+        self._rng = random.Random(seed)
+
+    def push(self, item: Any):
+        if len(self.inner) >= self.threshold and self._rng.random() < self.balk_probability:
+            self.balked += 1
+            return False
+        return self.inner.push(item)
+
+    def requeue(self, item: Any) -> None:
+        """Re-admit an already-accepted item at the head — never balks.
+
+        Called by :meth:`Queue.requeue` when the driver hands back a popped
+        item (worker filled between poll and delivery): the item already
+        joined the line, so the balk check must not apply again.
+        """
+        from happysim_tpu.components.queue_policy import FIFOQueue
+
+        if isinstance(self.inner, FIFOQueue):
+            self.inner._items.appendleft(item)
+        else:
+            self.inner.push(item)
+
+    def pop(self) -> Any:
+        return self.inner.pop()
+
+    def peek(self) -> Any:
+        return self.inner.peek()
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def clear(self) -> None:
+        self.inner.clear()

@@ -1,0 +1,98 @@
+"""Batch accumulation: collect N items (or time out), process as one unit.
+
+Parity target: ``happysimulator/components/industrial/batch_processor.py:34``
+(``BatchProcessor``) — flush on full batch or on ``timeout_s`` since the
+first buffered item; one ``process_time_s`` delay covers the whole batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+_BATCH_TIMEOUT = "BatchProcessor.timeout"
+
+
+@dataclass(frozen=True)
+class BatchProcessorStats:
+    batches_processed: int = 0
+    items_processed: int = 0
+    timeouts: int = 0
+
+
+class BatchProcessor(Entity):
+    """Buffers items; processes ``batch_size`` at a time downstream.
+
+    A timeout event is armed when the first item enters an empty buffer
+    (``timeout_s > 0``) and cancelled when the batch fills first.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        batch_size: int = 10,
+        process_time_s: float = 1.0,
+        timeout_s: float = 0.0,
+    ):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be > 0")
+        if process_time_s < 0:
+            raise ValueError("process_time_s must be >= 0")
+        super().__init__(name)
+        self.downstream = downstream
+        self.batch_size = batch_size
+        self.process_time_s = process_time_s
+        self.timeout_s = timeout_s
+        self.batches_processed = 0
+        self.items_processed = 0
+        self.timeouts = 0
+        self._buffer: list[Event] = []
+        self._timeout_event: Optional[Event] = None
+
+    @property
+    def buffer_depth(self) -> int:
+        return len(self._buffer)
+
+    def stats(self) -> BatchProcessorStats:
+        return BatchProcessorStats(
+            batches_processed=self.batches_processed,
+            items_processed=self.items_processed,
+            timeouts=self.timeouts,
+        )
+
+    def handle_event(self, event: Event):
+        if event.event_type == _BATCH_TIMEOUT:
+            self._timeout_event = None
+            if not self._buffer:
+                return None
+            self.timeouts += 1
+            return self._process_batch()
+
+        self._buffer.append(event)
+        if len(self._buffer) >= self.batch_size:
+            return self._process_batch()
+        if len(self._buffer) == 1 and self.timeout_s > 0:
+            # Primary (non-daemon): a pending flush is real work and must
+            # hold the simulation open until it fires or is cancelled.
+            self._timeout_event = Event(
+                self.now + self.timeout_s, _BATCH_TIMEOUT, target=self
+            )
+            return [self._timeout_event]
+        return None
+
+    def _process_batch(self):
+        batch, self._buffer = self._buffer, []
+        if self._timeout_event is not None:
+            self._timeout_event.cancel()
+            self._timeout_event = None
+        yield self.process_time_s
+        self.batches_processed += 1
+        self.items_processed += len(batch)
+        return [self.forward(item, self.downstream) for item in batch]
+
+    def downstream_entities(self):
+        return [self.downstream]

@@ -1,0 +1,103 @@
+"""Random machine breakdowns with repair cycles.
+
+Parity target: ``happysimulator/components/industrial/breakdown.py:49``
+(``BreakdownScheduler``/``Breakable``/``BreakdownStats``). House
+difference: seeded RNG for time-to-failure and repair draws.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional, Protocol, runtime_checkable
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+_BREAKDOWN = "Breakdown.fail"
+_REPAIR = "Breakdown.repair"
+
+
+@runtime_checkable
+class Breakable(Protocol):
+    """Entities whose ``has_capacity`` should honor ``_broken``."""
+
+    _broken: bool
+
+
+@dataclass(frozen=True)
+class BreakdownStats:
+    breakdown_count: int = 0
+    total_downtime_s: float = 0.0
+    total_uptime_s: float = 0.0
+
+    @property
+    def availability(self) -> float:
+        total = self.total_uptime_s + self.total_downtime_s
+        return self.total_uptime_s / total if total > 0 else 1.0
+
+
+class BreakdownScheduler(Entity):
+    """Alternates a target between UP and DOWN via exponential draws.
+
+    While DOWN, ``target._broken`` is True so capacity checks can refuse
+    work. Arm the cycle with ``sim.schedule(scheduler.start_event())``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        target: Entity,
+        mean_time_to_failure_s: float = 100.0,
+        mean_repair_time_s: float = 5.0,
+        seed: Optional[int] = None,
+    ):
+        if mean_time_to_failure_s <= 0 or mean_repair_time_s <= 0:
+            raise ValueError("mean times must be > 0")
+        super().__init__(name)
+        if not hasattr(target, "_broken"):
+            target._broken = False  # type: ignore[attr-defined]
+        self.target = target
+        self.mean_time_to_failure_s = mean_time_to_failure_s
+        self.mean_repair_time_s = mean_repair_time_s
+        self.breakdown_count = 0
+        self.total_downtime_s = 0.0
+        self.total_uptime_s = 0.0
+        self.is_down = False
+        self._last_change_s = 0.0
+        self._rng = random.Random(seed)
+
+    def stats(self) -> BreakdownStats:
+        return BreakdownStats(
+            breakdown_count=self.breakdown_count,
+            total_downtime_s=self.total_downtime_s,
+            total_uptime_s=self.total_uptime_s,
+        )
+
+    def start_event(self) -> Event:
+        """The first failure event; schedule it to arm the cycle."""
+        ttf = self._rng.expovariate(1.0 / self.mean_time_to_failure_s)
+        return Event(Instant.from_seconds(ttf), _BREAKDOWN, target=self, daemon=True)
+
+    def handle_event(self, event: Event):
+        now_s = self.now.to_seconds()
+        elapsed = now_s - self._last_change_s
+        self._last_change_s = now_s
+        if event.event_type == _BREAKDOWN:
+            self.total_uptime_s += elapsed
+            self.is_down = True
+            self.target._broken = True  # type: ignore[attr-defined]
+            self.breakdown_count += 1
+            repair = self._rng.expovariate(1.0 / self.mean_repair_time_s)
+            return [Event(self.now + repair, _REPAIR, target=self, daemon=True)]
+        if event.event_type == _REPAIR:
+            self.total_downtime_s += elapsed
+            self.is_down = False
+            self.target._broken = False  # type: ignore[attr-defined]
+            ttf = self._rng.expovariate(1.0 / self.mean_time_to_failure_s)
+            return [Event(self.now + ttf, _BREAKDOWN, target=self, daemon=True)]
+        return None
+
+    def downstream_entities(self):
+        return [self.target]

@@ -1,0 +1,82 @@
+"""Predicate-ordered event routing.
+
+Parity target:
+``happysimulator/components/industrial/conditional_router.py:34``
+(``ConditionalRouter``/``RouterStats``) — first matching ``(predicate,
+target)`` wins; unmatched events fall to ``default`` or are dropped.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class RouterStats:
+    total_routed: int = 0
+    dropped: int = 0
+    by_target: dict[str, int] = field(default_factory=dict)
+
+
+class ConditionalRouter(Entity):
+    """Routes each event to the first route whose predicate matches."""
+
+    def __init__(
+        self,
+        name: str,
+        routes: list[tuple[Callable[[Event], bool], Entity]],
+        default: Optional[Entity] = None,
+    ):
+        super().__init__(name)
+        self.routes = routes
+        self.default = default
+        self.total_routed = 0
+        self.dropped = 0
+        self.routed_by_target: dict[str, int] = defaultdict(int)
+
+    @classmethod
+    def by_context_field(
+        cls,
+        name: str,
+        context_key: str,
+        mapping: dict[object, Entity],
+        default: Optional[Entity] = None,
+    ) -> "ConditionalRouter":
+        """Dispatch on ``event.context[context_key]`` via a value→target map."""
+        routes = [
+            (lambda e, v=value, k=context_key: e.context.get(k) == v, target)
+            for value, target in mapping.items()
+        ]
+        return cls(name, routes=routes, default=default)
+
+    def stats(self) -> RouterStats:
+        return RouterStats(
+            total_routed=self.total_routed,
+            dropped=self.dropped,
+            by_target=dict(self.routed_by_target),
+        )
+
+    def handle_event(self, event: Event):
+        for predicate, target in self.routes:
+            if predicate(event):
+                return self._route(event, target)
+        if self.default is not None:
+            return self._route(event, self.default)
+        self.dropped += 1
+        return event.complete_as_dropped(self.now, self.name)
+
+    def _route(self, event: Event, target: Entity):
+        self.total_routed += 1
+        self.routed_by_target[target.name] += 1
+        return [self.forward(event, target)]
+
+    def downstream_entities(self):
+        targets = [target for _, target in self.routes]
+        if self.default is not None:
+            targets.append(self.default)
+        return targets

@@ -1,0 +1,71 @@
+"""Fixed transit-time transport between stations.
+
+Parity target: ``happysimulator/components/industrial/conveyor.py:32``
+(``ConveyorBelt``) — a pure delay element with an optional in-transit
+capacity limit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class ConveyorStats:
+    items_transported: int = 0
+    items_in_transit: int = 0
+    items_rejected: int = 0
+
+
+class ConveyorBelt(Entity):
+    """Holds each item for ``transit_time_s`` then forwards downstream.
+
+    ``capacity`` bounds simultaneous in-transit items (0 = unlimited);
+    arrivals beyond it are rejected.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        transit_time_s: float,
+        capacity: int = 0,
+    ):
+        if transit_time_s < 0:
+            raise ValueError("transit_time_s must be >= 0")
+        super().__init__(name)
+        self.downstream = downstream
+        self.transit_time_s = transit_time_s
+        self.capacity = capacity
+        self.in_transit = 0
+        self.transported = 0
+        self.rejected = 0
+
+    def stats(self) -> ConveyorStats:
+        return ConveyorStats(
+            items_transported=self.transported,
+            items_in_transit=self.in_transit,
+            items_rejected=self.rejected,
+        )
+
+    def has_capacity(self) -> bool:
+        return self.capacity <= 0 or self.in_transit < self.capacity
+
+    def handle_event(self, event: Event):
+        if not self.has_capacity():
+            self.rejected += 1
+            return event.complete_as_dropped(self.now, self.name)
+        self.in_transit += 1
+        return self._transport(event)
+
+    def _transport(self, event: Event):
+        yield self.transit_time_s
+        self.in_transit -= 1
+        self.transported += 1
+        return [self.forward(event, self.downstream)]
+
+    def downstream_entities(self):
+        return [self.downstream]

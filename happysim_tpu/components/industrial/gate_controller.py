@@ -1,0 +1,119 @@
+"""Scheduled or programmatic gate in front of a downstream entity.
+
+Parity target: ``happysimulator/components/industrial/gate_controller.py:34``
+(``GateController``/``GateStats``) — closed gates queue (bounded) arrivals;
+opening flushes the queue downstream in arrival order.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+_OPEN = "Gate.open"
+_CLOSE = "Gate.close"
+
+
+@dataclass(frozen=True)
+class GateStats:
+    passed_through: int = 0
+    queued_while_closed: int = 0
+    rejected: int = 0
+    open_cycles: int = 0
+    is_open: bool = True
+
+
+class GateController(Entity):
+    """Pass-through when open; buffer (or reject) when closed."""
+
+    def __init__(
+        self,
+        name: str,
+        downstream: Entity,
+        schedule: Optional[list[tuple[float, float]]] = None,
+        initially_open: bool = True,
+        queue_capacity: int = 0,
+    ):
+        super().__init__(name)
+        self.downstream = downstream
+        self.schedule = schedule or []
+        self.is_open = initially_open
+        self.queue_capacity = queue_capacity
+        self.passed_through = 0
+        self.queued_while_closed = 0
+        self.rejected = 0
+        self.open_cycles = 0
+        self._queue: deque[Event] = deque()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def stats(self) -> GateStats:
+        return GateStats(
+            passed_through=self.passed_through,
+            queued_while_closed=self.queued_while_closed,
+            rejected=self.rejected,
+            open_cycles=self.open_cycles,
+            is_open=self.is_open,
+        )
+
+    def start_events(self) -> list[Event]:
+        """Daemon open/close events for every scheduled interval."""
+        produced: list[Event] = []
+        for open_at_s, close_at_s in self.schedule:
+            produced.append(
+                Event(Instant.from_seconds(open_at_s), _OPEN, target=self, daemon=True)
+            )
+            produced.append(
+                Event(Instant.from_seconds(close_at_s), _CLOSE, target=self, daemon=True)
+            )
+        return produced
+
+    def open(self) -> list[Event]:
+        """Open programmatically; returns the flushed events to schedule."""
+        return self._open()
+
+    def close(self) -> list[Event]:
+        """Close programmatically."""
+        self._close()
+        return []
+
+    def handle_event(self, event: Event):
+        if event.event_type == _OPEN:
+            return self._open() or None
+        if event.event_type == _CLOSE:
+            self._close()
+            return None
+        if self.is_open:
+            self.passed_through += 1
+            return [self.forward(event, self.downstream)]
+        if self.queue_capacity > 0 and len(self._queue) >= self.queue_capacity:
+            self.rejected += 1
+            return event.complete_as_dropped(self.now, self.name)
+        self._queue.append(event)
+        self.queued_while_closed += 1
+        return None
+
+    def _open(self) -> list[Event]:
+        if self.is_open:
+            return []
+        self.is_open = True
+        self.open_cycles += 1
+        flushed: list[Event] = []
+        while self._queue:
+            queued = self._queue.popleft()
+            self.passed_through += 1
+            flushed.append(self.forward(queued, self.downstream))
+        return flushed
+
+    def _close(self) -> None:
+        self.is_open = False
+
+    def downstream_entities(self):
+        return [self.downstream]

@@ -1,0 +1,71 @@
+"""Probabilistic pass/fail quality inspection.
+
+Parity target: ``happysimulator/components/industrial/inspection.py:36``
+(``InspectionStation``). House difference: seeded RNG (the reference draws
+from the global ``random`` module).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queued_resource import QueuedResource
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class InspectionStats:
+    inspected: int = 0
+    passed: int = 0
+    failed: int = 0
+
+
+class InspectionStation(QueuedResource):
+    """Inspects each item for ``inspection_time_s``; routes by outcome."""
+
+    def __init__(
+        self,
+        name: str,
+        pass_target: Entity,
+        fail_target: Entity,
+        inspection_time_s: float = 0.1,
+        pass_rate: float = 0.95,
+        queue_policy: Optional[QueuePolicy] = None,
+        seed: Optional[int] = None,
+    ):
+        if not 0.0 <= pass_rate <= 1.0:
+            raise ValueError("pass_rate must be in [0, 1]")
+        if inspection_time_s < 0:
+            raise ValueError("inspection_time_s must be >= 0")
+        super().__init__(name, queue_policy=queue_policy)
+        self.pass_target = pass_target
+        self.fail_target = fail_target
+        self.inspection_time_s = inspection_time_s
+        self.pass_rate = pass_rate
+        self.inspected = 0
+        self.passed = 0
+        self.failed = 0
+        self._rng = random.Random(seed)
+
+    def stats(self) -> InspectionStats:
+        return InspectionStats(
+            inspected=self.inspected, passed=self.passed, failed=self.failed
+        )
+
+    def handle_queued_event(self, event: Event):
+        yield self.inspection_time_s
+        self.inspected += 1
+        if self._rng.random() < self.pass_rate:
+            self.passed += 1
+            target = self.pass_target
+        else:
+            self.failed += 1
+            target = self.fail_target
+        return [self.forward(event, target)]
+
+    def downstream_entities(self):
+        return super().downstream_entities() + [self.pass_target, self.fail_target]

@@ -1,0 +1,121 @@
+"""Inventory buffer with an (s, Q) reorder policy.
+
+Parity target: ``happysimulator/components/industrial/inventory.py:40``
+(``InventoryBuffer``) — consume events draw stock; at or below the reorder
+point ``s`` a replenishment of ``Q`` arrives after ``lead_time_s``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+_REPLENISH = "Inventory.replenish"
+
+
+@dataclass(frozen=True)
+class InventoryStats:
+    current_stock: int = 0
+    stockouts: int = 0
+    reorders: int = 0
+    items_consumed: int = 0
+    items_replenished: int = 0
+
+    @property
+    def fill_rate(self) -> float:
+        total = self.items_consumed + self.stockouts
+        return self.items_consumed / total if total > 0 else 1.0
+
+
+class InventoryBuffer(Entity):
+    """Stock counter with (s, Q) replenishment.
+
+    Satisfied demand forwards to ``downstream`` as ``"Fulfilled"``;
+    unsatisfiable demand counts a stockout and optionally forwards to
+    ``stockout_target`` as ``"Stockout"``. Demand quantity comes from
+    ``event.context["quantity"]`` (default 1).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        initial_stock: int = 100,
+        reorder_point: int = 20,
+        order_quantity: int = 50,
+        lead_time_s: float = 5.0,
+        supplier: Optional[Entity] = None,
+        downstream: Optional[Entity] = None,
+        stockout_target: Optional[Entity] = None,
+    ):
+        if initial_stock < 0 or reorder_point < 0:
+            raise ValueError("stock levels must be >= 0")
+        if order_quantity <= 0:
+            raise ValueError("order_quantity must be > 0")
+        super().__init__(name)
+        self.stock = initial_stock
+        self.reorder_point = reorder_point
+        self.order_quantity = order_quantity
+        self.lead_time_s = lead_time_s
+        self.supplier = supplier
+        self.downstream = downstream
+        self.stockout_target = stockout_target
+        self.stockouts = 0
+        self.reorders = 0
+        self.items_consumed = 0
+        self.items_replenished = 0
+        self._order_pending = False
+
+    def stats(self) -> InventoryStats:
+        return InventoryStats(
+            current_stock=self.stock,
+            stockouts=self.stockouts,
+            reorders=self.reorders,
+            items_consumed=self.items_consumed,
+            items_replenished=self.items_replenished,
+        )
+
+    def handle_event(self, event: Event):
+        if event.event_type == _REPLENISH:
+            quantity = event.context.get("quantity", self.order_quantity)
+            self.stock += quantity
+            self.items_replenished += quantity
+            self._order_pending = False
+            return None
+        return self._consume(event)
+
+    def _consume(self, event: Event):
+        amount = event.context.get("quantity", 1)
+        produced: list[Event] = []
+        if self.stock >= amount:
+            self.stock -= amount
+            self.items_consumed += amount
+            if self.downstream is not None:
+                produced.append(self.forward(event, self.downstream, event_type="Fulfilled"))
+        else:
+            self.stockouts += 1
+            if self.stockout_target is not None:
+                produced.append(
+                    self.forward(event, self.stockout_target, event_type="Stockout")
+                )
+        if self.stock <= self.reorder_point and not self._order_pending:
+            self._order_pending = True
+            self.reorders += 1
+            produced.append(
+                Event(
+                    self.now + self.lead_time_s,
+                    _REPLENISH,
+                    target=self,
+                    context={"quantity": self.order_quantity},
+                )
+            )
+        return produced or None
+
+    def downstream_entities(self):
+        return [
+            entity
+            for entity in (self.downstream, self.supplier, self.stockout_target)
+            if entity is not None
+        ]

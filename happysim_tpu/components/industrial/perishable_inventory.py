@@ -1,0 +1,187 @@
+"""Perishable inventory: shelf life, spoilage sweeps, (s, Q) reorder.
+
+Parity target:
+``happysimulator/components/industrial/perishable_inventory.py:42``
+(``PerishableInventory``) — FIFO age batches, periodic spoilage checks as
+self-perpetuating daemon events, waste-rate accounting.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+_SPOILAGE_CHECK = "PerishableInventory.spoilage_check"
+_REPLENISH = "PerishableInventory.replenish"
+
+
+@dataclass(frozen=True)
+class PerishableInventoryStats:
+    current_stock: int = 0
+    total_consumed: int = 0
+    total_spoiled: int = 0
+    stockouts: int = 0
+    reorders: int = 0
+
+    @property
+    def waste_rate(self) -> float:
+        total = self.total_consumed + self.total_spoiled
+        return self.total_spoiled / total if total > 0 else 0.0
+
+
+class PerishableInventory(Entity):
+    """Stock held as FIFO ``(arrival, quantity)`` batches that expire.
+
+    Arm the spoilage sweep with ``sim.schedule(inv.start_event())``.
+    Initial stock is timestamped at the first handled event unless
+    ``initial_stock_time_s`` pins it explicitly.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        initial_stock: int = 100,
+        shelf_life_s: float = 3600.0,
+        spoilage_check_interval_s: float = 60.0,
+        reorder_point: int = 20,
+        order_quantity: int = 50,
+        lead_time_s: float = 5.0,
+        downstream: Optional[Entity] = None,
+        waste_target: Optional[Entity] = None,
+        initial_stock_time_s: Optional[float] = None,
+    ):
+        super().__init__(name)
+        self.shelf_life_s = shelf_life_s
+        self.spoilage_check_interval_s = spoilage_check_interval_s
+        self.reorder_point = reorder_point
+        self.order_quantity = order_quantity
+        self.lead_time_s = lead_time_s
+        self.downstream = downstream
+        self.waste_target = waste_target
+        self._batches: deque[tuple[Instant, int]] = deque()
+        self._deferred_initial = 0
+        if initial_stock > 0:
+            if initial_stock_time_s is not None:
+                self._batches.append(
+                    (Instant.from_seconds(initial_stock_time_s), initial_stock)
+                )
+            else:
+                self._deferred_initial = initial_stock
+        self.total_consumed = 0
+        self.total_spoiled = 0
+        self.stockouts = 0
+        self.reorders = 0
+        self._order_pending = False
+
+    @property
+    def stock(self) -> int:
+        return self._deferred_initial + sum(qty for _, qty in self._batches)
+
+    def stats(self) -> PerishableInventoryStats:
+        return PerishableInventoryStats(
+            current_stock=self.stock,
+            total_consumed=self.total_consumed,
+            total_spoiled=self.total_spoiled,
+            stockouts=self.stockouts,
+            reorders=self.reorders,
+        )
+
+    def start_event(self) -> Event:
+        """The first spoilage sweep; schedule it to arm the cycle."""
+        return Event(
+            Instant.from_seconds(self.spoilage_check_interval_s),
+            _SPOILAGE_CHECK,
+            target=self,
+            daemon=True,
+        )
+
+    def handle_event(self, event: Event):
+        if self._deferred_initial > 0:
+            self._batches.append((self.now, self._deferred_initial))
+            self._deferred_initial = 0
+        if event.event_type == _SPOILAGE_CHECK:
+            return self._sweep_spoilage()
+        if event.event_type == _REPLENISH:
+            quantity = event.context.get("quantity", self.order_quantity)
+            self._batches.append((self.now, quantity))
+            self._order_pending = False
+            return None
+        return self._consume(event)
+
+    def _sweep_spoilage(self):
+        spoiled = 0
+        while self._batches:
+            arrival, qty = self._batches[0]
+            if (self.now - arrival).to_seconds() >= self.shelf_life_s:
+                self._batches.popleft()
+                spoiled += qty
+            else:
+                break
+        produced: list[Event] = []
+        if spoiled > 0:
+            self.total_spoiled += spoiled
+            if self.waste_target is not None:
+                produced.append(
+                    Event(
+                        self.now,
+                        "Spoiled",
+                        target=self.waste_target,
+                        context={"quantity": spoiled},
+                    )
+                )
+        produced.extend(self._maybe_reorder())
+        produced.append(
+            Event(
+                self.now + self.spoilage_check_interval_s,
+                _SPOILAGE_CHECK,
+                target=self,
+                daemon=True,
+            )
+        )
+        return produced
+
+    def _consume(self, event: Event):
+        amount = event.context.get("quantity", 1)
+        produced: list[Event] = []
+        if self.stock >= amount:
+            self._drain_fifo(amount)
+            self.total_consumed += amount
+            if self.downstream is not None:
+                produced.append(self.forward(event, self.downstream, event_type="Fulfilled"))
+        else:
+            self.stockouts += 1
+        produced.extend(self._maybe_reorder())
+        return produced or None
+
+    def _drain_fifo(self, amount: int) -> None:
+        remaining = amount
+        while remaining > 0 and self._batches:
+            arrival, qty = self._batches[0]
+            if qty <= remaining:
+                self._batches.popleft()
+                remaining -= qty
+            else:
+                self._batches[0] = (arrival, qty - remaining)
+                remaining = 0
+
+    def _maybe_reorder(self) -> list[Event]:
+        if self.stock <= self.reorder_point and not self._order_pending:
+            self._order_pending = True
+            self.reorders += 1
+            return [
+                Event(
+                    self.now + self.lead_time_s,
+                    _REPLENISH,
+                    target=self,
+                    context={"quantity": self.order_quantity},
+                )
+            ]
+        return []
+
+    def downstream_entities(self):
+        return [e for e in (self.downstream, self.waste_target) if e is not None]

@@ -1,0 +1,102 @@
+"""Pool of identical fixed-cycle units (washers, ride seats, rentals).
+
+Parity target: ``happysimulator/components/industrial/pooled_cycle.py:37``
+(``PooledCycleResource``) — each use holds one unit for ``cycle_time_s``,
+then the unit returns to the pool and any queued item starts.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class PooledCycleStats:
+    pool_size: int = 0
+    available: int = 0
+    active: int = 0
+    queued: int = 0
+    completed: int = 0
+    rejected: int = 0
+    utilization: float = 0.0
+
+
+class PooledCycleResource(Entity):
+    """N identical units; arrivals queue (bounded) when all are busy."""
+
+    def __init__(
+        self,
+        name: str,
+        pool_size: int,
+        cycle_time_s: float,
+        downstream: Optional[Entity] = None,
+        queue_capacity: int = 0,
+    ):
+        if pool_size <= 0:
+            raise ValueError("pool_size must be > 0")
+        if cycle_time_s < 0:
+            raise ValueError("cycle_time_s must be >= 0")
+        super().__init__(name)
+        self.pool_size = pool_size
+        self.cycle_time_s = cycle_time_s
+        self.downstream = downstream
+        self.queue_capacity = queue_capacity
+        self.available = pool_size
+        self.active = 0
+        self.completed = 0
+        self.rejected = 0
+        self._queue: deque[Event] = deque()
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def utilization(self) -> float:
+        return self.active / self.pool_size
+
+    def stats(self) -> PooledCycleStats:
+        return PooledCycleStats(
+            pool_size=self.pool_size,
+            available=self.available,
+            active=self.active,
+            queued=len(self._queue),
+            completed=self.completed,
+            rejected=self.rejected,
+            utilization=self.utilization,
+        )
+
+    def handle_event(self, event: Event):
+        if self.available > 0:
+            return self._run_cycle(event)
+        if self.queue_capacity > 0 and len(self._queue) >= self.queue_capacity:
+            self.rejected += 1
+            return event.complete_as_dropped(self.now, self.name)
+        self._queue.append(event)
+        return None
+
+    def _run_cycle(self, event: Event):
+        self.available -= 1
+        self.active += 1
+        try:
+            yield self.cycle_time_s
+        finally:
+            self.active -= 1
+            self.available += 1
+        self.completed += 1
+        produced: list[Event] = []
+        if self.downstream is not None:
+            produced.append(self.forward(event, self.downstream))
+        if self._queue and self.available > 0:
+            # Re-dispatch the next waiter to ourselves at the current time.
+            waiter = self._queue.popleft()
+            produced.append(self.forward(waiter, self))
+        return produced
+
+    def downstream_entities(self):
+        return [self.downstream] if self.downstream is not None else []

@@ -1,0 +1,73 @@
+"""Reneging: queued items that give up after waiting too long.
+
+Parity target: ``happysimulator/components/industrial/reneging.py:35``
+(``RenegingQueuedResource``). An item's patience comes from
+``event.context["patience_s"]`` or the resource default; items over
+patience at dequeue time are routed to ``reneged_target`` instead of
+being served.
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queued_resource import QueuedResource
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class RenegingStats:
+    served: int = 0
+    reneged: int = 0
+
+
+class RenegingQueuedResource(QueuedResource):
+    """QueuedResource that checks patience before serving each item.
+
+    Subclasses implement :meth:`handle_served_event` for items still
+    within their patience window; expired items are forwarded to
+    ``reneged_target`` (or discarded) with event type ``"Reneged"``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        reneged_target: Optional[Entity] = None,
+        default_patience_s: float = float("inf"),
+        queue_policy: Optional[QueuePolicy] = None,
+        queue_capacity: Optional[int] = None,
+    ):
+        super().__init__(name, queue_policy=queue_policy, queue_capacity=queue_capacity)
+        self.reneged_target = reneged_target
+        self.default_patience_s = default_patience_s
+        self.served = 0
+        self.reneged = 0
+
+    def reneging_stats(self) -> RenegingStats:
+        return RenegingStats(served=self.served, reneged=self.reneged)
+
+    def handle_queued_event(self, event: Event):
+        created_at = event.context.get("created_at", self.now)
+        patience_s = event.context.get("patience_s", self.default_patience_s)
+        waited_s = (self.now - created_at).to_seconds()
+        if waited_s > patience_s:
+            self.reneged += 1
+            if self.reneged_target is None:
+                return None
+            return [self.forward(event, self.reneged_target, event_type="Reneged")]
+        self.served += 1
+        return self.handle_served_event(event)
+
+    @abstractmethod
+    def handle_served_event(self, event: Event):
+        """Process an item that is still within its patience window."""
+
+    def downstream_entities(self):
+        downstream = super().downstream_entities()
+        if self.reneged_target is not None:
+            downstream.append(self.reneged_target)
+        return downstream

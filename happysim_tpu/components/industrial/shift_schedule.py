@@ -1,0 +1,140 @@
+"""Shift-based time-varying server capacity.
+
+Parity target: ``happysimulator/components/industrial/shift_schedule.py:29-87``
+(``Shift``/``ShiftSchedule``/``ShiftedServer``). House difference: a shift
+change that raises capacity while work is queued kicks the queue driver
+immediately (the reference waits for the next arrival or completion to
+re-poll, stranding queued work across idle shift boundaries).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.components.queue import QUEUE_NOTIFY
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queued_resource import QueuedResource
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.temporal import Instant
+
+_SHIFT_CHANGE = "ShiftedServer.shift_change"
+
+
+@dataclass(frozen=True)
+class Shift:
+    """Capacity over the half-open window [start_s, end_s)."""
+
+    start_s: float
+    end_s: float
+    capacity: int
+
+
+class ShiftSchedule:
+    """Ordered, possibly-gapped shifts; gaps fall back to ``default_capacity``."""
+
+    def __init__(self, shifts: list[Shift], default_capacity: int = 0):
+        self.shifts = sorted(shifts, key=lambda shift: shift.start_s)
+        self.default_capacity = default_capacity
+
+    def capacity_at(self, time_s: float) -> int:
+        for shift in self.shifts:
+            if shift.start_s <= time_s < shift.end_s:
+                return shift.capacity
+        return self.default_capacity
+
+    def transition_times(self) -> list[float]:
+        times: set[float] = set()
+        for shift in self.shifts:
+            times.add(shift.start_s)
+            times.add(shift.end_s)
+        return sorted(times)
+
+    def next_transition_after(self, time_s: float) -> Optional[float]:
+        for t in self.transition_times():
+            if t > time_s:
+                return t
+        return None
+
+
+class ShiftedServer(QueuedResource):
+    """QueuedResource whose concurrency follows a :class:`ShiftSchedule`.
+
+    Schedule :meth:`start_events` into the simulation to arm the shift
+    transitions up front; otherwise they are armed lazily on the first
+    arrival (matching the reference's self-perpetuating pattern).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        schedule: ShiftSchedule,
+        service_time_s: float = 0.1,
+        downstream: Optional[Entity] = None,
+        queue_policy: Optional[QueuePolicy] = None,
+    ):
+        super().__init__(name, queue_policy=queue_policy)
+        self.schedule = schedule
+        self.service_time_s = service_time_s
+        self.downstream = downstream
+        self.current_capacity = schedule.capacity_at(0.0)
+        self.active = 0
+        self.processed = 0
+        self._transitions_armed = False
+
+    def start_events(self) -> list[Event]:
+        """Daemon events for every shift boundary (schedule via ``sim.schedule``)."""
+        self._transitions_armed = True
+        return [
+            Event(Instant.from_seconds(t), _SHIFT_CHANGE, target=self, daemon=True)
+            for t in self.schedule.transition_times()
+        ]
+
+    def worker_has_capacity(self) -> bool:
+        return self.active < self.current_capacity and not getattr(self, "_broken", False)
+
+    def handle_event(self, event: Event):
+        if event.event_type == _SHIFT_CHANGE:
+            return self._change_shift()
+        if not self._transitions_armed:
+            armed = self._arm_remaining_transitions()
+            produced = super().handle_event(event)
+            if armed:
+                produced = (produced or []) + armed if isinstance(produced, list) else armed
+            return produced
+        return super().handle_event(event)
+
+    def _arm_remaining_transitions(self) -> list[Event]:
+        self._transitions_armed = True
+        self.current_capacity = self.schedule.capacity_at(self.now.to_seconds())
+        return [
+            Event(Instant.from_seconds(t), _SHIFT_CHANGE, target=self, daemon=True)
+            for t in self.schedule.transition_times()
+            if t > self.now.to_seconds()
+        ]
+
+    def _change_shift(self):
+        previous = self.current_capacity
+        self.current_capacity = self.schedule.capacity_at(self.now.to_seconds())
+        if self.current_capacity > previous and self.queue_depth > 0:
+            # Capacity appeared while work is queued: wake the driver now.
+            return [Event(self.now, QUEUE_NOTIFY, target=self.driver)]
+        return None
+
+    def handle_queued_event(self, event: Event):
+        self.active += 1
+        try:
+            yield self.service_time_s
+        finally:
+            self.active -= 1
+        self.processed += 1
+        if self.downstream is not None:
+            return [self.forward(event, self.downstream)]
+        return None
+
+    def downstream_entities(self):
+        downstream = super().downstream_entities()
+        if self.downstream is not None:
+            downstream.append(self.downstream)
+        return downstream

@@ -1,0 +1,86 @@
+"""Fan-out / fan-in over parallel sub-tasks.
+
+Parity target: ``happysimulator/components/industrial/split_merge.py:33``
+(``SplitMerge``) — one event fans out to N targets, each resolving
+``context["reply_future"]``; ``all_of`` gates the merge, and the merged
+event carries ``context["sub_results"]`` downstream.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.sim_future import SimFuture, all_of
+
+
+@dataclass(frozen=True)
+class SplitMergeStats:
+    splits_initiated: int = 0
+    merges_completed: int = 0
+    fan_out: int = 0
+
+
+class SplitMerge(Entity):
+    """Fans an event out to every target, merges when all reply.
+
+    Each target receives a ``split_event_type`` event whose context holds
+    a fresh ``reply_future``; targets resolve it with their result.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        targets: list[Entity],
+        downstream: Entity,
+        split_event_type: str = "SubTask",
+        merge_event_type: str = "Merged",
+    ):
+        if not targets:
+            raise ValueError("SplitMerge needs at least one target")
+        super().__init__(name)
+        self.targets = targets
+        self.downstream = downstream
+        self.split_event_type = split_event_type
+        self.merge_event_type = merge_event_type
+        self.splits_initiated = 0
+        self.merges_completed = 0
+
+    def stats(self) -> SplitMergeStats:
+        return SplitMergeStats(
+            splits_initiated=self.splits_initiated,
+            merges_completed=self.merges_completed,
+            fan_out=len(self.targets),
+        )
+
+    def handle_event(self, event: Event):
+        self.splits_initiated += 1
+        futures: list[SimFuture] = []
+        sub_events: list[Event] = []
+        for target in self.targets:
+            future = SimFuture()
+            futures.append(future)
+            sub_events.append(
+                Event(
+                    self.now,
+                    self.split_event_type,
+                    target=target,
+                    context={**event.context, "reply_future": future},
+                )
+            )
+        # Emit the fan-out and park on the merge in one step: yielding
+        # (future, side_effects) schedules the sub-events and suspends.
+        results = yield all_of(*futures), sub_events
+        self.merges_completed += 1
+        return [
+            Event(
+                self.now,
+                self.merge_event_type,
+                target=self.downstream,
+                context={**event.context, "sub_results": results},
+            )
+        ]
+
+    def downstream_entities(self):
+        return list(self.targets) + [self.downstream]

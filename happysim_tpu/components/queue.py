@@ -145,7 +145,10 @@ class Queue(Entity):
         """
         from happysim_tpu.components.queue_policy import FIFOQueue
 
-        if isinstance(self.policy, FIFOQueue):
+        if hasattr(self.policy, "requeue"):
+            # Wrapper policies (BalkingQueue) re-admit without re-screening.
+            self.policy.requeue(payload)
+        elif isinstance(self.policy, FIFOQueue):
             self.policy._items.appendleft(payload)
         else:
             accepted = self.policy.push(payload)

@@ -73,10 +73,12 @@ class SinkSpec:
 class EnsembleModel:
     """Static topology of vectorizable components.
 
-    ``warmup_s`` masks statistics accumulation before the cutoff: latency,
-    wait, utilization, and queue-depth integrals only measure the
-    (stationary) window [warmup_s, horizon_s], removing the empty-start
-    transient bias. Raw event/drop counts remain whole-run.
+    ``warmup_s`` masks statistics accumulation before the cutoff: sink
+    latency samples (count/mean/percentile histogram), server waits,
+    utilization, and queue-depth integrals only measure the (stationary)
+    window [warmup_s, horizon_s], removing the empty-start transient bias.
+    Server started/completed/dropped counters remain whole-run, so
+    ``server_completed == sink_count`` only holds when ``warmup_s == 0``.
     """
 
     def __init__(self, horizon_s: float = 60.0, warmup_s: float = 0.0):

@@ -1,0 +1,674 @@
+"""Unit tests: industrial components (OR / manufacturing building blocks).
+
+Mirrors the reference's coverage (tests/unit/components/industrial/ and
+tests/integration/industrial/) with tiny real simulations, per the
+unit≈micro-integration strategy (SURVEY.md §4).
+"""
+
+import pytest
+
+from happysim_tpu import (
+    AppointmentScheduler,
+    BalkingQueue,
+    BatchProcessor,
+    BreakdownScheduler,
+    ConditionalRouter,
+    ConstantLatency,
+    ConveyorBelt,
+    Counter,
+    Event,
+    FIFOQueue,
+    GateController,
+    InspectionStation,
+    Instant,
+    InventoryBuffer,
+    PerishableInventory,
+    PooledCycleResource,
+    PreemptibleResource,
+    RenegingQueuedResource,
+    Server,
+    Shift,
+    ShiftSchedule,
+    ShiftedServer,
+    Simulation,
+    Sink,
+    SplitMerge,
+)
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.sim_future import SimFuture
+
+
+def run_sim(entities, events, end_s=None):
+    sim = Simulation(
+        entities=entities,
+        end_time=Instant.from_seconds(end_s) if end_s is not None else None,
+    )
+    sim.schedule(events)
+    sim.run()
+    return sim
+
+
+def keepalive(until_s):
+    """A primary event that holds the sim open while daemon cycles run.
+
+    Daemon events (breakdowns, spoilage sweeps, shift changes) never hold
+    the simulation open by themselves — same semantics as the reference.
+    """
+    return Event(Instant.from_seconds(until_s), "Keepalive", target=Counter("keepalive"))
+
+
+class TestBalkingQueue:
+    def test_accepts_below_threshold(self):
+        policy = BalkingQueue(threshold=2, balk_probability=1.0)
+        policy.push("a")
+        policy.push("b")
+        assert len(policy) == 2
+        assert policy.balked == 0
+
+    def test_always_balks_at_threshold(self):
+        policy = BalkingQueue(threshold=1, balk_probability=1.0)
+        policy.push("a")
+        assert policy.push("b") is False
+        assert policy.balked == 1
+        assert policy.pop() == "a"
+
+    def test_probabilistic_balk_is_seeded(self):
+        def balk_count(seed):
+            policy = BalkingQueue(threshold=0, balk_probability=0.5, seed=seed)
+            return sum(policy.push(i) is False for i in range(100))
+
+        assert balk_count(1) == balk_count(1)
+        assert 20 < balk_count(1) < 80
+
+    def test_server_counts_balked_as_dropped(self):
+        """A balking policy inside a Server surfaces as queue drops."""
+        sink = Sink()
+        server = Server(
+            "teller",
+            service_time=ConstantLatency(1.0),
+            queue_policy=BalkingQueue(threshold=1, balk_probability=1.0),
+            downstream=sink,
+        )
+        events = [Event(Instant.Epoch, "Customer", target=server) for _ in range(4)]
+        run_sim([server, sink], events)
+        # 1 in service, 1 queued, 2 balk (depth already at threshold).
+        assert server.queue.dropped == 2
+        assert sink.events_received == 2
+
+
+class _ImpatientDesk(RenegingQueuedResource):
+    def __init__(self, name, reneged_target, patience_s):
+        super().__init__(name, reneged_target=reneged_target, default_patience_s=patience_s)
+        self.service_time_s = 1.0
+        self.active = 0
+
+    def worker_has_capacity(self):
+        return self.active < 1
+
+    def handle_served_event(self, event):
+        self.active += 1
+        try:
+            yield self.service_time_s
+        finally:
+            self.active -= 1
+        return [self.forward(event, self.sink)]
+
+
+class TestReneging:
+    def test_impatient_items_renege(self):
+        served_sink = Sink("served")
+        reneged_counter = Counter("reneged")
+        desk = _ImpatientDesk("desk", reneged_counter, patience_s=0.5)
+        desk.sink = served_sink
+        events = [Event(Instant.Epoch, "Customer", target=desk) for _ in range(3)]
+        run_sim([desk, served_sink, reneged_counter], events)
+        # First starts immediately (wait 0); the rest are dequeued at t=1.0
+        # having waited past their 0.5s patience.
+        assert desk.served == 1
+        assert desk.reneged == 2
+        assert reneged_counter.count == 2
+        assert desk.reneging_stats().served == 1
+
+    def test_patient_items_all_served(self):
+        served_sink = Sink("served")
+        desk = _ImpatientDesk("desk", None, patience_s=100.0)
+        desk.sink = served_sink
+        events = [Event(Instant.Epoch, "Customer", target=desk) for _ in range(3)]
+        run_sim([desk, served_sink], events)
+        assert desk.served == 3
+        assert desk.reneged == 0
+
+
+class TestConveyor:
+    def test_fixed_transit_delay(self):
+        sink = Sink()
+        belt = ConveyorBelt("belt", sink, transit_time_s=2.5)
+        run_sim([belt, sink], [Event(Instant.Epoch, "Part", target=belt)])
+        assert sink.events_received == 1
+        assert sink.completion_times[0].to_seconds() == pytest.approx(2.5)
+        assert belt.stats().items_transported == 1
+
+    def test_capacity_rejects_overflow(self):
+        sink = Sink()
+        belt = ConveyorBelt("belt", sink, transit_time_s=1.0, capacity=2)
+        events = [Event(Instant.Epoch, "Part", target=belt) for _ in range(3)]
+        run_sim([belt, sink], events)
+        assert belt.rejected == 1
+        assert sink.events_received == 2
+
+
+class TestInspection:
+    def test_all_pass(self):
+        passed, failed = Sink("pass"), Sink("fail")
+        station = InspectionStation(
+            "qa", passed, failed, inspection_time_s=0.1, pass_rate=1.0
+        )
+        events = [Event(Instant.Epoch, "Part", target=station) for _ in range(5)]
+        run_sim([station, passed, failed], events)
+        assert passed.events_received == 5
+        assert failed.events_received == 0
+        assert station.stats().inspected == 5
+
+    def test_all_fail(self):
+        passed, failed = Sink("pass"), Sink("fail")
+        station = InspectionStation(
+            "qa", passed, failed, inspection_time_s=0.1, pass_rate=0.0
+        )
+        events = [Event(Instant.Epoch, "Part", target=station) for _ in range(5)]
+        run_sim([station, passed, failed], events)
+        assert failed.events_received == 5
+
+    def test_seeded_mix_reproducible(self):
+        def outcome(seed):
+            passed, failed = Sink("pass"), Sink("fail")
+            station = InspectionStation(
+                "qa", passed, failed, inspection_time_s=0.01, pass_rate=0.7, seed=seed
+            )
+            events = [Event(Instant.Epoch, "Part", target=station) for _ in range(50)]
+            run_sim([station, passed, failed], events)
+            return passed.events_received
+
+        assert outcome(3) == outcome(3)
+        assert 20 < outcome(3) < 50
+
+
+class TestBatchProcessor:
+    def test_flush_on_full_batch(self):
+        sink = Sink()
+        batcher = BatchProcessor("oven", sink, batch_size=3, process_time_s=2.0)
+        events = [Event(Instant.Epoch, "Loaf", target=batcher) for _ in range(3)]
+        run_sim([batcher, sink], events)
+        assert batcher.batches_processed == 1
+        assert batcher.items_processed == 3
+        assert all(t.to_seconds() == pytest.approx(2.0) for t in sink.completion_times)
+
+    def test_flush_on_timeout(self):
+        sink = Sink()
+        batcher = BatchProcessor(
+            "oven", sink, batch_size=10, process_time_s=1.0, timeout_s=2.0
+        )
+        events = [Event(Instant.Epoch, "Loaf", target=batcher) for _ in range(3)]
+        run_sim([batcher, sink], events)
+        assert batcher.timeouts == 1
+        assert batcher.items_processed == 3
+        # Timeout at t=2, plus 1s processing.
+        assert all(t.to_seconds() == pytest.approx(3.0) for t in sink.completion_times)
+
+    def test_full_batch_cancels_timeout(self):
+        sink = Sink()
+        batcher = BatchProcessor(
+            "oven", sink, batch_size=2, process_time_s=0.5, timeout_s=10.0
+        )
+        events = [Event(Instant.Epoch, "Loaf", target=batcher) for _ in range(2)]
+        sim = run_sim([batcher, sink], events)
+        assert batcher.timeouts == 0
+        assert batcher.batches_processed == 1
+        # The cancelled timeout must not hold the simulation open.
+        assert sim.clock.now.to_seconds() < 5.0
+
+
+class TestShiftSchedule:
+    def test_capacity_lookup_and_transitions(self):
+        schedule = ShiftSchedule(
+            [Shift(0, 8, 2), Shift(8, 16, 5)], default_capacity=1
+        )
+        assert schedule.capacity_at(0.0) == 2
+        assert schedule.capacity_at(8.0) == 5
+        assert schedule.capacity_at(20.0) == 1
+        assert schedule.transition_times() == [0, 8, 16]
+        assert schedule.next_transition_after(8.0) == 16
+
+    def test_shift_opening_drains_queued_work(self):
+        """Work arriving while capacity is 0 starts when the shift opens."""
+        sink = Sink()
+        server = ShiftedServer(
+            "desk",
+            ShiftSchedule([Shift(5, 100, 1)], default_capacity=0),
+            service_time_s=1.0,
+            downstream=sink,
+        )
+        sim = Simulation(entities=[server, sink])
+        sim.schedule(server.start_events())
+        sim.schedule(
+            [
+                Event(Instant.Epoch, "Job", target=server),
+                Event(Instant.from_seconds(1.0), "Job", target=server),
+                keepalive(10.0),
+            ]
+        )
+        sim.run()
+        assert server.processed == 2
+        done = sorted(t.to_seconds() for t in sink.completion_times)
+        assert done == pytest.approx([6.0, 7.0])
+
+    def test_lazy_arming_without_start_events(self):
+        sink = Sink()
+        server = ShiftedServer(
+            "desk",
+            ShiftSchedule([Shift(0, 100, 1)], default_capacity=0),
+            service_time_s=1.0,
+            downstream=sink,
+        )
+        run_sim([server, sink], [Event(Instant.Epoch, "Job", target=server)])
+        assert server.processed == 1
+
+
+class TestBreakdown:
+    def test_cycle_accounting(self):
+        workstation = Counter("machine")
+        scheduler = BreakdownScheduler(
+            "breaker",
+            workstation,
+            mean_time_to_failure_s=5.0,
+            mean_repair_time_s=1.0,
+            seed=7,
+        )
+        sim = Simulation(
+            entities=[workstation, scheduler], end_time=Instant.from_seconds(200)
+        )
+        sim.schedule([scheduler.start_event(), keepalive(200.0)])
+        sim.run()
+        stats = scheduler.stats()
+        assert stats.breakdown_count > 10
+        assert stats.total_downtime_s > 0
+        assert 0.5 < stats.availability < 1.0
+
+    def test_broken_flag_follows_state(self):
+        target = Counter("machine")
+        scheduler = BreakdownScheduler("breaker", target, seed=1)
+        assert target._broken is False
+        sim = Simulation(entities=[target, scheduler], end_time=Instant.from_seconds(500))
+        sim.schedule([scheduler.start_event(), keepalive(500.0)])
+        sim.run()
+        assert target._broken == scheduler.is_down
+
+    def test_seeded_reproducibility(self):
+        def count(seed):
+            target = Counter("m")
+            sched = BreakdownScheduler("b", target, 10.0, 2.0, seed=seed)
+            sim = Simulation(entities=[target, sched], end_time=Instant.from_seconds(300))
+            sim.schedule([sched.start_event(), keepalive(300.0)])
+            sim.run()
+            return sched.breakdown_count
+
+        assert count(42) == count(42)
+
+
+class TestInventory:
+    def test_consume_and_fulfill(self):
+        fulfilled = Counter("fulfilled")
+        buffer = InventoryBuffer("store", initial_stock=10, reorder_point=0, downstream=fulfilled)
+        events = [Event(Instant.Epoch, "Demand", target=buffer) for _ in range(4)]
+        run_sim([buffer, fulfilled], events)
+        assert buffer.stock == 6
+        assert fulfilled.count == 4
+        assert buffer.stats().fill_rate == 1.0
+
+    def test_stockout_routing(self):
+        stockouts = Counter("stockouts")
+        buffer = InventoryBuffer(
+            "store", initial_stock=1, reorder_point=0, order_quantity=5,
+            lead_time_s=100.0, stockout_target=stockouts,
+        )
+        events = [
+            Event(Instant.from_seconds(i * 0.1), "Demand", target=buffer)
+            for i in range(3)
+        ]
+        run_sim([buffer, stockouts], events, end_s=1.0)
+        assert buffer.stockouts == 2
+        assert stockouts.count == 2
+        assert buffer.stats().fill_rate == pytest.approx(1 / 3)
+
+    def test_reorder_replenishes_after_lead_time(self):
+        buffer = InventoryBuffer(
+            "store", initial_stock=3, reorder_point=2, order_quantity=10, lead_time_s=5.0
+        )
+        events = [
+            Event(Instant.Epoch, "Demand", target=buffer),
+            Event(Instant.from_seconds(1.0), "Demand", target=buffer),
+        ]
+        run_sim([buffer], events)
+        # First consume drops stock to 2 <= s, placing one order of 10.
+        assert buffer.reorders == 1
+        assert buffer.stock == 1 + 10
+        assert buffer.items_replenished == 10
+
+    def test_quantity_from_context(self):
+        buffer = InventoryBuffer("store", initial_stock=10, reorder_point=0)
+        event = Event(Instant.Epoch, "Demand", target=buffer, context={"quantity": 7})
+        run_sim([buffer], [event])
+        assert buffer.stock == 3
+
+
+class TestPerishableInventory:
+    def test_spoilage_sweep(self):
+        waste = Counter("waste")
+        inventory = PerishableInventory(
+            "fridge",
+            initial_stock=10,
+            shelf_life_s=5.0,
+            spoilage_check_interval_s=2.0,
+            reorder_point=0,
+            waste_target=waste,
+            initial_stock_time_s=0.0,
+        )
+        sim = Simulation(
+            entities=[inventory, waste], end_time=Instant.from_seconds(10)
+        )
+        sim.schedule([inventory.start_event(), keepalive(10.0)])
+        sim.run()
+        # The t=6 sweep finds the t=0 batch older than 5s.
+        assert inventory.total_spoiled == 10
+        assert waste.count == 1
+        assert inventory.stock == 0
+        assert inventory.stats().waste_rate == 1.0
+
+    def test_fifo_consumption_spares_fresh_stock(self):
+        inventory = PerishableInventory(
+            "fridge",
+            initial_stock=5,
+            shelf_life_s=100.0,
+            spoilage_check_interval_s=1000.0,
+            reorder_point=2,
+            order_quantity=5,
+            lead_time_s=1.0,
+            initial_stock_time_s=0.0,
+        )
+        events = [
+            Event(Instant.from_seconds(i), "Demand", target=inventory, context={})
+            for i in range(4)
+        ]
+        run_sim([inventory], events, end_s=10.0)
+        assert inventory.total_consumed == 4
+        # Reorder fired when stock hit 2; replenishment of 5 arrived.
+        assert inventory.reorders == 1
+        assert inventory.stock == 1 + 5
+
+    def test_consume_prefers_oldest_batch(self):
+        inventory = PerishableInventory(
+            "fridge", initial_stock=3, shelf_life_s=5.0,
+            spoilage_check_interval_s=3.0, reorder_point=0, initial_stock_time_s=0.0,
+        )
+        inventory._batches.append((Instant.from_seconds(2.0), 3))
+        sim = Simulation(entities=[inventory], end_time=Instant.from_seconds(7.0))
+        sim.schedule([inventory.start_event(), keepalive(7.0)])
+        sim.schedule(Event(Instant.from_seconds(1.0), "Demand", target=inventory))
+        sim.run()
+        # The t=1 consume drains one unit of the t=0 batch (FIFO). At the
+        # t=6 sweep, the t=0 leftovers (age 6 >= 5) spoil; the t=2 batch
+        # (age 4) survives.
+        assert inventory.total_consumed == 1
+        assert inventory.total_spoiled == 2
+        assert inventory.stock == 3
+
+
+class TestAppointments:
+    def test_arrivals_at_appointment_times(self):
+        sink = Sink()
+        scheduler = AppointmentScheduler(
+            "book", sink, appointments_s=[1.0, 2.0, 3.5], no_show_rate=0.0
+        )
+        sim = Simulation(entities=[scheduler, sink])
+        sim.schedule(scheduler.start_events())
+        sim.run()
+        assert sink.events_received == 3
+        assert [t.to_seconds() for t in sink.completion_times] == pytest.approx(
+            [1.0, 2.0, 3.5]
+        )
+
+    def test_all_no_shows(self):
+        sink = Sink()
+        scheduler = AppointmentScheduler(
+            "book", sink, appointments_s=[1.0, 2.0], no_show_rate=1.0
+        )
+        sim = Simulation(entities=[scheduler, sink])
+        sim.schedule(scheduler.start_events())
+        sim.run()
+        assert sink.events_received == 0
+        assert scheduler.stats().no_shows == 2
+
+
+class TestConditionalRouter:
+    def test_first_match_wins(self):
+        a, b = Counter("a"), Counter("b")
+        router = ConditionalRouter(
+            "router",
+            routes=[
+                (lambda e: e.context.get("size", 0) > 10, a),
+                (lambda e: True, b),
+            ],
+        )
+        events = [
+            Event(Instant.Epoch, "Job", target=router, context={"size": 20}),
+            Event(Instant.Epoch, "Job", target=router, context={"size": 5}),
+        ]
+        run_sim([router, a, b], events)
+        assert a.count == 1
+        assert b.count == 1
+        assert router.stats().by_target == {"a": 1, "b": 1}
+
+    def test_unmatched_drops_without_default(self):
+        a = Counter("a")
+        router = ConditionalRouter("router", routes=[(lambda e: False, a)])
+        run_sim([router, a], [Event(Instant.Epoch, "Job", target=router)])
+        assert router.dropped == 1
+        assert a.count == 0
+
+    def test_by_context_field(self):
+        express, standard = Counter("express"), Counter("standard")
+        router = ConditionalRouter.by_context_field(
+            "router", "tier", {"gold": express}, default=standard
+        )
+        events = [
+            Event(Instant.Epoch, "Order", target=router, context={"tier": "gold"}),
+            Event(Instant.Epoch, "Order", target=router, context={"tier": "basic"}),
+        ]
+        run_sim([router, express, standard], events)
+        assert express.count == 1
+        assert standard.count == 1
+
+
+class TestPooledCycle:
+    def test_cycle_timing_and_queueing(self):
+        sink = Sink()
+        pool = PooledCycleResource("washers", pool_size=2, cycle_time_s=1.0, downstream=sink)
+        events = [Event(Instant.Epoch, "Load", target=pool) for _ in range(3)]
+        run_sim([pool, sink], events)
+        done = sorted(t.to_seconds() for t in sink.completion_times)
+        assert done == pytest.approx([1.0, 1.0, 2.0])
+        assert pool.completed == 3
+        assert pool.available == 2
+
+    def test_bounded_queue_rejects(self):
+        sink = Sink()
+        pool = PooledCycleResource(
+            "washers", pool_size=1, cycle_time_s=1.0, downstream=sink, queue_capacity=1
+        )
+        events = [Event(Instant.Epoch, "Load", target=pool) for _ in range(4)]
+        run_sim([pool, sink], events)
+        assert pool.rejected == 2
+        assert pool.completed == 2
+
+
+class TestGateController:
+    def test_closed_gate_queues_then_flushes(self):
+        sink = Sink()
+        gate = GateController(
+            "gate", sink, schedule=[(2.0, 4.0)], initially_open=False
+        )
+        sim = Simulation(entities=[gate, sink])
+        sim.schedule(gate.start_events())
+        sim.schedule(
+            [
+                Event(Instant.Epoch, "Car", target=gate),
+                Event(Instant.from_seconds(1.0), "Car", target=gate),
+                Event(Instant.from_seconds(3.0), "Car", target=gate),
+                Event(Instant.from_seconds(5.0), "Car", target=gate),
+            ]
+        )
+        sim.run()
+        stats = gate.stats()
+        # Two queued pre-open flush at t=2; the t=3 arrival passes through;
+        # the t=5 arrival queues against the closed gate.
+        assert stats.passed_through == 3
+        assert stats.queued_while_closed == 3
+        assert gate.queue_depth == 1
+        assert sorted(t.to_seconds() for t in sink.completion_times) == pytest.approx(
+            [2.0, 2.0, 3.0]
+        )
+
+    def test_bounded_queue_rejects_when_closed(self):
+        sink = Sink()
+        gate = GateController("gate", sink, initially_open=False, queue_capacity=1)
+        events = [Event(Instant.Epoch, "Car", target=gate) for _ in range(3)]
+        run_sim([gate, sink], events)
+        assert gate.rejected == 2
+
+
+class _FutureWorker(Entity):
+    """Resolves ``reply_future`` with its name after a service delay."""
+
+    def __init__(self, name, delay_s):
+        super().__init__(name)
+        self.delay_s = delay_s
+
+    def handle_event(self, event):
+        yield self.delay_s
+        event.context["reply_future"].resolve(self.name)
+        return None
+
+
+class TestSplitMerge:
+    def test_fan_out_and_merge(self):
+        sink = Sink()
+        workers = [_FutureWorker("w0", 1.0), _FutureWorker("w1", 3.0)]
+        splitter = SplitMerge("split", workers, sink)
+        run_sim(
+            [splitter, sink, *workers],
+            [Event(Instant.Epoch, "Task", target=splitter)],
+        )
+        assert sink.events_received == 1
+        # Merge completes when the slowest branch resolves.
+        assert sink.completion_times[0].to_seconds() == pytest.approx(3.0)
+        assert splitter.stats().merges_completed == 1
+
+    def test_merged_context_carries_sub_results(self):
+        collected = {}
+
+        class Collector(Entity):
+            def handle_event(self, event):
+                collected["sub_results"] = event.context.get("sub_results")
+                return None
+
+        collector = Collector("collector")
+        workers = [_FutureWorker("w0", 0.5), _FutureWorker("w1", 0.1)]
+        splitter = SplitMerge("split", workers, collector)
+        run_sim(
+            [splitter, collector, *workers],
+            [Event(Instant.Epoch, "Task", target=splitter)],
+        )
+        assert collected["sub_results"] == ["w0", "w1"]
+
+
+class TestPreemptibleResource:
+    def test_immediate_grant_and_release(self):
+        resource = PreemptibleResource("crane", capacity=2)
+        future = resource.acquire(1, priority=1.0)
+        assert future.is_resolved
+        grant = future._value
+        assert resource.available == 1
+        grant.release()
+        assert resource.available == 2
+        grant.release()  # idempotent
+        assert resource.stats().releases == 1
+
+    def test_preemption_evicts_weakest_holder(self):
+        resource = PreemptibleResource("crane", capacity=1)
+        preempted = []
+        low = resource.acquire(1, priority=5.0, on_preempt=lambda: preempted.append("low"))
+        assert low.is_resolved
+        high = resource.acquire(1, priority=1.0, preempt=True)
+        assert high.is_resolved
+        assert preempted == ["low"]
+        assert low._value.preempted
+        assert resource.preemptions == 1
+
+    def test_no_preempt_queues_instead(self):
+        resource = PreemptibleResource("crane", capacity=1)
+        holder = resource.acquire(1, priority=5.0)
+        waiter = resource.acquire(1, priority=1.0, preempt=False)
+        assert not waiter.is_resolved
+        assert resource.contentions == 1
+        holder._value.release()
+        assert waiter.is_resolved
+
+    def test_equal_priority_cannot_preempt(self):
+        resource = PreemptibleResource("crane", capacity=1)
+        first = resource.acquire(1, priority=2.0)
+        second = resource.acquire(1, priority=2.0, preempt=True)
+        assert first.is_resolved
+        assert not second.is_resolved
+        assert resource.preemptions == 0
+
+    def test_waiters_wake_in_priority_order(self):
+        resource = PreemptibleResource("crane", capacity=1)
+        holder = resource.acquire(1, priority=0.0)
+        low = resource.acquire(1, priority=9.0, preempt=False)
+        high = resource.acquire(1, priority=1.0, preempt=False)
+        holder._value.release()
+        assert high.is_resolved
+        assert not low.is_resolved
+
+    def test_generator_integration(self):
+        """Preemption mid-service: the preempted job observes its grant."""
+        log = []
+
+        class CraneUser(Entity):
+            def __init__(self, name, resource, priority, hold_s):
+                super().__init__(name)
+                self.resource = resource
+                self.priority = priority
+                self.hold_s = hold_s
+
+            def handle_event(self, event):
+                grant = yield self.resource.acquire(
+                    1, priority=self.priority,
+                    on_preempt=lambda: log.append(f"{self.name}-preempted"),
+                )
+                yield self.hold_s
+                if not grant.preempted:
+                    grant.release()
+                    log.append(f"{self.name}-done")
+                return None
+
+        resource = PreemptibleResource("crane", capacity=1)
+        routine = CraneUser("routine", resource, priority=5.0, hold_s=10.0)
+        urgent = CraneUser("urgent", resource, priority=1.0, hold_s=1.0)
+        run_sim(
+            [resource, routine, urgent],
+            [
+                Event(Instant.Epoch, "Job", target=routine),
+                Event(Instant.from_seconds(2.0), "Job", target=urgent),
+            ],
+        )
+        assert log == ["routine-preempted", "urgent-done"]
