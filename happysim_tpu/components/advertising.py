@@ -1,0 +1,252 @@
+"""Advertising economics: audience tiers, advertisers, platforms.
+
+Parity target: ``happysimulator/components/advertising.py``
+(``AudienceTier`` :43, ``Advertiser`` :124, ``AdPlatform`` :327) — models
+the Adverse Advertising Amplification effect: as consumer sentiment
+falls, effective CPA rises and broad (outer-ring, high-CPA) tiers turn
+unprofitable first, so a rational advertiser shuts them off and the
+platform loses its largest fixed ad spends disproportionately fast.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.instrumentation.data import Data
+
+_EVALUATE = "EvaluateCampaigns"
+_SENTIMENT = "SentimentChange"
+_AD_REVENUE = "AdRevenue"
+
+
+@dataclass(frozen=True)
+class AudienceTier:
+    """One concentric ring of advertising reach.
+
+    Niche inner rings convert cheaply (low CPA); broad outer rings
+    convert expensively. Reach cost is fixed, so falling sentiment
+    raises the effective CPA until the tier stops being worth running.
+    """
+
+    name: str
+    base_monthly_sales: int
+    base_cpa: float
+
+    @property
+    def monthly_ad_spend(self) -> float:
+        """Fixed reach cost per period (independent of sentiment)."""
+        return self.base_monthly_sales * self.base_cpa
+
+    def effective_cpa(self, sentiment: float) -> float:
+        return self.base_cpa / sentiment if sentiment > 0 else float("inf")
+
+    def monthly_sales(self, sentiment: float) -> float:
+        return self.base_monthly_sales * sentiment
+
+    def breakeven_sentiment(self, margin: float) -> float:
+        """Sentiment below which this tier runs at a loss."""
+        return self.base_cpa / margin if margin > 0 else float("inf")
+
+    def is_profitable(self, sentiment: float, margin: float) -> bool:
+        return self.effective_cpa(sentiment) < margin
+
+    def tier_profit(self, sentiment: float, margin: float) -> float:
+        if not self.is_profitable(sentiment, margin):
+            return 0.0
+        return self.monthly_sales(sentiment) * (margin - self.effective_cpa(sentiment))
+
+    def tier_platform_revenue(self, sentiment: float, margin: float) -> float:
+        """What the platform collects: full spend while active, else zero."""
+        return self.monthly_ad_spend if self.is_profitable(sentiment, margin) else 0.0
+
+
+@dataclass(frozen=True)
+class AdvertiserStats:
+    periods_evaluated: int = 0
+    total_profit: float = 0.0
+    total_platform_revenue: float = 0.0
+    tier_shutoff_events: int = 0
+
+
+class Advertiser(Entity):
+    """A business running tiered ad campaigns on a platform.
+
+    Re-evaluates tier profitability every ``evaluation_interval_s``,
+    shuts off loss-making tiers, and reports the period's ad spend to
+    the platform as revenue. React to ``SentimentChange`` events (e.g.
+    from a behavior-package stimulus) via ``context["metadata"]["sentiment"]``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        product_price: float,
+        production_cost: float,
+        tiers: list[AudienceTier],
+        platform: "AdPlatform",
+        evaluation_interval_s: float = 1.0,
+    ):
+        super().__init__(name)
+        self.product_price = product_price
+        self.production_cost = production_cost
+        self.margin = product_price - production_cost
+        self.tiers = list(tiers)
+        self.platform = platform
+        self.evaluation_interval_s = evaluation_interval_s
+        self.active_tiers: list[AudienceTier] = list(tiers)
+        self.periods_evaluated = 0
+        self.total_profit = 0.0
+        self.total_platform_revenue = 0.0
+        self.tier_shutoff_events = 0
+        self._sentiment = 1.0
+        self.profit_data = Data(f"{name}.profit")
+        self.platform_revenue_data = Data(f"{name}.platform_revenue")
+        self.active_tier_data = Data(f"{name}.active_tiers")
+        self.sentiment_data = Data(f"{name}.sentiment")
+        self.total_sales_data = Data(f"{name}.total_sales")
+        self.gross_revenue_data = Data(f"{name}.gross_revenue")
+        self.ad_spend_data = Data(f"{name}.ad_spend")
+        self.blended_cpa_data = Data(f"{name}.blended_cpa")
+        self.margin_pct_data = Data(f"{name}.margin_pct")
+
+    @property
+    def sentiment(self) -> float:
+        return self._sentiment
+
+    @sentiment.setter
+    def sentiment(self, value: float) -> None:
+        self._sentiment = max(0.0, min(1.0, value))
+
+    def stats(self) -> AdvertiserStats:
+        return AdvertiserStats(
+            periods_evaluated=self.periods_evaluated,
+            total_profit=self.total_profit,
+            total_platform_revenue=self.total_platform_revenue,
+            tier_shutoff_events=self.tier_shutoff_events,
+        )
+
+    def start_events(self) -> list[Event]:
+        """The first campaign evaluation; schedule to arm the cycle."""
+        return [Event(self.evaluation_interval_s, _EVALUATE, target=self)]
+
+    def handle_event(self, event: Event):
+        if event.event_type == _EVALUATE:
+            return self._evaluate()
+        if event.event_type == _SENTIMENT:
+            metadata = event.context.get("metadata", {})
+            self.sentiment = metadata.get("sentiment", self._sentiment)
+            return None
+        return None
+
+    def _evaluate(self) -> list[Event]:
+        sentiment, margin = self._sentiment, self.margin
+        previously_active = len(self.active_tiers)
+        self.active_tiers = [
+            tier for tier in self.tiers if tier.is_profitable(sentiment, margin)
+        ]
+        if len(self.active_tiers) < previously_active:
+            self.tier_shutoff_events += previously_active - len(self.active_tiers)
+
+        # active_tiers is already the profitable subset, so the per-tier
+        # guards are settled: profit is sales x unit margin net of CPA, and
+        # the platform collects each active tier's full fixed spend.
+        sales = sum(t.monthly_sales(sentiment) for t in self.active_tiers)
+        gross = sales * self.product_price
+        spend = sum(t.monthly_ad_spend for t in self.active_tiers)
+        profit = sum(
+            t.monthly_sales(sentiment) * (margin - t.effective_cpa(sentiment))
+            for t in self.active_tiers
+        )
+        platform_revenue = spend
+
+        self.periods_evaluated += 1
+        self.total_profit += profit
+        self.total_platform_revenue += platform_revenue
+
+        now = self.now
+        self.profit_data.add(now, profit)
+        self.platform_revenue_data.add(now, platform_revenue)
+        self.active_tier_data.add(now, len(self.active_tiers))
+        self.sentiment_data.add(now, sentiment)
+        self.total_sales_data.add(now, sales)
+        self.gross_revenue_data.add(now, gross)
+        self.ad_spend_data.add(now, spend)
+        self.blended_cpa_data.add(now, spend / sales if sales > 0 else 0.0)
+        self.margin_pct_data.add(now, profit / gross * 100 if gross > 0 else 0.0)
+
+        return [
+            Event(
+                now,
+                _AD_REVENUE,
+                target=self.platform,
+                context={
+                    "metadata": {
+                        "revenue": platform_revenue,
+                        "advertiser": self.name,
+                        "active_tiers": len(self.active_tiers),
+                        "sentiment": sentiment,
+                    }
+                },
+            ),
+            Event(now + self.evaluation_interval_s, _EVALUATE, target=self),
+        ]
+
+    def sensitivity_analysis(
+        self,
+        sentiment_range: tuple[float, float] = (0.0, 1.0),
+        steps: int = 100,
+    ) -> list[dict]:
+        """Profit/revenue/active-tier curve across a sentiment sweep."""
+        lo, hi = sentiment_range
+        rows = []
+        for step in range(steps + 1):
+            sentiment = lo + (hi - lo) * step / steps
+            active = [t for t in self.tiers if t.is_profitable(sentiment, self.margin)]
+            rows.append(
+                {
+                    "sentiment": sentiment,
+                    "advertiser_profit": sum(
+                        t.tier_profit(sentiment, self.margin) for t in active
+                    ),
+                    "platform_revenue": sum(
+                        t.tier_platform_revenue(sentiment, self.margin) for t in active
+                    ),
+                    "active_tiers": len(active),
+                    "tier_names": [t.name for t in active],
+                }
+            )
+        return rows
+
+    def downstream_entities(self):
+        return [self.platform]
+
+
+@dataclass(frozen=True)
+class AdPlatformStats:
+    revenue_events: int = 0
+    total_revenue: float = 0.0
+
+
+class AdPlatform(Entity):
+    """Collects ``AdRevenue`` events from advertisers."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.revenue_events = 0
+        self.total_revenue = 0.0
+        self.revenue_data = Data(f"{name}.revenue")
+
+    def stats(self) -> AdPlatformStats:
+        return AdPlatformStats(
+            revenue_events=self.revenue_events, total_revenue=self.total_revenue
+        )
+
+    def handle_event(self, event: Event):
+        if event.event_type == _AD_REVENUE:
+            revenue = event.context.get("metadata", {}).get("revenue", 0.0)
+            self.revenue_events += 1
+            self.total_revenue += revenue
+            self.revenue_data.add(self.now, revenue)
+        return None

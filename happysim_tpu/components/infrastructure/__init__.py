@@ -1,0 +1,75 @@
+"""OS/hardware-level primitives: disk, page cache, CPU, GC, TCP, DNS.
+
+Parity target: ``happysimulator/components/infrastructure/`` (6 modules).
+"""
+
+from happysim_tpu.components.infrastructure.cpu_scheduler import (
+    CPUScheduler,
+    CPUSchedulerStats,
+    CPUTask,
+    FairShare,
+    PriorityPreemptive,
+    SchedulingPolicy,
+)
+from happysim_tpu.components.infrastructure.disk_io import (
+    HDD,
+    NVMe,
+    SSD,
+    DiskIO,
+    DiskIOStats,
+    DiskProfile,
+)
+from happysim_tpu.components.infrastructure.dns_resolver import (
+    DNSRecord,
+    DNSResolver,
+    DNSStats,
+)
+from happysim_tpu.components.infrastructure.garbage_collector import (
+    ConcurrentGC,
+    GarbageCollector,
+    GCStats,
+    GCStrategy,
+    GenerationalGC,
+    StopTheWorld,
+)
+from happysim_tpu.components.infrastructure.page_cache import PageCache, PageCacheStats
+from happysim_tpu.components.infrastructure.tcp_connection import (
+    AIMD,
+    BBR,
+    CongestionControl,
+    Cubic,
+    TCPConnection,
+    TCPStats,
+)
+
+__all__ = [
+    "AIMD",
+    "BBR",
+    "CPUScheduler",
+    "CPUSchedulerStats",
+    "CPUTask",
+    "ConcurrentGC",
+    "CongestionControl",
+    "Cubic",
+    "DNSRecord",
+    "DNSResolver",
+    "DNSStats",
+    "DiskIO",
+    "DiskIOStats",
+    "DiskProfile",
+    "FairShare",
+    "GCStats",
+    "GCStrategy",
+    "GarbageCollector",
+    "GenerationalGC",
+    "HDD",
+    "NVMe",
+    "PageCache",
+    "PageCacheStats",
+    "PriorityPreemptive",
+    "SSD",
+    "SchedulingPolicy",
+    "StopTheWorld",
+    "TCPConnection",
+    "TCPStats",
+]

@@ -1,0 +1,203 @@
+"""GC pause injection with strategy-specific pause profiles.
+
+Parity target:
+``happysimulator/components/infrastructure/garbage_collector.py:210``
+(``GarbageCollector``; StopTheWorld/ConcurrentGC/GenerationalGC :60-126).
+House difference: pause jitter is seeded per strategy.
+"""
+
+from __future__ import annotations
+
+import random
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+_GC_COLLECT = "GC.collect"
+
+
+class GCStrategy(ABC):
+    """Pause duration + cadence of a collector design."""
+
+    name: str = ""
+
+    @abstractmethod
+    def pause_duration_s(self, heap_pressure: float) -> float: ...
+
+    @abstractmethod
+    def collection_interval_s(self) -> float: ...
+
+
+class StopTheWorld(GCStrategy):
+    """Full-heap collection: long pauses scaling with pressure."""
+
+    name = "StopTheWorld"
+
+    def __init__(
+        self,
+        base_pause_s: float = 0.05,
+        interval_s: float = 10.0,
+        pressure_multiplier: float = 3.0,
+        seed: Optional[int] = None,
+    ):
+        self.base_pause_s = base_pause_s
+        self.interval_s = interval_s
+        self.pressure_multiplier = pressure_multiplier
+        self._rng = random.Random(seed)
+
+    def pause_duration_s(self, heap_pressure: float) -> float:
+        jitter = 0.8 + 0.4 * self._rng.random()
+        return self.base_pause_s * (1.0 + self.pressure_multiplier * heap_pressure) * jitter
+
+    def collection_interval_s(self) -> float:
+        return self.interval_s
+
+
+class ConcurrentGC(GCStrategy):
+    """Mostly-concurrent collection: short mark/remark pauses."""
+
+    name = "ConcurrentGC"
+
+    def __init__(
+        self,
+        pause_s: float = 0.005,
+        interval_s: float = 2.0,
+        seed: Optional[int] = None,
+    ):
+        self.pause_s = pause_s
+        self.interval_s = interval_s
+        self._rng = random.Random(seed)
+
+    def pause_duration_s(self, heap_pressure: float) -> float:
+        return self.pause_s * (0.9 + 0.2 * self._rng.random())
+
+    def collection_interval_s(self) -> float:
+        return self.interval_s
+
+
+class GenerationalGC(GCStrategy):
+    """Frequent minor collections; major ones above a pressure threshold."""
+
+    name = "GenerationalGC"
+
+    def __init__(
+        self,
+        minor_pause_s: float = 0.002,
+        major_pause_s: float = 0.03,
+        minor_interval_s: float = 1.0,
+        major_threshold: float = 0.75,
+        seed: Optional[int] = None,
+    ):
+        self.minor_pause_s = minor_pause_s
+        self.major_pause_s = major_pause_s
+        self.minor_interval_s = minor_interval_s
+        self.major_threshold = major_threshold
+        self._rng = random.Random(seed)
+
+    def pause_duration_s(self, heap_pressure: float) -> float:
+        if heap_pressure >= self.major_threshold:
+            return self.major_pause_s * (0.8 + 0.4 * self._rng.random())
+        return self.minor_pause_s * (0.9 + 0.2 * self._rng.random())
+
+    def collection_interval_s(self) -> float:
+        return self.minor_interval_s
+
+
+@dataclass(frozen=True)
+class GCStats:
+    collections: int = 0
+    total_pause_s: float = 0.0
+    max_pause_s: float = 0.0
+    min_pause_s: float = 0.0
+    minor_collections: int = 0
+    major_collections: int = 0
+    strategy_name: str = ""
+
+    @property
+    def avg_pause_s(self) -> float:
+        return self.total_pause_s / self.collections if self.collections else 0.0
+
+
+class GarbageCollector(Entity):
+    """Injects GC pauses, either self-scheduled or at call sites.
+
+    Self-scheduled mode: ``sim.schedule(gc.prime())`` arms a periodic
+    collection cycle. Call-site mode: ``yield from gc.pause()`` inside
+    any entity handler charges a collection there.
+
+    ``heap_pressure`` fixes the pressure; when None it follows a ramp
+    from 0.3 toward 0.9 over the first 50 collections.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        strategy: Optional[GCStrategy] = None,
+        heap_pressure: Optional[float] = None,
+    ):
+        super().__init__(name)
+        self.strategy = strategy or GenerationalGC()
+        self.fixed_pressure = heap_pressure
+        self.collection_count = 0
+        self.total_pause_s = 0.0
+        self.max_pause_s = 0.0
+        self.min_pause_s = float("inf")
+        self.minor_collections = 0
+        self.major_collections = 0
+
+    def stats(self) -> GCStats:
+        return GCStats(
+            collections=self.collection_count,
+            total_pause_s=self.total_pause_s,
+            max_pause_s=self.max_pause_s,
+            min_pause_s=self.min_pause_s if self.collection_count else 0.0,
+            minor_collections=self.minor_collections,
+            major_collections=self.major_collections,
+            strategy_name=self.strategy.name,
+        )
+
+    def heap_pressure(self) -> float:
+        if self.fixed_pressure is not None:
+            return self.fixed_pressure
+        return min(0.95, 0.3 + 0.6 * min(1.0, self.collection_count / 50.0))
+
+    def prime(self) -> Event:
+        """The first collection event; schedule it to arm the cycle."""
+        return Event(self.now, _GC_COLLECT, target=self, daemon=True)
+
+    def _collect(self) -> float:
+        pressure = self.heap_pressure()
+        pause = self.strategy.pause_duration_s(pressure)
+        self.collection_count += 1
+        self.total_pause_s += pause
+        self.max_pause_s = max(self.max_pause_s, pause)
+        self.min_pause_s = min(self.min_pause_s, pause)
+        if isinstance(self.strategy, GenerationalGC):
+            if pressure >= self.strategy.major_threshold:
+                self.major_collections += 1
+            else:
+                self.minor_collections += 1
+        return pause
+
+    def pause(self):
+        """Charge one collection pause at the call site; returns its length."""
+        pause = self._collect()
+        yield pause
+        return pause
+
+    def handle_event(self, event: Event):
+        if event.event_type != _GC_COLLECT:
+            return None
+        pause = self._collect()
+        yield pause
+        return [
+            Event(
+                self.now + self.strategy.collection_interval_s(),
+                _GC_COLLECT,
+                target=self,
+                daemon=True,
+            )
+        ]

@@ -1,0 +1,138 @@
+"""OS page cache: LRU, read-ahead, write-back.
+
+Parity target: ``happysimulator/components/infrastructure/page_cache.py:77``
+(``PageCache``) — reads hit memory or fall through to disk latency;
+writes dirty pages in cache; evicting a dirty page pays a synchronous
+writeback first.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+@dataclass(frozen=True)
+class PageCacheStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    dirty_writebacks: int = 0
+    readaheads: int = 0
+    pages_cached: int = 0
+    dirty_pages: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total > 0 else 0.0
+
+
+class PageCache(Entity):
+    """LRU page cache between storage engines and the disk.
+
+    Usage from a generator entity::
+
+        yield from cache.read_page(42)
+        yield from cache.write_page(42)
+        flushed = yield from cache.flush()
+    """
+
+    def __init__(
+        self,
+        name: str,
+        capacity_pages: int = 1000,
+        page_size_bytes: int = 4096,
+        readahead_pages: int = 0,
+        disk_read_latency_s: float = 0.0001,
+        disk_write_latency_s: float = 0.0002,
+    ):
+        if capacity_pages < 1:
+            raise ValueError("capacity_pages must be >= 1")
+        super().__init__(name)
+        self.capacity_pages = capacity_pages
+        self.page_size_bytes = page_size_bytes
+        self.readahead_pages = readahead_pages
+        self.disk_read_latency_s = disk_read_latency_s
+        self.disk_write_latency_s = disk_write_latency_s
+        # page_id -> dirty flag; insertion order is LRU order (MRU at end).
+        self._pages: OrderedDict[int, bool] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.dirty_writebacks = 0
+        self.readaheads = 0
+
+    @property
+    def pages_cached(self) -> int:
+        return len(self._pages)
+
+    @property
+    def dirty_pages(self) -> int:
+        return sum(1 for dirty in self._pages.values() if dirty)
+
+    def stats(self) -> PageCacheStats:
+        return PageCacheStats(
+            hits=self.hits,
+            misses=self.misses,
+            evictions=self.evictions,
+            dirty_writebacks=self.dirty_writebacks,
+            readaheads=self.readaheads,
+            pages_cached=len(self._pages),
+            dirty_pages=self.dirty_pages,
+        )
+
+    def _make_room(self):
+        while len(self._pages) >= self.capacity_pages:
+            page_id, dirty = next(iter(self._pages.items()))
+            if dirty:
+                yield self.disk_write_latency_s
+                self.dirty_writebacks += 1
+            del self._pages[page_id]
+            self.evictions += 1
+
+    def read_page(self, page_id: int):
+        """Serve from cache, or load from disk (+ optional read-ahead)."""
+        if page_id in self._pages:
+            self.hits += 1
+            self._pages.move_to_end(page_id)
+            return
+        self.misses += 1
+        yield from self._make_room()
+        yield self.disk_read_latency_s
+        self._pages[page_id] = False
+        for offset in range(1, self.readahead_pages + 1):
+            ahead = page_id + offset
+            if ahead not in self._pages and len(self._pages) < self.capacity_pages:
+                yield self.disk_read_latency_s
+                self._pages[ahead] = False
+                self.readaheads += 1
+
+    def write_page(self, page_id: int):
+        """Write into cache as a dirty page (write-back)."""
+        if page_id in self._pages:
+            self.hits += 1
+            self._pages[page_id] = True
+            self._pages.move_to_end(page_id)
+            return
+        self.misses += 1
+        yield from self._make_room()
+        self._pages[page_id] = True
+
+    def flush(self):
+        """Write back every dirty page; returns the count flushed."""
+        flushed = 0
+        for page_id, dirty in self._pages.items():
+            if dirty:
+                yield self.disk_write_latency_s
+                self._pages[page_id] = False
+                self.dirty_writebacks += 1
+                flushed += 1
+        return flushed
+
+    def handle_event(self, event: Event):
+        """Not an event target; interact via the page methods."""
+        return None

@@ -1,0 +1,212 @@
+"""TCP transport with pluggable congestion control.
+
+Parity target:
+``happysimulator/components/infrastructure/tcp_connection.py:230``
+(``TCPConnection``; AIMD/Cubic/BBR :67-145) — ``send()`` segments data,
+walks slow start / congestion avoidance, and pays retransmission
+timeouts on (seeded) random loss.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+class CongestionControl(ABC):
+    """Congestion-window update rules."""
+
+    name: str = ""
+
+    @abstractmethod
+    def on_ack(self, cwnd: float, ssthresh: float) -> float:
+        """New cwnd after a successful ACK."""
+
+    @abstractmethod
+    def on_loss(self, cwnd: float) -> tuple[float, float]:
+        """(new cwnd, new ssthresh) after a loss."""
+
+
+class AIMD(CongestionControl):
+    """TCP Reno: additive increase, multiplicative decrease."""
+
+    name = "AIMD"
+
+    def __init__(self, additive_increase: float = 1.0, multiplicative_decrease: float = 0.5):
+        self.additive_increase = additive_increase
+        self.multiplicative_decrease = multiplicative_decrease
+
+    def on_ack(self, cwnd: float, ssthresh: float) -> float:
+        if cwnd < ssthresh:  # slow start doubles per RTT (one segment per ACK)
+            return cwnd + 1.0
+        return cwnd + self.additive_increase / cwnd
+
+    def on_loss(self, cwnd: float) -> tuple[float, float]:
+        halved = max(cwnd * self.multiplicative_decrease, 2.0)
+        return halved, halved
+
+
+class Cubic(CongestionControl):
+    """CUBIC: cubic-function window growth around the last-loss plateau."""
+
+    name = "Cubic"
+
+    def __init__(self, beta: float = 0.7, c: float = 0.4):
+        self.beta = beta
+        self.c = c
+        self._w_max = 0.0
+        self._acks_since_loss = 0
+
+    def on_ack(self, cwnd: float, ssthresh: float) -> float:
+        if cwnd < ssthresh:
+            return cwnd + 1.0
+        self._acks_since_loss += 1
+        t = self._acks_since_loss / max(cwnd, 1.0)  # ~ elapsed RTTs
+        k = ((self._w_max * (1.0 - self.beta)) / self.c) ** (1.0 / 3.0)
+        w_cubic = self.c * (t - k) ** 3 + self._w_max
+        # TCP-friendly floor keeps CUBIC at least as aggressive as Reno.
+        w_tcp = self._w_max * self.beta + (
+            3.0 * (1.0 - self.beta) / (1.0 + self.beta)
+        ) * t
+        return max(cwnd + 1.0 / cwnd, w_cubic, w_tcp)
+
+    def on_loss(self, cwnd: float) -> tuple[float, float]:
+        self._w_max = cwnd
+        self._acks_since_loss = 0
+        reduced = max(cwnd * self.beta, 2.0)
+        return reduced, reduced
+
+
+class BBR(CongestionControl):
+    """Simplified BBR: startup/drain/probe phases, loss-tolerant."""
+
+    name = "BBR"
+
+    def __init__(self, gain: float = 1.0, drain_gain: float = 0.75):
+        self.gain = gain
+        self.drain_gain = drain_gain
+        self._phase = "startup"
+
+    def on_ack(self, cwnd: float, ssthresh: float) -> float:
+        if self._phase == "startup":
+            grown = cwnd * 2.0
+            if grown > ssthresh > 0:
+                self._phase = "drain"
+            return grown
+        if self._phase == "drain":
+            drained = cwnd * self.drain_gain
+            # Drain until the window falls back to the estimated BDP
+            # (ssthresh stands in for it in this simplified model).
+            if drained <= ssthresh:
+                self._phase = "probe_bw"
+            return max(drained, 2.0)
+        return cwnd + self.gain / cwnd
+
+    def on_loss(self, cwnd: float) -> tuple[float, float]:
+        # BBR is rate-based: loss only nudges the window down.
+        reduced = max(cwnd * 0.9, 2.0)
+        return reduced, reduced
+
+
+@dataclass(frozen=True)
+class TCPStats:
+    segments_sent: int = 0
+    segments_acked: int = 0
+    retransmissions: int = 0
+    cwnd: float = 0.0
+    ssthresh: float = 0.0
+    rtt_s: float = 0.0
+    throughput_segments_per_s: float = 0.0
+    total_bytes_sent: int = 0
+    algorithm: str = ""
+
+
+class TCPConnection(Entity):
+    """A TCP flow between two endpoints.
+
+    Usage from a generator entity::
+
+        yield from tcp.send(65536)
+    """
+
+    def __init__(
+        self,
+        name: str,
+        congestion_control: Optional[CongestionControl] = None,
+        base_rtt_s: float = 0.05,
+        loss_rate: float = 0.001,
+        mss_bytes: int = 1460,
+        initial_cwnd: float = 10.0,
+        initial_ssthresh: float = 64.0,
+        retransmit_timeout_s: float = 1.0,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(name)
+        self.congestion_control = congestion_control or AIMD()
+        self.base_rtt_s = base_rtt_s
+        self.loss_rate = loss_rate
+        self.mss_bytes = mss_bytes
+        self.cwnd = initial_cwnd
+        self.ssthresh = initial_ssthresh
+        self.retransmit_timeout_s = retransmit_timeout_s
+        self.segments_sent = 0
+        self.segments_acked = 0
+        self.retransmissions = 0
+        self.total_bytes_sent = 0
+        self._rng = random.Random(seed)
+
+    @property
+    def rtt_s(self) -> float:
+        # Queuing delay grows as the window presses past the threshold.
+        return self.base_rtt_s + 0.001 * self.cwnd / max(self.ssthresh, 1.0)
+
+    @property
+    def throughput_segments_per_s(self) -> float:
+        rtt = self.rtt_s
+        return self.cwnd / rtt if rtt > 0 else 0.0
+
+    def stats(self) -> TCPStats:
+        return TCPStats(
+            segments_sent=self.segments_sent,
+            segments_acked=self.segments_acked,
+            retransmissions=self.retransmissions,
+            cwnd=self.cwnd,
+            ssthresh=self.ssthresh,
+            rtt_s=self.rtt_s,
+            throughput_segments_per_s=self.throughput_segments_per_s,
+            total_bytes_sent=self.total_bytes_sent,
+            algorithm=self.congestion_control.name,
+        )
+
+    def send(self, size_bytes: int):
+        """Transmit ``size_bytes``, yielding per-window RTTs and RTOs."""
+        segments = math.ceil(size_bytes / self.mss_bytes)
+        sent = 0
+        while sent < segments:
+            window = min(int(self.cwnd), segments - sent)
+            for _ in range(max(window, 1)):
+                self.segments_sent += 1
+                self.total_bytes_sent += self.mss_bytes
+                if self._rng.random() < self.loss_rate:
+                    self.retransmissions += 1
+                    self.cwnd, self.ssthresh = self.congestion_control.on_loss(self.cwnd)
+                    yield self.retransmit_timeout_s
+                    self.segments_sent += 1
+                    self.total_bytes_sent += self.mss_bytes
+                else:
+                    self.segments_acked += 1
+                    self.cwnd = self.congestion_control.on_ack(self.cwnd, self.ssthresh)
+                sent += 1
+                if sent >= segments:
+                    break
+            yield self.rtt_s
+
+    def handle_event(self, event: Event):
+        """Not an event target; interact via :meth:`send`."""
+        return None

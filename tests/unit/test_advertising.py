@@ -1,0 +1,114 @@
+"""Unit tests: advertising economics (tiers, advertiser, platform, AAA effect)."""
+
+import pytest
+
+from happysim_tpu import (
+    AdPlatform,
+    Advertiser,
+    AudienceTier,
+    Event,
+    Instant,
+    Simulation,
+)
+
+NICHE = AudienceTier("Niche", base_monthly_sales=100, base_cpa=10.0)
+BROAD = AudienceTier("Broad", base_monthly_sales=1000, base_cpa=40.0)
+
+
+def build(sentiment_events=(), end_s=5.5, interval=1.0):
+    platform = AdPlatform("Meta")
+    advertiser = Advertiser(
+        "PosterShop",
+        product_price=100.0,
+        production_cost=50.0,
+        tiers=[NICHE, BROAD],
+        platform=platform,
+        evaluation_interval_s=interval,
+    )
+    sim = Simulation(entities=[platform, advertiser], end_time=Instant.from_seconds(end_s))
+    sim.schedule(advertiser.start_events())
+    for t, sentiment in sentiment_events:
+        sim.schedule(
+            Event(
+                Instant.from_seconds(t),
+                "SentimentChange",
+                target=advertiser,
+                context={"metadata": {"sentiment": sentiment}},
+            )
+        )
+    sim.run()
+    return advertiser, platform
+
+
+class TestAudienceTier:
+    def test_economics_at_full_sentiment(self):
+        assert BROAD.monthly_ad_spend == 40_000
+        assert BROAD.effective_cpa(1.0) == 40.0
+        assert BROAD.monthly_sales(0.5) == 500.0
+
+    def test_cpa_rises_as_sentiment_falls(self):
+        assert BROAD.effective_cpa(0.5) == 80.0
+        assert BROAD.effective_cpa(0.0) == float("inf")
+
+    def test_breakeven_ordering(self):
+        # Broad (outer ring) breaks even at higher sentiment than niche.
+        margin = 50.0
+        assert BROAD.breakeven_sentiment(margin) > NICHE.breakeven_sentiment(margin)
+        assert BROAD.breakeven_sentiment(margin) == pytest.approx(0.8)
+        assert NICHE.breakeven_sentiment(margin) == pytest.approx(0.2)
+
+    def test_profit_zero_when_unprofitable(self):
+        assert BROAD.tier_profit(0.5, 50.0) == 0.0
+        assert BROAD.tier_platform_revenue(0.5, 50.0) == 0.0
+        assert BROAD.tier_profit(1.0, 50.0) == pytest.approx(1000 * (50 - 40))
+
+
+class TestAdvertiser:
+    def test_steady_state_all_tiers_active(self):
+        advertiser, platform = build()
+        assert advertiser.periods_evaluated == 5
+        assert len(advertiser.active_tiers) == 2
+        assert advertiser.tier_shutoff_events == 0
+        # Platform collects both tiers' spend each period.
+        expected = 5 * (NICHE.monthly_ad_spend + BROAD.monthly_ad_spend)
+        assert platform.total_revenue == pytest.approx(expected)
+
+    def test_aaa_effect_broad_tier_shuts_off_first(self):
+        """A modest sentiment drop (1.0 -> 0.7) kills the broad tier only,
+        costing the platform most of its revenue — the AAA effect."""
+        advertiser, platform = build(sentiment_events=[(2.5, 0.7)])
+        assert advertiser.tier_shutoff_events == 1
+        assert [t.name for t in advertiser.active_tiers] == ["Niche"]
+        # Periods 1-2 at full revenue, 3-5 niche-only.
+        full = NICHE.monthly_ad_spend + BROAD.monthly_ad_spend
+        expected = 2 * full + 3 * NICHE.monthly_ad_spend
+        assert platform.total_revenue == pytest.approx(expected)
+        # Revenue drop (-49k of 50k/period) far exceeds the 30% sentiment drop.
+        assert NICHE.monthly_ad_spend / full < 0.05
+
+    def test_sentiment_clamped(self):
+        advertiser, _ = build(sentiment_events=[(0.5, 5.0)])
+        assert advertiser.sentiment == 1.0
+        advertiser.sentiment = -3.0
+        assert advertiser.sentiment == 0.0
+
+    def test_time_series_recorded(self):
+        advertiser, platform = build()
+        assert advertiser.profit_data.count() == 5
+        assert advertiser.sentiment_data.mean() == pytest.approx(1.0)
+        assert platform.revenue_data.count() == 5
+
+    def test_sensitivity_analysis_monotone_tiers(self):
+        advertiser, _ = build(end_s=0.5)  # no evaluations needed
+        rows = advertiser.sensitivity_analysis(steps=10)
+        assert rows[0]["active_tiers"] == 0  # sentiment 0
+        assert rows[-1]["active_tiers"] == 2  # sentiment 1
+        active_counts = [r["active_tiers"] for r in rows]
+        assert active_counts == sorted(active_counts)
+
+    def test_stats_snapshot(self):
+        advertiser, platform = build()
+        stats = advertiser.stats()
+        assert stats.periods_evaluated == 5
+        assert stats.total_profit > 0
+        assert platform.stats().revenue_events == 5
