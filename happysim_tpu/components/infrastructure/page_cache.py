@@ -125,7 +125,9 @@ class PageCache(Entity):
     def flush(self):
         """Write back every dirty page; returns the count flushed."""
         flushed = 0
-        for page_id, dirty in self._pages.items():
+        # Snapshot: other entities may insert pages while we yield
+        # writeback latency mid-iteration.
+        for page_id, dirty in list(self._pages.items()):
             if dirty:
                 yield self.disk_write_latency_s
                 self._pages[page_id] = False

@@ -135,7 +135,7 @@ class APIGateway(Entity):
         if route is None:
             self._tally["no_route"] += 1
             logger.debug("[%s] no route for key=%r", self.name, key)
-            return None
+            return event.complete_as_dropped(self.now, self.name)
         self._route_tally[key] += 1
         if route.auth_required:
             return self._authenticate_then_route(event, key, route)
@@ -149,17 +149,17 @@ class APIGateway(Entity):
         if self._auth_failure_rate > 0 and self._rng.random() < self._auth_failure_rate:
             self._tally["auth_rejected"] += 1
             logger.debug("[%s] auth rejected on %s", self.name, key)
-            return []
+            return event.complete_as_dropped(self.now, self.name)
         return self._route(event, key, route) or []
 
     def _route(self, event: Event, key: str, route: RouteConfig) -> Optional[list[Event]]:
         policy = route.rate_limit_policy
         if policy is not None and not policy.try_acquire(self.now):
             self._tally["rate_limited"] += 1
-            return None
+            return event.complete_as_dropped(self.now, self.name)
         if not route.backends:
             self._tally["no_backend"] += 1
-            return None
+            return event.complete_as_dropped(self.now, self.name)
         cursor = self._rr_cursor[key]
         self._rr_cursor[key] += 1
         backend = route.backends[cursor % len(route.backends)]
@@ -194,8 +194,9 @@ class APIGateway(Entity):
             )
 
         relay.add_completion_hook(acknowledge)
-        for hook in event.on_complete:
-            relay.add_completion_hook(hook)
+        # MOVE the caller's hooks (leaving them on the inbound event would
+        # fire them at route time as a phantom success).
+        event.transfer_hooks(relay)
         out = [relay]
         if timeout is not None:
             out.append(

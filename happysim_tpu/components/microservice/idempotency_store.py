@@ -61,6 +61,7 @@ class IdempotencyStore(Entity):
         # key -> cached-at (dicts iterate in insertion order = oldest first)
         self._seen: dict[str, Instant] = {}
         self._in_flight: set[str] = set()
+        self._sweep_armed = False
         self._tally: Counter = Counter()
 
     # -- introspection -----------------------------------------------------
@@ -128,27 +129,35 @@ class IdempotencyStore(Entity):
         if key is not None:
 
             def mark_done(finish_time: Instant) -> Event:
+                # A dropped forward never ran: release the key so retries
+                # pass, and do NOT cache it as completed.
                 return Event(
                     finish_time,
                     _DONE,
                     target=self,
-                    context={"metadata": {"key": key}},
+                    context={"metadata": {"key": key, "dropped": bool(relay.dropped_by)}},
                 )
 
             relay.add_completion_hook(mark_done)
-        for hook in event.on_complete:
-            relay.add_completion_hook(hook)
+        # MOVE the caller's hooks (leaving them on the inbound event would
+        # fire them at forward time as a phantom success).
+        event.transfer_hooks(relay)
         out = [relay]
-        # First traffic through an idle store also arms the sweep loop.
-        if not self._seen and len(self._in_flight) <= 1:
+        # First traffic through an idle store arms the sweep loop — at
+        # most one chain, however many requests land before the first
+        # sweep fires.
+        if not self._sweep_armed:
             out.append(self._arm_sweep())
         return out
 
     def _settle(self, event: Event) -> None:
-        key = event.context.get("metadata", {}).get("key")
+        metadata = event.context.get("metadata", {})
+        key = metadata.get("key")
         if key is None:
             return None
         self._in_flight.discard(key)
+        if metadata.get("dropped"):
+            return None  # the work never ran — leave the key replayable
         if len(self._seen) >= self._max_entries:
             oldest = next(iter(self._seen))
             del self._seen[oldest]
@@ -159,6 +168,7 @@ class IdempotencyStore(Entity):
 
     # -- expiry ------------------------------------------------------------
     def _sweep(self, event: Event) -> Optional[list[Event]]:
+        self._sweep_armed = False
         stale = [
             key
             for key, cached_at in self._seen.items()
@@ -177,6 +187,7 @@ class IdempotencyStore(Entity):
         return None  # go quiet until the next request re-arms
 
     def _arm_sweep(self) -> Event:
+        self._sweep_armed = True
         at = (
             self.now + self._sweep_every
             if self._clock is not None

@@ -122,11 +122,13 @@ class OutboxRelay(Entity):
     def _drain(self, event: Event):
         self._poll_armed = False
         self._tally["polls"] += 1
-        out: list[Event] = []
         batch = min(self._batch_size, len(self._backlog))
         for _ in range(batch):
-            # Pay the relay latency BEFORE emitting, so every emitted event
-            # carries the (monotone) time it actually left the outbox.
+            # Pay the relay latency BEFORE emitting, then emit as a yield
+            # side effect so each message is scheduled at the (monotone)
+            # time it actually left the outbox — collecting them for the
+            # generator's final return would stamp earlier entries with
+            # by-then-past times and the loop would skip them.
             if self._relay_latency > 0:
                 yield self._relay_latency
             entry = self._backlog.popleft()
@@ -135,7 +137,7 @@ class OutboxRelay(Entity):
             lag = (self.now - entry.written_at).to_seconds()
             self._lag_sum += lag
             self._lag_max = max(self._lag_max, lag)
-            out.append(
+            yield 0.0, [
                 Event(
                     self.now,
                     "OutboxMessage",
@@ -148,10 +150,10 @@ class OutboxRelay(Entity):
                         "payload": entry.payload,
                     },
                 )
-            )
+            ]
         if self._backlog or self._tally["written"]:
-            out.append(self._arm_poll())
-        return out
+            return [self._arm_poll()]
+        return []
 
     def _arm_poll(self) -> Event:
         self._poll_armed = True

@@ -24,7 +24,9 @@ logger = logging.getLogger(__name__)
 
 _STEP_DONE = "_saga_step_complete"
 _STEP_TIMEOUT = "_saga_step_timeout"
+_STEP_DROPPED = "_saga_step_dropped"
 _COMP_DONE = "_saga_comp_complete"
+_COMP_DROPPED = "_saga_comp_dropped"
 
 
 class SagaState(Enum):
@@ -70,11 +72,14 @@ class SagaStats:
 @dataclass
 class _Instance:
     saga_id: int
-    trigger: Event  # the original request; its hooks fire on success
+    trigger: Event  # the original request
     started_at: Instant
     state: SagaState = SagaState.RUNNING
     cursor: int = 0  # forward: next step; compensating: next to unwind
     results: list[SagaStepResult] = field(default_factory=list)
+    # The trigger's completion hooks, moved here at launch so they fire
+    # when the saga settles — not when the launch returns.
+    hooks: list = field(default_factory=list)
 
 
 class Saga(Entity):
@@ -141,10 +146,12 @@ class Saga(Entity):
         kind = event.event_type
         if kind == _STEP_DONE:
             return self._step_finished(event)
-        if kind == _STEP_TIMEOUT:
-            return self._step_timed_out(event)
+        if kind in (_STEP_TIMEOUT, _STEP_DROPPED):
+            return self._step_failed(event)
         if kind == _COMP_DONE:
             return self._compensation_finished(event)
+        if kind == _COMP_DROPPED:
+            return self._compensation_failed(event)
         return self._launch(event)
 
     def _launch(self, trigger: Event) -> list[Event]:
@@ -152,18 +159,32 @@ class Saga(Entity):
         instance = _Instance(
             saga_id=self._serial, trigger=trigger, started_at=self.now
         )
+        # MOVE the trigger's hooks: the request completes when the saga
+        # settles, not when the first step is dispatched.
+        instance.hooks, trigger.on_complete = trigger.on_complete, []
         self._instances[instance.saga_id] = instance
         self._tally["started"] += 1
         logger.info("[%s] saga %d started", self.name, instance.saga_id)
         return self._advance(instance)
 
-    def _notify(self, instance: _Instance, step_index: int, kind: str) -> Callable:
-        """Completion hook telling this saga a step/compensation landed."""
+    def _notify(
+        self,
+        instance: _Instance,
+        step_index: int,
+        carrier: Event,
+        done_kind: str,
+        dropped_kind: str,
+    ) -> Callable:
+        """Completion hook telling this saga a step/compensation settled.
+
+        A dropped carrier (crashed target, shed queue — hooks still fire,
+        marked) reports the failure kind, never a phantom completion.
+        """
 
         def hook(finish_time: Instant) -> Event:
             return Event(
                 finish_time,
-                kind,
+                dropped_kind if carrier.dropped_by else done_kind,
                 target=self,
                 context={
                     "metadata": {
@@ -196,7 +217,9 @@ class Saga(Entity):
                 "payload": instance.trigger.context.get("payload", {}),
             },
         )
-        action.add_completion_hook(self._notify(instance, index, _STEP_DONE))
+        action.add_completion_hook(
+            self._notify(instance, index, action, _STEP_DONE, _STEP_DROPPED)
+        )
         out = [action]
         if step.timeout is not None:
             out.append(
@@ -234,7 +257,9 @@ class Saga(Entity):
                 "payload": instance.trigger.context.get("payload", {}),
             },
         )
-        undo.add_completion_hook(self._notify(instance, index, _COMP_DONE))
+        undo.add_completion_hook(
+            self._notify(instance, index, undo, _COMP_DONE, _COMP_DROPPED)
+        )
         return [undo]
 
     def _live_instance(
@@ -261,15 +286,15 @@ class Saga(Entity):
             return self._finish(instance, SagaState.COMPLETED)
         return self._advance(instance)
 
-    def _step_timed_out(self, event: Event) -> Optional[list[Event]]:
+    def _step_failed(self, event: Event) -> Optional[list[Event]]:
         instance = self._live_instance(event, SagaState.RUNNING)
         if instance is None:
             return None
         self._tally["step_failures"] += 1
         logger.info(
-            "[%s] saga %d: step %d (%s) timed out -> compensating",
+            "[%s] saga %d: step %d (%s) failed (%s) -> compensating",
             self.name, instance.saga_id, instance.cursor,
-            self._steps[instance.cursor].name,
+            self._steps[instance.cursor].name, event.event_type,
         )
         instance.state = SagaState.COMPENSATING
         instance.cursor -= 1  # unwind starting at the last completed step
@@ -286,6 +311,14 @@ class Saga(Entity):
             return self._finish(instance, SagaState.COMPENSATED)
         return self._unwind(instance)
 
+    def _compensation_failed(self, event: Event) -> Optional[list[Event]]:
+        """A dropped compensation cannot unwind: the saga is stuck FAILED
+        (manual intervention territory in a real system)."""
+        instance = self._live_instance(event, SagaState.COMPENSATING)
+        if instance is None:
+            return None
+        return self._finish(instance, SagaState.FAILED)
+
     def _finish(self, instance: _Instance, final: SagaState) -> list[Event]:
         instance.state = final
         key = {
@@ -296,13 +329,10 @@ class Saga(Entity):
         logger.info("[%s] saga %d %s", self.name, instance.saga_id, key)
         if self._finished_callback:
             self._finished_callback(instance.saga_id, final, instance.results)
-        follow_ups: list[Event] = []
+        # The triggering request settles with the saga: hooks fire as a
+        # success on commit, and unwind as a drop on compensation/failure.
+        instance.trigger.on_complete = instance.hooks
+        instance.hooks = []
         if final is SagaState.COMPLETED:
-            # The triggering request is only "done" when the saga commits.
-            for hook in instance.trigger.on_complete:
-                produced = hook(self.now)
-                if isinstance(produced, list):
-                    follow_ups.extend(produced)
-                elif produced is not None:
-                    follow_ups.append(produced)
-        return follow_ups
+            return instance.trigger._run_completion_hooks(self.now)
+        return instance.trigger.complete_as_dropped(self.now, self.name)

@@ -26,6 +26,7 @@ logger = logging.getLogger(__name__)
 
 _RESPONSE = "_sc_response"
 _TIMEOUT = "_sc_timeout"
+_DROPPED = "_sc_dropped"
 _RETRY_FIELD = "_sc_retry_attempt"
 
 
@@ -44,6 +45,7 @@ class SidecarStats:
     rate_limited: int = 0
     circuit_broken: int = 0
     timed_out: int = 0
+    dropped_downstream: int = 0
 
 
 class Sidecar(Entity):
@@ -106,6 +108,7 @@ class Sidecar(Entity):
             rate_limited=self._tally["rate_limited"],
             circuit_broken=self._tally["circuit_broken"],
             timed_out=self._tally["timed_out"],
+            dropped_downstream=self._tally["dropped"],
         )
 
     @property
@@ -120,22 +123,40 @@ class Sidecar(Entity):
             return self._on_response(event)
         if kind == _TIMEOUT:
             return self._on_timeout(event)
+        if kind == _DROPPED:
+            return self._on_dropped(event)
         return self._admit(event)
 
     def _admit(self, event: Event) -> Optional[list[Event]]:
-        self._tally["total"] += 1
         attempt = event.context.get("metadata", {}).get(_RETRY_FIELD, 0)
+        if attempt == 0:
+            # total counts logical requests; retries are attempts of the
+            # same request, not new traffic.
+            self._tally["total"] += 1
         if self._limiter is not None and not self._limiter.try_acquire(self.now):
             self._tally["rate_limited"] += 1
-            return None
+            return self._reject(event, attempt)
         self._maybe_enter_half_open()
         if self._breaker is _Breaker.OPEN:
             self._tally["circuit_broken"] += 1
-            return None
+            return self._reject(event, attempt)
         return self._dispatch(event, attempt)
 
+    def _reject(self, event: Event, attempt: int) -> list[Event]:
+        """A rejected attempt terminates the logical request: unwind its
+        hooks as a drop (retry attempts carry no hooks — they moved onto
+        the first relay — so this is then just bookkeeping)."""
+        if attempt > 0:
+            self._tally["failed"] += 1
+        return event.complete_as_dropped(self.now, self.name)
+
     def _dispatch(self, event: Event, attempt: int) -> list[Event]:
-        call_id = self._pending.issue(origin=event, attempt=attempt)
+        # The caller's hooks settle with the LOGICAL request (success or
+        # final failure), not with any single attempt: hold them in the
+        # pending ledger rather than on the relay, so a retried attempt's
+        # drop doesn't fire them early.
+        hooks, event.on_complete = event.on_complete, []
+        call_id = self._pending.issue(origin=event, attempt=attempt, hooks=hooks)
         relay = Event(
             self.now,
             event.event_type,
@@ -151,18 +172,17 @@ class Sidecar(Entity):
         )
 
         def acknowledge(finish_time: Instant) -> Event:
+            # A drop (crashed target, shed queue) is a failure, not a
+            # success — complete_as_dropped fires hooks too, marked.
+            kind = _DROPPED if relay.dropped_by else _RESPONSE
             return Event(
                 finish_time,
-                _RESPONSE,
+                kind,
                 target=self,
                 context={"metadata": {"call_id": call_id}},
             )
 
         relay.add_completion_hook(acknowledge)
-        if attempt == 0:
-            # Retries must not re-fire the caller's hooks.
-            for hook in event.on_complete:
-                relay.add_completion_hook(hook)
         deadline = Event(
             self.now + self._request_timeout,
             _TIMEOUT,
@@ -173,7 +193,7 @@ class Sidecar(Entity):
         return [relay, deadline]
 
     # -- settle paths ------------------------------------------------------
-    def _on_response(self, event: Event) -> None:
+    def _on_response(self, event: Event) -> Optional[list[Event]]:
         info = self._pending.settle(
             event.context.get("metadata", {}).get("call_id")
         )
@@ -181,31 +201,51 @@ class Sidecar(Entity):
             return None  # lost the race against the timeout
         self._tally["succeeded"] += 1
         self._breaker_success()
-        return None
+        # The logical request is done: fire the caller's held hooks.
+        origin: Event = info["origin"]
+        origin.on_complete = info["hooks"]
+        return origin._run_completion_hooks(self.now) or None
 
     def _on_timeout(self, event: Event) -> Optional[list[Event]]:
+        return self._attempt_failed(event, "timed_out")
+
+    def _on_dropped(self, event: Event) -> Optional[list[Event]]:
+        return self._attempt_failed(event, "dropped")
+
+    def _attempt_failed(self, event: Event, reason: str) -> Optional[list[Event]]:
         info = self._pending.settle(
             event.context.get("metadata", {}).get("call_id")
         )
         if info is None:
             return None  # response landed first
-        self._tally["timed_out"] += 1
+        self._tally[reason] += 1
         attempt = info["attempt"]
+        origin: Event = info["origin"]
         if attempt < self._max_retries:
             self._tally["retries"] += 1
-            origin: Event = info["origin"]
             backoff = self._backoff_base * (2 ** attempt)
+            # Fresh metadata dict: a shallow context copy would alias the
+            # origin's metadata and leak the retry counter into it.
             retry = Event(
                 self.now + backoff,
                 origin.event_type,
                 target=self,
-                context=dict(origin.context),
+                context={
+                    **origin.context,
+                    "metadata": {
+                        **origin.context.get("metadata", {}),
+                        _RETRY_FIELD: attempt + 1,
+                    },
+                },
             )
-            retry.context.setdefault("metadata", {})[_RETRY_FIELD] = attempt + 1
+            # The held hooks travel with the retry; _dispatch re-captures
+            # them (and a rejected retry unwinds them as a drop).
+            retry.on_complete = info["hooks"]
             return [retry]
         self._tally["failed"] += 1
         self._breaker_failure()
-        return None
+        origin.on_complete = info["hooks"]
+        return origin.complete_as_dropped(self.now, self.name) or None
 
     # -- circuit breaker ---------------------------------------------------
     def _maybe_enter_half_open(self) -> None:

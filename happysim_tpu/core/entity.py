@@ -69,7 +69,7 @@ class Entity(ABC):
             daemon=event.daemon,
             context=event.context,
         )
-        forwarded.on_complete, event.on_complete = event.on_complete, []
+        event.transfer_hooks(forwarded)
         return forwarded
 
     def has_capacity(self) -> bool:

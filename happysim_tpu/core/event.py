@@ -197,6 +197,23 @@ class Event:
             produced.extend(_normalize_events(hook(time)))
         return produced
 
+    def transfer_hooks(self, recipient: "Event") -> None:
+        """MOVE completion hooks onto ``recipient``.
+
+        Wrapper entities (gateways, sidecars, dedup filters) that relay a
+        request downstream must move — not copy — the inbound event's
+        hooks: a copy double-fires, and hooks left behind fire at relay
+        time as a phantom success.
+        """
+        for hook in self.on_complete:
+            recipient.add_completion_hook(hook)
+        self.on_complete = []
+
+    @property
+    def dropped_by(self) -> Optional[str]:
+        """Who dropped this event, or None if it completed normally."""
+        return self.context.get("metadata", {}).get("dropped_by")
+
     def complete_as_dropped(self, time: Instant, reason: str) -> list["Event"]:
         """Terminal unwind for an event that will never be serviced.
 

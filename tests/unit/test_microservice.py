@@ -1,0 +1,690 @@
+"""Unit tests: microservice components (saga, gateway, sidecar, idempotency,
+outbox) — including regression tests for the hook double-fire family, retry
+stat inflation, retry metadata aliasing, and duplicate sweep chains.
+"""
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Counter,
+    Event,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+    TokenBucketPolicy,
+)
+from happysim_tpu.components.microservice import (
+    APIGateway,
+    IdempotencyStore,
+    OutboxRelay,
+    RouteConfig,
+    Saga,
+    SagaState,
+    SagaStep,
+    Sidecar,
+)
+from happysim_tpu.core.entity import Entity
+
+
+class HookRecorder:
+    """Counts completion-hook firings and whether they were drops."""
+
+    def __init__(self):
+        self.fired = []
+
+    def hook(self, event):
+        def _fire(time):
+            self.fired.append(
+                (time.to_seconds(), event.context.get("metadata", {}).get("dropped_by"))
+            )
+            return None
+
+        event.add_completion_hook(_fire)
+        return event
+
+
+class StepService(Entity):
+    """Records received events; optionally sleeps longer than any timeout."""
+
+    def __init__(self, name, delay_s=0.01, stall=False):
+        super().__init__(name)
+        self.delay_s = delay_s
+        self.stall = stall
+        self.received = []
+
+    def handle_event(self, event):
+        self.received.append((self.now.to_seconds(), event.event_type))
+        if self.stall:
+            yield 1e6  # never completes within any test horizon
+            return None
+        yield self.delay_s
+        return None
+
+
+def run(entities, events, end_s=None):
+    sim = Simulation(
+        entities=entities,
+        end_time=Instant.from_seconds(end_s) if end_s is not None else None,
+    )
+    sim.schedule(events)
+    sim.run()
+    return sim
+
+
+def keepalive(until_s):
+    return Event(Instant.from_seconds(until_s), "Keepalive", target=Counter("ka"))
+
+
+# ---------------------------------------------------------------------------
+# Saga
+# ---------------------------------------------------------------------------
+
+
+def make_saga(stall_step=None, timeout=0.5, n_steps=3):
+    services, compensators, steps = [], [], []
+    for i in range(n_steps):
+        service = StepService(f"svc{i}", stall=(i == stall_step))
+        comp = StepService(f"comp{i}")
+        services.append(service)
+        compensators.append(comp)
+        steps.append(
+            SagaStep(
+                name=f"step{i}",
+                action_target=service,
+                action_event_type=f"Do{i}",
+                compensation_target=comp,
+                compensation_event_type=f"Undo{i}",
+                timeout=timeout,
+            )
+        )
+    saga = Saga("saga", steps)
+    return saga, services, compensators
+
+
+class TestSaga:
+    def test_happy_path_completes_all_steps(self):
+        saga, services, compensators = make_saga()
+        run(
+            [saga, *services, *compensators],
+            [Event(Instant.Epoch, "Order", target=saga), keepalive(10.0)],
+        )
+        assert saga.get_instance_state(1) is SagaState.COMPLETED
+        assert all(len(s.received) == 1 for s in services)
+        assert all(len(c.received) == 0 for c in compensators)
+        stats = saga.stats
+        assert stats.sagas_completed == 1
+        assert stats.steps_executed == 3
+        assert stats.compensations_executed == 0
+
+    def test_step_timeout_compensates_in_reverse(self):
+        saga, services, compensators = make_saga(stall_step=2)
+        run(
+            [saga, *services, *compensators],
+            [Event(Instant.Epoch, "Order", target=saga), keepalive(10.0)],
+        )
+        assert saga.get_instance_state(1) is SagaState.COMPENSATED
+        # Steps 0 and 1 completed then were compensated, newest first.
+        assert len(compensators[1].received) == 1
+        assert len(compensators[0].received) == 1
+        assert len(compensators[2].received) == 0  # the failed step isn't undone
+        assert compensators[1].received[0][0] < compensators[0].received[0][0]
+        assert saga.stats.sagas_compensated == 1
+        assert saga.stats.steps_failed == 1
+        assert saga.stats.compensations_executed == 2
+
+    def test_first_step_timeout_compensates_nothing(self):
+        saga, services, compensators = make_saga(stall_step=0)
+        run(
+            [saga, *services, *compensators],
+            [Event(Instant.Epoch, "Order", target=saga), keepalive(10.0)],
+        )
+        assert saga.get_instance_state(1) is SagaState.COMPENSATED
+        assert saga.stats.compensations_executed == 0
+
+    def test_trigger_hooks_fire_once_at_commit(self):
+        saga, services, compensators = make_saga()
+        recorder = HookRecorder()
+        trigger = recorder.hook(Event(Instant.Epoch, "Order", target=saga))
+        run([saga, *services, *compensators], [trigger, keepalive(10.0)])
+        assert len(recorder.fired) == 1
+        fired_at, dropped_by = recorder.fired[0]
+        assert dropped_by is None  # success, not a drop
+        # Commit time = 3 steps x 10ms, not the launch time.
+        assert fired_at == pytest.approx(0.03, abs=1e-3)
+
+    def test_trigger_hooks_unwind_as_drop_on_compensation(self):
+        saga, services, compensators = make_saga(stall_step=1)
+        recorder = HookRecorder()
+        trigger = recorder.hook(Event(Instant.Epoch, "Order", target=saga))
+        run([saga, *services, *compensators], [trigger, keepalive(10.0)])
+        assert len(recorder.fired) == 1
+        _, dropped_by = recorder.fired[0]
+        assert dropped_by == "saga"
+
+    def test_concurrent_instances_are_independent(self):
+        saga, services, compensators = make_saga()
+        run(
+            [saga, *services, *compensators],
+            [
+                Event(Instant.Epoch, "Order", target=saga),
+                Event(Instant.from_seconds(0.001), "Order", target=saga),
+                keepalive(10.0),
+            ],
+        )
+        assert saga.stats.sagas_started == 2
+        assert saga.stats.sagas_completed == 2
+        assert saga.active_instances == 0
+
+    def test_late_timeout_after_completion_is_ignored(self):
+        # Steps finish in 10ms; the 500ms timeouts fire long after and
+        # must not flip a completed saga into compensation.
+        saga, services, compensators = make_saga(timeout=0.5)
+        run(
+            [saga, *services, *compensators],
+            [Event(Instant.Epoch, "Order", target=saga), keepalive(10.0)],
+        )
+        assert saga.get_instance_state(1) is SagaState.COMPLETED
+        assert saga.stats.sagas_compensated == 0
+
+
+# ---------------------------------------------------------------------------
+# API gateway
+# ---------------------------------------------------------------------------
+
+
+def gw_request(gateway, route, at_s=0.0):
+    return Event(
+        Instant.from_seconds(at_s),
+        "Request",
+        target=gateway,
+        context={"metadata": {"route": route}},
+    )
+
+
+class TestAPIGateway:
+    def test_round_robin_across_backends(self):
+        a, b = Counter("a"), Counter("b")
+        gateway = APIGateway(
+            "gw",
+            routes={"orders": RouteConfig("orders", backends=[a, b], auth_required=False)},
+        )
+        run([gateway, a, b], [gw_request(gateway, "orders", i * 0.01) for i in range(4)])
+        assert a.count == 2
+        assert b.count == 2
+        assert gateway.stats.requests_routed == 4
+
+    def test_no_route_drops_with_hook_unwind(self):
+        backend = Counter("a")
+        gateway = APIGateway(
+            "gw", routes={"orders": RouteConfig("orders", backends=[backend])}
+        )
+        recorder = HookRecorder()
+        request = recorder.hook(gw_request(gateway, "unknown"))
+        run([gateway, backend], [request])
+        assert gateway.stats.requests_no_route == 1
+        assert recorder.fired[0][1] == "gw"
+
+    def test_auth_latency_and_rejection(self):
+        backend = Counter("a")
+        gateway = APIGateway(
+            "gw",
+            routes={"r": RouteConfig("r", backends=[backend], auth_required=True)},
+            auth_latency=0.005,
+            auth_failure_rate=1.0,
+            seed=1,
+        )
+        recorder = HookRecorder()
+        request = recorder.hook(gw_request(gateway, "r"))
+        run([gateway, backend], [request])
+        assert gateway.stats.requests_rejected_auth == 1
+        assert backend.count == 0
+        # Rejection happens after the auth latency elapsed.
+        assert recorder.fired[0][0] == pytest.approx(0.005, abs=1e-6)
+
+    def test_rate_limit_rejects_beyond_budget(self):
+        backend = Counter("a")
+        gateway = APIGateway(
+            "gw",
+            routes={
+                "r": RouteConfig(
+                    "r",
+                    backends=[backend],
+                    auth_required=False,
+                    rate_limit_policy=TokenBucketPolicy(capacity=2.0, refill_rate=0.001),
+                )
+            },
+        )
+        run([gateway, backend], [gw_request(gateway, "r", i * 0.001) for i in range(5)])
+        assert backend.count == 2
+        assert gateway.stats.requests_rejected_rate_limit == 3
+
+    def test_backend_hooks_fire_once_at_backend_completion(self):
+        backend = Server("backend", service_time=ConstantLatency(0.05))
+        gateway = APIGateway(
+            "gw", routes={"r": RouteConfig("r", backends=[backend], auth_required=False)}
+        )
+        recorder = HookRecorder()
+        request = recorder.hook(gw_request(gateway, "r"))
+        run([gateway, backend], [request])
+        assert len(recorder.fired) == 1
+        assert recorder.fired[0][0] == pytest.approx(0.05, abs=1e-3)
+
+    def test_timeout_settles_pending(self):
+        stalled = StepService("slow", stall=True)
+        gateway = APIGateway(
+            "gw",
+            routes={"r": RouteConfig("r", backends=[stalled], auth_required=False,
+                                     timeout=0.1)},
+        )
+        run([gateway, stalled], [gw_request(gateway, "r"), keepalive(1.0)], end_s=1.0)
+        assert gateway.in_flight == 0
+
+
+# ---------------------------------------------------------------------------
+# Sidecar
+# ---------------------------------------------------------------------------
+
+
+class TestSidecar:
+    def test_success_path(self):
+        target = Server("svc", service_time=ConstantLatency(0.01))
+        sidecar = Sidecar("mesh", target, request_timeout=1.0)
+        recorder = HookRecorder()
+        request = recorder.hook(Event(Instant.Epoch, "Call", target=sidecar))
+        run([sidecar, target], [request, keepalive(5.0)])
+        stats = sidecar.stats
+        assert stats.total_requests == 1
+        assert stats.successful_requests == 1
+        assert stats.retries == 0
+        assert len(recorder.fired) == 1
+        assert recorder.fired[0][0] == pytest.approx(0.01, abs=1e-3)
+
+    def test_timeout_retries_with_backoff_then_fails(self):
+        stalled = StepService("svc", stall=True)
+        sidecar = Sidecar(
+            "mesh", stalled, request_timeout=0.1, max_retries=2, retry_base_delay=0.1
+        )
+        run([sidecar, stalled], [Event(Instant.Epoch, "Call", target=sidecar),
+                                 keepalive(5.0)])
+        stats = sidecar.stats
+        # One logical request: attempts at 0, 0.2 (0.1 timeout + 0.1 backoff),
+        # and 0.5 (0.3 timeout + 0.2 backoff); then terminal failure.
+        assert stats.total_requests == 1  # regression: retries inflated this
+        assert stats.retries == 2
+        assert stats.timed_out == 3
+        assert stats.failed_requests == 1
+        assert [t for t, _ in stalled.received] == pytest.approx(
+            [0.0, 0.2, 0.5], abs=1e-3
+        )
+
+    def test_retry_metadata_does_not_alias_origin(self):
+        stalled = StepService("svc", stall=True)
+        sidecar = Sidecar("mesh", stalled, request_timeout=0.1, max_retries=1)
+        origin = Event(Instant.Epoch, "Call", target=sidecar)
+        original_metadata = origin.context["metadata"]
+        run([sidecar, stalled], [origin, keepalive(2.0)])
+        # Regression: the retry's attempt counter must not leak back.
+        assert "_sc_retry_attempt" not in original_metadata
+
+    def test_rate_limit_rejection_unwinds_hooks(self):
+        target = Server("svc", service_time=ConstantLatency(0.01))
+        sidecar = Sidecar(
+            "mesh", target, rate_limit_policy=TokenBucketPolicy(capacity=1.0, refill_rate=0.001)
+        )
+        recorder = HookRecorder()
+        first = Event(Instant.Epoch, "Call", target=sidecar)
+        second = recorder.hook(Event(Instant.from_seconds(0.001), "Call", target=sidecar))
+        run([sidecar, target], [first, second, keepalive(2.0)])
+        assert sidecar.stats.rate_limited == 1
+        assert recorder.fired[0][1] == "mesh"
+
+    def test_circuit_opens_after_threshold_and_recovers(self):
+        stalled = StepService("svc", stall=True)
+        sidecar = Sidecar(
+            "mesh",
+            stalled,
+            circuit_failure_threshold=2,
+            circuit_timeout=10.0,
+            request_timeout=0.1,
+            max_retries=0,
+        )
+        events = [
+            Event(Instant.from_seconds(i * 0.5), "Call", target=sidecar) for i in range(3)
+        ]
+        sim = Simulation(entities=[sidecar, stalled], end_time=Instant.from_seconds(60))
+        sim.schedule(events + [keepalive(30.0)])
+        sim.run()
+        stats = sidecar.stats
+        # Two timeouts trip the breaker; the third call is refused outright.
+        assert stats.failed_requests == 2
+        assert stats.circuit_broken == 1
+        # After circuit_timeout the breaker probes half-open.
+        assert sidecar.circuit_state == "half_open"
+
+    def test_half_open_success_closes_circuit(self):
+        flaky = StepService("svc", stall=True)
+        sidecar = Sidecar(
+            "mesh",
+            flaky,
+            circuit_failure_threshold=1,
+            circuit_success_threshold=1,
+            circuit_timeout=1.0,
+            request_timeout=0.1,
+            max_retries=0,
+        )
+        sim = Simulation(entities=[sidecar, flaky], end_time=Instant.from_seconds(60))
+        sim.schedule([Event(Instant.Epoch, "Call", target=sidecar), keepalive(30.0)])
+        # Heal the service before the probe call.
+        heal = Event(Instant.from_seconds(2.0), "Call", target=sidecar)
+        sim.schedule(heal)
+        flaky_heals_at = 1.5
+
+        class Healer(Entity):
+            def handle_event(self, event):
+                flaky.stall = False
+                return None
+
+        healer = Healer("healer")
+        sim.schedule(Event(Instant.from_seconds(flaky_heals_at), "Heal", target=healer))
+        sim.run()
+        assert sidecar.circuit_state == "closed"
+        assert sidecar.stats.successful_requests == 1
+
+
+# ---------------------------------------------------------------------------
+# Idempotency store
+# ---------------------------------------------------------------------------
+
+
+def keyed_request(store, key, at_s=0.0):
+    return Event(
+        Instant.from_seconds(at_s),
+        "Write",
+        target=store,
+        context={"metadata": {"idempotency_key": key}},
+    )
+
+
+def key_of(event):
+    return event.context.get("metadata", {}).get("idempotency_key")
+
+
+class TestIdempotencyStore:
+    def test_unique_keys_forward_duplicates_suppressed(self):
+        backend = Server("db", service_time=ConstantLatency(0.01))
+        store = IdempotencyStore("idem", backend, key_extractor=key_of)
+        run(
+            [store, backend],
+            [
+                keyed_request(store, "a", 0.0),
+                keyed_request(store, "a", 0.5),  # cached by now
+                keyed_request(store, "b", 0.5),
+                keepalive(2.0),
+            ],
+            end_s=2.0,
+        )
+        stats = store.stats
+        assert stats.cache_misses == 2
+        assert stats.cache_hits == 1
+        assert backend.requests_completed == 2
+
+    def test_in_flight_duplicate_suppressed(self):
+        backend = Server("db", service_time=ConstantLatency(0.5))
+        store = IdempotencyStore("idem", backend, key_extractor=key_of)
+        run(
+            [store, backend],
+            [keyed_request(store, "a", 0.0), keyed_request(store, "a", 0.1),
+             keepalive(2.0)],
+            end_s=2.0,
+        )
+        assert store.stats.cache_hits == 1
+        assert backend.requests_completed == 1
+
+    def test_keyless_requests_opt_out(self):
+        backend = Server("db", service_time=ConstantLatency(0.01))
+        store = IdempotencyStore("idem", backend, key_extractor=key_of)
+        run(
+            [store, backend],
+            [Event(Instant.from_seconds(i * 0.1), "Write", target=store) for i in range(3)]
+            + [keepalive(1.0)],
+            end_s=1.0,
+        )
+        assert backend.requests_completed == 3
+        assert store.stats.cache_hits == 0
+
+    def test_ttl_expiry_allows_replay(self):
+        backend = Server("db", service_time=ConstantLatency(0.01))
+        store = IdempotencyStore(
+            "idem", backend, key_extractor=key_of, ttl=1.0, cleanup_interval=0.5
+        )
+        run(
+            [store, backend],
+            [keyed_request(store, "a", 0.0), keyed_request(store, "a", 3.0),
+             keepalive(5.0)],
+            end_s=5.0,
+        )
+        assert store.stats.cache_misses == 2
+        assert store.stats.entries_expired >= 1
+        assert backend.requests_completed == 2
+
+    def test_capacity_eviction_oldest_first(self):
+        backend = Server("db", service_time=ConstantLatency(0.001))
+        store = IdempotencyStore("idem", backend, key_extractor=key_of, max_entries=2)
+        run(
+            [store, backend],
+            [
+                keyed_request(store, "a", 0.0),
+                keyed_request(store, "b", 0.2),
+                keyed_request(store, "c", 0.4),  # evicts "a"
+                keyed_request(store, "a", 0.6),  # forwards again
+                keepalive(2.0),
+            ],
+            end_s=2.0,
+        )
+        assert store.stats.cache_misses == 4
+        assert backend.requests_completed == 4
+
+    def test_single_sweep_chain(self):
+        """Regression: multiple requests through an idle store must arm at
+        most one sweep chain, not one per request."""
+        sweeps = []
+
+        class CountingStore(IdempotencyStore):
+            def _sweep(self, event):
+                sweeps.append(self.now.to_seconds())
+                return super()._sweep(event)
+
+        backend = Server("db", service_time=ConstantLatency(0.001))
+        store = CountingStore(
+            "idem", backend, key_extractor=key_of, ttl=100.0, cleanup_interval=1.0
+        )
+        run(
+            [store, backend],
+            [keyed_request(store, k, 0.0) for k in ("a", "b", "c")] + [keepalive(5.5)],
+            end_s=5.5,
+        )
+        # One chain: sweeps at ~1,2,3,4,5 — not three interleaved chains.
+        assert len(sweeps) == 5
+
+    def test_forward_hooks_fire_once(self):
+        backend = Server("db", service_time=ConstantLatency(0.02))
+        store = IdempotencyStore("idem", backend, key_extractor=key_of)
+        recorder = HookRecorder()
+        request = recorder.hook(keyed_request(store, "a"))
+        run([store, backend], [request, keepalive(1.0)], end_s=1.0)
+        assert len(recorder.fired) == 1
+        assert recorder.fired[0][0] == pytest.approx(0.02, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Drop-vs-success discrimination (crashed / load-shedding downstream)
+# ---------------------------------------------------------------------------
+
+
+class TestDropDiscrimination:
+    def test_sidecar_counts_crashed_backend_as_failure(self):
+        """Regression: a crashed target's dropped relay must not read as a
+        success (which would keep the breaker closed forever)."""
+        target = Server("svc", service_time=ConstantLatency(0.01))
+        target._crashed = True
+        sidecar = Sidecar(
+            "mesh", target, circuit_failure_threshold=2, max_retries=0,
+            request_timeout=5.0,
+        )
+        run(
+            [sidecar, target],
+            [Event(Instant.from_seconds(i * 0.1), "Call", target=sidecar)
+             for i in range(3)] + [keepalive(2.0)],
+            end_s=2.0,
+        )
+        stats = sidecar.stats
+        assert stats.successful_requests == 0
+        assert stats.dropped_downstream >= 2
+        assert stats.failed_requests == 2
+        # Two drops tripped the breaker; the third call was refused.
+        assert stats.circuit_broken == 1
+
+    def test_sidecar_retries_after_drop_then_succeeds(self):
+        target = Server("svc", service_time=ConstantLatency(0.01))
+        target._crashed = True
+        sidecar = Sidecar(
+            "mesh", target, max_retries=3, retry_base_delay=0.5, request_timeout=5.0
+        )
+
+        class Healer(Entity):
+            def handle_event(self, event):
+                target._crashed = False
+                return None
+
+        healer = Healer("healer")
+        recorder = HookRecorder()
+        request = recorder.hook(Event(Instant.Epoch, "Call", target=sidecar))
+        run(
+            [sidecar, target, healer],
+            [request, Event(Instant.from_seconds(0.2), "Heal", target=healer),
+             keepalive(5.0)],
+            end_s=5.0,
+        )
+        stats = sidecar.stats
+        assert stats.successful_requests == 1
+        assert stats.retries == 1
+        # The caller's hook fired exactly once, as a success, at the
+        # retry's completion — not at the first attempt's drop.
+        assert len(recorder.fired) == 1
+        assert recorder.fired[0][1] is None
+        assert recorder.fired[0][0] == pytest.approx(0.51, abs=1e-2)
+
+    def test_saga_step_drop_triggers_compensation(self):
+        saga, services, compensators = make_saga(n_steps=2, timeout=None)
+        services[1]._crashed = True
+        run(
+            [saga, *services, *compensators],
+            [Event(Instant.Epoch, "Order", target=saga), keepalive(5.0)],
+            end_s=5.0,
+        )
+        assert saga.get_instance_state(1) is SagaState.COMPENSATED
+        assert len(compensators[0].received) == 1
+
+    def test_saga_compensation_drop_marks_failed(self):
+        saga, services, compensators = make_saga(stall_step=1, n_steps=2)
+        compensators[0]._crashed = True
+        run(
+            [saga, *services, *compensators],
+            [Event(Instant.Epoch, "Order", target=saga), keepalive(5.0)],
+            end_s=5.0,
+        )
+        assert saga.get_instance_state(1) is SagaState.FAILED
+        assert saga.stats.sagas_failed == 1
+
+    def test_idempotency_drop_leaves_key_replayable(self):
+        """Regression: a dropped forward must not cache its key as done."""
+        backend = Server("db", service_time=ConstantLatency(0.01))
+        backend._crashed = True
+        store = IdempotencyStore("idem", backend, key_extractor=key_of)
+
+        class Healer(Entity):
+            def handle_event(self, event):
+                backend._crashed = False
+                return None
+
+        healer = Healer("healer")
+        run(
+            [store, backend, healer],
+            [
+                keyed_request(store, "a", 0.0),  # dropped by crashed backend
+                Event(Instant.from_seconds(0.5), "Heal", target=healer),
+                keyed_request(store, "a", 1.0),  # must forward again
+                keepalive(3.0),
+            ],
+            end_s=3.0,
+        )
+        assert store.stats.cache_hits == 0
+        assert store.stats.cache_misses == 2
+
+
+# ---------------------------------------------------------------------------
+# Outbox relay
+# ---------------------------------------------------------------------------
+
+
+class TestOutboxRelay:
+    def test_writes_drain_in_batches(self):
+        sink = Counter("consumer")
+        outbox = OutboxRelay("outbox", sink, poll_interval=0.1, batch_size=2,
+                             relay_latency=0.0)
+        sim = Simulation(entities=[outbox, sink], end_time=Instant.from_seconds(1.0))
+        for i in range(5):
+            outbox.write({"n": i})
+        sim.schedule([outbox.prime_poll(), keepalive(1.0)])
+        sim.run()
+        stats = outbox.stats
+        assert stats.entries_written == 5
+        assert stats.entries_relayed == 5
+        assert sink.count == 5
+        # 5 entries at batch_size 2 need 3 polls (2+2+1); later polls idle.
+        assert stats.poll_cycles >= 3
+
+    def test_relay_lag_tracked(self):
+        sink = Counter("consumer")
+        outbox = OutboxRelay("outbox", sink, poll_interval=0.5, relay_latency=0.0)
+        sim = Simulation(entities=[outbox, sink], end_time=Instant.from_seconds(2.0))
+        outbox.write({"n": 1})
+        sim.schedule([outbox.prime_poll(), keepalive(2.0)])
+        sim.run()
+        stats = outbox.stats
+        assert stats.entries_relayed == 1
+        # Written at epoch, relayed at the first 0.5s poll.
+        assert stats.relay_lag_max == pytest.approx(0.5, abs=1e-3)
+        assert stats.avg_relay_lag == pytest.approx(0.5, abs=1e-3)
+
+    def test_any_event_kicks_poll_loop(self):
+        sink = Counter("consumer")
+        outbox = OutboxRelay("outbox", sink, poll_interval=0.1, relay_latency=0.0)
+
+        class Writer(Entity):
+            def handle_event(self, event):
+                outbox.write({"from": "writer"})
+                return [Event(self.now, "Kick", target=outbox)]
+
+        writer = Writer("writer")
+        run(
+            [outbox, sink, writer],
+            [Event(Instant.Epoch, "Go", target=writer), keepalive(1.0)],
+            end_s=1.0,
+        )
+        assert sink.count == 1
+
+    def test_relay_latency_orders_emissions(self):
+        sink = Sink("consumer")
+        outbox = OutboxRelay("outbox", sink, poll_interval=0.1, batch_size=10,
+                             relay_latency=0.01)
+        sim = Simulation(entities=[outbox, sink], end_time=Instant.from_seconds(1.0))
+        for i in range(3):
+            outbox.write({"n": i})
+        sim.schedule([outbox.prime_poll(), keepalive(1.0)])
+        sim.run()
+        times = [t.to_seconds() for t in sink.completion_times]
+        assert times == sorted(times)
+        assert len(times) == 3
