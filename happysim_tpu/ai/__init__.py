@@ -1,0 +1,23 @@
+"""AI-facing result wrappers and rule-based insights.
+
+Parity target: ``happysimulator/ai/`` (``SimulationResult`` :result.py:116,
+``SimulationComparison`` :44, ``SweepResult`` :253,
+``generate_recommendations`` :insights.py:34).
+"""
+
+from happysim_tpu.ai.insights import Recommendation, generate_recommendations
+from happysim_tpu.ai.result import (
+    MetricDiff,
+    SimulationComparison,
+    SimulationResult,
+    SweepResult,
+)
+
+__all__ = [
+    "MetricDiff",
+    "Recommendation",
+    "SimulationComparison",
+    "SimulationResult",
+    "SweepResult",
+    "generate_recommendations",
+]

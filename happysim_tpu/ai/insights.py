@@ -1,0 +1,195 @@
+"""Rule-based recommendations from simulation results.
+
+Parity target: ``happysimulator/ai/insights.py:34-160``
+(``generate_recommendations``) — four rules: queue saturation, tail
+latency variance, degraded phases, and underutilization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from happysim_tpu.ai.result import SimulationResult
+
+
+@dataclass
+class Recommendation:
+    category: str  # "capacity" | "architecture" | "configuration"
+    description: str
+    confidence: str  # "high" | "medium" | "low"
+    suggested_change: str
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "category": self.category,
+            "description": self.description,
+            "confidence": self.confidence,
+            "suggested_change": self.suggested_change,
+        }
+
+
+def generate_recommendations(result: "SimulationResult") -> list[Recommendation]:
+    """Apply every rule; ordering is saturation, pressure, tail, phases, waste."""
+    recommendations: list[Recommendation] = []
+    recommendations.extend(_queue_saturation(result))
+    recommendations.extend(_server_pressure(result))
+    recommendations.extend(_tail_latency(result))
+    recommendations.extend(_degraded_phases(result))
+    recommendations.extend(_underutilization(result))
+    return recommendations
+
+
+def _server_pressure(result: "SimulationResult") -> list[Recommendation]:
+    """Near-saturated utilization or drops in per-entity summaries.
+
+    This is the rule that fires for TPU ensemble results, whose server
+    stats arrive as aggregate utilization/drop counters rather than a
+    queue-depth time series.
+    """
+    out = []
+    for entity in result.summary.entities:
+        utilization = entity.extra.get("utilization")
+        dropped = entity.extra.get("dropped", 0) or 0
+        if utilization is not None and utilization >= 0.95:
+            out.append(
+                Recommendation(
+                    category="capacity",
+                    description=(
+                        f"Server '{entity.name}' ran at {utilization:.0%} "
+                        f"utilization — effectively saturated"
+                        + (f" and dropped {dropped} requests" if dropped else "")
+                        + "."
+                    ),
+                    confidence="high",
+                    suggested_change=(
+                        "Increase concurrency or add servers; at this "
+                        "utilization queueing delay grows without bound."
+                    ),
+                )
+            )
+        elif dropped:
+            out.append(
+                Recommendation(
+                    category="capacity",
+                    description=(
+                        f"Server '{entity.name}' dropped {dropped} requests "
+                        f"(queue overflow)."
+                    ),
+                    confidence="high",
+                    suggested_change=(
+                        "Increase queue capacity or service capacity, or add "
+                        "admission control upstream."
+                    ),
+                )
+            )
+    return out
+
+
+def _queue_saturation(result: "SimulationResult") -> list[Recommendation]:
+    """Queue depth growing early->late means arrivals outpace service."""
+    out = []
+    for name, data in result.queue_depth.items():
+        if data.count() < 20:
+            continue
+        times = data.times_s
+        duration = times[-1] - times[0]
+        if duration <= 0:
+            continue
+        early = data.between(times[0], times[0] + duration * 0.2)
+        late = data.between(times[0] + duration * 0.8, times[-1])
+        if early.count() == 0 or late.count() == 0:
+            continue
+        if late.mean() > max(early.mean() * 2, 5):
+            out.append(
+                Recommendation(
+                    category="capacity",
+                    description=(
+                        f"Queue depth for '{name}' is growing over time "
+                        f"(early mean: {early.mean():.1f}, late mean: "
+                        f"{late.mean():.1f}), indicating the system is saturated."
+                    ),
+                    confidence="high",
+                    suggested_change=(
+                        "Increase service capacity (more servers or higher "
+                        "concurrency) or reduce arrival rate."
+                    ),
+                )
+            )
+    return out
+
+
+def _tail_latency(result: "SimulationResult") -> list[Recommendation]:
+    if result.latency is None or result.latency.count() < 20:
+        return []
+    p50 = result.latency.percentile(50)
+    p99 = result.latency.percentile(99)
+    if p50 <= 0 or p99 / p50 <= 10:
+        return []
+    return [
+        Recommendation(
+            category="configuration",
+            description=(
+                f"Tail latency is very high relative to median: p99={p99:.4f}s "
+                f"is {p99 / p50:.0f}x the p50={p50:.4f}s. This suggests high "
+                f"variance or occasional queueing delays."
+            ),
+            confidence="medium",
+            suggested_change=(
+                "Investigate sources of variance: service time distribution, "
+                "queue buildup during bursts, or resource contention. Consider "
+                "adding concurrency or using a less variable service time."
+            ),
+        )
+    ]
+
+
+def _degraded_phases(result: "SimulationResult") -> list[Recommendation]:
+    out = []
+    for metric_name, phases in result.analysis.phases.items():
+        for phase in phases:
+            if phase.label in ("degraded", "overloaded"):
+                out.append(
+                    Recommendation(
+                        category="capacity",
+                        description=(
+                            f"Metric '{metric_name}' entered a '{phase.label}' "
+                            f"phase from t={phase.start_s:.1f}s to "
+                            f"t={phase.end_s:.1f}s (mean={phase.mean:.4f})."
+                        ),
+                        confidence="high",
+                        suggested_change=(
+                            f"Plan capacity for the load levels around "
+                            f"t={phase.start_s:.1f}s. Consider auto-scaling or "
+                            f"load shedding."
+                        ),
+                    )
+                )
+                break  # one per metric
+    return out
+
+
+def _underutilization(result: "SimulationResult") -> list[Recommendation]:
+    out = []
+    for name, data in result.queue_depth.items():
+        if data.count() < 20:
+            continue
+        if data.mean() < 0.5 and data.max() < 3:
+            out.append(
+                Recommendation(
+                    category="capacity",
+                    description=(
+                        f"Queue '{name}' is nearly always empty (mean depth: "
+                        f"{data.mean():.2f}, max: {data.max():.1f}), suggesting "
+                        f"the system is overprovisioned."
+                    ),
+                    confidence="low",
+                    suggested_change=(
+                        "Consider reducing server count or concurrency to save "
+                        "resources, unless headroom is intentional for burst "
+                        "handling."
+                    ),
+                )
+            )
+    return out

@@ -1,0 +1,315 @@
+"""Rich result wrappers: analysis + comparison + sweeps, LLM-friendly.
+
+Parity target: ``happysimulator/ai/result.py`` (``SimulationResult`` :116
+with ``from_run``/``compare``/``to_prompt_context``, ``SimulationComparison``
+:44, ``SweepResult`` :253). House extension: ``SimulationResult.from_run``
+also accepts the TPU executor's ``EnsembleResult`` (via ``analyze``'s
+coercion), so host and TPU runs produce the same result shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Optional
+
+from happysim_tpu.analysis.report import SimulationAnalysis, analyze
+
+if TYPE_CHECKING:
+    from happysim_tpu.instrumentation.data import Data
+    from happysim_tpu.instrumentation.summary import SimulationSummary
+
+
+def _pct_change(a: float, b: float) -> float:
+    if a == 0:
+        return 0.0 if b == 0 else float("inf")
+    return (b - a) / abs(a) * 100
+
+
+def _json_round(value: float, digits: int = 6):
+    """Round for serialization; non-finite becomes None (strict-JSON safe)."""
+    import math
+
+    return round(value, digits) if math.isfinite(value) else None
+
+
+@dataclass
+class MetricDiff:
+    """One metric's movement between two runs."""
+
+    name: str
+    mean_a: float
+    mean_b: float
+    mean_change_pct: float
+    p99_a: float
+    p99_b: float
+    p99_change_pct: float
+
+    @classmethod
+    def between(cls, name: str, data_a: "Data", data_b: "Data") -> "MetricDiff":
+        mean_a, mean_b = data_a.mean(), data_b.mean()
+        p99_a, p99_b = data_a.percentile(99), data_b.percentile(99)
+        return cls(
+            name=name,
+            mean_a=mean_a,
+            mean_b=mean_b,
+            mean_change_pct=_pct_change(mean_a, mean_b),
+            p99_a=p99_a,
+            p99_b=p99_b,
+            p99_change_pct=_pct_change(p99_a, p99_b),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "mean_a": _json_round(self.mean_a),
+            "mean_b": _json_round(self.mean_b),
+            "mean_change_pct": _json_round(self.mean_change_pct, 1),
+            "p99_a": _json_round(self.p99_a),
+            "p99_b": _json_round(self.p99_b),
+            "p99_change_pct": _json_round(self.p99_change_pct, 1),
+        }
+
+
+@dataclass
+class SimulationComparison:
+    """A/B view over two results."""
+
+    result_a: "SimulationResult"
+    result_b: "SimulationResult"
+    metric_diffs: dict[str, MetricDiff] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "result_a": self.result_a.to_dict(),
+            "result_b": self.result_b.to_dict(),
+            "metric_diffs": {n: d.to_dict() for n, d in self.metric_diffs.items()},
+        }
+
+    def to_prompt_context(self, max_tokens: int = 2000) -> str:
+        lines = ["## Simulation Comparison", "", "| Metric | Run A | Run B | Change |",
+                 "|--------|-------|-------|--------|"]
+        for name, diff in self.metric_diffs.items():
+            sign = "+" if diff.mean_change_pct >= 0 else ""
+            lines.append(
+                f"| {name} (mean) | {diff.mean_a:.4f}s | {diff.mean_b:.4f}s "
+                f"| {sign}{diff.mean_change_pct:.1f}% |"
+            )
+            sign = "+" if diff.p99_change_pct >= 0 else ""
+            lines.append(
+                f"| {name} (p99) | {diff.p99_a:.4f}s | {diff.p99_b:.4f}s "
+                f"| {sign}{diff.p99_change_pct:.1f}% |"
+            )
+        eps_a = self.result_a.summary.events_per_second
+        eps_b = self.result_b.summary.events_per_second
+        if eps_a > 0:
+            change = _pct_change(eps_a, eps_b)
+            sign = "+" if change >= 0 else ""
+            lines.append(f"| throughput | {eps_a:.1f}/s | {eps_b:.1f}/s | {sign}{change:.1f}% |")
+        lines.append("")
+
+        highlights = []
+        for name, diff in self.metric_diffs.items():
+            if abs(diff.p99_change_pct) > 10:
+                direction = "lower" if diff.p99_change_pct < 0 else "higher"
+                highlights.append(
+                    f"- Run B has {abs(diff.p99_change_pct):.0f}% {direction} "
+                    f"tail latency (p99) for {name}"
+                )
+            if abs(diff.mean_change_pct) > 20:
+                direction = "lower" if diff.mean_change_pct < 0 else "higher"
+                highlights.append(
+                    f"- {name} mean is {abs(diff.mean_change_pct):.0f}% {direction} in Run B"
+                )
+        if highlights:
+            lines.append("## Key Differences")
+            lines.extend(highlights)
+            lines.append("")
+        return "\n".join(lines)
+
+
+@dataclass
+class SimulationResult:
+    """Summary + analysis + raw metrics + recommendations, in one handle."""
+
+    summary: "SimulationSummary"
+    analysis: SimulationAnalysis
+    latency: Optional["Data"] = None
+    queue_depth: dict[str, "Data"] = field(default_factory=dict)
+    throughput: Optional["Data"] = None
+    recommendations: list[Any] = field(default_factory=list)
+
+    @classmethod
+    def from_run(
+        cls,
+        summary,
+        latency: Optional["Data"] = None,
+        queue_depth: Optional[dict[str, "Data"]] = None,
+        throughput: Optional["Data"] = None,
+        **named_metrics: "Data",
+    ) -> "SimulationResult":
+        """Analyze + recommend in one call.
+
+        ``summary`` may be a host SimulationSummary or a TPU
+        EnsembleResult (see ``analyze``).
+        """
+        depths = queue_depth or {}
+        # The causal-chain "queue_depth" slot gets the MOST LOADED queue
+        # (highest mean) — an arbitrary first entry would let an idle
+        # final stage mask a saturated earlier one. The rest come along
+        # as named per-stage metrics.
+        primary_depth = None
+        extra_depths: dict[str, Data] = {}
+        if depths:
+            primary_name = max(
+                depths, key=lambda name: depths[name].mean() if depths[name].count() else 0.0
+            )
+            primary_depth = depths[primary_name]
+            extra_depths = {
+                f"queue_depth_{name}": data
+                for name, data in depths.items()
+                if name != primary_name and data.count() > 0
+            }
+        analysis = analyze(
+            summary,
+            latency=latency,
+            queue_depth=primary_depth,
+            throughput=throughput,
+            **extra_depths,
+            **named_metrics,
+        )
+        result = cls(
+            summary=analysis.summary,
+            analysis=analysis,
+            latency=latency,
+            queue_depth=depths,
+            throughput=throughput,
+        )
+        from happysim_tpu.ai.insights import generate_recommendations
+
+        result.recommendations = generate_recommendations(result)
+        return result
+
+    def to_dict(self) -> dict[str, Any]:
+        out = self.analysis.to_dict()
+        if self.recommendations:
+            out["recommendations"] = [r.to_dict() for r in self.recommendations]
+        return out
+
+    def to_prompt_context(self, max_tokens: int = 2000) -> str:
+        parts = [self.analysis.to_prompt_context(max_tokens=max_tokens)]
+        if self.recommendations:
+            lines = ["## Recommendations"]
+            for rec in self.recommendations:
+                lines.append(f"- [{rec.confidence}] **{rec.category}**: {rec.description}")
+                if rec.suggested_change:
+                    lines.append(f"  Suggested: {rec.suggested_change}")
+            lines.append("")
+            parts.append("\n".join(lines))
+        return "\n".join(parts)
+
+    def compare(self, other: "SimulationResult") -> SimulationComparison:
+        diffs: dict[str, MetricDiff] = {}
+        if (
+            self.latency is not None
+            and other.latency is not None
+            and self.latency.count() > 0
+            and other.latency.count() > 0
+        ):
+            diffs["latency"] = MetricDiff.between("latency", self.latency, other.latency)
+        for key in sorted(set(self.queue_depth) & set(other.queue_depth)):
+            data_a, data_b = self.queue_depth[key], other.queue_depth[key]
+            if data_a.count() > 0 and data_b.count() > 0:
+                diffs[f"queue_depth_{key}"] = MetricDiff.between(
+                    f"queue_depth_{key}", data_a, data_b
+                )
+        return SimulationComparison(result_a=self, result_b=other, metric_diffs=diffs)
+
+
+@dataclass
+class SweepResult:
+    """One parameter swept across several runs."""
+
+    parameter_name: str
+    parameter_values: list[Any]
+    results: list[SimulationResult]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "parameter_name": self.parameter_name,
+            "parameter_values": self.parameter_values,
+            "results": [r.to_dict() for r in self.results],
+        }
+
+    def best_by(self, metric: str = "latency", stat: str = "p99") -> SimulationResult:
+        """The run minimizing ``stat`` of ``metric``."""
+
+        def value_of(result: SimulationResult) -> float:
+            if metric == "latency" and result.latency is not None:
+                data = result.latency
+            elif metric in result.queue_depth:
+                data = result.queue_depth[metric]
+            else:
+                return float("inf")
+            if data.count() == 0:
+                return float("inf")
+            if stat == "mean":
+                return data.mean()
+            if stat == "p50":
+                return data.percentile(50)
+            return data.percentile(99)
+
+        return min(self.results, key=value_of)
+
+    def to_prompt_context(self, max_tokens: int = 2000) -> str:
+        lines = [f"## Parameter Sweep: {self.parameter_name}", ""]
+        depth_keys: list[str] = []
+        for result in self.results:
+            for key in result.queue_depth:
+                if key not in depth_keys:
+                    depth_keys.append(key)
+        header = f"| {self.parameter_name} | latency_mean | latency_p99 |"
+        separator = "|" + "---|" * 3
+        for key in depth_keys:
+            header += f" qd_{key}_mean |"
+            separator += "---|"
+        header += " throughput |"
+        separator += "---|"
+        lines.extend([header, separator])
+
+        p99s: list[Optional[float]] = []
+        for value, result in zip(self.parameter_values, self.results):
+            row = f"| {value} |"
+            if result.latency is not None and result.latency.count() > 0:
+                p99 = result.latency.percentile(99)
+                row += f" {result.latency.mean():.4f}s | {p99:.4f}s |"
+                if p99s and p99s[-1] not in (None, 0) and p99 > p99s[-1] * 5:
+                    row += "  <-- saturation"
+                p99s.append(p99)
+            else:
+                row += " - | - |"
+                p99s.append(None)
+            for key in depth_keys:
+                depth = result.queue_depth.get(key)
+                row += f" {depth.mean():.1f} |" if depth is not None and depth.count() else " - |"
+            row += f" {result.summary.events_per_second:.1f}/s |"
+            lines.append(row)
+        lines.append("")
+
+        observations = []
+        for i in range(1, len(p99s)):
+            if p99s[i] is not None and p99s[i - 1] not in (None, 0) and p99s[i] > p99s[i - 1] * 5:
+                observations.append(
+                    f"- System saturates between {self.parameter_name}="
+                    f"{self.parameter_values[i - 1]} and {self.parameter_name}="
+                    f"{self.parameter_values[i]}"
+                )
+                observations.append(
+                    f"- At {self.parameter_name}={self.parameter_values[i]}, "
+                    f"p99 latency increases {p99s[i] / p99s[i - 1]:.0f}x"
+                )
+                break
+        if observations:
+            lines.append("## Observations")
+            lines.extend(observations)
+            lines.append("")
+        return "\n".join(lines)

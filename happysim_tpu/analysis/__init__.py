@@ -1,0 +1,33 @@
+"""Structured post-run analysis: phases, anomalies, causal chains.
+
+Parity target: ``happysimulator/analysis/`` (``detect_phases``
+:phases.py:46, ``analyze`` :report.py:202, ``trace_event_lifecycle``
+:trace_analysis.py:66).
+"""
+
+from happysim_tpu.analysis.phases import Phase, detect_phases
+from happysim_tpu.analysis.report import (
+    Anomaly,
+    CausalChain,
+    MetricSummary,
+    SimulationAnalysis,
+    analyze,
+)
+from happysim_tpu.analysis.trace_analysis import (
+    EventLifecycle,
+    list_event_lifecycles,
+    trace_event_lifecycle,
+)
+
+__all__ = [
+    "Anomaly",
+    "CausalChain",
+    "EventLifecycle",
+    "MetricSummary",
+    "Phase",
+    "SimulationAnalysis",
+    "analyze",
+    "detect_phases",
+    "list_event_lifecycles",
+    "trace_event_lifecycle",
+]

@@ -1,0 +1,122 @@
+"""Regime/phase detection over metric time series.
+
+Parity target: ``happysimulator/analysis/phases.py:46`` (``detect_phases``)
+— window the series, track the running phase mean, and split wherever a
+window deviates by more than ``threshold`` effective standard deviations.
+Labels classify each phase's mean against the first window's baseline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from happysim_tpu.instrumentation.data import Data
+
+# Mean/baseline ratio boundaries for labels.
+_STABLE_BELOW = 1.5
+_DEGRADED_BELOW = 3.0
+# Effective std floor, as a fraction of the phase mean (keeps near-constant
+# phases from flagging every tiny wiggle as a transition).
+_STD_FLOOR_FRACTION = 0.1
+
+
+@dataclass
+class Phase:
+    """One contiguous regime in a metric's history."""
+
+    start_s: float
+    end_s: float
+    mean: float
+    std: float
+    label: str  # "stable" | "degraded" | "overloaded"
+
+    @property
+    def duration_s(self) -> float:
+        return self.end_s - self.start_s
+
+    def to_dict(self) -> dict:
+        return {
+            "start_s": self.start_s,
+            "end_s": self.end_s,
+            "duration_s": self.duration_s,
+            "mean": self.mean,
+            "std": self.std,
+            "label": self.label,
+        }
+
+
+def _label_for(mean: float, baseline: float) -> str:
+    if baseline == 0:
+        return "stable" if mean == 0 else "degraded"
+    ratio = mean / baseline
+    if ratio < _STABLE_BELOW:
+        return "stable"
+    if ratio < _DEGRADED_BELOW:
+        return "degraded"
+    return "overloaded"
+
+
+def _pstdev(values: list[float]) -> float:
+    if len(values) < 2:
+        return 0.0
+    mean = sum(values) / len(values)
+    return (sum((v - mean) ** 2 for v in values) / len(values)) ** 0.5
+
+
+def detect_phases(
+    data: "Data",
+    window_s: float = 5.0,
+    threshold: float = 2.0,
+) -> list[Phase]:
+    """Change-point detection: windows that shift > ``threshold`` effective
+    stds from the running phase mean start a new phase."""
+    if data.count() < 2:
+        return []
+    bucketed = data.bucket(window_s)
+    if len(bucketed) == 0:
+        return []
+    times = [start.to_seconds() for start in bucketed.starts]
+    means = bucketed.means
+    baseline = means[0]
+
+    if len(times) < 2:
+        return [
+            Phase(
+                start_s=times[0],
+                end_s=times[0] + window_s,
+                mean=means[0],
+                std=0.0,
+                label=_label_for(means[0], baseline),
+            )
+        ]
+
+    def close(start_index: int, end_s: float, values: list[float]) -> Phase:
+        mean = sum(values) / len(values)
+        return Phase(
+            start_s=times[start_index],
+            end_s=end_s,
+            mean=mean,
+            std=_pstdev(values),
+            label=_label_for(mean, baseline),
+        )
+
+    phases: list[Phase] = []
+    phase_start = 0
+    phase_values = [means[0]]
+    for i in range(1, len(means)):
+        phase_mean = sum(phase_values) / len(phase_values)
+        effective_std = (
+            max(_pstdev(phase_values), abs(phase_mean) * _STD_FLOOR_FRACTION)
+            if phase_mean != 0
+            else 1.0
+        )
+        if abs(means[i] - phase_mean) / effective_std > threshold:
+            phases.append(close(phase_start, times[i], phase_values))
+            phase_start = i
+            phase_values = [means[i]]
+        else:
+            phase_values.append(means[i])
+    phases.append(close(phase_start, times[-1] + window_s, phase_values))
+    return phases

@@ -1,0 +1,367 @@
+"""Full analysis pipeline: phases + anomalies + causal chains + summaries.
+
+Parity target: ``happysimulator/analysis/report.py`` (``analyze`` :202,
+``SimulationAnalysis``/``MetricSummary``/``Anomaly``/``CausalChain``
+:24-91; 15s causal correlation window :15). House extension: ``analyze``
+also accepts the TPU executor's :class:`EnsembleResult` directly — its
+aggregate summary and histogram-backed latency data feed the same
+pipeline, so both backends produce the same analysis shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Optional, Union
+
+from happysim_tpu.analysis.phases import Phase, detect_phases
+
+if TYPE_CHECKING:
+    from happysim_tpu.instrumentation.data import Data
+    from happysim_tpu.instrumentation.summary import SimulationSummary
+    from happysim_tpu.tpu.engine import EnsembleResult
+
+# Phase transitions within this offset across metrics are treated as one
+# causal episode (queue buildup -> latency, etc.).
+_CAUSAL_WINDOW_S = 15.0
+
+
+@dataclass
+class MetricSummary:
+    """Descriptive statistics for one named metric."""
+
+    name: str
+    count: int
+    mean: float
+    std: float
+    min: float
+    max: float
+    p50: float
+    p95: float
+    p99: float
+    by_phase: list[dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {
+            "name": self.name,
+            "count": self.count,
+            "mean": round(self.mean, 6),
+            "std": round(self.std, 6),
+            "min": round(self.min, 6),
+            "max": round(self.max, 6),
+            "p50": round(self.p50, 6),
+            "p95": round(self.p95, 6),
+            "p99": round(self.p99, 6),
+        }
+        if self.by_phase:
+            out["by_phase"] = self.by_phase
+        return out
+
+
+@dataclass
+class Anomaly:
+    time_s: float
+    metric: str
+    description: str
+    severity: str  # "info" | "warning" | "critical"
+    context: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "time_s": round(self.time_s, 3),
+            "metric": self.metric,
+            "description": self.description,
+            "severity": self.severity,
+            "context": self.context,
+        }
+
+
+@dataclass
+class CausalChain:
+    trigger_description: str
+    effects: list[str]
+    duration_s: float
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "trigger": self.trigger_description,
+            "effects": self.effects,
+            "duration_s": round(self.duration_s, 3),
+        }
+
+
+@dataclass
+class SimulationAnalysis:
+    """Everything the analyzer found, formatted for humans and LLMs."""
+
+    summary: "SimulationSummary"
+    phases: dict[str, list[Phase]] = field(default_factory=dict)
+    metrics: dict[str, MetricSummary] = field(default_factory=dict)
+    anomalies: list[Anomaly] = field(default_factory=list)
+    causal_chains: list[CausalChain] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "summary": self.summary.to_dict(),
+            "phases": {
+                name: [p.to_dict() for p in phases]
+                for name, phases in self.phases.items()
+            },
+            "metrics": {name: m.to_dict() for name, m in self.metrics.items()},
+            "anomalies": [a.to_dict() for a in self.anomalies],
+            "causal_chains": [c.to_dict() for c in self.causal_chains],
+        }
+
+    def to_prompt_context(self, max_tokens: int = 2000) -> str:
+        """Compact structured text for an LLM prompt (~4 chars/token budget).
+
+        Anomalies and causal chains always make the cut; metric and
+        entity tables are appended only while budget remains.
+        """
+        max_chars = max_tokens * 4
+        sections = [
+            "## Simulation Summary",
+            f"- Duration: {self.summary.simulated_seconds:.2f}s",
+            f"- Events processed: {self.summary.events_processed}",
+            f"- Events/sec: {self.summary.events_per_second:.1f}",
+            f"- Wall clock: {self.summary.wall_clock_seconds:.3f}s",
+            f"- Backend: {self.summary.backend} (replicas={self.summary.replicas})",
+            "",
+        ]
+        if self.anomalies:
+            sections.append("## Anomalies Detected")
+            sections.extend(
+                f"- [{a.severity}] t={a.time_s:.1f}s: {a.description}"
+                for a in self.anomalies
+            )
+            sections.append("")
+        if self.causal_chains:
+            sections.append("## Causal Chains")
+            for chain in self.causal_chains:
+                sections.append(f"- Trigger: {chain.trigger_description}")
+                sections.extend(f"  -> {effect}" for effect in chain.effects)
+                sections.append(f"  Duration: {chain.duration_s:.1f}s")
+            sections.append("")
+        if self.phases:
+            sections.append("## Phase Analysis")
+            for metric_name, phases in self.phases.items():
+                sections.append(f"### {metric_name}")
+                sections.extend(
+                    f"- [{p.label}] {p.start_s:.1f}s-{p.end_s:.1f}s: "
+                    f"mean={p.mean:.4f}, std={p.std:.4f}"
+                    for p in phases
+                )
+            sections.append("")
+
+        def append_if_fits(lines: list[str]) -> None:
+            if len("\n".join(sections)) + len("\n".join(lines)) < max_chars:
+                sections.extend(lines)
+
+        if self.metrics:
+            metric_lines = ["## Metrics"]
+            for name, m in self.metrics.items():
+                metric_lines.append(
+                    f"- {name}: mean={m.mean:.4f}, p50={m.p50:.4f}, "
+                    f"p95={m.p95:.4f}, p99={m.p99:.4f}, n={m.count}"
+                )
+                metric_lines.extend(
+                    f"    [{row.get('label', '?')}] mean={row.get('mean', 0):.4f}"
+                    for row in m.by_phase
+                )
+            metric_lines.append("")
+            append_if_fits(metric_lines)
+        if self.summary.entities:
+            entity_lines = ["## Entities"]
+            for entity in self.summary.entities:
+                line = f"- {entity.name} ({entity.kind})"
+                if entity.events_received is not None:
+                    line += f": {entity.events_received} events"
+                entity_lines.append(line)
+            entity_lines.append("")
+            append_if_fits(entity_lines)
+
+        text = "\n".join(sections)
+        if len(text) > max_chars:
+            text = text[: max_chars - 20] + "\n\n[truncated]"
+        return text
+
+
+def _ensemble_latency_data(result: "EnsembleResult") -> "Optional[Data]":
+    """Synthesize a latency Data series from the ensemble's sink histogram.
+
+    Bin centers weighted by counts — percentile/mean queries behave like
+    the host path's sample series (within histogram resolution).
+    """
+    import numpy as np
+
+    from happysim_tpu.instrumentation.data import Data
+    from happysim_tpu.tpu.engine import HIST_BINS, HIST_DECADES, HIST_LO_LOG10
+
+    if result.sink_hist is None or not len(result.sink_hist):
+        return None
+    hist = np.asarray(result.sink_hist).sum(axis=0).astype(np.int64)
+    total = int(hist.sum())
+    if total == 0:
+        return Data("ensemble.latency_s")
+    centers = 10 ** (
+        HIST_LO_LOG10 + (np.arange(HIST_BINS) + 0.5) / HIST_BINS * HIST_DECADES
+    )
+    # Cap the synthesized series so giant ensembles don't materialize
+    # billions of points: scale counts down proportionally (keeping at
+    # least one sample per occupied bin so the tail survives).
+    scale = max(1, total // 100_000)
+    counts = np.where(hist > 0, np.maximum(hist // scale, 1), 0)
+    values = np.repeat(centers, counts)
+    # Deterministic shuffle: the histogram has no time axis, so leaving
+    # values bin-ordered would fabricate a rising trend (and phony phase
+    # transitions) over the synthetic timeline.
+    values = np.random.default_rng(0).permutation(values)
+    times = np.linspace(0.0, result.horizon_s, num=len(values))
+    return Data.from_arrays(times, values, name="ensemble.latency_s")
+
+
+def analyze(
+    summary: "Union[SimulationSummary, EnsembleResult]",
+    latency: "Optional[Data]" = None,
+    queue_depth: "Optional[Data]" = None,
+    throughput: "Optional[Data]" = None,
+    phase_window_s: float = 5.0,
+    phase_threshold: float = 2.0,
+    anomaly_threshold: float = 3.0,
+    **named_metrics: "Data",
+) -> SimulationAnalysis:
+    """Run the full pipeline over any combination of metric series.
+
+    ``summary`` may be a host ``SimulationSummary`` or a TPU
+    ``EnsembleResult`` (whose sink histogram becomes the latency metric
+    when none is passed explicitly).
+    """
+    # Duck-typed EnsembleResult check (callable .summary + sink_hist):
+    # keeps the pure-host path from importing jax via tpu.engine.
+    if callable(getattr(summary, "summary", None)) and hasattr(summary, "sink_hist"):
+        if latency is None:
+            latency = _ensemble_latency_data(summary)
+        summary = summary.summary()
+
+    metrics: dict[str, Data] = {}
+    if latency is not None:
+        metrics["latency"] = latency
+    if queue_depth is not None:
+        metrics["queue_depth"] = queue_depth
+    if throughput is not None:
+        metrics["throughput"] = throughput
+    metrics.update(named_metrics)
+
+    phases: dict[str, list[Phase]] = {}
+    for name, data in metrics.items():
+        detected = detect_phases(data, window_s=phase_window_s, threshold=phase_threshold)
+        if detected:
+            phases[name] = detected
+
+    metric_summaries: dict[str, MetricSummary] = {}
+    for name, data in metrics.items():
+        if data.count() == 0:
+            continue
+        by_phase: list[dict[str, Any]] = []
+        for phase in phases.get(name, []):
+            window = data.between(phase.start_s, phase.end_s)
+            if window.count() > 0:
+                by_phase.append(
+                    {
+                        "label": phase.label,
+                        "start_s": phase.start_s,
+                        "end_s": phase.end_s,
+                        "mean": window.mean(),
+                        "p50": window.percentile(50),
+                        "p99": window.percentile(99),
+                    }
+                )
+        metric_summaries[name] = MetricSummary(
+            name=name,
+            count=data.count(),
+            mean=data.mean(),
+            std=data.std(),
+            min=data.min(),
+            max=data.max(),
+            p50=data.percentile(50),
+            p95=data.percentile(95),
+            p99=data.percentile(99),
+            by_phase=by_phase,
+        )
+
+    anomalies = _detect_anomalies(metrics, anomaly_threshold)
+    causal_chains = _detect_causal_chains(phases)
+    return SimulationAnalysis(
+        summary=summary,
+        phases=phases,
+        metrics=metric_summaries,
+        anomalies=anomalies,
+        causal_chains=causal_chains,
+    )
+
+
+def _detect_anomalies(metrics: "dict[str, Data]", threshold: float) -> list[Anomaly]:
+    """Windows whose mean sits far from the series mean, in series stds."""
+    anomalies: list[Anomaly] = []
+    for name, data in metrics.items():
+        if data.count() < 10:
+            continue
+        overall_mean = data.mean()
+        overall_std = data.std()
+        if overall_std == 0:
+            continue
+        bucketed = data.bucket(5.0)
+        for start, window_mean in zip(bucketed.starts, bucketed.means):
+            deviation = abs(window_mean - overall_mean) / overall_std
+            if deviation > threshold:
+                anomalies.append(
+                    Anomaly(
+                        time_s=start.to_seconds(),
+                        metric=name,
+                        description=(
+                            f"{name} at t={start.to_seconds():.1f}s: "
+                            f"mean={window_mean:.4f} ({deviation:.1f}x std from "
+                            f"overall mean {overall_mean:.4f})"
+                        ),
+                        severity="critical" if deviation > threshold * 2 else "warning",
+                        context={
+                            "window_mean": round(window_mean, 6),
+                            "overall_mean": round(overall_mean, 6),
+                            "overall_std": round(overall_std, 6),
+                            "deviation_stds": round(deviation, 2),
+                        },
+                    )
+                )
+    anomalies.sort(key=lambda a: a.time_s)
+    return anomalies
+
+
+def _detect_causal_chains(phases: dict[str, list[Phase]]) -> list[CausalChain]:
+    """Correlate near-simultaneous degradations (queue buildup -> latency)."""
+    chains: list[CausalChain] = []
+    queue_phases = phases.get("queue_depth", [])
+    latency_phases = phases.get("latency", [])
+    for queue_phase in queue_phases:
+        if queue_phase.label not in ("degraded", "overloaded"):
+            continue
+        for latency_phase in latency_phases:
+            if latency_phase.label not in ("degraded", "overloaded"):
+                continue
+            if abs(queue_phase.start_s - latency_phase.start_s) < _CAUSAL_WINDOW_S:
+                start = min(queue_phase.start_s, latency_phase.start_s)
+                end = max(queue_phase.end_s, latency_phase.end_s)
+                chains.append(
+                    CausalChain(
+                        trigger_description=(
+                            f"System degradation starting at t={start:.1f}s"
+                        ),
+                        effects=[
+                            f"Queue depth entered '{queue_phase.label}' state "
+                            f"(mean={queue_phase.mean:.2f})",
+                            f"Latency entered '{latency_phase.label}' state "
+                            f"(mean={latency_phase.mean:.4f}s)",
+                        ],
+                        duration_s=end - start,
+                    )
+                )
+                break
+    return chains

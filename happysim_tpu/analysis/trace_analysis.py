@@ -1,0 +1,116 @@
+"""Event lifecycle reconstruction from engine trace records.
+
+Parity target: ``happysimulator/analysis/trace_analysis.py:66``
+(``trace_event_lifecycle``/``list_event_lifecycles``) — stitches
+``simulation.schedule``/``simulation.dequeue`` spans from an
+:class:`InMemoryTraceRecorder` into per-event timing views.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Optional
+
+if TYPE_CHECKING:
+    from happysim_tpu.core.temporal import Duration, Instant
+    from happysim_tpu.instrumentation.recorder import InMemoryTraceRecorder
+
+
+@dataclass
+class EventLifecycle:
+    """Timing of one event: scheduled -> dequeued (+ spawned children)."""
+
+    event_id: int
+    event_type: Optional[str] = None
+    scheduled_at: Optional["Instant"] = None
+    dequeued_at: Optional["Instant"] = None
+    child_event_ids: list[int] = field(default_factory=list)
+
+    @property
+    def wait_time(self) -> Optional["Duration"]:
+        if self.scheduled_at is not None and self.dequeued_at is not None:
+            return self.dequeued_at - self.scheduled_at
+        return None
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"event_id": self.event_id}
+        if self.event_type:
+            out["event_type"] = self.event_type
+        if self.scheduled_at is not None:
+            out["scheduled_at_s"] = self.scheduled_at.to_seconds()
+        if self.dequeued_at is not None:
+            out["dequeued_at_s"] = self.dequeued_at.to_seconds()
+        if self.wait_time is not None:
+            out["wait_time_s"] = self.wait_time.to_seconds()
+        if self.child_event_ids:
+            out["children"] = list(self.child_event_ids)
+        return out
+
+    def __str__(self) -> str:
+        lines = [f"Event {self.event_id}" + (f" ({self.event_type})" if self.event_type else "")]
+        if self.scheduled_at is not None:
+            lines.append(f"  scheduled: {self.scheduled_at}")
+        if self.dequeued_at is not None:
+            lines.append(f"  dequeued:  {self.dequeued_at}")
+        if self.wait_time is not None:
+            lines.append(f"  wait:      {self.wait_time}")
+        if self.child_event_ids:
+            lines.append(f"  children:  {len(self.child_event_ids)}")
+        return "\n".join(lines)
+
+
+def _build_lifecycles(
+    recorder: "InMemoryTraceRecorder",
+) -> dict[int, EventLifecycle]:
+    """One O(n) pass grouping schedule/dequeue spans by event id.
+
+    Children come from the ``parent_id`` the loop records with every
+    schedule span — exact attribution, not same-timestamp guessing.
+    """
+    lifecycles: dict[int, EventLifecycle] = {}
+
+    def lifecycle_for(event_id: int) -> EventLifecycle:
+        lifecycle = lifecycles.get(event_id)
+        if lifecycle is None:
+            lifecycle = lifecycles[event_id] = EventLifecycle(event_id=event_id)
+        return lifecycle
+
+    for span in recorder.records:
+        if span.event_id is None:
+            continue
+        if span.kind == "simulation.schedule":
+            lifecycle = lifecycle_for(span.event_id)
+            lifecycle.scheduled_at = span.time
+            lifecycle.event_type = lifecycle.event_type or span.event_type
+            parent_id = span.data.get("parent_id")
+            if parent_id is not None:
+                lifecycle_for(parent_id).child_event_ids.append(span.event_id)
+        elif span.kind == "simulation.dequeue":
+            lifecycle = lifecycle_for(span.event_id)
+            lifecycle.dequeued_at = span.time
+            lifecycle.event_type = lifecycle.event_type or span.event_type
+    return lifecycles
+
+
+def trace_event_lifecycle(
+    recorder: "InMemoryTraceRecorder", event_id: int
+) -> Optional[EventLifecycle]:
+    """Rebuild one event's lifecycle; None if the id never appears."""
+    lifecycle = _build_lifecycles(recorder).get(event_id)
+    if lifecycle is None:
+        return None
+    if lifecycle.scheduled_at is None and lifecycle.dequeued_at is None:
+        return None  # id only appeared as someone's parent reference
+    return lifecycle
+
+
+def list_event_lifecycles(
+    recorder: "InMemoryTraceRecorder", event_type: Optional[str] = None
+) -> list[EventLifecycle]:
+    """Lifecycles for every traced event, optionally filtered by type."""
+    return [
+        lifecycle
+        for lifecycle in _build_lifecycles(recorder).values()
+        if (lifecycle.scheduled_at is not None or lifecycle.dequeued_at is not None)
+        and (event_type is None or lifecycle.event_type == event_type)
+    ]

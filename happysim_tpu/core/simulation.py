@@ -280,7 +280,12 @@ class Simulation:
                     new_events = router(new_events)
                 for produced in new_events:
                     if recorder is not None:
-                        recorder.record("simulation.schedule", time=clock.now, event=produced)
+                        recorder.record(
+                            "simulation.schedule",
+                            time=clock.now,
+                            event=produced,
+                            data={"parent_id": event._id},
+                        )
                 heap.push(new_events)
             if control is not None:
                 control._after_event(event, time_advanced)

@@ -23,6 +23,11 @@ class LatencyTracker(Entity):
         self.latencies = Data(f"{name}.latency_s")
         self.events_received = 0
 
+    @property
+    def data(self) -> Data:
+        """Alias for :attr:`latencies` (reference-API parity)."""
+        return self.latencies
+
     def handle_event(self, event: Event):
         self.events_received += 1
         created_at = event.context.get("created_at")

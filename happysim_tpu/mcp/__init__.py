@@ -1,0 +1,23 @@
+"""MCP integration: canned simulations as LLM-callable tools.
+
+Parity target: ``happysimulator/mcp/`` (server :31, tools :23,58).
+"""
+
+from happysim_tpu.mcp.server import TOOLS, call_tool, handle_request, serve
+from happysim_tpu.mcp.tools import (
+    format_distributions,
+    format_response,
+    run_pipeline_simulation,
+    run_queue_simulation,
+)
+
+__all__ = [
+    "TOOLS",
+    "call_tool",
+    "format_distributions",
+    "format_response",
+    "handle_request",
+    "run_pipeline_simulation",
+    "run_queue_simulation",
+    "serve",
+]

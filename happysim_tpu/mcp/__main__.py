@@ -1,0 +1,6 @@
+"""``python -m happysim_tpu.mcp`` — stdio MCP server."""
+
+from happysim_tpu.mcp.server import serve
+
+if __name__ == "__main__":
+    serve()

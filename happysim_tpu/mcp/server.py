@@ -1,0 +1,200 @@
+"""MCP server exposing the simulator as LLM-callable tools.
+
+Parity target: ``happysimulator/mcp/server.py:31,225,337``. The reference
+depends on the ``mcp`` SDK; this implementation speaks the MCP stdio
+protocol (JSON-RPC 2.0: ``initialize``, ``tools/list``, ``tools/call``)
+directly, so it has zero dependencies beyond the standard library.
+
+Usage::
+
+    python -m happysim_tpu.mcp
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, BinaryIO, Optional
+
+from happysim_tpu.mcp.tools import (
+    format_distributions,
+    format_response,
+    run_pipeline_simulation,
+    run_queue_simulation,
+)
+
+PROTOCOL_VERSION = "2024-11-05"
+SERVER_INFO = {"name": "happysim_tpu", "version": "0.4.0"}
+
+TOOLS: list[dict[str, Any]] = [
+    {
+        "name": "simulate_queue",
+        "description": (
+            "Run an M/M/1 or M/M/c queue simulation. Models a server pool "
+            "with exponential service times and Poisson arrivals. Returns "
+            "latency, queue depth, and throughput analysis with "
+            "recommendations. Set backend='tpu' to run a Monte-Carlo "
+            "ensemble on the compiled TPU engine."
+        ),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "arrival_rate": {
+                    "type": "number",
+                    "description": "Mean arrivals per second (Poisson)",
+                },
+                "service_rate": {
+                    "type": "number",
+                    "description": "Mean completions per second per server",
+                },
+                "servers": {
+                    "type": "integer",
+                    "description": "Number of servers (default 1 for M/M/1)",
+                    "default": 1,
+                },
+                "duration": {
+                    "type": "number",
+                    "description": "Simulation duration in seconds (default 100)",
+                    "default": 100,
+                },
+                "seed": {
+                    "type": "integer",
+                    "description": "Random seed for reproducibility (optional)",
+                },
+                "backend": {
+                    "type": "string",
+                    "enum": ["python", "tpu"],
+                    "description": "Executor: single host run or TPU ensemble",
+                    "default": "python",
+                },
+            },
+            "required": ["arrival_rate", "service_rate"],
+        },
+    },
+    {
+        "name": "simulate_pipeline",
+        "description": (
+            "Run a multi-stage pipeline simulation. Each stage is a server "
+            "with configurable concurrency and service time. Returns "
+            "per-stage queue depth and end-to-end latency analysis."
+        ),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "stages": {
+                    "type": "array",
+                    "description": "Pipeline stages in order",
+                    "items": {
+                        "type": "object",
+                        "properties": {
+                            "name": {"type": "string"},
+                            "concurrency": {"type": "integer", "default": 1},
+                            "service_time": {
+                                "type": "number",
+                                "description": "Mean service time in seconds",
+                            },
+                        },
+                        "required": ["name", "service_time"],
+                    },
+                },
+                "source_rate": {
+                    "type": "number",
+                    "description": "Arrival rate in events/sec",
+                },
+                "duration": {
+                    "type": "number",
+                    "description": "Simulation duration in seconds (default 100)",
+                    "default": 100,
+                },
+                "seed": {
+                    "type": "integer",
+                    "description": "Random seed for reproducibility (optional)",
+                },
+                "poisson": {
+                    "type": "boolean",
+                    "description": "Use Poisson arrivals (default true)",
+                    "default": True,
+                },
+            },
+            "required": ["stages", "source_rate"],
+        },
+    },
+    {
+        "name": "list_distributions",
+        "description": "List the available service-time distributions.",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+]
+
+
+def call_tool(name: str, arguments: dict[str, Any]) -> str:
+    """Dispatch one tool call; returns the tool's text payload."""
+    if name == "simulate_queue":
+        return format_response(run_queue_simulation(**arguments))
+    if name == "simulate_pipeline":
+        return format_response(run_pipeline_simulation(**arguments))
+    if name == "list_distributions":
+        return format_distributions()
+    raise ValueError(f"unknown tool: {name}")
+
+
+def handle_request(request: dict[str, Any]) -> Optional[dict[str, Any]]:
+    """One JSON-RPC request -> response dict (None for notifications)."""
+    method = request.get("method")
+    request_id = request.get("id")
+    if request_id is None:
+        return None  # notification (e.g. notifications/initialized)
+
+    def ok(result: Any) -> dict[str, Any]:
+        return {"jsonrpc": "2.0", "id": request_id, "result": result}
+
+    def error(code: int, message: str) -> dict[str, Any]:
+        return {
+            "jsonrpc": "2.0",
+            "id": request_id,
+            "error": {"code": code, "message": message},
+        }
+
+    if method == "initialize":
+        return ok(
+            {
+                "protocolVersion": PROTOCOL_VERSION,
+                "capabilities": {"tools": {}},
+                "serverInfo": SERVER_INFO,
+            }
+        )
+    if method == "tools/list":
+        return ok({"tools": TOOLS})
+    if method == "tools/call":
+        params = request.get("params", {})
+        try:
+            text = call_tool(params.get("name", ""), params.get("arguments", {}))
+            return ok({"content": [{"type": "text", "text": text}]})
+        except Exception as exc:  # tool errors flow back in-band
+            return ok(
+                {
+                    "content": [{"type": "text", "text": f"error: {exc}"}],
+                    "isError": True,
+                }
+            )
+    if method == "ping":
+        return ok({})
+    return error(-32601, f"method not found: {method}")
+
+
+def serve(stdin: Optional[BinaryIO] = None, stdout: Optional[BinaryIO] = None) -> None:
+    """Blocking stdio loop: newline-delimited JSON-RPC (MCP stdio framing)."""
+    stdin = stdin or sys.stdin.buffer
+    stdout = stdout or sys.stdout.buffer
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            request = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        response = handle_request(request)
+        if response is not None:
+            stdout.write(json.dumps(response, default=str).encode() + b"\n")
+            stdout.flush()

@@ -1,0 +1,185 @@
+"""Canned simulations behind the MCP tools.
+
+Parity target: ``happysimulator/mcp/tools.py:23,58``
+(``run_queue_simulation``/``run_pipeline_simulation``). House extension:
+``backend="tpu"`` routes the M/M/c case through the compiled ensemble
+engine (thousands of Monte-Carlo replicas in one XLA program) and feeds
+the same :class:`SimulationResult` shape.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from happysim_tpu.ai.result import SimulationResult
+from happysim_tpu.components.server import Server
+from happysim_tpu.core.simulation import Simulation
+from happysim_tpu.distributions.latency_distribution import ExponentialLatency
+from happysim_tpu.instrumentation.collectors import LatencyTracker
+from happysim_tpu.instrumentation.probe import Probe
+from happysim_tpu.load.source import Source
+
+
+def run_queue_simulation(
+    arrival_rate: float,
+    service_rate: float,
+    servers: int = 1,
+    duration: float = 100.0,
+    seed: Optional[int] = None,
+    backend: str = "python",
+    n_replicas: int = 8192,
+    queue_capacity: Optional[int] = None,
+) -> SimulationResult:
+    """M/M/1 or M/M/c on either executor.
+
+    ``backend="python"`` runs one instrumented host simulation;
+    ``backend="tpu"`` runs an ``n_replicas`` Monte-Carlo ensemble on the
+    compiled engine (latency analysis from the on-device histogram).
+
+    ``queue_capacity`` bounds the server queue on BOTH backends so they
+    model the same system. When omitted, the host queue is unbounded and
+    the TPU path uses its 4096-slot arrays — an overloaded workload can
+    then drop on TPU but not on host; pass an explicit capacity when
+    comparing saturated systems across backends.
+    """
+    if backend == "tpu":
+        from happysim_tpu.tpu import run_ensemble
+        from happysim_tpu.tpu.model import EnsembleModel
+
+        model = EnsembleModel(horizon_s=duration, warmup_s=min(duration / 4, 40.0))
+        source = model.source(rate=arrival_rate, kind="poisson")
+        server = model.server(
+            concurrency=servers,
+            service_mean=1.0 / service_rate,
+            queue_capacity=queue_capacity or 4096,
+        )
+        sink = model.sink()
+        model.connect(source, server)
+        model.connect(server, sink)
+        result = run_ensemble(model, n_replicas=n_replicas, seed=seed or 0)
+        return SimulationResult.from_run(result)
+
+    tracker = LatencyTracker("Sink")
+    # Distinct seeds per stream: sharing one seed gives the arrival and
+    # service processes IDENTICAL RNG sequences, which correlates them and
+    # systematically understates queueing delay (~2x at rho=0.8).
+    server_entity = Server(
+        "Server",
+        concurrency=servers,
+        service_time=ExponentialLatency(
+            1.0 / service_rate, seed=None if seed is None else seed * 2 + 1
+        ),
+        queue_capacity=queue_capacity,
+        downstream=tracker,
+    )
+    source = Source.poisson(
+        rate=arrival_rate, target=server_entity, seed=seed
+    )
+    probe = Probe.on(server_entity, "queue_depth", interval_s=0.5)
+    summary = Simulation(
+        duration=duration,
+        sources=[source],
+        entities=[server_entity, tracker],
+        probes=[probe],
+    ).run()
+    return SimulationResult.from_run(
+        summary,
+        latency=tracker.data,
+        queue_depth={"Server": probe.data},
+    )
+
+
+def run_pipeline_simulation(
+    stages: list[dict[str, Any]],
+    source_rate: float,
+    duration: float = 100.0,
+    seed: Optional[int] = None,
+    poisson: bool = True,
+) -> SimulationResult:
+    """A chain of servers; per-stage depth probes + end-to-end latency."""
+    tracker = LatencyTracker("Sink")
+    entities: list[Any] = [tracker]
+    probes = []
+    depth_data: dict[str, Any] = {}
+    downstream: Any = tracker
+    for index, stage in enumerate(reversed(stages)):
+        name = stage.get("name", f"Server{len(stages) - 1 - index}")
+        server = Server(
+            name,
+            concurrency=stage.get("concurrency", 1),
+            # Offset stage seeds away from the source's seed (sharing a
+            # seed correlates the streams and biases queueing delay).
+            service_time=ExponentialLatency(
+                stage.get("service_time", 0.01),
+                seed=None if seed is None else seed * 2 + 1 + index,
+            ),
+            downstream=downstream,
+        )
+        probe = Probe.on(server, "queue_depth", interval_s=0.5)
+        probes.append(probe)
+        depth_data[name] = probe.data
+        entities.append(server)
+        downstream = server
+    if poisson:
+        source = Source.poisson(rate=source_rate, target=downstream, seed=seed)
+    else:
+        source = Source.constant(rate=source_rate, target=downstream)
+    summary = Simulation(
+        duration=duration,
+        sources=[source],
+        entities=entities,
+        probes=probes,
+    ).run()
+    # Stages were built back-to-front; report depths in pipeline order.
+    depth_data = dict(reversed(list(depth_data.items())))
+    return SimulationResult.from_run(
+        summary, latency=tracker.data, queue_depth=depth_data
+    )
+
+
+def format_response(result: SimulationResult) -> str:
+    """JSON envelope with both the prompt text and the structured data."""
+    return json.dumps(
+        {"prompt_context": result.to_prompt_context(), "data": result.to_dict()},
+        indent=2,
+        default=str,
+    )
+
+
+DISTRIBUTIONS_INFO = [
+    {
+        "name": "ConstantLatency",
+        "description": "Fixed service time",
+        "parameters": {"latency_s": "Service time in seconds"},
+        "example": "ConstantLatency(0.01) -> always 10ms",
+    },
+    {
+        "name": "ExponentialLatency",
+        "description": "Exponentially distributed service time (memoryless)",
+        "parameters": {"mean_s": "Mean service time in seconds"},
+        "example": "ExponentialLatency(0.1) -> mean 100ms",
+    },
+    {
+        "name": "UniformValueDistribution",
+        "description": "Uniformly distributed between min and max",
+        "parameters": {"low": "Minimum value", "high": "Maximum value"},
+        "example": "UniformValueDistribution(0.01, 0.1) -> 10-100ms",
+    },
+    {
+        "name": "PercentileFittedLatency",
+        "description": "Fit a distribution to observed percentile data",
+        "parameters": {"percentiles": "Dict of {percentile: value}"},
+        "example": "PercentileFittedLatency({0.5: 0.01, 0.99: 0.1})",
+    },
+]
+
+
+def format_distributions(distributions: Optional[list[dict]] = None) -> str:
+    """Markdown catalog of service-time distributions."""
+    rows = distributions or DISTRIBUTIONS_INFO
+    lines = ["## Available Service Time Distributions", ""]
+    for row in rows:
+        lines.extend([f"### {row['name']}", row["description"],
+                      f"Example: `{row['example']}`", ""])
+    return "\n".join(lines)

@@ -1,0 +1,296 @@
+"""Unit tests: analysis pipeline (phases/anomalies/causal chains) and the
+AI layer (results, recommendations, comparisons, sweeps, MCP tools)."""
+
+import json
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Event,
+    ExponentialLatency,
+    Instant,
+    Probe,
+    Server,
+    Simulation,
+    SimulationResult,
+    Source,
+    analyze,
+    detect_phases,
+    generate_recommendations,
+    list_event_lifecycles,
+    trace_event_lifecycle,
+)
+from happysim_tpu.instrumentation.collectors import LatencyTracker
+from happysim_tpu.instrumentation.data import Data
+from happysim_tpu.instrumentation.recorder import InMemoryTraceRecorder
+
+
+def series(values_by_window, window_s=5.0, samples_per_window=10):
+    """Data with `samples_per_window` points at each window's level."""
+    data = Data("metric")
+    t = 0.0
+    for level in values_by_window:
+        for _ in range(samples_per_window):
+            data.add(Instant.from_seconds(t), level)
+            t += window_s / samples_per_window
+    return data
+
+
+class TestDetectPhases:
+    def test_constant_series_is_one_stable_phase(self):
+        phases = detect_phases(series([1.0, 1.0, 1.0, 1.0]))
+        assert len(phases) == 1
+        assert phases[0].label == "stable"
+        assert phases[0].mean == pytest.approx(1.0)
+
+    def test_step_change_splits_phases(self):
+        phases = detect_phases(series([1.0, 1.0, 1.0, 10.0, 10.0, 10.0]))
+        assert len(phases) == 2
+        assert phases[0].label == "stable"
+        assert phases[1].label == "overloaded"
+        assert phases[1].start_s == pytest.approx(15.0)
+
+    def test_moderate_rise_is_degraded(self):
+        phases = detect_phases(series([1.0, 1.0, 1.0, 2.0, 2.0, 2.0]))
+        assert len(phases) == 2
+        assert phases[1].label == "degraded"
+
+    def test_empty_and_tiny_data(self):
+        assert detect_phases(Data("empty")) == []
+        single = Data("single")
+        single.add(Instant.from_seconds(0.0), 1.0)
+        assert detect_phases(single) == []
+
+    def test_phase_dict_roundtrip(self):
+        phases = detect_phases(series([1.0, 1.0, 5.0, 5.0]))
+        as_dict = phases[0].to_dict()
+        assert set(as_dict) == {
+            "start_s", "end_s", "duration_s", "mean", "std", "label"
+        }
+
+
+def run_mm1(lam, mu, duration=60.0, seed=7):
+    tracker = LatencyTracker("Sink")
+    server = Server(
+        "Server",
+        service_time=ExponentialLatency(1.0 / mu, seed=seed),
+        downstream=tracker,
+    )
+    source = Source.poisson(rate=lam, target=server, seed=seed)
+    probe = Probe.on(server, "queue_depth", interval_s=0.5)
+    summary = Simulation(
+        duration=duration, sources=[source], entities=[server, tracker], probes=[probe]
+    ).run()
+    return summary, tracker.data, probe.data
+
+
+class TestAnalyze:
+    def test_healthy_mm1_analysis(self):
+        summary, latency, depth = run_mm1(lam=5.0, mu=10.0)
+        analysis = analyze(summary, latency=latency, queue_depth=depth)
+        assert "latency" in analysis.metrics
+        assert analysis.metrics["latency"].count == latency.count()
+        assert analysis.metrics["latency"].mean == pytest.approx(latency.mean())
+
+    def test_deterministic_run_is_one_stable_phase(self):
+        # Constant service + constant arrivals -> flat latency -> stable.
+        tracker = LatencyTracker("Sink")
+        server = Server("Server", service_time=ConstantLatency(0.05), downstream=tracker)
+        source = Source.constant(rate=4.0, target=server)
+        summary = Simulation(
+            duration=60.0, sources=[source], entities=[server, tracker]
+        ).run()
+        analysis = analyze(summary, latency=tracker.data)
+        for phases in analysis.phases.values():
+            assert all(p.label == "stable" for p in phases)
+
+    def test_prompt_context_sections_and_budget(self):
+        summary, latency, depth = run_mm1(lam=5.0, mu=10.0)
+        analysis = analyze(summary, latency=latency, queue_depth=depth)
+        text = analysis.to_prompt_context(max_tokens=2000)
+        assert "## Simulation Summary" in text
+        assert len(text) <= 2000 * 4
+        tiny = analysis.to_prompt_context(max_tokens=100)
+        assert len(tiny) <= 100 * 4
+
+    def test_anomaly_detection_flags_spike(self):
+        data = series([1.0] * 10 + [50.0] + [1.0] * 10)
+        summary, _, _ = run_mm1(lam=1.0, mu=10.0, duration=5.0)
+        analysis = analyze(summary, spiky=data)
+        assert any(a.metric == "spiky" for a in analysis.anomalies)
+        spike = next(a for a in analysis.anomalies if a.metric == "spiky")
+        assert spike.severity in ("warning", "critical")
+
+    def test_causal_chain_queue_then_latency(self):
+        # Both metrics degrade at t=25s: one causal episode.
+        latency = series([0.01] * 5 + [0.2] * 5)
+        depth = series([1.0] * 5 + [40.0] * 5)
+        summary, _, _ = run_mm1(lam=1.0, mu=10.0, duration=5.0)
+        analysis = analyze(summary, latency=latency, queue_depth=depth)
+        assert len(analysis.causal_chains) >= 1
+        chain = analysis.causal_chains[0]
+        assert "degradation" in chain.trigger_description
+        assert len(chain.effects) == 2
+
+
+class TestSimulationResult:
+    def test_from_run_attaches_recommendations(self):
+        summary, latency, depth = run_mm1(lam=9.5, mu=10.0, duration=120.0)
+        result = SimulationResult.from_run(
+            summary, latency=latency, queue_depth={"Server": depth}
+        )
+        assert result.analysis is not None
+        assert isinstance(result.recommendations, list)
+        payload = result.to_dict()
+        assert "summary" in payload and "metrics" in payload
+
+    def test_saturated_system_flagged(self):
+        """The round-trip oracle: rho>1 must produce a saturation warning."""
+        summary, latency, depth = run_mm1(lam=20.0, mu=10.0, duration=120.0)
+        result = SimulationResult.from_run(
+            summary, latency=latency, queue_depth={"Server": depth}
+        )
+        categories = {r.category for r in result.recommendations}
+        assert "capacity" in categories
+        text = result.to_prompt_context()
+        assert "Recommendations" in text
+
+    def test_healthy_underutilized_system_flagged_low(self):
+        summary, latency, depth = run_mm1(lam=0.5, mu=100.0, duration=120.0)
+        result = SimulationResult.from_run(
+            summary, latency=latency, queue_depth={"Server": depth}
+        )
+        assert any(r.confidence == "low" for r in result.recommendations)
+
+    def test_compare_detects_latency_shift(self):
+        summary_a, latency_a, depth_a = run_mm1(lam=5.0, mu=10.0)
+        summary_b, latency_b, depth_b = run_mm1(lam=9.0, mu=10.0)
+        result_a = SimulationResult.from_run(
+            summary_a, latency=latency_a, queue_depth={"Server": depth_a}
+        )
+        result_b = SimulationResult.from_run(
+            summary_b, latency=latency_b, queue_depth={"Server": depth_b}
+        )
+        comparison = result_a.compare(result_b)
+        assert "latency" in comparison.metric_diffs
+        assert comparison.metric_diffs["latency"].mean_b > comparison.metric_diffs["latency"].mean_a
+        text = comparison.to_prompt_context()
+        assert "Simulation Comparison" in text
+
+    def test_sweep_result_best_by_and_saturation(self):
+        from happysim_tpu import SweepResult
+
+        results, values = [], []
+        for lam in (5.0, 8.0, 9.9):
+            summary, latency, depth = run_mm1(lam=lam, mu=10.0, duration=60.0)
+            results.append(
+                SimulationResult.from_run(
+                    summary, latency=latency, queue_depth={"Server": depth}
+                )
+            )
+            values.append(lam)
+        sweep = SweepResult(
+            parameter_name="arrival_rate", parameter_values=values, results=results
+        )
+        best = sweep.best_by("latency", "p99")
+        assert best is results[0]
+        assert "Parameter Sweep" in sweep.to_prompt_context()
+
+
+class TestTraceAnalysis:
+    def test_lifecycle_reconstruction(self):
+        recorder = InMemoryTraceRecorder()
+        tracker = LatencyTracker("Sink")
+        server = Server(
+            "Server", service_time=ConstantLatency(0.05), downstream=tracker
+        )
+        sim = Simulation(
+            duration=1.0,
+            entities=[server, tracker],
+            trace_recorder=recorder,
+        )
+        sim.schedule(Event(Instant.Epoch, "Request", target=server))
+        sim.run()
+        lifecycles = list_event_lifecycles(recorder)
+        assert lifecycles
+        request = next(
+            (lc for lc in lifecycles if lc.event_type == "Request"), None
+        )
+        assert request is not None
+        assert request.dequeued_at is not None
+        assert trace_event_lifecycle(recorder, request.event_id).event_id == request.event_id
+        assert trace_event_lifecycle(recorder, 10**9) is None
+
+
+class TestMCP:
+    def test_run_queue_simulation_tool(self):
+        from happysim_tpu.mcp import run_queue_simulation
+
+        result = run_queue_simulation(
+            arrival_rate=5.0, service_rate=10.0, duration=30.0, seed=3
+        )
+        assert result.latency is not None
+        assert result.latency.count() > 50
+        assert result.summary.events_processed > 0
+
+    def test_run_pipeline_simulation_tool(self):
+        from happysim_tpu.mcp import run_pipeline_simulation
+
+        result = run_pipeline_simulation(
+            stages=[
+                {"name": "web", "service_time": 0.01},
+                {"name": "db", "service_time": 0.02, "concurrency": 2},
+            ],
+            source_rate=10.0,
+            duration=30.0,
+            seed=3,
+        )
+        assert set(result.queue_depth) == {"web", "db"}
+        assert result.latency.count() > 100
+
+    def test_jsonrpc_protocol_round_trip(self):
+        from happysim_tpu.mcp import handle_request
+
+        init = handle_request(
+            {"jsonrpc": "2.0", "id": 1, "method": "initialize", "params": {}}
+        )
+        assert init["result"]["serverInfo"]["name"] == "happysim_tpu"
+        tools = handle_request({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+        names = {t["name"] for t in tools["result"]["tools"]}
+        assert {"simulate_queue", "simulate_pipeline"} <= names
+        call = handle_request(
+            {
+                "jsonrpc": "2.0",
+                "id": 3,
+                "method": "tools/call",
+                "params": {
+                    "name": "simulate_queue",
+                    "arguments": {
+                        "arrival_rate": 4.0,
+                        "service_rate": 10.0,
+                        "duration": 20.0,
+                        "seed": 1,
+                    },
+                },
+            }
+        )
+        payload = json.loads(call["result"]["content"][0]["text"])
+        assert "prompt_context" in payload and "data" in payload
+        # Notifications produce no response; unknown methods error.
+        assert handle_request({"jsonrpc": "2.0", "method": "notifications/initialized"}) is None
+        missing = handle_request({"jsonrpc": "2.0", "id": 4, "method": "nope"})
+        assert missing["error"]["code"] == -32601
+
+    def test_tool_error_flows_in_band(self):
+        from happysim_tpu.mcp import handle_request
+
+        bad = handle_request(
+            {
+                "jsonrpc": "2.0",
+                "id": 5,
+                "method": "tools/call",
+                "params": {"name": "unknown_tool", "arguments": {}},
+            }
+        )
+        assert bad["result"]["isError"] is True
