@@ -32,6 +32,14 @@ def _json_round(value: float, digits: int = 6):
     return round(value, digits) if math.isfinite(value) else None
 
 
+def _fit_budget(text: str, max_tokens: int) -> str:
+    """Truncate to the ~4 chars/token budget every prompt-context honors."""
+    max_chars = max_tokens * 4
+    if len(text) > max_chars:
+        return text[: max_chars - 20] + "\n\n[truncated]"
+    return text
+
+
 @dataclass
 class MetricDiff:
     """One metric's movement between two runs."""
@@ -124,7 +132,7 @@ class SimulationComparison:
             lines.append("## Key Differences")
             lines.extend(highlights)
             lines.append("")
-        return "\n".join(lines)
+        return _fit_budget("\n".join(lines), max_tokens)
 
 
 @dataclass
@@ -196,7 +204,12 @@ class SimulationResult:
         return out
 
     def to_prompt_context(self, max_tokens: int = 2000) -> str:
-        parts = [self.analysis.to_prompt_context(max_tokens=max_tokens)]
+        # Reserve a slice of the budget for recommendations so the
+        # combined text still fits what the caller asked for.
+        analysis_tokens = max_tokens if not self.recommendations else max(
+            max_tokens * 3 // 4, 1
+        )
+        parts = [self.analysis.to_prompt_context(max_tokens=analysis_tokens)]
         if self.recommendations:
             lines = ["## Recommendations"]
             for rec in self.recommendations:
@@ -205,7 +218,7 @@ class SimulationResult:
                     lines.append(f"  Suggested: {rec.suggested_change}")
             lines.append("")
             parts.append("\n".join(lines))
-        return "\n".join(parts)
+        return _fit_budget("\n".join(parts), max_tokens)
 
     def compare(self, other: "SimulationResult") -> SimulationComparison:
         diffs: dict[str, MetricDiff] = {}
@@ -279,11 +292,11 @@ class SweepResult:
         p99s: list[Optional[float]] = []
         for value, result in zip(self.parameter_values, self.results):
             row = f"| {value} |"
+            saturated = False
             if result.latency is not None and result.latency.count() > 0:
                 p99 = result.latency.percentile(99)
                 row += f" {result.latency.mean():.4f}s | {p99:.4f}s |"
-                if p99s and p99s[-1] not in (None, 0) and p99 > p99s[-1] * 5:
-                    row += "  <-- saturation"
+                saturated = bool(p99s and p99s[-1] not in (None, 0) and p99 > p99s[-1] * 5)
                 p99s.append(p99)
             else:
                 row += " - | - |"
@@ -292,6 +305,9 @@ class SweepResult:
                 depth = result.queue_depth.get(key)
                 row += f" {depth.mean():.1f} |" if depth is not None and depth.count() else " - |"
             row += f" {result.summary.events_per_second:.1f}/s |"
+            if saturated:
+                # After the final column, so the table stays well-formed.
+                row += "  <-- saturation"
             lines.append(row)
         lines.append("")
 
@@ -312,4 +328,4 @@ class SweepResult:
             lines.append("## Observations")
             lines.extend(observations)
             lines.append("")
-        return "\n".join(lines)
+        return _fit_budget("\n".join(lines), max_tokens)

@@ -67,6 +67,19 @@ TOOLS: list[dict[str, Any]] = [
                     "description": "Executor: single host run or TPU ensemble",
                     "default": "python",
                 },
+                "queue_capacity": {
+                    "type": "integer",
+                    "description": (
+                        "Bound the server queue on BOTH backends (omit for "
+                        "unbounded host / 4096-slot TPU defaults; set it when "
+                        "comparing saturated systems across backends)"
+                    ),
+                },
+                "n_replicas": {
+                    "type": "integer",
+                    "description": "Monte-Carlo replicas for backend='tpu' (default 8192)",
+                    "default": 8192,
+                },
             },
             "required": ["arrival_rate", "service_rate"],
         },
@@ -138,8 +151,14 @@ def call_tool(name: str, arguments: dict[str, Any]) -> str:
     raise ValueError(f"unknown tool: {name}")
 
 
-def handle_request(request: dict[str, Any]) -> Optional[dict[str, Any]]:
+def handle_request(request: Any) -> Optional[dict[str, Any]]:
     """One JSON-RPC request -> response dict (None for notifications)."""
+    if not isinstance(request, dict):
+        return {
+            "jsonrpc": "2.0",
+            "id": None,
+            "error": {"code": -32600, "message": "request must be a JSON object"},
+        }
     method = request.get("method")
     request_id = request.get("id")
     if request_id is None:
@@ -194,7 +213,15 @@ def serve(stdin: Optional[BinaryIO] = None, stdout: Optional[BinaryIO] = None) -
             request = json.loads(line)
         except json.JSONDecodeError:
             continue
-        response = handle_request(request)
+        try:
+            response = handle_request(request)
+        except Exception as exc:  # one bad request must not kill the server
+            request_id = request.get("id") if isinstance(request, dict) else None
+            response = {
+                "jsonrpc": "2.0",
+                "id": request_id,
+                "error": {"code": -32603, "message": f"internal error: {exc}"},
+            }
         if response is not None:
             stdout.write(json.dumps(response, default=str).encode() + b"\n")
             stdout.flush()

@@ -103,8 +103,16 @@ def run_pipeline_simulation(
     probes = []
     depth_data: dict[str, Any] = {}
     downstream: Any = tracker
+    used_names: set[str] = set()
     for index, stage in enumerate(reversed(stages)):
         name = stage.get("name", f"Server{len(stages) - 1 - index}")
+        # Duplicate stage names would silently overwrite each other's
+        # depth series; disambiguate deterministically.
+        base, suffix = name, 2
+        while name in used_names:
+            name = f"{base}#{suffix}"
+            suffix += 1
+        used_names.add(name)
         server = Server(
             name,
             concurrency=stage.get("concurrency", 1),

@@ -1,0 +1,8 @@
+"""Performance benchmark harness (``python -m tests.perf``).
+
+Parity target: ``/root/reference/tests/perf`` (runner :18-83, scenarios/,
+JSON checkpoints under data/, baseline compare). The committed
+``reference.json`` carries the reference implementation's last published
+checkpoint numbers (BASELINE.md) so every report shows where the rebuilt
+executor stands against them.
+"""

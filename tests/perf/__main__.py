@@ -1,0 +1,100 @@
+"""CLI entry point: ``python -m tests.perf``."""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import pstats
+from pathlib import Path
+
+from tests.perf.runner import (
+    DATA_DIR,
+    list_checkpoints,
+    load_baseline,
+    load_checkpoint,
+    load_reference,
+    print_report,
+    run_all,
+    run_scenario,
+    save_baseline,
+    save_checkpoint,
+)
+from tests.perf.scenarios import SCENARIOS
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m tests.perf", description="happysim_tpu performance benchmarks"
+    )
+    parser.add_argument("--scenario", choices=sorted(SCENARIOS), help="run one scenario")
+    parser.add_argument("--scale", type=float, default=1.0, help="event-count multiplier")
+    parser.add_argument("--save-baseline", action="store_true")
+    parser.add_argument("--checkpoint", action="store_true",
+                        help="save a dated JSON checkpoint under tests/perf/data/")
+    parser.add_argument("--compare-checkpoint", metavar="FILE",
+                        help="compare against a checkpoint in tests/perf/data/")
+    parser.add_argument("--list-checkpoints", action="store_true")
+    parser.add_argument("--json", action="store_true", help="print results as JSON")
+    parser.add_argument("--profile", action="store_true",
+                        help="cProfile each scenario; .prof files under test_output/perf/")
+    args = parser.parse_args()
+
+    if args.list_checkpoints:
+        checkpoints = list_checkpoints()
+        if not checkpoints:
+            print("  No checkpoints saved yet.")
+        for path in checkpoints:
+            data = load_checkpoint(path)
+            print(
+                f"    {path.name:<36s} {data.get('timestamp', '?')[:19]} "
+                f"{data.get('git_hash', '?')} ({len(data.get('results', {}))} scenarios)"
+            )
+        return
+
+    selected = {args.scenario: SCENARIOS[args.scenario]} if args.scenario else SCENARIOS
+
+    if args.profile:
+        out_dir = Path("test_output/perf")
+        out_dir.mkdir(parents=True, exist_ok=True)
+        results = []
+        for name, scenario in selected.items():
+            print(f"  Profiling '{name}'...")
+            profiler = cProfile.Profile()
+            profiler.enable()
+            results.append(run_scenario(scenario, args.scale))
+            profiler.disable()
+            profiler.dump_stats(str(out_dir / f"{name}.prof"))
+            pstats.Stats(profiler).sort_stats("cumulative").print_stats(15)
+    else:
+        results = run_all(selected, scale=args.scale)
+
+    if args.json:
+        import dataclasses
+        import json
+
+        print(json.dumps([dataclasses.asdict(r) for r in results], indent=2))
+        return
+
+    baseline = None
+    if args.compare_checkpoint:
+        path = Path(args.compare_checkpoint)
+        if not path.exists():
+            path = DATA_DIR / args.compare_checkpoint
+        if path.exists():
+            baseline = load_checkpoint(path).get("results")
+            print(f"  Comparing against checkpoint {path.name}")
+        else:
+            print(f"  Warning: checkpoint {args.compare_checkpoint!r} not found")
+    else:
+        baseline = load_baseline()
+
+    print_report(results, baseline=baseline, reference=load_reference())
+
+    if args.save_baseline:
+        print(f"  Baseline saved to {save_baseline(results)}")
+    if args.checkpoint:
+        print(f"  Checkpoint saved to {save_checkpoint(results)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""Scenario orchestration, checkpoints, and comparison reports."""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import subprocess
+import time
+import tracemalloc
+from dataclasses import asdict, dataclass, field
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Callable
+
+PERF_DIR = Path(__file__).parent
+BASELINE_PATH = PERF_DIR / "baseline.json"
+REFERENCE_PATH = PERF_DIR / "reference.json"
+DATA_DIR = PERF_DIR / "data"
+
+
+@dataclass
+class PerfResult:
+    """One scenario's measurements."""
+
+    name: str
+    events_processed: int
+    wall_clock_s: float
+    events_per_second: float
+    peak_memory_mb: float
+    extra: dict[str, float] = field(default_factory=dict)
+
+
+Scenario = Callable[[float], PerfResult]
+
+
+def system_info() -> dict:
+    return {
+        "python_version": platform.python_version(),
+        "platform": platform.platform(),
+        "architecture": platform.machine(),
+        "cpu_count_logical": os.cpu_count(),
+    }
+
+
+def git_short_hash() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=PERF_DIR, timeout=5,
+        )
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def run_scenario(scenario: Scenario, scale: float = 1.0) -> PerfResult:
+    """Run one scenario.
+
+    Methodology note: speed scenarios run WITHOUT tracemalloc — its
+    allocation hooks cost ~3-4x wall time, and we want honest events/sec.
+    (The reference's checkpoints keep tracemalloc on for every scenario,
+    so its published numbers carry that overhead.) Scenarios that measure
+    memory start tracemalloc themselves (see ``memory_footprint``).
+    """
+    return scenario(scale)
+
+
+def run_all(scenarios: dict[str, Scenario], scale: float = 1.0) -> list[PerfResult]:
+    results = []
+    for name, scenario in scenarios.items():
+        print(f"  Running '{name}'...", end="", flush=True)
+        result = run_scenario(scenario, scale)
+        if result.events_per_second > 0:
+            print(f" {result.events_per_second:,.0f} events/sec ({result.wall_clock_s:.3f}s)")
+        else:
+            print(f" done ({result.wall_clock_s:.3f}s)")
+        results.append(result)
+    return results
+
+
+def _payload(results: list[PerfResult]) -> dict:
+    return {
+        "timestamp": datetime.now(timezone.utc).isoformat(),
+        "git_hash": git_short_hash(),
+        "system": system_info(),
+        "results": {r.name: asdict(r) for r in results},
+    }
+
+
+def save_baseline(results: list[PerfResult]) -> Path:
+    BASELINE_PATH.write_text(json.dumps(_payload(results), indent=2))
+    return BASELINE_PATH
+
+
+def load_baseline() -> dict | None:
+    if not BASELINE_PATH.exists():
+        return None
+    return json.loads(BASELINE_PATH.read_text()).get("results")
+
+
+def load_reference() -> dict | None:
+    """The reference implementation's published numbers (committed)."""
+    if not REFERENCE_PATH.exists():
+        return None
+    return json.loads(REFERENCE_PATH.read_text()).get("results")
+
+
+def save_checkpoint(results: list[PerfResult]) -> Path:
+    DATA_DIR.mkdir(exist_ok=True)
+    stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d")
+    path = DATA_DIR / f"{stamp}_{git_short_hash()}.json"
+    path.write_text(json.dumps(_payload(results), indent=2))
+    return path
+
+
+def list_checkpoints() -> list[Path]:
+    if not DATA_DIR.exists():
+        return []
+    return sorted(DATA_DIR.glob("*.json"))
+
+
+def load_checkpoint(path: Path) -> dict:
+    return json.loads(path.read_text())
+
+
+def _delta(current: float, past: float) -> str:
+    if past <= 0:
+        return "(new)"
+    pct = (current - past) / past * 100
+    return f"{'+' if pct >= 0 else ''}{pct:.1f}%"
+
+
+def print_report(
+    results: list[PerfResult],
+    baseline: dict | None = None,
+    reference: dict | None = None,
+) -> None:
+    stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%M:%S UTC")
+    print()
+    print("=" * 80)
+    print("  HAPPYSIM-TPU PERFORMANCE REPORT")
+    print(f"  Python {platform.python_version()} | {stamp} | {git_short_hash()}")
+    print("=" * 80)
+    print()
+    print(
+        f"  {'Scenario':<20s} {'Events/sec':>12s} {'Peak MB':>9s} {'Wall (s)':>9s}"
+        f" {'vs baseline':>12s} {'vs reference':>13s}"
+    )
+    print(f"  {'-' * 20} {'-' * 12} {'-' * 9} {'-' * 9} {'-' * 12} {'-' * 13}")
+    for r in results:
+        eps = f"{r.events_per_second:>12,.0f}" if r.events_per_second > 0 else f"{'-':>12s}"
+        base_delta = ref_delta = ""
+        if baseline is not None:
+            past = baseline.get(r.name, {})
+            base_delta = _delta(r.events_per_second, past.get("events_per_second", 0))
+        if reference is not None:
+            past = reference.get(r.name, {})
+            ref_delta = (
+                _delta(r.events_per_second, past.get("events_per_second", 0))
+                if past
+                else ""
+            )
+        print(
+            f"  {r.name:<20s} {eps} {r.peak_memory_mb:>9.1f} {r.wall_clock_s:>9.3f}"
+            f" {base_delta:>12s} {ref_delta:>13s}"
+        )
+    extras = [(r.name, r.extra) for r in results if r.extra]
+    if extras:
+        print()
+        print("  Extra metrics:")
+        for name, extra in extras:
+            print(f"    {name}: " + ", ".join(f"{k}={v}" for k, v in extra.items()))
+    print()
+    print("=" * 80)
+
+
+def timed(fn: Callable[[], int]) -> tuple[int, float]:
+    """Run fn() (returns events processed); returns (events, wall seconds)."""
+    start = time.perf_counter()
+    events = fn()
+    return events, time.perf_counter() - start

@@ -1,0 +1,54 @@
+"""Smoke tests for the perf harness: scenarios run at tiny scale, the
+report renders, and checkpoints round-trip."""
+
+import json
+
+from tests.perf.runner import (
+    PerfResult,
+    load_reference,
+    print_report,
+    run_scenario,
+)
+from tests.perf.scenarios import SCENARIOS
+
+
+def test_all_scenarios_run_at_tiny_scale(tmp_path):
+    results = []
+    for name, scenario in SCENARIOS.items():
+        result = run_scenario(scenario, scale=0.01)
+        assert result.name == name
+        assert result.wall_clock_s >= 0
+        results.append(result)
+    # Speed scenarios actually processed work.
+    by_name = {r.name: r for r in results}
+    assert by_name["throughput"].events_processed > 1000
+    assert by_name["large_heap"].events_processed == 1000
+    print_report(results, baseline=None, reference=load_reference())
+
+
+def test_reference_numbers_present():
+    reference = load_reference()
+    assert reference is not None
+    assert reference["throughput"]["events_per_second"] == 134580
+
+
+def test_checkpoint_roundtrip(tmp_path, monkeypatch):
+    import tests.perf.runner as runner
+
+    monkeypatch.setattr(runner, "DATA_DIR", tmp_path)
+    results = [
+        PerfResult(
+            name="throughput",
+            events_processed=1000,
+            wall_clock_s=0.01,
+            events_per_second=100000.0,
+            peak_memory_mb=1.0,
+        )
+    ]
+    path = runner.save_checkpoint(results)
+    assert path.exists()
+    data = runner.load_checkpoint(path)
+    assert data["results"]["throughput"]["events_per_second"] == 100000.0
+    assert path in runner.list_checkpoints()
+    payload = json.loads(path.read_text())
+    assert "system" in payload and "git_hash" in payload
