@@ -36,7 +36,7 @@ def _fit_budget(text: str, max_tokens: int) -> str:
     """Truncate to the ~4 chars/token budget every prompt-context honors."""
     max_chars = max_tokens * 4
     if len(text) > max_chars:
-        return text[: max_chars - 20] + "\n\n[truncated]"
+        return text[: max(max_chars - 20, 0)] + "\n\n[truncated]"
     return text
 
 

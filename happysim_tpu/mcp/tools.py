@@ -52,7 +52,7 @@ def run_queue_simulation(
         server = model.server(
             concurrency=servers,
             service_mean=1.0 / service_rate,
-            queue_capacity=queue_capacity or 4096,
+            queue_capacity=4096 if queue_capacity is None else queue_capacity,
         )
         sink = model.sink()
         model.connect(source, server)

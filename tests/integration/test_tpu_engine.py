@@ -53,6 +53,37 @@ class TestMM1General:
         assert "sink[0]" in names and "server[0]" in names
 
 
+class TestShardingInvariance:
+    def test_single_vs_eight_device_mesh_same_result(self):
+        """Per-replica threefry streams are mesh-layout independent, so the
+        engine's metrics match across shardings up to reduction order —
+        the general-engine analogue of the kernel's invariance oracle."""
+        import jax
+
+        from happysim_tpu.tpu.mesh import replica_mesh
+
+        devices = jax.devices("cpu")
+        model_kwargs = dict(lam=8.0, mu=10.0, horizon_s=30.0, warmup_s=5.0)
+        r1 = run_ensemble(
+            mm1_model(**model_kwargs), n_replicas=512, seed=7,
+            mesh=replica_mesh(devices[:1]),
+        )
+        r8 = run_ensemble(
+            mm1_model(**model_kwargs), n_replicas=512, seed=7,
+            mesh=replica_mesh(devices[:8]),
+        )
+        assert r1.sink_count == r8.sink_count
+        assert r1.server_completed == r8.server_completed
+        assert r1.server_dropped == r8.server_dropped
+        assert np.array_equal(r1.sink_hist, r8.sink_hist)
+        assert r1.server_mean_wait_s[0] == pytest.approx(
+            r8.server_mean_wait_s[0], rel=1e-5
+        )
+        assert r1.sink_mean_latency_s[0] == pytest.approx(
+            r8.sink_mean_latency_s[0], rel=1e-5
+        )
+
+
 class TestMMc:
     def test_mmc_beats_mm1_at_same_load(self, mesh):
         # lam=16, c=2, mu=10 (rho=0.8) vs M/M/1 lam=8 mu=10 (rho=0.8):

@@ -73,6 +73,11 @@ def main() -> None:
         import json
 
         print(json.dumps([dataclasses.asdict(r) for r in results], indent=2))
+        # Persistence flags still apply — don't silently drop the run.
+        if args.save_baseline:
+            save_baseline(results)
+        if args.checkpoint:
+            save_checkpoint(results)
         return
 
     baseline = None

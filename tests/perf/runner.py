@@ -7,7 +7,6 @@ import os
 import platform
 import subprocess
 import time
-import tracemalloc
 from dataclasses import asdict, dataclass, field
 from datetime import datetime, timezone
 from pathlib import Path
@@ -150,17 +149,25 @@ def print_report(
     print(f"  {'-' * 20} {'-' * 12} {'-' * 9} {'-' * 9} {'-' * 12} {'-' * 13}")
     for r in results:
         eps = f"{r.events_per_second:>12,.0f}" if r.events_per_second > 0 else f"{'-':>12s}"
-        base_delta = ref_delta = ""
-        if baseline is not None:
-            past = baseline.get(r.name, {})
-            base_delta = _delta(r.events_per_second, past.get("events_per_second", 0))
-        if reference is not None:
-            past = reference.get(r.name, {})
-            ref_delta = (
-                _delta(r.events_per_second, past.get("events_per_second", 0))
-                if past
-                else ""
-            )
+
+        def compare_against(past: dict) -> str:
+            if not past:
+                return ""
+            if r.events_per_second > 0:
+                return _delta(r.events_per_second, past.get("events_per_second", 0))
+            # Memory scenario: compare bytes/event — lower is better, so
+            # '+' here means "uses less memory than the comparison".
+            current = r.extra.get("bytes_per_event", r.peak_memory_mb)
+            past_value = past.get("bytes_per_event") or past.get(
+                "extra", {}
+            ).get("bytes_per_event") or past.get("peak_memory_mb", 0)
+            if not past_value:
+                return "(new)"
+            pct = (past_value - current) / past_value * 100
+            return f"{'+' if pct >= 0 else ''}{pct:.1f}%"
+
+        base_delta = compare_against(baseline.get(r.name, {})) if baseline else ""
+        ref_delta = compare_against(reference.get(r.name, {})) if reference else ""
         print(
             f"  {r.name:<20s} {eps} {r.peak_memory_mb:>9.1f} {r.wall_clock_s:>9.3f}"
             f" {base_delta:>12s} {ref_delta:>13s}"
