@@ -1,8 +1,10 @@
 """General TPU ensemble engine: event-driven networks as one XLA program.
 
-Executes an :class:`~happysim_tpu.tpu.model.EnsembleModel` (Sources,
-Servers with FIFO queues + multi-slot concurrency, Routers, Sinks) for
-thousands of Monte-Carlo replicas simultaneously:
+Executes an :class:`~happysim_tpu.tpu.model.EnsembleModel` (Sources with
+optional ramp/spike rate profiles, Servers with FIFO queues +
+multi-slot concurrency + deadline/retry, token-bucket Limiters, Routers,
+latency-carrying edges, Sinks) for thousands of Monte-Carlo replicas
+simultaneously:
 
 - Per-replica state is a struct-of-arrays pytree (wake-time registers
   instead of a heap: each component type has a bounded set of future events,
@@ -10,17 +12,26 @@ thousands of Monte-Carlo replicas simultaneously:
   TPU-idiomatic replacement for the reference's binary heap,
   /root/reference/happysimulator/core/event_heap.py).
 - One ``lax.scan`` step processes exactly one event per replica via
-  ``lax.switch`` over (source fire | server completion) branches.
+  ``lax.switch`` over (source fire | server completion | transit arrival)
+  branches.
 - ``vmap`` lifts the single-replica step over the replica axis; the replica
   axis is sharded over the ``jax.sharding.Mesh`` and metric reductions
   lower to psum over ICI.
 - Per-replica parameter sweeps (the reference's ``run_sweep``) are just
   per-lane parameter arrays.
+- Non-homogeneous arrivals use host-precomputed inverse-integral tables:
+  the cumulative rate Lambda(t) on a grid, inverted on-device with
+  ``jnp.interp`` (SURVEY §2.2: the host path's Simpson+Brent inversion
+  becomes a table lookup).
 
-Semantics parity (host twins): Source ticks (load/source.py), Server
-concurrency + FIFO queue + drop-on-full (components/server/server.py,
-components/queue.py), router policies (components/random_router.py and
-load_balancer strategies), Sink latency accounting (components/common.py).
+Semantics parity (host twins): Source ticks + profiles (load/source.py,
+load/profile.py), Server concurrency + FIFO queue + drop-on-full
+(components/server/server.py, components/queue.py), deadline/retry
+(resilience timeout + retry patterns), token bucket
+(components/rate_limiter/policy.py), link latency
+(components/network/link.py), router policies (components/random_router.py
+and load_balancer strategies), Sink latency accounting
+(components/common.py).
 """
 
 from __future__ import annotations
@@ -41,10 +52,12 @@ logger = logging.getLogger("happysim_tpu.tpu.engine")
 
 from happysim_tpu.tpu.mesh import pad_to_multiple, replica_mesh, replica_sharding
 from happysim_tpu.tpu.model import (
+    LIMITER,
     ROUTER,
     SERVER,
     SINK,
     SOURCE,
+    EdgeLatency,
     EnsembleModel,
     NodeRef,
 )
@@ -55,6 +68,9 @@ INF = jnp.float32(jnp.inf)
 HIST_BINS = 80
 HIST_LO_LOG10 = -5.0
 HIST_DECADES = 8.0
+
+# Rate-profile integral tables: grid resolution over [0, horizon].
+PROFILE_GRID_POINTS = 512
 
 
 def _hist_bin(latency):
@@ -98,6 +114,12 @@ class EnsembleResult:
     server_utilization: list[float]
     server_mean_wait_s: list[float]
     server_mean_queue_len: list[float]
+    server_timed_out: list[int]
+    server_retried: list[int]
+    transit_dropped: list[int]
+    # per limiter
+    limiter_admitted: list[int]
+    limiter_dropped: list[int]
     # replicas whose event budget ran out before the horizon (bias warning)
     truncated_replicas: int = 0
 
@@ -120,16 +142,29 @@ class EnsembleResult:
                 )
             )
         for index in range(len(self.server_completed)):
+            extra = {
+                "completed": self.server_completed[index],
+                "dropped": self.server_dropped[index],
+                "utilization": self.server_utilization[index],
+                "mean_wait_s": self.server_mean_wait_s[index],
+                "mean_queue_len": self.server_mean_queue_len[index],
+            }
+            if self.server_timed_out[index] or self.server_retried[index]:
+                extra["timed_out"] = self.server_timed_out[index]
+                extra["retried"] = self.server_retried[index]
+            if self.transit_dropped[index]:
+                extra["transit_dropped"] = self.transit_dropped[index]
+            entities.append(
+                EntitySummary(name=f"server[{index}]", kind="Server", extra=extra)
+            )
+        for index in range(len(self.limiter_admitted)):
             entities.append(
                 EntitySummary(
-                    name=f"server[{index}]",
-                    kind="Server",
+                    name=f"limiter[{index}]",
+                    kind="RateLimiter",
                     extra={
-                        "completed": self.server_completed[index],
-                        "dropped": self.server_dropped[index],
-                        "utilization": self.server_utilization[index],
-                        "mean_wait_s": self.server_mean_wait_s[index],
-                        "mean_queue_len": self.server_mean_queue_len[index],
+                        "admitted": self.limiter_admitted[index],
+                        "dropped": self.limiter_dropped[index],
                     },
                 )
             )
@@ -161,8 +196,10 @@ class _Compiled:
         self.nV = max(len(model.servers), 1)
         self.nK = len(model.sinks)
         self.nR = max(len(model.routers), 1)
+        self.nL = max(len(model.limiters), 1)
         self.C = max(model.max_concurrency, 1)
         self.K = max(model.max_queue_capacity, 1)
+        self.TR = model.transit_capacity
         # Statistics before this sim-time are masked out of every
         # latency/wait/integral accumulator (empty-start transient removal).
         self.warmup = float(model.warmup_s)
@@ -171,10 +208,15 @@ class _Compiled:
         self.slot_valid = np.zeros((self.nV, self.C), np.bool_)
         self.queue_cap = np.zeros((self.nV,), np.int32)
         self.service_is_exp = np.zeros((self.nV,), np.bool_)
+        self.srv_deadline = np.full((self.nV,), np.inf, np.float32)
+        self.srv_max_retries = np.zeros((self.nV,), np.int32)
         for v, spec in enumerate(servers):
             self.slot_valid[v, : spec.concurrency] = True
             self.queue_cap[v] = spec.queue_capacity
             self.service_is_exp[v] = spec.service == "exponential"
+            if spec.deadline_s is not None:
+                self.srv_deadline[v] = spec.deadline_s
+                self.srv_max_retries[v] = spec.max_retries
 
         self.arrival_is_poisson = np.array(
             [s.arrival == "poisson" for s in model.sources], np.bool_
@@ -187,41 +229,138 @@ class _Compiled:
             np.float32,
         )
 
+        self.lim_rate = np.array(
+            [l.refill_rate for l in model.limiters] or [1.0], np.float32
+        )
+        self.lim_cap = np.array(
+            [l.capacity for l in model.limiters] or [1.0], np.float32
+        )
+
+        # Whether ANY edge into a server carries latency (enables the
+        # transit registers + the transit-arrival branch).
+        self.has_transit = any(
+            edge.mean_s > 0 and dest is not None and self._reaches_server(dest)
+            for edge, dest in self._edges()
+        )
+        self._build_profile_tables()
+
+    def _edges(self):
+        for s in self.model.sources:
+            yield s.latency, s.downstream
+        for v in self.model.servers:
+            yield v.latency, v.downstream
+        for l in self.model.limiters:
+            yield l.latency, l.downstream
+        for r in self.model.routers:
+            for edge, target in zip(r.target_latencies, r.targets):
+                yield edge, target
+
+    def _reaches_server(self, ref: NodeRef) -> bool:
+        if ref.kind == SERVER:
+            return True
+        if ref.kind == ROUTER:
+            return any(t.kind == SERVER for t in self.model.routers[ref.index].targets)
+        if ref.kind == LIMITER:
+            down = self.model.limiters[ref.index].downstream
+            return down is not None and self._reaches_server(down)
+        return False
+
+    # -- profile tables ------------------------------------------------------
+    def _build_profile_tables(self) -> None:
+        """Cumulative-rate grids for profiled sources (inverse-integral).
+
+        Lambda(t) = integral of rate over [0, t] on a uniform grid; on
+        device the next arrival solves Lambda(t') = Lambda(t) + E via two
+        jnp.interp lookups (forward then inverse), with linear
+        extrapolation at the final rate past the grid.
+        """
+        horizon = float(self.model.horizon_s)
+        self.has_profile = np.array(
+            [s.profile is not None and s.profile.kind != "constant"
+             for s in self.model.sources],
+            np.bool_,
+        )
+        n_grid = PROFILE_GRID_POINTS
+        self.profile_times = np.zeros((self.nS, n_grid), np.float32)
+        self.profile_cum = np.zeros((self.nS, n_grid), np.float32)
+        self.profile_end_rate = np.zeros((self.nS,), np.float32)
+        for i, source in enumerate(self.model.sources):
+            if not self.has_profile[i]:
+                continue
+            grid = np.linspace(0.0, horizon, n_grid)
+            rates = np.array(
+                [source.profile.rate_at(source.rate, t) for t in grid]
+            )
+            cumulative = np.concatenate(
+                [[0.0], np.cumsum((rates[1:] + rates[:-1]) / 2.0 * np.diff(grid))]
+            )
+            self.profile_times[i] = grid
+            self.profile_cum[i] = cumulative
+            self.profile_end_rate[i] = max(rates[-1], 1e-9)
+
     # -- state -------------------------------------------------------------
     def init_state(self, key, params):
         gaps = self._initial_gaps(key, params)
         gaps = jnp.where(gaps > jnp.asarray(self.stop_after), INF, gaps)
-        return {
+        state = {
             "t": jnp.float32(0.0),
             "key": key,
             "src_next": gaps,
             "srv_slot_done": jnp.full((self.nV, self.C), INF),
             "srv_slot_created": jnp.zeros((self.nV, self.C), jnp.float32),
+            "srv_slot_attempt": jnp.zeros((self.nV, self.C), jnp.int32),
             "srv_q_created": jnp.zeros((self.nV, self.K), jnp.float32),
             "srv_q_enq": jnp.zeros((self.nV, self.K), jnp.float32),
+            "srv_q_attempt": jnp.zeros((self.nV, self.K), jnp.int32),
             "srv_q_head": jnp.zeros((self.nV,), jnp.int32),
             "srv_q_len": jnp.zeros((self.nV,), jnp.int32),
             "srv_dropped": jnp.zeros((self.nV,), jnp.int32),
             "srv_started": jnp.zeros((self.nV,), jnp.int32),
             "srv_completed": jnp.zeros((self.nV,), jnp.int32),
+            "srv_timed_out": jnp.zeros((self.nV,), jnp.int32),
+            "srv_retried": jnp.zeros((self.nV,), jnp.int32),
             "srv_busy_int": jnp.zeros((self.nV,), jnp.float32),
             "srv_depth_int": jnp.zeros((self.nV,), jnp.float32),
             "srv_wait_sum": jnp.zeros((self.nV,), jnp.float32),
             "srv_wait_n": jnp.zeros((self.nV,), jnp.int32),
             "rr_next": jnp.zeros((self.nR,), jnp.int32),
+            "lim_tokens": jnp.asarray(self.lim_cap),
+            "lim_last": jnp.zeros((self.nL,), jnp.float32),
+            "lim_admitted": jnp.zeros((self.nL,), jnp.int32),
+            "lim_dropped": jnp.zeros((self.nL,), jnp.int32),
             "sink_count": jnp.zeros((self.nK,), jnp.int32),
             "sink_sum": jnp.zeros((self.nK,), jnp.float32),
             "sink_sq": jnp.zeros((self.nK,), jnp.float32),
             "sink_hist": jnp.zeros((self.nK, HIST_BINS), jnp.int32),
             "events": jnp.int32(0),
         }
+        if self.has_transit:
+            state["tr_time"] = jnp.full((self.nV, self.TR), INF)
+            state["tr_created"] = jnp.zeros((self.nV, self.TR), jnp.float32)
+            state["tr_dropped"] = jnp.zeros((self.nV,), jnp.int32)
+        return state
 
     def _initial_gaps(self, key, params):
         u = jax.random.uniform(key, (self.nS,), minval=1e-12, maxval=1.0)
         rate = params["src_rate"]
         poisson_gap = -jnp.log(u) / rate
         constant_gap = 1.0 / rate
-        return jnp.where(jnp.asarray(self.arrival_is_poisson), poisson_gap, constant_gap)
+        flat = jnp.where(
+            jnp.asarray(self.arrival_is_poisson), poisson_gap, constant_gap
+        )
+        if not self.has_profile.any():
+            return flat
+        # Profiled sources invert their integral table from t=0.
+        gaps = []
+        for i in range(self.nS):
+            if self.has_profile[i]:
+                target = jnp.where(
+                    self.arrival_is_poisson[i], -jnp.log(u[i]), jnp.float32(1.0)
+                )
+                gaps.append(self._invert_profile(i, jnp.float32(0.0), target))
+            else:
+                gaps.append(flat[i])
+        return jnp.stack(gaps)
 
     # -- dense index helpers ------------------------------------------------
     # TPU-idiomatic state updates: every "indexed" read/write goes through a
@@ -244,32 +383,120 @@ class _Compiled:
         is_exp = jnp.any(jnp.asarray(self.service_is_exp) & row)
         return jnp.where(is_exp, -jnp.log(u) * mean, mean)
 
-    def _sample_gap(self, u, i: int, params):
+    def _profile_cum_at(self, i: int, t):
+        """Lambda_i(t) with linear extrapolation past the grid."""
+        times = jnp.asarray(self.profile_times[i])
+        cum = jnp.asarray(self.profile_cum[i])
+        inside = jnp.interp(t, times, cum)
+        beyond = cum[-1] + (t - times[-1]) * self.profile_end_rate[i]
+        return jnp.where(t <= times[-1], inside, beyond)
+
+    def _invert_profile(self, i: int, t, target_increment):
+        """Gap g such that Lambda_i(t+g) - Lambda_i(t) = target_increment."""
+        times = jnp.asarray(self.profile_times[i])
+        cum = jnp.asarray(self.profile_cum[i])
+        target = self._profile_cum_at(i, t) + target_increment
+        inside = jnp.interp(target, cum, times)
+        beyond = times[-1] + (target - cum[-1]) / self.profile_end_rate[i]
+        t_next = jnp.where(target <= cum[-1], inside, beyond)
+        return jnp.maximum(t_next - t, 1e-9)
+
+    def _sample_gap(self, u, i: int, t, params):
+        if self.has_profile[i]:
+            increment = jnp.where(
+                self.arrival_is_poisson[i], -jnp.log(u), jnp.float32(1.0)
+            )
+            return self._invert_profile(i, t, increment)
         rate = params["src_rate"][i]
         if self.arrival_is_poisson[i]:
             return -jnp.log(u) / rate
         return 1.0 / rate
 
+    @staticmethod
+    def _sample_edge(edge: EdgeLatency, u):
+        """Latency draw for a static edge (0 when the edge is free)."""
+        if edge.mean_s <= 0:
+            return jnp.float32(0.0)
+        if edge.kind == "exponential":
+            return -jnp.log(u) * edge.mean_s
+        return jnp.float32(edge.mean_s)
+
     # -- job delivery ------------------------------------------------------
-    def _deliver(self, state, t, created, u_route, u_service, dest: NodeRef, params):
+    def _deliver(self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params):
+        """Deliver a job leaving some node at time t across ``edge``.
+
+        ``u`` is a (3,) uniform triple: route, service, latency.
+        """
+        if dest.kind == LIMITER:
+            return self._through_limiter(state, t, created, u, dest.index, params)
         if dest.kind == SINK:
-            return self._deliver_sink(state, t, created, dest.index)
+            latency = self._sample_edge(edge, u[2])
+            return self._deliver_sink(state, t + latency, created, dest.index)
         if dest.kind == SERVER:
-            return self._arrive_server(state, dest.index, t, created, u_service, params)
-        # Router: one dynamic hop to a homogeneous target set.
+            if edge.mean_s > 0:
+                latency = self._sample_edge(edge, u[2])
+                return self._into_transit(state, dest.index, t + latency, created)
+            return self._arrive_server(state, dest.index, t, created, 0, u[1], params)
+        # Router: one dynamic hop to a homogeneous target set. Edges INTO a
+        # router are latency-free by construction (model.connect rejects
+        # them); only the per-target edge below carries latency.
         router = self.model.routers[dest.index]
         target_kinds = {ref.kind for ref in router.targets}
-        if target_kinds == {SINK}:
-            indices = jnp.asarray([ref.index for ref in router.targets])
-            choice = self._route_choice(state, u_route, dest.index, router, indices)
-            state = self._bump_rr(state, dest.index, router)
-            return self._deliver_sink(state, t, created, indices[choice])
-        if target_kinds != {SERVER}:
-            raise ValueError("Router targets must be all servers or all sinks")
         indices = jnp.asarray([ref.index for ref in router.targets], jnp.int32)
-        choice = self._route_choice(state, u_route, dest.index, router, indices)
+        choice = self._route_choice(state, u[0], dest.index, router, indices)
         state = self._bump_rr(state, dest.index, router)
-        return self._arrive_server(state, indices[choice], t, created, u_service, params)
+        lat_means = np.asarray(
+            [e.mean_s for e in router.target_latencies], np.float32
+        )
+        lat_exp = np.asarray(
+            [e.kind == "exponential" for e in router.target_latencies], np.bool_
+        )
+        # indices/lat arrays are compile-time constants: static gathers.
+        chosen_mean = jnp.asarray(lat_means)[choice]
+        chosen_exp = jnp.asarray(lat_exp)[choice]
+        latency = jnp.where(
+            chosen_mean > 0,
+            jnp.where(chosen_exp, -jnp.log(u[2]) * chosen_mean, chosen_mean),
+            0.0,
+        )
+        if target_kinds == {SINK}:
+            return self._deliver_sink(state, t + latency, created, indices[choice])
+        if lat_means.any():
+            return self._into_transit(state, indices[choice], t + latency, created)
+        return self._arrive_server(
+            state, indices[choice], t, created, 0, u[1], params
+        )
+
+    def _through_limiter(self, state, t, created, u, l: int, params):
+        """Token-bucket admission, inline (limiter edges are latency-free)."""
+        limiter = self.model.limiters[l]
+        row = self._row(l, self.nL)
+        tokens = self._pick(state["lim_tokens"], row)
+        last = self._pick(state["lim_last"], row)
+        rate = jnp.float32(self.lim_rate[l])
+        cap = jnp.float32(self.lim_cap[l])
+        refilled = jnp.minimum(tokens + (t - last) * rate, cap)
+        admit = refilled >= 1.0
+        new_tokens = jnp.where(admit, refilled - 1.0, refilled)
+        state = {
+            **state,
+            "lim_tokens": jnp.where(row, new_tokens, state["lim_tokens"]),
+            "lim_last": jnp.where(row, t, state["lim_last"]),
+            "lim_admitted": state["lim_admitted"]
+            + row.astype(jnp.int32) * admit.astype(jnp.int32),
+            "lim_dropped": state["lim_dropped"]
+            + row.astype(jnp.int32) * (~admit).astype(jnp.int32),
+        }
+        delivered = self._deliver(
+            state, t, created, u, limiter.downstream, limiter.latency, params
+        )
+        # Rejected jobs vanish: keep the admission bookkeeping, drop the
+        # delivery's effects.
+        return jax.tree_util.tree_map(
+            lambda on_admit, on_drop: jnp.where(admit, on_admit, on_drop),
+            delivered,
+            state,
+        )
 
     def _route_choice(self, state, u_route, router_index, router, indices):
         n = len(router.targets)
@@ -295,10 +522,18 @@ class _Compiled:
             "rr_next": state["rr_next"].at[router_index].add(1),
         }
 
-    def _deliver_sink(self, state, t, created, sink_index):
-        """sink_index may be a static int or a traced index (router choice)."""
-        latency = t - created
-        measure = t >= jnp.float32(self.warmup)
+    def _deliver_sink(self, state, arrival_t, created, sink_index):
+        """sink_index may be a static int or a traced index (router choice).
+
+        ``arrival_t`` includes any link latency; measurement masking uses
+        the arrival time.
+        """
+        latency = arrival_t - created
+        # Parity with the host executor (and the transit path): deliveries
+        # landing after the horizon are never observed.
+        measure = (arrival_t >= jnp.float32(self.warmup)) & (
+            arrival_t <= jnp.float32(self.model.horizon_s)
+        )
         row = self._row(sink_index, self.nK) & measure
         row_i = row.astype(jnp.int32)
         row_f = row.astype(jnp.float32)
@@ -313,7 +548,24 @@ class _Compiled:
             "sink_hist": state["sink_hist"] + hist_mask.astype(jnp.int32),
         }
 
-    def _arrive_server(self, state, v, t, created, u_service, params):
+    def _into_transit(self, state, v, arrival_t, created):
+        """Park a job on a latency edge until its transit arrival fires."""
+        row = self._row(v, self.nV)
+        free = jnp.isinf(state["tr_time"]) & row[:, None]
+        has_free = jnp.any(free)
+        first_free = jnp.argmax(free, axis=1)
+        slot_mask = free & (
+            jnp.arange(self.TR, dtype=jnp.int32)[None, :] == first_free[:, None]
+        )
+        return {
+            **state,
+            "tr_time": jnp.where(slot_mask, arrival_t, state["tr_time"]),
+            "tr_created": jnp.where(slot_mask, created, state["tr_created"]),
+            "tr_dropped": state["tr_dropped"]
+            + row.astype(jnp.int32) * (~has_free).astype(jnp.int32),
+        }
+
+    def _arrive_server(self, state, v, t, created, attempt, u_service, params):
         row = self._row(v, self.nV)  # (nV,)
         row_i = row.astype(jnp.int32)
         row_f = row.astype(jnp.float32)
@@ -347,6 +599,9 @@ class _Compiled:
             **state,
             "srv_slot_done": jnp.where(slot_mask, t + service, done),
             "srv_slot_created": jnp.where(slot_mask, created, state["srv_slot_created"]),
+            "srv_slot_attempt": jnp.where(
+                slot_mask, attempt, state["srv_slot_attempt"]
+            ),
             "srv_started": state["srv_started"] + row_i * has_free.astype(jnp.int32),
             # Zero-wait start: counts toward E[Wq] (the analytic rho/(mu-lam)
             # averages over non-waiters too), contributes 0 to the sum.
@@ -356,21 +611,48 @@ class _Compiled:
             + row_f * jnp.where(has_free & measure, service, 0.0),
             "srv_q_created": jnp.where(q_mask, created, state["srv_q_created"]),
             "srv_q_enq": jnp.where(q_mask, t, state["srv_q_enq"]),
+            "srv_q_attempt": jnp.where(q_mask, attempt, state["srv_q_attempt"]),
             "srv_q_len": state["srv_q_len"] + row_i * enq.astype(jnp.int32),
             "srv_dropped": state["srv_dropped"] + row_i * drop.astype(jnp.int32),
         }
 
+    def _enqueue_retry(self, state, v: int, t, created, attempt):
+        """Tail re-enqueue of a deadline-expired job (attempt already +1)."""
+        row = self._row(v, self.nV)
+        row_i = row.astype(jnp.int32)
+        q_len = self._pick(state["srv_q_len"], row)
+        cap = jnp.float32(self.queue_cap[v])
+        has_room = q_len < cap
+        tail = jnp.mod(self._pick(state["srv_q_head"], row) + q_len, self.K)
+        q_mask = (
+            row[:, None]
+            & (jnp.arange(self.K, dtype=jnp.int32)[None, :] == tail)
+            & has_room
+        )
+        return {
+            **state,
+            "srv_q_created": jnp.where(q_mask, created, state["srv_q_created"]),
+            "srv_q_enq": jnp.where(q_mask, t, state["srv_q_enq"]),
+            "srv_q_attempt": jnp.where(q_mask, attempt, state["srv_q_attempt"]),
+            "srv_q_len": state["srv_q_len"] + row_i * has_room.astype(jnp.int32),
+            "srv_retried": state["srv_retried"] + row_i * has_room.astype(jnp.int32),
+            # A retry that found the queue full is a drop.
+            "srv_dropped": state["srv_dropped"]
+            + row_i * (~has_room).astype(jnp.int32),
+        }
+
     # -- event branches ----------------------------------------------------
     def _fire_source(self, i: int, state, t, u, params):
-        gap = self._sample_gap(u[0], i, params)
+        gap = self._sample_gap(u[0], i, t, params)
         next_time = t + gap
         stopped = next_time > jnp.float32(self.stop_after[i])
         state = {
             **state,
             "src_next": state["src_next"].at[i].set(jnp.where(stopped, INF, next_time)),
         }
+        source = self.model.sources[i]
         return self._deliver(
-            state, t, t, u[1], u[2], self.model.sources[i].downstream, params
+            state, t, t, u[1:4], source.downstream, source.latency, params
         )
 
     def _complete_server(self, v: int, state, t, u, params):
@@ -385,15 +667,43 @@ class _Compiled:
         col_mask = jnp.arange(self.C, dtype=jnp.int32)[None, :] == k  # (1, C)
         slot_mask = row[:, None] & col_mask  # (nV, C)
         created = self._pick(state["srv_slot_created"], slot_mask)
+        attempt = self._pick(state["srv_slot_attempt"], slot_mask).astype(jnp.int32)
         state = {
             **state,
             "srv_slot_done": jnp.where(slot_mask, INF, state["srv_slot_done"]),
             "srv_completed": state["srv_completed"] + row_i,
         }
-        # Forward the finished job downstream.
-        state = self._deliver(
-            state, t, created, u[0], u[1], self.model.servers[v].downstream, params
-        )
+        spec = self.model.servers[v]
+        if spec.deadline_s is not None:
+            # Deadline accounting: a completion whose sojourn blew the
+            # deadline is a timeout — retried (tail re-enqueue) while the
+            # budget lasts, else counted and discarded.
+            expired = (t - created) > jnp.float32(self.srv_deadline[v])
+            can_retry = expired & (attempt < jnp.int32(self.srv_max_retries[v]))
+            timed_out = expired & ~can_retry
+            state = {
+                **state,
+                "srv_timed_out": state["srv_timed_out"]
+                + row_i * timed_out.astype(jnp.int32),
+            }
+            retried_state = self._enqueue_retry(state, v, t, created, attempt + 1)
+            forwarded_state = self._deliver(
+                state, t, created, u[0:3], spec.downstream, spec.latency, params
+            )
+            state = jax.tree_util.tree_map(
+                lambda retry_leaf, fwd_leaf, base_leaf: jnp.where(
+                    can_retry,
+                    retry_leaf,
+                    jnp.where(expired, base_leaf, fwd_leaf),
+                ),
+                retried_state,
+                forwarded_state,
+                state,
+            )
+        else:
+            state = self._deliver(
+                state, t, created, u[0:3], spec.downstream, spec.latency, params
+            )
         # Pull the next queued job into the freed slot (FIFO). A same-server
         # feedback delivery above may have re-claimed slot k, so only pull if
         # the slot is still free.
@@ -407,7 +717,8 @@ class _Compiled:
         )  # (nV, K)
         queued_created = self._pick(state["srv_q_created"], head_mask)
         queued_enq = self._pick(state["srv_q_enq"], head_mask)
-        service = self._sample_service(u[2], v, params)
+        queued_attempt = self._pick(state["srv_q_attempt"], head_mask).astype(jnp.int32)
+        service = self._sample_service(u[3], v, params)
         pull_mask = slot_mask & has_queued
         row_pull = row_i * has_queued.astype(jnp.int32)
         measure = t >= jnp.float32(self.warmup)
@@ -417,6 +728,9 @@ class _Compiled:
             "srv_slot_done": jnp.where(pull_mask, t + service, state["srv_slot_done"]),
             "srv_slot_created": jnp.where(
                 pull_mask, queued_created, state["srv_slot_created"]
+            ),
+            "srv_slot_attempt": jnp.where(
+                pull_mask, queued_attempt, state["srv_slot_attempt"]
             ),
             "srv_q_head": jnp.where(
                 row & has_queued, jnp.mod(head + 1, self.K), state["srv_q_head"]
@@ -431,25 +745,48 @@ class _Compiled:
             + row_i * measured_pull.astype(jnp.int32),
         }
 
+    def _transit_arrive(self, v: int, state, t, u, params):
+        """A job finished crossing a latency edge: hand it to server v."""
+        row = self._row(v, self.nV)
+        times_masked = jnp.where(row[:, None], state["tr_time"], INF)
+        k = jnp.argmin(jnp.min(times_masked, axis=0))
+        slot_mask = row[:, None] & (
+            jnp.arange(self.TR, dtype=jnp.int32)[None, :] == k
+        )
+        created = self._pick(state["tr_created"], slot_mask)
+        state = {
+            **state,
+            "tr_time": jnp.where(slot_mask, INF, state["tr_time"]),
+        }
+        return self._arrive_server(state, v, t, created, 0, u[1], params)
+
     # -- the step ----------------------------------------------------------
     def make_step(self, horizon: float):
         nS, nV = self.nS, self.nV
         slot_valid = jnp.asarray(self.slot_valid)
+        nV_real = len(self.model.servers)
 
-        branches = [partial(self._fire_source, i) for i in range(nS)] + [
-            partial(self._complete_server, v) for v in range(len(self.model.servers))
-        ]
+        branches = (
+            [partial(self._fire_source, i) for i in range(nS)]
+            + [partial(self._complete_server, v) for v in range(nV_real)]
+            + (
+                [partial(self._transit_arrive, v) for v in range(nV_real)]
+                if self.has_transit
+                else []
+            )
+        )
 
         def step(carry, step_index):
             state, params = carry
             src_next = state["src_next"]
             srv_done = jnp.where(slot_valid, state["srv_slot_done"], INF)
-            srv_next = jnp.min(srv_done, axis=1) if self.model.servers else jnp.full(
-                (nV,), INF
-            )
-            candidates = jnp.concatenate(
-                [src_next, srv_next[: len(self.model.servers)]]
-            ) if self.model.servers else src_next
+            srv_next = jnp.min(srv_done, axis=1) if nV_real else jnp.full((nV,), INF)
+            parts = [src_next]
+            if nV_real:
+                parts.append(srv_next[:nV_real])
+                if self.has_transit:
+                    parts.append(jnp.min(state["tr_time"], axis=1)[:nV_real])
+            candidates = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
             event_index = jnp.argmin(candidates)
             t_next = candidates[event_index]
             done = jnp.isinf(t_next) | (t_next > horizon)
@@ -458,7 +795,7 @@ class _Compiled:
             # vmap all branches execute predicated, so hoisting halves the
             # threefry work versus drawing inside each branch).
             step_key = jax.random.fold_in(state["key"], step_index)
-            u = jax.random.uniform(step_key, (3,), minval=1e-12, maxval=1.0)
+            u = jax.random.uniform(step_key, (4,), minval=1e-12, maxval=1.0)
 
             def process(state):
                 # Only the post-warmup portion of the interval counts toward
@@ -496,6 +833,8 @@ def _max_server_chain(model: EnsembleModel) -> int:
                 (depth_from(t, seen) for t in model.routers[ref.index].targets),
                 default=0,
             )
+        if ref.kind == LIMITER:
+            return depth_from(model.limiters[ref.index].downstream, seen)
         if ref.index in seen:  # feedback loop: bounded by budget anyway
             return 1
         return 1 + depth_from(
@@ -507,28 +846,52 @@ def _max_server_chain(model: EnsembleModel) -> int:
     )
 
 
+def _source_jobs(model: EnsembleModel, source, rate: float) -> float:
+    """Expected emissions for one source over its active window."""
+    window = (
+        min(model.horizon_s, source.stop_after_s)
+        if source.stop_after_s is not None
+        else model.horizon_s
+    )
+    if source.profile is not None and source.profile.kind != "constant":
+        # Trapezoid over the profile (same integral the tables encode).
+        grid = np.linspace(0.0, window, 256)
+        rates = np.array([source.profile.rate_at(source.rate, t) for t in grid])
+        return float(np.trapezoid(rates, grid))
+    return rate * window
+
+
 def _default_max_events(model: EnsembleModel, sweeps) -> int:
-    horizon = model.horizon_s
     rates = np.asarray([s.rate for s in model.sources], np.float64)
     if sweeps and "source_rate" in sweeps:
         arr = np.asarray(sweeps["source_rate"], np.float64)
         if arr.ndim == 1:  # per-replica scalar broadcast across sources
             arr = np.tile(arr[:, None], (1, len(model.sources)))
         rates = np.max(arr, axis=0)
-    # Budget each source for its own emission window — a short-lived burst
-    # source must not starve an open-ended one (and vice versa).
-    windows = np.asarray(
-        [
-            min(horizon, s.stop_after_s) if s.stop_after_s is not None else horizon
-            for s in model.sources
-        ],
-        np.float64,
+    total_jobs = sum(
+        _source_jobs(model, s, rates[i]) for i, s in enumerate(model.sources)
     )
-    total_jobs = float(np.sum(rates * windows))
-    # Each job costs one source-fire plus one completion per server on its
-    # path; 25% headroom covers Poisson variance and queue drain.
-    events_per_job = 1 + _max_server_chain(model)
+    # Each job costs one source-fire plus, per server on its path, one
+    # completion (plus one transit hop when edges carry latency); deadline
+    # retries re-run service up to (1 + max_retries) times. 25% headroom
+    # covers Poisson variance and queue drain.
+    hops_per_server = 2 if any(
+        e.mean_s > 0 for e in _all_edges(model)
+    ) else 1
+    retry_factor = 1 + max((s.max_retries for s in model.servers), default=0)
+    events_per_job = 1 + hops_per_server * _max_server_chain(model) * retry_factor
     return int(1.25 * events_per_job * total_jobs) + 64
+
+
+def _all_edges(model: EnsembleModel):
+    for s in model.sources:
+        yield s.latency
+    for v in model.servers:
+        yield v.latency
+    for l in model.limiters:
+        yield l.latency
+    for r in model.routers:
+        yield from r.target_latencies
 
 
 def run_ensemble(
@@ -545,6 +908,8 @@ def run_ensemble(
       - "source_rate": (R,) or (R, n_sources)
       - "service_mean": (R,) or (R, n_servers)
     This is the compiled equivalent of the reference's run_sweep grid.
+    (Sweeping a profiled source's rate is not supported: its table is
+    baked at compile time.)
     """
     compiled = _Compiled(model)
     if mesh is None:
@@ -566,6 +931,10 @@ def run_ensemble(
     )
     if sweeps:
         if "source_rate" in sweeps:
+            if compiled.has_profile.any():
+                raise ValueError(
+                    "source_rate sweeps are incompatible with profiled sources"
+                )
             arr = np.asarray(sweeps["source_rate"], np.float32)
             if arr.ndim == 1:
                 arr = np.tile(arr[:, None], (1, compiled.nS))
@@ -607,12 +976,14 @@ def run_ensemble(
         final = jax.vmap(one_replica)(keys, params)
         # A replica is truncated if the event budget ran out while it still
         # had work scheduled before the horizon (the engine is
-        # work-conserving, so pending work always surfaces in src_next or an
-        # occupied server slot).
+        # work-conserving, so pending work always surfaces in src_next, an
+        # occupied server slot, or a transit register).
         pending = jnp.minimum(
             jnp.min(final["src_next"], axis=-1),
             jnp.min(final["srv_slot_done"], axis=(-2, -1)),
         )
+        if compiled.has_transit:
+            pending = jnp.minimum(pending, jnp.min(final["tr_time"], axis=(-2, -1)))
         # Cross-replica reduction (psum over the mesh when sharded).
         reduced = {
             "truncated": jnp.sum((pending < horizon).astype(jnp.int32)),
@@ -624,11 +995,17 @@ def run_ensemble(
             "srv_completed": jnp.sum(final["srv_completed"], axis=0),
             "srv_dropped": jnp.sum(final["srv_dropped"], axis=0),
             "srv_started": jnp.sum(final["srv_started"], axis=0),
+            "srv_timed_out": jnp.sum(final["srv_timed_out"], axis=0),
+            "srv_retried": jnp.sum(final["srv_retried"], axis=0),
             "srv_busy_int": jnp.sum(final["srv_busy_int"], axis=0),
             "srv_depth_int": jnp.sum(final["srv_depth_int"], axis=0),
             "srv_wait_sum": jnp.sum(final["srv_wait_sum"], axis=0),
             "srv_wait_n": jnp.sum(final["srv_wait_n"], axis=0),
+            "lim_admitted": jnp.sum(final["lim_admitted"], axis=0),
+            "lim_dropped": jnp.sum(final["lim_dropped"], axis=0),
         }
+        if compiled.has_transit:
+            reduced["tr_dropped"] = jnp.sum(final["tr_dropped"], axis=0)
         return reduced
 
     # AOT-compile so the timed region is pure execution (and the ensemble
@@ -653,6 +1030,7 @@ def run_ensemble(
 
     host = {k: np.asarray(v) for k, v in reduced.items()}
     nV_real = len(model.servers)
+    nL_real = len(model.limiters)
     sink_count = host["sink_count"].astype(np.int64)
     with np.errstate(divide="ignore", invalid="ignore"):
         sink_mean = np.where(sink_count > 0, host["sink_sum"] / sink_count, 0.0)
@@ -660,6 +1038,11 @@ def run_ensemble(
         wait_mean = np.where(wait_n > 0, host["srv_wait_sum"][:nV_real] / wait_n, 0.0)
     # Integrals are accumulated only over the measured (post-warmup) window.
     denom = n_replicas * (horizon - compiled.warmup)
+    transit_dropped = (
+        [int(d) for d in host["tr_dropped"][:nV_real]]
+        if compiled.has_transit
+        else [0] * nV_real
+    )
     return EnsembleResult(
         n_replicas=n_replicas,
         horizon_s=horizon,
@@ -681,5 +1064,10 @@ def run_ensemble(
         server_mean_queue_len=[
             float(d) / denom for d in host["srv_depth_int"][:nV_real]
         ],
+        server_timed_out=[int(x) for x in host["srv_timed_out"][:nV_real]],
+        server_retried=[int(x) for x in host["srv_retried"][:nV_real]],
+        transit_dropped=transit_dropped,
+        limiter_admitted=[int(x) for x in host["lim_admitted"][:nL_real]],
+        limiter_dropped=[int(x) for x in host["lim_dropped"][:nL_real]],
         truncated_replicas=truncated,
     )

@@ -30,10 +30,12 @@ SOURCE = "source"
 SERVER = "server"
 SINK = "sink"
 ROUTER = "router"
+LIMITER = "limiter"
 
 ARRIVAL_KINDS = ("poisson", "constant")
 SERVICE_KINDS = ("exponential", "constant")
 ROUTER_POLICIES = ("random", "round_robin", "least_outstanding")
+LATENCY_KINDS = ("constant", "exponential")
 
 
 @dataclass(frozen=True)
@@ -42,12 +44,54 @@ class NodeRef:
     index: int
 
 
+@dataclass(frozen=True)
+class RateProfile:
+    """Time-varying arrival rate (host side; compiled to integral tables).
+
+    Kinds (parity: ``happysimulator/load/profile.py:38-78``):
+      - ``constant``: rate(t) = base
+      - ``ramp``: base -> ``end_rate`` linearly over ``ramp_duration_s``,
+        then holds (LinearRampProfile)
+      - ``spike``: base, except ``spike_rate`` inside
+        [``spike_start_s``, ``spike_end_s``) (SpikeProfile)
+    """
+
+    kind: str = "constant"
+    end_rate: float = 0.0
+    ramp_duration_s: float = 0.0
+    spike_rate: float = 0.0
+    spike_start_s: float = 0.0
+    spike_end_s: float = 0.0
+
+    def rate_at(self, base_rate: float, t: float) -> float:
+        if self.kind == "ramp":
+            if self.ramp_duration_s <= 0:
+                return self.end_rate
+            frac = min(t / self.ramp_duration_s, 1.0)
+            return base_rate + (self.end_rate - base_rate) * frac
+        if self.kind == "spike":
+            if self.spike_start_s <= t < self.spike_end_s:
+                return self.spike_rate
+            return base_rate
+        return base_rate
+
+
+@dataclass(frozen=True)
+class EdgeLatency:
+    """Link latency applied while a job crosses an edge."""
+
+    mean_s: float = 0.0
+    kind: str = "constant"  # or "exponential"
+
+
 @dataclass
 class SourceSpec:
     rate: float
     arrival: str = "poisson"
     stop_after_s: Optional[float] = None
     downstream: Optional[NodeRef] = None
+    profile: Optional[RateProfile] = None
+    latency: EdgeLatency = field(default_factory=EdgeLatency)
 
 
 @dataclass
@@ -57,12 +101,31 @@ class ServerSpec:
     service: str = "exponential"
     queue_capacity: int = 64
     downstream: Optional[NodeRef] = None
+    latency: EdgeLatency = field(default_factory=EdgeLatency)
+    # Deadline accounting: completions whose sojourn exceeds deadline_s
+    # count as timeouts instead of deliveries; with max_retries > 0 the
+    # job re-enters the queue (retry-storm dynamics) until the budget
+    # runs out.
+    deadline_s: Optional[float] = None
+    max_retries: int = 0
 
 
 @dataclass
 class RouterSpec:
     policy: str = "random"
     targets: list[NodeRef] = field(default_factory=list)
+    target_latencies: list[EdgeLatency] = field(default_factory=list)
+
+
+@dataclass
+class LimiterSpec:
+    """Token bucket: ``refill_rate``/s up to ``capacity``; one token per
+    job; jobs without a token are dropped (counted)."""
+
+    refill_rate: float = 10.0
+    capacity: float = 10.0
+    downstream: Optional[NodeRef] = None
+    latency: EdgeLatency = field(default_factory=EdgeLatency)
 
 
 @dataclass
@@ -81,14 +144,24 @@ class EnsembleModel:
     ``server_completed == sink_count`` only holds when ``warmup_s == 0``.
     """
 
-    def __init__(self, horizon_s: float = 60.0, warmup_s: float = 0.0):
+    def __init__(
+        self,
+        horizon_s: float = 60.0,
+        warmup_s: float = 0.0,
+        transit_capacity: int = 256,
+    ):
         if warmup_s < 0.0 or warmup_s >= horizon_s:
             raise ValueError("warmup_s must satisfy 0 <= warmup_s < horizon_s")
+        if transit_capacity < 1:
+            raise ValueError("transit_capacity must be >= 1")
         self.horizon_s = horizon_s
         self.warmup_s = warmup_s
+        # Bounded in-flight slots per server for latency-carrying edges.
+        self.transit_capacity = transit_capacity
         self.sources: list[SourceSpec] = []
         self.servers: list[ServerSpec] = []
         self.routers: list[RouterSpec] = []
+        self.limiters: list[LimiterSpec] = []
         self.sinks: list[SinkSpec] = []
 
     # -- builders ----------------------------------------------------------
@@ -97,11 +170,52 @@ class EnsembleModel:
         rate: float,
         kind: str = "poisson",
         stop_after_s: Optional[float] = None,
+        profile: Optional[RateProfile] = None,
     ) -> NodeRef:
         if kind not in ARRIVAL_KINDS:
             raise ValueError(f"arrival kind {kind!r} not in {ARRIVAL_KINDS}")
-        self.sources.append(SourceSpec(rate=rate, arrival=kind, stop_after_s=stop_after_s))
+        if profile is not None and profile.kind not in ("constant", "ramp", "spike"):
+            raise ValueError(f"unknown profile kind {profile.kind!r}")
+        self.sources.append(
+            SourceSpec(rate=rate, arrival=kind, stop_after_s=stop_after_s, profile=profile)
+        )
         return NodeRef(SOURCE, len(self.sources) - 1)
+
+    def ramp_source(
+        self,
+        start_rate: float,
+        end_rate: float,
+        ramp_duration_s: float,
+        kind: str = "poisson",
+    ) -> NodeRef:
+        """Arrival rate climbing linearly start->end over the ramp window."""
+        return self.source(
+            rate=start_rate,
+            kind=kind,
+            profile=RateProfile(
+                kind="ramp", end_rate=end_rate, ramp_duration_s=ramp_duration_s
+            ),
+        )
+
+    def spike_source(
+        self,
+        base_rate: float,
+        spike_rate: float,
+        spike_start_s: float,
+        spike_end_s: float,
+        kind: str = "poisson",
+    ) -> NodeRef:
+        """Constant base rate with a burst window at ``spike_rate``."""
+        return self.source(
+            rate=base_rate,
+            kind=kind,
+            profile=RateProfile(
+                kind="spike",
+                spike_rate=spike_rate,
+                spike_start_s=spike_start_s,
+                spike_end_s=spike_end_s,
+            ),
+        )
 
     def server(
         self,
@@ -109,6 +223,8 @@ class EnsembleModel:
         service_mean: float = 0.1,
         service: str = "exponential",
         queue_capacity: int = 64,
+        deadline_s: Optional[float] = None,
+        max_retries: int = 0,
     ) -> NodeRef:
         if service not in SERVICE_KINDS:
             raise ValueError(f"service kind {service!r} not in {SERVICE_KINDS}")
@@ -116,12 +232,20 @@ class EnsembleModel:
             raise ValueError("concurrency must be >= 1")
         if queue_capacity < 1:
             raise ValueError("queue_capacity must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if max_retries > 0 and deadline_s is None:
+            raise ValueError("max_retries requires a deadline_s")
         self.servers.append(
             ServerSpec(
                 concurrency=concurrency,
                 service_mean_s=service_mean,
                 service=service,
                 queue_capacity=queue_capacity,
+                deadline_s=deadline_s,
+                max_retries=max_retries,
             )
         )
         return NodeRef(SERVER, len(self.servers) - 1)
@@ -130,23 +254,76 @@ class EnsembleModel:
         if policy not in ROUTER_POLICIES:
             raise ValueError(f"router policy {policy!r} not in {ROUTER_POLICIES}")
         targets = list(targets)
-        self.routers.append(RouterSpec(policy=policy, targets=targets))
+        self.routers.append(
+            RouterSpec(
+                policy=policy,
+                targets=targets,
+                target_latencies=[EdgeLatency() for _ in targets],
+            )
+        )
         return NodeRef(ROUTER, len(self.routers) - 1)
+
+    def limiter(self, refill_rate: float, capacity: float) -> NodeRef:
+        """Token-bucket admission node (jobs without a token are dropped)."""
+        if refill_rate <= 0:
+            raise ValueError("refill_rate must be > 0")
+        if capacity < 1:
+            # Admission spends a whole token; a bucket that can never hold
+            # one would silently drop all traffic.
+            raise ValueError("capacity must be >= 1")
+        self.limiters.append(LimiterSpec(refill_rate=refill_rate, capacity=capacity))
+        return NodeRef(LIMITER, len(self.limiters) - 1)
 
     def sink(self) -> NodeRef:
         self.sinks.append(SinkSpec())
         return NodeRef(SINK, len(self.sinks) - 1)
 
     # -- wiring ------------------------------------------------------------
-    def connect(self, origin: NodeRef, downstream: NodeRef) -> None:
+    def connect(
+        self,
+        origin: NodeRef,
+        downstream: NodeRef,
+        latency_s: float = 0.0,
+        latency_kind: str = "constant",
+    ) -> None:
+        """Wire ``origin`` -> ``downstream``; the edge may carry latency.
+
+        ``latency_kind`` is "constant" or "exponential" (mean
+        ``latency_s``). Limiter admission is instantaneous, so edges INTO
+        a limiter must be latency-free (put the latency on the limiter's
+        own downstream edge instead).
+        """
+        if latency_s < 0:
+            raise ValueError("latency_s must be >= 0")
+        if latency_kind not in LATENCY_KINDS:
+            raise ValueError(f"latency kind {latency_kind!r} not in {LATENCY_KINDS}")
+        if downstream.kind == LIMITER and latency_s > 0:
+            raise ValueError(
+                "edges into a limiter must be latency-free; put the latency "
+                "on the limiter's downstream edge"
+            )
+        if downstream.kind == ROUTER and latency_s > 0:
+            raise ValueError(
+                "edges into a router must be latency-free; put the latency "
+                "on the router's per-target edges instead"
+            )
+        edge = EdgeLatency(mean_s=latency_s, kind=latency_kind)
         if origin.kind == SOURCE:
             self.sources[origin.index].downstream = downstream
+            self.sources[origin.index].latency = edge
         elif origin.kind == SERVER:
             self.servers[origin.index].downstream = downstream
+            self.servers[origin.index].latency = edge
+        elif origin.kind == LIMITER:
+            if downstream.kind == LIMITER:
+                raise ValueError("Limiters cannot chain to limiters")
+            self.limiters[origin.index].downstream = downstream
+            self.limiters[origin.index].latency = edge
         elif origin.kind == ROUTER:
             if downstream.kind == ROUTER:
                 raise ValueError("Routers cannot target routers (single hop)")
             self.routers[origin.index].targets.append(downstream)
+            self.routers[origin.index].target_latencies.append(edge)
         else:
             raise ValueError("Sinks have no downstream")
 
@@ -170,11 +347,21 @@ class EnsembleModel:
                 server.downstream.index
             ].targets:
                 raise ValueError(f"router targeted by server[{i}] has no targets")
+        for i, limiter in enumerate(self.limiters):
+            if limiter.downstream is None:
+                raise ValueError(f"limiter[{i}] has no downstream")
+            if limiter.downstream.kind == LIMITER:
+                raise ValueError(f"limiter[{i}] chains to a limiter")
         for i, router in enumerate(self.routers):
             kinds = {t.kind for t in router.targets}
             for target in router.targets:
                 if target.kind == ROUTER:
                     raise ValueError(f"router[{i}] targets another router")
+                if target.kind == LIMITER:
+                    raise ValueError(
+                        f"router[{i}] targets a limiter (route after, not into, "
+                        "admission)"
+                    )
             if len(kinds) > 1:
                 raise ValueError(
                     f"router[{i}] targets must be all servers or all sinks"
