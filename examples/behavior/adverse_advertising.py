@@ -1,0 +1,62 @@
+"""The adverse advertising amplification (AAA) effect.
+
+A 30% sentiment dip doesn't cost the ad platform 30% of revenue — it
+kills the broad (outer-ring) campaigns outright, which carried most of
+the spend. Role parity:
+``examples/behavior/adverse_advertising_amplification.py``.
+"""
+
+from happysim_tpu import (
+    AdPlatform,
+    Advertiser,
+    AudienceTier,
+    Event,
+    Instant,
+    Simulation,
+)
+
+
+def main() -> dict:
+    platform = AdPlatform("platform")
+    advertiser = Advertiser(
+        "poster-shop",
+        product_price=100.0,
+        production_cost=50.0,
+        tiers=[
+            AudienceTier("Niche", base_monthly_sales=100, base_cpa=10.0),
+            AudienceTier("Mid", base_monthly_sales=400, base_cpa=25.0),
+            AudienceTier("Broad", base_monthly_sales=1000, base_cpa=40.0),
+        ],
+        platform=platform,
+        evaluation_interval_s=1.0,
+    )
+    sim = Simulation(
+        entities=[platform, advertiser], end_time=Instant.from_seconds(20.5)
+    )
+    sim.schedule(advertiser.start_events())
+    sim.schedule(
+        Event(
+            Instant.from_seconds(10.5),
+            "SentimentChange",
+            target=advertiser,
+            context={"metadata": {"sentiment": 0.7}},
+        )
+    )
+    sim.run()
+
+    revenue = advertiser.platform_revenue_data.values
+    before, after = revenue[5], revenue[-1]
+    revenue_drop = 1.0 - after / before
+    assert advertiser.tier_shutoff_events >= 1
+    # 30% sentiment drop -> >70% revenue drop: the amplification.
+    assert revenue_drop > 2 * 0.3
+    return {
+        "sentiment_drop": 0.3,
+        "revenue_drop": round(revenue_drop, 3),
+        "amplification_x": round(revenue_drop / 0.3, 2),
+        "surviving_tiers": [t.name for t in advertiser.active_tiers],
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,69 @@
+"""Canary deployments: a healthy canary promotes, a broken one rolls back.
+
+The deployer shifts traffic through staged weights while evaluating
+health; a canary that fails evaluation is pulled and the baseline fleet
+restored untouched. Role parity:
+``examples/deployment/canary_deployment.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    Event,
+    Instant,
+    LoadBalancer,
+    Server,
+    Simulation,
+)
+from happysim_tpu.components.deployment import CanaryDeployer, CanaryStage
+
+
+def deploy(healthy: bool):
+    balancer = LoadBalancer("lb")
+    baselines = [
+        Server(f"old{i}", concurrency=4, service_time=ConstantLatency(0.01))
+        for i in range(2)
+    ]
+    for server in baselines:
+        balancer.add_backend(server)
+
+    class AlwaysUnhealthy:
+        def is_healthy(self, canary, baselines):
+            return False
+
+    deployer = CanaryDeployer(
+        "cd",
+        balancer,
+        lambda name: Server(name, concurrency=4, service_time=ConstantLatency(0.01)),
+        stages=[CanaryStage(0.1, 2.0), CanaryStage(1.0, 2.0)],
+        evaluation_interval=0.5,
+        metric_evaluator=None if healthy else AlwaysUnhealthy(),
+    )
+    sim = Simulation(
+        entities=[balancer, deployer, *baselines],
+        end_time=Instant.from_seconds(60.0),
+    )
+    sim.schedule(deployer.deploy())
+    sim.schedule(
+        [Event(Instant.from_seconds(0.05 * i), "req", target=balancer) for i in range(300)]
+    )
+    sim.run()
+    return deployer, {b.name for b in balancer.backends}
+
+
+def main() -> dict:
+    promoted, fleet_after_good = deploy(healthy=True)
+    assert promoted.state.status == "completed"
+    assert fleet_after_good == {"cd_canary"}
+
+    rolled_back, fleet_after_bad = deploy(healthy=False)
+    assert rolled_back.state.status == "rolled_back"
+    assert fleet_after_bad == {"old0", "old1"}
+    return {
+        "healthy_status": promoted.state.status,
+        "unhealthy_status": rolled_back.state.status,
+        "fleet_after_rollback": sorted(fleet_after_bad),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

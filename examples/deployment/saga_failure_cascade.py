@@ -1,0 +1,68 @@
+"""A saga compensates a multi-service order when one step dies.
+
+Order flow: charge payment -> reserve stock -> ship. The shipping
+service goes down mid-run; affected sagas unwind in reverse (refund
+after unreserve), leaving no half-committed orders. Role parity:
+``examples/deployment/saga_failure_cascade.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    CrashNode,
+    Event,
+    ExponentialLatency,
+    FaultSchedule,
+    Instant,
+    Saga,
+    SagaStep,
+    Server,
+    Simulation,
+)
+
+
+def main() -> dict:
+    payment = Server("payment", service_time=ExponentialLatency(0.05, seed=1))
+    refund = Server("refund", service_time=ConstantLatency(0.02))
+    stock = Server("stock", service_time=ExponentialLatency(0.03, seed=2))
+    unreserve = Server("unreserve", service_time=ConstantLatency(0.02))
+    shipping = Server("shipping", service_time=ExponentialLatency(0.08, seed=3))
+    noop = Server("noop", service_time=ConstantLatency(0.001))
+
+    saga = Saga(
+        "order",
+        steps=[
+            SagaStep("charge", payment, "Charge", refund, "Refund", timeout=2.0),
+            SagaStep("reserve", stock, "Reserve", unreserve, "Unreserve", timeout=2.0),
+            SagaStep("ship", shipping, "Ship", noop, "NoOp", timeout=2.0),
+        ],
+    )
+    faults = FaultSchedule()
+    faults.add(CrashNode(entity_name="shipping", at=30.0, restart_at=45.0))
+
+    sim = Simulation(
+        entities=[saga, payment, refund, stock, unreserve, shipping, noop],
+        fault_schedule=faults,
+        end_time=Instant.from_seconds(90.0),
+    )
+    sim.schedule(
+        [Event(Instant.from_seconds(i * 0.5), "Order", target=saga) for i in range(120)]
+    )
+    sim.run()
+
+    stats = saga.stats
+    assert stats.sagas_completed > 0
+    assert stats.sagas_compensated > 0  # orders caught in the outage
+    # Every compensated order refunded AND unreserved (reverse order).
+    assert refund.requests_completed == stats.sagas_compensated
+    assert unreserve.requests_completed == stats.sagas_compensated
+    assert stats.sagas_completed + stats.sagas_compensated == stats.sagas_started
+    return {
+        "orders": stats.sagas_started,
+        "completed": stats.sagas_completed,
+        "compensated": stats.sagas_compensated,
+        "refunds": refund.requests_completed,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

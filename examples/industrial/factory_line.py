@@ -1,0 +1,60 @@
+"""A production line: conveyor transport, inspection, machine breakdowns.
+
+Parts flow press -> conveyor -> inspection; the press breaks down
+randomly and repairs restore it; failed parts get scrapped. Role
+parity: ``examples/industrial/car_wash.py`` + ``breakdown.py`` patterns.
+"""
+
+from happysim_tpu import (
+    BreakdownScheduler,
+    ConstantLatency,
+    ConveyorBelt,
+    Counter,
+    Event,
+    Instant,
+    InspectionStation,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+
+
+def main() -> dict:
+    good, scrap = Sink("good"), Counter("scrap")
+    inspection = InspectionStation(
+        "inspection", good, scrap, inspection_time_s=2.0, pass_rate=0.92, seed=6
+    )
+    belt = ConveyorBelt("belt", inspection, transit_time_s=10.0)
+    press = Server(
+        "press", service_time=ConstantLatency(5.0), downstream=belt, queue_capacity=50
+    )
+    breakdowns = BreakdownScheduler(
+        "breakdowns", press, mean_time_to_failure_s=300.0, mean_repair_time_s=60.0, seed=2
+    )
+    source = Source.poisson(rate=1 / 8.0, target=press, stop_after=3600.0, seed=3)
+    sim = Simulation(
+        sources=[source],
+        entities=[press, belt, inspection, good, scrap, breakdowns],
+        end_time=Instant.from_seconds(4500.0),
+    )
+    sim.schedule(breakdowns.start_event())
+    sim.run()
+
+    stats = breakdowns.stats()
+    assert stats.breakdown_count > 0
+    assert 0.5 < stats.availability < 1.0
+    assert good.events_received > 0 and scrap.count > 0
+    pass_rate = good.events_received / (good.events_received + scrap.count)
+    assert 0.85 < pass_rate < 0.97
+    return {
+        "produced": good.events_received,
+        "scrapped": scrap.count,
+        "breakdowns": stats.breakdown_count,
+        "availability": round(stats.availability, 3),
+        "min_cycle_s": round(min(good.latencies_s), 1),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,51 @@
+"""Consistent hashing keeps most keys in place when the fleet changes.
+
+Hash 2,000 session keys onto 5 backends, remove one backend, re-hash:
+only ~1/5 of the keys move (modulo hashing would move ~4/5). Role
+parity: ``examples/load-balancing/consistent_hashing_basics.py``.
+"""
+
+from happysim_tpu import Counter, Event, Instant
+from happysim_tpu.components.load_balancer import ConsistentHash
+from happysim_tpu.components.load_balancer.strategies import BackendInfo
+
+N_KEYS = 2000
+
+
+def place(strategy, infos, keys):
+    owners = {}
+    for key in keys:
+        request = Event(
+            Instant.Epoch, "Request", target=infos[0].backend,
+            context={"metadata": {"session_id": key}},
+        )
+        owners[key] = strategy.select(infos, request).name
+    return owners
+
+
+def main() -> dict:
+    backends = [Counter(f"node{i}") for i in range(5)]
+    infos = [BackendInfo(backend=b) for b in backends]
+    keys = [f"user:{i}" for i in range(N_KEYS)]
+
+    before = place(ConsistentHash(virtual_nodes=100), infos, keys)
+    after = place(ConsistentHash(virtual_nodes=100), infos[:-1], keys)
+
+    moved = sum(1 for key in keys if before[key] != after[key])
+    moved_fraction = moved / N_KEYS
+    # Only keys owned by the removed node (~1/5) move, plus ring noise.
+    assert moved_fraction < 0.35
+    # Keys that didn't live on the removed node stay put.
+    stayed = sum(
+        1 for key in keys if before[key] != "node4" and before[key] == after[key]
+    )
+    assert stayed / N_KEYS > 0.6
+    loads: dict[str, int] = {}
+    for owner in before.values():
+        loads[owner] = loads.get(owner, 0) + 1
+    assert max(loads.values()) < 3.0 * min(loads.values())
+    return {"moved_fraction": round(moved_fraction, 3), "loads": loads}
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,64 @@
+"""Auto-scaling rides a traffic ramp out and back.
+
+A ramping load drives a target-utilization scaler: the fleet grows
+under load and shrinks (respecting cooldowns) when the wave passes.
+Role parity: ``examples/performance/auto_scaler.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    Instant,
+    LinearRampProfile,
+    LoadBalancer,
+    Server,
+    Simulation,
+    Source,
+)
+from happysim_tpu.components.deployment import AutoScaler, TargetUtilization
+
+
+def main() -> dict:
+    balancer = LoadBalancer("lb")
+    seed_server = Server("s0", concurrency=2, service_time=ConstantLatency(0.4))
+    balancer.add_backend(seed_server)
+
+    def factory(name):
+        return Server(name, concurrency=2, service_time=ConstantLatency(0.4))
+
+    scaler = AutoScaler(
+        "scaler",
+        balancer,
+        factory,
+        policy=TargetUtilization(0.5),
+        min_instances=1,
+        max_instances=8,
+        evaluation_interval=2.0,
+        scale_out_cooldown=2.0,
+        scale_in_cooldown=10.0,
+    )
+    # Ramp 1/s -> 12/s over 60s, then the source stops and load drains.
+    source = Source.with_profile(
+        LinearRampProfile(1.0, 12.0, 60.0), target=balancer,
+        stop_after=60.0, seed=4,
+    )
+    sim = Simulation(
+        sources=[source], entities=[balancer, scaler, seed_server],
+        end_time=Instant.from_seconds(180.0),
+    )
+    sim.schedule(scaler.start())
+    sim.run()
+
+    stats = scaler.stats
+    assert stats.scale_out_count >= 2  # grew with the ramp
+    assert stats.scale_in_count >= 1  # shrank after it
+    assert stats.instances_removed > 0
+    return {
+        "scale_outs": stats.scale_out_count,
+        "scale_ins": stats.scale_in_count,
+        "instances_added": stats.instances_added,
+        "final_instances": len(balancer.backends),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

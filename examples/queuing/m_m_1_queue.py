@@ -1,0 +1,38 @@
+"""The canonical M/M/1 queue against its closed forms.
+
+Poisson arrivals (lambda=8/s) into a single exponential server
+(mu=10/s): utilization rho = 0.8, mean sojourn 1/(mu-lambda) = 0.5s.
+Role parity: ``examples/queuing/m_m_1_queue.py`` in the reference.
+"""
+
+from happysim_tpu import ExponentialLatency, Instant, Server, Simulation, Sink, Source
+
+LAM, MU = 8.0, 10.0
+
+
+def main() -> dict:
+    sink = Sink("sink")
+    server = Server(
+        "server", service_time=ExponentialLatency(1.0 / MU, seed=1), downstream=sink
+    )
+    source = Source.poisson(rate=LAM, target=server, seed=42)
+    summary = Simulation(
+        sources=[source], entities=[server, sink],
+        end_time=Instant.from_seconds(800.0),
+    ).run()
+
+    sojourn = sink.latency_stats().mean_s
+    utilization = server.busy_seconds / summary.simulated_seconds
+    analytic_sojourn = 1.0 / (MU - LAM)
+    assert abs(utilization - LAM / MU) < 0.05
+    assert abs(sojourn - analytic_sojourn) / analytic_sojourn < 0.3
+    return {
+        "sojourn_s": round(sojourn, 4),
+        "analytic_s": analytic_sojourn,
+        "utilization": round(utilization, 3),
+        "served": sink.events_received,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,46 @@
+"""Write-ahead-log sync policies trade durability for throughput.
+
+SyncEveryWrite survives a crash with zero loss; SyncOnBatch loses
+whatever was buffered past the last sync. Role parity:
+``examples/storage/power_outage_durability.py``.
+"""
+
+from happysim_tpu import Event, Instant, Simulation
+from happysim_tpu.components.storage import SyncEveryWrite, SyncOnBatch, WriteAheadLog
+from happysim_tpu.core.entity import Entity
+
+N_WRITES = 50
+
+
+class Writer(Entity):
+    def __init__(self, name, wal):
+        super().__init__(name)
+        self.wal = wal
+
+    def handle_event(self, event):
+        for i in range(N_WRITES):
+            yield from self.wal.append(f"seq{i}", i)
+        return None
+
+
+def survivors(sync_policy) -> int:
+    wal = WriteAheadLog("wal", sync_policy=sync_policy)
+    writer = Writer("writer", wal)
+    sim = Simulation(entities=[wal, writer], end_time=Instant.from_seconds(60.0))
+    sim.schedule(Event(Instant.Epoch, "go", target=writer))
+    sim.run()
+    wal.crash()  # power outage: unsynced tail is gone
+    return len(wal.recover())
+
+
+def main() -> dict:
+    durable = survivors(SyncEveryWrite())
+    batched = survivors(SyncOnBatch(batch_size=16))
+    assert durable == N_WRITES
+    # The batch policy loses the unsynced tail (50 = 3*16 + 2 buffered).
+    assert batched == 48
+    return {"sync_every_write": durable, "sync_on_batch": batched}
+
+
+if __name__ == "__main__":
+    print(main())

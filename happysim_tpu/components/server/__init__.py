@@ -1,3 +1,4 @@
+from happysim_tpu.components.server.async_server import AsyncServer, AsyncServerStats
 from happysim_tpu.components.server.concurrency import (
     ConcurrencyModel,
     DynamicConcurrency,
@@ -5,12 +6,17 @@ from happysim_tpu.components.server.concurrency import (
     WeightedConcurrency,
 )
 from happysim_tpu.components.server.server import Server, ServerStats
+from happysim_tpu.components.server.thread_pool import ThreadPool, ThreadPoolStats
 
 __all__ = [
+    "AsyncServer",
+    "AsyncServerStats",
     "ConcurrencyModel",
     "DynamicConcurrency",
     "FixedConcurrency",
     "Server",
     "ServerStats",
+    "ThreadPool",
+    "ThreadPoolStats",
     "WeightedConcurrency",
 ]

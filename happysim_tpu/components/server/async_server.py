@@ -1,0 +1,136 @@
+"""Event-loop server: serialized CPU, overlapping I/O.
+
+Parity target: ``happysimulator/components/server/async_server.py:49``
+(``AsyncServer``) — models Node.js/asyncio-style servers: many
+concurrent connections, but CPU-bound work holds the single event-loop
+thread while I/O waits overlap. House design: the event loop is a
+capacity-1 :class:`Resource`, so CPU serialization falls out of the
+existing future-based acquire/release machinery instead of a hand-built
+internal event protocol.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from happysim_tpu.components.resource import Resource
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.distributions.latency_distribution import (
+    ConstantLatency,
+    LatencyDistribution,
+)
+from happysim_tpu.instrumentation.data import Data
+
+
+@dataclass(frozen=True)
+class AsyncServerStats:
+    requests_completed: int = 0
+    requests_rejected: int = 0
+    total_cpu_time_s: float = 0.0
+    total_io_time_s: float = 0.0
+
+
+class AsyncServer(Entity):
+    """Single-threaded event loop multiplexing many connections.
+
+    Each request runs two phases:
+      1. CPU: holds the event-loop thread (serialized across requests).
+      2. I/O: optional ``io_handler`` generator — its yields overlap
+         freely with other requests' work.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        max_connections: int = 10_000,
+        cpu_work: Optional[LatencyDistribution] = None,
+        io_handler: Optional[Callable[[Event], object]] = None,
+        downstream: Optional[Entity] = None,
+    ):
+        super().__init__(name)
+        if max_connections < 1:
+            raise ValueError("max_connections must be >= 1")
+        self.max_connections = max_connections
+        self.cpu_work = cpu_work if cpu_work is not None else ConstantLatency(0.0)
+        self.io_handler = io_handler
+        self.downstream = downstream
+        self._event_loop = Resource(f"{name}.loop", capacity=1.0)
+        self.active_connections = 0
+        self.peak_connections = 0
+        self.requests_completed = 0
+        self.requests_rejected = 0
+        self.total_cpu_time_s = 0.0
+        self.total_io_time_s = 0.0
+        self.cpu_times = Data(f"{name}.cpu_s")
+
+    def set_clock(self, clock) -> None:
+        super().set_clock(clock)
+        self._event_loop.set_clock(clock)
+
+    @property
+    def cpu_queue_depth(self) -> int:
+        return self._event_loop.waiting
+
+    @property
+    def utilization(self) -> float:
+        return self.active_connections / self.max_connections
+
+    def stats(self) -> AsyncServerStats:
+        return AsyncServerStats(
+            requests_completed=self.requests_completed,
+            requests_rejected=self.requests_rejected,
+            total_cpu_time_s=self.total_cpu_time_s,
+            total_io_time_s=self.total_io_time_s,
+        )
+
+    def has_capacity(self) -> bool:
+        return self.active_connections < self.max_connections
+
+    def handle_event(self, event: Event):
+        if not self.has_capacity():
+            self.requests_rejected += 1
+            return event.complete_as_dropped(self.now, self.name)
+        self.active_connections += 1
+        self.peak_connections = max(self.peak_connections, self.active_connections)
+        return self._serve(event)
+
+    def _serve(self, event: Event):
+        grant = None
+        try:
+            # CPU phase: one request holds the loop at a time.
+            grant = yield self._event_loop.acquire()
+            cpu_s = self.cpu_work.get_latency(self.now).to_seconds()
+            if cpu_s > 0:
+                yield cpu_s
+            grant.release()
+            self.total_cpu_time_s += cpu_s
+            self.cpu_times.add(self.now, cpu_s)
+
+            # I/O phase: overlaps with other requests (loop released).
+            produced = None
+            if self.io_handler is not None:
+                io_started = self.now
+                result = self.io_handler(event)
+                if hasattr(result, "__next__"):
+                    produced = yield from result
+                else:
+                    produced = result
+                self.total_io_time_s += (self.now - io_started).to_seconds()
+        finally:
+            self.active_connections -= 1
+            # A crashed/closed request must not wedge the capacity-1 loop
+            # (release is idempotent, so the happy path is unaffected).
+            if grant is not None:
+                grant.release()
+        self.requests_completed += 1
+        out = list(produced) if isinstance(produced, list) else (
+            [produced] if produced is not None else []
+        )
+        if self.downstream is not None:
+            out.append(self.forward(event, self.downstream))
+        return out or None
+
+    def downstream_entities(self):
+        return [self.downstream] if self.downstream is not None else []

@@ -1,0 +1,102 @@
+"""Worker-pool task processing with per-task durations.
+
+Parity target: ``happysimulator/components/server/thread_pool.py:32``
+(``ThreadPool``) — unlike :class:`Server` (distribution-sampled service
+times), each task carries its own processing time in
+``context["metadata"]["processing_time"]`` (or via an extractor).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queued_resource import QueuedResource
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.instrumentation.data import Data
+
+
+@dataclass(frozen=True)
+class ThreadPoolStats:
+    tasks_completed: int = 0
+    tasks_rejected: int = 0
+    total_processing_time_s: float = 0.0
+
+
+class ThreadPool(QueuedResource):
+    """N workers draining a task queue; task duration rides the task."""
+
+    def __init__(
+        self,
+        name: str,
+        num_workers: int,
+        queue_policy: Optional[QueuePolicy] = None,
+        queue_capacity: Optional[int] = None,
+        processing_time_extractor: Optional[Callable[[Event], float]] = None,
+        default_processing_time: float = 0.01,
+        downstream: Optional[Entity] = None,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        super().__init__(name, queue_policy=queue_policy, queue_capacity=queue_capacity)
+        self.num_workers = num_workers
+        self.downstream = downstream
+        self._extract_time = processing_time_extractor
+        self.default_processing_time = default_processing_time
+        self.active_workers = 0
+        self.tasks_completed = 0
+        self.total_processing_time_s = 0.0
+        self.processing_times = Data(f"{name}.task_s")
+
+    @property
+    def idle_workers(self) -> int:
+        return self.num_workers - self.active_workers
+
+    @property
+    def worker_utilization(self) -> float:
+        return self.active_workers / self.num_workers
+
+    @property
+    def queued_tasks(self) -> int:
+        return self.queue_depth
+
+    def stats(self) -> ThreadPoolStats:
+        return ThreadPoolStats(
+            tasks_completed=self.tasks_completed,
+            tasks_rejected=self.queue.dropped,
+            total_processing_time_s=self.total_processing_time_s,
+        )
+
+    def worker_has_capacity(self) -> bool:
+        return self.active_workers < self.num_workers
+
+    def processing_time_of(self, task: Event) -> float:
+        if self._extract_time is not None:
+            return self._extract_time(task)
+        value = task.context.get("metadata", {}).get("processing_time")
+        try:
+            return float(value) if value is not None else self.default_processing_time
+        except (TypeError, ValueError):
+            return self.default_processing_time
+
+    def handle_queued_event(self, task: Event):
+        duration = self.processing_time_of(task)
+        self.active_workers += 1
+        try:
+            yield duration
+        finally:
+            self.active_workers -= 1
+        self.tasks_completed += 1
+        self.total_processing_time_s += duration
+        self.processing_times.add(self.now, duration)
+        if self.downstream is not None:
+            return [self.forward(task, self.downstream)]
+        return None
+
+    def downstream_entities(self):
+        downstream = super().downstream_entities()
+        if self.downstream is not None:
+            downstream.append(self.downstream)
+        return downstream

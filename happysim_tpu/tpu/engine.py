@@ -857,7 +857,8 @@ def _source_jobs(model: EnsembleModel, source, rate: float) -> float:
         # Trapezoid over the profile (same integral the tables encode).
         grid = np.linspace(0.0, window, 256)
         rates = np.array([source.profile.rate_at(source.rate, t) for t in grid])
-        return float(np.trapezoid(rates, grid))
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2.0
+        return float(trapezoid(rates, grid))
     return rate * window
 
 

@@ -1,0 +1,125 @@
+"""Unit tests: AsyncServer (event-loop semantics) and ThreadPool."""
+
+import pytest
+
+from happysim_tpu import (
+    AsyncServer,
+    ConstantLatency,
+    Event,
+    Instant,
+    Simulation,
+    Sink,
+    ThreadPool,
+)
+
+
+def burst(target, n, at_s=0.0):
+    return [Event(Instant.from_seconds(at_s), "Request", target=target) for _ in range(n)]
+
+
+class TestAsyncServer:
+    def test_cpu_work_serializes(self):
+        """Four simultaneous requests with 0.1s CPU each: the single event
+        loop finishes them at 0.1, 0.2, 0.3, 0.4 — not all at 0.1."""
+        sink = Sink("sink")
+        server = AsyncServer("api", cpu_work=ConstantLatency(0.1), downstream=sink)
+        sim = Simulation(entities=[server, sink])
+        sim.schedule(burst(server, 4))
+        sim.run()
+        done = sorted(t.to_seconds() for t in sink.completion_times)
+        assert done == pytest.approx([0.1, 0.2, 0.3, 0.4])
+        assert server.requests_completed == 4
+        assert server.stats().total_cpu_time_s == pytest.approx(0.4)
+
+    def test_io_overlaps(self):
+        """0.01s CPU + 0.5s I/O x4: CPU serializes (~0.04 total) but the
+        I/O waits overlap, so the batch finishes near 0.54, not 2.0."""
+        sink = Sink("sink")
+
+        def io_wait(event):
+            yield 0.5
+
+        server = AsyncServer(
+            "api", cpu_work=ConstantLatency(0.01), io_handler=io_wait, downstream=sink
+        )
+        sim = Simulation(entities=[server, sink])
+        sim.schedule(burst(server, 4))
+        sim.run()
+        finished = max(t.to_seconds() for t in sink.completion_times)
+        assert finished == pytest.approx(0.54, abs=1e-3)
+        assert server.stats().total_io_time_s == pytest.approx(2.0, abs=1e-2)
+
+    def test_connection_cap_rejects(self):
+        server = AsyncServer("api", max_connections=2, cpu_work=ConstantLatency(1.0))
+        sim = Simulation(entities=[server])
+        sim.schedule(burst(server, 5))
+        sim.run()
+        assert server.requests_completed == 2
+        assert server.requests_rejected == 3
+        assert server.peak_connections == 2
+
+    def test_back_pressure_signal(self):
+        server = AsyncServer("api", max_connections=1)
+        assert server.has_capacity()
+        server.active_connections = 1
+        assert not server.has_capacity()
+
+
+class TestThreadPool:
+    def test_per_task_processing_times(self):
+        sink = Sink("sink")
+        pool = ThreadPool("pool", num_workers=1, downstream=sink)
+        sim = Simulation(entities=[pool, sink])
+        for duration in (0.3, 0.1):
+            sim.schedule(
+                Event(
+                    Instant.Epoch, "Task", target=pool,
+                    context={"metadata": {"processing_time": duration}},
+                )
+            )
+        sim.run()
+        done = sorted(t.to_seconds() for t in sink.completion_times)
+        # FIFO: 0.3s task first, then the 0.1s task.
+        assert done == pytest.approx([0.3, 0.4])
+        assert pool.stats().total_processing_time_s == pytest.approx(0.4)
+
+    def test_workers_run_in_parallel(self):
+        sink = Sink("sink")
+        pool = ThreadPool(
+            "pool", num_workers=3, default_processing_time=0.5, downstream=sink
+        )
+        sim = Simulation(entities=[pool, sink])
+        sim.schedule(burst(pool, 3))
+        sim.run()
+        done = [t.to_seconds() for t in sink.completion_times]
+        assert done == pytest.approx([0.5, 0.5, 0.5])
+
+    def test_queue_capacity_rejects(self):
+        pool = ThreadPool(
+            "pool", num_workers=1, queue_capacity=1, default_processing_time=1.0
+        )
+        sim = Simulation(entities=[pool])
+        sim.schedule(burst(pool, 4))
+        sim.run()
+        # A same-instant burst: the first task is still queued when the
+        # rest arrive, so capacity 1 admits exactly one.
+        assert pool.tasks_completed == 1
+        assert pool.stats().tasks_rejected == 3
+
+    def test_custom_extractor(self):
+        sink = Sink("sink")
+        pool = ThreadPool(
+            "pool",
+            num_workers=1,
+            processing_time_extractor=lambda e: 0.25,
+            downstream=sink,
+        )
+        sim = Simulation(entities=[pool, sink])
+        sim.schedule(burst(pool, 1))
+        sim.run()
+        assert sink.completion_times[0].to_seconds() == pytest.approx(0.25)
+
+    def test_utilization_snapshot(self):
+        pool = ThreadPool("pool", num_workers=4)
+        assert pool.worker_utilization == 0.0
+        assert pool.idle_workers == 4
