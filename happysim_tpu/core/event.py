@@ -334,6 +334,12 @@ class ProcessContinuation(Event):
         # unwind as a drop so upstream wrappers don't leak accounting.
         if getattr(self.target, "_crashed", False):
             self.process.close()
+            # An undelivered resource grant (resolved to this continuation
+            # while its owner crashed) would leak capacity forever: the
+            # waiter's finally never sees it, so release it here.
+            release = getattr(self._send_value, "release", None)
+            if callable(release):
+                release()
             return self.origin.complete_as_dropped(
                 self.time, f"crashed:{getattr(self.target, 'name', '?')}"
             )

@@ -1,0 +1,128 @@
+"""Logging configuration for the ``happysim_tpu`` logger hierarchy.
+
+Parity target: ``happysimulator/logging_config.py:115-402`` — the library
+is silent by default (a NullHandler on the root package logger); these
+helpers attach console/file/rotating/JSON handlers, set per-module
+levels, and read the ``HS_LOGGING`` family of environment variables.
+
+Environment configuration (``configure_from_env``):
+  - ``HS_LOGGING``: level name (``debug``/``info``/...) or ``1``/``true``
+    for INFO. Unset/empty means leave the library silent.
+  - ``HS_LOG_FILE``: also write to this path.
+  - ``HS_LOG_JSON``: ``1``/``true`` switches handlers to JSON lines.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import logging.handlers
+import os
+from typing import Optional, Union
+
+ROOT_LOGGER = "happysim_tpu"
+
+_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+_managed_handlers: list[logging.Handler] = []
+_module_overrides: list[str] = []
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: time, level, logger, message."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "time": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            payload["exception"] = self.formatException(record.exc_info)
+        return json.dumps(payload)
+
+
+def _coerce_level(level: Union[int, str]) -> int:
+    if isinstance(level, int):
+        return level
+    value = logging.getLevelName(level.upper())
+    if not isinstance(value, int):
+        raise ValueError(f"unknown log level {level!r}")
+    return value
+
+
+def _attach(handler: logging.Handler, level: Union[int, str], json_lines: bool) -> logging.Handler:
+    handler.setLevel(_coerce_level(level))
+    handler.setFormatter(JsonFormatter() if json_lines else logging.Formatter(_FORMAT))
+    root = logging.getLogger(ROOT_LOGGER)
+    root.addHandler(handler)
+    root.setLevel(min(root.level, handler.level) if root.level else handler.level)
+    _managed_handlers.append(handler)
+    return handler
+
+
+def enable_console_logging(
+    level: Union[int, str] = "INFO", json_lines: bool = False
+) -> logging.Handler:
+    """Stream library logs to stderr at ``level``."""
+    return _attach(logging.StreamHandler(), level, json_lines)
+
+
+def enable_file_logging(
+    path: str,
+    level: Union[int, str] = "INFO",
+    json_lines: bool = False,
+    rotate_bytes: Optional[int] = None,
+    backup_count: int = 3,
+) -> logging.Handler:
+    """Write library logs to ``path`` (size-rotating when ``rotate_bytes``)."""
+    if rotate_bytes:
+        handler: logging.Handler = logging.handlers.RotatingFileHandler(
+            path, maxBytes=rotate_bytes, backupCount=backup_count
+        )
+    else:
+        handler = logging.FileHandler(path)
+    return _attach(handler, level, json_lines)
+
+
+def enable_json_logging(level: Union[int, str] = "INFO") -> logging.Handler:
+    """Console logging with one JSON object per line."""
+    return enable_console_logging(level, json_lines=True)
+
+
+def set_module_level(module: str, level: Union[int, str]) -> None:
+    """Set the level of one subtree, e.g. ``"core"`` or ``"tpu.engine"``."""
+    name = module if module.startswith(ROOT_LOGGER) else f"{ROOT_LOGGER}.{module}"
+    logging.getLogger(name).setLevel(_coerce_level(level))
+    _module_overrides.append(name)
+
+
+def disable_logging() -> None:
+    """Undo everything these helpers configured (silent again)."""
+    root = logging.getLogger(ROOT_LOGGER)
+    for handler in _managed_handlers:
+        root.removeHandler(handler)
+        handler.close()
+    _managed_handlers.clear()
+    for name in _module_overrides:
+        logging.getLogger(name).setLevel(logging.NOTSET)
+    _module_overrides.clear()
+    root.setLevel(logging.NOTSET)
+
+
+def configure_from_env(environ: Optional[dict[str, str]] = None) -> bool:
+    """Apply the ``HS_LOGGING``/``HS_LOG_FILE``/``HS_LOG_JSON`` variables.
+
+    Returns True when any logging was enabled.
+    """
+    env = environ if environ is not None else os.environ
+    raw = env.get("HS_LOGGING", "").strip()
+    if not raw:
+        return False
+    level = "INFO" if raw.lower() in ("1", "true", "yes", "on") else raw
+    json_lines = env.get("HS_LOG_JSON", "").strip().lower() in ("1", "true", "yes", "on")
+    enable_console_logging(level, json_lines=json_lines)
+    log_file = env.get("HS_LOG_FILE", "").strip()
+    if log_file:
+        enable_file_logging(log_file, level, json_lines=json_lines)
+    return True

@@ -123,3 +123,44 @@ class TestThreadPool:
         pool = ThreadPool("pool", num_workers=4)
         assert pool.worker_utilization == 0.0
         assert pool.idle_workers == 4
+
+    def test_negative_processing_time_falls_back(self):
+        sink = Sink("sink")
+        pool = ThreadPool(
+            "pool", num_workers=1, default_processing_time=0.2, downstream=sink
+        )
+        sim = Simulation(entities=[pool, sink])
+        sim.schedule(
+            Event(
+                Instant.Epoch, "Task", target=pool,
+                context={"metadata": {"processing_time": -5.0}},
+            )
+        )
+        sim.run()
+        # Regression: a negative duration used to schedule the completion
+        # in the past and silently lose the task.
+        assert pool.tasks_completed == 1
+        assert sink.completion_times[0].to_seconds() == pytest.approx(0.2)
+
+
+class TestCrashRecovery:
+    def test_crash_does_not_wedge_event_loop(self):
+        """Regression: a Grant resolved to a waiter closed by a crash must
+        be released, or the capacity-1 loop wedges forever."""
+        from happysim_tpu import CrashNode, FaultSchedule
+
+        sink = Sink("sink")
+        server = AsyncServer("api", cpu_work=ConstantLatency(1.0), downstream=sink)
+        faults = FaultSchedule()
+        faults.add(CrashNode(entity_name="api", at=0.5, restart_at=3.0))
+        sim = Simulation(
+            entities=[server, sink], fault_schedule=faults,
+            end_time=Instant.from_seconds(20.0),
+        )
+        # Two requests before the crash (one holds the loop, one waits),
+        # three after the restart.
+        sim.schedule(burst(server, 2, at_s=0.0))
+        sim.schedule(burst(server, 3, at_s=5.0))
+        sim.run()
+        assert server.requests_completed == 3
+        assert server._event_loop.in_use == 0.0
