@@ -33,6 +33,14 @@ class Connection:
     last_used_at: Instant
     uses: int = 0
     closed: bool = False
+    pool: "ConnectionPool | None" = field(default=None, repr=False, compare=False)
+
+    def __crash_release__(self):
+        """Crash-path cleanup (core/event.py): a connection resolved to a
+        waiter that died before delivery goes back to the pool."""
+        if self.pool is not None:
+            return self.pool.release(self)
+        return None
 
 
 @dataclass(frozen=True)
@@ -252,7 +260,9 @@ class ConnectionPool(Entity):
     def _new_connection(self) -> Connection:
         self._next_id += 1
         self.connections_created += 1
-        return Connection(id=self._next_id, created_at=self.now, last_used_at=self.now)
+        return Connection(
+            id=self._next_id, created_at=self.now, last_used_at=self.now, pool=self
+        )
 
     def _idle_check_event(self, connection: Connection) -> Event:
         last_used = connection.last_used_at

@@ -69,6 +69,10 @@ class PreemptibleGrant:
         if self._on_preempt is not None:
             self._on_preempt()
 
+    def __crash_release__(self) -> None:
+        """Crash-path cleanup (core/event.py): undelivered grants return."""
+        self.release()
+
     def __repr__(self) -> str:
         state = "preempted" if self._preempted else "released" if self._released else "held"
         return f"PreemptibleGrant({self.amount}, priority={self.priority}, {state})"

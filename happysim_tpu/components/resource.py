@@ -56,6 +56,11 @@ class Grant:
         self._released = True
         self.resource._release(self.amount)
 
+    def __crash_release__(self) -> None:
+        """Crash-path cleanup: a grant resolved to a waiter that died
+        before delivery returns its capacity (core/event.py crash branch)."""
+        self.release()
+
     def __repr__(self) -> str:
         return f"Grant({self.resource.name}, amount={self.amount})"
 

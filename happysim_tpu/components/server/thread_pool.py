@@ -74,13 +74,13 @@ class ThreadPool(QueuedResource):
 
     def processing_time_of(self, task: Event) -> float:
         if self._extract_time is not None:
-            duration = self._extract_time(task)
+            value = self._extract_time(task)
         else:
             value = task.context.get("metadata", {}).get("processing_time")
-            try:
-                duration = float(value) if value is not None else self.default_processing_time
-            except (TypeError, ValueError):
-                duration = self.default_processing_time
+        try:
+            duration = float(value) if value is not None else self.default_processing_time
+        except (TypeError, ValueError):
+            duration = self.default_processing_time
         # A negative duration would schedule the completion in the past
         # and silently lose the task (time-travel skip).
         return duration if duration >= 0 else self.default_processing_time

@@ -99,6 +99,13 @@ class SimulationControl:
         """Call ``callback(event)`` after every processed event."""
         self._on_event.append(callback)
 
+    def remove_on_event(self, callback: Callable[[Event], None]) -> None:
+        """Detach a previously-registered event hook (no-op if absent).
+
+        With no hooks left the simulation returns to its fast loop."""
+        if callback in self._on_event:
+            self._on_event.remove(callback)
+
     def on_time_advance(self, callback: Callable[[Instant], None]) -> None:
         """Call ``callback(now)`` whenever simulated time moves forward."""
         self._on_time_advance.append(callback)

@@ -334,13 +334,16 @@ class ProcessContinuation(Event):
         # unwind as a drop so upstream wrappers don't leak accounting.
         if getattr(self.target, "_crashed", False):
             self.process.close()
-            # An undelivered resource grant (resolved to this continuation
-            # while its owner crashed) would leak capacity forever: the
-            # waiter's finally never sees it, so release it here.
-            release = getattr(self._send_value, "release", None)
-            if callable(release):
-                release()
-            return self.origin.complete_as_dropped(
+            # An undelivered capacity handle (grant/connection resolved to
+            # this continuation while its owner crashed) would leak forever:
+            # the waiter's finally never sees it. Payloads that need this
+            # cleanup declare __crash_release__ (an explicit opt-in — NOT a
+            # duck-typed .release, which could hit unrelated user objects).
+            cleanup = getattr(self._send_value, "__crash_release__", None)
+            produced: list[Event] = []
+            if callable(cleanup):
+                produced = list(cleanup() or [])
+            return produced + self.origin.complete_as_dropped(
                 self.time, f"crashed:{getattr(self.target, 'name', '?')}"
             )
         debugger = _active_code_debugger.get(None)

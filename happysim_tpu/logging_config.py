@@ -117,7 +117,7 @@ def configure_from_env(environ: Optional[dict[str, str]] = None) -> bool:
     """
     env = environ if environ is not None else os.environ
     raw = env.get("HS_LOGGING", "").strip()
-    if not raw:
+    if not raw or raw.lower() in ("0", "false", "no", "off"):
         return False
     level = "INFO" if raw.lower() in ("1", "true", "yes", "on") else raw
     json_lines = env.get("HS_LOG_JSON", "").strip().lower() in ("1", "true", "yes", "on")

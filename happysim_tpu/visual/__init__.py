@@ -1,0 +1,42 @@
+"""Visual debugger: topology, state, charts, code stepping over REST.
+
+Parity target: ``happysimulator/visual/`` (``serve`` :__init__.py:24,
+bridge :101, server :27-216, topology :225, code_debugger :140). The
+house server is dependency-free (stdlib HTTP + long-polling instead of
+FastAPI + WebSocket).
+"""
+
+from happysim_tpu.visual.bridge import SimulationBridge
+from happysim_tpu.visual.code_debugger import (
+    CodeBreakpoint,
+    CodeDebugger,
+    CodeLocation,
+    ExecutionTrace,
+    LineRecord,
+)
+from happysim_tpu.visual.dashboard import Chart
+from happysim_tpu.visual.serializers import (
+    is_internal_event,
+    serialize_entity,
+    serialize_event,
+)
+from happysim_tpu.visual.server import DebugServer, serve
+from happysim_tpu.visual.topology import Topology, TopologyNode, discover
+
+__all__ = [
+    "Chart",
+    "CodeBreakpoint",
+    "CodeDebugger",
+    "CodeLocation",
+    "DebugServer",
+    "ExecutionTrace",
+    "LineRecord",
+    "SimulationBridge",
+    "Topology",
+    "TopologyNode",
+    "discover",
+    "is_internal_event",
+    "serialize_entity",
+    "serialize_event",
+    "serve",
+]

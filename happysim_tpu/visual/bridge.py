@@ -1,0 +1,227 @@
+"""SimulationBridge: the mediator between a Simulation and the API layer.
+
+Parity target: ``happysimulator/visual/bridge.py:101`` — wraps
+``sim`` + ``sim.control``: bounded event/log recording, per-entity state
+history, topology, chart payloads, and the step/run_to/reset verbs the
+REST server exposes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from happysim_tpu.core.control.breakpoints import TimeBreakpoint
+from happysim_tpu.visual.code_debugger import CodeDebugger
+from happysim_tpu.visual.code_debugger import entity_source as get_entity_source
+from happysim_tpu.visual.serializers import (
+    is_internal_event,
+    serialize_entity,
+    serialize_event,
+)
+from happysim_tpu.visual.topology import discover
+
+MAX_EVENT_LOG = 5000
+MAX_LOG_BUFFER = 5000
+MAX_HISTORY_SAMPLES = 10_000
+SNAPSHOT_MIN_INTERVAL_S = 0.05
+
+
+@dataclass
+class RecordedLog:
+    time_s: Optional[float]
+    level: str
+    logger_name: str
+    message: str
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "time_s": self.time_s,
+            "level": self.level,
+            "logger_name": self.logger_name,
+            "message": self.message,
+        }
+
+
+class _BridgeLogHandler(logging.Handler):
+    def __init__(self, bridge: "SimulationBridge"):
+        super().__init__(level=logging.DEBUG)
+        self._bridge = bridge
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            time_s = None
+            try:
+                time_s = self._bridge.sim.now.to_seconds()
+            except Exception:
+                pass
+            name = record.name
+            if name.startswith("happysim_tpu."):
+                name = name[len("happysim_tpu."):]
+            self._bridge._record_log(
+                RecordedLog(
+                    time_s=time_s,
+                    level=record.levelname,
+                    logger_name=name,
+                    message=record.getMessage(),
+                )
+            )
+        except Exception:
+            self.handleError(record)
+
+
+class SimulationBridge:
+    """Everything the REST server needs, behind one lock."""
+
+    def __init__(self, sim, charts: Optional[list] = None):
+        self.sim = sim
+        self.charts = charts or []
+        self.topology = discover(sim)
+        self.code_debugger = CodeDebugger()
+        sim._code_debugger = self.code_debugger
+        self._lock = threading.Lock()
+        # Serializes the control verbs: each HTTP request runs on its own
+        # thread, and two threads inside sim.run() would corrupt the heap.
+        self._control_lock = threading.Lock()
+        self._events: deque[dict] = deque(maxlen=MAX_EVENT_LOG)
+        self._logs: deque[RecordedLog] = deque(maxlen=MAX_LOG_BUFFER)
+        self._event_serial = 0
+        self._entity_history: dict[str, list[tuple[float, dict]]] = {}
+        self._last_snapshot_s = -1.0
+        self._edge_counts: dict[tuple[str, str], int] = {}
+        self._last_target: Optional[str] = None
+        sim.control.on_event(self._on_event)
+        self._log_handler = _BridgeLogHandler(self)
+        logging.getLogger("happysim_tpu").addHandler(self._log_handler)
+
+    def close(self) -> None:
+        """Detach everything: log handler, event hook, code debugger.
+
+        Leaves the simulation on its fast loop again — a closed bridge
+        must not keep taxing (or observing) the run.
+        """
+        logging.getLogger("happysim_tpu").removeHandler(self._log_handler)
+        self.sim.control.remove_on_event(self._on_event)
+        if getattr(self.sim, "_code_debugger", None) is self.code_debugger:
+            self.sim._code_debugger = None
+
+    # -- recording ---------------------------------------------------------
+    def _on_event(self, event) -> None:
+        serialized = serialize_event(event)
+        with self._lock:
+            self._event_serial += 1
+            serialized["seq"] = self._event_serial
+            self._events.append(serialized)
+            if self._last_target is not None and not serialized["is_internal"]:
+                edge = (self._last_target, serialized["target"])
+                if edge[0] != edge[1]:
+                    self._edge_counts[edge] = self._edge_counts.get(edge, 0) + 1
+            if not serialized["is_internal"]:
+                self._last_target = serialized["target"]
+        self._maybe_snapshot(event.time.to_seconds())
+
+    def _maybe_snapshot(self, time_s: float) -> None:
+        if time_s - self._last_snapshot_s < SNAPSHOT_MIN_INTERVAL_S:
+            return
+        self._last_snapshot_s = time_s
+        for name, entity in self.topology.entities.items():
+            history = self._entity_history.setdefault(name, [])
+            if len(history) < MAX_HISTORY_SAMPLES:
+                history.append((time_s, serialize_entity(entity)))
+
+    def _record_log(self, entry: RecordedLog) -> None:
+        with self._lock:
+            self._logs.append(entry)
+
+    # -- queries -----------------------------------------------------------
+    def state(self) -> dict[str, Any]:
+        control_state = self.sim.control.get_state()
+        return {
+            "time_s": control_state.time.to_seconds(),
+            "events_processed": control_state.events_processed,
+            "pending_events": control_state.pending_events,
+            "is_paused": control_state.is_paused,
+            "is_completed": control_state.is_completed,
+            "entities": {
+                name: serialize_entity(entity)
+                for name, entity in self.topology.entities.items()
+            },
+        }
+
+    def events(self, since_seq: int = 0, include_internal: bool = False) -> list[dict]:
+        with self._lock:
+            return [
+                e
+                for e in self._events
+                if e["seq"] > since_seq
+                and (include_internal or not e["is_internal"])
+            ]
+
+    def logs(self, limit: int = 200) -> list[dict]:
+        with self._lock:
+            return [entry.to_dict() for entry in list(self._logs)[-limit:]]
+
+    def edge_traffic(self) -> list[dict]:
+        with self._lock:
+            return [
+                {"source": a, "target": b, "count": count}
+                for (a, b), count in self._edge_counts.items()
+            ]
+
+    def timeseries(self, entity_name: str) -> list[dict]:
+        history = self._entity_history.get(entity_name, [])
+        return [{"time_s": t, "state": snapshot} for t, snapshot in history]
+
+    def chart_data(self) -> list[dict]:
+        return [chart.series() for chart in self.charts]
+
+    def entity_source(self, entity_name: str) -> Optional[dict]:
+        entity = self.topology.entities.get(entity_name)
+        if entity is None:
+            return None
+        location = get_entity_source(entity)
+        return location.to_dict() if location else None
+
+    # -- control verbs -----------------------------------------------------
+    def step(self, n: int = 1) -> dict[str, Any]:
+        with self._control_lock:
+            control = self.sim.control
+            if not control.is_paused:
+                control.pause()
+                self.sim.run()
+            control.step(n)
+            return self.state()
+
+    def run_to(self, time_s: float) -> dict[str, Any]:
+        with self._control_lock:
+            control = self.sim.control
+            control.add_breakpoint(TimeBreakpoint(time_s))
+            if control.is_paused:
+                control.resume()
+            else:
+                self.sim.run()
+            return self.state()
+
+    def run_all(self) -> dict[str, Any]:
+        with self._control_lock:
+            control = self.sim.control
+            if control.is_paused:
+                control.resume()
+            else:
+                self.sim.run()
+            return self.state()
+
+    def reset(self) -> dict[str, Any]:
+        with self._control_lock:
+            self.sim.control.reset()
+            with self._lock:
+                self._events.clear()
+                self._logs.clear()
+                self._edge_counts.clear()
+                self._last_target = None
+                self._entity_history.clear()
+                self._last_snapshot_s = -1.0
+            return self.state()

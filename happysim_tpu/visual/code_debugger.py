@@ -1,0 +1,257 @@
+"""Line-level stepping through generator-based entity handlers.
+
+Parity target: ``happysimulator/visual/code_debugger.py:140``
+(``CodeDebugger``) — installs a frame trace function on an activated
+entity's generator (via the hook in ``ProcessContinuation.invoke``,
+core/event.py), records per-line execution for animated replay, and
+blocks at code breakpoints on a ``threading.Event`` gate until the
+client continues/steps (with a deadman timeout so a vanished client
+can't hang the simulation).
+"""
+
+from __future__ import annotations
+
+import inspect
+import sys
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+DEADMAN_TIMEOUT_S = 30.0
+
+
+@dataclass
+class CodeBreakpoint:
+    entity_name: str = ""
+    line_number: int = 0  # absolute 1-indexed file line
+    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "entity_name": self.entity_name,
+            "line_number": self.line_number,
+        }
+
+
+@dataclass
+class CodeLocation:
+    entity_name: str
+    class_name: str
+    method_name: str
+    source_lines: list[str]
+    start_line: int
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "entity_name": self.entity_name,
+            "class_name": self.class_name,
+            "method_name": self.method_name,
+            "source_lines": self.source_lines,
+            "start_line": self.start_line,
+        }
+
+
+@dataclass
+class LineRecord:
+    line_number: int
+    locals_snapshot: Optional[dict[str, Any]] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"line_number": self.line_number}
+        if self.locals_snapshot is not None:
+            out["locals"] = self.locals_snapshot
+        return out
+
+
+@dataclass
+class ExecutionTrace:
+    entity_name: str
+    method_name: str
+    start_line: int
+    lines: list[LineRecord] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "entity_name": self.entity_name,
+            "method_name": self.method_name,
+            "start_line": self.start_line,
+            "lines": [line.to_dict() for line in self.lines],
+        }
+
+
+def _snapshot_locals(frame_locals: dict[str, Any]) -> dict[str, Any]:
+    out = {}
+    for key, value in frame_locals.items():
+        if key.startswith("_") or key == "self":
+            continue
+        if value is None or isinstance(value, (bool, int, float, str)):
+            out[key] = value
+        else:
+            out[key] = repr(value)[:200]
+    return out
+
+
+def entity_source(entity: Any) -> Optional[CodeLocation]:
+    """Source lines of the entity's handler (for the code panel)."""
+    for method_name in ("handle_queued_event", "handle_event"):
+        method = getattr(type(entity), method_name, None)
+        if method is None:
+            continue
+        try:
+            lines, start = inspect.getsourcelines(method)
+        except (OSError, TypeError):
+            continue
+        return CodeLocation(
+            entity_name=getattr(entity, "name", type(entity).__name__),
+            class_name=type(entity).__name__,
+            method_name=method_name,
+            source_lines=[line.rstrip("\n") for line in lines],
+            start_line=start,
+        )
+    return None
+
+
+class CodeDebugger:
+    """Implements the engine's wants/attach/detach tracing protocol."""
+
+    def __init__(self):
+        self._active: dict[str, Any] = {}  # entity name -> entity
+        self._breakpoints: list[CodeBreakpoint] = []
+        self._traces: list[ExecutionTrace] = []
+        self._current: Optional[ExecutionTrace] = None
+        self._capture_locals = True
+        # Breakpoint gate: the sim thread waits; the API thread releases.
+        self._resume_gate = threading.Event()
+        self._paused_at: Optional[dict[str, Any]] = None
+        self._step_mode = False
+        # sys.settrace is THREAD-local; each thread that runs the sim
+        # (ThreadingHTTPServer uses one per request) installs its own.
+        self._traced_threads: set[int] = set()
+        self._lock = threading.Lock()
+
+    # -- client surface ----------------------------------------------------
+    def activate_entity(self, entity: Any) -> Optional[CodeLocation]:
+        name = getattr(entity, "name", type(entity).__name__)
+        self._active[name] = entity
+        return entity_source(entity)
+
+    def deactivate_entity(self, name: str) -> None:
+        self._active.pop(name, None)
+
+    def add_breakpoint(self, entity_name: str, line_number: int) -> CodeBreakpoint:
+        breakpoint_ = CodeBreakpoint(entity_name=entity_name, line_number=line_number)
+        self._breakpoints.append(breakpoint_)
+        return breakpoint_
+
+    def remove_breakpoint(self, breakpoint_id: str) -> None:
+        self._breakpoints = [b for b in self._breakpoints if b.id != breakpoint_id]
+
+    @property
+    def breakpoints(self) -> list[CodeBreakpoint]:
+        return list(self._breakpoints)
+
+    @property
+    def paused_at(self) -> Optional[dict[str, Any]]:
+        return self._paused_at
+
+    def resume(self, step: bool = False) -> None:
+        """Release a breakpoint pause; ``step=True`` re-pauses next line."""
+        self._step_mode = step
+        self._resume_gate.set()
+
+    def drain_traces(self) -> list[ExecutionTrace]:
+        with self._lock:
+            traces, self._traces = self._traces, []
+        return traces
+
+    # -- engine protocol (core/event.py) -----------------------------------
+    def wants(self, target: Any) -> bool:
+        name = getattr(target, "name", None)
+        if name in self._active:
+            return True
+        owner = getattr(target, "_owner", None)  # QueuedResource worker
+        return getattr(owner, "name", None) in self._active
+
+    def attach(self, target: Any, process: Any) -> None:
+        frame = getattr(process, "gi_frame", None)
+        if frame is None:
+            return
+        name = getattr(target, "name", None)
+        owner = getattr(target, "_owner", None)
+        if name not in self._active and owner is not None:
+            name = getattr(owner, "name", None)
+        self._current = ExecutionTrace(
+            entity_name=name or "?",
+            method_name=frame.f_code.co_name,
+            start_line=frame.f_code.co_firstlineno,
+        )
+        frame.f_trace = self._trace_line
+        frame.f_trace_lines = True
+        # Frame-level f_trace only fires while thread-level tracing is on;
+        # install a selective tracer on THIS (the current sim) thread.
+        # Frames we didn't mark return None, so the overhead is one
+        # call-event check per function call while the debugger is engaged.
+        thread_id = threading.get_ident()
+        if thread_id not in self._traced_threads:
+            sys.settrace(self._thread_tracer)
+            self._traced_threads.add(thread_id)
+
+    def detach(self, process: Any) -> None:
+        frame = getattr(process, "gi_frame", None)
+        if frame is not None:
+            frame.f_trace = None
+        if self._current is not None and self._current.lines:
+            with self._lock:
+                self._traces.append(self._current)
+                if len(self._traces) > 500:
+                    del self._traces[:-500]
+        self._current = None
+        if not self._active:
+            # Uninstalls only on the calling thread (settrace is
+            # thread-local); other threads' tracers cost one no-op call
+            # check per function until they detach themselves.
+            thread_id = threading.get_ident()
+            if thread_id in self._traced_threads:
+                sys.settrace(None)
+                self._traced_threads.discard(thread_id)
+
+    def _thread_tracer(self, frame, event: str, arg):
+        """Thread tracer enabling local tracing only for marked frames."""
+        if frame.f_trace is self._trace_line:
+            return self._trace_line
+        return None
+
+    # -- the trace function -------------------------------------------------
+    def _trace_line(self, frame, event: str, arg):
+        if event != "line":
+            return self._trace_line
+        trace = self._current
+        if trace is None:
+            return self._trace_line
+        record = LineRecord(
+            line_number=frame.f_lineno,
+            locals_snapshot=_snapshot_locals(frame.f_locals)
+            if self._capture_locals
+            else None,
+        )
+        trace.lines.append(record)
+        if self._hits_breakpoint(trace.entity_name, frame.f_lineno) or self._step_mode:
+            self._step_mode = False
+            self._paused_at = {
+                "entity_name": trace.entity_name,
+                "line_number": frame.f_lineno,
+                "locals": record.locals_snapshot,
+            }
+            self._resume_gate.clear()
+            # Block the sim thread until the client resumes (or deadman).
+            self._resume_gate.wait(timeout=DEADMAN_TIMEOUT_S)
+            self._paused_at = None
+        return self._trace_line
+
+    def _hits_breakpoint(self, entity_name: str, line_number: int) -> bool:
+        return any(
+            b.entity_name == entity_name and b.line_number == line_number
+            for b in self._breakpoints
+        )

@@ -1,0 +1,65 @@
+"""Chart declarations for the visual debugger.
+
+Parity target: ``happysimulator/visual/dashboard.py:27`` (``Chart`` with
+raw/mean/p50/p99/max/rate transforms over :class:`Data` series).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+from happysim_tpu.instrumentation.data import Data
+
+TRANSFORMS = ("raw", "mean", "p50", "p99", "p999", "max", "rate")
+
+
+class Chart:
+    """One dashboard panel over a (possibly lazily-fetched) Data series."""
+
+    def __init__(
+        self,
+        title: str,
+        data: Union[Data, Callable[[], Data]],
+        transform: str = "raw",
+        window_s: float = 1.0,
+        unit: str = "",
+    ):
+        if transform not in TRANSFORMS:
+            raise ValueError(f"transform {transform!r} not in {TRANSFORMS}")
+        self.title = title
+        self._data = data
+        self.transform = transform
+        self.window_s = window_s
+        self.unit = unit
+
+    @property
+    def data(self) -> Data:
+        return self._data() if callable(self._data) else self._data
+
+    def series(self) -> dict[str, Any]:
+        """The transformed (times, values) payload for the frontend."""
+        data = self.data
+        if self.transform == "raw":
+            times = [t for t in data.times_s]
+            values = list(data.values)
+        elif self.transform == "rate":
+            rated = data.rate(self.window_s)
+            times = [t for t in rated.times_s]
+            values = list(rated.values)
+        else:
+            bucketed = data.bucket(self.window_s)
+            times = [s.to_seconds() for s in bucketed.starts]
+            values = {
+                "mean": bucketed.means,
+                "p50": bucketed.p50s,
+                "p99": bucketed.p99s,
+                "p999": bucketed.p99s,  # log-resolution limit of the buckets
+                "max": bucketed.maxes,
+            }[self.transform]
+        return {
+            "title": self.title,
+            "transform": self.transform,
+            "unit": self.unit,
+            "times": [float(t) for t in times],
+            "values": [float(v) for v in values],
+        }

@@ -1,0 +1,93 @@
+"""Type-aware JSON serialization of entities and events.
+
+Parity target: ``happysimulator/visual/serializers.py:14,131``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, is_dataclass
+from typing import Any
+
+from happysim_tpu.core.event import Event
+
+# Event types that are plumbing, not domain traffic.
+_INTERNAL_PREFIXES = (
+    "Queue.",
+    "Gate.",
+    "GC.",
+    "Breakdown.",
+    "BatchProcessor.",
+    "ShiftedServer.",
+    "PerishableInventory.",
+    "Inventory.",
+    "Appointment.",
+    "_",
+)
+_INTERNAL_SUFFIXES = (".probe",)
+
+
+def is_internal_event(event_type: str) -> bool:
+    return event_type.startswith(_INTERNAL_PREFIXES) or event_type.endswith(
+        _INTERNAL_SUFFIXES
+    )
+
+
+def _jsonable(value: Any, depth: int = 0) -> Any:
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if depth >= 2:
+        return repr(value)
+    if is_dataclass(value) and not isinstance(value, type):
+        try:
+            return {k: _jsonable(v, depth + 1) for k, v in asdict(value).items()}
+        except Exception:
+            return repr(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in list(value.items())[:50]}
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [_jsonable(v, depth + 1) for v in list(value)[:50]]
+    if hasattr(value, "to_seconds"):
+        try:
+            return value.to_seconds()
+        except Exception:
+            return repr(value)
+    return repr(value)
+
+
+def serialize_entity(entity: Any) -> dict[str, Any]:
+    """Public scalar attributes + a stats() snapshot when available."""
+    out: dict[str, Any] = {
+        "name": getattr(entity, "name", type(entity).__name__),
+        "type": type(entity).__name__,
+    }
+    for attr in dir(entity):
+        if attr.startswith("_") or attr in ("name",):
+            continue
+        try:
+            value = getattr(entity, attr)
+        except Exception:
+            continue
+        if isinstance(value, (bool, int, float, str)):
+            out[attr] = value
+    stats = getattr(entity, "stats", None)
+    try:
+        snapshot = stats() if callable(stats) else stats
+        if snapshot is not None and is_dataclass(snapshot):
+            out["stats"] = _jsonable(snapshot)
+    except Exception:
+        pass
+    return out
+
+
+def serialize_event(event: Event) -> dict[str, Any]:
+    return {
+        "time_s": event.time.to_seconds(),
+        "event_type": event.event_type,
+        "target": getattr(event.target, "name", type(event.target).__name__),
+        "event_id": event._id,
+        "daemon": event.daemon,
+        "is_internal": is_internal_event(event.event_type),
+        "context": _jsonable(
+            {k: v for k, v in event.context.items() if k not in ("metadata",)}
+        ),
+    }

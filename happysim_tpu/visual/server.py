@@ -1,0 +1,206 @@
+"""Dependency-free REST server for the visual debugger.
+
+Parity target: ``happysimulator/visual/server.py:27-216``. The reference
+serves FastAPI + a WebSocket; this implementation runs on the standard
+library (``ThreadingHTTPServer``) with the same REST surface, and the
+play loop uses ``GET /api/poll?since=N`` long-polling instead of a
+WebSocket — same incremental event/log stream, zero dependencies.
+
+Endpoints:
+  GET  /api/topology                 nodes + edges (+ live edge traffic)
+  GET  /api/state                    time, counters, entity snapshots
+  POST /api/step?n=K                 process K events (pauses first)
+  POST /api/run_to?t=SECONDS         run until simulated time t
+  POST /api/run                      run to completion/next breakpoint
+  POST /api/reset                    rewind (sources re-primed)
+  GET  /api/events?since=N           recorded events after seq N
+  GET  /api/logs?limit=N             captured library logs
+  GET  /api/poll?since=N             {state, events, logs, traces}
+  GET  /api/timeseries/{entity}      entity state history
+  GET  /api/chart_data               chart payloads
+  GET  /api/entity/{name}/source     handler source for the code panel
+  POST /api/debug/code/activate      {"entity": name}
+  POST /api/debug/code/breakpoint    {"entity": name, "line": N}
+  DELETE /api/debug/code/breakpoint  {"id": breakpoint id}
+  GET  /api/debug/code/state         {paused_at, breakpoints}
+  POST /api/debug/code/continue      {"step": bool}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+from happysim_tpu.visual.bridge import SimulationBridge
+
+
+def _make_handler(bridge: SimulationBridge):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        # -- plumbing ------------------------------------------------------
+        def _send(self, payload: Any, status: int = 200) -> None:
+            body = json.dumps(payload, default=str).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return {}
+            try:
+                return json.loads(self.rfile.read(length))
+            except json.JSONDecodeError:
+                return {}
+
+        def _route(self, method: str) -> None:
+            parsed = urlparse(self.path)
+            query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            try:
+                result = self._dispatch(method, parsed.path, query)
+            except Exception as exc:
+                self._send({"error": str(exc)}, status=500)
+                return
+            if result is None:
+                self._send({"error": f"not found: {method} {parsed.path}"}, 404)
+            else:
+                self._send(result)
+
+        # -- routing -------------------------------------------------------
+        def _dispatch(self, method: str, path: str, query: dict) -> Optional[Any]:
+            if method == "GET":
+                if path == "/api/topology":
+                    payload = bridge.topology.to_dict()
+                    payload["traffic"] = bridge.edge_traffic()
+                    return payload
+                if path == "/api/state":
+                    return bridge.state()
+                if path == "/api/events":
+                    return {"events": bridge.events(int(query.get("since", 0)))}
+                if path == "/api/logs":
+                    return {"logs": bridge.logs(int(query.get("limit", 200)))}
+                if path == "/api/poll":
+                    since = int(query.get("since", 0))
+                    return {
+                        "state": bridge.state(),
+                        "events": bridge.events(since),
+                        "logs": bridge.logs(50),
+                        "traces": [
+                            t.to_dict() for t in bridge.code_debugger.drain_traces()
+                        ],
+                    }
+                if path == "/api/chart_data":
+                    return {"charts": bridge.chart_data()}
+                if path.startswith("/api/timeseries/"):
+                    entity = path.rsplit("/", 1)[1]
+                    return {"entity": entity, "samples": bridge.timeseries(entity)}
+                if path.startswith("/api/entity/") and path.endswith("/source"):
+                    entity = path.split("/")[3]
+                    source = bridge.entity_source(entity)
+                    return source or {"error": "no source", "entity": entity}
+                if path == "/api/debug/code/state":
+                    debugger = bridge.code_debugger
+                    return {
+                        "paused_at": debugger.paused_at,
+                        "breakpoints": [b.to_dict() for b in debugger.breakpoints],
+                    }
+                return None
+            if method == "POST":
+                if path == "/api/step":
+                    return bridge.step(int(query.get("n", 1)))
+                if path == "/api/run_to":
+                    return bridge.run_to(float(query["t"]))
+                if path == "/api/run":
+                    return bridge.run_all()
+                if path == "/api/reset":
+                    return bridge.reset()
+                if path == "/api/debug/code/activate":
+                    body = self._body()
+                    entity = bridge.topology.entities.get(body.get("entity"))
+                    if entity is None:
+                        return {"error": "unknown entity"}
+                    location = bridge.code_debugger.activate_entity(entity)
+                    return location.to_dict() if location else {"error": "no source"}
+                if path == "/api/debug/code/breakpoint":
+                    body = self._body()
+                    breakpoint_ = bridge.code_debugger.add_breakpoint(
+                        body.get("entity", ""), int(body.get("line", 0))
+                    )
+                    return breakpoint_.to_dict()
+                if path == "/api/debug/code/continue":
+                    bridge.code_debugger.resume(step=bool(self._body().get("step")))
+                    return {"ok": True}
+                return None
+            if method == "DELETE":
+                if path == "/api/debug/code/breakpoint":
+                    bridge.code_debugger.remove_breakpoint(self._body().get("id", ""))
+                    return {"ok": True}
+                return None
+            return None
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+    return Handler
+
+
+class DebugServer:
+    """Owns the HTTP server thread; ``with DebugServer(sim) as url: ...``"""
+
+    def __init__(self, sim, charts: Optional[list] = None, port: int = 0):
+        self.bridge = SimulationBridge(sim, charts=charts)
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", port), _make_handler(self.bridge)
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "DebugServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.bridge.close()
+
+    def __enter__(self) -> "DebugServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def serve(sim, charts: Optional[list] = None, port: int = 8000, blocking: bool = True):
+    """Start the visual debugger for ``sim`` (the reference's entry point).
+
+    Non-blocking mode returns the :class:`DebugServer` so callers (and
+    tests) can drive the REST API programmatically.
+    """
+    server = DebugServer(sim, charts=charts, port=port).start()
+    print(f"happysim_tpu visual debugger at {server.url} (Ctrl-C to stop)")
+    if not blocking:
+        return server
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return server

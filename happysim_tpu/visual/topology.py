@@ -1,0 +1,101 @@
+"""Entity-graph discovery for the visual debugger.
+
+Parity target: ``happysimulator/visual/topology.py:225`` — walks
+``downstream_entities()`` from the simulation's registered entities and
+sources, classifying nodes by component family.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+_KIND_BY_SUBSTRING = [
+    ("Source", "source"),
+    ("Sink", "sink"),
+    ("Tracker", "sink"),
+    ("Counter", "sink"),
+    ("LoadBalancer", "router"),
+    ("Router", "router"),
+    ("Queue", "queue"),
+    ("Server", "server"),
+    ("Pool", "server"),
+    ("Client", "client"),
+    ("Network", "network"),
+    ("Saga", "orchestrator"),
+    ("Gateway", "gateway"),
+]
+
+
+@dataclass
+class TopologyNode:
+    id: str
+    kind: str
+    type_name: str
+    group: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "kind": self.kind,
+            "type": self.type_name,
+            "group": self.group,
+        }
+
+
+@dataclass
+class Topology:
+    nodes: list[TopologyNode] = field(default_factory=list)
+    edges: list[tuple[str, str]] = field(default_factory=list)
+    entities: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "nodes": [n.to_dict() for n in self.nodes],
+            "edges": [{"source": a, "target": b} for a, b in self.edges],
+        }
+
+
+def _classify(entity: Any) -> str:
+    type_name = type(entity).__name__
+    for needle, kind in _KIND_BY_SUBSTRING:
+        if needle in type_name:
+            return kind
+    return "entity"
+
+
+def discover(sim: Any) -> Topology:
+    """Walk the entity graph from the simulation's roots."""
+    topology = Topology()
+    seen: set[int] = set()
+    roots = list(getattr(sim, "sources", [])) + list(getattr(sim, "entities", []))
+
+    def group_of(name: str) -> str | None:
+        # "server.queue" style internals group under their owner.
+        return name.split(".", 1)[0] if "." in name else None
+
+    def visit(entity: Any) -> None:
+        if id(entity) in seen:
+            return
+        seen.add(id(entity))
+        name = getattr(entity, "name", type(entity).__name__)
+        topology.nodes.append(
+            TopologyNode(
+                id=name,
+                kind=_classify(entity),
+                type_name=type(entity).__name__,
+                group=group_of(name),
+            )
+        )
+        topology.entities[name] = entity
+        downstream = getattr(entity, "downstream_entities", None)
+        for child in (downstream() if callable(downstream) else []) or []:
+            if child is None:
+                continue
+            child_name = getattr(child, "name", type(child).__name__)
+            topology.edges.append((name, child_name))
+            visit(child)
+
+    for root in roots:
+        visit(root)
+    return topology

@@ -1,0 +1,224 @@
+"""Visual debugger: topology discovery, REST surface over a live HTTP
+server, chart payloads, and generator code stepping."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Event,
+    Instant,
+    Probe,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.visual import (
+    Chart,
+    CodeDebugger,
+    DebugServer,
+    SimulationBridge,
+    discover,
+    serialize_entity,
+    serialize_event,
+)
+
+
+def build_sim(duration=60.0):
+    sink = Sink("sink")
+    server = Server("srv", service_time=ConstantLatency(0.01), downstream=sink)
+    source = Source.constant(rate=20.0, target=server, stop_after=duration)
+    probe = Probe.on(server, "queue_depth", interval_s=0.1)
+    sim = Simulation(
+        sources=[source], entities=[server, sink], probes=[probe],
+        end_time=Instant.from_seconds(duration),
+    )
+    return sim, server, sink, probe
+
+
+class TestTopology:
+    def test_discovers_nodes_and_edges(self):
+        sim, server, sink, _ = build_sim()
+        topology = discover(sim)
+        ids = {n.id for n in topology.nodes}
+        assert {"srv", "sink", "srv.queue"} <= ids
+        assert ("srv", "srv.queue") in topology.edges
+        kinds = {n.id: n.kind for n in topology.nodes}
+        assert kinds["sink"] == "sink"
+        assert kinds["srv"] == "server"
+        # Internal children group under their owner.
+        groups = {n.id: n.group for n in topology.nodes}
+        assert groups["srv.queue"] == "srv"
+
+
+class TestSerializers:
+    def test_entity_snapshot(self):
+        sim, server, sink, _ = build_sim()
+        snapshot = serialize_entity(server)
+        assert snapshot["name"] == "srv"
+        assert snapshot["type"] == "Server"
+        assert "requests_completed" in snapshot
+
+    def test_event_payload(self):
+        sink = Sink("sink")
+        event = Event(Instant.from_seconds(1.5), "Request", target=sink)
+        payload = serialize_event(event)
+        assert payload["time_s"] == 1.5
+        assert payload["target"] == "sink"
+        assert payload["is_internal"] is False
+
+
+class TestBridge:
+    def test_step_run_to_reset(self):
+        sim, server, sink, _ = build_sim()
+        bridge = SimulationBridge(sim)
+        state = bridge.step(10)
+        assert state["events_processed"] == 10
+        assert state["is_paused"]
+        state = bridge.run_to(1.0)
+        assert state["time_s"] <= 1.01
+        assert sink.events_received > 0
+        events = bridge.events()
+        assert events and all(not e["is_internal"] for e in events)
+        state = bridge.reset()
+        assert state["events_processed"] == 0
+        assert bridge.events() == []
+        bridge.close()
+
+    def test_entity_history_snapshots(self):
+        sim, *_ = build_sim()
+        bridge = SimulationBridge(sim)
+        bridge.run_to(2.0)
+        samples = bridge.timeseries("srv")
+        assert len(samples) > 5
+        assert samples[0]["state"]["name"] == "srv"
+        bridge.close()
+
+
+class TestChart:
+    def test_transforms(self):
+        sim, server, sink, probe = build_sim()
+        bridge = SimulationBridge(
+            sim,
+            charts=[
+                Chart("depth", lambda: probe.data, "raw"),
+                Chart("latency p99", lambda: sink.latency_data, "p99", window_s=0.5),
+            ],
+        )
+        bridge.run_to(5.0)
+        charts = bridge.chart_data()
+        assert charts[0]["title"] == "depth"
+        assert len(charts[0]["times"]) > 10
+        assert charts[1]["transform"] == "p99"
+        assert all(v >= 0 for v in charts[1]["values"])
+        bridge.close()
+
+    def test_bad_transform_rejected(self):
+        with pytest.raises(ValueError):
+            Chart("x", lambda: None, "median")
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=10) as response:
+        return json.loads(response.read())
+
+
+def post(url, body=None):
+    data = json.dumps(body or {}).encode()
+    request = urllib.request.Request(url, data=data, method="POST")
+    with urllib.request.urlopen(request, timeout=10) as response:
+        return json.loads(response.read())
+
+
+class TestRestServer:
+    def test_full_rest_surface(self):
+        sim, *_ = build_sim()
+        with DebugServer(sim, port=0) as server:
+            base = server.url
+            topology = get(f"{base}/api/topology")
+            assert {n["id"] for n in topology["nodes"]} >= {"srv", "sink"}
+
+            state = post(f"{base}/api/step?n=5")
+            assert state["events_processed"] == 5
+
+            state = post(f"{base}/api/run_to?t=1.0")
+            assert state["time_s"] <= 1.01
+
+            events = get(f"{base}/api/events?since=0")["events"]
+            assert events
+            seq = events[-1]["seq"]
+            poll = get(f"{base}/api/poll?since={seq}")
+            assert poll["events"] == []
+            assert poll["state"]["time_s"] == state["time_s"]
+
+            series = get(f"{base}/api/timeseries/srv")
+            assert series["samples"]
+
+            source = get(f"{base}/api/entity/srv/source")
+            assert source["class_name"] == "Server"
+            assert any("def handle_queued_event" in line
+                       for line in source["source_lines"])
+
+            state = post(f"{base}/api/reset")
+            assert state["events_processed"] == 0
+
+            final = post(f"{base}/api/run")
+            assert final["is_completed"]
+            with pytest.raises(urllib.error.HTTPError) as excinfo:
+                get(f"{base}/api/nope")
+            assert excinfo.value.code == 404
+
+
+class TestCodeStepping:
+    def test_traces_record_generator_lines(self):
+        sim, server, sink, _ = build_sim(duration=1.0)
+        bridge = SimulationBridge(sim)
+        location = bridge.code_debugger.activate_entity(server)
+        assert location.method_name == "handle_queued_event"
+        bridge.run_all()
+        traces = bridge.code_debugger.drain_traces()
+        assert traces
+        assert traces[0].entity_name == "srv"
+        lines = [record.line_number for record in traces[0].lines]
+        # Lines fall inside the handler's source span.
+        assert all(
+            location.start_line <= n < location.start_line + len(location.source_lines)
+            for n in lines
+        )
+        bridge.close()
+
+    def test_code_breakpoint_blocks_until_continue(self):
+        sim, server, sink, _ = build_sim(duration=1.0)
+        bridge = SimulationBridge(sim)
+        location = bridge.code_debugger.activate_entity(server)
+        # Break on the first executable line of the handler.
+        bridge.code_debugger.add_breakpoint("srv", location.start_line + 1)
+
+        finished = threading.Event()
+
+        def run():
+            bridge.run_all()
+            finished.set()
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        # The sim thread must hit the gate and pause.
+        for _ in range(100):
+            if bridge.code_debugger.paused_at is not None:
+                break
+            threading.Event().wait(0.02)
+        paused = bridge.code_debugger.paused_at
+        assert paused is not None and paused["entity_name"] == "srv"
+        assert not finished.is_set()
+        # Remove the breakpoint and release; the run completes.
+        bridge.code_debugger.remove_breakpoint(
+            bridge.code_debugger.breakpoints[0].id
+        )
+        bridge.code_debugger.resume()
+        assert finished.wait(timeout=20)
+        bridge.close()
