@@ -133,7 +133,10 @@ class Data:
 class BucketedData:
     """Fixed-window aggregation of a :class:`Data` series."""
 
-    __slots__ = ("window_s", "starts", "counts", "means", "mins", "maxes", "sums", "p50s", "p99s")
+    __slots__ = (
+        "window_s", "starts", "counts", "means", "mins", "maxes", "sums",
+        "p50s", "p99s", "p999s",
+    )
 
     def __init__(self, data: Data, window_s: float):
         self.window_s = window_s
@@ -145,6 +148,7 @@ class BucketedData:
         self.sums: list[float] = []
         self.p50s: list[float] = []
         self.p99s: list[float] = []
+        self.p999s: list[float] = []
         if not data._values:
             return
         window_ns = int(round(window_s * 1e9))
@@ -162,6 +166,7 @@ class BucketedData:
             self.sums.append(float(values.sum()))
             self.p50s.append(float(np.percentile(values, 50)))
             self.p99s.append(float(np.percentile(values, 99)))
+            self.p999s.append(float(np.percentile(values, 99.9)))
 
     def __len__(self) -> int:
         return len(self.starts)

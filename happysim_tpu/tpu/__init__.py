@@ -17,6 +17,12 @@ from happysim_tpu.tpu.mesh import (
 from happysim_tpu.tpu.engine import EnsembleResult, hist_percentile, run_ensemble
 from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
 from happysim_tpu.tpu.model import EnsembleModel, mm1_model, pipeline_model
+from happysim_tpu.tpu.partitioned import (
+    PARTITION_AXIS,
+    PartitionedResult,
+    partition_mesh,
+    run_partitioned,
+)
 
 __all__ = [
     "EnsembleModel",
@@ -27,6 +33,10 @@ __all__ = [
     "pipeline_model",
     "run_ensemble",
     "run_mm1_ensemble",
+    "run_partitioned",
+    "PARTITION_AXIS",
+    "PartitionedResult",
+    "partition_mesh",
     "REPLICA_AXIS",
     "pad_to_multiple",
     "replica_mesh",

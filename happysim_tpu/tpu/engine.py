@@ -189,8 +189,8 @@ class EnsembleResult:
 class _Compiled:
     """Static arrays + closures derived from an EnsembleModel."""
 
-    def __init__(self, model: EnsembleModel):
-        model.validate()
+    def __init__(self, model: EnsembleModel, allow_remote: bool = False):
+        model.validate(allow_remote=allow_remote)
         self.model = model
         self.nS = len(model.sources)
         self.nV = max(len(model.servers), 1)
@@ -761,9 +761,29 @@ class _Compiled:
         return self._arrive_server(state, v, t, created, 0, u[1], params)
 
     # -- the step ----------------------------------------------------------
-    def make_step(self, horizon: float):
-        nS, nV = self.nS, self.nV
+    def next_candidates(self, state):
+        """The fixed-size next-event vector (the heap replacement)."""
+        nV_real = len(self.model.servers)
         slot_valid = jnp.asarray(self.slot_valid)
+        srv_done = jnp.where(slot_valid, state["srv_slot_done"], INF)
+        srv_next = (
+            jnp.min(srv_done, axis=1) if nV_real else jnp.full((self.nV,), INF)
+        )
+        parts = [state["src_next"]]
+        if nV_real:
+            parts.append(srv_next[:nV_real])
+            if self.has_transit:
+                parts.append(jnp.min(state["tr_time"], axis=1)[:nV_real])
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def make_step(self, horizon: Optional[float] = None, windowed: bool = False):
+        """The one-event scan step.
+
+        ``windowed=False`` (ensemble mode): static ``horizon``, carry is
+        (state, params). ``windowed=True`` (partitioned mode): the horizon
+        is the traced window end carried as (state, params, window_end).
+        """
+        nS = self.nS
         nV_real = len(self.model.servers)
 
         branches = (
@@ -777,24 +797,22 @@ class _Compiled:
         )
 
         def step(carry, step_index):
-            state, params = carry
-            src_next = state["src_next"]
-            srv_done = jnp.where(slot_valid, state["srv_slot_done"], INF)
-            srv_next = jnp.min(srv_done, axis=1) if nV_real else jnp.full((nV,), INF)
-            parts = [src_next]
-            if nV_real:
-                parts.append(srv_next[:nV_real])
-                if self.has_transit:
-                    parts.append(jnp.min(state["tr_time"], axis=1)[:nV_real])
-            candidates = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            if windowed:
+                state, params, limit = carry
+            else:
+                state, params = carry
+                limit = horizon
+            candidates = self.next_candidates(state)
             event_index = jnp.argmin(candidates)
             t_next = candidates[event_index]
-            done = jnp.isinf(t_next) | (t_next > horizon)
+            done = jnp.isinf(t_next) | (t_next > limit)
 
             # One RNG draw per step, shared by whichever branch runs (under
             # vmap all branches execute predicated, so hoisting halves the
-            # threefry work versus drawing inside each branch).
-            step_key = jax.random.fold_in(state["key"], step_index)
+            # threefry work versus drawing inside each branch). Keyed on
+            # the MONOTONE event counter so windowed reruns of the scan
+            # never replay a stream (the per-window scan index restarts).
+            step_key = jax.random.fold_in(state["key"], state["events"])
             u = jax.random.uniform(step_key, (4,), minval=1e-12, maxval=1.0)
 
             def process(state):
@@ -812,7 +830,7 @@ class _Compiled:
                 return lax.switch(event_index, branches, state, t_next, u, params)
 
             state = lax.cond(done, lambda s: s, process, state)
-            return (state, params), None
+            return ((state, params, limit) if windowed else (state, params)), None
 
         return step
 

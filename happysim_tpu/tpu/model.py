@@ -31,6 +31,8 @@ SERVER = "server"
 SINK = "sink"
 ROUTER = "router"
 LIMITER = "limiter"
+# Cross-partition egress (partitioned mode only; run_ensemble rejects it).
+REMOTE = "remote"
 
 ARRIVAL_KINDS = ("poisson", "constant")
 SERVICE_KINDS = ("exponential", "constant")
@@ -118,6 +120,22 @@ class RouterSpec:
 
 
 @dataclass
+class RemoteSpec:
+    """Cross-partition egress point (partitioned execution only).
+
+    Jobs delivered here leave the partition: they ride the outbox to the
+    neighbor partition (ring ppermute), arriving at its ``ingress``
+    server after ``latency_s``. The conservative-window contract requires
+    ``latency_s >= window_s`` (events can't affect the window they were
+    sent in) — the same correctness argument as the host
+    WindowedCoordinator (SURVEY §2.5).
+    """
+
+    latency_s: float = 0.01
+    ingress: Optional[NodeRef] = None
+
+
+@dataclass
 class LimiterSpec:
     """Token bucket: ``refill_rate``/s up to ``capacity``; one token per
     job; jobs without a token are dropped (counted)."""
@@ -163,6 +181,7 @@ class EnsembleModel:
         self.routers: list[RouterSpec] = []
         self.limiters: list[LimiterSpec] = []
         self.sinks: list[SinkSpec] = []
+        self.remotes: list[RemoteSpec] = []
 
     # -- builders ----------------------------------------------------------
     def source(
@@ -278,6 +297,17 @@ class EnsembleModel:
         self.sinks.append(SinkSpec())
         return NodeRef(SINK, len(self.sinks) - 1)
 
+    def remote(self, ingress: NodeRef, latency_s: float) -> NodeRef:
+        """Cross-partition egress: jobs exit here and arrive at the
+        NEIGHBOR partition's ``ingress`` server after ``latency_s``
+        (partitioned execution only)."""
+        if ingress.kind != SERVER:
+            raise ValueError("remote ingress must be a server")
+        if latency_s <= 0:
+            raise ValueError("remote latency_s must be > 0 (window contract)")
+        self.remotes.append(RemoteSpec(latency_s=latency_s, ingress=ingress))
+        return NodeRef(REMOTE, len(self.remotes) - 1)
+
     # -- wiring ------------------------------------------------------------
     def connect(
         self,
@@ -307,6 +337,11 @@ class EnsembleModel:
                 "edges into a router must be latency-free; put the latency "
                 "on the router's per-target edges instead"
             )
+        if downstream.kind == REMOTE and latency_s > 0:
+            raise ValueError(
+                "edges into a remote are latency-free; the remote itself "
+                "carries the cross-partition latency"
+            )
         edge = EdgeLatency(mean_s=latency_s, kind=latency_kind)
         if origin.kind == SOURCE:
             self.sources[origin.index].downstream = downstream
@@ -324,15 +359,28 @@ class EnsembleModel:
                 raise ValueError("Routers cannot target routers (single hop)")
             self.routers[origin.index].targets.append(downstream)
             self.routers[origin.index].target_latencies.append(edge)
+        elif origin.kind == REMOTE:
+            raise ValueError(
+                "a remote's destination is fixed: jobs arrive at its "
+                "ingress server on the neighbor partition"
+            )
         else:
             raise ValueError("Sinks have no downstream")
 
     # -- validation --------------------------------------------------------
-    def validate(self) -> None:
+    def validate(self, allow_remote: bool = False) -> None:
         if not self.sources:
             raise ValueError("Model needs at least one source")
         if not self.sinks:
             raise ValueError("Model needs at least one sink")
+        if self.remotes and not allow_remote:
+            raise ValueError(
+                "model has remote() egress nodes — use run_partitioned, "
+                "not run_ensemble"
+            )
+        for i, remote in enumerate(self.remotes):
+            if remote.ingress is None or remote.ingress.kind != SERVER:
+                raise ValueError(f"remote[{i}] needs a server ingress")
         for i, source in enumerate(self.sources):
             if source.downstream is None:
                 raise ValueError(f"source[{i}] has no downstream")
@@ -362,9 +410,23 @@ class EnsembleModel:
                         f"router[{i}] targets a limiter (route after, not into, "
                         "admission)"
                     )
-            if len(kinds) > 1:
+                if target.kind == REMOTE and not allow_remote:
+                    raise ValueError(
+                        f"router[{i}] targets a remote — partitioned mode only"
+                    )
+            # Homogeneous server/sink sets, plus (partitioned) sink+remote
+            # mixes, which model "stay local or hop to the neighbor".
+            allowed = kinds in ({SERVER}, {SINK}, set()) or (
+                allow_remote and kinds <= {SINK, REMOTE}
+            )
+            if not allowed:
                 raise ValueError(
-                    f"router[{i}] targets must be all servers or all sinks"
+                    f"router[{i}] targets must be all servers, all sinks, or "
+                    "(partitioned) sinks+remotes"
+                )
+            if REMOTE in kinds and router.policy != "random":
+                raise ValueError(
+                    f"router[{i}]: remote targets require the 'random' policy"
                 )
             if router.policy == "least_outstanding" and kinds == {SINK}:
                 raise ValueError(

@@ -53,7 +53,7 @@ class Chart:
                 "mean": bucketed.means,
                 "p50": bucketed.p50s,
                 "p99": bucketed.p99s,
-                "p999": bucketed.p99s,  # log-resolution limit of the buckets
+                "p999": bucketed.p999s,
                 "max": bucketed.maxes,
             }[self.transform]
         return {
