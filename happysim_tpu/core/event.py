@@ -108,7 +108,7 @@ class Event:
         "target",
         "daemon",
         "on_complete",
-        "context",
+        "_context",
         "_sort_index",
         "_id",
         "_cancelled",
@@ -136,17 +136,29 @@ class Event:
         self._sort_index = _next_sort_index()
         self._id = self._sort_index
         self._cancelled = False
+        # Context is LAZY when not provided: events that never touch it
+        # (heap ticks, probe daemons, large pre-scheduled batches) skip
+        # three allocations each — the dominant share of per-event memory.
+        self._context: Optional[dict[str, Any]] = context
         if context is not None:
-            self.context = context
             context.setdefault("id", str(self._id))
             context.setdefault("created_at", time)
             context.setdefault("metadata", {})
-        else:
-            self.context = {
+
+    @property
+    def context(self) -> dict[str, Any]:
+        ctx = self._context
+        if ctx is None:
+            ctx = self._context = {
                 "id": str(self._id),
-                "created_at": time,
+                "created_at": self.time,
                 "metadata": {},
             }
+        return ctx
+
+    @context.setter
+    def context(self, value: dict[str, Any]) -> None:
+        self._context = value
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -212,7 +224,9 @@ class Event:
     @property
     def dropped_by(self) -> Optional[str]:
         """Who dropped this event, or None if it completed normally."""
-        return self.context.get("metadata", {}).get("dropped_by")
+        if self._context is None:  # never touched -> never dropped
+            return None
+        return self._context.get("metadata", {}).get("dropped_by")
 
     def complete_as_dropped(self, time: Instant, reason: str) -> list["Event"]:
         """Terminal unwind for an event that will never be serviced.

@@ -152,7 +152,12 @@ class Simulation:
                         "target": event.target,
                         "daemon": event.daemon,
                         "on_complete": list(event.on_complete),
-                        "context": dict(event.context),
+                        # Never-touched contexts stay lazy (None): copying
+                        # here would materialize dicts for every
+                        # pre-scheduled event; replay recreates fresh ones.
+                        "context": None
+                        if event._context is None
+                        else dict(event._context),
                     }
                 )
 
@@ -339,7 +344,7 @@ class Simulation:
                 target=spec["target"],
                 daemon=spec["daemon"],
                 on_complete=list(spec["on_complete"]),
-                context=dict(spec["context"]),
+                context=None if spec["context"] is None else dict(spec["context"]),
             )
             self.schedule(clone)
 
