@@ -202,7 +202,7 @@ class MultiPaxosNode(Entity):
                 )
             ]
         self._promised_ballot = ballot
-        self._is_leader = False
+        self._step_down()
         return [
             self._network.send(
                 source=self,
@@ -223,6 +223,10 @@ class MultiPaxosNode(Entity):
     def _handle_promise(self, event: Event) -> list[Event]:
         meta = event.context.get("metadata", {})
         if meta["ballot_number"] != self._ballot.number or self._is_leader:
+            return []
+        if self._promised_ballot is not None and self._promised_ballot > self._ballot:
+            # We promised a superior ballot since starting this candidacy:
+            # late promises for our stale ballot must not promote us.
             return []
         accepted = {
             int(slot): (Ballot(b_num, b_node), value)
@@ -370,6 +374,27 @@ class MultiPaxosNode(Entity):
             if future is not None:
                 future.resolve((entry.index, result))
 
+    def _step_down(self) -> None:
+        """Abandon leadership AND any in-progress candidacy.
+
+        In-flight client futures resolve to None — the outcome is unknown
+        (a newer leader may still re-propose the value via its phase-1
+        merge), and "unknown" must never read as "acked" (same contract as
+        raft.py's _step_down). Acceptor state (_promised_ballot, _accepted)
+        is deliberately preserved: promises outlive leaders.
+        """
+        self._is_leader = False
+        self._phase1_responses = []
+        if self._heartbeat_event is not None:
+            self._heartbeat_event.cancel()
+            self._heartbeat_event = None
+        for future in self._slot_futures.values():
+            if not future.is_resolved:
+                future.resolve(None)
+        self._slot_futures.clear()
+        self._slot_acks.clear()
+        self._slot_values.clear()
+
     # -- leadership maintenance --------------------------------------------
     def _heartbeat_tick(self) -> Event:
         if self._heartbeat_event is not None:
@@ -402,10 +427,17 @@ class MultiPaxosNode(Entity):
 
     def _handle_heartbeat(self, event: Event) -> None:
         meta = event.context.get("metadata", {})
-        if meta.get("ballot_number", 0) >= (
-            self._promised_ballot.number if self._promised_ballot else 0
-        ):
+        ballot = Ballot(meta.get("ballot_number", 0), meta.get("leader", ""))
+        if self._promised_ballot is None or ballot >= self._promised_ballot:
+            self._promised_ballot = ballot
             self._leader = meta.get("leader")
+            # A live superior leader deposes both sitting leaders and
+            # mid-phase-1 candidates (parity:
+            # happysimulator/components/consensus/multi_paxos.py:355-364) —
+            # e.g. our own prepare was partitioned away but their
+            # heartbeats get through.
+            if self._leader != self.name:
+                self._step_down()
         return None
 
     def _handle_forward(self, event: Event) -> list[Event]:

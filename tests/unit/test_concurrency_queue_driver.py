@@ -1,0 +1,184 @@
+"""Depth tests for server concurrency models and the queue<->worker driver
+protocol (ref components/server/concurrency.py:15-293,
+components/queue_driver.py:27)."""
+
+import pytest
+
+from happysim_tpu import Instant, Simulation
+from happysim_tpu.components.queue import Queue
+from happysim_tpu.components.queue_driver import QueueDriver
+from happysim_tpu.components.server.concurrency import (
+    DynamicConcurrency,
+    FixedConcurrency,
+    WeightedConcurrency,
+)
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+
+
+class TestFixedConcurrency:
+    def test_capacity_boundary(self):
+        c = FixedConcurrency(limit=2)
+        assert c.has_capacity()
+        c.acquire()
+        c.acquire()
+        assert not c.has_capacity()
+        assert c.active == 2
+
+    def test_over_acquire_raises(self):
+        c = FixedConcurrency(limit=1)
+        c.acquire()
+        with pytest.raises(RuntimeError, match="beyond concurrency limit"):
+            c.acquire()
+
+    def test_release_without_acquire_raises(self):
+        with pytest.raises(RuntimeError, match="nothing in flight"):
+            FixedConcurrency().release()
+
+    def test_invalid_limit(self):
+        with pytest.raises(ValueError):
+            FixedConcurrency(limit=0)
+
+    def test_release_restores_capacity(self):
+        c = FixedConcurrency(limit=1)
+        c.acquire()
+        c.release()
+        assert c.has_capacity()
+        assert c.active == 0
+
+
+class TestDynamicConcurrency:
+    def test_set_limit_widens_and_narrows(self):
+        c = DynamicConcurrency(initial_limit=1)
+        c.acquire()
+        assert not c.has_capacity()
+        c.set_limit(3)
+        assert c.has_capacity()
+        c.set_limit(1)
+        # Narrowing below in-flight work is allowed: existing work finishes,
+        # new admissions stop.
+        assert not c.has_capacity()
+        assert c.limit == 1
+
+    def test_invalid_limits(self):
+        with pytest.raises(ValueError):
+            DynamicConcurrency(initial_limit=0)
+        with pytest.raises(ValueError):
+            DynamicConcurrency().set_limit(0)
+
+
+class TestWeightedConcurrency:
+    def _event(self, cost):
+        class _E:
+            pass
+
+        e = _E()
+        e.cost = cost
+        return e
+
+    def test_cost_function_admission(self):
+        c = WeightedConcurrency(capacity=10.0, cost_fn=lambda e: e.cost)
+        big = self._event(8.0)
+        small = self._event(3.0)
+        assert c.has_capacity(big)
+        c.acquire(big)
+        assert not c.has_capacity(small)  # 8 + 3 > 10
+        assert c.has_capacity(self._event(2.0))
+        c.release(big)
+        assert c.active == 0.0
+
+    def test_default_unit_cost(self):
+        c = WeightedConcurrency(capacity=2.0)
+        c.acquire()
+        c.acquire()
+        assert not c.has_capacity()
+
+    def test_release_floors_at_zero(self):
+        c = WeightedConcurrency(capacity=5.0, cost_fn=lambda e: e.cost)
+        c.release(self._event(3.0))
+        assert c.active == 0.0
+
+    def test_invalid_capacity(self):
+        with pytest.raises(ValueError):
+            WeightedConcurrency(capacity=0.0)
+
+
+class _SlotWorker(Entity):
+    """Worker with explicit slots; records service order; completes instantly."""
+
+    def __init__(self, name, slots=1):
+        super().__init__(name)
+        self.slots = slots
+        self.in_flight = 0
+        self.handled = []
+
+    def has_capacity(self):
+        return self.in_flight < self.slots
+
+    def handle_event(self, event):
+        self.handled.append(event.context.get("request_id"))
+        return None
+
+
+def _enqueue(queue, t, request_id):
+    return Event(
+        Instant.from_seconds(t),
+        "Request",
+        target=queue,
+        context={"request_id": request_id},
+    )
+
+
+class TestQueueDriver:
+    def _rig(self, slots=1, capacity=None):
+        worker = _SlotWorker("worker", slots=slots)
+        queue = Queue("q", capacity=capacity) if capacity else Queue("q")
+        driver = QueueDriver("drv", queue=queue, worker=worker)
+        return queue, driver, worker
+
+    def test_single_item_flows_through(self):
+        queue, driver, worker = self._rig()
+        sim = Simulation(entities=[queue, driver, worker], end_time=Instant.from_seconds(5))
+        sim.schedule(_enqueue(queue, 1, 0))
+        sim.run()
+        assert worker.handled == [0]
+        assert queue.depth == 0
+
+    def test_fifo_order_preserved(self):
+        queue, driver, worker = self._rig()
+        sim = Simulation(entities=[queue, driver, worker], end_time=Instant.from_seconds(5))
+        for i in range(5):
+            sim.schedule(_enqueue(queue, 1, i))
+        sim.run()
+        assert worker.handled == [0, 1, 2, 3, 4]
+
+    def test_same_instant_burst_drains(self):
+        queue, driver, worker = self._rig(slots=2)
+        sim = Simulation(entities=[queue, driver, worker], end_time=Instant.from_seconds(5))
+        for i in range(6):
+            sim.schedule(_enqueue(queue, 1, i))
+        sim.run()
+        assert sorted(worker.handled) == [0, 1, 2, 3, 4, 5]
+
+    def test_downstream_entities_names_worker(self):
+        queue, driver, worker = self._rig()
+        assert driver.downstream_entities() == [worker]
+
+    def test_backpressure_holds_items_in_queue(self):
+        class _Sticky(_SlotWorker):
+            """Worker that never frees its slot (stuck service)."""
+
+            def handle_event(self, event):
+                self.in_flight += 1
+                self.handled.append(event.context.get("request_id"))
+                return None
+
+        worker = _Sticky("worker", slots=1)
+        queue = Queue("q")
+        driver = QueueDriver("drv", queue=queue, worker=worker)
+        sim = Simulation(entities=[queue, driver, worker], end_time=Instant.from_seconds(5))
+        for i in range(3):
+            sim.schedule(_enqueue(queue, 1, i))
+        sim.run()
+        assert worker.handled == [0]
+        assert queue.depth == 2

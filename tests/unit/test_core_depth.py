@@ -1,0 +1,211 @@
+"""Depth tests for core adapters: @simulatable, protocols, control state,
+CallbackEntity dispatch (SURVEY §2.1; ref core/decorators.py:48,
+core/protocols.py:58,98, core/callback_entity.py:15,39)."""
+
+import functools
+
+import pytest
+
+from happysim_tpu import Instant, Simulation
+from happysim_tpu.core.callback_entity import CallbackEntity, NullEntity
+from happysim_tpu.core.clock import Clock
+from happysim_tpu.core.control.state import BreakpointContext, SimulationState
+from happysim_tpu.core.decorators import simulatable
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.core.protocols import HasCapacity, Simulatable
+
+
+class TestSimulatableDecorator:
+    def test_requires_handle_event(self):
+        with pytest.raises(TypeError, match="handle_event"):
+
+            @simulatable
+            class Broken:
+                name = "broken"
+
+    def test_injects_clock_plumbing(self):
+        @simulatable
+        class Plain:
+            def __init__(self):
+                self.name = "plain"
+                self.seen = 0
+
+            def handle_event(self, event):
+                self.seen += 1
+                return None
+
+        p = Plain()
+        assert p._clock is None
+        assert p.has_capacity()
+        assert p.downstream_entities() == []
+        clock = Clock()
+        p.set_clock(clock)
+        assert p.now == clock.now
+
+    def test_now_without_clock_raises(self):
+        @simulatable
+        class Plain:
+            name = "p"
+
+            def handle_event(self, event):
+                return None
+
+        with pytest.raises(RuntimeError, match="no clock"):
+            Plain().now
+
+    def test_decorated_class_satisfies_simulatable(self):
+        @simulatable
+        class Plain:
+            name = "p"
+
+            def handle_event(self, event):
+                return None
+
+        assert isinstance(Plain(), Simulatable)
+
+    def test_existing_methods_not_overwritten(self):
+        @simulatable
+        class Custom:
+            name = "c"
+
+            def handle_event(self, event):
+                return None
+
+            def has_capacity(self):
+                return False
+
+        assert Custom().has_capacity() is False
+
+    def test_runs_inside_simulation(self):
+        @simulatable
+        class Tally:
+            def __init__(self):
+                self.name = "tally"
+                self.times = []
+
+            def handle_event(self, event):
+                self.times.append(self.now.to_seconds())
+                return None
+
+        t = Tally()
+        sim = Simulation(entities=[t], end_time=Instant.from_seconds(10))
+        sim.schedule(Event(Instant.from_seconds(1), "Ping", target=t))
+        sim.schedule(Event(Instant.from_seconds(2), "Ping", target=t))
+        sim.run()
+        assert t.times == [1.0, 2.0]
+
+
+class TestProtocols:
+    def test_entity_satisfies_simulatable(self):
+        class E(Entity):
+            def handle_event(self, event):
+                return None
+
+        assert isinstance(E("e"), Simulatable)
+
+    def test_plain_object_fails_simulatable(self):
+        class NotAnActor:
+            pass
+
+        assert not isinstance(NotAnActor(), Simulatable)
+
+    def test_has_capacity_structural(self):
+        class Worker:
+            def has_capacity(self):
+                return True
+
+        assert isinstance(Worker(), HasCapacity)
+        assert not isinstance(object(), HasCapacity)
+
+
+class TestControlState:
+    def test_simulation_state_frozen(self):
+        state = SimulationState(
+            time=Instant.from_seconds(1),
+            events_processed=3,
+            pending_events=2,
+            is_paused=False,
+            is_completed=False,
+        )
+        with pytest.raises(AttributeError):
+            state.events_processed = 4
+
+    def test_breakpoint_context_frozen(self):
+        sink = NullEntity
+        ctx = BreakpointContext(
+            simulation=None,
+            next_event=Event(Instant.Epoch, "X", target=sink),
+            time=Instant.Epoch,
+            events_processed=0,
+        )
+        with pytest.raises(AttributeError):
+            ctx.time = Instant.from_seconds(1)
+
+
+class TestCallbackEntity:
+    def test_zero_arg_function(self):
+        calls = []
+        e = CallbackEntity("cb", lambda: calls.append(1))
+        e.handle_event(Event(Instant.Epoch, "X", target=e))
+        assert calls == [1]
+
+    def test_one_arg_function_gets_event(self):
+        seen = []
+        e = CallbackEntity("cb", lambda event: seen.append(event.event_type))
+        e.handle_event(Event(Instant.Epoch, "Ping", target=e))
+        assert seen == ["Ping"]
+
+    def test_two_arg_function_gets_event_and_now(self):
+        seen = []
+        e = CallbackEntity("cb", lambda event, now: seen.append(now))
+        t = Instant.from_seconds(3)
+        e.handle_event(Event(t, "X", target=e))
+        # No clock injected: the event's own time is "now".
+        assert seen == [t]
+
+    def test_two_arg_uses_clock_when_present(self):
+        seen = []
+        e = CallbackEntity("cb", lambda event, now: seen.append(now))
+        clock = Clock()
+        clock.update(Instant.from_seconds(9))
+        e.set_clock(clock)
+        e.handle_event(Event(Instant.from_seconds(3), "X", target=e))
+        assert seen == [Instant.from_seconds(9)]
+
+    def test_bound_method_arity(self):
+        class Recorder:
+            def __init__(self):
+                self.events = []
+
+            def record(self, event):
+                self.events.append(event)
+
+        r = Recorder()
+        e = CallbackEntity("cb", r.record)
+        e.handle_event(Event(Instant.Epoch, "X", target=e))
+        assert len(r.events) == 1
+
+    def test_callable_without_code_object(self):
+        seen = []
+        wrapped = functools.partial(lambda tag, event: seen.append((tag, event)), "t")
+        e = CallbackEntity("cb", wrapped)
+        e.handle_event(Event(Instant.Epoch, "X", target=e))
+        assert seen and seen[0][0] == "t"
+
+    def test_returned_events_scheduled(self):
+        sink_hits = []
+        sink = CallbackEntity("sink", lambda: sink_hits.append(1))
+
+        def relay(event, now):
+            return [Event(now + 1.0, "Fwd", target=sink)]
+
+        e = CallbackEntity("relay", relay)
+        sim = Simulation(entities=[e, sink], end_time=Instant.from_seconds(10))
+        sim.schedule(Event(Instant.from_seconds(1), "X", target=e))
+        sim.run()
+        assert sink_hits == [1]
+
+    def test_null_entity_absorbs(self):
+        assert NullEntity.handle_event(Event(Instant.Epoch, "X", target=NullEntity)) is None
+        assert NullEntity.name == "null"

@@ -1,0 +1,299 @@
+"""Depth tests for the fault machinery: event builders, handles, contexts,
+capacity faults, and multi-paxos failover (ref faults/fault.py:25-135,
+faults/resource_faults.py:23, components/consensus/multi_paxos.py)."""
+
+import pytest
+
+from happysim_tpu import (
+    FaultSchedule,
+    Instant,
+    Network,
+    ReduceCapacity,
+    Resource,
+    Simulation,
+)
+from happysim_tpu.components.consensus import MultiPaxosNode
+from happysim_tpu.core.callback_entity import CallbackEntity
+from happysim_tpu.faults.fault import FaultContext, FaultHandle, one_shot, window
+
+
+class TestEventBuilders:
+    def test_one_shot_is_daemon(self):
+        ev = one_shot(2.0, "fault.test", lambda e: None)
+        assert ev.daemon
+        assert ev.time == Instant.from_seconds(2.0)
+        assert ev.event_type == "fault.test"
+
+    def test_window_brackets_half_open_span(self):
+        calls = []
+        events = window(1.0, 3.0, "f", lambda e: calls.append("on"), lambda e: calls.append("off"))
+        assert [e.time.to_seconds() for e in events] == [1.0, 3.0]
+        assert [e.event_type for e in events] == ["f.activate", "f.deactivate"]
+
+    def test_one_shot_fires_in_simulation(self):
+        fired = []
+        ev = one_shot(1.5, "f", lambda e: fired.append(e.time.to_seconds()))
+        anchor = CallbackEntity("anchor", lambda: None)
+        sim = Simulation(entities=[anchor], end_time=Instant.from_seconds(5))
+        sim.schedule(ev)
+        # A lone daemon event does not hold the sim open: add a primary event.
+        from happysim_tpu.core.event import Event
+
+        sim.schedule(Event(Instant.from_seconds(2), "Keep", target=anchor))
+        sim.run()
+        assert fired == [1.5]
+
+
+class TestFaultHandle:
+    class _Fault:
+        def generate_events(self, ctx):
+            return []
+
+    def test_cancel_counts_live_events(self):
+        handle = FaultHandle(self._Fault())
+        events = [one_shot(1.0, "a", lambda e: None), one_shot(2.0, "b", lambda e: None)]
+        events[0].cancel()
+        handle.attach(events)
+        assert handle.cancel() == 1
+        assert handle.cancelled
+        assert all(e.cancelled for e in events)
+
+    def test_double_cancel_is_zero(self):
+        handle = FaultHandle(self._Fault())
+        handle.attach([one_shot(1.0, "a", lambda e: None)])
+        assert handle.cancel() == 1
+        assert handle.cancel() == 0
+
+    def test_attach_aliases_list(self):
+        handle = FaultHandle(self._Fault())
+        chain = [one_shot(1.0, "a", lambda e: None)]
+        handle.attach(chain)
+        late = one_shot(2.0, "b", lambda e: None)
+        chain.append(late)  # self-scheduled follow-up
+        handle.cancel()
+        assert late.cancelled
+
+
+class TestFaultContext:
+    def test_resolve_named_network(self):
+        net = Network("net")
+        ctx = FaultContext(entities={}, networks={"net": net}, resources={}, start_time=Instant.Epoch)
+        assert ctx.resolve_network("net") is net
+
+    def test_resolve_default_single_network(self):
+        net = Network("only")
+        ctx = FaultContext(entities={}, networks={"only": net}, resources={}, start_time=Instant.Epoch)
+        assert ctx.resolve_network(None) is net
+
+    def test_resolve_without_networks_raises(self):
+        ctx = FaultContext(entities={}, networks={}, resources={}, start_time=Instant.Epoch)
+        with pytest.raises(ValueError, match="No networks"):
+            ctx.resolve_network(None)
+
+
+class TestReduceCapacity:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="factor"):
+            ReduceCapacity("r", factor=-0.1, start=0.0, end=1.0)
+        with pytest.raises(ValueError, match="window is empty"):
+            ReduceCapacity("r", factor=0.5, start=2.0, end=2.0)
+
+    def test_capacity_squeezed_then_restored(self):
+        pool = Resource("pool", capacity=4.0)
+        observed = {}
+
+        def probe_mid(event):
+            observed["mid"] = pool.capacity
+
+        def probe_late(event):
+            observed["late"] = pool.capacity
+
+        faults = FaultSchedule()
+        faults.add(ReduceCapacity("pool", factor=0.25, start=1.0, end=3.0))
+        anchor = CallbackEntity("anchor", lambda: None)
+        sim = Simulation(
+            entities=[pool, anchor], fault_schedule=faults, end_time=Instant.from_seconds(5)
+        )
+        sim.schedule(one_shot(2.0, "probe.mid", probe_mid))
+        sim.schedule(one_shot(4.0, "probe.late", probe_late))
+        from happysim_tpu.core.event import Event
+
+        sim.schedule(Event(Instant.from_seconds(4.5), "Keep", target=anchor))
+        sim.run()
+        assert observed["mid"] == 1.0
+        assert observed["late"] == 4.0
+
+    def test_restore_wakes_fitting_waiter(self):
+        """A waiter parked because degraded capacity is exhausted must be
+        woken when capacity is restored, not stranded until the next release."""
+        pool = Resource("pool", capacity=2.0)
+        granted = []
+
+        def hold(event):
+            def _run():
+                yield pool.acquire(1.0)  # hold forever
+
+            return _run()
+
+        def wait(event):
+            def _run():
+                grant = yield pool.acquire(1.0)
+                granted.append(grant.acquired_at.to_seconds())
+                grant.release()
+
+            return _run()
+
+        holder = CallbackEntity("holder", hold)
+        waiter = CallbackEntity("waiter", wait)
+        faults = FaultSchedule()
+        faults.add(ReduceCapacity("pool", factor=0.5, start=0.0, end=3.0))
+        sim = Simulation(
+            entities=[pool, holder, waiter],
+            fault_schedule=faults,
+            end_time=Instant.from_seconds(6),
+        )
+        from happysim_tpu.core.event import Event
+
+        sim.schedule(Event(Instant.from_seconds(1), "Go", target=holder))
+        sim.schedule(Event(Instant.from_seconds(1.5), "Go", target=waiter))
+        # Keep a primary event past the restore so auto-termination does not
+        # end the run while only the daemon restore event remains.
+        keep = CallbackEntity("keep", lambda: None)
+        sim.schedule(Event(Instant.from_seconds(5), "Keep", target=keep))
+        sim.run()
+        # Degraded capacity 1.0 fully held; restored to 2.0 at t=3 -> grant.
+        assert granted == [3.0]
+
+
+class TestMultiPaxosFailover:
+    def _cluster(self, n=3):
+        from happysim_tpu import ConstantLatency, NetworkLink
+
+        network = Network(
+            "net", default_link=NetworkLink("link", latency=ConstantLatency(0.01))
+        )
+        nodes = [MultiPaxosNode(f"mp{i}", network) for i in range(n)]
+        for node in nodes:
+            node.set_peers(nodes)
+        return network, nodes
+
+    def test_leader_crash_then_manual_failover(self):
+        """Failover is caller-driven (as in the reference): after the leader
+        crashes, a follower re-runs start() and takes over."""
+        network, nodes = self._cluster()
+        sim = Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(120))
+        for ev in nodes[0].start():
+            sim.schedule(ev)
+
+        follower = nodes[1]
+
+        def crash_leader(event):
+            leaders = [n for n in nodes if n.is_leader]
+            assert leaders, "no leader by t=10"
+            leaders[0]._crashed = True
+            return None
+
+        def promote_follower(event):
+            return follower.start()
+
+        sim.schedule(one_shot(10.0, "crash", crash_leader))
+        anchor = CallbackEntity("promote", promote_follower)
+        from happysim_tpu.core.event import Event
+
+        sim.schedule(Event(Instant.from_seconds(11.0), "Promote", target=anchor))
+        sim.run()
+        alive = [n for n in nodes if not getattr(n, "_crashed", False)]
+        alive_leaders = [n for n in alive if n.is_leader]
+        assert alive_leaders == [follower]
+        # The other alive follower learned the new leader from heartbeats.
+        other = next(n for n in alive if n is not follower)
+        assert other.leader == follower.name
+
+    def test_deposed_leader_fails_inflight_submissions(self):
+        """Step-down must resolve pending client futures to None — an
+        unknown outcome must never be left to be falsely acked later."""
+        from happysim_tpu.core.event import Event
+
+        network, nodes = self._cluster()
+        sim = Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(40))
+        for ev in nodes[0].start():
+            sim.schedule(ev)
+        futures = {}
+
+        def submit_then_depose(event):
+            leader = next(n for n in nodes if n.is_leader)
+            futures["f"] = leader.submit({"op": "set", "key": "z", "value": 9})
+            # Superior heartbeat lands before any phase-2 ack round-trip.
+            leader.handle_event(
+                Event(
+                    leader.now,
+                    "MultiPaxosHeartbeat",
+                    target=leader,
+                    context={"metadata": {"leader": "mp9", "ballot_number": 99}},
+                )
+            )
+            return None
+
+        client = CallbackEntity("client", submit_then_depose)
+        sim.schedule(Event(Instant.from_seconds(5), "Go", target=client))
+        sim.run()
+        assert futures["f"].is_resolved and futures["f"].value is None
+
+    def test_stale_candidate_cannot_promote_after_superior_promise(self):
+        """A candidate that promised a superior ballot mid-phase-1 must
+        ignore late promises for its own stale ballot."""
+        from happysim_tpu.core.event import Event
+
+        network, nodes = self._cluster()
+        # Construction injects clocks; we drive handlers directly.
+        Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(10))
+        candidate = nodes[0]
+        candidate.start()  # ballot (1, mp0); phase-1 in flight
+        # Superior leader's heartbeat arrives before peer promises.
+        candidate.handle_event(
+            Event(
+                Instant.from_seconds(1),
+                "MultiPaxosHeartbeat",
+                target=candidate,
+                context={"metadata": {"leader": "mp9", "ballot_number": 99}},
+            )
+        )
+        # Two late promises for the stale ballot would have been quorum.
+        for peer_name in ("mp1", "mp2"):
+            candidate.handle_event(
+                Event(
+                    Instant.from_seconds(2),
+                    "MultiPaxosPromise",
+                    target=candidate,
+                    context={
+                        "metadata": {
+                            "ballot_number": 1,
+                            "from": peer_name,
+                            "accepted": {},
+                        }
+                    },
+                )
+            )
+        assert not candidate.is_leader
+        assert candidate.leader == "mp9"
+
+    def test_heartbeat_from_superior_leader_deposes(self):
+        from happysim_tpu.core.event import Event
+
+        network, nodes = self._cluster()
+        sim = Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(30))
+        for ev in nodes[0].start():
+            sim.schedule(ev)
+        sim.run()
+        assert nodes[0].is_leader
+        # A heartbeat carrying a strictly higher ballot arrives (its prepare
+        # was partitioned away): the sitting leader must step down.
+        hb = Event(
+            Instant.from_seconds(31),
+            "MultiPaxosHeartbeat",
+            target=nodes[0],
+            context={"metadata": {"leader": "mp9", "ballot_number": 10_000}},
+        )
+        nodes[0].handle_event(hb)
+        assert not nodes[0].is_leader
+        assert nodes[0].leader == "mp9"

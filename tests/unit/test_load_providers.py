@@ -1,0 +1,104 @@
+"""Depth tests for event providers (ref load/event_provider.py:15,
+load/source.py:31, load/providers/distributed_field.py)."""
+
+from happysim_tpu import Instant, Simulation, Sink, Source, UniformDistribution, ZipfDistribution
+from happysim_tpu.core.callback_entity import NullEntity
+from happysim_tpu.load.event_provider import EventProvider, SimpleEventProvider
+from happysim_tpu.load.providers.distributed_field import DistributedFieldProvider
+
+
+class TestSimpleEventProvider:
+    def test_sequential_request_ids(self):
+        p = SimpleEventProvider(target=NullEntity)
+        a = p.get_events(Instant.from_seconds(1))[0]
+        b = p.get_events(Instant.from_seconds(2))[0]
+        assert a.context["request_id"] == 0
+        assert b.context["request_id"] == 1
+        assert a.context["created_at"] == Instant.from_seconds(1)
+        assert p.generated == 2
+
+    def test_stop_after_exhausts(self):
+        p = SimpleEventProvider(target=NullEntity, stop_after=Instant.from_seconds(5))
+        assert p.get_events(Instant.from_seconds(5))  # boundary still emits
+        assert p.get_events(Instant.from_seconds(6)) == []
+        assert p.is_exhausted(Instant.from_seconds(6))
+        assert not p.is_exhausted(Instant.from_seconds(4))
+
+    def test_context_fn_merges(self):
+        p = SimpleEventProvider(
+            target=NullEntity,
+            context_fn=lambda time, i: {"tenant": f"t{i}"},
+        )
+        e = p.get_events(Instant.from_seconds(1))[0]
+        assert e.context["tenant"] == "t0"
+        assert "request_id" in e.context
+
+    def test_reset_rewinds_ids(self):
+        p = SimpleEventProvider(target=NullEntity)
+        p.get_events(Instant.from_seconds(1))
+        p.reset()
+        assert p.generated == 0
+        assert p.get_events(Instant.from_seconds(2))[0].context["request_id"] == 0
+
+    def test_custom_event_type(self):
+        p = SimpleEventProvider(target=NullEntity, event_type="Write")
+        assert p.get_events(Instant.Epoch)[0].event_type == "Write"
+
+
+class TestDistributedFieldProvider:
+    def test_fields_sampled_per_event(self):
+        p = DistributedFieldProvider(
+            target=NullEntity,
+            fields={
+                "key": ZipfDistribution(items=100, exponent=1.2, seed=7),
+                "size": UniformDistribution(low=1.0, high=2.0, seed=8),
+            },
+        )
+        events = [p.get_events(Instant.from_seconds(t))[0] for t in range(20)]
+        keys = {e.context["key"] for e in events}
+        assert len(keys) > 1  # not constant
+        assert all(1.0 <= e.context["size"] <= 2.0 for e in events)
+
+    def test_zipf_skews_toward_head(self):
+        p = DistributedFieldProvider(
+            target=NullEntity,
+            fields={"key": ZipfDistribution(items=1000, exponent=1.5, seed=3)},
+        )
+        keys = [p.get_events(Instant.Epoch)[0].context["key"] for _ in range(500)]
+        head_share = sum(1 for k in keys if k < 10) / len(keys)
+        assert head_share > 0.4
+
+    def test_stop_after_and_reset(self):
+        p = DistributedFieldProvider(
+            target=NullEntity, stop_after=Instant.from_seconds(1)
+        )
+        p.get_events(Instant.from_seconds(1))
+        assert p.get_events(Instant.from_seconds(2)) == []
+        p.reset()
+        assert p.get_events(Instant.from_seconds(0))[0].context["request_id"] == 0
+
+    def test_no_fields_still_emits(self):
+        p = DistributedFieldProvider(target=NullEntity)
+        e = p.get_events(Instant.Epoch)[0]
+        assert e.context["request_id"] == 0
+
+    def test_drives_source_in_simulation(self):
+        sink = Sink("sink")
+        provider = DistributedFieldProvider(
+            target=sink, fields={"key": ZipfDistribution(items=50, seed=1)}
+        )
+        source = Source.constant(rate=10.0, stop_after=5.0, event_provider=provider)
+        sim = Simulation(sources=[source], entities=[sink], end_time=Instant.from_seconds(6))
+        sim.run()
+        assert sink.events_received >= 45
+
+
+class TestEventProviderDefaults:
+    def test_base_defaults(self):
+        class Fixed(EventProvider):
+            def get_events(self, time):
+                return []
+
+        f = Fixed()
+        assert f.is_exhausted(Instant.from_seconds(1e9)) is False
+        f.reset()  # no-op, must not raise

@@ -1,0 +1,71 @@
+"""Depth tests for the scipy-replacement numerics (ref
+numerics/integration.py:10, numerics/root_finding.py:27)."""
+
+import math
+
+import pytest
+
+from happysim_tpu.numerics.integration import integrate_adaptive_simpson
+from happysim_tpu.numerics.root_finding import brentq
+
+
+class TestAdaptiveSimpson:
+    def test_polynomial_exact(self):
+        # Simpson is exact for cubics.
+        val = integrate_adaptive_simpson(lambda x: x**3 - 2 * x + 1, 0.0, 2.0)
+        assert val == pytest.approx(2.0, abs=1e-10)
+
+    def test_exponential(self):
+        val = integrate_adaptive_simpson(math.exp, 0.0, 1.0)
+        assert val == pytest.approx(math.e - 1.0, rel=1e-8)
+
+    def test_oscillatory(self):
+        val = integrate_adaptive_simpson(math.sin, 0.0, math.pi)
+        assert val == pytest.approx(2.0, rel=1e-8)
+
+    def test_sharp_peak_adaptivity(self):
+        # Narrow Gaussian: uniform Simpson would need a fine grid everywhere.
+        f = lambda x: math.exp(-((x - 0.5) ** 2) / 2e-4)
+        val = integrate_adaptive_simpson(f, 0.0, 1.0)
+        assert val == pytest.approx(math.sqrt(2 * math.pi * 1e-4), rel=1e-4)
+
+    def test_zero_width_interval(self):
+        assert integrate_adaptive_simpson(math.exp, 1.0, 1.0) == 0.0
+
+    def test_reversed_interval_is_negative(self):
+        fwd = integrate_adaptive_simpson(math.sin, 0.0, 1.0)
+        rev = integrate_adaptive_simpson(math.sin, 1.0, 0.0)
+        assert rev == pytest.approx(-fwd, rel=1e-9)
+
+
+class TestBrentq:
+    def test_simple_root(self):
+        r = brentq(lambda x: x**2 - 4, 0.0, 10.0)
+        assert r == pytest.approx(2.0, abs=1e-9)
+
+    def test_transcendental_root(self):
+        r = brentq(lambda x: math.cos(x) - x, 0.0, 1.0)
+        assert r == pytest.approx(0.7390851332151607, abs=1e-9)
+
+    def test_root_at_bracket_edge(self):
+        assert brentq(lambda x: x, 0.0, 1.0) == pytest.approx(0.0, abs=1e-12)
+
+    def test_no_sign_change_raises(self):
+        with pytest.raises(ValueError):
+            brentq(lambda x: x**2 + 1, -1.0, 1.0)
+
+    def test_steep_function(self):
+        r = brentq(lambda x: math.expm1(50 * (x - 0.3)), 0.0, 1.0)
+        assert r == pytest.approx(0.3, abs=1e-8)
+
+    def test_flat_then_steep(self):
+        f = lambda x: 0.0 if x < 0.6 else (x - 0.6) ** 3
+        # Root is the whole flat region boundary; any point with |f| ~ 0 works.
+        r = brentq(lambda x: f(x) - 1e-9, 0.0, 1.0)
+        assert 0.59 <= r <= 0.7
+
+    def test_arrival_inversion_shape(self):
+        # The actual use: solve integral(rate) = target for ramp profiles.
+        # integral of rate(t)=2t from 0 to T is T^2; target 9 => T=3.
+        r = brentq(lambda T: T * T - 9.0, 0.0, 10.0)
+        assert r == pytest.approx(3.0, abs=1e-9)

@@ -1,0 +1,193 @@
+"""Depth tests for partitioned-run internals: router classification,
+partition validation, links, aggregate summaries (ref parallel/routing.py:40,
+parallel/validation.py:19-180, parallel/link.py:19, parallel/summary.py)."""
+
+import pytest
+
+from happysim_tpu import Duration, Instant
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.event import Event
+from happysim_tpu.instrumentation.summary import SimulationSummary
+from happysim_tpu.parallel.link import PartitionLink
+from happysim_tpu.parallel.partition import SimulationPartition
+from happysim_tpu.parallel.routing import RoutingError, make_router
+from happysim_tpu.parallel.summary import ParallelSimulationSummary
+from happysim_tpu.parallel.validation import (
+    PartitionValidationError,
+    validate_partitions,
+)
+
+
+class _Node(Entity):
+    def __init__(self, name):
+        super().__init__(name)
+
+    def handle_event(self, event):
+        return None
+
+
+def _ev(target):
+    return Event(Instant.from_seconds(1), "X", target=target)
+
+
+class TestRouter:
+    def _setup(self):
+        a_ent, b_ent = _Node("a"), _Node("b")
+        part_a = SimulationPartition("A", entities=[a_ent])
+        mapping = {id(a_ent): "A", id(b_ent): "B"}
+        outbox = []
+        return a_ent, b_ent, part_a, mapping, outbox
+
+    def test_local_events_pass_through(self):
+        a_ent, b_ent, part_a, mapping, outbox = self._setup()
+        route = make_router(part_a, mapping, links_from={"B"}, outbox=outbox)
+        ev = _ev(a_ent)
+        assert route([ev]) == [ev]
+        assert outbox == []
+
+    def test_linked_cross_partition_goes_to_outbox(self):
+        a_ent, b_ent, part_a, mapping, outbox = self._setup()
+        route = make_router(part_a, mapping, links_from={"B"}, outbox=outbox)
+        ev = _ev(b_ent)
+        assert route([ev]) == []
+        assert outbox == [ev]
+
+    def test_unlinked_cross_partition_raises(self):
+        a_ent, b_ent, part_a, mapping, outbox = self._setup()
+        route = make_router(part_a, mapping, links_from=set(), outbox=outbox)
+        with pytest.raises(RoutingError, match="no PartitionLink"):
+            route([_ev(b_ent)])
+
+    def test_unowned_target_treated_as_local(self):
+        # Shared infrastructure (e.g. Event.once function targets) is not in
+        # the ownership map and must stay on the producing partition.
+        a_ent, b_ent, part_a, mapping, outbox = self._setup()
+        route = make_router(part_a, {}, links_from=set(), outbox=outbox)
+        ev = _ev(a_ent)
+        assert route([ev]) == [ev]
+
+    def test_mixed_batch_splits(self):
+        a_ent, b_ent, part_a, mapping, outbox = self._setup()
+        route = make_router(part_a, mapping, links_from={"B"}, outbox=outbox)
+        local, remote = _ev(a_ent), _ev(b_ent)
+        assert route([local, remote, local]) == [local, local]
+        assert outbox == [remote]
+
+
+class TestPartitionValidation:
+    def test_duplicate_partition_names(self):
+        with pytest.raises(PartitionValidationError, match="Duplicate partition names"):
+            validate_partitions(
+                [SimulationPartition("A"), SimulationPartition("A")], []
+            )
+
+    def test_entity_in_two_partitions(self):
+        shared = _Node("shared")
+        with pytest.raises(PartitionValidationError, match="appears in both"):
+            validate_partitions(
+                [
+                    SimulationPartition("A", entities=[shared]),
+                    SimulationPartition("B", entities=[shared]),
+                ],
+                [],
+            )
+
+    def test_link_to_unknown_partition(self):
+        link = PartitionLink("A", "C", min_latency=Duration.from_seconds(0.1))
+        with pytest.raises(PartitionValidationError, match="unknown partition"):
+            validate_partitions([SimulationPartition("A")], [link])
+
+    def test_duplicate_link_rejected(self):
+        links = [
+            PartitionLink("A", "B", min_latency=Duration.from_seconds(0.1)),
+            PartitionLink("A", "B", min_latency=Duration.from_seconds(0.2)),
+        ]
+        with pytest.raises(PartitionValidationError, match="Duplicate link"):
+            validate_partitions(
+                [SimulationPartition("A"), SimulationPartition("B")], links
+            )
+
+    def test_cross_reference_without_link_rejected(self):
+        a_ent, b_ent = _Node("a"), _Node("b")
+        a_ent.peer = b_ent  # direct attribute reference crossing partitions
+        with pytest.raises(PartitionValidationError):
+            validate_partitions(
+                [
+                    SimulationPartition("A", entities=[a_ent]),
+                    SimulationPartition("B", entities=[b_ent]),
+                ],
+                [],
+            )
+
+    def test_cross_reference_with_link_allowed(self):
+        a_ent, b_ent = _Node("a"), _Node("b")
+        a_ent.peer = b_ent
+        validate_partitions(
+            [
+                SimulationPartition("A", entities=[a_ent]),
+                SimulationPartition("B", entities=[b_ent]),
+            ],
+            [PartitionLink("A", "B", min_latency=Duration.from_seconds(0.1))],
+        )
+
+    def test_owns(self):
+        e = _Node("e")
+        p = SimulationPartition("P", entities=[e])
+        assert p.owns(e)
+        assert not p.owns(_Node("other"))
+
+
+class TestPartitionLink:
+    def test_zero_latency_rejected(self):
+        with pytest.raises(ValueError, match="min_latency must be > 0"):
+            PartitionLink("A", "B", min_latency=Duration.from_seconds(0.0))
+
+    def test_bad_packet_loss_rejected(self):
+        with pytest.raises(ValueError, match="packet_loss"):
+            PartitionLink(
+                "A", "B", min_latency=Duration.from_seconds(0.1), packet_loss=1.0
+            )
+
+    def test_float_latency_coerced(self):
+        link = PartitionLink("A", "B", min_latency=0.25)
+        assert link.min_latency == Duration.from_seconds(0.25)
+
+
+class TestParallelSummary:
+    def _inner(self, events=100):
+        return SimulationSummary(
+            start_time=Instant.Epoch,
+            end_time=Instant.from_seconds(10),
+            events_processed=events,
+            wall_clock_seconds=0.5,
+        )
+
+    def test_events_per_second(self):
+        s = ParallelSimulationSummary(
+            partition_summaries={"A": self._inner()},
+            total_events=100,
+            wall_seconds=2.0,
+        )
+        assert s.events_per_second == 50.0
+
+    def test_zero_wall_guard(self):
+        s = ParallelSimulationSummary(
+            partition_summaries={}, total_events=10, wall_seconds=0.0
+        )
+        assert s.events_per_second == 0.0
+
+    def test_to_dict_round_trip(self):
+        s = ParallelSimulationSummary(
+            partition_summaries={"A": self._inner(40), "B": self._inner(60)},
+            total_events=100,
+            wall_seconds=1.0,
+            total_windows=7,
+            cross_partition_events=12,
+            speedup=1.8,
+        )
+        d = s.to_dict()
+        assert d["total_events"] == 100
+        assert d["total_windows"] == 7
+        assert d["cross_partition_events"] == 12
+        assert set(d["partitions"]) == {"A", "B"}
+        assert d["partitions"]["A"]["events_processed"] == 40

@@ -1,0 +1,130 @@
+"""Depth tests for run summaries, sketch hashing, and shared stats helpers
+(ref instrumentation/summary.py:15-48, utils)."""
+
+from happysim_tpu import Instant
+from happysim_tpu.instrumentation.summary import (
+    EntitySummary,
+    QueueStats,
+    SimulationSummary,
+)
+from happysim_tpu.sketching.hashing import hash64, hash_pair, item_bytes
+from happysim_tpu.utils.stats import percentile_nearest_rank, stable_seed
+
+
+class TestSimulationSummary:
+    def _summary(self, **kw):
+        defaults = dict(
+            start_time=Instant.Epoch,
+            end_time=Instant.from_seconds(60),
+            events_processed=1200,
+            wall_clock_seconds=0.4,
+        )
+        defaults.update(kw)
+        return SimulationSummary(**defaults)
+
+    def test_derived_rates(self):
+        s = self._summary()
+        assert s.simulated_seconds == 60.0
+        assert s.events_per_second == 3000.0
+
+    def test_zero_wall_clock_guard(self):
+        assert self._summary(wall_clock_seconds=0.0).events_per_second == 0.0
+
+    def test_str_mentions_backend_and_pause(self):
+        s = self._summary(completed=False, backend="tpu", replicas=4096)
+        text = str(s)
+        assert "paused" in text
+        assert "backend=tpu" in text
+        assert "replicas=4096" in text
+
+    def test_str_warns_on_truncated_replicas(self):
+        assert "WARNING" in str(self._summary(truncated_replicas=3))
+        assert "WARNING" not in str(self._summary())
+
+    def test_entities_rendered(self):
+        s = self._summary(
+            entities=[
+                EntitySummary("sink", "Sink", events_received=10),
+                EntitySummary("ctr", "Counter", count=5, extra={"p99_ms": 12}),
+            ]
+        )
+        text = str(s)
+        assert "sink [Sink] received=10" in text
+        assert "p99_ms=12" in text
+
+    def test_to_dict_keys(self):
+        d = self._summary(entities=[EntitySummary("s", "Sink")]).to_dict()
+        assert d["events_processed"] == 1200
+        assert d["backend"] == "python"
+        assert d["entities"] == [{"name": "s", "kind": "Sink"}]
+
+    def test_queue_stats_defaults(self):
+        q = QueueStats()
+        assert (q.depth, q.enqueued, q.dequeued, q.dropped) == (0, 0, 0, 0)
+
+
+class TestEntitySummary:
+    def test_optional_fields_omitted(self):
+        d = EntitySummary("x", "Thing").to_dict()
+        assert "events_received" not in d and "count" not in d
+
+    def test_extra_merged(self):
+        d = EntitySummary("x", "Thing", extra={"busy_s": 1.5}).to_dict()
+        assert d["busy_s"] == 1.5
+
+
+class TestHashing:
+    def test_deterministic_across_calls(self):
+        assert hash64("alpha", seed=3) == hash64("alpha", seed=3)
+
+    def test_seed_gives_independent_streams(self):
+        vals = {hash64("alpha", seed=s) for s in range(16)}
+        assert len(vals) == 16
+
+    def test_distinct_items_distinct_hashes(self):
+        vals = {hash64(f"item{i}") for i in range(1000)}
+        assert len(vals) == 1000
+
+    def test_item_bytes_stable_encodings(self):
+        assert item_bytes(b"raw") == b"raw"
+        assert item_bytes("s") == b"s"
+        assert item_bytes(42) == item_bytes(42)
+        assert item_bytes((1, "a")) == item_bytes((1, "a"))
+
+    def test_hash_pair_second_hash_odd(self):
+        for i in range(50):
+            _, h2 = hash_pair(f"k{i}")
+            assert h2 % 2 == 1  # coprime with any power-of-two table size
+
+    def test_hash_pair_parts_differ(self):
+        h1, h2 = hash_pair("k")
+        assert h1 != h2
+
+    def test_kirsch_mitzenmacher_rows_spread(self):
+        # h1 + i*h2 mod m should hit many distinct buckets across rows.
+        h1, h2 = hash_pair("key", seed=1)
+        m = 1 << 16
+        rows = {(h1 + i * h2) % m for i in range(8)}
+        assert len(rows) == 8
+
+
+class TestStatsHelpers:
+    def test_percentile_empty(self):
+        assert percentile_nearest_rank([], 0.5) == 0.0
+
+    def test_percentile_single(self):
+        assert percentile_nearest_rank([7.0], 0.99) == 7.0
+
+    def test_percentile_nearest_rank_definition(self):
+        values = list(range(1, 11))  # 1..10
+        assert percentile_nearest_rank(values, 0.5) == 5
+        assert percentile_nearest_rank(values, 0.9) == 9
+        assert percentile_nearest_rank(values, 1.0) == 10
+        assert percentile_nearest_rank(values, 0.0) == 1
+
+    def test_percentile_unsorted_input(self):
+        assert percentile_nearest_rank([3.0, 1.0, 2.0], 0.5) == 2.0
+
+    def test_stable_seed_is_stable_and_distinct(self):
+        assert stable_seed("node-1") == stable_seed("node-1")
+        assert stable_seed("node-1") != stable_seed("node-2")

@@ -1,0 +1,102 @@
+"""Depth tests for cache write policies (ref
+components/datastore/write_policies.py:20-172)."""
+
+import pytest
+
+from happysim_tpu.components.datastore.write_policies import (
+    WriteAround,
+    WriteBack,
+    WriteThrough,
+)
+
+
+class TestWriteThrough:
+    def test_synchronous_and_stateless(self):
+        p = WriteThrough()
+        assert p.should_write_through()
+        p.on_write("a", 1)
+        assert not p.should_flush()
+        assert p.get_keys_to_flush() == []
+        p.on_flush(["a"])  # no-op, must not raise
+
+
+class TestWriteBack:
+    def test_writes_stay_dirty_until_flush(self):
+        p = WriteBack(flush_interval=10.0, max_dirty=100)
+        assert not p.should_write_through()
+        p.on_write("a", 1)
+        p.on_write("b", 2)
+        p.on_write("a", 3)  # rewrite dedupes
+        assert p.dirty_count == 2
+        assert sorted(p.get_keys_to_flush()) == ["a", "b"]
+
+    def test_max_dirty_triggers_flush(self):
+        p = WriteBack(flush_interval=1e9, max_dirty=3)
+        for k in "abc":
+            p.on_write(k, 0)
+        assert p.should_flush()
+        p.on_flush(p.get_keys_to_flush())
+        assert p.dirty_count == 0
+        assert not p.should_flush()
+
+    def test_interval_triggers_flush_via_clock(self):
+        t = {"now": 0.0}
+        p = WriteBack(flush_interval=5.0, max_dirty=100, clock_func=lambda: t["now"])
+        p.on_write("a", 1)
+        t["now"] = 4.9
+        assert not p.should_flush()
+        t["now"] = 5.0
+        assert p.should_flush()
+        p.on_flush(["a"])
+        # last_flush advanced: next interval starts from now.
+        p.on_write("b", 1)
+        t["now"] = 9.9
+        assert not p.should_flush()
+        t["now"] = 10.0
+        assert p.should_flush()
+
+    def test_empty_dirty_set_never_interval_flushes(self):
+        t = {"now": 100.0}
+        p = WriteBack(flush_interval=1.0, clock_func=lambda: t["now"])
+        assert not p.should_flush()
+
+    def test_set_clock_func_late(self):
+        p = WriteBack(flush_interval=1.0)
+        p.on_write("a", 1)
+        p.set_clock_func(lambda: 50.0)
+        assert p.should_flush()
+
+    def test_partial_flush_keeps_remainder_dirty(self):
+        p = WriteBack(flush_interval=10.0)
+        p.on_write("a", 1)
+        p.on_write("b", 2)
+        p.on_flush(["a"])
+        assert p.get_keys_to_flush() == ["b"]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            WriteBack(flush_interval=0.0)
+        with pytest.raises(ValueError):
+            WriteBack(max_dirty=0)
+
+    def test_accessors(self):
+        p = WriteBack(flush_interval=2.5, max_dirty=7)
+        assert p.flush_interval == 2.5
+        assert p.max_dirty == 7
+
+
+class TestWriteAround:
+    def test_bypasses_cache_and_invalidates(self):
+        p = WriteAround()
+        assert p.should_write_through()
+        p.on_write("a", 1)
+        p.on_write("b", 2)
+        assert p.get_keys_to_invalidate() == ["a", "b"]
+        # The invalidation list drains on read.
+        assert p.get_keys_to_invalidate() == []
+
+    def test_never_flushes(self):
+        p = WriteAround()
+        p.on_write("a", 1)
+        assert not p.should_flush()
+        assert p.get_keys_to_flush() == []
