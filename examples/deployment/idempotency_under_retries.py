@@ -1,0 +1,50 @@
+"""An idempotency store makes at-least-once delivery safe.
+
+A client fires the same payment request three times (original + two
+retries). Without the store the backend would charge three times; with it,
+duplicates hit the result cache and exactly one charge lands. Role parity:
+``examples/deployment/idempotency_under_retries.py``.
+"""
+
+from happysim_tpu import ConstantLatency, Counter, Event, Instant, Server, Simulation
+from happysim_tpu.components.microservice import IdempotencyStore
+
+
+def main() -> dict:
+    charges = Counter("ledger")
+    backend = Server("payments", service_time=ConstantLatency(0.02), downstream=charges)
+    store = IdempotencyStore(
+        "idem",
+        backend,
+        key_extractor=lambda e: e.context.get("metadata", {}).get("idempotency_key"),
+    )
+    sim = Simulation(entities=[store, backend, charges], end_time=Instant.from_seconds(5))
+    for at in (0.0, 0.5, 1.0):  # original + client retries
+        sim.schedule(
+            Event(
+                Instant.from_seconds(at),
+                "ChargeCard",
+                target=store,
+                context={"metadata": {"idempotency_key": "order-42", "amount": 99}},
+            )
+        )
+    # A different order is NOT deduplicated.
+    sim.schedule(
+        Event(
+            Instant.from_seconds(1.5),
+            "ChargeCard",
+            target=store,
+            context={"metadata": {"idempotency_key": "order-43", "amount": 12}},
+        )
+    )
+    sim.schedule(Event(Instant.from_seconds(4.0), "Keepalive", target=Counter("ka")))
+    sim.run()
+
+    assert charges.count == 2, "exactly one charge per distinct order"
+    assert store.stats.cache_hits == 2
+    assert store.stats.cache_misses == 2
+    return {"charges": charges.count, "duplicates_suppressed": store.stats.cache_hits}
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,73 @@
+"""A mesh sidecar absorbs a flaky backend with retries + circuit breaking.
+
+Calls go through a sidecar proxy that retries timeouts with backoff. While
+the backend is stalled, the circuit opens and sheds load instantly; once the
+backend heals, the circuit closes and traffic succeeds again. Role parity:
+``examples/deployment/service_mesh_sidecar.py``.
+"""
+
+from happysim_tpu import ConstantLatency, Counter, Event, Instant, Server, Simulation
+from happysim_tpu.components.microservice import Sidecar
+from happysim_tpu.core.entity import Entity
+
+
+class FlakyService(Entity):
+    """Stalls (never replies) until healed, then behaves like a 10ms server."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.healthy = False
+        self.received = 0
+
+    def handle_event(self, event):
+        self.received += 1
+        if self.healthy:
+            yield 0.01
+            return None
+        yield 1e6  # stalled: the caller's timeout fires long before this
+        return None
+
+
+def main() -> dict:
+    service = FlakyService("svc")
+    sidecar = Sidecar(
+        "mesh",
+        service,
+        request_timeout=0.1,
+        max_retries=1,
+        retry_base_delay=0.1,
+        circuit_failure_threshold=3,
+        circuit_timeout=1.0,
+    )
+    sim = Simulation(entities=[sidecar, service], end_time=Instant.from_seconds(20))
+    # Calls while the backend is dark: they time out and open the circuit.
+    for i in range(6):
+        sim.schedule(Event(Instant.from_seconds(0.5 * i), "Call", target=sidecar))
+
+    class Healer(Entity):
+        def handle_event(self, event):
+            service.healthy = True
+            return None
+
+    healer = Healer("healer")
+    sim.schedule(Event(Instant.from_seconds(8.0), "Heal", target=healer))
+    # Calls after recovery timeout: half-open probe closes the circuit.
+    for i in range(4):
+        sim.schedule(Event(Instant.from_seconds(10.0 + 0.5 * i), "Call", target=sidecar))
+    sim.schedule(Event(Instant.from_seconds(19.0), "ka", target=Counter("ka")))
+    sim.run()
+
+    stats = sidecar.stats
+    assert stats.failed_requests >= 1
+    assert stats.circuit_broken >= 1, "open circuit shed at least one call"
+    assert sidecar.circuit_state == "closed"
+    assert stats.successful_requests >= 3
+    return {
+        "shed_by_circuit": stats.circuit_broken,
+        "succeeded_after_heal": stats.successful_requests,
+        "final_circuit": sidecar.circuit_state,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

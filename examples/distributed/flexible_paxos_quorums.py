@@ -1,0 +1,65 @@
+"""Flexible Paxos: shrink the steady-state quorum, pay at election time.
+
+With 5 nodes, classic Paxos needs 3 acks per command. Flexible Paxos only
+requires q1 + q2 > n: electing with q1=4 lets every subsequent command
+commit with just q2=2 acks — lower steady-state latency, rarer but more
+expensive elections. Role parity:
+``examples/distributed/flexible_paxos_quorums.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    Entity,
+    Event,
+    Instant,
+    Network,
+    NetworkLink,
+    Simulation,
+)
+from happysim_tpu.components.consensus import FlexiblePaxosNode
+
+
+def main() -> dict:
+    network = Network(
+        "net", default_link=NetworkLink("link", latency=ConstantLatency(0.01))
+    )
+    nodes = [
+        FlexiblePaxosNode(f"f{i}", network, phase1_quorum=4, phase2_quorum=2)
+        for i in range(5)
+    ]
+    for node in nodes:
+        node.set_peers(nodes)
+
+    # The invariant q1 + q2 > n is enforced at wiring time.
+    try:
+        bad = FlexiblePaxosNode("bad", network, phase1_quorum=2, phase2_quorum=2)
+        bad.set_peers(nodes)
+        invariant_enforced = False
+    except ValueError:
+        invariant_enforced = True
+
+    results = []
+
+    class Client(Entity):
+        def handle_event(self, event):
+            for i in range(3):
+                outcome = yield nodes[0].submit({"op": "set", "key": f"k{i}", "value": i})
+                results.append(outcome)
+
+    client = Client("client")
+    sim = Simulation(
+        entities=[network, client, *nodes], end_time=Instant.from_seconds(30)
+    )
+    sim.schedule(nodes[0].start())
+    sim.schedule(Event(Instant.from_seconds(2.0), "go", target=client))
+    sim.run()
+
+    assert invariant_enforced
+    assert len(results) == 3 and all(r is not None for r in results)
+    assert nodes[0].is_leader
+    assert nodes[0].phase2_quorum == 2
+    return {"commits": len(results), "phase2_quorum": nodes[0].phase2_quorum}
+
+
+if __name__ == "__main__":
+    print(main())

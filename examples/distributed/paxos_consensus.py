@@ -1,0 +1,58 @@
+"""Competing Paxos proposers always agree on a single value.
+
+Two proposers race to decide different values over a 10ms network. Safety
+holds: every acceptor ends up decided on the SAME value, and both proposers
+learn that one winner. Role parity: ``examples/distributed/paxos_consensus.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    Entity,
+    Event,
+    Instant,
+    Network,
+    NetworkLink,
+    Simulation,
+)
+from happysim_tpu.components.consensus import PaxosNode
+
+
+def main() -> dict:
+    network = Network(
+        "net", default_link=NetworkLink("link", latency=ConstantLatency(0.01))
+    )
+    nodes = [PaxosNode(f"acceptor{i}", network, retry_delay=0.2, seed=i) for i in range(5)]
+    for node in nodes:
+        node.set_peers(nodes)
+
+    outcomes = []
+
+    class Proposer(Entity):
+        def __init__(self, name, node, value):
+            super().__init__(name)
+            self.node = node
+            self.value = value
+
+        def handle_event(self, event):
+            decided = yield self.node.propose(self.value), self.node.start_phase1()
+            outcomes.append(decided)
+
+    red = Proposer("proposer_red", nodes[0], "red")
+    blue = Proposer("proposer_blue", nodes[1], "blue")
+    sim = Simulation(
+        entities=[network, red, blue, *nodes], end_time=Instant.from_seconds(30)
+    )
+    sim.schedule(Event(Instant.from_seconds(0.0), "go", target=red))
+    sim.schedule(Event(Instant.from_seconds(0.001), "go", target=blue))
+    sim.run()
+
+    decided = {n.decided_value for n in nodes if n.is_decided}
+    assert len(decided) == 1, f"split decision: {decided}"
+    winner = decided.pop()
+    assert winner in {"red", "blue"}
+    assert outcomes[0] == outcomes[1] == winner
+    return {"winner": winner, "proposals": len(outcomes)}
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,65 @@
+"""SWIM-style membership: a crashed node is detected and declared dead.
+
+Five members probe each other every 500ms. When one crashes at t=10s, the
+survivors move it through SUSPECT to DEAD via indirect probes and suspicion
+timeouts, while every healthy member stays ALIVE. Role parity:
+``examples/distributed/swim_membership.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    Event,
+    Instant,
+    Network,
+    NetworkLink,
+    Simulation,
+)
+from happysim_tpu.components.consensus import MembershipProtocol, MemberState
+from happysim_tpu.core.callback_entity import CallbackEntity
+
+
+def main() -> dict:
+    network = Network(
+        "net", default_link=NetworkLink("link", latency=ConstantLatency(0.005))
+    )
+    members = [
+        MembershipProtocol(
+            f"m{i}",
+            network,
+            probe_interval=0.5,
+            suspicion_timeout=2.0,
+            phi_threshold=3.0,
+            seed=i,
+        )
+        for i in range(5)
+    ]
+    for m in members:
+        for other in members:
+            m.add_member(other)
+
+    def crash(event):
+        members[4]._crashed = True
+        return None
+
+    crasher = CallbackEntity("crasher", crash)
+    sim = Simulation(
+        entities=[network, crasher, *members], end_time=Instant.from_seconds(60)
+    )
+    for m in members:
+        sim.schedule(m.start())
+    sim.schedule(Event(Instant.from_seconds(10), "crash", target=crasher))
+    sim.run()
+
+    survivors = members[:4]
+    for s in survivors:
+        assert s.get_member_state("m4") == MemberState.DEAD
+        for other in survivors:
+            if other is not s:
+                assert s.get_member_state(other.name) == MemberState.ALIVE
+    probes = sum(s.stats.probes_sent for s in survivors)
+    assert probes > 100
+    return {"dead": "m4", "survivor_probes": probes}
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,61 @@
+"""A DAG job scheduler runs an ETL pipeline in dependency order.
+
+extract -> transform -> load, plus an independent report job that runs as
+soon as the scheduler ticks. Each stage starts only after its dependency
+COMPLETES (not merely starts). Role parity:
+``examples/infrastructure/job_scheduler_dag.py``.
+"""
+
+from happysim_tpu import Entity, Instant, Simulation
+from happysim_tpu.components.scheduling import JobDefinition, JobScheduler
+
+
+class Stage(Entity):
+    def __init__(self, name, work_s):
+        super().__init__(name)
+        self.work_s = work_s
+        self.runs = []
+
+    def handle_event(self, event):
+        self.runs.append(self.now.to_seconds())
+        yield self.work_s
+
+
+def main() -> dict:
+    extract = Stage("extract", work_s=1.0)
+    transform = Stage("transform", work_s=2.0)
+    load = Stage("load", work_s=0.5)
+    report = Stage("report", work_s=0.2)
+
+    scheduler = JobScheduler("etl", tick_interval=0.5)
+    scheduler.add_job(JobDefinition(name="extract", target=extract))
+    scheduler.add_job(
+        JobDefinition(name="transform", target=transform, dependencies=("extract",))
+    )
+    scheduler.add_job(JobDefinition(name="load", target=load, dependencies=("transform",)))
+    scheduler.add_job(JobDefinition(name="report", target=report))
+
+    sim = Simulation(
+        entities=[scheduler, extract, transform, load, report],
+        end_time=Instant.from_seconds(30),
+    )
+    sim.schedule(scheduler.start())
+    sim.run()
+
+    assert scheduler.stats.jobs_completed == 4
+    assert extract.runs[0] < transform.runs[0] < load.runs[0]
+    assert transform.runs[0] >= extract.runs[0] + 1.0, "waits for completion"
+    assert load.runs[0] >= transform.runs[0] + 2.0
+    assert report.runs[0] < transform.runs[0], "independent job is not serialized"
+    return {
+        "order": {
+            "extract": round(extract.runs[0], 2),
+            "transform": round(transform.runs[0], 2),
+            "load": round(load.runs[0], 2),
+            "report": round(report.runs[0], 2),
+        }
+    }
+
+
+if __name__ == "__main__":
+    print(main())

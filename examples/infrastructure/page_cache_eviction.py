@@ -1,0 +1,50 @@
+"""Page-cache hit ratio collapses when the working set outgrows capacity.
+
+The same 20-page cyclic scan runs against a 32-page cache (everything fits:
+one cold pass, then all hits) and an 8-page cache (LRU evicts each page
+just before its next use — the classic sequential-scan worst case, ~0%
+warm hits). Role parity: ``examples/infrastructure/page_cache_eviction.py``.
+"""
+
+from happysim_tpu import Entity, Event, Instant, Simulation
+from happysim_tpu.components.infrastructure import PageCache
+
+
+def _scan(capacity_pages: int, working_set: int = 20, passes: int = 3):
+    cache = PageCache("cache", capacity_pages=capacity_pages)
+
+    class Scanner(Entity):
+        def handle_event(self, event):
+            for _ in range(passes):
+                for page in range(working_set):
+                    yield from cache.read_page(page)
+            return None
+
+    scanner = Scanner("scanner")
+    sim = Simulation(entities=[cache, scanner], end_time=Instant.from_seconds(600))
+    sim.schedule(Event(Instant.Epoch, "Go", target=scanner))
+    sim.run()
+    return cache.stats()
+
+
+def main() -> dict:
+    fits = _scan(capacity_pages=32)
+    thrash = _scan(capacity_pages=8)
+
+    # Fits: 20 cold misses, then 40 hits.
+    assert fits.misses == 20
+    assert fits.hits == 40
+    assert fits.evictions == 0
+
+    # Thrashing: LRU + cyclic scan evicts every page before reuse.
+    assert thrash.hits == 0
+    assert thrash.misses == 60
+    assert thrash.evictions >= 50
+    return {
+        "fits_hit_ratio": round(fits.hits / (fits.hits + fits.misses), 3),
+        "thrash_hit_ratio": round(thrash.hits / (thrash.hits + thrash.misses), 3),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,70 @@
+"""Windowed stream processing: tumbling sums and session gaps.
+
+A clickstream flows through two processors: a 10s tumbling window sums
+revenue per window, and a 5s-gap session window groups a user's burst of
+clicks into one session while a later click opens a second. Role parity:
+``examples/infrastructure/stream_processor.py``.
+"""
+
+from happysim_tpu import Entity, Event, Instant, Simulation
+from happysim_tpu.components.streaming import (
+    SessionWindow,
+    StreamProcessor,
+    TumblingWindow,
+)
+
+
+class WindowSink(Entity):
+    def __init__(self, name="sink"):
+        super().__init__(name)
+        self.windows = []
+
+    def handle_event(self, event):
+        if event.event_type == "WindowResult":
+            meta = event.context["metadata"]
+            self.windows.append(
+                (meta["window_start"], meta["window_end"], meta["result"])
+            )
+        return None
+
+
+def _click(processor, at, key, value):
+    return Event(
+        Instant.from_seconds(at),
+        "Process",
+        target=processor,
+        context={"metadata": {"key": key, "value": value, "event_time_s": at}},
+    )
+
+
+def main() -> dict:
+    revenue_sink = WindowSink("revenue_sink")
+    revenue = StreamProcessor(
+        "revenue", TumblingWindow(10.0), sum, revenue_sink, watermark_interval_s=1.0
+    )
+    sim = Simulation(entities=[revenue, revenue_sink], end_time=Instant.from_seconds(60))
+    for at, amount in ((1.0, 5), (4.0, 10), (9.0, 1), (12.0, 20), (18.0, 2)):
+        sim.schedule(_click(revenue, at, "checkout", amount))
+    sim.run()
+    sums = {(s, e): r for s, e, r in revenue_sink.windows}
+    assert sums[(0.0, 10.0)] == 16
+    assert sums[(10.0, 20.0)] == 22
+
+    session_sink = WindowSink("session_sink")
+    sessions = StreamProcessor(
+        "sessions", SessionWindow(gap_s=5.0), len, session_sink, watermark_interval_s=1.0
+    )
+    sim2 = Simulation(
+        entities=[sessions, session_sink], end_time=Instant.from_seconds(120)
+    )
+    for at in (1.0, 3.0, 6.0, 30.0):  # burst then a lone late click
+        sim2.schedule(_click(sessions, at, "user42", at))
+    sim2.run()
+    session_sizes = sorted(r for _, _, r in session_sink.windows)
+    assert session_sizes == [1, 3], "burst merges; the gap opens a new session"
+
+    return {"tumbling_sums": list(sums.values()), "session_sizes": session_sizes}
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,79 @@
+"""Zipf-skewed keys break key-affinity load balancing.
+
+With uniform keys, a consistent-hash ring spreads load evenly. Feed it a
+Zipf(1.2) key stream and the hot keys' owners melt: the busiest backend
+carries several times the coldest one's load, while round-robin (no
+affinity) stays level — the fundamental cache-affinity vs load-evenness
+trade. Role parity: ``examples/load-balancing/zipf_effect.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    Instant,
+    LoadBalancer,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+    UniformDistribution,
+    ZipfDistribution,
+)
+from happysim_tpu.components.load_balancer import ConsistentHash, RoundRobin
+from happysim_tpu.load.event_provider import SimpleEventProvider
+
+N_BACKENDS = 8
+N_REQUESTS = 4000
+
+
+def _run(strategy, key_dist):
+    sink = Sink("sink")
+    lb = LoadBalancer("lb", strategy=strategy)
+    backends = [
+        Server(f"b{i}", concurrency=64, service_time=ConstantLatency(0.001), downstream=sink)
+        for i in range(N_BACKENDS)
+    ]
+    for b in backends:
+        lb.add_backend(b)
+    provider = SimpleEventProvider(
+        target=lb,
+        context_fn=lambda t, i: {"metadata": {"key": f"key{key_dist.sample()}"}},
+    )
+    source = Source.constant(rate=400.0, event_provider=provider, stop_after=10.0)
+    sim = Simulation(
+        sources=[source], entities=[lb, sink, *backends], end_time=Instant.from_seconds(12)
+    )
+    sim.run()
+    counts = [b.requests_completed for b in backends]
+    assert sum(counts) >= N_REQUESTS * 0.95
+    return counts
+
+
+def _key_of(event):
+    return event.context.get("metadata", {}).get("key")
+
+
+def main() -> dict:
+    uniform_counts = _run(
+        ConsistentHash(get_key=_key_of), UniformDistribution(items=range(4000), seed=1)
+    )
+    zipf_counts = _run(
+        ConsistentHash(get_key=_key_of), ZipfDistribution(items=4000, exponent=1.2, seed=1)
+    )
+    rr_counts = _run(RoundRobin(), ZipfDistribution(items=4000, exponent=1.2, seed=1))
+
+    def imbalance(counts):
+        return max(counts) / max(1, min(counts))
+
+    assert imbalance(rr_counts) < 1.1, "round-robin ignores keys: flat"
+    assert imbalance(zipf_counts) > 2 * imbalance(uniform_counts), (
+        f"hot keys skew the ring: {zipf_counts} vs {uniform_counts}"
+    )
+    return {
+        "uniform_imbalance": round(imbalance(uniform_counts), 2),
+        "zipf_imbalance": round(imbalance(zipf_counts), 2),
+        "round_robin_imbalance": round(imbalance(rr_counts), 2),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

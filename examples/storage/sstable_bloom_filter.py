@@ -1,0 +1,39 @@
+"""Bloom filters make absent-key lookups nearly free in an LSM tree.
+
+An SSTable of 1,000 keys answers present-key gets with ~2 page reads
+(sparse index + data page). For keys that don't exist, the bloom filter
+short-circuits >95% of lookups to ZERO page reads — the reason LSM read
+amplification stays bounded as levels stack up. Role parity:
+``examples/storage/sstable_bloom_filter.py``.
+"""
+
+from happysim_tpu.components.storage import SSTable
+
+
+def main() -> dict:
+    sst = SSTable([(f"user{i:05d}", {"id": i}) for i in range(1000)])
+
+    present_reads = [sst.page_reads_for_get(f"user{i:05d}") for i in range(0, 1000, 50)]
+    assert all(1 <= r <= 3 for r in present_reads)
+    assert all(sst.get(f"user{i:05d}") == {"id": i} for i in range(0, 1000, 100))
+
+    absent_probes = 1000
+    filtered = sum(
+        1 for i in range(absent_probes) if sst.page_reads_for_get(f"ghost{i}") == 0
+    )
+    fp_rate = 1.0 - filtered / absent_probes
+    assert fp_rate < 0.05, f"bloom FP rate too high: {fp_rate}"
+    assert sst.get("ghost1") is None
+
+    stats = sst.stats
+    assert stats.key_count == 1000
+    assert stats.bloom_filter_size_bits > 0
+    return {
+        "present_page_reads": max(present_reads),
+        "absent_filtered_pct": round(100 * filtered / absent_probes, 1),
+        "nominal_fp_rate": stats.bloom_filter_fp_rate,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -151,7 +151,14 @@ class MultiPaxosNode(Entity):
 
     def start(self) -> list[Event]:
         """Run Phase 1 to become the stable leader."""
-        self._ballot = Ballot(self._ballot.number + 1, self.name)
+        # Supersede every ballot we have seen, not just our own: a failover
+        # candidate must outbid the dead leader's ballot or every acceptor
+        # that promised it would nack us (parity: reference
+        # multi_paxos.py:153-156 tracks max-seen in _current_ballot).
+        seen = self._ballot.number
+        if self._promised_ballot is not None:
+            seen = max(seen, self._promised_ballot.number)
+        self._ballot = Ballot(seen + 1, self.name)
         self._phase1_responses = [{"from": self.name, "accepted": dict(self._accepted)}]
         self._promised_ballot = self._ballot
         self._prepares_sent += 1
@@ -180,6 +187,7 @@ class MultiPaxosNode(Entity):
             "MultiPaxosForward": self._handle_forward,
             "MultiPaxosDecided": self._handle_slot_decided,
             "MultiPaxosHeartbeatTick": self._handle_heartbeat_tick,
+            "MultiPaxosNack": self._handle_nack,
         }
         handler = handlers.get(event.event_type)
         return handler(event) if handler else None
@@ -313,6 +321,12 @@ class MultiPaxosNode(Entity):
             ]
         self._promised_ballot = ballot
         self._leader = ballot.node_id
+        # A superior leader's Accept deposes us the same way its prepare or
+        # heartbeat would — a stale leader must not keep assigning slots at
+        # its old ballot (parity: reference multi_paxos.py:313-314 adopts
+        # _current_ballot on every accepted Accept).
+        if ballot.node_id != self.name and (self._is_leader or self._phase1_responses):
+            self._step_down()
         slot = meta["slot"]
         self._accepted[slot] = (ballot, meta["value"])
         return [
@@ -373,6 +387,17 @@ class MultiPaxosNode(Entity):
             future = self._slot_futures.pop(entry.index, None)
             if future is not None:
                 future.resolve((entry.index, result))
+
+    def _handle_nack(self, event: Event) -> None:
+        """A peer refused our prepare/accept: adopt the higher ballot number
+        so the caller's next start() outbids it, and abandon leadership
+        (parity: reference multi_paxos.py:382-392)."""
+        meta = event.context.get("metadata", {})
+        higher = meta.get("highest_ballot_number", 0)
+        if higher > self._ballot.number:
+            self._ballot = Ballot(higher, self.name)
+            self._step_down()
+        return None
 
     def _step_down(self) -> None:
         """Abandon leadership AND any in-progress candidacy.

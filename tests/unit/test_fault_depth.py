@@ -277,6 +277,78 @@ class TestMultiPaxosFailover:
         assert not candidate.is_leader
         assert candidate.leader == "mp9"
 
+    def test_failover_candidate_outbids_dead_leaders_ballot(self):
+        """start() must supersede the promised ballot, or every acceptor
+        that promised the dead leader would nack the candidate forever."""
+        network, nodes = self._cluster()
+        sim = Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(30))
+        for ev in nodes[2].start():
+            sim.schedule(ev)
+        sim.run()
+        assert nodes[2].is_leader
+        # nodes[0] promised nodes[2]'s ballot via phase 1/heartbeats.
+        assert nodes[0]._promised_ballot.node_id == "mp2"
+        promised_number = nodes[0]._promised_ballot.number
+        nodes[2]._crashed = True
+        sim_b = Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(60))
+        events = nodes[0].start()
+        assert nodes[0]._ballot.number > promised_number, "candidate outbids"
+        for ev in events:
+            sim_b.schedule(ev)
+        sim_b.run()
+        assert nodes[0].is_leader
+
+    def test_superior_accept_deposes_stale_leader(self):
+        """An Accept at a higher ballot from another leader must depose a
+        sitting leader, not leave it assigning slots at its stale ballot."""
+        from happysim_tpu.core.event import Event
+
+        network, nodes = self._cluster()
+        sim = Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(30))
+        for ev in nodes[0].start():
+            sim.schedule(ev)
+        sim.run()
+        assert nodes[0].is_leader
+        nodes[0].handle_event(
+            Event(
+                Instant.from_seconds(31),
+                "MultiPaxosAccept",
+                target=nodes[0],
+                context={
+                    "metadata": {
+                        "ballot_number": 500,
+                        "ballot_node": "mp1",
+                        "source": "mp1",
+                        "slot": 1,
+                        "value": {"op": "set", "key": "x", "value": 1},
+                    }
+                },
+            )
+        )
+        assert not nodes[0].is_leader
+        assert nodes[0]._accepted[1][0].number == 500
+
+    def test_nack_adopts_higher_ballot_for_next_attempt(self):
+        from happysim_tpu.core.event import Event
+
+        network, nodes = self._cluster()
+        Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(10))
+        candidate = nodes[0]
+        candidate.start()
+        candidate.handle_event(
+            Event(
+                Instant.from_seconds(1),
+                "MultiPaxosNack",
+                target=candidate,
+                context={"metadata": {"highest_ballot_number": 77}},
+            )
+        )
+        assert candidate._ballot.number == 77
+        assert not candidate.is_leader
+        # The next start() outbids the nacker.
+        candidate.start()
+        assert candidate._ballot.number == 78
+
     def test_heartbeat_from_superior_leader_deposes(self):
         from happysim_tpu.core.event import Event
 
