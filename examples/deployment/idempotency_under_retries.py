@@ -37,6 +37,8 @@ def main() -> dict:
             context={"metadata": {"idempotency_key": "order-43", "amount": 12}},
         )
     )
+    # Timers (TTL sweeps) are daemon events and a sim with only daemon
+    # events auto-terminates; one late primary event holds it open to t=4.
     sim.schedule(Event(Instant.from_seconds(4.0), "Keepalive", target=Counter("ka")))
     sim.run()
 

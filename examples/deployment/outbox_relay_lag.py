@@ -20,6 +20,8 @@ def main() -> dict:
     # Business writes spread over 3 seconds.
     for i in range(12):
         outbox.write({"order": i})
+    # Poll ticks are daemon events and a sim with only daemon events
+    # auto-terminates; one late primary event holds the run open to t=9.
     sim.schedule([outbox.prime_poll(), Event(Instant.from_seconds(9), "ka", target=Counter("ka"))])
     sim.run()
 

@@ -54,6 +54,8 @@ def main() -> dict:
     # Calls after recovery timeout: half-open probe closes the circuit.
     for i in range(4):
         sim.schedule(Event(Instant.from_seconds(10.0 + 0.5 * i), "Call", target=sidecar))
+    # Retry/circuit timers are daemon events and a sim with only daemon
+    # events auto-terminates; one late primary event holds it open to t=19.
     sim.schedule(Event(Instant.from_seconds(19.0), "ka", target=Counter("ka")))
     sim.run()
 

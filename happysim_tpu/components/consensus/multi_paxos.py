@@ -205,7 +205,10 @@ class MultiPaxosNode(Entity):
                     source=self,
                     destination=sender,
                     event_type="MultiPaxosNack",
-                    payload={"highest_ballot_number": self._promised_ballot.number},
+                    payload={
+                        "highest_ballot_number": self._promised_ballot.number,
+                        "highest_ballot_node": self._promised_ballot.node_id,
+                    },
                     daemon=False,
                 )
             ]
@@ -315,7 +318,10 @@ class MultiPaxosNode(Entity):
                     source=self,
                     destination=sender,
                     event_type="MultiPaxosNack",
-                    payload={"highest_ballot_number": self._promised_ballot.number},
+                    payload={
+                        "highest_ballot_number": self._promised_ballot.number,
+                        "highest_ballot_node": self._promised_ballot.node_id,
+                    },
                     daemon=False,
                 )
             ]
@@ -389,13 +395,20 @@ class MultiPaxosNode(Entity):
                 future.resolve((entry.index, result))
 
     def _handle_nack(self, event: Event) -> None:
-        """A peer refused our prepare/accept: adopt the higher ballot number
-        so the caller's next start() outbids it, and abandon leadership
-        (parity: reference multi_paxos.py:382-392)."""
+        """A peer refused our prepare/accept: adopt the refusing ballot so
+        the caller's next start() outbids it, and abandon leadership
+        (parity: reference multi_paxos.py:382-392).
+
+        The full (number, node) ballot is compared — an equal-number rival
+        that won the node-id tie-break must still depose us, or a lost
+        leadership race leaves a zombie leader accepting doomed submits.
+        """
         meta = event.context.get("metadata", {})
-        higher = meta.get("highest_ballot_number", 0)
-        if higher > self._ballot.number:
-            self._ballot = Ballot(higher, self.name)
+        refusing = Ballot(
+            meta.get("highest_ballot_number", 0), meta.get("highest_ballot_node", "")
+        )
+        if refusing > self._ballot:
+            self._ballot = Ballot(refusing.number, self.name)
             self._step_down()
         return None
 

@@ -349,6 +349,47 @@ class TestMultiPaxosFailover:
         candidate.start()
         assert candidate._ballot.number == 78
 
+    def test_nack_with_equal_number_higher_node_deposes(self):
+        """Two failover candidates can race to the same ballot number; the
+        node-id tie-break loser must honor nacks from the winner's
+        acceptors, not shrug them off as equal-numbered."""
+        from happysim_tpu.core.event import Event
+
+        network, nodes = self._cluster()
+        Simulation(entities=[network, *nodes], end_time=Instant.from_seconds(10))
+        loser = nodes[0]  # "mp0" loses the tie-break to "mp2"
+        loser.start()     # ballot (1, mp0)
+        number = loser._ballot.number
+        # One promise reaches quorum: the loser thinks it is leader...
+        loser.handle_event(
+            Event(
+                Instant.from_seconds(0.5),
+                "MultiPaxosPromise",
+                target=loser,
+                context={
+                    "metadata": {"ballot_number": number, "from": "mp1", "accepted": {}}
+                },
+            )
+        )
+        assert loser.is_leader
+        # ...until an acceptor promised to the equal-number rival nacks it.
+        loser.handle_event(
+            Event(
+                Instant.from_seconds(1),
+                "MultiPaxosNack",
+                target=loser,
+                context={
+                    "metadata": {
+                        "highest_ballot_number": number,
+                        "highest_ballot_node": "mp2",
+                    }
+                },
+            )
+        )
+        assert not loser.is_leader
+        loser.start()
+        assert loser._ballot.number == number + 1  # outbids the rival
+
     def test_heartbeat_from_superior_leader_deposes(self):
         from happysim_tpu.core.event import Event
 

@@ -1,0 +1,168 @@
+"""Generate docs/api/*.md reference pages by introspecting the package.
+
+Usage: python tools/gen_api_docs.py
+Rewrites one page per subpackage: public classes/functions, signatures,
+and docstring summaries. Kept in-repo so the pages never drift from code.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pathlib
+import pkgutil
+
+import happysim_tpu
+
+ROOT = pathlib.Path(__file__).parent.parent
+OUT = ROOT / "docs" / "api"
+
+PAGES: dict[str, list[str]] = {
+    "core": ["happysim_tpu.core"],
+    "load": ["happysim_tpu.load"],
+    "distributions": ["happysim_tpu.distributions"],
+    "faults": ["happysim_tpu.faults"],
+    "instrumentation": ["happysim_tpu.instrumentation"],
+    "sketching": ["happysim_tpu.sketching"],
+    "numerics": ["happysim_tpu.numerics"],
+    "parallel": ["happysim_tpu.parallel"],
+    "analysis": ["happysim_tpu.analysis"],
+    "ai": ["happysim_tpu.ai"],
+    "mcp": ["happysim_tpu.mcp"],
+    "visual": ["happysim_tpu.visual"],
+    "logging": ["happysim_tpu.logging_config"],
+    "tpu": ["happysim_tpu.tpu"],
+    "components-primitives": [
+        "happysim_tpu.components.queue",
+        "happysim_tpu.components.queue_driver",
+        "happysim_tpu.components.queue_policy",
+        "happysim_tpu.components.queued_resource",
+        "happysim_tpu.components.resource",
+        "happysim_tpu.components.common",
+        "happysim_tpu.components.random_router",
+    ],
+    "components-server-client": [
+        "happysim_tpu.components.server",
+        "happysim_tpu.components.client",
+        "happysim_tpu.components.load_balancer",
+    ],
+    "components-network": ["happysim_tpu.components.network"],
+    "components-consensus": ["happysim_tpu.components.consensus"],
+    "components-replication-crdt": [
+        "happysim_tpu.components.replication",
+        "happysim_tpu.components.crdt",
+    ],
+    "components-datastore-storage": [
+        "happysim_tpu.components.datastore",
+        "happysim_tpu.components.storage",
+    ],
+    "components-streaming-messaging": [
+        "happysim_tpu.components.streaming",
+        "happysim_tpu.components.messaging",
+    ],
+    "components-resilience-ratelimit": [
+        "happysim_tpu.components.resilience",
+        "happysim_tpu.components.rate_limiter",
+        "happysim_tpu.components.queue_policies",
+    ],
+    "components-microservice-deployment": [
+        "happysim_tpu.components.microservice",
+        "happysim_tpu.components.deployment",
+        "happysim_tpu.components.scheduling",
+    ],
+    "components-infrastructure": ["happysim_tpu.components.infrastructure"],
+    "components-industrial": ["happysim_tpu.components.industrial"],
+    "components-behavior": ["happysim_tpu.components.behavior"],
+    "components-sync-sketching": [
+        "happysim_tpu.components.sync",
+        "happysim_tpu.components.sketching",
+        "happysim_tpu.components.advertising",
+    ],
+}
+
+
+def _submodules(pkg) -> list:
+    mods = [pkg]
+    if hasattr(pkg, "__path__"):
+        for info in pkgutil.iter_modules(pkg.__path__):
+            if info.name.startswith("_"):
+                continue
+            mods.append(importlib.import_module(f"{pkg.__name__}.{info.name}"))
+    return mods
+
+
+def _first_line(obj) -> str:
+    doc = inspect.getdoc(obj) or ""
+    return doc.split("\n")[0] if doc else ""
+
+
+def _signature(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _document_module(mod) -> list[str]:
+    lines: list[str] = []
+    members = []
+    for name in sorted(vars(mod)):
+        if name.startswith("_"):
+            continue
+        obj = vars(mod)[name]
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "").split(".")[0] != "happysim_tpu":
+            continue
+        if getattr(obj, "__module__", "") != mod.__name__:
+            continue  # document where defined, not where re-exported
+        members.append((name, obj))
+    if not members:
+        return lines
+    lines.append(f"### `{mod.__name__}`")
+    mod_doc = _first_line(mod)
+    if mod_doc:
+        lines.append(f"\n{mod_doc}\n")
+    for name, obj in members:
+        kind = "class" if inspect.isclass(obj) else "def"
+        if inspect.isclass(obj):
+            try:
+                sig = str(inspect.signature(obj.__init__))
+                sig = sig.replace("(self, ", "(").replace("(self)", "()")
+            except (ValueError, TypeError):
+                sig = "(...)"
+        else:
+            sig = _signature(obj)
+        lines.append(f"- **`{name}`** `{kind} {name}{sig}`")
+        summary = _first_line(obj)
+        if summary:
+            lines.append(f"  — {summary}")
+        if inspect.isclass(obj):
+            methods = [
+                (m, fn)
+                for m, fn in sorted(vars(obj).items())
+                if not m.startswith("_") and inspect.isfunction(fn) and inspect.getdoc(fn)
+            ]
+            for m, fn in methods[:8]:
+                lines.append(f"    - `.{m}{_signature(fn)}` — {_first_line(fn)}")
+    lines.append("")
+    return lines
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    total_pages = 0
+    for page, module_names in PAGES.items():
+        body: list[str] = [f"# API: {page.replace('-', ' ')}", ""]
+        for module_name in module_names:
+            pkg = importlib.import_module(module_name)
+            for mod in _submodules(pkg):
+                body.extend(_document_module(mod))
+        text = "\n".join(body).rstrip() + "\n"
+        (OUT / f"{page}.md").write_text(text)
+        total_pages += 1
+    print(f"wrote {total_pages} pages to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
