@@ -1,7 +1,11 @@
 from happysim_tpu.distributions.latency_distribution import (
     ConstantLatency,
+    ErlangLatency,
     ExponentialLatency,
+    HyperExponentialLatency,
     LatencyDistribution,
+    LogNormalLatency,
+    ParetoLatency,
     PercentileFittedLatency,
     ShiftedLatency,
     UniformLatency,
@@ -14,7 +18,11 @@ from happysim_tpu.distributions.value_distribution import (
 
 __all__ = [
     "ConstantLatency",
+    "ErlangLatency",
     "ExponentialLatency",
+    "HyperExponentialLatency",
+    "LogNormalLatency",
+    "ParetoLatency",
     "LatencyDistribution",
     "PercentileFittedLatency",
     "ShiftedLatency",

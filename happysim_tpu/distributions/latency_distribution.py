@@ -128,6 +128,140 @@ class UniformLatency(LatencyDistribution):
         )
 
 
+class ErlangLatency(LatencyDistribution):
+    """Erlang-k delay (sum of k exponential phases), cv^2 = 1/k.
+
+    The low-variance M/G/1 service family; TPU twin:
+    ``tpu/model.py`` server ``service="erlang"``. Host sampling accepts
+    any ``k >= 1``, but the TPU twin only compiles ``k in (2, 3)`` (its
+    per-step uniform budget) — ``tpu_spec()`` with another k will be
+    rejected by ``EnsembleModel.server``.
+    """
+
+    def __init__(self, mean: Duration | float, k: int = 2, seed: Optional[int] = None):
+        self._mean = as_duration(mean)
+        if self._mean.nanoseconds <= 0:
+            raise ValueError("ErlangLatency mean must be positive")
+        if k < 1:
+            raise ValueError("ErlangLatency k must be >= 1")
+        self._k = k
+        self._rng = random.Random(seed)
+
+    def get_latency(self, time: Instant) -> Duration:
+        phases = sum(self._rng.expovariate(1.0) for _ in range(self._k))
+        return Duration(round(phases * self._mean.nanoseconds / self._k))
+
+    def mean(self) -> Duration:
+        return self._mean
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("erlang", {"mean_s": self._mean.to_seconds(), "k": self._k})
+
+    def __repr__(self) -> str:
+        return f"ErlangLatency(mean={self._mean!r}, k={self._k})"
+
+
+class HyperExponentialLatency(LatencyDistribution):
+    """Balanced two-phase hyperexponential with cv^2 = ``scv`` > 1.
+
+    Standard H2 fit: p1 = (1 + sqrt((c2-1)/(c2+1)))/2, branch means
+    mean/(2 p_i). The high-variance M/G/1 service family.
+    """
+
+    def __init__(self, mean: Duration | float, scv: float = 2.0, seed: Optional[int] = None):
+        self._mean = as_duration(mean)
+        if self._mean.nanoseconds <= 0:
+            raise ValueError("HyperExponentialLatency mean must be positive")
+        if scv <= 1.0:
+            raise ValueError("HyperExponentialLatency scv must be > 1")
+        self._scv = scv
+        self._p1 = 0.5 * (1.0 + math.sqrt((scv - 1.0) / (scv + 1.0)))
+        self._rng = random.Random(seed)
+
+    def get_latency(self, time: Instant) -> Duration:
+        p1 = self._p1
+        branch_mean = 1.0 / (2.0 * p1) if self._rng.random() < p1 else 1.0 / (
+            2.0 * (1.0 - p1)
+        )
+        sample = self._rng.expovariate(1.0) * branch_mean
+        return Duration(round(sample * self._mean.nanoseconds))
+
+    def mean(self) -> Duration:
+        return self._mean
+
+    @property
+    def scv(self) -> float:
+        return self._scv
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("hyperexp", {"mean_s": self._mean.to_seconds(), "scv": self._scv})
+
+    def __repr__(self) -> str:
+        return f"HyperExponentialLatency(mean={self._mean!r}, scv={self._scv})"
+
+
+class LogNormalLatency(LatencyDistribution):
+    """Lognormal delay, mean-preserving, cv^2 = ``scv``.
+
+    sigma^2 = ln(1 + scv); mu = ln(mean) - sigma^2/2.
+    """
+
+    def __init__(self, mean: Duration | float, scv: float = 1.0, seed: Optional[int] = None):
+        self._mean = as_duration(mean)
+        if self._mean.nanoseconds <= 0:
+            raise ValueError("LogNormalLatency mean must be positive")
+        if scv <= 0.0:
+            raise ValueError("LogNormalLatency scv must be > 0")
+        self._scv = scv
+        self._sigma = math.sqrt(math.log(1.0 + scv))
+        self._rng = random.Random(seed)
+
+    def get_latency(self, time: Instant) -> Duration:
+        z = self._rng.gauss(0.0, 1.0)
+        factor = math.exp(self._sigma * z - 0.5 * self._sigma * self._sigma)
+        return Duration(round(factor * self._mean.nanoseconds))
+
+    def mean(self) -> Duration:
+        return self._mean
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("lognormal", {"mean_s": self._mean.to_seconds(), "scv": self._scv})
+
+    def __repr__(self) -> str:
+        return f"LogNormalLatency(mean={self._mean!r}, scv={self._scv})"
+
+
+class ParetoLatency(LatencyDistribution):
+    """Mean-matched Pareto delay: heavy tails, x_m = mean (alpha-1)/alpha.
+
+    Finite variance (and a P-K oracle) requires alpha > 2.
+    """
+
+    def __init__(self, mean: Duration | float, alpha: float = 2.5, seed: Optional[int] = None):
+        self._mean = as_duration(mean)
+        if self._mean.nanoseconds <= 0:
+            raise ValueError("ParetoLatency mean must be positive")
+        if alpha <= 1.0:
+            raise ValueError("ParetoLatency alpha must be > 1 (finite mean)")
+        self._alpha = alpha
+        self._xm_factor = (alpha - 1.0) / alpha
+        self._rng = random.Random(seed)
+
+    def get_latency(self, time: Instant) -> Duration:
+        u = 1.0 - self._rng.random()  # (0, 1]
+        sample = self._xm_factor * u ** (-1.0 / self._alpha)
+        return Duration(round(sample * self._mean.nanoseconds))
+
+    def mean(self) -> Duration:
+        return self._mean
+
+    def tpu_spec(self) -> tuple[str, dict]:
+        return ("pareto", {"mean_s": self._mean.to_seconds(), "alpha": self._alpha})
+
+    def __repr__(self) -> str:
+        return f"ParetoLatency(mean={self._mean!r}, alpha={self._alpha})"
+
+
 class PercentileFittedLatency(LatencyDistribution):
     """Exponential fit through observed percentile points.
 

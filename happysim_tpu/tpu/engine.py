@@ -37,6 +37,7 @@ and load_balancer strategies), Sink latency accounting
 from __future__ import annotations
 
 import logging
+import math
 import time as _wall
 from dataclasses import dataclass
 from functools import partial
@@ -207,13 +208,45 @@ class _Compiled:
         servers = model.servers
         self.slot_valid = np.zeros((self.nV, self.C), np.bool_)
         self.queue_cap = np.zeros((self.nV,), np.int32)
-        self.service_is_exp = np.zeros((self.nV,), np.bool_)
         self.srv_deadline = np.full((self.nV,), np.inf, np.float32)
         self.srv_max_retries = np.zeros((self.nV,), np.int32)
+        # Service family per server + host-precomputed shape constants.
+        # Kind ids: 0 constant, 1 exponential, 2 erlang, 3 hyperexp,
+        # 4 lognormal, 5 pareto (see model.SERVICE_KINDS).
+        self.service_kind = np.zeros((self.nV,), np.int32)
+        self.srv_erlang_k = np.full((self.nV,), 2.0, np.float32)
+        self.srv_hyp_p1 = np.full((self.nV,), 0.5, np.float32)
+        self.srv_hyp_f1 = np.ones((self.nV,), np.float32)
+        self.srv_hyp_f2 = np.ones((self.nV,), np.float32)
+        self.srv_ln_sigma = np.zeros((self.nV,), np.float32)
+        self.srv_par_alpha = np.full((self.nV,), 2.5, np.float32)
+        self.srv_par_xmf = np.ones((self.nV,), np.float32)
+        kind_ids = {
+            "constant": 0, "exponential": 1, "erlang": 2,
+            "hyperexp": 3, "lognormal": 4, "pareto": 5,
+        }
         for v, spec in enumerate(servers):
             self.slot_valid[v, : spec.concurrency] = True
             self.queue_cap[v] = spec.queue_capacity
-            self.service_is_exp[v] = spec.service == "exponential"
+            self.service_kind[v] = kind_ids[spec.service]
+            if spec.service == "erlang":
+                self.srv_erlang_k[v] = float(spec.service_k)
+            elif spec.service == "hyperexp":
+                # Balanced two-phase: p1 = (1 + sqrt((c2-1)/(c2+1))) / 2,
+                # branch means m_i = mean / (2 p_i) (standard H2 fit).
+                c2 = spec.service_scv
+                p1 = 0.5 * (1.0 + math.sqrt((c2 - 1.0) / (c2 + 1.0)))
+                self.srv_hyp_p1[v] = p1
+                self.srv_hyp_f1[v] = 1.0 / (2.0 * p1)
+                self.srv_hyp_f2[v] = 1.0 / (2.0 * (1.0 - p1))
+            elif spec.service == "lognormal":
+                # cv^2 = exp(sigma^2) - 1; mean-preserving mu offset folded
+                # into the sampler (mean * exp(sigma z - sigma^2/2)).
+                self.srv_ln_sigma[v] = math.sqrt(math.log(1.0 + spec.service_scv))
+            elif spec.service == "pareto":
+                # x_m chosen so E[S] = mean: x_m = mean (alpha-1)/alpha.
+                self.srv_par_alpha[v] = spec.pareto_alpha
+                self.srv_par_xmf[v] = (spec.pareto_alpha - 1.0) / spec.pareto_alpha
             if spec.deadline_s is not None:
                 self.srv_deadline[v] = spec.deadline_s
                 self.srv_max_retries[v] = spec.max_retries
@@ -377,11 +410,45 @@ class _Compiled:
         return jnp.sum(jnp.where(mask, arr, jnp.zeros_like(arr)))
 
     # -- sampling ----------------------------------------------------------
-    def _sample_service(self, u, v, params):
+    def _sample_service(self, u3, v, params):
+        """Draw one service time for server ``v`` from its static family.
+
+        ``u3`` is a (3,) uniform slice — Erlang-3 is the hungriest family.
+        All six families are computed and masked by the compile-time kind
+        id (one-hot math, no data-dependent control flow); XLA folds the
+        unused branches when every server shares a family.
+        """
+        ua, ub, uc = u3[0], u3[1], u3[2]
         row = self._row(v, self.nV)
         mean = self._pick(params["srv_mean"], row)
-        is_exp = jnp.any(jnp.asarray(self.service_is_exp) & row)
-        return jnp.where(is_exp, -jnp.log(u) * mean, mean)
+        kind = self._pick(jnp.asarray(self.service_kind), row).astype(jnp.int32)
+
+        exp_draw = -jnp.log(ua) * mean
+        erlang_k = self._pick(jnp.asarray(self.srv_erlang_k), row)
+        erlang_draw = jnp.where(
+            erlang_k == 2.0,
+            -jnp.log(ua * ub) * mean * 0.5,
+            -jnp.log(ua * ub * uc) * mean / 3.0,
+        )
+        p1 = self._pick(jnp.asarray(self.srv_hyp_p1), row)
+        hyp_factor = jnp.where(
+            ua < p1,
+            self._pick(jnp.asarray(self.srv_hyp_f1), row),
+            self._pick(jnp.asarray(self.srv_hyp_f2), row),
+        )
+        hyp_draw = -jnp.log(ub) * mean * hyp_factor
+        sigma = self._pick(jnp.asarray(self.srv_ln_sigma), row)
+        z = jnp.sqrt(2.0) * jax.scipy.special.erfinv(2.0 * ua - 1.0)
+        ln_draw = mean * jnp.exp(sigma * z - 0.5 * sigma * sigma)
+        alpha = self._pick(jnp.asarray(self.srv_par_alpha), row)
+        par_draw = mean * self._pick(jnp.asarray(self.srv_par_xmf), row) * jnp.power(
+            ua, -1.0 / alpha
+        )
+        return jnp.select(
+            [kind == 0, kind == 1, kind == 2, kind == 3, kind == 4],
+            [mean, exp_draw, erlang_draw, hyp_draw, ln_draw],
+            par_draw,
+        )
 
     def _profile_cum_at(self, i: int, t):
         """Lambda_i(t) with linear extrapolation past the grid."""
@@ -425,18 +492,20 @@ class _Compiled:
     def _deliver(self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params):
         """Deliver a job leaving some node at time t across ``edge``.
 
-        ``u`` is a (3,) uniform triple: route, service, latency.
+        ``u`` is a (5,) uniform window: [route, latency, svc_a, svc_b,
+        svc_c] — three service draws so Erlang/hyperexponential families
+        have independent uniforms.
         """
         if dest.kind == LIMITER:
             return self._through_limiter(state, t, created, u, dest.index, params)
         if dest.kind == SINK:
-            latency = self._sample_edge(edge, u[2])
+            latency = self._sample_edge(edge, u[1])
             return self._deliver_sink(state, t + latency, created, dest.index)
         if dest.kind == SERVER:
             if edge.mean_s > 0:
-                latency = self._sample_edge(edge, u[2])
+                latency = self._sample_edge(edge, u[1])
                 return self._into_transit(state, dest.index, t + latency, created)
-            return self._arrive_server(state, dest.index, t, created, 0, u[1], params)
+            return self._arrive_server(state, dest.index, t, created, 0, u[2:5], params)
         # Router: one dynamic hop to a homogeneous target set. Edges INTO a
         # router are latency-free by construction (model.connect rejects
         # them); only the per-target edge below carries latency.
@@ -456,7 +525,7 @@ class _Compiled:
         chosen_exp = jnp.asarray(lat_exp)[choice]
         latency = jnp.where(
             chosen_mean > 0,
-            jnp.where(chosen_exp, -jnp.log(u[2]) * chosen_mean, chosen_mean),
+            jnp.where(chosen_exp, -jnp.log(u[1]) * chosen_mean, chosen_mean),
             0.0,
         )
         if target_kinds == {SINK}:
@@ -464,7 +533,7 @@ class _Compiled:
         if lat_means.any():
             return self._into_transit(state, indices[choice], t + latency, created)
         return self._arrive_server(
-            state, indices[choice], t, created, 0, u[1], params
+            state, indices[choice], t, created, 0, u[2:5], params
         )
 
     def _through_limiter(self, state, t, created, u, l: int, params):
@@ -565,7 +634,7 @@ class _Compiled:
             + row.astype(jnp.int32) * (~has_free).astype(jnp.int32),
         }
 
-    def _arrive_server(self, state, v, t, created, attempt, u_service, params):
+    def _arrive_server(self, state, v, t, created, attempt, u3, params):
         row = self._row(v, self.nV)  # (nV,)
         row_i = row.astype(jnp.int32)
         row_f = row.astype(jnp.float32)
@@ -579,7 +648,7 @@ class _Compiled:
             free
             & (jnp.arange(self.C, dtype=jnp.int32)[None, :] == first_free_col[:, None])
         )
-        service = self._sample_service(u_service, v, params)
+        service = self._sample_service(u3, v, params)
 
         q_len = self._pick(state["srv_q_len"], row)
         cap = self._pick(jnp.asarray(self.queue_cap), row)
@@ -652,7 +721,7 @@ class _Compiled:
         }
         source = self.model.sources[i]
         return self._deliver(
-            state, t, t, u[1:4], source.downstream, source.latency, params
+            state, t, t, u[1:6], source.downstream, source.latency, params
         )
 
     def _complete_server(self, v: int, state, t, u, params):
@@ -688,7 +757,7 @@ class _Compiled:
             }
             retried_state = self._enqueue_retry(state, v, t, created, attempt + 1)
             forwarded_state = self._deliver(
-                state, t, created, u[0:3], spec.downstream, spec.latency, params
+                state, t, created, u[0:5], spec.downstream, spec.latency, params
             )
             state = jax.tree_util.tree_map(
                 lambda retry_leaf, fwd_leaf, base_leaf: jnp.where(
@@ -702,7 +771,7 @@ class _Compiled:
             )
         else:
             state = self._deliver(
-                state, t, created, u[0:3], spec.downstream, spec.latency, params
+                state, t, created, u[0:5], spec.downstream, spec.latency, params
             )
         # Pull the next queued job into the freed slot (FIFO). A same-server
         # feedback delivery above may have re-claimed slot k, so only pull if
@@ -718,7 +787,7 @@ class _Compiled:
         queued_created = self._pick(state["srv_q_created"], head_mask)
         queued_enq = self._pick(state["srv_q_enq"], head_mask)
         queued_attempt = self._pick(state["srv_q_attempt"], head_mask).astype(jnp.int32)
-        service = self._sample_service(u[3], v, params)
+        service = self._sample_service(u[5:8], v, params)
         pull_mask = slot_mask & has_queued
         row_pull = row_i * has_queued.astype(jnp.int32)
         measure = t >= jnp.float32(self.warmup)
@@ -758,7 +827,7 @@ class _Compiled:
             **state,
             "tr_time": jnp.where(slot_mask, INF, state["tr_time"]),
         }
-        return self._arrive_server(state, v, t, created, 0, u[1], params)
+        return self._arrive_server(state, v, t, created, 0, u[1:4], params)
 
     # -- the step ----------------------------------------------------------
     def next_candidates(self, state):
@@ -813,7 +882,7 @@ class _Compiled:
             # the MONOTONE event counter so windowed reruns of the scan
             # never replay a stream (the per-window scan index restarts).
             step_key = jax.random.fold_in(state["key"], state["events"])
-            u = jax.random.uniform(step_key, (4,), minval=1e-12, maxval=1.0)
+            u = jax.random.uniform(step_key, (8,), minval=1e-12, maxval=1.0)
 
             def process(state):
                 # Only the post-warmup portion of the interval counts toward

@@ -35,7 +35,12 @@ LIMITER = "limiter"
 REMOTE = "remote"
 
 ARRIVAL_KINDS = ("poisson", "constant")
-SERVICE_KINDS = ("exponential", "constant")
+# Service-time families. Beyond M/M shapes, the M/G/1 set: Erlang-k
+# (cv^2 = 1/k), balanced 2-phase hyperexponential (cv^2 = service_scv > 1),
+# lognormal (cv^2 = service_scv), and mean-matched Pareto (pareto_alpha > 2
+# for a finite-variance P-K oracle). Host twins live in
+# happysim_tpu/distributions/latency_distribution.py.
+SERVICE_KINDS = ("exponential", "constant", "erlang", "hyperexp", "lognormal", "pareto")
 ROUTER_POLICIES = ("random", "round_robin", "least_outstanding")
 LATENCY_KINDS = ("constant", "exponential")
 
@@ -110,6 +115,10 @@ class ServerSpec:
     # runs out.
     deadline_s: Optional[float] = None
     max_retries: int = 0
+    # Shape parameters (used per `service` kind; ignored otherwise):
+    service_k: int = 2  # erlang phases (2 or 3)
+    service_scv: float = 2.0  # squared coeff. of variation (hyperexp/lognormal)
+    pareto_alpha: float = 2.5  # tail index (> 1; > 2 for finite variance)
 
 
 @dataclass
@@ -244,6 +253,9 @@ class EnsembleModel:
         queue_capacity: int = 64,
         deadline_s: Optional[float] = None,
         max_retries: int = 0,
+        service_k: int = 2,
+        service_scv: float = 2.0,
+        pareto_alpha: float = 2.5,
     ) -> NodeRef:
         if service not in SERVICE_KINDS:
             raise ValueError(f"service kind {service!r} not in {SERVICE_KINDS}")
@@ -257,6 +269,16 @@ class EnsembleModel:
             raise ValueError("max_retries must be >= 0")
         if max_retries > 0 and deadline_s is None:
             raise ValueError("max_retries requires a deadline_s")
+        if service == "erlang" and service_k not in (2, 3):
+            raise ValueError("erlang supports service_k in (2, 3)")
+        if service in ("hyperexp", "lognormal") and service_scv <= (
+            1.0 if service == "hyperexp" else 0.0
+        ):
+            raise ValueError(
+                "service_scv must be > 1 for hyperexp and > 0 for lognormal"
+            )
+        if service == "pareto" and pareto_alpha <= 1.0:
+            raise ValueError("pareto_alpha must be > 1 (finite mean)")
         self.servers.append(
             ServerSpec(
                 concurrency=concurrency,
@@ -265,6 +287,9 @@ class EnsembleModel:
                 queue_capacity=queue_capacity,
                 deadline_s=deadline_s,
                 max_retries=max_retries,
+                service_k=service_k,
+                service_scv=service_scv,
+                pareto_alpha=pareto_alpha,
             )
         )
         return NodeRef(SERVER, len(self.servers) - 1)

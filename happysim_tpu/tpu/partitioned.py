@@ -139,7 +139,7 @@ class _PartitionCompiled(_Compiled):
         )[choice]
         sink_latency = jnp.where(
             lat_mean > 0,
-            jnp.where(lat_exp, -jnp.log(u[2]) * lat_mean, lat_mean),
+            jnp.where(lat_exp, -jnp.log(u[1]) * lat_mean, lat_mean),
             0.0,
         )
         went_remote = self._into_outbox(state, remote_index, t, created)

@@ -1,0 +1,157 @@
+"""M/G/1 service-time families on the TPU engine vs Pollaczek-Khinchine.
+
+Each new family (Erlang-k, balanced hyperexponential, lognormal, Pareto)
+runs a single-server queue at a known rho; the ensemble's mean wait must
+match Wq = rho * E[S] * (1 + cv^2) / (2 (1 - rho)). The host executor runs
+the same laws via the new LatencyDistributions as a cross-check.
+"""
+
+import math
+
+import pytest
+
+from happysim_tpu import (
+    ErlangLatency,
+    HyperExponentialLatency,
+    Instant,
+    LogNormalLatency,
+    ParetoLatency,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.tpu.engine import run_ensemble
+from happysim_tpu.tpu.model import EnsembleModel
+
+LAM = 8.0
+MEAN_S = 0.1  # rho = 0.8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+
+    from happysim_tpu.tpu.mesh import replica_mesh
+
+    return replica_mesh(jax.devices("cpu")[:8])
+
+
+def pk_wait(lam: float, mean_s: float, scv: float) -> float:
+    rho = lam * mean_s
+    return rho * mean_s * (1.0 + scv) / (2.0 * (1.0 - rho))
+
+
+def run_tpu(mesh, service: str, **shape) -> float:
+    model = EnsembleModel(horizon_s=400.0, warmup_s=80.0)
+    src = model.source(rate=LAM, kind="poisson")
+    srv = model.server(
+        concurrency=1,
+        service_mean=MEAN_S,
+        service=service,
+        queue_capacity=512,
+        **shape,
+    )
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    result = run_ensemble(model, n_replicas=2048, seed=7, mesh=mesh)
+    assert result.truncated_replicas == 0
+    return result.server_mean_wait_s[0]
+
+
+class TestPollaczekKhinchine:
+    def test_erlang2_low_variance(self, mesh):
+        wait = run_tpu(mesh, "erlang", service_k=2)
+        assert wait == pytest.approx(pk_wait(LAM, MEAN_S, 0.5), rel=0.05)
+
+    def test_erlang3(self, mesh):
+        wait = run_tpu(mesh, "erlang", service_k=3)
+        assert wait == pytest.approx(pk_wait(LAM, MEAN_S, 1.0 / 3.0), rel=0.05)
+
+    def test_hyperexp_high_variance(self, mesh):
+        wait = run_tpu(mesh, "hyperexp", service_scv=4.0)
+        assert wait == pytest.approx(pk_wait(LAM, MEAN_S, 4.0), rel=0.10)
+
+    def test_lognormal(self, mesh):
+        wait = run_tpu(mesh, "lognormal", service_scv=2.0)
+        assert wait == pytest.approx(pk_wait(LAM, MEAN_S, 2.0), rel=0.10)
+
+    def test_pareto(self, mesh):
+        # Mean-matched Pareto(alpha): cv^2 = (alpha-1)^2/(alpha(alpha-2)) - 1.
+        alpha = 3.0
+        scv = (alpha - 1.0) ** 2 / (alpha * (alpha - 2.0)) - 1.0
+        wait = run_tpu(mesh, "pareto", pareto_alpha=alpha)
+        assert wait == pytest.approx(pk_wait(LAM, MEAN_S, scv), rel=0.15)
+
+    def test_variance_ordering(self, mesh):
+        """The M/G/1 story in one assertion: wait grows with service cv^2."""
+        erlang = run_tpu(mesh, "erlang", service_k=3)
+        exp = run_tpu(mesh, "exponential")
+        hyper = run_tpu(mesh, "hyperexp", service_scv=4.0)
+        assert erlang < exp < hyper
+
+
+class TestHostDistributionMoments:
+    def _moments(self, dist, n=20000):
+        samples = [dist.get_latency(Instant.Epoch).to_seconds() for _ in range(n)]
+        mean = sum(samples) / n
+        var = sum((s - mean) ** 2 for s in samples) / n
+        return mean, var / (mean * mean)
+
+    def test_erlang_moments(self):
+        mean, scv = self._moments(ErlangLatency(0.1, k=2, seed=1))
+        assert mean == pytest.approx(0.1, rel=0.03)
+        assert scv == pytest.approx(0.5, rel=0.10)
+
+    def test_hyperexp_moments(self):
+        mean, scv = self._moments(HyperExponentialLatency(0.1, scv=4.0, seed=2))
+        assert mean == pytest.approx(0.1, rel=0.05)
+        assert scv == pytest.approx(4.0, rel=0.20)
+
+    def test_lognormal_moments(self):
+        mean, scv = self._moments(LogNormalLatency(0.1, scv=2.0, seed=3))
+        assert mean == pytest.approx(0.1, rel=0.05)
+        assert scv == pytest.approx(2.0, rel=0.25)
+
+    def test_pareto_moments(self):
+        # alpha=4 keeps the variance estimator sane at 50k samples.
+        mean, scv = self._moments(ParetoLatency(0.1, alpha=4.0, seed=4), n=50000)
+        assert mean == pytest.approx(0.1, rel=0.05)
+        nominal = (4.0 - 1.0) ** 2 / (4.0 * (4.0 - 2.0)) - 1.0
+        assert scv == pytest.approx(nominal, rel=0.35)  # heavy tail converges slowly
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            HyperExponentialLatency(0.1, scv=1.0)
+        with pytest.raises(ValueError):
+            ParetoLatency(0.1, alpha=1.0)
+        with pytest.raises(ValueError):
+            LogNormalLatency(0.1, scv=0.0)
+        with pytest.raises(ValueError):
+            ErlangLatency(0.1, k=0)
+        model = EnsembleModel()
+        with pytest.raises(ValueError):
+            model.server(service="erlang", service_k=5)
+        with pytest.raises(ValueError):
+            model.server(service="hyperexp", service_scv=0.9)
+
+
+class TestHostVsTpuMG1:
+    def test_erlang_host_matches_tpu(self, mesh):
+        tpu_wait = run_tpu(mesh, "erlang", service_k=2)
+        sink = Sink("sink")
+        server = Server(
+            "srv",
+            service_time=ErlangLatency(MEAN_S, k=2, seed=11),
+            downstream=sink,
+            queue_capacity=512,
+        )
+        source = Source.poisson(rate=LAM, target=server, stop_after=2000.0, seed=13)
+        sim = Simulation(
+            sources=[source], entities=[server, sink], end_time=Instant.from_seconds(2400)
+        )
+        sim.run()
+        # Host sojourn - service mean ~ queue wait.
+        host_wait = sink.latency_stats().mean_s - MEAN_S
+        assert host_wait == pytest.approx(tpu_wait, rel=0.15)

@@ -11,10 +11,12 @@ import importlib
 import inspect
 import pathlib
 import pkgutil
-
-import happysim_tpu
+import sys
 
 ROOT = pathlib.Path(__file__).parent.parent
+sys.path.insert(0, str(ROOT))
+
+import happysim_tpu  # noqa: E402
 OUT = ROOT / "docs" / "api"
 
 PAGES: dict[str, list[str]] = {
