@@ -112,6 +112,7 @@ class EnsembleResult:
     # per server
     server_completed: list[int]
     server_dropped: list[int]
+    server_outage_dropped: list[int]
     server_utilization: list[float]
     server_mean_wait_s: list[float]
     server_mean_queue_len: list[float]
@@ -210,6 +211,9 @@ class _Compiled:
         self.queue_cap = np.zeros((self.nV,), np.int32)
         self.srv_deadline = np.full((self.nV,), np.inf, np.float32)
         self.srv_max_retries = np.zeros((self.nV,), np.int32)
+        # Brownout windows: arrivals in [start, end) are dropped.
+        self.srv_outage_start = np.full((self.nV,), np.inf, np.float32)
+        self.srv_outage_end = np.full((self.nV,), np.inf, np.float32)
         # Service family per server + host-precomputed shape constants.
         # Kind ids: 0 constant, 1 exponential, 2 erlang, 3 hyperexp,
         # 4 lognormal, 5 pareto (see model.SERVICE_KINDS).
@@ -250,6 +254,9 @@ class _Compiled:
             if spec.deadline_s is not None:
                 self.srv_deadline[v] = spec.deadline_s
                 self.srv_max_retries[v] = spec.max_retries
+            if spec.outage_start_s is not None:
+                self.srv_outage_start[v] = spec.outage_start_s
+                self.srv_outage_end[v] = spec.outage_end_s
 
         self.arrival_is_poisson = np.array(
             [s.arrival == "poisson" for s in model.sources], np.bool_
@@ -348,6 +355,7 @@ class _Compiled:
             "srv_q_head": jnp.zeros((self.nV,), jnp.int32),
             "srv_q_len": jnp.zeros((self.nV,), jnp.int32),
             "srv_dropped": jnp.zeros((self.nV,), jnp.int32),
+            "srv_outage_dropped": jnp.zeros((self.nV,), jnp.int32),
             "srv_started": jnp.zeros((self.nV,), jnp.int32),
             "srv_completed": jnp.zeros((self.nV,), jnp.int32),
             "srv_timed_out": jnp.zeros((self.nV,), jnp.int32),
@@ -650,13 +658,25 @@ class _Compiled:
         )
         service = self._sample_service(u3, v, params)
 
+        # Brownout: a job arriving inside the outage window is lost
+        # outright — no slot, no queue (host analogue: a PauseNode'd
+        # upstream relay dropping deliveries).
+        out_start = self._pick(jnp.asarray(self.srv_outage_start), row)
+        out_end = self._pick(jnp.asarray(self.srv_outage_end), row)
+        dark = (t >= out_start) & (t < out_end)
+        admit_free = has_free & ~dark
+        slot_mask = slot_mask & ~dark
+
         q_len = self._pick(state["srv_q_len"], row)
         cap = self._pick(jnp.asarray(self.queue_cap), row)
         has_room = q_len < cap
         tail = jnp.mod(self._pick(state["srv_q_head"], row) + q_len, self.K)
 
-        enq = (~has_free) & has_room
-        drop = (~has_free) & (~has_room)
+        enq = (~dark) & (~has_free) & has_room
+        # Disjoint loss counters (like srv_timed_out): an in-window loss is
+        # ONLY srv_outage_dropped — the host twin's server never sees those
+        # arrivals, so its queue-full drop counter must not either.
+        drop = (~dark) & (~has_free) & (~has_room)
         q_mask = (
             row[:, None]
             & (jnp.arange(self.K, dtype=jnp.int32)[None, :] == tail)
@@ -671,18 +691,20 @@ class _Compiled:
             "srv_slot_attempt": jnp.where(
                 slot_mask, attempt, state["srv_slot_attempt"]
             ),
-            "srv_started": state["srv_started"] + row_i * has_free.astype(jnp.int32),
+            "srv_started": state["srv_started"] + row_i * admit_free.astype(jnp.int32),
             # Zero-wait start: counts toward E[Wq] (the analytic rho/(mu-lam)
             # averages over non-waiters too), contributes 0 to the sum.
             "srv_wait_n": state["srv_wait_n"]
-            + row_i * (has_free & measure).astype(jnp.int32),
+            + row_i * (admit_free & measure).astype(jnp.int32),
             "srv_busy_int": state["srv_busy_int"]
-            + row_f * jnp.where(has_free & measure, service, 0.0),
+            + row_f * jnp.where(admit_free & measure, service, 0.0),
             "srv_q_created": jnp.where(q_mask, created, state["srv_q_created"]),
             "srv_q_enq": jnp.where(q_mask, t, state["srv_q_enq"]),
             "srv_q_attempt": jnp.where(q_mask, attempt, state["srv_q_attempt"]),
             "srv_q_len": state["srv_q_len"] + row_i * enq.astype(jnp.int32),
             "srv_dropped": state["srv_dropped"] + row_i * drop.astype(jnp.int32),
+            "srv_outage_dropped": state["srv_outage_dropped"]
+            + row_i * dark.astype(jnp.int32),
         }
 
     def _enqueue_retry(self, state, v: int, t, created, attempt):
@@ -1082,6 +1104,7 @@ def run_ensemble(
             "sink_hist": jnp.sum(final["sink_hist"], axis=0),
             "srv_completed": jnp.sum(final["srv_completed"], axis=0),
             "srv_dropped": jnp.sum(final["srv_dropped"], axis=0),
+            "srv_outage_dropped": jnp.sum(final["srv_outage_dropped"], axis=0),
             "srv_started": jnp.sum(final["srv_started"], axis=0),
             "srv_timed_out": jnp.sum(final["srv_timed_out"], axis=0),
             "srv_retried": jnp.sum(final["srv_retried"], axis=0),
@@ -1144,6 +1167,7 @@ def run_ensemble(
         sink_hist=host["sink_hist"],
         server_completed=[int(c) for c in host["srv_completed"][:nV_real]],
         server_dropped=[int(d) for d in host["srv_dropped"][:nV_real]],
+        server_outage_dropped=[int(d) for d in host["srv_outage_dropped"][:nV_real]],
         server_utilization=[
             float(b) / (denom * model.servers[v].concurrency)
             for v, b in enumerate(host["srv_busy_int"][:nV_real])

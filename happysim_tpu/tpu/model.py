@@ -119,6 +119,11 @@ class ServerSpec:
     service_k: int = 2  # erlang phases (2 or 3)
     service_scv: float = 2.0  # squared coeff. of variation (hyperexp/lognormal)
     pareto_alpha: float = 2.5  # tail index (> 1; > 2 for finite variance)
+    # Brownout window [start, end): arrivals during it are dropped
+    # (host analogue: PauseNode on an upstream relay — in-flight work
+    # completes, new deliveries are lost; faults/node_faults.py).
+    outage_start_s: Optional[float] = None
+    outage_end_s: Optional[float] = None
 
 
 @dataclass
@@ -256,6 +261,7 @@ class EnsembleModel:
         service_k: int = 2,
         service_scv: float = 2.0,
         pareto_alpha: float = 2.5,
+        outage: Optional[tuple] = None,
     ) -> NodeRef:
         if service not in SERVICE_KINDS:
             raise ValueError(f"service kind {service!r} not in {SERVICE_KINDS}")
@@ -279,6 +285,12 @@ class EnsembleModel:
             )
         if service == "pareto" and pareto_alpha <= 1.0:
             raise ValueError("pareto_alpha must be > 1 (finite mean)")
+        if outage is not None:
+            start, end = outage
+            if start < 0.0:
+                raise ValueError(f"outage window start must be >= 0, was {start}")
+            if end <= start:
+                raise ValueError(f"outage window is empty: [{start}, {end})")
         self.servers.append(
             ServerSpec(
                 concurrency=concurrency,
@@ -290,6 +302,8 @@ class EnsembleModel:
                 service_k=service_k,
                 service_scv=service_scv,
                 pareto_alpha=pareto_alpha,
+                outage_start_s=outage[0] if outage is not None else None,
+                outage_end_s=outage[1] if outage is not None else None,
             )
         )
         return NodeRef(SERVER, len(self.servers) - 1)
