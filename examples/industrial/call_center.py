@@ -1,0 +1,80 @@
+"""A call center with shift changes, impatient callers, and balking.
+
+Morning shift staffs 5 agents, lunch drops to 2, afternoon returns to 5.
+Callers balk when the hold queue looks long and hang up (renege) after
+3 minutes on hold. The lunch dip shows up directly in abandoned calls.
+Role parity: ``examples/industrial/call_center.py``.
+"""
+
+from happysim_tpu import Counter, Instant, Simulation, Sink, Source
+from happysim_tpu.components.industrial import (
+    BalkingQueue,
+    RenegingQueuedResource,
+    Shift,
+    ShiftSchedule,
+)
+
+MINUTE = 60.0
+
+
+class CallDesk(RenegingQueuedResource):
+    """Shift-staffed desk with reneging callers and a balking hold queue."""
+
+    def __init__(self, name, schedule, answered, abandoned):
+        super().__init__(
+            name,
+            reneged_target=abandoned,
+            default_patience_s=3 * MINUTE,
+            queue_policy=BalkingQueue(threshold=10, balk_probability=0.8, seed=3),
+        )
+        self.schedule = schedule
+        self.answered = answered
+        self.active = 0
+
+    def worker_has_capacity(self):
+        return self.active < self.schedule.capacity_at(self.now.to_seconds())
+
+    def handle_served_event(self, event):
+        self.active += 1
+        try:
+            yield 4 * MINUTE  # average handle time
+        finally:
+            self.active -= 1
+        return [self.forward(event, self.answered)]
+
+
+def main() -> dict:
+    schedule = ShiftSchedule(
+        [
+            Shift(start_s=0.0, end_s=120 * MINUTE, capacity=5),        # morning
+            Shift(start_s=120 * MINUTE, end_s=180 * MINUTE, capacity=2),  # lunch
+            Shift(start_s=180 * MINUTE, end_s=300 * MINUTE, capacity=5),  # afternoon
+        ]
+    )
+    answered = Sink("answered")
+    abandoned = Counter("abandoned")
+    desk = CallDesk("desk", schedule, answered, abandoned)
+    # 1 call/min: under the morning capacity (5 agents / 4-min calls =
+    # 1.25/min) but ABOVE the lunch capacity (0.5/min) — the dip bites.
+    calls = Source.poisson(
+        rate=1.0 / MINUTE, target=desk, stop_after=300 * MINUTE, seed=21
+    )
+    sim = Simulation(
+        sources=[calls], entities=[desk, answered, abandoned],
+        end_time=Instant.from_seconds(320 * MINUTE),
+    )
+    sim.run()
+
+    total = answered.events_received + abandoned.count + desk.queue.dropped
+    assert answered.events_received > 200  # ~300 offered over 5 hours
+    assert abandoned.count > 0, "the lunch dip strands callers past patience"
+    return {
+        "answered": answered.events_received,
+        "abandoned_on_hold": abandoned.count,
+        "balked": desk.queue.dropped,
+        "offered": total,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

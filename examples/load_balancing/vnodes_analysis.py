@@ -1,0 +1,68 @@
+"""Virtual nodes smooth a consistent-hash ring.
+
+The same uniform key stream is hashed onto 8 backends with 1, 16, and 150
+vnodes per backend. With one point per backend the ring's arc lengths are
+wildly uneven; adding vnodes drives the max/min load ratio toward 1. Role
+parity: ``examples/load-balancing/vnodes_analysis.py``.
+"""
+
+from happysim_tpu import (
+    ConstantLatency,
+    Instant,
+    LoadBalancer,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+    UniformDistribution,
+)
+from happysim_tpu.components.load_balancer import ConsistentHash
+from happysim_tpu.load.event_provider import SimpleEventProvider
+
+N_BACKENDS = 8
+
+
+def _imbalance(virtual_nodes: int) -> float:
+    sink = Sink("sink")
+    lb = LoadBalancer(
+        "lb",
+        strategy=ConsistentHash(
+            virtual_nodes=virtual_nodes,
+            get_key=lambda e: e.context.get("metadata", {}).get("key"),
+        ),
+    )
+    backends = [
+        Server(f"b{i}", concurrency=64, service_time=ConstantLatency(0.001), downstream=sink)
+        for i in range(N_BACKENDS)
+    ]
+    for b in backends:
+        lb.add_backend(b)
+    keys = UniformDistribution(items=range(100_000), seed=5)
+    provider = SimpleEventProvider(
+        target=lb, context_fn=lambda t, i: {"metadata": {"key": f"key{keys.sample()}"}}
+    )
+    source = Source.constant(rate=400.0, event_provider=provider, stop_after=10.0)
+    sim = Simulation(
+        sources=[source], entities=[lb, sink, *backends], end_time=Instant.from_seconds(12)
+    )
+    sim.run()
+    counts = [b.requests_completed for b in backends]
+    return max(counts) / max(1, min(counts))
+
+
+def main() -> dict:
+    single = _imbalance(1)
+    some = _imbalance(16)
+    many = _imbalance(150)
+    assert single > some > many, (single, some, many)
+    assert many < 1.6, "150 vnodes: near-even arcs"
+    assert single > 2.0, "one point per backend: lopsided arcs"
+    return {
+        "imbalance_1_vnode": round(single, 2),
+        "imbalance_16_vnodes": round(some, 2),
+        "imbalance_150_vnodes": round(many, 2),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

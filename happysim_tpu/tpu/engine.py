@@ -154,6 +154,8 @@ class EnsembleResult:
             if self.server_timed_out[index] or self.server_retried[index]:
                 extra["timed_out"] = self.server_timed_out[index]
                 extra["retried"] = self.server_retried[index]
+            if self.server_outage_dropped[index]:
+                extra["outage_dropped"] = self.server_outage_dropped[index]
             if self.transit_dropped[index]:
                 extra["transit_dropped"] = self.transit_dropped[index]
             entities.append(

@@ -68,6 +68,7 @@ class PartitionedResult:
     sink_mean_latency_s: list[float]
     server_completed: list[int]
     server_dropped: list[int]
+    server_outage_dropped: list[int]
     remote_sent: int
     remote_dropped: int  # outbox overflow (raise outbox_capacity)
     transit_dropped: int  # ingress transit overflow (raise transit_capacity)
@@ -373,6 +374,9 @@ def run_partitioned(
         ],
         server_dropped=[
             int(d) for d in host["srv_dropped"].sum(axis=(0, 1))[:nV_real]
+        ],
+        server_outage_dropped=[
+            int(d) for d in host["srv_outage_dropped"].sum(axis=(0, 1))[:nV_real]
         ],
         remote_sent=int(host["ob_sent"].sum()),
         remote_dropped=int(host["ob_dropped"].sum()),

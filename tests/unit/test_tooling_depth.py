@@ -1,0 +1,223 @@
+"""Depth tests for the tooling tier: MCP stdio loop, chart transforms,
+serializers, data-series edge cases, and network condition presets."""
+
+import io
+import json
+
+import pytest
+
+from happysim_tpu import Data, Instant
+from happysim_tpu.components.network.conditions import (
+    cross_region_network,
+    datacenter_network,
+    internet_network,
+    local_network,
+    lossy_network,
+    mobile_3g_network,
+    mobile_4g_network,
+    satellite_network,
+    slow_network,
+)
+from happysim_tpu.mcp.server import serve
+from happysim_tpu.mcp.tools import format_distributions
+from happysim_tpu.visual.dashboard import Chart
+from happysim_tpu.visual.serializers import is_internal_event, serialize_entity
+
+
+def _rpc(method, request_id=1, **params):
+    msg = {"jsonrpc": "2.0", "id": request_id, "method": method}
+    if params:
+        msg["params"] = params
+    return json.dumps(msg).encode() + b"\n"
+
+
+class TestMcpStdioLoop:
+    def _drive(self, *lines):
+        stdin = io.BytesIO(b"".join(lines))
+        stdout = io.BytesIO()
+        serve(stdin=stdin, stdout=stdout)
+        return [json.loads(l) for l in stdout.getvalue().splitlines()]
+
+    def test_initialize_then_list_then_ping(self):
+        replies = self._drive(
+            _rpc("initialize", 1),
+            _rpc("tools/list", 2),
+            _rpc("ping", 3),
+        )
+        assert replies[0]["result"]["serverInfo"]
+        tool_names = {t["name"] for t in replies[1]["result"]["tools"]}
+        assert {"simulate_queue", "simulate_pipeline"} <= tool_names
+        assert replies[2] == {"jsonrpc": "2.0", "id": 3, "result": {}}
+
+    def test_tool_call_runs_simulation(self):
+        replies = self._drive(
+            _rpc(
+                "tools/call",
+                7,
+                name="simulate_queue",
+                arguments={"arrival_rate": 5.0, "service_rate": 10.0, "duration": 20.0, "seed": 1},
+            )
+        )
+        text = replies[0]["result"]["content"][0]["text"]
+        assert "rho" in text.lower() or "utilization" in text.lower() or "latency" in text.lower()
+        assert not replies[0]["result"].get("isError")
+
+    def test_bad_tool_errors_in_band(self):
+        replies = self._drive(
+            _rpc("tools/call", 8, name="no_such_tool", arguments={})
+        )
+        assert replies[0]["result"]["isError"]
+
+    def test_unknown_method_code(self):
+        replies = self._drive(_rpc("wat", 9))
+        assert replies[0]["error"]["code"] == -32601
+
+    def test_notifications_and_garbage_skipped(self):
+        stdin = io.BytesIO(
+            b"not json\n"
+            + b"\n"
+            + json.dumps({"jsonrpc": "2.0", "method": "notifications/initialized"}).encode()
+            + b"\n"
+            + _rpc("ping", 4)
+        )
+        stdout = io.BytesIO()
+        serve(stdin=stdin, stdout=stdout)
+        replies = [json.loads(l) for l in stdout.getvalue().splitlines()]
+        assert len(replies) == 1  # only the ping got a response
+        assert replies[0]["id"] == 4
+
+    def test_format_distributions_default(self):
+        assert "exponential" in format_distributions().lower() or format_distributions()
+
+
+class TestChartTransforms:
+    def _data(self):
+        d = Data("lat")
+        for i in range(100):
+            d.add(Instant.from_seconds(i * 0.1), float(i % 10))
+        return d
+
+    def test_raw_passthrough(self):
+        chart = Chart("t", self._data(), transform="raw")
+        s = chart.series()
+        assert len(s["times"]) == 100
+        assert s["values"][3] == 3.0
+
+    @pytest.mark.parametrize("transform", ["mean", "p50", "p99", "p999", "max"])
+    def test_bucketed_transforms(self, transform):
+        chart = Chart("t", self._data(), transform=transform, window_s=1.0)
+        s = chart.series()
+        assert len(s["times"]) == 10
+        if transform == "max":
+            assert all(v == 9.0 for v in s["values"])
+        if transform == "mean":
+            assert all(v == pytest.approx(4.5) for v in s["values"])
+
+    def test_rate_transform(self):
+        chart = Chart("t", self._data(), transform="rate", window_s=1.0)
+        s = chart.series()
+        assert all(v == pytest.approx(10.0) for v in s["values"])
+
+    def test_lazy_data_refetched(self):
+        backing = {"d": Data("a")}
+        chart = Chart("t", lambda: backing["d"], transform="raw")
+        assert chart.series()["values"] == []
+        fresh = Data("b")
+        fresh.add(Instant.from_seconds(1), 5.0)
+        backing["d"] = fresh
+        assert chart.series()["values"] == [5.0]
+
+    def test_unknown_transform_rejected(self):
+        with pytest.raises(ValueError):
+            Chart("t", Data("x"), transform="median")
+
+
+class TestSerializers:
+    def test_internal_events_filtered(self):
+        assert is_internal_event("Queue.poll")
+        assert not is_internal_event("Request")
+
+    def test_entity_snapshot_jsonable(self):
+        from happysim_tpu import ConstantLatency, Server
+
+        server = Server("srv", service_time=ConstantLatency(0.01))
+        snapshot = serialize_entity(server)
+        json.dumps(snapshot)  # must be JSON-clean
+        assert snapshot["name"] == "srv"
+
+    def test_deeply_nested_values_capped(self):
+        class Weird:
+            name = "w"
+
+            def __init__(self):
+                self.loop = {"a": {"b": {"c": {"d": {"e": {"f": 1}}}}}}
+
+        payload = serialize_entity(Weird())
+        json.dumps(payload)  # depth-capped, not infinite
+
+
+class TestDataEdgeCases:
+    def test_empty_series(self):
+        d = Data("x")
+        assert d.count() == 0
+        assert d.mean() == 0.0
+        assert d.percentile(0.99) == 0.0
+        assert list(d.bucket(1.0).means) == []
+
+    def test_single_point(self):
+        d = Data("x")
+        d.add(Instant.from_seconds(2), 7.0)
+        assert d.mean() == 7.0
+        assert d.min() == d.max() == 7.0
+        assert d.percentile(0.5) == 7.0
+
+    def test_between_half_open(self):
+        d = Data("x")
+        for t in (1.0, 2.0, 3.0):
+            d.add(Instant.from_seconds(t), t)
+        window = d.between(1.0, 3.0)
+        assert window.count() == 3  # inclusive of both endpoints
+        assert d.between(1.5, 2.5).count() == 1
+
+    def test_bucket_alignment(self):
+        d = Data("x")
+        d.add(Instant.from_seconds(0.5), 1.0)
+        d.add(Instant.from_seconds(1.5), 3.0)
+        b = d.bucket(1.0)
+        assert list(b.counts) == [1, 1]
+        assert b.means[0] == 1.0 and b.means[1] == 3.0
+
+
+class TestNetworkPresets:
+    PRESETS = [
+        local_network,
+        datacenter_network,
+        cross_region_network,
+        internet_network,
+        satellite_network,
+        lambda seed: lossy_network(0.1, seed=seed),
+        lambda seed: slow_network(1.0, seed=seed),
+        mobile_3g_network,
+        mobile_4g_network,
+    ]
+    IDS = ["local", "datacenter", "cross_region", "internet", "satellite",
+           "lossy", "slow", "mobile_3g", "mobile_4g"]
+
+    @pytest.mark.parametrize("factory", PRESETS, ids=IDS)
+    def test_preset_builds_and_samples(self, factory):
+        link = factory(seed=3)
+        latency = link.latency.get_latency(Instant.Epoch)
+        assert latency.to_seconds() >= 0.0
+
+    def test_latency_ordering_makes_sense(self):
+        fast = local_network(seed=1).latency.mean().to_seconds()
+        dc = datacenter_network(seed=1).latency.mean().to_seconds()
+        wan = cross_region_network(seed=1).latency.mean().to_seconds()
+        sat = satellite_network(seed=1).latency.mean().to_seconds()
+        assert fast < dc < wan < sat
+
+    def test_lossy_network_drops(self):
+        link = lossy_network(loss_rate=0.5, seed=2)
+        assert link.packet_loss_rate == pytest.approx(0.5)
+        with pytest.raises(ValueError):
+            lossy_network(loss_rate=1.5)
