@@ -4,7 +4,7 @@ With uniform keys, a consistent-hash ring spreads load evenly. Feed it a
 Zipf(1.2) key stream and the hot keys' owners melt: the busiest backend
 carries several times the coldest one's load, while round-robin (no
 affinity) stays level — the fundamental cache-affinity vs load-evenness
-trade. Role parity: ``examples/load-balancing/zipf_effect.py``.
+trade. Role parity (reference tree): ``examples/load-balancing/zipf_effect.py``.
 """
 
 from happysim_tpu import (

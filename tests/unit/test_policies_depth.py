@@ -1,0 +1,209 @@
+"""Depth tests for rate-limiter policies, LB strategies, and network-link
+behaviors beyond the basics (ref rate_limiter/policy.py:65-310,
+load_balancer/strategies.py:30-436, network/link.py)."""
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Duration,
+    Event,
+    Instant,
+    LoadBalancer,
+    Network,
+    NetworkLink,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.components.load_balancer import (
+    LeastResponseTime,
+    PowerOfTwoChoices,
+    WeightedRoundRobin,
+)
+from happysim_tpu.components.rate_limiter.policy import (
+    AdaptivePolicy,
+    FixedWindowPolicy,
+    LeakyBucketPolicy,
+    SlidingWindowPolicy,
+    TokenBucketPolicy,
+)
+
+
+def t(seconds):
+    return Instant.from_seconds(seconds)
+
+
+class TestSlidingWindow:
+    def test_trailing_window_slides(self):
+        p = SlidingWindowPolicy(window_size_seconds=1.0, max_requests=2)
+        assert p.try_acquire(t(0.0))
+        assert p.try_acquire(t(0.5))
+        assert not p.try_acquire(t(0.9))  # 2 in the last second
+        assert p.try_acquire(t(1.01))  # t=0.0 aged out
+        assert not p.try_acquire(t(1.2))  # 0.5 and 1.01 still inside
+
+    def test_time_until_available_tracks_oldest(self):
+        p = SlidingWindowPolicy(window_size_seconds=1.0, max_requests=1)
+        p.try_acquire(t(2.0))
+        wait = p.time_until_available(t(2.4))
+        assert wait.to_seconds() == pytest.approx(0.6)
+        assert p.time_until_available(t(3.01)).to_seconds() == 0.0
+
+
+class TestFixedWindow:
+    def test_aligned_reset(self):
+        p = FixedWindowPolicy(requests_per_window=2, window_size=1.0)
+        assert p.try_acquire(t(0.1)) and p.try_acquire(t(0.2))
+        assert not p.try_acquire(t(0.99))
+        assert p.try_acquire(t(1.0))  # new aligned window
+
+    def test_boundary_burst(self):
+        """The classic fixed-window artifact: 2N requests straddle a
+        boundary — exactly why sliding windows exist."""
+        p = FixedWindowPolicy(requests_per_window=2, window_size=1.0)
+        admitted = sum(p.try_acquire(t(x)) for x in (0.8, 0.9, 1.0, 1.1))
+        assert admitted == 4
+        sliding = SlidingWindowPolicy(window_size_seconds=1.0, max_requests=2)
+        admitted_sliding = sum(sliding.try_acquire(t(x)) for x in (0.8, 0.9, 1.0, 1.1))
+        assert admitted_sliding == 2
+
+    def test_time_until_next_window(self):
+        p = FixedWindowPolicy(requests_per_window=1, window_size=2.0)
+        p.try_acquire(t(0.5))
+        assert p.time_until_available(t(0.5)).to_seconds() == pytest.approx(1.5)
+
+
+class TestLeakyBucket:
+    def test_steady_drain(self):
+        p = LeakyBucketPolicy(leak_rate=2.0)  # 2/s
+        assert p.try_acquire(t(0.0))
+        # Fill the bucket at t=0, then confirm the leak frees space.
+        while p.try_acquire(t(0.0)):
+            pass
+        assert not p.try_acquire(t(0.0))  # full
+        assert p.try_acquire(t(0.6))  # ~1 unit leaked by then
+
+
+class TestAdaptiveAIMD:
+    def test_backpressure_halves_rate(self):
+        p = AdaptivePolicy(initial_rate=100.0, min_rate=1.0, decrease_factor=0.5)
+        p.record_backpressure(t(1.0))
+        assert p.current_rate == 50.0
+        p.record_backpressure(t(2.0))
+        assert p.current_rate == 25.0
+
+    def test_success_additive_increase_caps(self):
+        p = AdaptivePolicy(initial_rate=99.5, max_rate=100.0, increase_per_second=1.0)
+        p.record_success(t(1.0))
+        assert p.current_rate == 100.0
+        p.record_success(t(2.0))
+        assert p.current_rate == 100.0  # capped
+
+    def test_floor_respected(self):
+        p = AdaptivePolicy(initial_rate=2.0, min_rate=1.0, decrease_factor=0.1)
+        p.record_backpressure(t(1.0))
+        assert p.current_rate == 1.0
+
+    def test_sawtooth_history_recorded(self):
+        p = AdaptivePolicy(initial_rate=10.0)
+        p.record_success(t(1.0))
+        p.record_backpressure(t(2.0))
+        p.record_success(t(3.0))
+        rates = [snap.rate for snap in p.history]
+        assert rates == [11.0, 5.5, 6.5]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AdaptivePolicy(initial_rate=0.5, min_rate=1.0)
+        with pytest.raises(ValueError):
+            AdaptivePolicy(decrease_factor=1.0)
+
+
+class TestTokenBucketEdge:
+    def test_burst_up_to_capacity_then_paced(self):
+        p = TokenBucketPolicy(capacity=3.0, refill_rate=1.0)
+        burst = sum(p.try_acquire(t(0.0)) for _ in range(5))
+        assert burst == 3
+        assert not p.try_acquire(t(0.5))
+        assert p.try_acquire(t(1.01))  # one token refilled
+
+
+def _run_lb(strategy, service_means, n_requests=200, weights=None):
+    sink = Sink("sink")
+    lb = LoadBalancer("lb", strategy=strategy)
+    backends = [
+        Server(
+            f"b{i}", concurrency=4, service_time=ConstantLatency(mean), downstream=sink
+        )
+        for i, mean in enumerate(service_means)
+    ]
+    for i, b in enumerate(backends):
+        lb.add_backend(b, weight=(weights[i] if weights else 1.0))
+    source = Source.constant(rate=50.0, target=lb, stop_after=n_requests / 50.0)
+    sim = Simulation(
+        sources=[source],
+        entities=[lb, sink, *backends],
+        end_time=Instant.from_seconds(n_requests / 50.0 + 5),
+    )
+    sim.run()
+    return [b.requests_completed for b in backends]
+
+
+class TestStrategiesDepth:
+    def test_weighted_round_robin_ratio(self):
+        counts = _run_lb(
+            WeightedRoundRobin(), [0.001, 0.001], weights=[3.0, 1.0]
+        )
+        assert counts[0] / counts[1] == pytest.approx(3.0, rel=0.1)
+
+    def test_least_response_time_prefers_fast_backend(self):
+        counts = _run_lb(LeastResponseTime(), [0.002, 0.08])
+        assert counts[0] > counts[1] * 2
+
+    def test_power_of_two_balances(self):
+        counts = _run_lb(PowerOfTwoChoices(seed=5), [0.01] * 8)
+        assert max(counts) < 2.5 * min(counts)
+
+
+class TestNetworkLinkDepth:
+    def test_per_pair_link_overrides_default(self):
+        received = []
+        from happysim_tpu.core.callback_entity import CallbackEntity
+
+        a = CallbackEntity("a", lambda: None)
+        b = CallbackEntity("b", lambda e, now: received.append(now.to_seconds()))
+        net = Network(
+            "net", default_link=NetworkLink("slow", latency=ConstantLatency(1.0))
+        )
+        net.add_link(a, b, NetworkLink("fast", latency=ConstantLatency(0.01)))
+        sim = Simulation(entities=[net, a, b], end_time=Instant.from_seconds(10))
+
+        class Go(CallbackEntity):
+            def __init__(self):
+                super().__init__("go", self._fire)
+
+            def _fire(self, event):
+                return [net.send(source=a, destination=b, event_type="Msg", payload={})]
+
+        go = Go()
+        sim.schedule(Event(Instant.from_seconds(1.0), "Go", target=go))
+        sim.run()
+        assert received == [pytest.approx(1.01)]
+
+    def test_bandwidth_serialization_delay(self):
+        from happysim_tpu.core.clock import Clock
+
+        link = NetworkLink(
+            "thin", latency=ConstantLatency(0.0), bandwidth_bps=8_000
+        )  # 1 KB/s
+        link.set_clock(Clock())
+        assert link._delay(payload_size=500) == pytest.approx(0.5)
+
+    def test_jittered_latency_varies(self):
+        from happysim_tpu import ExponentialLatency
+
+        link = NetworkLink("j", latency=ExponentialLatency(0.01, seed=4))
+        samples = {link.latency.get_latency(Instant.Epoch).nanoseconds for _ in range(20)}
+        assert len(samples) > 10

@@ -87,7 +87,8 @@ class TestMcpStdioLoop:
         assert replies[0]["id"] == 4
 
     def test_format_distributions_default(self):
-        assert "exponential" in format_distributions().lower() or format_distributions()
+        text = format_distributions().lower()
+        assert "exponential" in text and "constant" in text
 
 
 class TestChartTransforms:
@@ -171,7 +172,7 @@ class TestDataEdgeCases:
         assert d.min() == d.max() == 7.0
         assert d.percentile(0.5) == 7.0
 
-    def test_between_half_open(self):
+    def test_between_inclusive_endpoints(self):
         d = Data("x")
         for t in (1.0, 2.0, 3.0):
             d.add(Instant.from_seconds(t), t)
