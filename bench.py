@@ -21,6 +21,84 @@ import sys
 
 REFERENCE_EVENTS_PER_SEC = 134_580.0  # BASELINE.md throughput checkpoint
 
+# Scaled down when the TPU is unreachable and we fall back to CPU, so the
+# bench still completes and emits honest (clearly-labeled) numbers.
+KERNEL_REPLICAS = 65536
+ENGINE_REPLICAS = 65536
+ENGINE_HORIZON_S = 160.0
+DEVICE_FALLBACK = False
+
+
+def _tpu_reachable(timeout_s: float = 90.0) -> bool:
+    """Probe JAX init in a child process — a wedged TPU tunnel blocks
+    `import jax` indefinitely, so the probe must be killable.
+
+    No pipes (a wedged plugin's helper process holding an inherited pipe
+    would deadlock subprocess timeout handling) and the probe gets its
+    own session so the timeout can kill the whole tree.
+    """
+    import os
+    import signal
+    import subprocess
+
+    probe_src = (
+        "import jax; ds = jax.devices(); "
+        "assert any(d.platform != 'cpu' for d in ds), 'no accelerator'"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", probe_src],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        return proc.wait(timeout=timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return False
+
+
+def _reexec_cpu_fallback() -> "None":
+    """Re-exec this script pinned to CPU with the TPU plugin shadowed.
+
+    The shadow must be on PYTHONPATH at interpreter start — runtime
+    sys.path edits are too late to stop a wedged plugin's registration
+    from blocking `import jax` — hence the re-exec rather than an
+    in-process switch.
+    """
+    import os
+    import tempfile
+
+    stub = tempfile.mkdtemp(prefix="happysim_jaxstub_")
+    os.makedirs(os.path.join(stub, "jax_plugins"), exist_ok=True)
+    open(os.path.join(stub, "jax_plugins", "__init__.py"), "w").close()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # REPLACE (not prepend to) PYTHONPATH: the ambient path may carry a
+    # sitecustomize that registers the TPU plugin at interpreter startup
+    # (observed: /root/.axon_site), which re-wedges the fallback child no
+    # matter what JAX_PLATFORMS says. The repo itself is found via the
+    # script-dir sys.path entry, so nothing else is needed here.
+    env["PYTHONPATH"] = stub
+    env["HS_BENCH_CPU_FALLBACK"] = "1"
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _apply_fallback_scale() -> None:
+    global KERNEL_REPLICAS, ENGINE_REPLICAS, ENGINE_HORIZON_S, DEVICE_FALLBACK
+    KERNEL_REPLICAS = 2048
+    ENGINE_REPLICAS = 4096
+    # Horizon shrinks less than replicas do: the 40s warmup (~4.5 M/M/1
+    # relaxation times, see bench_general_engine) must survive, or the
+    # accuracy gate would fail from warmup truncation instead of any
+    # engine defect.
+    ENGINE_HORIZON_S = 120.0
+    DEVICE_FALLBACK = True
+
 
 def bench_kernel(devices) -> dict:
     from happysim_tpu.tpu import run_mm1_ensemble
@@ -28,7 +106,7 @@ def bench_kernel(devices) -> dict:
     result = run_mm1_ensemble(
         lam=8.0,
         mu=10.0,
-        n_replicas=65536,
+        n_replicas=KERNEL_REPLICAS,
         n_customers=4096,
         seed=0,
     )
@@ -60,8 +138,8 @@ def bench_general_engine(devices) -> dict:
     # virtual-mesh oracle run); the general engine carries the same 1%
     # accuracy gate as the kernel.
     result = run_ensemble(
-        mm1_model(lam=lam, mu=mu, horizon_s=160.0, warmup_s=40.0),
-        n_replicas=65536,
+        mm1_model(lam=lam, mu=mu, horizon_s=ENGINE_HORIZON_S, warmup_s=40.0),
+        n_replicas=ENGINE_REPLICAS,
         seed=0,
     )
     analytic = (lam / mu) / (mu - lam)
@@ -89,11 +167,30 @@ def bench_general_engine(devices) -> dict:
 
 
 def main() -> int:
+    import os
+
+    if os.environ.get("HS_BENCH_CPU_FALLBACK") == "1":
+        _apply_fallback_scale()
+    elif not _tpu_reachable():
+        _reexec_cpu_fallback()  # does not return
     import jax
 
     devices = jax.devices()
-    print(json.dumps(bench_kernel(devices)))
-    print(json.dumps(bench_general_engine(devices)))
+    kernel = bench_kernel(devices)
+    engine = bench_general_engine(devices)
+    if DEVICE_FALLBACK:
+        note = "TPU unreachable at bench time; CPU fallback at reduced scale"
+        kernel["device_fallback"] = note
+        kernel["metric"] = (
+            f"simulated-events/sec (CPU fallback, {KERNEL_REPLICAS}-replica M/M/1 ensemble)"
+        )
+        engine["device_fallback"] = note
+        engine["metric"] = (
+            f"simulated-events/sec (CPU fallback, general engine, {ENGINE_REPLICAS}-replica M/M/1)"
+        )
+        engine["north_star_ok"] = False  # per-chip target is a TPU claim
+    print(json.dumps(kernel))
+    print(json.dumps(engine))
     return 0
 
 

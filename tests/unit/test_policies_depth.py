@@ -201,9 +201,11 @@ class TestNetworkLinkDepth:
         link.set_clock(Clock())
         assert link._delay(payload_size=500) == pytest.approx(0.5)
 
-    def test_jittered_latency_varies(self):
+    def test_link_delay_samples_its_distribution(self):
         from happysim_tpu import ExponentialLatency
+        from happysim_tpu.core.clock import Clock
 
         link = NetworkLink("j", latency=ExponentialLatency(0.01, seed=4))
-        samples = {link.latency.get_latency(Instant.Epoch).nanoseconds for _ in range(20)}
-        assert len(samples) > 10
+        link.set_clock(Clock())
+        samples = {round(link._delay(payload_size=0), 9) for _ in range(20)}
+        assert len(samples) > 10  # the LINK's per-delivery delay varies
