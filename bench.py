@@ -17,6 +17,7 @@ rho/(mu-lambda).
 """
 
 import json
+import os
 import sys
 
 REFERENCE_EVENTS_PER_SEC = 134_580.0  # BASELINE.md throughput checkpoint
@@ -37,7 +38,6 @@ def _tpu_reachable(timeout_s: float = 90.0) -> bool:
     would deadlock subprocess timeout handling) and the probe gets its
     own session so the timeout can kill the whole tree.
     """
-    import os
     import signal
     import subprocess
 
@@ -70,10 +70,11 @@ def _reexec_cpu_fallback() -> "None":
     from blocking `import jax` — hence the re-exec rather than an
     in-process switch.
     """
-    import os
     import tempfile
 
-    stub = tempfile.mkdtemp(prefix="happysim_jaxstub_")
+    # Fixed path, reused across runs (mkdtemp would leak one dir per
+    # fallback invocation — the parent execve's away before any cleanup).
+    stub = os.path.join(tempfile.gettempdir(), "happysim_jaxstub")
     os.makedirs(os.path.join(stub, "jax_plugins"), exist_ok=True)
     open(os.path.join(stub, "jax_plugins", "__init__.py"), "w").close()
     env = dict(os.environ)
@@ -110,8 +111,13 @@ def bench_kernel(devices) -> dict:
         n_customers=4096,
         seed=0,
     )
+    label = (
+        f"simulated-events/sec (CPU fallback, {KERNEL_REPLICAS}-replica M/M/1 ensemble)"
+        if DEVICE_FALLBACK
+        else f"simulated-events/sec/chip ({KERNEL_REPLICAS // 1024}k-replica M/M/1 ensemble)"
+    )
     return {
-        "metric": "simulated-events/sec/chip (65k-replica M/M/1 ensemble)",
+        "metric": label,
         "value": round(result.events_per_second, 0),
         "unit": "events/sec",
         "vs_baseline": round(result.events_per_second / REFERENCE_EVENTS_PER_SEC, 2),
@@ -146,8 +152,13 @@ def bench_general_engine(devices) -> dict:
     mean_wait = result.server_mean_wait_s[0]
     error = abs(mean_wait - analytic) / analytic
     accuracy_ok = bool(error < 0.01)
+    label = (
+        f"simulated-events/sec (CPU fallback, general engine, {ENGINE_REPLICAS}-replica M/M/1)"
+        if DEVICE_FALLBACK
+        else f"simulated-events/sec/chip (general engine, {ENGINE_REPLICAS // 1024}k-replica M/M/1)"
+    )
     return {
-        "metric": "simulated-events/sec/chip (general engine, 65k-replica M/M/1)",
+        "metric": label,
         "value": round(result.events_per_second, 0),
         "unit": "events/sec",
         "vs_baseline": round(result.events_per_second / REFERENCE_EVENTS_PER_SEC, 2),
@@ -167,8 +178,6 @@ def bench_general_engine(devices) -> dict:
 
 
 def main() -> int:
-    import os
-
     if os.environ.get("HS_BENCH_CPU_FALLBACK") == "1":
         _apply_fallback_scale()
     elif not _tpu_reachable():
@@ -181,13 +190,7 @@ def main() -> int:
     if DEVICE_FALLBACK:
         note = "TPU unreachable at bench time; CPU fallback at reduced scale"
         kernel["device_fallback"] = note
-        kernel["metric"] = (
-            f"simulated-events/sec (CPU fallback, {KERNEL_REPLICAS}-replica M/M/1 ensemble)"
-        )
         engine["device_fallback"] = note
-        engine["metric"] = (
-            f"simulated-events/sec (CPU fallback, general engine, {ENGINE_REPLICAS}-replica M/M/1)"
-        )
         engine["north_star_ok"] = False  # per-chip target is a TPU claim
     print(json.dumps(kernel))
     print(json.dumps(engine))

@@ -1,0 +1,58 @@
+"""Connection-pool exhaustion turns fast queries into timeouts.
+
+A 2-connection database serves 5ms queries. At low concurrency every call
+is fast; fire 12 concurrent reports and most of each caller's latency is
+WAITING for a connection, pushing calls past a 25ms client timeout that
+the query itself would never hit. Role parity:
+``examples/infrastructure/database_query_timeout.py``.
+"""
+
+from happysim_tpu import Event, Instant, Simulation
+from happysim_tpu.components.datastore import Database
+from happysim_tpu.core.entity import Entity
+
+TIMEOUT_S = 0.025
+
+
+def _run(n_concurrent: int):
+    db = Database(
+        "db", query_latency=0.005, connection_latency=0.001, max_connections=2
+    )
+    latencies = []
+
+    class Reporter(Entity):
+        def handle_event(self, event):
+            start = self.now.to_seconds()
+            yield from db.execute("SELECT * FROM reports")
+            latencies.append(self.now.to_seconds() - start)
+            return None
+
+    reporters = [Reporter(f"r{i}") for i in range(n_concurrent)]
+    sim = Simulation(entities=[db, *reporters], end_time=Instant.from_seconds(10))
+    for r in reporters:
+        sim.schedule(Event(Instant.Epoch, "go", target=r))
+    sim.run()
+    timeouts = sum(1 for l in latencies if l > TIMEOUT_S)
+    return latencies, timeouts, db.stats
+
+
+def main() -> dict:
+    calm, calm_timeouts, _ = _run(2)
+    storm, storm_timeouts, stats = _run(12)
+
+    assert calm_timeouts == 0
+    assert max(calm) < 0.01
+    # 12 callers / 2 connections: the last pair waits ~5 query durations.
+    assert storm_timeouts >= 4
+    assert max(storm) > 0.025
+    assert stats.connection_wait_count > 0
+    return {
+        "calm_max_ms": round(max(calm) * 1000, 1),
+        "storm_max_ms": round(max(storm) * 1000, 1),
+        "storm_timeouts": storm_timeouts,
+        "waited_for_connection": stats.connection_wait_count,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

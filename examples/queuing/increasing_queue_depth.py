@@ -1,0 +1,63 @@
+"""Watch a queue cross the stability boundary as load ramps.
+
+Arrivals ramp linearly from rho=0.4 to rho=1.3 over two minutes while a
+probe samples queue depth. Below saturation depth stays near its
+steady-state value; once rho crosses 1, depth stops fluctuating and grows
+~linearly — the probe's time series shows the knee. Role parity:
+``examples/queuing/increasing_queue_depth.py``.
+"""
+
+from happysim_tpu import (
+    ExponentialLatency,
+    Instant,
+    LinearRampProfile,
+    Probe,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+
+MU = 10.0
+DURATION = 120.0
+
+
+def main() -> dict:
+    sink = Sink("sink")
+    server = Server(
+        "srv",
+        service_time=ExponentialLatency(1.0 / MU, seed=2),
+        downstream=sink,
+        queue_capacity=100_000,
+    )
+    source = Source.with_profile(
+        LinearRampProfile(start_rate=4.0, end_rate=13.0, ramp_duration_s=DURATION),
+        target=server,
+        stop_after=DURATION,
+        seed=8,
+    )
+    depth_probe = Probe.on(server, "queue_depth", interval_s=1.0)
+    sim = Simulation(
+        sources=[source],
+        entities=[server, sink],
+        probes=[depth_probe],
+        end_time=Instant.from_seconds(DURATION),
+    )
+    sim.run()
+
+    series = depth_probe.data
+    early = series.between(10.0, 40.0)   # rho in [0.47, 0.70]
+    late = series.between(100.0, 120.0)  # rho in [1.15, 1.30]
+    assert early.max() < 30, "subcritical: depth bounded"
+    assert late.mean() > 5 * max(early.mean(), 1.0), "supercritical: depth grows"
+    # Monotone-ish growth after the knee: the last samples dominate.
+    assert late.max() == series.max()
+    return {
+        "early_mean_depth": round(early.mean(), 1),
+        "late_mean_depth": round(late.mean(), 1),
+        "final_depth": int(series.max()),
+    }
+
+
+if __name__ == "__main__":
+    print(main())
