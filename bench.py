@@ -72,9 +72,12 @@ def _reexec_cpu_fallback() -> "None":
     """
     import tempfile
 
-    # Fixed path, reused across runs (mkdtemp would leak one dir per
-    # fallback invocation — the parent execve's away before any cleanup).
-    stub = os.path.join(tempfile.gettempdir(), "happysim_jaxstub")
+    # Per-user fixed path, reused across runs (mkdtemp would leak one
+    # dir per fallback invocation — the parent execve's away before any
+    # cleanup). The uid suffix keeps the dir user-owned: this path becomes
+    # the child's entire PYTHONPATH, so it must not be attacker-writable.
+    uid = os.getuid() if hasattr(os, "getuid") else "na"
+    stub = os.path.join(tempfile.gettempdir(), f"happysim_jaxstub_{uid}")
     os.makedirs(os.path.join(stub, "jax_plugins"), exist_ok=True)
     open(os.path.join(stub, "jax_plugins", "__init__.py"), "w").close()
     env = dict(os.environ)
@@ -114,7 +117,7 @@ def bench_kernel(devices) -> dict:
     label = (
         f"simulated-events/sec (CPU fallback, {KERNEL_REPLICAS}-replica M/M/1 ensemble)"
         if DEVICE_FALLBACK
-        else f"simulated-events/sec/chip ({KERNEL_REPLICAS // 1024}k-replica M/M/1 ensemble)"
+        else f"simulated-events/sec/chip ({round(KERNEL_REPLICAS / 1000)}k-replica M/M/1 ensemble)"
     )
     return {
         "metric": label,
@@ -155,7 +158,7 @@ def bench_general_engine(devices) -> dict:
     label = (
         f"simulated-events/sec (CPU fallback, general engine, {ENGINE_REPLICAS}-replica M/M/1)"
         if DEVICE_FALLBACK
-        else f"simulated-events/sec/chip (general engine, {ENGINE_REPLICAS // 1024}k-replica M/M/1)"
+        else f"simulated-events/sec/chip (general engine, {round(ENGINE_REPLICAS / 1000)}k-replica M/M/1)"
     )
     return {
         "metric": label,

@@ -2,8 +2,8 @@
 
 Writes accumulate in a size-bounded memtable; crossing the threshold
 flushes a sorted SSTable. 100 unsorted writes through a 25-entry memtable
-yield 4 runs, each internally sorted, with the tail still buffered in
-memory. Role parity: ``examples/storage/memtable_flush.py``.
+yield exactly 4 sorted runs and an empty memtable. Role parity:
+``examples/storage/memtable_flush.py``.
 """
 
 from happysim_tpu.components.storage import Memtable
