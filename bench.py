@@ -76,8 +76,17 @@ def _reexec_cpu_fallback() -> "None":
     # dir per fallback invocation — the parent execve's away before any
     # cleanup). The uid suffix keeps the dir user-owned: this path becomes
     # the child's entire PYTHONPATH, so it must not be attacker-writable.
-    uid = os.getuid() if hasattr(os, "getuid") else "na"
+    uid = os.getuid() if hasattr(os, "getuid") else None
     stub = os.path.join(tempfile.gettempdir(), f"happysim_jaxstub_{uid}")
+    try:
+        os.makedirs(stub, mode=0o700, exist_ok=True)
+        owner = os.stat(stub).st_uid if uid is not None else None
+        if uid is not None and owner != uid:
+            raise OSError("stub dir owned by another user")
+    except OSError:
+        # Squatted or unusable: take a private one-off dir instead (leaks
+        # one dir per run in this adversarial case — acceptable).
+        stub = tempfile.mkdtemp(prefix="happysim_jaxstub_")
     os.makedirs(os.path.join(stub, "jax_plugins"), exist_ok=True)
     open(os.path.join(stub, "jax_plugins", "__init__.py"), "w").close()
     env = dict(os.environ)
@@ -117,7 +126,7 @@ def bench_kernel(devices) -> dict:
     label = (
         f"simulated-events/sec (CPU fallback, {KERNEL_REPLICAS}-replica M/M/1 ensemble)"
         if DEVICE_FALLBACK
-        else f"simulated-events/sec/chip ({round(KERNEL_REPLICAS / 1000)}k-replica M/M/1 ensemble)"
+        else f"simulated-events/sec/chip ({KERNEL_REPLICAS // 1000}k-replica M/M/1 ensemble)"
     )
     return {
         "metric": label,
@@ -158,7 +167,7 @@ def bench_general_engine(devices) -> dict:
     label = (
         f"simulated-events/sec (CPU fallback, general engine, {ENGINE_REPLICAS}-replica M/M/1)"
         if DEVICE_FALLBACK
-        else f"simulated-events/sec/chip (general engine, {round(ENGINE_REPLICAS / 1000)}k-replica M/M/1)"
+        else f"simulated-events/sec/chip (general engine, {ENGINE_REPLICAS // 1000}k-replica M/M/1)"
     )
     return {
         "metric": label,

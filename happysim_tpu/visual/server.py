@@ -7,6 +7,7 @@ play loop uses ``GET /api/poll?since=N`` long-polling instead of a
 WebSocket — same incremental event/log stream, zero dependencies.
 
 Endpoints:
+  GET  /                             self-contained HTML frontend (static/)
   GET  /api/topology                 nodes + edges (+ live edge traffic)
   GET  /api/state                    time, counters, entity snapshots
   POST /api/step?n=K                 process K events (pauses first)
@@ -29,12 +30,15 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import pathlib
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
 from happysim_tpu.visual.bridge import SimulationBridge
+
+_STATIC_DIR = pathlib.Path(__file__).parent / "static"
 
 
 def _make_handler(bridge: SimulationBridge):
@@ -147,6 +151,17 @@ def _make_handler(bridge: SimulationBridge):
             return None
 
         def do_GET(self):
+            path = urlparse(self.path).path
+            if path in ("/", "/index.html"):
+                page = _STATIC_DIR / "index.html"
+                if page.exists():
+                    body = page.read_bytes()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
             self._route("GET")
 
         def do_POST(self):

@@ -222,3 +222,57 @@ class TestCodeStepping:
         bridge.code_debugger.resume()
         assert finished.wait(timeout=20)
         bridge.close()
+
+
+class TestStaticFrontend:
+    def test_index_served_and_wired_to_api(self):
+        sim, *_ = build_sim()
+        with DebugServer(sim, port=0) as server:
+            base = server.url
+            with urllib.request.urlopen(f"{base}/", timeout=10) as response:
+                assert response.headers["Content-Type"].startswith("text/html")
+                html = response.read().decode()
+            # The page drives exactly these endpoints; keep them in sync.
+            for endpoint in (
+                "/api/poll", "/api/topology", "/api/chart_data",
+                "/api/step", "/api/run_to", "/api/reset", "/api/timeseries/",
+            ):
+                assert endpoint in html, f"frontend lost its {endpoint} wiring"
+            for element in ("btn-step", "btn-run", "btn-reset", "topo-box",
+                            "log-body", "inspector-body", "charts"):
+                assert f'id="{element}"' in html or f'$(`{element}' in html
+
+            # The control flow the buttons trigger works over live HTTP.
+            post(f"{base}/api/step?n=5")
+            state = post(f"{base}/api/run_to?t=1.0")
+            # run_to stops on the last event at or before t.
+            assert 0.9 <= state["time_s"] <= 1.0
+            poll = get(f"{base}/api/poll?since=0")
+            assert poll["events"], "poll feed drives the event log"
+            assert all("seq" in e for e in poll["events"][:5])
+
+            # Shape contract between the page's JS and the API: edges and
+            # traffic are OBJECT lists, and the script indexes them so.
+            topo = get(f"{base}/api/topology")
+            assert all({"source", "target"} <= set(e) for e in topo["edges"])
+            assert isinstance(topo["traffic"], list)
+            assert "e.source" in html and "t.source" in html, (
+                "frontend must consume object-shaped edges/traffic"
+            )
+
+    def test_index_script_brackets_balanced(self):
+        import pathlib
+        import re
+
+        html = (
+            pathlib.Path(__file__).parent.parent.parent
+            / "happysim_tpu" / "visual" / "static" / "index.html"
+        ).read_text()
+        script = re.search(r"<script>\n(.*)</script>", html, re.S).group(1)
+        # Strip string/template literals before counting brackets.
+        stripped = re.sub(r"`[^`]*`|\"[^\"\n]*\"|'[^'\n]*'", "", script)
+        stripped = re.sub(r"/\*.*?\*/", "", stripped, flags=re.S)
+        for open_ch, close_ch in ("{}", "()", "[]"):
+            assert stripped.count(open_ch) == stripped.count(close_ch), (
+                f"unbalanced {open_ch}{close_ch} in frontend script"
+            )
