@@ -1,0 +1,62 @@
+"""An emergency room where trauma cases preempt scheduled surgeries.
+
+One operating room runs scheduled procedures back-to-back. A trauma case
+arrives mid-procedure, preempts the elective patient (who must restart
+later), and takes the room immediately — priority preemption traded
+against redone work. Role parity: ``examples/industrial/hospital_er.py``.
+"""
+
+from happysim_tpu import Event, Instant, Simulation
+from happysim_tpu.components.industrial import PreemptibleResource
+from happysim_tpu.core.entity import Entity
+
+MINUTE = 60.0
+
+
+def main() -> dict:
+    theater = PreemptibleResource("or1", capacity=1)
+    log = []
+
+    class Elective(Entity):
+        def handle_event(self, event):
+            while True:
+                grant = yield theater.acquire(1, priority=5.0)
+                yield 60 * MINUTE  # procedure length
+                if grant.preempted:
+                    # Noticed at the natural wake: the work is void, rebook.
+                    log.append(("elective_interrupted", self.now.to_seconds() / MINUTE))
+                    continue
+                grant.release()
+                log.append(("elective_done", self.now.to_seconds() / MINUTE))
+                return None
+
+    class Trauma(Entity):
+        def handle_event(self, event):
+            grant = yield theater.acquire(1, priority=1.0, preempt=True)
+            log.append(("trauma_started", self.now.to_seconds() / MINUTE))
+            yield 45 * MINUTE
+            grant.release()
+            log.append(("trauma_done", self.now.to_seconds() / MINUTE))
+            return None
+
+    elective, trauma = Elective("elective"), Trauma("trauma")
+    sim = Simulation(
+        entities=[theater, elective, trauma], end_time=Instant.from_seconds(6 * 3600)
+    )
+    sim.schedule(Event(Instant.Epoch, "admit", target=elective))
+    sim.schedule(Event(Instant.from_seconds(20 * MINUTE), "code", target=trauma))
+    sim.run()
+
+    times = dict(log)
+    # Trauma takes the room the moment it arrives, mid-elective.
+    assert log[0] == ("trauma_started", 20.0)
+    assert times["trauma_started"] == 20.0
+    assert times["trauma_done"] == 65.0
+    # The elective restarts AFTER the trauma and finishes a full hour later.
+    assert times["elective_done"] >= 125.0
+    assert theater.preemptions == 1
+    return {"timeline_min": log, "preemptions": theater.preemptions}
+
+
+if __name__ == "__main__":
+    print(main())
