@@ -8,7 +8,7 @@ time (Erlang-B B(4, 3.6) ~ 0.27), on top of the opening-flush rush. Role parity:
 ``examples/industrial/car_wash.py``.
 """
 
-from happysim_tpu import Event, Instant, Simulation, Sink, Source
+from happysim_tpu import Instant, Simulation, Sink, Source
 from happysim_tpu.components.industrial import ConveyorBelt, GateController
 
 MINUTE = 60.0

@@ -1,0 +1,64 @@
+"""Direct tests for shared internal helpers: the settle-once call ledger
+and the sync-primitive ambient-clock base."""
+
+from happysim_tpu import Event, Instant, Mutex, Simulation
+from happysim_tpu.components.microservice._tracking import PendingCalls
+from happysim_tpu.components.sync._base import SyncPrimitive
+from happysim_tpu.core.entity import Entity
+
+
+class TestPendingCalls:
+    def test_issue_settle_roundtrip(self):
+        calls = PendingCalls()
+        call_id = calls.issue(route="orders", attempt=1)
+        assert len(calls) == 1
+        info = calls.settle(call_id)
+        assert info == {"route": "orders", "attempt": 1}
+        assert len(calls) == 0
+
+    def test_settle_exactly_once(self):
+        """The response/timeout race: the loser must get None."""
+        calls = PendingCalls()
+        call_id = calls.issue(kind="call")
+        assert calls.settle(call_id) is not None  # winner
+        assert calls.settle(call_id) is None  # loser does nothing
+
+    def test_unknown_and_none_ids(self):
+        calls = PendingCalls()
+        assert calls.settle(None) is None
+        assert calls.settle(99) is None
+
+    def test_ids_monotonic_across_settles(self):
+        calls = PendingCalls()
+        first = calls.issue()
+        calls.settle(first)
+        second = calls.issue()
+        assert second > first  # ids never reused
+
+
+class TestSyncPrimitiveClock:
+    def test_outside_simulation_reads_zero(self):
+        class Standalone(SyncPrimitive):
+            def handle_event(self, event):
+                return None
+
+        assert Standalone("standalone")._now_ns() == 0
+
+    def test_ambient_clock_inside_simulation(self):
+        """A primitive never registered as an entity still reads sim time
+        (wait-time accounting in Mutex/Semaphore relies on this)."""
+        mutex = Mutex("m")  # NOT passed to Simulation(entities=...)
+        seen = {}
+
+        class Worker(Entity):
+            def handle_event(self, event):
+                grant = yield mutex.acquire()
+                seen["t_ns"] = mutex._now_ns()
+                mutex.release()
+                return None
+
+        worker = Worker("w")
+        sim = Simulation(entities=[worker], end_time=Instant.from_seconds(10))
+        sim.schedule(Event(Instant.from_seconds(2.5), "go", target=worker))
+        sim.run()
+        assert seen["t_ns"] == Instant.from_seconds(2.5).nanoseconds
