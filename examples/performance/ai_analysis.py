@@ -1,0 +1,64 @@
+"""Run a simulation, then let the analysis/AI stack explain it.
+
+The same M/M/1 is run near-idle (rho=0.05) and saturated (rho=1.5);
+``SimulationResult.from_run`` attaches phase detection, anomaly scan, and
+rule-based recommendations. The saturated run is told its queue is
+saturated/growing, the idle run that it is overprovisioned, and
+``to_prompt_context()`` emits the compact text an LLM agent consumes. Role parity:
+``examples/performance/ai_analysis.py``.
+"""
+
+from happysim_tpu import (
+    ExponentialLatency,
+    Instant,
+    Probe,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.ai import SimulationResult
+
+
+def _run(lam: float) -> SimulationResult:
+    sink = Sink("sink")
+    server = Server(
+        "server",
+        service_time=ExponentialLatency(0.1, seed=1),
+        downstream=sink,
+        queue_capacity=100_000,
+    )
+    source = Source.poisson(rate=lam, target=server, stop_after=120.0, seed=4)
+    depth = Probe.on(server, "queue_depth", interval_s=0.5)
+    sim = Simulation(
+        sources=[source], entities=[server, sink], probes=[depth],
+        end_time=Instant.from_seconds(120),
+    )
+    summary = sim.run()
+    return SimulationResult.from_run(
+        summary, latency=sink.latency_data, queue_depth={"server": depth.data}
+    )
+
+
+def main() -> dict:
+    healthy = _run(lam=0.5)
+    saturated = _run(lam=15.0)
+
+    sat_text = " ".join(r.description for r in saturated.recommendations).lower()
+    assert "saturat" in sat_text or "grow" in sat_text, sat_text
+    idle_text = " ".join(r.description for r in healthy.recommendations).lower()
+    assert "empty" in idle_text or "overprovision" in idle_text, idle_text
+    assert "saturat" not in idle_text
+
+    prompt = saturated.to_prompt_context()
+    assert "Recommendations" in prompt
+    assert len(prompt) < 8000, "prompt context stays compact for LLM consumption"
+    return {
+        "healthy_recommendations": [r.description[:60] for r in healthy.recommendations],
+        "saturated_recommendations": [r.description[:60] for r in saturated.recommendations],
+        "prompt_chars": len(prompt),
+    }
+
+
+if __name__ == "__main__":
+    print(main())
