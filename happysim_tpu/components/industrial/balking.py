@@ -53,7 +53,12 @@ class BalkingQueue(QueuePolicy):
         """
         from happysim_tpu.components.queue_policy import FIFOQueue
 
-        if isinstance(self.inner, FIFOQueue):
+        if hasattr(self.inner, "requeue"):
+            # Fair/WFQ inners restore lane-front + rotation themselves — a
+            # plain push would reintroduce the sparse-flow starvation their
+            # requeue() exists to prevent.
+            self.inner.requeue(item)
+        elif isinstance(self.inner, FIFOQueue):
             self.inner._items.appendleft(item)
         else:
             self.inner.push(item)

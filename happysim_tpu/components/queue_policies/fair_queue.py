@@ -56,6 +56,21 @@ class FairQueue(QueuePolicy):
             self._flows[key] = lane  # re-append at the end (round robin)
         return item
 
+    def requeue(self, item: Any) -> None:
+        """Undo a pop for an undeliverable item: back to the FRONT of its
+        lane, with its flow next in rotation.
+
+        Plain push would tail-append the item AND leave the rotation
+        advanced — the driver's poll/deliver/requeue races then starve
+        sparse flows (each service completion chains a spurious poll whose
+        requeue rotates past them).
+        """
+        key = self._flow_key(item)
+        lane = self._flows.setdefault(key, deque())
+        lane.appendleft(item)
+        self._flows.move_to_end(key, last=False)
+        self._size += 1
+
     def peek(self) -> Any:
         if self._size == 0:
             return None
@@ -123,6 +138,14 @@ class WeightedFairQueue(QueuePolicy):
         finish, _, item = heapq.heappop(self._heap)
         self._virtual_now = finish
         return item
+
+    def requeue(self, item: Any) -> None:
+        """Undo a pop without recomputing a (later) finish time: the item
+        re-enters at the current virtual time, so it is served next among
+        its peers instead of being pushed behind the backlog."""
+        import heapq
+
+        heapq.heappush(self._heap, (self._virtual_now, next(self._tiebreak), item))
 
     def peek(self) -> Any:
         return self._heap[0][2] if self._heap else None
