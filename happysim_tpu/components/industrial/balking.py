@@ -44,24 +44,16 @@ class BalkingQueue(QueuePolicy):
             return False
         return self.inner.push(item)
 
-    def requeue(self, item: Any) -> None:
-        """Re-admit an already-accepted item at the head — never balks.
+    def requeue(self, item: Any):
+        """Re-admit an already-accepted item — never balks.
 
         Called by :meth:`Queue.requeue` when the driver hands back a popped
         item (worker filled between poll and delivery): the item already
-        joined the line, so the balk check must not apply again.
+        joined the line, so the balk check must not apply again. The inner
+        policy's own requeue restores its position (front for FIFO,
+        lane-front + rotation for fair queues); its acceptance propagates.
         """
-        from happysim_tpu.components.queue_policy import FIFOQueue
-
-        if hasattr(self.inner, "requeue"):
-            # Fair/WFQ inners restore lane-front + rotation themselves — a
-            # plain push would reintroduce the sparse-flow starvation their
-            # requeue() exists to prevent.
-            self.inner.requeue(item)
-        elif isinstance(self.inner, FIFOQueue):
-            self.inner._items.appendleft(item)
-        else:
-            self.inner.push(item)
+        return self.inner.requeue(item)
 
     def pop(self) -> Any:
         return self.inner.pop()

@@ -143,22 +143,14 @@ class Queue(Entity):
         policy that rejects the re-push (RED under congestion) turns the
         requeue into a drop, with hooks unwound.
         """
-        from happysim_tpu.components.queue_policy import FIFOQueue
-
-        if hasattr(self.policy, "requeue"):
-            # Wrapper policies (BalkingQueue) re-admit without re-screening.
-            self.policy.requeue(payload)
-        elif isinstance(self.policy, FIFOQueue):
-            self.policy._items.appendleft(payload)
-        else:
-            accepted = self.policy.push(payload)
-            if accepted is False:
-                # Undo the poll's dequeue count: the item's final fate is
-                # "dropped", not "dequeued" (keeps enqueued == dequeued +
-                # depth + dropped).
-                self.dequeued -= 1
-                self.dropped += 1
-                return payload.complete_as_dropped(self.now, self.name)
+        accepted = self.policy.requeue(payload)
+        if accepted is False:
+            # A policy that re-screens (RED under congestion) may reject the
+            # re-admission: the item's final fate is "dropped", not
+            # "dequeued" (keeps enqueued == dequeued + depth + dropped).
+            self.dequeued -= 1
+            self.dropped += 1
+            return payload.complete_as_dropped(self.now, self.name)
         self.dequeued -= 1
         self.requeued += 1
         return []

@@ -135,17 +135,26 @@ class WeightedFairQueue(QueuePolicy):
 
         if not self._heap:
             return None
-        finish, _, item = heapq.heappop(self._heap)
-        self._virtual_now = finish
-        return item
+        entry = heapq.heappop(self._heap)
+        self._virtual_now = entry[0]
+        self._last_pop = entry
+        return entry[2]
 
     def requeue(self, item: Any) -> None:
-        """Undo a pop without recomputing a (later) finish time: the item
-        re-enters at the current virtual time, so it is served next among
-        its peers instead of being pushed behind the backlog."""
+        """Undo a pop exactly: the driver requeues immediately after the
+        pop, so restoring the popped heap entry (finish AND tiebreak)
+        puts the item back ahead of equal-finish peers it preceded. A
+        foreign item (not the last pop) re-enters at virtual_now."""
         import heapq
 
-        heapq.heappush(self._heap, (self._virtual_now, next(self._tiebreak), item))
+        last = getattr(self, "_last_pop", None)
+        if last is not None and last[2] is item:
+            heapq.heappush(self._heap, last)
+            self._last_pop = None
+        else:
+            heapq.heappush(
+                self._heap, (self._virtual_now, next(self._tiebreak), item)
+            )
 
     def peek(self) -> Any:
         return self._heap[0][2] if self._heap else None

@@ -37,6 +37,13 @@ class QueuePolicy(ABC):
     @abstractmethod
     def __len__(self) -> int: ...
 
+    def requeue(self, item: Any):
+        """Undo a pop for a popped-but-undeliverable item (the driver's
+        same-instant delivery race). Default: re-push — order-sensitive
+        policies override to restore the item's exact position. Returns
+        the push's acceptance (False = the policy dropped it)."""
+        return self.push(item)
+
     def clear(self) -> None:
         while len(self):
             self.pop()
@@ -48,6 +55,9 @@ class FIFOQueue(QueuePolicy):
 
     def push(self, item: Any) -> None:
         self._items.append(item)
+
+    def requeue(self, item: Any) -> None:
+        self._items.appendleft(item)  # back to the front, FIFO restored
 
     def pop(self) -> Any:
         return self._items.popleft()

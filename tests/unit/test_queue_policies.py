@@ -214,55 +214,42 @@ class TestFairRequeue:
         # Rotation was restored too: drip still gets the following turn.
         assert q.pop().context["metadata"]["flow"] == "drip"
 
-    def test_wfq_requeue_serves_item_before_later_arrivals(self):
+    def test_wfq_requeue_is_exact_undo_even_among_ties(self):
         q = WeightedFairQueue()
-        a = self._event("a")
+        a, b = self._event("a"), self._event("b")
         q.push(a)
-        assert q.pop() is a  # virtual_now advances to a's finish
-        b = self._event("b")
-        q.push(b)  # strictly later finish than virtual_now
-        q.requeue(a)
-        # Re-entered at virtual_now: a is NOT pushed behind the backlog.
+        q.push(b)  # same finish time as a; a holds the earlier tiebreak
         assert q.pop() is a
+        q.requeue(a)  # restores a's ORIGINAL heap entry
+        assert q.pop() is a  # still ahead of its equal-finish peer
         assert q.pop() is b
 
-    def test_server_isolation_end_to_end(self):
-        """Two tenants, one flooding: fair queuing keeps the sparse
-        tenant's latency near its FIFO-free baseline."""
-        from happysim_tpu import ConstantLatency, Instant, Server, Simulation, Source
-        from happysim_tpu.core.entity import Entity
-        from happysim_tpu.load.event_provider import SimpleEventProvider
 
-        class ByFlow(Entity):
-            def __init__(self):
-                super().__init__("sink")
-                self.sums = {"flood": [0.0, 0], "drip": [0.0, 0]}
+    def test_requeue_rejection_accounts_as_drop(self):
+        """A re-screening policy (RED under congestion) may reject the
+        requeue; the unified path must record a drop and unwind hooks,
+        keeping enqueued == dequeued + depth + dropped."""
+        from happysim_tpu.components.queue import Queue
 
-            def handle_event(self, event):
-                flow = event.context["metadata"]["flow"]
-                cell = self.sums[flow]
-                cell[0] += (event.time - event.context["created_at"]).to_seconds()
-                cell[1] += 1
-                return None
+        class RejectingPolicy(FairQueue):
+            def requeue(self, item):
+                return False  # simulate RED rejecting the re-admission
 
-        sink = ByFlow()
-        server = Server(
-            "srv", service_time=ConstantLatency(0.018), downstream=sink,
-            queue_policy=FairQueue(), queue_capacity=10_000,
+        queue = Queue("q", policy=RejectingPolicy())
+        from happysim_tpu.core.clock import Clock
+
+        queue.set_clock(Clock())
+        victim = Event(t(0), "req", target=_SINK)
+        fates = []
+        victim.add_completion_hook(
+            lambda time, dropped_by=None: fates.append(dropped_by) or []
         )
-        sources = []
-        for flow, rate, seed in (("flood", 50.0, 1), ("drip", 5.0, 2)):
-            provider = SimpleEventProvider(
-                target=server, stop_after=Instant.from_seconds(20.0),
-                context_fn=lambda t_, i, flow=flow: {"metadata": {"flow": flow}},
-            )
-            sources.append(
-                Source.poisson(rate=rate, event_provider=provider, seed=seed,
-                               name=f"src_{flow}")
-            )
-        sim = Simulation(sources=sources, entities=[server, sink],
-                         end_time=Instant.from_seconds(30))
-        sim.run()
-        drip_mean = sink.sums["drip"][0] / sink.sums["drip"][1]
-        flood_mean = sink.sums["flood"][0] / sink.sums["flood"][1]
-        assert drip_mean < flood_mean / 2, (drip_mean, flood_mean)
+        queue.policy.push(victim)
+        queue.enqueued += 1
+        popped = queue.policy.pop()
+        queue.dequeued += 1
+        queue.requeue(popped)
+        assert queue.dropped == 1
+        assert queue.dequeued == 0  # the pop was undone
+        assert queue.enqueued == queue.dequeued + queue.depth + queue.dropped
+        assert fates, "the victim's hooks were unwound as a drop"
