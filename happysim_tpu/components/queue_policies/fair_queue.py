@@ -111,7 +111,13 @@ class WeightedFairQueue(QueuePolicy):
         self._flow_key = flow_key or _default_flow_key
         self._cost = cost or (lambda item: 1.0)
         self._heap: list[tuple[float, int, Any]] = []
-        self._tiebreak = itertools.count()
+        # Tiebreak ranges are segregated: pushes draw from a high counter,
+        # requeues from a low one. A requeued item re-entering at
+        # virtual_now therefore precedes every equal-finish pushed peer
+        # (it popped first, so it sorted first — the undo restores that),
+        # and successive requeues keep their pop order.
+        self._tiebreak = itertools.count(2**33)
+        self._requeue_tiebreak = itertools.count()
         self._virtual_now = 0.0
         self._last_finish: dict[str, float] = {}
 
@@ -135,26 +141,19 @@ class WeightedFairQueue(QueuePolicy):
 
         if not self._heap:
             return None
-        entry = heapq.heappop(self._heap)
-        self._virtual_now = entry[0]
-        self._last_pop = entry
-        return entry[2]
+        finish, _, item = heapq.heappop(self._heap)
+        self._virtual_now = finish
+        return item
 
     def requeue(self, item: Any) -> None:
-        """Undo a pop exactly: the driver requeues immediately after the
-        pop, so restoring the popped heap entry (finish AND tiebreak)
-        puts the item back ahead of equal-finish peers it preceded. A
-        foreign item (not the last pop) re-enters at virtual_now."""
+        """Undo a pop: re-enter at virtual_now with a low-range tiebreak,
+        so the item precedes equal-finish peers it originally beat and
+        multiple same-instant requeues keep their pop order."""
         import heapq
 
-        last = getattr(self, "_last_pop", None)
-        if last is not None and last[2] is item:
-            heapq.heappush(self._heap, last)
-            self._last_pop = None
-        else:
-            heapq.heappush(
-                self._heap, (self._virtual_now, next(self._tiebreak), item)
-            )
+        heapq.heappush(
+            self._heap, (self._virtual_now, next(self._requeue_tiebreak), item)
+        )
 
     def peek(self) -> Any:
         return self._heap[0][2] if self._heap else None
@@ -166,3 +165,5 @@ class WeightedFairQueue(QueuePolicy):
         self._heap.clear()
         self._last_finish.clear()
         self._virtual_now = 0.0
+        self._tiebreak = itertools.count(2**33)
+        self._requeue_tiebreak = itertools.count()

@@ -1,0 +1,95 @@
+"""Host-executor accuracy against closed-form queueing theory.
+
+The TPU engine has its oracle suite (test_tpu_mm1/engine/mg1); this is
+the same discipline for the HOST executor: M/M/1 sojourn across loads,
+M/M/c Erlang-C waiting, and M/D/1 Pollaczek-Khinchine.
+"""
+
+import math
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    ExponentialLatency,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+
+MU = 100.0
+HORIZON = 120.0
+
+
+def run_queue(lam, concurrency=1, service=None):
+    sink = Sink("sink")
+    server = Server(
+        "srv",
+        concurrency=concurrency,
+        service_time=service or ExponentialLatency(1.0 / MU, seed=2),
+        downstream=sink,
+        queue_capacity=1_000_000,
+    )
+    source = Source.poisson(rate=lam, target=server, stop_after=HORIZON, seed=7)
+    sim = Simulation(
+        sources=[source], entities=[server, sink],
+        end_time=Instant.from_seconds(HORIZON + 60),
+    )
+    sim.run()
+    return sink.latency_stats().mean_s
+
+
+def erlang_c(c, a):
+    """P(wait) for M/M/c with offered load a = lam/mu erlangs."""
+    summation = sum(a**k / math.factorial(k) for k in range(c))
+    top = a**c / (math.factorial(c) * (1 - a / c))
+    return top / (summation + top)
+
+
+class TestMM1Sojourn:
+    @pytest.mark.parametrize("rho", [0.3, 0.6, 0.8])
+    def test_sojourn_tracks_theory(self, rho):
+        lam = rho * MU
+        measured = run_queue(lam)
+        analytic = 1.0 / (MU - lam)
+        assert measured == pytest.approx(analytic, rel=0.12), (measured, analytic)
+
+    def test_sojourn_monotone_in_load(self):
+        sojourns = [run_queue(rho * MU) for rho in (0.3, 0.6, 0.8)]
+        assert sojourns[0] < sojourns[1] < sojourns[2]
+
+
+class TestMMcErlangC:
+    @pytest.mark.parametrize("c", [2, 4])
+    def test_mean_sojourn(self, c):
+        rho = 0.8
+        lam = rho * c * MU  # per-server utilization 0.8
+        measured = run_queue(lam, concurrency=c)
+        a = lam / MU
+        wq = erlang_c(c, a) / (c * MU - lam)
+        analytic = wq + 1.0 / MU
+        assert measured == pytest.approx(analytic, rel=0.12), (measured, analytic)
+
+    def test_pooling_beats_split_queues(self):
+        """The M/M/2 pooled sojourn beats one M/M/1 at equal per-server load."""
+        pooled = run_queue(0.8 * 2 * MU, concurrency=2)
+        split = run_queue(0.8 * MU, concurrency=1)
+        assert pooled < split
+
+
+class TestMD1:
+    def test_deterministic_service_halves_the_wait(self):
+        rho = 0.8
+        lam = rho * MU
+        measured = run_queue(lam, service=ConstantLatency(1.0 / MU))
+        # P-K: Wq(M/D/1) = rho/(2 mu (1-rho)); sojourn adds 1/mu.
+        analytic = rho / (2 * MU * (1 - rho)) + 1.0 / MU
+        assert measured == pytest.approx(analytic, rel=0.12), (measured, analytic)
+
+    def test_md1_beats_mm1(self):
+        lam = 0.8 * MU
+        md1 = run_queue(lam, service=ConstantLatency(1.0 / MU))
+        mm1 = run_queue(lam)
+        assert md1 < mm1

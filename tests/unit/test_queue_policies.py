@@ -253,3 +253,17 @@ class TestFairRequeue:
         assert queue.dequeued == 0  # the pop was undone
         assert queue.enqueued == queue.dequeued + queue.depth + queue.dropped
         assert fates, "the victim's hooks were unwound as a drop"
+
+    def test_wfq_multi_requeue_preserves_pop_order(self):
+        """Two same-instant pops requeued in order must pop in that same
+        order again (FIFO within flow survives concurrency>=2 races)."""
+        q = WeightedFairQueue()
+        d, e = self._event("f"), self._event("f")
+        q.push(d)
+        q.push(e)
+        assert q.pop() is d
+        assert q.pop() is e
+        q.requeue(d)
+        q.requeue(e)
+        assert q.pop() is d
+        assert q.pop() is e
