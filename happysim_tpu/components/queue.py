@@ -135,19 +135,23 @@ class Queue(Entity):
         return produced or None
 
     def requeue(self, payload: Event) -> list[Event]:
-        """Return a popped-but-undeliverable item to the head of the queue.
+        """Return a popped-but-undeliverable item to the queue.
 
         Used by the driver when the worker filled up between poll and
-        delivery (same-instant burst arrivals). FIFO puts it back at the
-        front; other policies re-push (priority order is recomputed). A
-        policy that rejects the re-push (RED under congestion) turns the
-        requeue into a drop, with hooks unwound.
+        delivery (same-instant burst arrivals). Every shipped policy
+        implements this as an exact pop undo — the item regains its
+        original position (FIFO front, rank-with-earlier-tiebreak, WFQ
+        finish tag, popped deque end). A policy may still REJECT the
+        re-admission — the shipped hard-capacity policies (RED, CoDel,
+        AdaptiveLIFO with ``capacity=``) do when same-instant arrivals
+        refilled the popped slot, as may third-party policies using the
+        default push-based requeue — turning the requeue into a drop,
+        with hooks unwound.
         """
         accepted = self.policy.requeue(payload)
         if accepted is False:
-            # A policy that re-screens (RED under congestion) may reject the
-            # re-admission: the item's final fate is "dropped", not
-            # "dequeued" (keeps enqueued == dequeued + depth + dropped).
+            # Rejected re-admission: the item's final fate is "dropped",
+            # not "dequeued" (keeps enqueued == dequeued + depth + dropped).
             self.dequeued -= 1
             self.dropped += 1
             return payload.complete_as_dropped(self.now, self.name)

@@ -14,7 +14,11 @@ from __future__ import annotations
 from collections import deque
 from typing import Any, Optional
 
-from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queue_policy import (
+    PopSnapshots,
+    QueuePolicy,
+    RequeueStreak,
+)
 
 
 class AdaptiveLIFO(QueuePolicy):
@@ -32,6 +36,20 @@ class AdaptiveLIFO(QueuePolicy):
         )
         self.capacity = capacity
         self._items: deque[Any] = deque()
+        # Per-popped-item memory of (which end, pre/post mode state) so
+        # requeue can restore both the item's position and — when nothing
+        # else touched the queue in between — the serving discipline a
+        # spurious pop+requeue race would otherwise flip permanently.
+        self._pop_snapshots = PopSnapshots()
+        # Monotone operation sequence: the exact-undo branch of requeue may
+        # only fire when NO other push/pop/requeue happened since the pop —
+        # comparing mode state alone is unsound (intervening ops can leave
+        # the mode unchanged while still making a rollback stale).
+        self._op_seq = 0
+        # Separate streaks per restored end so consecutive same-instant
+        # requeues land in POP order at both the head and the tail.
+        self._head_streak = RequeueStreak()
+        self._tail_streak = RequeueStreak()
         self._congested = False
         self.mode_switches = 0
         self.dropped = 0
@@ -56,6 +74,9 @@ class AdaptiveLIFO(QueuePolicy):
         if self.capacity is not None and len(self._items) >= self.capacity:
             self.dropped += 1
             return False
+        self._op_seq += 1
+        self._head_streak.reset()
+        self._tail_streak.reset()
         self._items.append(item)
         self._update_mode()
         return True
@@ -63,9 +84,49 @@ class AdaptiveLIFO(QueuePolicy):
     def pop(self) -> Any:
         if not self._items:
             return None
-        item = self._items.pop() if self._congested else self._items.popleft()
+        self._op_seq += 1
+        self._head_streak.reset()
+        self._tail_streak.reset()
+        pre = (self._congested, self.mode_switches)
+        from_tail = self._congested
+        item = self._items.pop() if from_tail else self._items.popleft()
         self._update_mode()
+        self._pop_snapshots.remember(item, (from_tail, pre, self._op_seq))
         return item
+
+    def requeue(self, item: Any):
+        """Undo a pop: restore the item to the end it was popped from (a
+        plain push would tail-append, which in FIFO mode sends the item
+        behind everything that arrived after it). If the queue is unchanged
+        since that pop, the pre-pop mode/hysteresis state is restored too —
+        otherwise a spurious pop+requeue race inside the hysteresis band
+        would permanently flip the serving discipline. A hard capacity
+        bound still holds: if same-instant arrivals refilled the slot, the
+        requeue is rejected and becomes a drop."""
+        if self.capacity is not None and len(self._items) >= self.capacity:
+            self.dropped += 1
+            return False
+        snapshot = self._pop_snapshots.take(item)
+        if snapshot is None:
+            from_tail, pre, pop_seq = self._congested, None, None
+        else:
+            from_tail, pre, pop_seq = snapshot
+        exact_undo = pop_seq is not None and pop_seq == self._op_seq
+        self._op_seq += 1
+        if from_tail:
+            # i-th consecutive tail requeue lands i slots below the top.
+            self._items.insert(
+                len(self._items) - self._tail_streak.next_index(), item
+            )
+        else:
+            # i-th consecutive head requeue lands at offset i.
+            self._items.insert(self._head_streak.next_index(), item)
+        if exact_undo:
+            # No other push/pop/requeue since the pop: full rollback.
+            self._congested, self.mode_switches = pre
+        else:
+            self._update_mode()
+        return True
 
     def peek(self) -> Any:
         if not self._items:
@@ -77,3 +138,4 @@ class AdaptiveLIFO(QueuePolicy):
 
     def clear(self) -> None:
         self._items.clear()
+        self._pop_snapshots.clear()

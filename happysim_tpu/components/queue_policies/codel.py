@@ -15,7 +15,11 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
-from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queue_policy import (
+    PopSnapshots,
+    QueuePolicy,
+    RequeueStreak,
+)
 from happysim_tpu.core.temporal import Duration, Instant
 
 
@@ -44,6 +48,10 @@ class CoDelQueue(QueuePolicy):
         self.capacity = capacity
         self._clock_func = clock_func
         self._items: deque[tuple[Instant, Any]] = deque()
+        # Snapshot of recently popped items' enqueue times so a driver
+        # requeue can restore the original sojourn baseline.
+        self._popped_times = PopSnapshots()
+        self._streak = RequeueStreak()
         self._first_above_time: Optional[Instant] = None
         self._dropping = False
         self._drop_next: Optional[Instant] = None
@@ -82,10 +90,12 @@ class CoDelQueue(QueuePolicy):
             self.dropped += 1
             return False
         self.pushed += 1
+        self._streak.reset()
         self._items.append((self._now(), item))
         return True
 
     def pop(self) -> Any:
+        self._streak.reset()
         while self._items:
             now = self._now()
             enqueue_time, item = self._items.popleft()
@@ -96,8 +106,27 @@ class CoDelQueue(QueuePolicy):
                     self.on_drop(item)
                 continue
             self.popped += 1
+            self._popped_times.remember(item, enqueue_time)
             return item
         return None
+
+    def requeue(self, item: Any):
+        """Undo a pop: back to the FRONT with the item's ORIGINAL enqueue
+        time (a push would tail-append with a fresh timestamp, losing both
+        its place and its accumulated sojourn for CoDel's delay tracking).
+        The hard capacity bound still holds: if same-instant arrivals
+        refilled the popped slot, the requeue is rejected as a drop."""
+        if self.capacity is not None and len(self._items) >= self.capacity:
+            # The pop is converted into a drop: one final fate per item
+            # (keeps pushed == popped + depth + dropped).
+            self.popped -= 1
+            self.dropped += 1
+            return False
+        self.popped -= 1
+        enqueue_time = self._popped_times.take(item, self._now())
+        # POP order among consecutive requeues: i-th lands at offset i.
+        self._items.insert(self._streak.next_index(), (enqueue_time, item))
+        return True
 
     def peek(self) -> Any:
         return self._items[0][1] if self._items else None
@@ -107,6 +136,7 @@ class CoDelQueue(QueuePolicy):
 
     def clear(self) -> None:
         self._items.clear()
+        self._popped_times.clear()
 
     # -- CoDel state machine ----------------------------------------------
     def _should_drop(self, now: Instant, sojourn: float) -> bool:

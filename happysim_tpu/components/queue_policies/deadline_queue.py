@@ -11,11 +11,10 @@ deadline sort last (infinite slack).
 from __future__ import annotations
 
 import heapq
-import itertools
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
-from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queue_policy import RankedHeapPolicy
 from happysim_tpu.core.event import Event
 from happysim_tpu.core.temporal import Instant
 
@@ -37,18 +36,17 @@ def _default_deadline(item: Any) -> Optional[float]:
     return None
 
 
-class DeadlineQueue(QueuePolicy):
+class DeadlineQueue(RankedHeapPolicy):
     def __init__(
         self,
         get_deadline: Optional[Callable[[Any], Optional[float]]] = None,
         drop_expired: bool = True,
         clock_func: Optional[Callable[[], Instant]] = None,
     ):
+        super().__init__()
         self._get_deadline = get_deadline or _default_deadline
         self.drop_expired = drop_expired
         self._clock_func = clock_func
-        self._heap: list[tuple[float, int, Any]] = []
-        self._tiebreak = itertools.count()
         self.pushed = 0
         self.popped = 0
         self.expired = 0
@@ -67,12 +65,21 @@ class DeadlineQueue(QueuePolicy):
         deadline = self._get_deadline(item)
         return float("inf") if deadline is None else deadline
 
+    _rank_of = _deadline_of
+
     def _now_s(self) -> Optional[float]:
         return self._clock_func().to_seconds() if self._clock_func is not None else None
 
     def push(self, item: Any) -> None:
         self.pushed += 1
-        heapq.heappush(self._heap, (self._deadline_of(item), next(self._tiebreak), item))
+        self._heap_push(item)
+
+    def requeue(self, item: Any) -> None:
+        """Undo a pop: EDF rank with a low-range tiebreak restores the
+        item ahead of every equal-deadline peer; the pop's stats bump is
+        rolled back so pushed == popped + depth + expired holds."""
+        self.popped -= 1
+        super().requeue(item)
 
     def pop(self) -> Any:
         now_s = self._now_s()
@@ -115,6 +122,3 @@ class DeadlineQueue(QueuePolicy):
 
     def __len__(self) -> int:
         return len(self._heap)
-
-    def clear(self) -> None:
-        self._heap.clear()

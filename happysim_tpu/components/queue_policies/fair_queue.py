@@ -15,7 +15,7 @@ import itertools
 from collections import OrderedDict, deque
 from typing import Any, Callable, Optional
 
-from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queue_policy import PopSnapshots, QueuePolicy
 from happysim_tpu.core.event import Event
 
 
@@ -35,9 +35,14 @@ class FairQueue(QueuePolicy):
     def __init__(self, flow_key: Optional[Callable[[Any], str]] = None):
         self._flow_key = flow_key or _default_flow_key
         self._flows: "OrderedDict[str, deque]" = OrderedDict()
+        # Flow keys of consecutive requeues (cleared by any push/pop):
+        # same-instant multi-item requeues must restore POP order both
+        # within a lane and across the flow rotation.
+        self._requeue_streak: list[str] = []
         self._size = 0
 
     def push(self, item: Any) -> None:
+        self._requeue_streak.clear()
         key = self._flow_key(item)
         if key not in self._flows:
             self._flows[key] = deque()
@@ -47,6 +52,7 @@ class FairQueue(QueuePolicy):
     def pop(self) -> Any:
         if self._size == 0:
             return None
+        self._requeue_streak.clear()
         # Serve the first flow, then rotate it to the back.
         key, lane = next(iter(self._flows.items()))
         item = lane.popleft()
@@ -57,19 +63,34 @@ class FairQueue(QueuePolicy):
         return item
 
     def requeue(self, item: Any) -> None:
-        """Undo a pop for an undeliverable item: back to the FRONT of its
-        lane, with its flow next in rotation.
+        """Undo a pop for an undeliverable item: back to the front of its
+        lane, with its flow back at the front of the rotation.
 
         Plain push would tail-append the item AND leave the rotation
         advanced — the driver's poll/deliver/requeue races then starve
         sparse flows (each service completion chains a spurious poll whose
-        requeue rotates past them).
+        requeue rotates past them). Consecutive requeues restore POP order:
+        the i-th requeue of the same flow lands at lane offset i, and
+        requeued flows occupy the head of the rotation in requeue order.
         """
         key = self._flow_key(item)
         lane = self._flows.setdefault(key, deque())
-        lane.appendleft(item)
-        self._flows.move_to_end(key, last=False)
+        lane.insert(self._requeue_streak.count(key), item)
         self._size += 1
+        if key not in self._requeue_streak:
+            # Place this flow after the already-requeued flows, ahead of
+            # the rest of the rotation. The common case (first requeued
+            # flow) is an O(1) move to the front; only a SECOND distinct
+            # flow in the same instant pays the O(flows) rebuild.
+            position = len(set(self._requeue_streak))
+            if position == 0:
+                self._flows.move_to_end(key, last=False)
+            else:
+                rotation = list(self._flows.keys())
+                rotation.remove(key)
+                rotation.insert(position, key)
+                self._flows = OrderedDict((k, self._flows[k]) for k in rotation)
+        self._requeue_streak.append(key)
 
     def peek(self) -> Any:
         if self._size == 0:
@@ -81,6 +102,7 @@ class FairQueue(QueuePolicy):
 
     def clear(self) -> None:
         self._flows.clear()
+        self._requeue_streak.clear()
         self._size = 0
 
     @property
@@ -120,6 +142,11 @@ class WeightedFairQueue(QueuePolicy):
         self._requeue_tiebreak = itertools.count()
         self._virtual_now = 0.0
         self._last_finish: dict[str, float] = {}
+        # Snapshot of recently popped items' finish tags so requeue can
+        # restore the EXACT tag even if other pops advanced _virtual_now
+        # in between (e.g. a multi-slot driver poll). Bounded: the driver
+        # only ever requeues items it popped moments ago.
+        self._popped_finish = PopSnapshots()
 
     def set_weight(self, flow: str, weight: float) -> None:
         if weight <= 0:
@@ -142,18 +169,23 @@ class WeightedFairQueue(QueuePolicy):
         if not self._heap:
             return None
         finish, _, item = heapq.heappop(self._heap)
-        self._virtual_now = finish
+        # max(): popping a snapshot-requeued item must not REWIND virtual
+        # time — that would hand artificially early finish tags to flows
+        # that push after the rewind, letting them jump earlier arrivals.
+        self._virtual_now = max(self._virtual_now, finish)
+        self._popped_finish.remember(item, finish)
         return item
 
     def requeue(self, item: Any) -> None:
-        """Undo a pop: re-enter at virtual_now with a low-range tiebreak,
-        so the item precedes equal-finish peers it originally beat and
-        multiple same-instant requeues keep their pop order."""
+        """Undo a pop: re-enter at the item's OWN popped finish tag (not
+        _virtual_now, which a later pop may have advanced past it) with a
+        low-range tiebreak, so the item precedes equal-finish peers it
+        originally beat and multiple same-instant requeues keep their pop
+        order."""
         import heapq
 
-        heapq.heappush(
-            self._heap, (self._virtual_now, next(self._requeue_tiebreak), item)
-        )
+        finish = self._popped_finish.take(item, self._virtual_now)
+        heapq.heappush(self._heap, (finish, next(self._requeue_tiebreak), item))
 
     def peek(self) -> Any:
         return self._heap[0][2] if self._heap else None
@@ -167,3 +199,4 @@ class WeightedFairQueue(QueuePolicy):
         self._virtual_now = 0.0
         self._tiebreak = itertools.count(2**33)
         self._requeue_tiebreak = itertools.count()
+        self._popped_finish.clear()

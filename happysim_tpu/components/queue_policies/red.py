@@ -15,7 +15,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Optional
 
-from happysim_tpu.components.queue_policy import QueuePolicy
+from happysim_tpu.components.queue_policy import QueuePolicy, RequeueStreak
 
 
 @dataclass(frozen=True)
@@ -24,6 +24,7 @@ class REDStats:
     popped: int
     early_drops: int
     forced_drops: int
+    requeue_drops: int
     avg_depth: float
 
 
@@ -48,11 +49,16 @@ class REDQueue(QueuePolicy):
         self.capacity = capacity
         self._rng = random.Random(seed)
         self._items: deque[Any] = deque()
+        self._streak = RequeueStreak()
         self._avg = 0.0
         self.pushed = 0
         self.popped = 0
         self.early_drops = 0
         self.forced_drops = 0
+        # Post-admission drops: requeues rejected at the hard capacity
+        # bound. Kept apart from forced_drops (pre-admission arrival
+        # drops) so pushed == popped + depth + requeue_drops holds.
+        self.requeue_drops = 0
 
     @property
     def average_depth(self) -> float:
@@ -65,10 +71,12 @@ class REDQueue(QueuePolicy):
             popped=self.popped,
             early_drops=self.early_drops,
             forced_drops=self.forced_drops,
+            requeue_drops=self.requeue_drops,
             avg_depth=self._avg,
         )
 
     def push(self, item: Any):
+        self._streak.reset()
         self._avg += self.weight * (len(self._items) - self._avg)
         if self.capacity is not None and len(self._items) >= self.capacity:
             self.forced_drops += 1
@@ -88,8 +96,25 @@ class REDQueue(QueuePolicy):
     def pop(self) -> Any:
         if not self._items:
             return None
+        self._streak.reset()
         self.popped += 1
         return self._items.popleft()
+
+    def requeue(self, item: Any):
+        """Undo a pop: back to the front in POP order, no probabilistic
+        re-screening and no EWMA update — the item was already admitted;
+        re-screening would let RED drop traffic the driver merely failed
+        to deliver this instant. The HARD capacity bound still holds: if
+        same-instant arrivals refilled the popped slot, the requeue is
+        rejected and the pop converts into a requeue_drop (one final fate
+        per item)."""
+        if self.capacity is not None and len(self._items) >= self.capacity:
+            self.popped -= 1
+            self.requeue_drops += 1
+            return False
+        self.popped -= 1
+        self._items.insert(self._streak.next_index(), item)
+        return True
 
     def peek(self) -> Any:
         return self._items[0] if self._items else None

@@ -9,7 +9,7 @@ from __future__ import annotations
 import heapq
 import itertools
 from abc import ABC, abstractmethod
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Optional, Protocol, runtime_checkable
 
 from happysim_tpu.core.event import Event
@@ -52,14 +52,19 @@ class QueuePolicy(ABC):
 class FIFOQueue(QueuePolicy):
     def __init__(self):
         self._items: deque = deque()
+        self._streak = RequeueStreak()
 
     def push(self, item: Any) -> None:
+        self._streak.reset()
         self._items.append(item)
 
     def requeue(self, item: Any) -> None:
-        self._items.appendleft(item)  # back to the front, FIFO restored
+        # Back to the front in POP order: the i-th consecutive requeue
+        # lands at offset i, so requeue(A), requeue(B) yields [A, B, ...].
+        self._items.insert(self._streak.next_index(), item)
 
     def pop(self) -> Any:
+        self._streak.reset()
         return self._items.popleft()
 
     def peek(self) -> Any:
@@ -70,16 +75,26 @@ class FIFOQueue(QueuePolicy):
 
     def clear(self) -> None:
         self._items.clear()
+        self._streak.reset()
 
 
 class LIFOQueue(QueuePolicy):
     def __init__(self):
         self._items: list = []
+        self._streak = RequeueStreak()
 
     def push(self, item: Any) -> None:
+        self._streak.reset()
         self._items.append(item)
 
+    def requeue(self, item: Any) -> None:
+        # Back to the top in POP order: undoing "pop A, pop B" must
+        # restore [..., B, A] (A back on top), so the i-th consecutive
+        # requeue lands i slots below the top.
+        self._items.insert(len(self._items) - self._streak.next_index(), item)
+
     def pop(self) -> Any:
+        self._streak.reset()
         return self._items.pop()
 
     def peek(self) -> Any:
@@ -90,30 +105,86 @@ class LIFOQueue(QueuePolicy):
 
     def clear(self) -> None:
         self._items.clear()
+        self._streak.reset()
 
 
-class PriorityQueue(QueuePolicy):
-    """Lowest priority value first; FIFO within equal priorities.
+class RequeueStreak:
+    """Counts consecutive requeue operations (reset by any push/pop).
 
-    Priority comes from ``key(item)`` if given, else ``item.priority``, else
-    the event context's ``priority`` field, else 0.
+    The driver requeues same-instant undeliverables in POP order, so undoing
+    "pop A, pop B" arrives as requeue(A), requeue(B). Naive front-insertion
+    would leave [B, A] — pop order inverted. Deque policies instead insert
+    the i-th consecutive requeue at offset i from the restored end, which
+    reproduces the original layout.
     """
 
-    def __init__(self, key: Optional[Callable[[Any], float]] = None):
-        self._key = key
-        self._heap: list[tuple[float, int, Any]] = []
-        self._tiebreak = itertools.count()
+    def __init__(self):
+        self.count = 0
 
-    def _priority_of(self, item: Any) -> float:
-        if self._key is not None:
-            return self._key(item)
-        priority = getattr(item, "priority", None)
-        if priority is None and isinstance(item, Event):
-            priority = item.context.get("priority")
-        return float(priority) if priority is not None else 0.0
+    def reset(self) -> None:
+        self.count = 0
+
+    def next_index(self) -> int:
+        index = self.count
+        self.count += 1
+        return index
+
+
+class PopSnapshots:
+    """Bounded ``id(item) -> record`` memory of recently popped items, so a
+    policy's ``requeue`` can restore pop-time state (enqueue timestamp,
+    finish tag, which end of the deque...). Bounded because the driver only
+    ever requeues items it popped moments ago; on overflow the oldest
+    snapshot is evicted and ``take`` falls back to the caller's default.
+    """
+
+    def __init__(self, cap: int = 1024):
+        self._cap = cap
+        self._records: "OrderedDict[int, Any]" = OrderedDict()
+
+    def remember(self, item: Any, record: Any) -> None:
+        records = self._records
+        records[id(item)] = record
+        records.move_to_end(id(item))
+        while len(records) > self._cap:
+            records.popitem(last=False)
+
+    def take(self, item: Any, default: Any = None) -> Any:
+        return self._records.pop(id(item), default)
+
+    def clear(self) -> None:
+        self._records.clear()
+
+
+class RankedHeapPolicy(QueuePolicy):
+    """Base for heap policies ordered by ``(rank(item), tiebreak)`` where
+    the rank is a pure function of the item (priority, deadline, ...).
+
+    Tiebreak ranges are segregated: pushes draw from a high counter,
+    requeues from a low one, so a requeued item re-enters AHEAD of every
+    equal-rank pushed peer — it popped first, so it sorted first; the undo
+    restores that — and successive requeues keep their pop order.
+    """
+
+    def __init__(self):
+        self._heap: list[tuple[float, int, Any]] = []
+        self._tiebreak = itertools.count(2**33)
+        self._requeue_tiebreak = itertools.count()
+
+    def _rank_of(self, item: Any) -> float:
+        raise NotImplementedError
+
+    def _heap_push(self, item: Any) -> None:
+        heapq.heappush(self._heap, (self._rank_of(item), next(self._tiebreak), item))
 
     def push(self, item: Any) -> None:
-        heapq.heappush(self._heap, (self._priority_of(item), next(self._tiebreak), item))
+        self._heap_push(item)
+
+    def requeue(self, item: Any) -> None:
+        """Undo a pop: same rank, low-range tiebreak."""
+        heapq.heappush(
+            self._heap, (self._rank_of(item), next(self._requeue_tiebreak), item)
+        )
 
     def pop(self) -> Any:
         return heapq.heappop(self._heap)[2]
@@ -126,3 +197,27 @@ class PriorityQueue(QueuePolicy):
 
     def clear(self) -> None:
         self._heap.clear()
+        self._tiebreak = itertools.count(2**33)
+        self._requeue_tiebreak = itertools.count()
+
+
+class PriorityQueue(RankedHeapPolicy):
+    """Lowest priority value first; FIFO within equal priorities.
+
+    Priority comes from ``key(item)`` if given, else ``item.priority``, else
+    the event context's ``priority`` field, else 0.
+    """
+
+    def __init__(self, key: Optional[Callable[[Any], float]] = None):
+        super().__init__()
+        self._key = key
+
+    def _priority_of(self, item: Any) -> float:
+        if self._key is not None:
+            return self._key(item)
+        priority = getattr(item, "priority", None)
+        if priority is None and isinstance(item, Event):
+            priority = item.context.get("priority")
+        return float(priority) if priority is not None else 0.0
+
+    _rank_of = _priority_of

@@ -267,3 +267,337 @@ class TestFairRequeue:
         q.requeue(e)
         assert q.pop() is d
         assert q.pop() is e
+
+    def test_wfq_pop_of_requeued_item_does_not_rewind_virtual_time(self):
+        """Popping a snapshot-requeued item must not rewind _virtual_now:
+        a rewind hands artificially early finish tags to flows pushed
+        afterward, letting them jump earlier arrivals."""
+        q = WeightedFairQueue(weights={"fast": 10.0, "slow": 1.0})
+        a, b = self._event("fast"), self._event("slow")
+        q.push(a)   # finish 0.1
+        q.push(b)   # finish 1.0
+        assert q.pop() is a
+        assert q.pop() is b     # virtual_now -> 1.0
+        q.requeue(a)            # re-enters at its snapshot 0.1
+        c = self._event("c")    # pushed BEFORE the requeued pop drains
+        q.push(c)               # finish 2.0
+        assert q.pop() is a     # must NOT rewind virtual_now to 0.1
+        d = self._event("d")    # arrives after c
+        q.push(d)               # with rewind this would get finish 1.1 < c
+        assert q.pop() is c, "later arrival jumped an earlier one"
+        assert q.pop() is d
+
+    def test_wfq_requeue_uses_snapshotted_finish_after_later_pops(self):
+        """A multi-slot driver may pop a SECOND item before requeueing the
+        first. The requeue must restore the first item's own finish tag,
+        not the later _virtual_now — otherwise it loses its place."""
+        q = WeightedFairQueue(weights={"fast": 10.0, "slow": 1.0})
+        first = self._event("fast")   # finish = 0.1
+        second = self._event("slow")  # finish = 1.0
+        q.push(first)
+        q.push(second)
+        assert q.pop() is first   # virtual_now -> 0.1
+        assert q.pop() is second  # virtual_now -> 1.0
+        q.requeue(first)          # must re-enter at 0.1, not 1.0
+        q.requeue(second)
+        assert q.pop() is first, "first lost its place to the later pop"
+        assert q.pop() is second
+
+
+class TestPriorityRequeue:
+    def _event(self, priority):
+        return Event(t(0), "req", target=_SINK, context={"priority": priority})
+
+    def test_requeue_restores_position_among_equal_priorities(self):
+        """PriorityQueue is FIFO within equal priorities; a driver requeue
+        must restore the popped item AHEAD of every equal-priority peer,
+        including ones pushed after the pop (regression: requeue fell back
+        to push(), sending the item to the back of its priority class)."""
+        from happysim_tpu.components.queue_policy import PriorityQueue
+
+        q = PriorityQueue()
+        a, b = self._event(1), self._event(1)
+        q.push(a)
+        q.push(b)
+        popped = q.pop()
+        assert popped is a
+        late = self._event(1)
+        q.push(late)  # arrives between the pop and the requeue
+        q.requeue(a)
+        assert q.pop() is a, "requeued item lost FIFO position"
+        assert q.pop() is b
+        assert q.pop() is late
+
+    def test_requeue_respects_priority_classes(self):
+        """A requeued low-priority item must not jump a higher class."""
+        from happysim_tpu.components.queue_policy import PriorityQueue
+
+        q = PriorityQueue()
+        low = self._event(5)
+        q.push(low)
+        assert q.pop() is low
+        urgent = self._event(0)
+        q.push(urgent)
+        q.requeue(low)
+        assert q.pop() is urgent
+        assert q.pop() is low
+
+    def test_multi_requeue_preserves_pop_order(self):
+        from happysim_tpu.components.queue_policy import PriorityQueue
+
+        q = PriorityQueue()
+        a, b = self._event(1), self._event(1)
+        q.push(a)
+        q.push(b)
+        assert q.pop() is a
+        assert q.pop() is b
+        q.requeue(a)
+        q.requeue(b)
+        assert q.pop() is a
+        assert q.pop() is b
+
+
+class TestRequeueAcrossPolicies:
+    """Every shipped policy must treat requeue as an exact pop undo."""
+
+    def _event(self, deadline=None):
+        metadata = {} if deadline is None else {"deadline": deadline}
+        return Event(t(0), "req", target=_SINK, context={"metadata": metadata})
+
+    def test_deadline_requeue_restores_edf_position(self):
+        q = DeadlineQueue()
+        a, b = self._event(5.0), self._event(5.0)
+        q.push(a)
+        q.push(b)
+        assert q.pop() is a
+        late = self._event(5.0)
+        q.push(late)
+        q.requeue(a)
+        assert q.pop() is a, "requeued item lost FIFO-within-deadline spot"
+        assert q.pop() is b
+        assert q.pop() is late
+        # Stats invariant: pushed == popped + depth + expired.
+        assert q.pushed == q.popped + len(q) + q.expired
+
+    def test_codel_requeue_keeps_sojourn_baseline(self):
+        clock = _FakeClock()
+        q = CoDelQueue(target_delay=0.1, interval=0.5, clock_func=clock)
+        q.push("a")
+        q.push("b")
+        popped = q.pop()
+        assert popped == "a"
+        clock.set(10.0)  # much later; a fresh push timestamp would hide the delay
+        q.requeue(popped)
+        assert q.peek() == "a", "requeue lost front position"
+        # The original t=0 enqueue time survived: CoDel sees a 10s sojourn
+        # and enters drop mode against the stale front item.
+        assert q.pop() in ("a", "b")
+        assert q.stats.dropped + q.stats.popped >= 1
+        assert q.pushed == q.popped + len(q) + q.dropped
+
+    def test_red_requeue_skips_drop_screening(self):
+        q = REDQueue(min_threshold=1, max_threshold=3, max_p=1.0, seed=7)
+        q.push("a")
+        popped = q.pop()
+        # Fill to the forced-drop region: a requeue must still be accepted.
+        q.push("b")
+        q.push("c")
+        q.push("d")
+        assert q.requeue(popped) is True
+        assert q.peek() == "a"
+        assert q.pushed == q.popped + len(q)
+
+    def test_adaptive_lifo_requeue_restores_hysteresis_state(self):
+        """A spurious pop+requeue inside the hysteresis band must not flip
+        the serving discipline: the pre-pop mode and switch count come back
+        when nothing else touched the queue in between."""
+        q = AdaptiveLIFO(congestion_threshold=4, recovery_threshold=2)
+        for x in ("a", "b", "c", "d"):
+            q.push(x)
+        assert q.mode == "lifo"
+        q.pop()  # depth 3, still lifo (hysteresis)
+        q.pop()  # depth 2 <= recovery -> flips to fifo
+        switches_before_race = q.mode_switches
+        popped = q.pop()  # depth 1, fifo (head = "a")
+        assert q.mode == "fifo"
+        q.requeue(popped)
+        assert q.mode == "fifo"
+        assert q.mode_switches == switches_before_race, (
+            "undo must not inflate mode_switches"
+        )
+        # Now the race that matters: congested pop dips into recovery, the
+        # delivery fails, requeue must restore LIFO mode.
+        q2 = AdaptiveLIFO(congestion_threshold=3, recovery_threshold=2)
+        for x in ("a", "b", "c"):
+            q2.push(x)
+        assert q2.mode == "lifo"
+        victim = q2.pop()  # depth 2 -> flips to fifo
+        q2.requeue(victim)  # exact undo: back to lifo, switch count rolled back
+        assert q2.mode == "lifo"
+        assert q2.mode_switches == 1
+
+    def test_adaptive_lifo_stale_snapshot_does_not_roll_back(self):
+        """The exact-undo branch may only fire when NOTHING touched the
+        queue since that pop: intervening ops that happen to leave the mode
+        state equal must not resurrect a stale pre-pop mode."""
+        q = AdaptiveLIFO(congestion_threshold=4, recovery_threshold=2)
+        for x in ("a", "b", "c", "d"):
+            q.push(x)  # mode -> lifo, switches = 1
+        d = q.pop()   # lifo pop, no flip
+        c = q.pop()   # flips to fifo (depth 2 <= recovery), switches = 2
+        a = q.pop()   # fifo pop, no flip — state again (fifo, 2)
+        assert (q.mode, q.mode_switches) == ("fifo", 2)
+        q.requeue(c)  # c's snapshot is STALE (a's pop intervened)
+        assert q.mode == "fifo", "stale snapshot must not flip mode back"
+        assert q.mode_switches == 2
+        # c was a lifo-mode tail pop, so it's restored to the tail; the
+        # queue serves fifo from the head.
+        assert q.pop() == "b"
+        assert q.pop() == "c"
+        del d, a
+
+    def test_hard_capacity_bound_rejects_requeue_after_refill(self):
+        """capacity=1: pop frees the slot, a same-instant push refills it —
+        the requeue must be rejected (drop), not grow past the bound."""
+        red = REDQueue(min_threshold=5, max_threshold=10, capacity=1)
+        red.push("a")
+        popped = red.pop()
+        red.push("b")  # refills the only slot
+        assert red.requeue(popped) is False
+        assert len(red) == 1
+
+        clock = _FakeClock()
+        codel = CoDelQueue(
+            target_delay=0.1, interval=0.5, capacity=1, clock_func=clock
+        )
+        codel.push("a")
+        popped = codel.pop()
+        codel.push("b")
+        assert codel.requeue(popped) is False
+        assert len(codel) == 1
+        # Reject converts the pop into a drop — one final fate per item.
+        assert codel.pushed == codel.popped + len(codel) + codel.dropped
+
+        alifo = AdaptiveLIFO(congestion_threshold=10, capacity=1)
+        alifo.push("a")
+        popped = alifo.pop()
+        alifo.push("b")
+        assert alifo.requeue(popped) is False
+        assert len(alifo) == 1
+
+    def test_same_instant_double_requeue_preserves_pop_order(self):
+        """Undoing "pop A, pop B" arrives as requeue(A), requeue(B); naive
+        front-insertion would serve B before A. Every deque policy must
+        restore pop order."""
+        from happysim_tpu.components.queue_policy import FIFOQueue, LIFOQueue
+
+        fifo = FIFOQueue()
+        for x in ("a", "b", "c"):
+            fifo.push(x)
+        a, b = fifo.pop(), fifo.pop()
+        fifo.requeue(a)
+        fifo.requeue(b)
+        assert [fifo.pop() for _ in range(3)] == ["a", "b", "c"]
+
+        lifo = LIFOQueue()
+        for x in ("x", "y", "z"):
+            lifo.push(x)
+        z, y = lifo.pop(), lifo.pop()
+        lifo.requeue(z)
+        lifo.requeue(y)
+        assert [lifo.pop() for _ in range(3)] == ["z", "y", "x"]
+
+        red = REDQueue(min_threshold=50, max_threshold=60)
+        for x in ("a", "b", "c"):
+            red.push(x)
+        a, b = red.pop(), red.pop()
+        red.requeue(a)
+        red.requeue(b)
+        assert [red.pop() for _ in range(3)] == ["a", "b", "c"]
+        assert red.pushed == red.popped + len(red)
+
+        clock = _FakeClock()
+        codel = CoDelQueue(target_delay=1.0, interval=5.0, clock_func=clock)
+        for x in ("a", "b", "c"):
+            codel.push(x)
+        a, b = codel.pop(), codel.pop()
+        codel.requeue(a)
+        codel.requeue(b)
+        assert [codel.pop() for _ in range(3)] == ["a", "b", "c"]
+
+        alifo = AdaptiveLIFO(congestion_threshold=100)
+        for x in ("a", "b", "c"):
+            alifo.push(x)
+        a, b = alifo.pop(), alifo.pop()
+        alifo.requeue(a)
+        alifo.requeue(b)
+        assert [alifo.pop() for _ in range(3)] == ["a", "b", "c"]
+
+        # LIFO-mode tail restores too: pop order z (top) then y.
+        alifo2 = AdaptiveLIFO(congestion_threshold=3)
+        for x in ("x", "y", "z"):
+            alifo2.push(x)
+        assert alifo2.mode == "lifo"
+        z, y = alifo2.pop(), alifo2.pop()
+        assert (z, y) == ("z", "y")
+        alifo2.requeue(z)
+        alifo2.requeue(y)
+        assert alifo2.pop() == "z"
+        assert alifo2.pop() == "y"
+
+    def test_fair_queue_multi_requeue_restores_lane_and_rotation(self):
+        """Same-instant requeues across flows must restore pop order within
+        each lane AND the original flow rotation order."""
+        q = FairQueue()
+
+        def ev(flow):
+            return Event(
+                t(0), "req", target=_SINK, context={"metadata": {"flow": flow}}
+            )
+
+        a1, a2, b1 = ev("fa"), ev("fa"), ev("fb")
+        q.push(a1)
+        q.push(a2)
+        q.push(b1)
+        # Round-robin pops: a1 (fa), b1 (fb), then fa again.
+        p1 = q.pop()
+        p2 = q.pop()
+        assert (p1, p2) == (a1, b1)
+        q.requeue(p1)
+        q.requeue(p2)
+        # Pop order restored: fa first (a1), then fb (b1), then a2.
+        assert q.pop() is a1
+        assert q.pop() is b1
+        assert q.pop() is a2
+
+        # Same-flow double requeue keeps lane order.
+        q2 = FairQueue()
+        c1, c2 = ev("fc"), ev("fc")
+        q2.push(c1)
+        q2.push(c2)
+        x1 = q2.pop()
+        # fc lane rotated out and back; pop again gets c2.
+        x2 = q2.pop()
+        assert (x1, x2) == (c1, c2)
+        q2.requeue(x1)
+        q2.requeue(x2)
+        assert q2.pop() is c1
+        assert q2.pop() is c2
+
+    def test_adaptive_lifo_requeue_restores_popped_end(self):
+        q = AdaptiveLIFO(congestion_threshold=100)
+        for x in ("a", "b", "c"):
+            q.push(x)
+        popped = q.pop()  # FIFO mode: from the head
+        assert popped == "a"
+        q.requeue(popped)
+        assert q.pop() == "a", "FIFO-mode requeue must restore the head"
+        # LIFO mode: pops come from the tail and must requeue to the tail.
+        q2 = AdaptiveLIFO(congestion_threshold=2)
+        q2.push("x")
+        q2.push("y")
+        assert q2.mode == "lifo"
+        popped2 = q2.pop()
+        assert popped2 == "y"
+        q2.requeue(popped2)
+        assert q2.pop() == "y"
