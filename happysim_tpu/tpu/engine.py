@@ -24,6 +24,28 @@ simultaneously:
   ``jnp.interp`` (SURVEY §2.2: the host path's Simpson+Brent inversion
   becomes a table lookup).
 
+Performance architecture (why the hot path is O(1) per event, not O(K)):
+
+- Under ``vmap``, ``lax.cond``/``lax.switch`` execute every branch
+  predicated and select each state leaf — so any LARGE array flowing
+  through them costs a full read+write per step regardless of the logical
+  update size. The per-server FIFO ring metadata ((nV, K) created/enqueue
+  arrays) is therefore kept OUT of the branch-visible state: branches read
+  it via O(1) gathers and describe at most one push per step in a tiny
+  descriptor (``_qpush``); the single write is applied OUTSIDE the
+  cond/switch as a predicated scatter (out-of-bounds index = masked-off,
+  ``mode="drop"``). ``HS_TPU_QUEUE_UPDATE=dense`` switches the write back
+  to a one-hot masked update if a backend's batched scatter misbehaves.
+- The per-step uniform vector is sized at compile time from the model
+  (draw slots for gap / route / edge latency / two service draws exist
+  only if the topology can consume them — an M/M/1 needs 3, not 8), and
+  service-time sampling only computes the distribution families actually
+  present (no erfinv unless a lognormal server exists).
+- Ensemble mode generates uniforms in chunks: one
+  ``uniform((CHUNK, n_draws))`` per outer step replaces a per-event
+  ``fold_in`` + ``uniform`` (windowed/partitioned mode keeps the per-event
+  counter-keyed stream, which must stay monotone across window reruns).
+
 Semantics parity (host twins): Source ticks + profiles (load/source.py,
 load/profile.py), Server concurrency + FIFO queue + drop-on-full
 (components/server/server.py, components/queue.py), deadline/retry
@@ -38,6 +60,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import time as _wall
 from dataclasses import dataclass
 from functools import partial
@@ -72,6 +95,25 @@ HIST_DECADES = 8.0
 
 # Rate-profile integral tables: grid resolution over [0, horizon].
 PROFILE_GRID_POINTS = 512
+
+# Events per uniform-generation chunk in ensemble mode.
+RNG_CHUNK = 32
+
+# Queue-ring write strategy: "scatter" (O(1) predicated scatter) or
+# "dense" (one-hot masked write, O(K) but scatter-free). Both are
+# numerically identical; the faster one is backend-dependent (measured:
+# dense wins on CPU where per-lane scatters serialize, scatter wins when
+# K is large). HS_TPU_QUEUE_UPDATE overrides the per-backend default.
+
+
+def _queue_update_mode() -> str:
+    mode = os.environ.get("HS_TPU_QUEUE_UPDATE")
+    if mode in ("scatter", "dense"):
+        return mode
+    try:
+        return "dense" if jax.default_backend() == "cpu" else "scatter"
+    except Exception:  # pragma: no cover - backend probing failed
+        return "scatter"
 
 
 def _hist_bin(latency):
@@ -189,6 +231,18 @@ class EnsembleResult:
 # Compilation: model spec -> single-replica init/step closures
 # ---------------------------------------------------------------------------
 
+_SERVICE_KIND_IDS = {
+    "constant": 0, "exponential": 1, "erlang": 2,
+    "hyperexp": 3, "lognormal": 4, "pareto": 5,
+}
+# Uniform draws each service family consumes (erlang resolved per-model).
+_SERVICE_DRAWS = {0: 0, 1: 1, 2: 2, 3: 2, 4: 1, 5: 1}
+
+# The queue-ring metadata arrays kept out of the branch-visible state
+# (see "Performance architecture" above); ``srv_q_attempt`` joins when the
+# model has deadline servers.
+_QRO_KEYS = ("srv_q_created", "srv_q_enq")
+
 
 class _Compiled:
     """Static arrays + closures derived from an EnsembleModel."""
@@ -209,6 +263,7 @@ class _Compiled:
         self.warmup = float(model.warmup_s)
 
         servers = model.servers
+        self.has_deadlines = any(s.deadline_s is not None for s in servers)
         self.slot_valid = np.zeros((self.nV, self.C), np.bool_)
         self.queue_cap = np.zeros((self.nV,), np.int32)
         self.srv_deadline = np.full((self.nV,), np.inf, np.float32)
@@ -216,6 +271,7 @@ class _Compiled:
         # Brownout windows: arrivals in [start, end) are dropped.
         self.srv_outage_start = np.full((self.nV,), np.inf, np.float32)
         self.srv_outage_end = np.full((self.nV,), np.inf, np.float32)
+        self.has_outages = any(s.outage_start_s is not None for s in servers)
         # Service family per server + host-precomputed shape constants.
         # Kind ids: 0 constant, 1 exponential, 2 erlang, 3 hyperexp,
         # 4 lognormal, 5 pareto (see model.SERVICE_KINDS).
@@ -227,14 +283,10 @@ class _Compiled:
         self.srv_ln_sigma = np.zeros((self.nV,), np.float32)
         self.srv_par_alpha = np.full((self.nV,), 2.5, np.float32)
         self.srv_par_xmf = np.ones((self.nV,), np.float32)
-        kind_ids = {
-            "constant": 0, "exponential": 1, "erlang": 2,
-            "hyperexp": 3, "lognormal": 4, "pareto": 5,
-        }
         for v, spec in enumerate(servers):
             self.slot_valid[v, : spec.concurrency] = True
             self.queue_cap[v] = spec.queue_capacity
-            self.service_kind[v] = kind_ids[spec.service]
+            self.service_kind[v] = _SERVICE_KIND_IDS[spec.service]
             if spec.service == "erlang":
                 self.srv_erlang_k[v] = float(spec.service_k)
             elif spec.service == "hyperexp":
@@ -259,6 +311,23 @@ class _Compiled:
             if spec.outage_start_s is not None:
                 self.srv_outage_start[v] = spec.outage_start_s
                 self.srv_outage_end[v] = spec.outage_end_s
+
+        # Families actually present decide what _sample_service computes
+        # and how many service-draw slots the uniform vector carries.
+        present = sorted(
+            {int(self.service_kind[v]) for v in range(len(servers))}
+        ) or [1]
+        self.families_present = present
+        draws_needed = dict(_SERVICE_DRAWS)
+        if 2 in present:
+            draws_needed[2] = int(
+                max(
+                    self.srv_erlang_k[v]
+                    for v in range(len(servers))
+                    if self.service_kind[v] == 2
+                )
+            )
+        self.n_svc_draws = max(draws_needed[k] for k in present)
 
         self.arrival_is_poisson = np.array(
             [s.arrival == "poisson" for s in model.sources], np.bool_
@@ -285,6 +354,7 @@ class _Compiled:
             for edge, dest in self._edges()
         )
         self._build_profile_tables()
+        self._assign_uniform_slots()
 
     def _edges(self):
         for s in self.model.sources:
@@ -306,6 +376,56 @@ class _Compiled:
             down = self.model.limiters[ref.index].downstream
             return down is not None and self._reaches_server(down)
         return False
+
+    # -- uniform-slot layout -------------------------------------------------
+    def _assign_uniform_slots(self) -> None:
+        """Compile-time map of draw slots the topology can consume.
+
+        Slots: arrival gap (any Poisson source), router choice (any
+        "random"-policy router), edge latency (any exponential edge with
+        positive mean), and two service-draw windows (a delivery arrival
+        and a completion's queue pull can both sample service in one step).
+        An M/M/1 ends up with 3 draws/step instead of a fixed 8.
+        """
+        slot = 0
+        if self.arrival_is_poisson.any():
+            self.U_GAP: Optional[int] = slot
+            slot += 1
+        else:
+            self.U_GAP = None
+        if any(r.policy == "random" for r in self.model.routers):
+            self.U_ROUTE: Optional[int] = slot
+            slot += 1
+        else:
+            self.U_ROUTE = None
+        if any(
+            e.mean_s > 0 and e.kind == "exponential" for e in _all_edges(self.model)
+        ):
+            self.U_LAT: Optional[int] = slot
+            slot += 1
+        else:
+            self.U_LAT = None
+        if self.model.servers and self.n_svc_draws > 0:
+            self.U_SVC1: Optional[int] = slot
+            slot += self.n_svc_draws
+            self.U_SVC2: Optional[int] = slot
+            slot += self.n_svc_draws
+        else:
+            self.U_SVC1 = None
+            self.U_SVC2 = None
+        self.n_draws = max(slot, 1)
+
+    def _uslot(self, u, slot: Optional[int]):
+        """Read one named draw; unallocated slots return an inert constant
+        (every consumer is compile-time gated, so the value is never used
+        in a way that affects results)."""
+        return u[slot] if slot is not None else jnp.float32(0.5)
+
+    def _usvc(self, u, base: Optional[int]):
+        """The service-draw window starting at ``base``."""
+        if base is None:
+            return u[0:0]
+        return u[base : base + self.n_svc_draws]
 
     # -- profile tables ------------------------------------------------------
     def _build_profile_tables(self) -> None:
@@ -350,10 +470,8 @@ class _Compiled:
             "src_next": gaps,
             "srv_slot_done": jnp.full((self.nV, self.C), INF),
             "srv_slot_created": jnp.zeros((self.nV, self.C), jnp.float32),
-            "srv_slot_attempt": jnp.zeros((self.nV, self.C), jnp.int32),
             "srv_q_created": jnp.zeros((self.nV, self.K), jnp.float32),
             "srv_q_enq": jnp.zeros((self.nV, self.K), jnp.float32),
-            "srv_q_attempt": jnp.zeros((self.nV, self.K), jnp.int32),
             "srv_q_head": jnp.zeros((self.nV,), jnp.int32),
             "srv_q_len": jnp.zeros((self.nV,), jnp.int32),
             "srv_dropped": jnp.zeros((self.nV,), jnp.int32),
@@ -377,11 +495,64 @@ class _Compiled:
             "sink_hist": jnp.zeros((self.nK, HIST_BINS), jnp.int32),
             "events": jnp.int32(0),
         }
+        if self.has_deadlines:
+            state["srv_slot_attempt"] = jnp.zeros((self.nV, self.C), jnp.int32)
+            state["srv_q_attempt"] = jnp.zeros((self.nV, self.K), jnp.int32)
         if self.has_transit:
             state["tr_time"] = jnp.full((self.nV, self.TR), INF)
             state["tr_created"] = jnp.zeros((self.nV, self.TR), jnp.float32)
             state["tr_dropped"] = jnp.zeros((self.nV,), jnp.int32)
         return state
+
+    def _qro_keys(self):
+        return _QRO_KEYS + (("srv_q_attempt",) if self.has_deadlines else ())
+
+    def _null_qpush(self):
+        """The per-step queue-push descriptor, initially inert."""
+        desc = {
+            "pred": jnp.bool_(False),
+            "v": jnp.int32(0),
+            "slot": jnp.int32(0),
+            "created": jnp.float32(0.0),
+            "enq": jnp.float32(0.0),
+        }
+        if self.has_deadlines:
+            desc["attempt"] = jnp.int32(0)
+        return desc
+
+    def _apply_qpush(self, qro, desc):
+        """The step's single queue-ring write, OUTSIDE all cond/switch.
+
+        A masked-off push becomes an out-of-bounds index that the scatter
+        drops, so inactive steps cost nothing beyond the index math.
+        """
+        slot = jnp.where(desc["pred"], desc["slot"], jnp.int32(self.K))
+        if _queue_update_mode() == "dense":
+            mask = self._row(desc["v"], self.nV)[:, None] & (
+                jnp.arange(self.K, dtype=jnp.int32)[None, :] == slot
+            )
+            out = {
+                "srv_q_created": jnp.where(mask, desc["created"], qro["srv_q_created"]),
+                "srv_q_enq": jnp.where(mask, desc["enq"], qro["srv_q_enq"]),
+            }
+            if self.has_deadlines:
+                out["srv_q_attempt"] = jnp.where(
+                    mask, desc["attempt"], qro["srv_q_attempt"]
+                )
+            return out
+        out = {
+            "srv_q_created": qro["srv_q_created"]
+            .at[desc["v"], slot]
+            .set(desc["created"], mode="drop"),
+            "srv_q_enq": qro["srv_q_enq"]
+            .at[desc["v"], slot]
+            .set(desc["enq"], mode="drop"),
+        }
+        if self.has_deadlines:
+            out["srv_q_attempt"] = (
+                qro["srv_q_attempt"].at[desc["v"], slot].set(desc["attempt"], mode="drop")
+            )
+        return out
 
     def _initial_gaps(self, key, params):
         u = jax.random.uniform(key, (self.nS,), minval=1e-12, maxval=1.0)
@@ -406,10 +577,9 @@ class _Compiled:
         return jnp.stack(gaps)
 
     # -- dense index helpers ------------------------------------------------
-    # TPU-idiomatic state updates: every "indexed" read/write goes through a
-    # one-hot mask + jnp.where / masked reduction instead of scatter/gather.
-    # Under vmap, scatters with per-lane indices lower to TPU scatter ops
-    # that serialize; dense masked ops stay wide elementwise and fuse.
+    # Small per-node state ((nV,), (nV, C), (nL,), (nK,)) uses one-hot
+    # masks + jnp.where — wide elementwise ops that fuse. Only the K-sized
+    # queue rings get gather/scatter treatment (see _apply_qpush).
     def _row(self, v, n: int):
         """(n,) bool one-hot row mask; v may be static or traced."""
         return jnp.arange(n, dtype=jnp.int32) == v
@@ -420,44 +590,64 @@ class _Compiled:
         return jnp.sum(jnp.where(mask, arr, jnp.zeros_like(arr)))
 
     # -- sampling ----------------------------------------------------------
-    def _sample_service(self, u3, v, params):
-        """Draw one service time for server ``v`` from its static family.
+    def _sample_service(self, u_svc, v, params):
+        """Draw one service time for server ``v``.
 
-        ``u3`` is a (3,) uniform slice — Erlang-3 is the hungriest family.
-        All six families are computed and masked by the compile-time kind
-        id (one-hot math, no data-dependent control flow); XLA folds the
-        unused branches when every server shares a family.
+        ``u_svc`` is the (n_svc_draws,) service window of the step's
+        uniform vector. Only the families PRESENT in the model are
+        computed (compile-time pruning: an all-exponential model does one
+        log, not an erfinv + power + three logs), masked by the kind id
+        when more than one family coexists.
         """
-        ua, ub, uc = u3[0], u3[1], u3[2]
         row = self._row(v, self.nV)
         mean = self._pick(params["srv_mean"], row)
-        kind = self._pick(jnp.asarray(self.service_kind), row).astype(jnp.int32)
+        present = self.families_present
+        ua = u_svc[0] if self.n_svc_draws >= 1 else None
+        ub = u_svc[1] if self.n_svc_draws >= 2 else None
+        uc = u_svc[2] if self.n_svc_draws >= 3 else None
 
-        exp_draw = -jnp.log(ua) * mean
-        erlang_k = self._pick(jnp.asarray(self.srv_erlang_k), row)
-        erlang_draw = jnp.where(
-            erlang_k == 2.0,
-            -jnp.log(ua * ub) * mean * 0.5,
-            -jnp.log(ua * ub * uc) * mean / 3.0,
-        )
-        p1 = self._pick(jnp.asarray(self.srv_hyp_p1), row)
-        hyp_factor = jnp.where(
-            ua < p1,
-            self._pick(jnp.asarray(self.srv_hyp_f1), row),
-            self._pick(jnp.asarray(self.srv_hyp_f2), row),
-        )
-        hyp_draw = -jnp.log(ub) * mean * hyp_factor
-        sigma = self._pick(jnp.asarray(self.srv_ln_sigma), row)
-        z = jnp.sqrt(2.0) * jax.scipy.special.erfinv(2.0 * ua - 1.0)
-        ln_draw = mean * jnp.exp(sigma * z - 0.5 * sigma * sigma)
-        alpha = self._pick(jnp.asarray(self.srv_par_alpha), row)
-        par_draw = mean * self._pick(jnp.asarray(self.srv_par_xmf), row) * jnp.power(
-            ua, -1.0 / alpha
-        )
+        draws = {}
+        if 0 in present:
+            draws[0] = mean
+        if 1 in present:
+            draws[1] = -jnp.log(ua) * mean
+        if 2 in present:
+            if self.n_svc_draws >= 3:
+                erlang_k = self._pick(jnp.asarray(self.srv_erlang_k), row)
+                draws[2] = jnp.where(
+                    erlang_k == 2.0,
+                    -jnp.log(ua * ub) * mean * 0.5,
+                    -jnp.log(ua * ub * uc) * mean / 3.0,
+                )
+            else:
+                draws[2] = -jnp.log(ua * ub) * mean * 0.5
+        if 3 in present:
+            p1 = self._pick(jnp.asarray(self.srv_hyp_p1), row)
+            hyp_factor = jnp.where(
+                ua < p1,
+                self._pick(jnp.asarray(self.srv_hyp_f1), row),
+                self._pick(jnp.asarray(self.srv_hyp_f2), row),
+            )
+            draws[3] = -jnp.log(ub) * mean * hyp_factor
+        if 4 in present:
+            sigma = self._pick(jnp.asarray(self.srv_ln_sigma), row)
+            z = jnp.sqrt(2.0) * jax.scipy.special.erfinv(2.0 * ua - 1.0)
+            draws[4] = mean * jnp.exp(sigma * z - 0.5 * sigma * sigma)
+        if 5 in present:
+            alpha = self._pick(jnp.asarray(self.srv_par_alpha), row)
+            draws[5] = (
+                mean
+                * self._pick(jnp.asarray(self.srv_par_xmf), row)
+                * jnp.power(ua, -1.0 / alpha)
+            )
+
+        if len(present) == 1:
+            return draws[present[0]]
+        kind = self._pick(jnp.asarray(self.service_kind), row).astype(jnp.int32)
         return jnp.select(
-            [kind == 0, kind == 1, kind == 2, kind == 3, kind == 4],
-            [mean, exp_draw, erlang_draw, hyp_draw, ln_draw],
-            par_draw,
+            [kind == k for k in present[:-1]],
+            [draws[k] for k in present[:-1]],
+            draws[present[-1]],
         )
 
     def _profile_cum_at(self, i: int, t):
@@ -478,51 +668,52 @@ class _Compiled:
         t_next = jnp.where(target <= cum[-1], inside, beyond)
         return jnp.maximum(t_next - t, 1e-9)
 
-    def _sample_gap(self, u, i: int, t, params):
+    def _sample_gap(self, u_gap, i: int, t, params):
         if self.has_profile[i]:
             increment = jnp.where(
-                self.arrival_is_poisson[i], -jnp.log(u), jnp.float32(1.0)
+                self.arrival_is_poisson[i], -jnp.log(u_gap), jnp.float32(1.0)
             )
             return self._invert_profile(i, t, increment)
         rate = params["src_rate"][i]
         if self.arrival_is_poisson[i]:
-            return -jnp.log(u) / rate
+            return -jnp.log(u_gap) / rate
         return 1.0 / rate
 
     @staticmethod
-    def _sample_edge(edge: EdgeLatency, u):
+    def _sample_edge(edge: EdgeLatency, u_lat):
         """Latency draw for a static edge (0 when the edge is free)."""
         if edge.mean_s <= 0:
             return jnp.float32(0.0)
         if edge.kind == "exponential":
-            return -jnp.log(u) * edge.mean_s
+            return -jnp.log(u_lat) * edge.mean_s
         return jnp.float32(edge.mean_s)
 
     # -- job delivery ------------------------------------------------------
     def _deliver(self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params):
         """Deliver a job leaving some node at time t across ``edge``.
 
-        ``u`` is a (5,) uniform window: [route, latency, svc_a, svc_b,
-        svc_c] — three service draws so Erlang/hyperexponential families
-        have independent uniforms.
+        ``u`` is the step's full uniform vector; the named slots
+        (U_ROUTE / U_LAT / U_SVC1) are read as needed.
         """
         if dest.kind == LIMITER:
             return self._through_limiter(state, t, created, u, dest.index, params)
         if dest.kind == SINK:
-            latency = self._sample_edge(edge, u[1])
+            latency = self._sample_edge(edge, self._uslot(u, self.U_LAT))
             return self._deliver_sink(state, t + latency, created, dest.index)
         if dest.kind == SERVER:
             if edge.mean_s > 0:
-                latency = self._sample_edge(edge, u[1])
+                latency = self._sample_edge(edge, self._uslot(u, self.U_LAT))
                 return self._into_transit(state, dest.index, t + latency, created)
-            return self._arrive_server(state, dest.index, t, created, 0, u[2:5], params)
+            return self._arrive_server(
+                state, dest.index, t, created, 0, self._usvc(u, self.U_SVC1), params
+            )
         # Router: one dynamic hop to a homogeneous target set. Edges INTO a
         # router are latency-free by construction (model.connect rejects
         # them); only the per-target edge below carries latency.
         router = self.model.routers[dest.index]
         target_kinds = {ref.kind for ref in router.targets}
         indices = jnp.asarray([ref.index for ref in router.targets], jnp.int32)
-        choice = self._route_choice(state, u[0], dest.index, router, indices)
+        choice = self._route_choice(state, u, dest.index, router, indices)
         state = self._bump_rr(state, dest.index, router)
         lat_means = np.asarray(
             [e.mean_s for e in router.target_latencies], np.float32
@@ -532,18 +723,31 @@ class _Compiled:
         )
         # indices/lat arrays are compile-time constants: static gathers.
         chosen_mean = jnp.asarray(lat_means)[choice]
-        chosen_exp = jnp.asarray(lat_exp)[choice]
-        latency = jnp.where(
-            chosen_mean > 0,
-            jnp.where(chosen_exp, -jnp.log(u[1]) * chosen_mean, chosen_mean),
-            0.0,
-        )
+        if lat_exp.any():
+            chosen_exp = jnp.asarray(lat_exp)[choice]
+            latency = jnp.where(
+                chosen_mean > 0,
+                jnp.where(
+                    chosen_exp,
+                    -jnp.log(self._uslot(u, self.U_LAT)) * chosen_mean,
+                    chosen_mean,
+                ),
+                0.0,
+            )
+        else:
+            latency = jnp.where(chosen_mean > 0, chosen_mean, 0.0)
         if target_kinds == {SINK}:
             return self._deliver_sink(state, t + latency, created, indices[choice])
         if lat_means.any():
             return self._into_transit(state, indices[choice], t + latency, created)
         return self._arrive_server(
-            state, indices[choice], t, created, 0, u[2:5], params
+            state,
+            indices[choice],
+            t,
+            created,
+            0,
+            self._usvc(u, self.U_SVC1),
+            params,
         )
 
     def _through_limiter(self, state, t, created, u, l: int, params):
@@ -570,17 +774,20 @@ class _Compiled:
             state, t, created, u, limiter.downstream, limiter.latency, params
         )
         # Rejected jobs vanish: keep the admission bookkeeping, drop the
-        # delivery's effects.
+        # delivery's effects. (Big queue arrays aren't in this state — the
+        # delivery's push lives in the _qpush descriptor, selected here.)
         return jax.tree_util.tree_map(
             lambda on_admit, on_drop: jnp.where(admit, on_admit, on_drop),
             delivered,
             state,
         )
 
-    def _route_choice(self, state, u_route, router_index, router, indices):
+    def _route_choice(self, state, u, router_index, router, indices):
         n = len(router.targets)
         if router.policy == "random":
-            return jnp.minimum((u_route * n).astype(jnp.int32), n - 1)
+            return jnp.minimum(
+                (self._uslot(u, self.U_ROUTE) * n).astype(jnp.int32), n - 1
+            )
         if router.policy == "round_robin":
             return jnp.mod(state["rr_next"][router_index], n)
         # least_outstanding: in-service + queued per candidate server.
@@ -644,7 +851,7 @@ class _Compiled:
             + row.astype(jnp.int32) * (~has_free).astype(jnp.int32),
         }
 
-    def _arrive_server(self, state, v, t, created, attempt, u3, params):
+    def _arrive_server(self, state, v, t, created, attempt, u_svc, params):
         row = self._row(v, self.nV)  # (nV,)
         row_i = row.astype(jnp.int32)
         row_f = row.astype(jnp.float32)
@@ -658,41 +865,50 @@ class _Compiled:
             free
             & (jnp.arange(self.C, dtype=jnp.int32)[None, :] == first_free_col[:, None])
         )
-        service = self._sample_service(u3, v, params)
+        service = self._sample_service(u_svc, v, params)
 
         # Brownout: a job arriving inside the outage window is lost
         # outright — no slot, no queue (host analogue: a PauseNode'd
         # upstream relay dropping deliveries).
-        out_start = self._pick(jnp.asarray(self.srv_outage_start), row)
-        out_end = self._pick(jnp.asarray(self.srv_outage_end), row)
-        dark = (t >= out_start) & (t < out_end)
+        if self.has_outages:
+            out_start = self._pick(jnp.asarray(self.srv_outage_start), row)
+            out_end = self._pick(jnp.asarray(self.srv_outage_end), row)
+            dark = (t >= out_start) & (t < out_end)
+        else:
+            dark = jnp.bool_(False)
         admit_free = has_free & ~dark
         slot_mask = slot_mask & ~dark
 
         q_len = self._pick(state["srv_q_len"], row)
         cap = self._pick(jnp.asarray(self.queue_cap), row)
         has_room = q_len < cap
-        tail = jnp.mod(self._pick(state["srv_q_head"], row) + q_len, self.K)
+        tail = jnp.mod(
+            self._pick(state["srv_q_head"], row).astype(jnp.int32)
+            + q_len.astype(jnp.int32),
+            self.K,
+        )
 
         enq = (~dark) & (~has_free) & has_room
         # Disjoint loss counters (like srv_timed_out): an in-window loss is
         # ONLY srv_outage_dropped — the host twin's server never sees those
         # arrivals, so its queue-full drop counter must not either.
         drop = (~dark) & (~has_free) & (~has_room)
-        q_mask = (
-            row[:, None]
-            & (jnp.arange(self.K, dtype=jnp.int32)[None, :] == tail)
-            & enq
-        )
 
         measure = t >= jnp.float32(self.warmup)
-        return {
+        desc = {
+            "pred": enq,
+            "v": jnp.int32(v) + jnp.int32(0),
+            "slot": tail,
+            "created": created + jnp.float32(0.0),
+            "enq": t + jnp.float32(0.0),
+        }
+        if self.has_deadlines:
+            desc["attempt"] = jnp.int32(attempt) + jnp.int32(0)
+        out = {
             **state,
+            "_qpush": desc,
             "srv_slot_done": jnp.where(slot_mask, t + service, done),
             "srv_slot_created": jnp.where(slot_mask, created, state["srv_slot_created"]),
-            "srv_slot_attempt": jnp.where(
-                slot_mask, attempt, state["srv_slot_attempt"]
-            ),
             "srv_started": state["srv_started"] + row_i * admit_free.astype(jnp.int32),
             # Zero-wait start: counts toward E[Wq] (the analytic rho/(mu-lam)
             # averages over non-waiters too), contributes 0 to the sum.
@@ -700,14 +916,16 @@ class _Compiled:
             + row_i * (admit_free & measure).astype(jnp.int32),
             "srv_busy_int": state["srv_busy_int"]
             + row_f * jnp.where(admit_free & measure, service, 0.0),
-            "srv_q_created": jnp.where(q_mask, created, state["srv_q_created"]),
-            "srv_q_enq": jnp.where(q_mask, t, state["srv_q_enq"]),
-            "srv_q_attempt": jnp.where(q_mask, attempt, state["srv_q_attempt"]),
             "srv_q_len": state["srv_q_len"] + row_i * enq.astype(jnp.int32),
             "srv_dropped": state["srv_dropped"] + row_i * drop.astype(jnp.int32),
             "srv_outage_dropped": state["srv_outage_dropped"]
             + row_i * dark.astype(jnp.int32),
         }
+        if self.has_deadlines:
+            out["srv_slot_attempt"] = jnp.where(
+                slot_mask, attempt, state["srv_slot_attempt"]
+            )
+        return out
 
     def _enqueue_retry(self, state, v: int, t, created, attempt):
         """Tail re-enqueue of a deadline-expired job (attempt already +1)."""
@@ -716,17 +934,22 @@ class _Compiled:
         q_len = self._pick(state["srv_q_len"], row)
         cap = jnp.float32(self.queue_cap[v])
         has_room = q_len < cap
-        tail = jnp.mod(self._pick(state["srv_q_head"], row) + q_len, self.K)
-        q_mask = (
-            row[:, None]
-            & (jnp.arange(self.K, dtype=jnp.int32)[None, :] == tail)
-            & has_room
+        tail = jnp.mod(
+            self._pick(state["srv_q_head"], row).astype(jnp.int32)
+            + q_len.astype(jnp.int32),
+            self.K,
         )
+        desc = {
+            "pred": has_room,
+            "v": jnp.int32(v),
+            "slot": tail,
+            "created": created + jnp.float32(0.0),
+            "enq": t + jnp.float32(0.0),
+            "attempt": jnp.int32(attempt) + jnp.int32(0),
+        }
         return {
             **state,
-            "srv_q_created": jnp.where(q_mask, created, state["srv_q_created"]),
-            "srv_q_enq": jnp.where(q_mask, t, state["srv_q_enq"]),
-            "srv_q_attempt": jnp.where(q_mask, attempt, state["srv_q_attempt"]),
+            "_qpush": desc,
             "srv_q_len": state["srv_q_len"] + row_i * has_room.astype(jnp.int32),
             "srv_retried": state["srv_retried"] + row_i * has_room.astype(jnp.int32),
             # A retry that found the queue full is a drop.
@@ -734,9 +957,25 @@ class _Compiled:
             + row_i * (~has_room).astype(jnp.int32),
         }
 
+    def _read_queue_head(self, state, qro, v: int, head):
+        """O(1) gather of the head item's metadata, forwarding a same-step
+        push when the branch's own delivery just enqueued at ``head``
+        (deferred writes land after the switch, so the array is stale)."""
+        desc = state["_qpush"]
+        from_push = desc["pred"] & (desc["v"] == v) & (desc["slot"] == head)
+        created = jnp.where(from_push, desc["created"], qro["srv_q_created"][v, head])
+        enq = jnp.where(from_push, desc["enq"], qro["srv_q_enq"][v, head])
+        if self.has_deadlines:
+            attempt = jnp.where(
+                from_push, desc["attempt"], qro["srv_q_attempt"][v, head]
+            ).astype(jnp.int32)
+        else:
+            attempt = jnp.int32(0)
+        return created, enq, attempt
+
     # -- event branches ----------------------------------------------------
-    def _fire_source(self, i: int, state, t, u, params):
-        gap = self._sample_gap(u[0], i, t, params)
+    def _fire_source(self, i: int, state, qro, t, u, params):
+        gap = self._sample_gap(self._uslot(u, self.U_GAP), i, t, params)
         next_time = t + gap
         stopped = next_time > jnp.float32(self.stop_after[i])
         state = {
@@ -745,10 +984,10 @@ class _Compiled:
         }
         source = self.model.sources[i]
         return self._deliver(
-            state, t, t, u[1:6], source.downstream, source.latency, params
+            state, t, t, u, source.downstream, source.latency, params
         )
 
-    def _complete_server(self, v: int, state, t, u, params):
+    def _complete_server(self, v: int, state, qro, t, u, params):
         row = self._row(v, self.nV)
         row_i = row.astype(jnp.int32)
         slot_valid = jnp.asarray(self.slot_valid)
@@ -760,7 +999,10 @@ class _Compiled:
         col_mask = jnp.arange(self.C, dtype=jnp.int32)[None, :] == k  # (1, C)
         slot_mask = row[:, None] & col_mask  # (nV, C)
         created = self._pick(state["srv_slot_created"], slot_mask)
-        attempt = self._pick(state["srv_slot_attempt"], slot_mask).astype(jnp.int32)
+        if self.has_deadlines:
+            attempt = self._pick(state["srv_slot_attempt"], slot_mask).astype(jnp.int32)
+        else:
+            attempt = jnp.int32(0)
         state = {
             **state,
             "srv_slot_done": jnp.where(slot_mask, INF, state["srv_slot_done"]),
@@ -781,7 +1023,7 @@ class _Compiled:
             }
             retried_state = self._enqueue_retry(state, v, t, created, attempt + 1)
             forwarded_state = self._deliver(
-                state, t, created, u[0:5], spec.downstream, spec.latency, params
+                state, t, created, u, spec.downstream, spec.latency, params
             )
             state = jax.tree_util.tree_map(
                 lambda retry_leaf, fwd_leaf, base_leaf: jnp.where(
@@ -795,7 +1037,7 @@ class _Compiled:
             )
         else:
             state = self._deliver(
-                state, t, created, u[0:5], spec.downstream, spec.latency, params
+                state, t, created, u, spec.downstream, spec.latency, params
             )
         # Pull the next queued job into the freed slot (FIFO). A same-server
         # feedback delivery above may have re-claimed slot k, so only pull if
@@ -803,27 +1045,20 @@ class _Compiled:
         q_len = self._pick(state["srv_q_len"], row)
         slot_still_free = jnp.any(jnp.isinf(state["srv_slot_done"]) & slot_mask)
         has_queued = (q_len > 0) & slot_still_free
-        head = self._pick(state["srv_q_head"], row)
-        head_mask = (
-            row[:, None]
-            & (jnp.arange(self.K, dtype=jnp.int32)[None, :] == head)
-        )  # (nV, K)
-        queued_created = self._pick(state["srv_q_created"], head_mask)
-        queued_enq = self._pick(state["srv_q_enq"], head_mask)
-        queued_attempt = self._pick(state["srv_q_attempt"], head_mask).astype(jnp.int32)
-        service = self._sample_service(u[5:8], v, params)
+        head = self._pick(state["srv_q_head"], row).astype(jnp.int32)
+        queued_created, queued_enq, queued_attempt = self._read_queue_head(
+            state, qro, v, head
+        )
+        service = self._sample_service(self._usvc(u, self.U_SVC2), v, params)
         pull_mask = slot_mask & has_queued
         row_pull = row_i * has_queued.astype(jnp.int32)
         measure = t >= jnp.float32(self.warmup)
         measured_pull = has_queued & measure
-        return {
+        out = {
             **state,
             "srv_slot_done": jnp.where(pull_mask, t + service, state["srv_slot_done"]),
             "srv_slot_created": jnp.where(
                 pull_mask, queued_created, state["srv_slot_created"]
-            ),
-            "srv_slot_attempt": jnp.where(
-                pull_mask, queued_attempt, state["srv_slot_attempt"]
             ),
             "srv_q_head": jnp.where(
                 row & has_queued, jnp.mod(head + 1, self.K), state["srv_q_head"]
@@ -837,8 +1072,13 @@ class _Compiled:
             "srv_wait_n": state["srv_wait_n"]
             + row_i * measured_pull.astype(jnp.int32),
         }
+        if self.has_deadlines:
+            out["srv_slot_attempt"] = jnp.where(
+                pull_mask, queued_attempt, state["srv_slot_attempt"]
+            )
+        return out
 
-    def _transit_arrive(self, v: int, state, t, u, params):
+    def _transit_arrive(self, v: int, state, qro, t, u, params):
         """A job finished crossing a latency edge: hand it to server v."""
         row = self._row(v, self.nV)
         times_masked = jnp.where(row[:, None], state["tr_time"], INF)
@@ -851,7 +1091,9 @@ class _Compiled:
             **state,
             "tr_time": jnp.where(slot_mask, INF, state["tr_time"]),
         }
-        return self._arrive_server(state, v, t, created, 0, u[1:4], params)
+        return self._arrive_server(
+            state, v, t, created, 0, self._usvc(u, self.U_SVC1), params
+        )
 
     # -- the step ----------------------------------------------------------
     def next_candidates(self, state):
@@ -869,12 +1111,19 @@ class _Compiled:
                 parts.append(jnp.min(state["tr_time"], axis=1)[:nV_real])
         return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
-    def make_step(self, horizon: Optional[float] = None, windowed: bool = False):
+    def make_step(
+        self,
+        horizon: Optional[float] = None,
+        windowed: bool = False,
+        external_u: bool = False,
+    ):
         """The one-event scan step.
 
         ``windowed=False`` (ensemble mode): static ``horizon``, carry is
         (state, params). ``windowed=True`` (partitioned mode): the horizon
         is the traced window end carried as (state, params, window_end).
+        ``external_u=True``: the scan xs supply the per-step uniform row
+        (chunked generation); otherwise draws are counter-keyed per event.
         """
         nS = self.nS
         nV_real = len(self.model.servers)
@@ -888,41 +1137,56 @@ class _Compiled:
                 else []
             )
         )
+        qro_keys = self._qro_keys()
 
-        def step(carry, step_index):
+        def step(carry, x):
             if windowed:
                 state, params, limit = carry
             else:
                 state, params = carry
                 limit = horizon
-            candidates = self.next_candidates(state)
+            qro = {k: state[k] for k in qro_keys}
+            small = {k: v for k, v in state.items() if k not in qro_keys}
+            small["_qpush"] = self._null_qpush()
+
+            candidates = self.next_candidates(small)
             event_index = jnp.argmin(candidates)
             t_next = candidates[event_index]
             done = jnp.isinf(t_next) | (t_next > limit)
 
-            # One RNG draw per step, shared by whichever branch runs (under
-            # vmap all branches execute predicated, so hoisting halves the
-            # threefry work versus drawing inside each branch). Keyed on
-            # the MONOTONE event counter so windowed reruns of the scan
-            # never replay a stream (the per-window scan index restarts).
-            step_key = jax.random.fold_in(state["key"], state["events"])
-            u = jax.random.uniform(step_key, (8,), minval=1e-12, maxval=1.0)
+            if external_u:
+                u = x
+            else:
+                # One RNG draw per step, shared by whichever branch runs
+                # (under vmap all branches execute predicated, so hoisting
+                # halves the threefry work versus drawing inside each
+                # branch). Keyed on the MONOTONE event counter so windowed
+                # reruns of the scan never replay a stream (the per-window
+                # scan index restarts).
+                step_key = jax.random.fold_in(small["key"], small["events"])
+                u = jax.random.uniform(
+                    step_key, (self.n_draws,), minval=1e-12, maxval=1.0
+                )
 
-            def process(state):
+            def process(s):
                 # Only the post-warmup portion of the interval counts toward
                 # the depth integral (handles intervals straddling the cutoff).
                 warmup = jnp.float32(self.warmup)
-                dt = jnp.maximum(t_next - jnp.maximum(state["t"], warmup), 0.0)
-                state = {
-                    **state,
-                    "srv_depth_int": state["srv_depth_int"]
-                    + state["srv_q_len"].astype(jnp.float32) * dt,
+                dt = jnp.maximum(t_next - jnp.maximum(s["t"], warmup), 0.0)
+                s = {
+                    **s,
+                    "srv_depth_int": s["srv_depth_int"]
+                    + s["srv_q_len"].astype(jnp.float32) * dt,
                     "t": t_next,
-                    "events": state["events"] + 1,
+                    "events": s["events"] + 1,
                 }
-                return lax.switch(event_index, branches, state, t_next, u, params)
+                return lax.switch(event_index, branches, s, qro, t_next, u, params)
 
-            state = lax.cond(done, lambda s: s, process, state)
+            small = lax.cond(done, lambda s: s, process, small)
+            # The step's one queue-ring write, outside the cond/switch so
+            # the (nV, K) arrays never flow through per-leaf selects.
+            desc = small.pop("_qpush")
+            state = {**small, **self._apply_qpush(qro, desc)}
             return ((state, params, limit) if windowed else (state, params)), None
 
         return step
@@ -1071,17 +1335,37 @@ def run_ensemble(
     )
 
     horizon = float(model.horizon_s)
-    step = compiled.make_step(horizon)
+    step = compiled.make_step(horizon, external_u=True)
+    n_chunks = -(-max_events // RNG_CHUNK)
 
     @jax.jit
     def run(keys, params):
         def one_replica(key, p):
             state = compiled.init_state(key, p)
+
+            def chunk_body(carry, c):
+                # One batched uniform per chunk instead of a per-event
+                # fold_in + draw (threefry amortization; the chunk index
+                # keeps lane streams deterministic and layout-independent).
+                chunk_key = jax.random.fold_in(key, c)
+                U = jax.random.uniform(
+                    chunk_key,
+                    (RNG_CHUNK, compiled.n_draws),
+                    minval=1e-12,
+                    maxval=1.0,
+                )
+                carry, _ = lax.scan(
+                    step,
+                    carry,
+                    U,
+                    unroll=2,  # measured best on v5e (2: +24%, 4: regression)
+                )
+                return carry, None
+
             (state, _), _ = lax.scan(
-                step,
+                chunk_body,
                 (state, p),
-                jnp.arange(max_events, dtype=jnp.uint32),
-                unroll=2,  # measured best on v5e (2: +24%, 4: regression)
+                jnp.arange(n_chunks, dtype=jnp.uint32),
             )
             return state
 

@@ -517,8 +517,13 @@ def pipeline_model(
 
 
 def mm1_model(lam: float = 8.0, mu: float = 10.0, horizon_s: float = 60.0,
-              queue_capacity: int = 512, warmup_s: float = 0.0) -> EnsembleModel:
-    """The canonical M/M/1 as a general-engine model (oracle workload)."""
+              queue_capacity: int = 256, warmup_s: float = 0.0) -> EnsembleModel:
+    """The canonical M/M/1 as a general-engine model (oracle workload).
+
+    ``queue_capacity=256`` is effectively infinite for any stable load
+    (P(Q >= 256) < 1e-6 even at rho = 0.95), while keeping the ring
+    metadata small; raise it for rho -> 1 studies.
+    """
     model = EnsembleModel(horizon_s=horizon_s, warmup_s=warmup_s)
     src = model.source(rate=lam, kind="poisson")
     srv = model.server(concurrency=1, service_mean=1.0 / mu, queue_capacity=queue_capacity)

@@ -120,7 +120,9 @@ class _PartitionCompiled(_Compiled):
         latency is the RemoteSpec's — its router edge must be free).
         """
         n = len(router.targets)
-        choice = jnp.minimum((u[0] * n).astype(jnp.int32), n - 1)
+        choice = jnp.minimum(
+            (self._uslot(u, self.U_ROUTE) * n).astype(jnp.int32), n - 1
+        )
         is_remote = jnp.asarray(
             [target.kind == REMOTE for target in router.targets]
         )[choice]
@@ -135,14 +137,21 @@ class _PartitionCompiled(_Compiled):
         lat_mean = jnp.asarray(
             [e.mean_s for e in router.target_latencies], jnp.float32
         )[choice]
-        lat_exp = jnp.asarray(
-            [e.kind == "exponential" for e in router.target_latencies]
-        )[choice]
-        sink_latency = jnp.where(
-            lat_mean > 0,
-            jnp.where(lat_exp, -jnp.log(u[1]) * lat_mean, lat_mean),
-            0.0,
-        )
+        if any(e.kind == "exponential" for e in router.target_latencies):
+            lat_exp = jnp.asarray(
+                [e.kind == "exponential" for e in router.target_latencies]
+            )[choice]
+            sink_latency = jnp.where(
+                lat_mean > 0,
+                jnp.where(
+                    lat_exp,
+                    -jnp.log(self._uslot(u, self.U_LAT)) * lat_mean,
+                    lat_mean,
+                ),
+                0.0,
+            )
+        else:
+            sink_latency = jnp.where(lat_mean > 0, lat_mean, 0.0)
         went_remote = self._into_outbox(state, remote_index, t, created)
         went_local = self._deliver_sink(state, t + sink_latency, created, sink_index)
         return jax.tree_util.tree_map(
