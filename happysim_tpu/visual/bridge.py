@@ -96,6 +96,11 @@ class SimulationBridge:
         sim.control.on_event(self._on_event)
         self._log_handler = _BridgeLogHandler(self)
         logging.getLogger("happysim_tpu").addHandler(self._log_handler)
+        self._playing = False
+        self._play_thread: Optional[threading.Thread] = None
+        self._play_gen = 0
+        self._play_lock = threading.Lock()
+        self.closed = False
 
     def close(self) -> None:
         """Detach everything: log handler, event hook, code debugger.
@@ -103,6 +108,8 @@ class SimulationBridge:
         Leaves the simulation on its fast loop again — a closed bridge
         must not keep taxing (or observing) the run.
         """
+        self.closed = True  # ends any live SSE streams' poll loops
+        self.pause_play()
         logging.getLogger("happysim_tpu").removeHandler(self._log_handler)
         self.sim.control.remove_on_event(self._on_event)
         if getattr(self.sim, "_code_debugger", None) is self.code_debugger:
@@ -184,6 +191,53 @@ class SimulationBridge:
             return None
         location = get_entity_source(entity)
         return location.to_dict() if location else None
+
+    # -- live play loop ----------------------------------------------------
+    # Parity: the reference's WebSocket play loop
+    # (/root/reference/happysimulator/visual/server.py:129-216) steps the
+    # simulation continuously while streaming state; here a daemon thread
+    # steps in batches and the SSE stream carries the updates.
+    def play(self, events_per_tick: int = 50, interval_s: float = 0.05) -> dict:
+        with self._play_lock:
+            if self._playing:
+                return {"playing": True}
+            self._playing = True
+            # Generation token: a stale loop thread (pause released the
+            # lock before its join finished) must neither keep stepping nor
+            # clear the flag of a NEWER loop on its way out.
+            self._play_gen += 1
+            generation = self._play_gen
+            self._play_thread = threading.Thread(
+                target=self._play_loop,
+                args=(generation, events_per_tick, interval_s),
+                daemon=True,
+            )
+            self._play_thread.start()
+        return {"playing": True}
+
+    def pause_play(self) -> dict:
+        with self._play_lock:
+            self._playing = False
+            thread = self._play_thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)
+        return {"playing": False}
+
+    @property
+    def is_playing(self) -> bool:
+        return self._playing
+
+    def _play_loop(self, generation: int, events_per_tick: int, interval_s: float) -> None:
+        import time
+
+        while self._playing and self._play_gen == generation:
+            state = self.step(events_per_tick)
+            if state.get("is_completed") or state.get("pending_events") == 0:
+                break
+            time.sleep(interval_s)
+        with self._play_lock:
+            if self._play_gen == generation:
+                self._playing = False
 
     # -- control verbs -----------------------------------------------------
     def step(self, n: int = 1) -> dict[str, Any]:

@@ -140,6 +140,10 @@ class CodeDebugger:
     def deactivate_entity(self, name: str) -> None:
         self._active.pop(name, None)
 
+    def active_entities(self) -> list[str]:
+        """Names with code tracing engaged (sorted, for stable payloads)."""
+        return sorted(self._active.keys())
+
     def add_breakpoint(self, entity_name: str, line_number: int) -> CodeBreakpoint:
         breakpoint_ = CodeBreakpoint(entity_name=entity_name, line_number=line_number)
         self._breakpoints.append(breakpoint_)

@@ -16,14 +16,20 @@ Endpoints:
   POST /api/reset                    rewind (sources re-primed)
   GET  /api/events?since=N           recorded events after seq N
   GET  /api/logs?limit=N             captured library logs
-  GET  /api/poll?since=N             {state, events, logs, traces}
+  GET  /api/poll?since=N             {state, events, logs, traces, code}
+  GET  /api/stream?since=N           Server-Sent Events: the /api/poll
+                                     payload pushed every ~200ms (the live
+                                     play loop; replaces client polling)
+  POST /api/play?n=K                 background play loop (K events/tick)
+  POST /api/pause                    stop the play loop
   GET  /api/timeseries/{entity}      entity state history
   GET  /api/chart_data               chart payloads
   GET  /api/entity/{name}/source     handler source for the code panel
   POST /api/debug/code/activate      {"entity": name}
+  POST /api/debug/code/deactivate    {"entity": name}
   POST /api/debug/code/breakpoint    {"entity": name, "line": N}
   DELETE /api/debug/code/breakpoint  {"id": breakpoint id}
-  GET  /api/debug/code/state         {paused_at, breakpoints}
+  GET  /api/debug/code/state         {paused_at, breakpoints, active}
   POST /api/debug/code/continue      {"step": bool}
 """
 
@@ -78,6 +84,53 @@ def _make_handler(bridge: SimulationBridge):
             else:
                 self._send(result)
 
+        # -- shared payloads -----------------------------------------------
+        def _code_state(self) -> dict:
+            debugger = bridge.code_debugger
+            return {
+                "paused_at": debugger.paused_at,
+                "breakpoints": [b.to_dict() for b in debugger.breakpoints],
+                "active": debugger.active_entities(),
+            }
+
+        def _poll_payload(self, since: int) -> dict:
+            return {
+                "state": {**bridge.state(), "is_playing": bridge.is_playing},
+                "events": bridge.events(since),
+                "logs": bridge.logs(50),
+                "traces": [
+                    t.to_dict() for t in bridge.code_debugger.drain_traces()
+                ],
+                "code": self._code_state(),
+            }
+
+        def _stream(self, query: dict) -> None:
+            """Server-Sent Events: push the poll payload every ~200ms.
+
+            The reference's WebSocket play/debug loop equivalent — one
+            long-lived response per client; a broken pipe (client gone)
+            ends the stream. Works alongside the polling fallback.
+            """
+            import time
+
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.end_headers()
+            since = int(query.get("since", 0))
+            try:
+                while not bridge.closed:
+                    payload = self._poll_payload(since)
+                    for event in payload["events"]:
+                        since = max(since, event.get("seq", since))
+                    body = json.dumps(payload, default=str)
+                    self.wfile.write(f"data: {body}\n\n".encode())
+                    self.wfile.flush()
+                    time.sleep(0.2)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return
+
         # -- routing -------------------------------------------------------
         def _dispatch(self, method: str, path: str, query: dict) -> Optional[Any]:
             if method == "GET":
@@ -92,15 +145,7 @@ def _make_handler(bridge: SimulationBridge):
                 if path == "/api/logs":
                     return {"logs": bridge.logs(int(query.get("limit", 200)))}
                 if path == "/api/poll":
-                    since = int(query.get("since", 0))
-                    return {
-                        "state": bridge.state(),
-                        "events": bridge.events(since),
-                        "logs": bridge.logs(50),
-                        "traces": [
-                            t.to_dict() for t in bridge.code_debugger.drain_traces()
-                        ],
-                    }
+                    return self._poll_payload(int(query.get("since", 0)))
                 if path == "/api/chart_data":
                     return {"charts": bridge.chart_data()}
                 if path.startswith("/api/timeseries/"):
@@ -111,15 +156,15 @@ def _make_handler(bridge: SimulationBridge):
                     source = bridge.entity_source(entity)
                     return source or {"error": "no source", "entity": entity}
                 if path == "/api/debug/code/state":
-                    debugger = bridge.code_debugger
-                    return {
-                        "paused_at": debugger.paused_at,
-                        "breakpoints": [b.to_dict() for b in debugger.breakpoints],
-                    }
+                    return self._code_state()
                 return None
             if method == "POST":
                 if path == "/api/step":
                     return bridge.step(int(query.get("n", 1)))
+                if path == "/api/play":
+                    return bridge.play(events_per_tick=int(query.get("n", 50)))
+                if path == "/api/pause":
+                    return bridge.pause_play()
                 if path == "/api/run_to":
                     return bridge.run_to(float(query["t"]))
                 if path == "/api/run":
@@ -133,6 +178,11 @@ def _make_handler(bridge: SimulationBridge):
                         return {"error": "unknown entity"}
                     location = bridge.code_debugger.activate_entity(entity)
                     return location.to_dict() if location else {"error": "no source"}
+                if path == "/api/debug/code/deactivate":
+                    bridge.code_debugger.deactivate_entity(
+                        self._body().get("entity", "")
+                    )
+                    return {"ok": True}
                 if path == "/api/debug/code/breakpoint":
                     body = self._body()
                     breakpoint_ = bridge.code_debugger.add_breakpoint(
@@ -151,7 +201,12 @@ def _make_handler(bridge: SimulationBridge):
             return None
 
         def do_GET(self):
-            path = urlparse(self.path).path
+            parsed = urlparse(self.path)
+            path = parsed.path
+            if path == "/api/stream":
+                query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                self._stream(query)
+                return
             if path in ("/", "/index.html"):
                 page = _STATIC_DIR / "index.html"
                 if page.exists():

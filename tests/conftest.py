@@ -25,6 +25,33 @@ except Exception:  # pragma: no cover - jax-less environments
     pass
 
 
+# Files whose tests compile XLA programs (minutes each on the CPU backend).
+# Auto-marked `tpu` so `-m "not tpu"` is the fast (<60s) developer loop;
+# `tests/unit` stays unmarked and runs in seconds.
+_TPU_TEST_FILES = {
+    "test_tpu_engine.py",
+    "test_tpu_mg1.py",
+    "test_tpu_mm1.py",
+    "test_tpu_widened.py",
+    "test_tpu_outage.py",
+    "test_tpu_partitioned.py",
+    "test_tpu_opinion.py",
+    "test_analysis_tpu.py",
+    "test_mm1_queue.py",
+}
+# Long host-side suites (examples execute end-to-end, some on the TPU path).
+_SLOW_TEST_FILES = {"test_examples.py"}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = item.path.name if hasattr(item, "path") else item.fspath.basename
+        if name in _TPU_TEST_FILES:
+            item.add_marker(pytest.mark.tpu)
+        elif name in _SLOW_TEST_FILES:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture
 def test_output_dir(tmp_path):
     return tmp_path

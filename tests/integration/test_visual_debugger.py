@@ -224,6 +224,128 @@ class TestCodeStepping:
         bridge.close()
 
 
+class TestLiveDebugWorkflow:
+    """The shipped UX: activate -> breakpoint -> pause -> step -> continue,
+    driven entirely over HTTP, plus the SSE live stream and play loop."""
+
+    def test_activate_breakpoint_step_over_http(self):
+        sim, *_ = build_sim(duration=2.0)
+        with DebugServer(sim, port=0) as server:
+            base = server.url
+            # Activate the entity's code panel: the response is the code
+            # contract the page renders (source lines + start line).
+            location = post_json(
+                f"{base}/api/debug/code/activate", {"entity": "srv"}
+            )
+            assert location["entity_name"] == "srv"
+            assert location["source_lines"] and location["start_line"] > 0
+
+            breakpoint_ = post_json(
+                f"{base}/api/debug/code/breakpoint",
+                {"entity": "srv", "line": location["start_line"] + 1},
+            )
+            assert breakpoint_["line_number"] == location["start_line"] + 1
+
+            state = get(f"{base}/api/debug/code/state")
+            assert state["active"] == ["srv"]
+            assert [b["id"] for b in state["breakpoints"]] == [breakpoint_["id"]]
+
+            # Run in the background; the sim must pause AT the breakpoint.
+            runner = threading.Thread(
+                target=lambda: post(f"{base}/api/run"), daemon=True
+            )
+            runner.start()
+            paused = _wait_for(
+                lambda: get(f"{base}/api/debug/code/state")["paused_at"]
+            )
+            assert paused["entity_name"] == "srv"
+            assert paused["line_number"] == breakpoint_["line_number"]
+            assert "locals" in paused
+
+            # Single line step: still paused, but one line further along.
+            post_json(f"{base}/api/debug/code/continue", {"step": True})
+            stepped = _wait_for(
+                lambda: (
+                    (p := get(f"{base}/api/debug/code/state")["paused_at"])
+                    and p["line_number"] != paused["line_number"]
+                    and p
+                )
+            )
+            assert stepped["line_number"] > paused["line_number"]
+
+            # Remove the breakpoint and continue: the run completes.
+            request(
+                f"{base}/api/debug/code/breakpoint",
+                method="DELETE",
+                body={"id": breakpoint_["id"]},
+            )
+            post_json(f"{base}/api/debug/code/continue", {"step": False})
+            runner.join(timeout=30)
+            assert not runner.is_alive()
+            post_json(f"{base}/api/debug/code/deactivate", {"entity": "srv"})
+            assert get(f"{base}/api/debug/code/state")["active"] == []
+
+    def test_sse_stream_carries_poll_payload(self):
+        sim, *_ = build_sim(duration=1.0)
+        with DebugServer(sim, port=0) as server:
+            post(f"{server.url}/api/step?n=10")
+            with urllib.request.urlopen(
+                f"{server.url}/api/stream?since=0", timeout=10
+            ) as stream:
+                assert stream.headers["Content-Type"].startswith(
+                    "text/event-stream"
+                )
+                frames = []
+                while len(frames) < 2:
+                    line = stream.readline().decode()
+                    if line.startswith("data: "):
+                        frames.append(json.loads(line[len("data: "):]))
+            for frame in frames:
+                assert {"state", "events", "logs", "traces", "code"} <= set(frame)
+                assert "is_playing" in frame["state"]
+                assert {"paused_at", "breakpoints", "active"} <= set(frame["code"])
+            # The first frame carries the stepped events; seq advances.
+            assert frames[0]["events"], "stream must deliver buffered events"
+
+    def test_play_pause_loop(self):
+        sim, *_ = build_sim(duration=5.0)
+        with DebugServer(sim, port=0) as server:
+            base = server.url
+            assert post(f"{base}/api/play?n=10")["playing"] is True
+            _wait_for(
+                lambda: get(f"{base}/api/state")["events_processed"] > 20 or None
+            )
+            assert post(f"{base}/api/pause")["playing"] is False
+            frozen = get(f"{base}/api/state")["events_processed"]
+            threading.Event().wait(0.2)
+            assert get(f"{base}/api/state")["events_processed"] == frozen, (
+                "pause must stop the play loop"
+            )
+
+
+def _wait_for(probe, attempts=200, interval=0.02):
+    for _ in range(attempts):
+        value = probe()
+        if value:
+            return value
+        threading.Event().wait(interval)
+    raise AssertionError("condition not reached")
+
+
+def post_json(url, body):
+    return request(url, method="POST", body=body)
+
+
+def request(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as response:
+        return json.loads(response.read())
+
+
 class TestStaticFrontend:
     def test_index_served_and_wired_to_api(self):
         sim, *_ = build_sim()
@@ -236,10 +358,16 @@ class TestStaticFrontend:
             for endpoint in (
                 "/api/poll", "/api/topology", "/api/chart_data",
                 "/api/step", "/api/run_to", "/api/reset", "/api/timeseries/",
+                "/api/stream", "/api/play", "/api/pause",
+                "/api/debug/code/activate", "/api/debug/code/breakpoint",
+                "/api/debug/code/continue", "/api/debug/code/deactivate",
             ):
                 assert endpoint in html, f"frontend lost its {endpoint} wiring"
             for element in ("btn-step", "btn-run", "btn-reset", "topo-box",
-                            "log-body", "inspector-body", "charts"):
+                            "log-body", "inspector-body", "charts",
+                            "btn-play", "btn-pause", "btn-continue",
+                            "btn-step-line", "code-box", "code-locals",
+                            "paused-banner"):
                 assert f'id="{element}"' in html or f'$(`{element}' in html
 
             # The control flow the buttons trigger works over live HTTP.
