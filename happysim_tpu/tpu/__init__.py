@@ -14,17 +14,24 @@ from happysim_tpu.tpu.mesh import (
     replica_sharding,
     replicated_sharding,
 )
-from happysim_tpu.tpu.engine import EnsembleResult, hist_percentile, run_ensemble
+from happysim_tpu.tpu.engine import (
+    EnsembleCheckpoint,
+    EnsembleResult,
+    hist_percentile,
+    run_ensemble,
+)
 from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
 from happysim_tpu.tpu.model import EnsembleModel, mm1_model, pipeline_model
 from happysim_tpu.tpu.partitioned import (
     PARTITION_AXIS,
+    PartitionedCheckpoint,
     PartitionedResult,
     partition_mesh,
     run_partitioned,
 )
 
 __all__ = [
+    "EnsembleCheckpoint",
     "EnsembleModel",
     "EnsembleResult",
     "MM1Result",
@@ -35,6 +42,7 @@ __all__ = [
     "run_mm1_ensemble",
     "run_partitioned",
     "PARTITION_AXIS",
+    "PartitionedCheckpoint",
     "PartitionedResult",
     "partition_mesh",
     "REPLICA_AXIS",

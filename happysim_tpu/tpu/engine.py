@@ -136,6 +136,113 @@ def hist_percentile(hist: np.ndarray, q: float) -> float:
     return float(10 ** (HIST_LO_LOG10 + frac * HIST_DECADES))
 
 
+def _npz_path(path: str) -> str:
+    """np.savez appends '.npz' to suffix-less paths; normalize so
+    save(p) followed by load(p) always round-trips."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_checkpoint_npz(path: str, meta: dict, state: dict) -> None:
+    """Shared on-disk checkpoint format: one npz with a JSON meta blob
+    plus 'state__'-prefixed arrays (used by both executors' checkpoints —
+    keep readers and writers in ONE place)."""
+    import json
+
+    np.savez(
+        _npz_path(path),
+        __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        **{f"state__{k}": v for k, v in state.items()},
+    )
+
+
+def load_checkpoint_npz(path: str) -> tuple[dict, dict]:
+    import json
+
+    with np.load(_npz_path(path)) as archive:
+        meta = json.loads(archive["__meta__"].tobytes().decode())
+        state = {
+            k[len("state__"):]: archive[k]
+            for k in archive.files
+            if k.startswith("state__")
+        }
+    return meta, state
+
+
+def model_fingerprint(model: EnsembleModel) -> str:
+    """Stable digest of everything the compiled step bakes in at trace
+    time (topology, horizons, service families...). Resume validates it:
+    a checkpoint's state under a DIFFERENT compiled step would produce
+    plausible but wrong statistics with no shape error to catch it."""
+    import hashlib
+
+    spec = repr(
+        (
+            model.horizon_s,
+            model.warmup_s,
+            model.transit_capacity,
+            model.sources,
+            model.servers,
+            model.routers,
+            model.limiters,
+            len(model.sinks),
+            model.remotes,
+        )
+    )
+    return hashlib.sha256(spec.encode()).hexdigest()[:16]
+
+
+def params_fingerprint(params: dict) -> str:
+    """Digest of the RESOLVED per-replica parameter arrays (broadcast
+    rates/means including any sweeps). A checkpoint resumed under
+    different sweep values would mix two parameterizations mid-run with
+    no shape error — the fingerprint catches it."""
+    import hashlib
+
+    digest = hashlib.sha256()
+    for name in sorted(params):
+        digest.update(name.encode())
+        digest.update(np.ascontiguousarray(np.asarray(params[name])).tobytes())
+    return digest.hexdigest()[:16]
+
+
+@dataclass
+class EnsembleCheckpoint:
+    """A resumable snapshot of an ensemble run (SURVEY §5.4's capability
+    upgrade over the reference: the scan carry IS the simulation state, a
+    pytree of arrays, so checkpointing is a device->host fetch).
+
+    Resuming with the same model/replicas/seed reproduces the
+    uninterrupted run bit-for-bit: per-replica RNG streams are keyed by
+    absolute chunk index, which the snapshot records.
+    """
+
+    chunk_index: int  # chunks fully executed
+    n_chunks: int
+    n_replicas: int
+    seed: int
+    max_events: int
+    state: dict  # replica-major np arrays (the vmapped scan carry)
+    model_fingerprint: str = ""
+    params_fingerprint: str = ""  # resolved sweeps (src_rate/srv_mean)
+
+    def save(self, path: str) -> None:
+        meta = {
+            "chunk_index": self.chunk_index,
+            "n_chunks": self.n_chunks,
+            "n_replicas": self.n_replicas,
+            "seed": self.seed,
+            "max_events": self.max_events,
+            "model_fingerprint": self.model_fingerprint,
+            "params_fingerprint": self.params_fingerprint,
+        }
+        save_checkpoint_npz(path, meta, self.state)
+
+    @classmethod
+    def load(cls, path: str) -> "EnsembleCheckpoint":
+        meta, state = load_checkpoint_npz(path)
+        return cls(state=state, **meta)
+
+
 @dataclass
 class EnsembleResult:
     """Aggregated ensemble statistics (cross-replica sums/means)."""
@@ -1270,6 +1377,137 @@ def _all_edges(model: EnsembleModel):
         yield from r.target_latencies
 
 
+# Target segment count for the checkpointing path (granularity of the
+# wall-clock checkpoint trigger; each boundary is a host sync point).
+CHECKPOINT_SEGMENTS = 32
+
+
+def _run_ensemble_segmented(
+    compiled,
+    replica_chunks,
+    reduce_final,
+    keys,
+    params,
+    sharding,
+    *,
+    n_chunks: int,
+    n_replicas: int,
+    seed: int,
+    max_events: int,
+    checkpoint_every_s: Optional[float],
+    checkpoint_callback,
+    resume_from: Optional[EnsembleCheckpoint],
+):
+    """The checkpointing execution path: the chunk scan split into
+    segments with a host sync (and optional carry snapshot) between them.
+    Chunk indices are absolute, so segmentation does not perturb RNG
+    streams — results are bit-identical to the single-scan path."""
+    fingerprint = model_fingerprint(compiled.model)
+    p_fingerprint = params_fingerprint(params)
+    if resume_from is not None:
+        mismatches = {
+            "n_replicas": (resume_from.n_replicas, n_replicas),
+            "seed": (resume_from.seed, seed),
+            "max_events": (resume_from.max_events, max_events),
+            "n_chunks": (resume_from.n_chunks, n_chunks),
+            "model_fingerprint": (resume_from.model_fingerprint, fingerprint),
+            "params_fingerprint": (resume_from.params_fingerprint, p_fingerprint),
+        }
+        bad = {k: v for k, v in mismatches.items() if v[0] != v[1]}
+        if bad:
+            raise ValueError(
+                f"resume_from does not match this run: {bad} "
+                "(checkpoint value vs requested value)"
+            )
+
+    seg_chunks = max(1, -(-n_chunks // CHECKPOINT_SEGMENTS))
+
+    # Pin every state leaf to the replica sharding on BOTH sides of each
+    # segment: AOT-compiled calls reject sharding mismatches, and without
+    # the pin XLA's propagation may mark untouched leaves replicated on
+    # the init output while the runner emits them replica-sharded.
+    init_all = jax.jit(
+        lambda keys, params: jax.vmap(compiled.init_state)(keys, params),
+        out_shardings=sharding,
+    )
+
+    def make_seg_runner(n: int):
+        def run_seg(state, keys, params, offset):
+            return jax.vmap(
+                lambda key, s, p: replica_chunks(key, s, p, offset, n)
+            )(keys, state, params)
+
+        return jax.jit(
+            run_seg,
+            in_shardings=(sharding, sharding, sharding, None),
+            out_shardings=sharding,
+        )
+
+    # Prepare state and AOT-compile every segment shape BEFORE the timer,
+    # mirroring the non-checkpoint path (whose timed region is pure
+    # execution) so events_per_second stays comparable between paths.
+    if resume_from is not None:
+        state = {
+            k: jax.device_put(jnp.asarray(v), sharding)
+            for k, v in resume_from.state.items()
+        }
+        chunk_done = resume_from.chunk_index
+    else:
+        state = init_all(keys, params)
+        chunk_done = 0
+
+    offset0 = jnp.uint32(0)
+    runners = {
+        seg_chunks: make_seg_runner(seg_chunks)
+        .lower(state, keys, params, offset0)
+        .compile()
+    }
+    rem = n_chunks % seg_chunks
+    if rem:
+        runners[rem] = (
+            make_seg_runner(rem).lower(state, keys, params, offset0).compile()
+        )
+    reduce_jit = (
+        jax.jit(reduce_final, in_shardings=(sharding,)).lower(state).compile()
+    )
+
+    start = _wall.perf_counter()
+    last_snapshot = _wall.perf_counter()
+    while chunk_done < n_chunks:
+        n_seg = min(seg_chunks, n_chunks - chunk_done)
+        if n_seg not in runners:  # unaligned resume point
+            runners[n_seg] = (
+                make_seg_runner(n_seg).lower(state, keys, params, offset0).compile()
+            )
+        state = runners[n_seg](state, keys, params, jnp.uint32(chunk_done))
+        chunk_done += n_seg
+        # A callback without an interval means "snapshot every segment".
+        every = (
+            checkpoint_every_s
+            if checkpoint_every_s is not None
+            else (0.0 if checkpoint_callback is not None else None)
+        )
+        due = every is not None and _wall.perf_counter() - last_snapshot >= every
+        if checkpoint_callback is not None and due and chunk_done < n_chunks:
+            snapshot = EnsembleCheckpoint(
+                chunk_index=chunk_done,
+                n_chunks=n_chunks,
+                n_replicas=n_replicas,
+                seed=seed,
+                max_events=max_events,
+                state={k: np.asarray(v) for k, v in state.items()},
+                model_fingerprint=fingerprint,
+                params_fingerprint=p_fingerprint,
+            )
+            checkpoint_callback(snapshot)
+            last_snapshot = _wall.perf_counter()
+
+    reduced = reduce_jit(state)
+    events_total = int(reduced["events"])
+    wall = _wall.perf_counter() - start
+    return reduced, events_total, wall
+
+
 def run_ensemble(
     model: EnsembleModel,
     n_replicas: int = 8192,
@@ -1277,6 +1515,9 @@ def run_ensemble(
     mesh: Optional[Mesh] = None,
     max_events: Optional[int] = None,
     sweeps: Optional[dict[str, np.ndarray]] = None,
+    checkpoint_every_s: Optional[float] = None,
+    checkpoint_callback=None,
+    resume_from: Optional[EnsembleCheckpoint] = None,
 ) -> EnsembleResult:
     """Execute the model for ``n_replicas`` Monte-Carlo lanes on the mesh.
 
@@ -1286,6 +1527,14 @@ def run_ensemble(
     This is the compiled equivalent of the reference's run_sweep grid.
     (Sweeping a profiled source's rate is not supported: its table is
     baked at compile time.)
+
+    Checkpoint/resume: ``checkpoint_every_s`` (wall seconds; 0 = every
+    segment) snapshots the scan carry at chunk boundaries and hands each
+    :class:`EnsembleCheckpoint` to ``checkpoint_callback``. Passing one
+    back as ``resume_from`` (same model/replicas/seed/max_events)
+    continues the run and reproduces the uninterrupted result
+    bit-for-bit. The checkpointing path runs the scan in segments, so
+    ``wall_seconds`` includes the snapshot fetches.
     """
     compiled = _Compiled(model)
     if mesh is None:
@@ -1338,38 +1587,36 @@ def run_ensemble(
     step = compiled.make_step(horizon, external_u=True)
     n_chunks = -(-max_events // RNG_CHUNK)
 
-    @jax.jit
-    def run(keys, params):
-        def one_replica(key, p):
-            state = compiled.init_state(key, p)
+    def replica_chunks(key, state, p, offset, n: int):
+        """Advance one replica by ``n`` chunks from absolute chunk
+        ``offset``. One batched uniform per chunk instead of a per-event
+        fold_in + draw (threefry amortization); keying on the ABSOLUTE
+        index keeps streams identical across segmentation/resume."""
 
-            def chunk_body(carry, c):
-                # One batched uniform per chunk instead of a per-event
-                # fold_in + draw (threefry amortization; the chunk index
-                # keeps lane streams deterministic and layout-independent).
-                chunk_key = jax.random.fold_in(key, c)
-                U = jax.random.uniform(
-                    chunk_key,
-                    (RNG_CHUNK, compiled.n_draws),
-                    minval=1e-12,
-                    maxval=1.0,
-                )
-                carry, _ = lax.scan(
-                    step,
-                    carry,
-                    U,
-                    unroll=2,  # measured best on v5e (2: +24%, 4: regression)
-                )
-                return carry, None
-
-            (state, _), _ = lax.scan(
-                chunk_body,
-                (state, p),
-                jnp.arange(n_chunks, dtype=jnp.uint32),
+        def chunk_body(carry, c):
+            chunk_key = jax.random.fold_in(key, c)
+            U = jax.random.uniform(
+                chunk_key,
+                (RNG_CHUNK, compiled.n_draws),
+                minval=1e-12,
+                maxval=1.0,
             )
-            return state
+            carry, _ = lax.scan(
+                step,
+                carry,
+                U,
+                unroll=2,  # measured best on v5e (2: +24%, 4: regression)
+            )
+            return carry, None
 
-        final = jax.vmap(one_replica)(keys, params)
+        (state, _), _ = lax.scan(
+            chunk_body,
+            (state, p),
+            jnp.arange(n, dtype=jnp.uint32) + offset,
+        )
+        return state
+
+    def reduce_final(final):
         # A replica is truncated if the event budget ran out while it still
         # had work scheduled before the horizon (the engine is
         # work-conserving, so pending work always surfaces in src_next, an
@@ -1405,13 +1652,45 @@ def run_ensemble(
             reduced["tr_dropped"] = jnp.sum(final["tr_dropped"], axis=0)
         return reduced
 
-    # AOT-compile so the timed region is pure execution (and the ensemble
-    # only runs once; a device->host fetch is the completion barrier).
-    compiled_fn = run.lower(keys, params).compile()
-    start = _wall.perf_counter()
-    reduced = compiled_fn(keys, params)
-    events_total = int(reduced["events"])
-    wall = _wall.perf_counter() - start
+    checkpointing = (
+        checkpoint_every_s is not None
+        or checkpoint_callback is not None
+        or resume_from is not None
+    )
+    if not checkpointing:
+
+        @jax.jit
+        def run(keys, params):
+            def one_replica(key, p):
+                state = compiled.init_state(key, p)
+                return replica_chunks(key, state, p, jnp.uint32(0), n_chunks)
+
+            return reduce_final(jax.vmap(one_replica)(keys, params))
+
+        # AOT-compile so the timed region is pure execution (and the
+        # ensemble only runs once; a device->host fetch is the completion
+        # barrier).
+        compiled_fn = run.lower(keys, params).compile()
+        start = _wall.perf_counter()
+        reduced = compiled_fn(keys, params)
+        events_total = int(reduced["events"])
+        wall = _wall.perf_counter() - start
+    else:
+        reduced, events_total, wall = _run_ensemble_segmented(
+            compiled,
+            replica_chunks,
+            reduce_final,
+            keys,
+            params,
+            sharding,
+            n_chunks=n_chunks,
+            n_replicas=n_replicas,
+            seed=seed,
+            max_events=max_events,
+            checkpoint_every_s=checkpoint_every_s,
+            checkpoint_callback=checkpoint_callback,
+            resume_from=resume_from,
+        )
 
     truncated = int(reduced["truncated"])
     if truncated:

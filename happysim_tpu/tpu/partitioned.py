@@ -39,7 +39,13 @@ try:  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from happysim_tpu.tpu.engine import INF, _Compiled
+from happysim_tpu.tpu.engine import (
+    INF,
+    _Compiled,
+    load_checkpoint_npz,
+    model_fingerprint,
+    save_checkpoint_npz,
+)
 from happysim_tpu.tpu.model import REMOTE, ROUTER, SINK, EnsembleModel, NodeRef
 
 PARTITION_AXIS = "partitions"
@@ -50,6 +56,43 @@ def partition_mesh(devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+
+
+@dataclass
+class PartitionedCheckpoint:
+    """A resumable snapshot of a partitioned run, taken at a window
+    barrier (outboxes are empty there — the exchange already merged).
+    Same bit-for-bit resume contract as :class:`EnsembleCheckpoint`:
+    window indices are absolute and the per-event RNG stream is keyed by
+    the carried event counter."""
+
+    window_index: int  # windows fully executed (including their barrier)
+    n_windows: int
+    n_partitions: int
+    n_replicas: int
+    seed: int
+    state: dict  # partition-major np arrays (P, R, ...)
+    model_fingerprint: str = ""
+    window_s: float = 0.0
+    max_events_per_window: int = 0
+
+    def save(self, path: str) -> None:
+        meta = {
+            "window_index": self.window_index,
+            "n_windows": self.n_windows,
+            "n_partitions": self.n_partitions,
+            "n_replicas": self.n_replicas,
+            "seed": self.seed,
+            "model_fingerprint": self.model_fingerprint,
+            "window_s": self.window_s,
+            "max_events_per_window": self.max_events_per_window,
+        }
+        save_checkpoint_npz(path, meta, self.state)
+
+    @classmethod
+    def load(cls, path: str) -> "PartitionedCheckpoint":
+        meta, state = load_checkpoint_npz(path)
+        return cls(state=state, **meta)
 
 
 @dataclass
@@ -199,6 +242,122 @@ class _PartitionCompiled(_Compiled):
         return lax.fori_loop(0, self.OB, insert_one, state)
 
 
+def _run_partitioned_segmented(
+    keys,
+    params,
+    sharded,
+    shard_map_compat,
+    param_specs,
+    init_replica,
+    run_windows_replica,
+    *,
+    n_windows: int,
+    n_partitions: int,
+    n_replicas: int,
+    seed: int,
+    fingerprint: str,
+    window_s: float,
+    max_events_per_window: int,
+    checkpoint_every_windows: Optional[int],
+    checkpoint_callback,
+    resume_from: Optional[PartitionedCheckpoint],
+):
+    """Checkpointing path: the window scan split into segments of
+    ``checkpoint_every_windows`` windows with a host sync (and snapshot)
+    at each boundary. Window indices are absolute, so segmentation does
+    not perturb barrier times or RNG streams."""
+    if resume_from is not None:
+        mismatches = {
+            "n_partitions": (resume_from.n_partitions, n_partitions),
+            "n_replicas": (resume_from.n_replicas, n_replicas),
+            "seed": (resume_from.seed, seed),
+            "n_windows": (resume_from.n_windows, n_windows),
+            "model_fingerprint": (resume_from.model_fingerprint, fingerprint),
+            "window_s": (resume_from.window_s, window_s),
+            "max_events_per_window": (
+                resume_from.max_events_per_window,
+                max_events_per_window,
+            ),
+        }
+        bad = {k: v for k, v in mismatches.items() if v[0] != v[1]}
+        if bad:
+            raise ValueError(
+                f"resume_from does not match this run: {bad} "
+                "(checkpoint value vs requested value)"
+            )
+    seg = checkpoint_every_windows or max(1, n_windows // 8)
+
+    def spmd_init(keys, params):
+        keys = keys[0]
+        params = {k: v[0] for k, v in params.items()}
+        state = jax.vmap(init_replica)(keys, params)
+        return jax.tree_util.tree_map(lambda x: x[None], state)
+
+    def make_seg(n: int):
+        def spmd_seg(state, params, w_offset):
+            state = jax.tree_util.tree_map(lambda x: x[0], state)
+            params = {k: v[0] for k, v in params.items()}
+            state = jax.vmap(
+                lambda s, p: run_windows_replica(s, p, w_offset, n)
+            )(state, params)
+            return jax.tree_util.tree_map(lambda x: x[None], state)
+
+        return jax.jit(
+            shard_map_compat(
+                spmd_seg, (P(PARTITION_AXIS), param_specs, P())
+            )
+        )
+
+    init = jax.jit(shard_map_compat(spmd_init, (P(PARTITION_AXIS), param_specs)))
+
+    # Prepare state and AOT-compile every segment shape BEFORE the timer
+    # (the non-checkpoint path's timed region is pure execution; keep
+    # events_per_second comparable).
+    if resume_from is not None:
+        state = {
+            k: jax.device_put(jnp.asarray(v), sharded)
+            for k, v in resume_from.state.items()
+        }
+        windows_done = resume_from.window_index
+    else:
+        state = init(keys, params)
+        windows_done = 0
+
+    offset0 = jnp.int32(0)
+    runners = {seg: make_seg(seg).lower(state, params, offset0).compile()}
+    rem = n_windows % seg
+    if rem:
+        runners[rem] = make_seg(rem).lower(state, params, offset0).compile()
+
+    start = _wall.perf_counter()
+    while windows_done < n_windows:
+        n_seg = min(seg, n_windows - windows_done)
+        if n_seg not in runners:  # unaligned resume point
+            runners[n_seg] = (
+                make_seg(n_seg).lower(state, params, offset0).compile()
+            )
+        state = runners[n_seg](state, params, jnp.int32(windows_done))
+        windows_done += n_seg
+        if checkpoint_callback is not None and windows_done < n_windows:
+            checkpoint_callback(
+                PartitionedCheckpoint(
+                    window_index=windows_done,
+                    n_windows=n_windows,
+                    n_partitions=n_partitions,
+                    n_replicas=n_replicas,
+                    seed=seed,
+                    state={k: np.asarray(v) for k, v in state.items()},
+                    model_fingerprint=fingerprint,
+                    window_s=window_s,
+                    max_events_per_window=max_events_per_window,
+                )
+            )
+
+    events_total = int(jnp.sum(state["events"]))
+    wall = _wall.perf_counter() - start
+    return state, events_total, wall
+
+
 def run_partitioned(
     model: EnsembleModel,
     window_s: float,
@@ -207,6 +366,9 @@ def run_partitioned(
     seed: int = 0,
     max_events_per_window: Optional[int] = None,
     outbox_capacity: int = 128,
+    checkpoint_every_windows: Optional[int] = None,
+    checkpoint_callback=None,
+    resume_from: Optional[PartitionedCheckpoint] = None,
 ) -> PartitionedResult:
     """Execute ``model`` as one entity-sharded simulation per replica lane.
 
@@ -215,6 +377,13 @@ def run_partitioned(
     ring. ``window_s`` must not exceed the minimum remote latency (the
     conservative-window contract); each barrier rotates outboxes with
     ``lax.ppermute`` over the mesh axis.
+
+    Checkpoint/resume: ``checkpoint_every_windows`` snapshots the sharded
+    state every K window barriers and hands each
+    :class:`PartitionedCheckpoint` to ``checkpoint_callback``; resuming
+    with the same model/mesh/replicas/seed reproduces the uninterrupted
+    run bit-for-bit (window indices are absolute; outboxes are empty at
+    every barrier, so no in-flight exchange is lost).
     """
     if not model.remotes:
         raise ValueError("run_partitioned needs at least one model.remote(...)")
@@ -242,55 +411,62 @@ def run_partitioned(
     window_step = compiled.make_step(windowed=True)
     ring = [(i, (i + 1) % n_partitions) for i in range(n_partitions)]
 
-    def one_partition_replica(key, params):
+    def one_window(carry, w):
+        state, params = carry
+        truncated_windows = state.pop("truncated_windows")
+        window_end = (w.astype(jnp.float32) + 1.0) * jnp.float32(window_s)
+        (state, _, _), _ = lax.scan(
+            window_step,
+            (state, params, window_end),
+            jnp.arange(max_events_per_window, dtype=jnp.uint32),
+        )
+        # Budget-exhaustion detection: work still pending before the
+        # barrier means the window was truncated and statistics (and
+        # the t=window_end alignment below) are suspect.
+        pending = jnp.min(compiled.next_candidates(state))
+        truncated_windows = truncated_windows + (
+            pending <= window_end
+        ).astype(jnp.int32)
+        # BARRIER: rotate outboxes one step around the partition ring.
+        inbox_arrival = lax.ppermute(state["ob_arrival"], PARTITION_AXIS, ring)
+        inbox_created = lax.ppermute(state["ob_created"], PARTITION_AXIS, ring)
+        inbox_ingress = lax.ppermute(state["ob_ingress"], PARTITION_AXIS, ring)
+        inbox_len = lax.ppermute(state["ob_len"], PARTITION_AXIS, ring)
+        # Close the window's depth-integral accounting (no events may
+        # have fired between the last event and the barrier) and align
+        # local time to the barrier: merged jobs arrive >= window_end
+        # by the latency contract, so the next window processes them.
+        warmup = jnp.float32(compiled.warmup)
+        gap = jnp.maximum(window_end - jnp.maximum(state["t"], warmup), 0.0)
+        state = {
+            **state,
+            "srv_depth_int": state["srv_depth_int"]
+            + state["srv_q_len"].astype(jnp.float32) * gap,
+            "ob_arrival": jnp.full((compiled.OB,), INF),
+            "ob_created": jnp.zeros((compiled.OB,), jnp.float32),
+            "ob_ingress": jnp.zeros((compiled.OB,), jnp.int32),
+            "ob_len": jnp.int32(0),
+            "t": jnp.maximum(state["t"], window_end),
+        }
+        state = compiled.merge_inbox(
+            state, inbox_arrival, inbox_created, inbox_ingress, inbox_len
+        )
+        state["truncated_windows"] = truncated_windows
+        return (state, params), None
+
+    def init_replica(key, params):
         state = compiled.init_state(key, params)
         state["truncated_windows"] = jnp.int32(0)
+        return state
 
-        def one_window(carry, w):
-            state, params = carry
-            truncated_windows = state.pop("truncated_windows")
-            window_end = (w.astype(jnp.float32) + 1.0) * jnp.float32(window_s)
-            (state, _, _), _ = lax.scan(
-                window_step,
-                (state, params, window_end),
-                jnp.arange(max_events_per_window, dtype=jnp.uint32),
-            )
-            # Budget-exhaustion detection: work still pending before the
-            # barrier means the window was truncated and statistics (and
-            # the t=window_end alignment below) are suspect.
-            pending = jnp.min(compiled.next_candidates(state))
-            truncated_windows = truncated_windows + (
-                pending <= window_end
-            ).astype(jnp.int32)
-            # BARRIER: rotate outboxes one step around the partition ring.
-            inbox_arrival = lax.ppermute(state["ob_arrival"], PARTITION_AXIS, ring)
-            inbox_created = lax.ppermute(state["ob_created"], PARTITION_AXIS, ring)
-            inbox_ingress = lax.ppermute(state["ob_ingress"], PARTITION_AXIS, ring)
-            inbox_len = lax.ppermute(state["ob_len"], PARTITION_AXIS, ring)
-            # Close the window's depth-integral accounting (no events may
-            # have fired between the last event and the barrier) and align
-            # local time to the barrier: merged jobs arrive >= window_end
-            # by the latency contract, so the next window processes them.
-            warmup = jnp.float32(compiled.warmup)
-            gap = jnp.maximum(window_end - jnp.maximum(state["t"], warmup), 0.0)
-            state = {
-                **state,
-                "srv_depth_int": state["srv_depth_int"]
-                + state["srv_q_len"].astype(jnp.float32) * gap,
-                "ob_arrival": jnp.full((compiled.OB,), INF),
-                "ob_created": jnp.zeros((compiled.OB,), jnp.float32),
-                "ob_ingress": jnp.zeros((compiled.OB,), jnp.int32),
-                "ob_len": jnp.int32(0),
-                "t": jnp.maximum(state["t"], window_end),
-            }
-            state = compiled.merge_inbox(
-                state, inbox_arrival, inbox_created, inbox_ingress, inbox_len
-            )
-            state["truncated_windows"] = truncated_windows
-            return (state, params), None
-
+    def run_windows_replica(state, params, w_offset, n: int):
+        """Advance one partition-replica by ``n`` windows from absolute
+        window ``w_offset`` (absolute indices keep barrier times and RNG
+        streams identical across segmentation/resume)."""
         (state, _), _ = lax.scan(
-            one_window, (state, params), jnp.arange(n_windows, dtype=jnp.int32)
+            one_window,
+            (state, params),
+            jnp.arange(n, dtype=jnp.int32) + w_offset,
         )
         return state
 
@@ -300,7 +476,11 @@ def run_partitioned(
         # the replica axis, and put the partition axis back on the way out.
         keys = keys[0]
         params = {k: v[0] for k, v in params.items()}
-        final = jax.vmap(one_partition_replica)(keys, params)
+        final = jax.vmap(
+            lambda key, p: run_windows_replica(
+                init_replica(key, p), p, jnp.int32(0), n_windows
+            )
+        )(keys, params)
         return jax.tree_util.tree_map(lambda x: x[None], final)
 
     # Per-(partition, replica) keys: fold partition then replica.
@@ -326,28 +506,54 @@ def run_partitioned(
     keys = jax.device_put(jnp.asarray(keys), sharded)
     params = {k: jax.device_put(jnp.asarray(v), sharded) for k, v in params.items()}
 
-    shard_kwargs = dict(
-        mesh=mesh,
-        in_specs=(P(PARTITION_AXIS), {k: P(PARTITION_AXIS) for k in params}),
-        out_specs=P(PARTITION_AXIS),
+    def _shard_map_compat(fn, in_specs):
+        # The replication/varying-axis checker's name changed across jax
+        # versions (check_vma in >=0.8, check_rep before); we disable it
+        # either way — lax.switch branches that leave different state
+        # leaves untouched trip its conservative varying-axes propagation.
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=P(PARTITION_AXIS))
+        for disable in ({"check_vma": False}, {"check_rep": False}, {}):
+            try:
+                return shard_map(fn, **disable, **kwargs)
+            except TypeError:
+                continue
+        raise RuntimeError("shard_map construction failed")
+
+    param_specs = {k: P(PARTITION_AXIS) for k in params}
+    checkpointing = (
+        checkpoint_every_windows is not None
+        or checkpoint_callback is not None
+        or resume_from is not None
     )
-    # The replication/varying-axis checker's name changed across jax
-    # versions (check_vma in >=0.8, check_rep before); we disable it either
-    # way — lax.switch branches that leave different state leaves untouched
-    # trip its conservative varying-axes propagation.
-    mapped = None
-    for disable in ({"check_vma": False}, {"check_rep": False}, {}):
-        try:
-            mapped = shard_map(spmd, **disable, **shard_kwargs)
-            break
-        except TypeError:
-            continue
-    run = jax.jit(mapped)
-    compiled_fn = run.lower(keys, params).compile()
-    start = _wall.perf_counter()
-    final = compiled_fn(keys, params)
-    events_total = int(jnp.sum(final["events"]))
-    wall = _wall.perf_counter() - start
+    if not checkpointing:
+        run = jax.jit(
+            _shard_map_compat(spmd, (P(PARTITION_AXIS), param_specs))
+        )
+        compiled_fn = run.lower(keys, params).compile()
+        start = _wall.perf_counter()
+        final = compiled_fn(keys, params)
+        events_total = int(jnp.sum(final["events"]))
+        wall = _wall.perf_counter() - start
+    else:
+        final, events_total, wall = _run_partitioned_segmented(
+            keys,
+            params,
+            sharded,
+            _shard_map_compat,
+            param_specs,
+            init_replica,
+            run_windows_replica,
+            n_windows=n_windows,
+            n_partitions=n_partitions,
+            n_replicas=n_replicas,
+            seed=seed,
+            fingerprint=model_fingerprint(model),
+            window_s=window_s,
+            max_events_per_window=max_events_per_window,
+            checkpoint_every_windows=checkpoint_every_windows,
+            checkpoint_callback=checkpoint_callback,
+            resume_from=resume_from,
+        )
 
     host = {k: np.asarray(v) for k, v in final.items()}
     nV_real = len(model.servers)

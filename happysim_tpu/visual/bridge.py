@@ -230,14 +230,19 @@ class SimulationBridge:
     def _play_loop(self, generation: int, events_per_tick: int, interval_s: float) -> None:
         import time
 
-        while self._playing and self._play_gen == generation:
-            state = self.step(events_per_tick)
-            if state.get("is_completed") or state.get("pending_events") == 0:
-                break
-            time.sleep(interval_s)
-        with self._play_lock:
-            if self._play_gen == generation:
-                self._playing = False
+        # try/finally: if step() raises (entity bug, torn-down sim), the
+        # flag must still clear — otherwise /api/play reports "playing"
+        # forever with no thread advancing anything.
+        try:
+            while self._playing and self._play_gen == generation:
+                state = self.step(events_per_tick)
+                if state.get("is_completed") or state.get("pending_events") == 0:
+                    break
+                time.sleep(interval_s)
+        finally:
+            with self._play_lock:
+                if self._play_gen == generation:
+                    self._playing = False
 
     # -- control verbs -----------------------------------------------------
     def step(self, n: int = 1) -> dict[str, Any]:

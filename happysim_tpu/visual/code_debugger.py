@@ -71,6 +71,7 @@ class ExecutionTrace:
     method_name: str
     start_line: int
     lines: list[LineRecord] = field(default_factory=list)
+    seq: int = 0  # monotone id so multiple consumers can cursor past it
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -78,6 +79,7 @@ class ExecutionTrace:
             "method_name": self.method_name,
             "start_line": self.start_line,
             "lines": [line.to_dict() for line in self.lines],
+            "seq": self.seq,
         }
 
 
@@ -120,6 +122,7 @@ class CodeDebugger:
         self._active: dict[str, Any] = {}  # entity name -> entity
         self._breakpoints: list[CodeBreakpoint] = []
         self._traces: list[ExecutionTrace] = []
+        self._trace_seq = 0
         self._current: Optional[ExecutionTrace] = None
         self._capture_locals = True
         # Breakpoint gate: the sim thread waits; the API thread releases.
@@ -166,9 +169,20 @@ class CodeDebugger:
         self._resume_gate.set()
 
     def drain_traces(self) -> list[ExecutionTrace]:
+        """Destructive read of the whole buffer. Single-consumer only —
+        a second poller steals traces; concurrent consumers (multiple
+        browser tabs) must use :meth:`traces_since` cursors instead."""
         with self._lock:
             traces, self._traces = self._traces, []
         return traces
+
+    def traces_since(self, cursor: int) -> tuple[list[ExecutionTrace], int]:
+        """Non-destructive cursor read: traces with seq > cursor, plus the
+        new cursor. The buffer is bounded (500), so each consumer sees
+        every trace as long as it polls faster than the overflow."""
+        with self._lock:
+            fresh = [t for t in self._traces if t.seq > cursor]
+        return fresh, (fresh[-1].seq if fresh else cursor)
 
     # -- engine protocol (core/event.py) -----------------------------------
     def wants(self, target: Any) -> bool:
@@ -208,6 +222,8 @@ class CodeDebugger:
             frame.f_trace = None
         if self._current is not None and self._current.lines:
             with self._lock:
+                self._trace_seq += 1
+                self._current.seq = self._trace_seq
                 self._traces.append(self._current)
                 if len(self._traces) > 500:
                     del self._traces[:-500]

@@ -93,14 +93,16 @@ def _make_handler(bridge: SimulationBridge):
                 "active": debugger.active_entities(),
             }
 
-        def _poll_payload(self, since: int) -> dict:
+        def _poll_payload(self, since: int, trace_since: int = 0) -> dict:
+            # Non-destructive cursor reads so several consumers (tabs,
+            # poll + stream) each see every trace.
+            traces, trace_cursor = bridge.code_debugger.traces_since(trace_since)
             return {
                 "state": {**bridge.state(), "is_playing": bridge.is_playing},
                 "events": bridge.events(since),
                 "logs": bridge.logs(50),
-                "traces": [
-                    t.to_dict() for t in bridge.code_debugger.drain_traces()
-                ],
+                "traces": [t.to_dict() for t in traces],
+                "trace_cursor": trace_cursor,
                 "code": self._code_state(),
             }
 
@@ -119,9 +121,11 @@ def _make_handler(bridge: SimulationBridge):
             self.send_header("Access-Control-Allow-Origin", "*")
             self.end_headers()
             since = int(query.get("since", 0))
+            trace_cursor = 0
             try:
                 while not bridge.closed:
-                    payload = self._poll_payload(since)
+                    payload = self._poll_payload(since, trace_cursor)
+                    trace_cursor = payload["trace_cursor"]
                     for event in payload["events"]:
                         since = max(since, event.get("seq", since))
                     body = json.dumps(payload, default=str)
@@ -145,7 +149,10 @@ def _make_handler(bridge: SimulationBridge):
                 if path == "/api/logs":
                     return {"logs": bridge.logs(int(query.get("limit", 200)))}
                 if path == "/api/poll":
-                    return self._poll_payload(int(query.get("since", 0)))
+                    return self._poll_payload(
+                        int(query.get("since", 0)),
+                        int(query.get("trace_since", 0)),
+                    )
                 if path == "/api/chart_data":
                     return {"charts": bridge.chart_data()}
                 if path.startswith("/api/timeseries/"):

@@ -38,6 +38,7 @@ _TPU_TEST_FILES = {
     "test_tpu_opinion.py",
     "test_analysis_tpu.py",
     "test_mm1_queue.py",
+    "test_tpu_checkpoint.py",
 }
 # Long host-side suites (examples execute end-to-end, some on the TPU path).
 _SLOW_TEST_FILES = {"test_examples.py"}

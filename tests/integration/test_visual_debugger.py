@@ -128,10 +128,13 @@ def get(url):
         return json.loads(response.read())
 
 
-def post(url, body=None):
+def post(url, body=None, method="POST", timeout=30):
     data = json.dumps(body or {}).encode()
-    request = urllib.request.Request(url, data=data, method="POST")
-    with urllib.request.urlopen(request, timeout=10) as response:
+    request = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(request, timeout=timeout) as response:
         return json.loads(response.read())
 
 
@@ -234,13 +237,13 @@ class TestLiveDebugWorkflow:
             base = server.url
             # Activate the entity's code panel: the response is the code
             # contract the page renders (source lines + start line).
-            location = post_json(
+            location = post(
                 f"{base}/api/debug/code/activate", {"entity": "srv"}
             )
             assert location["entity_name"] == "srv"
             assert location["source_lines"] and location["start_line"] > 0
 
-            breakpoint_ = post_json(
+            breakpoint_ = post(
                 f"{base}/api/debug/code/breakpoint",
                 {"entity": "srv", "line": location["start_line"] + 1},
             )
@@ -263,7 +266,7 @@ class TestLiveDebugWorkflow:
             assert "locals" in paused
 
             # Single line step: still paused, but one line further along.
-            post_json(f"{base}/api/debug/code/continue", {"step": True})
+            post(f"{base}/api/debug/code/continue", {"step": True})
             stepped = _wait_for(
                 lambda: (
                     (p := get(f"{base}/api/debug/code/state")["paused_at"])
@@ -274,15 +277,15 @@ class TestLiveDebugWorkflow:
             assert stepped["line_number"] > paused["line_number"]
 
             # Remove the breakpoint and continue: the run completes.
-            request(
+            post(
                 f"{base}/api/debug/code/breakpoint",
+                {"id": breakpoint_["id"]},
                 method="DELETE",
-                body={"id": breakpoint_["id"]},
             )
-            post_json(f"{base}/api/debug/code/continue", {"step": False})
+            post(f"{base}/api/debug/code/continue", {"step": False})
             runner.join(timeout=30)
             assert not runner.is_alive()
-            post_json(f"{base}/api/debug/code/deactivate", {"entity": "srv"})
+            post(f"{base}/api/debug/code/deactivate", {"entity": "srv"})
             assert get(f"{base}/api/debug/code/state")["active"] == []
 
     def test_sse_stream_carries_poll_payload(self):
@@ -330,20 +333,6 @@ def _wait_for(probe, attempts=200, interval=0.02):
             return value
         threading.Event().wait(interval)
     raise AssertionError("condition not reached")
-
-
-def post_json(url, body):
-    return request(url, method="POST", body=body)
-
-
-def request(url, method="GET", body=None):
-    data = json.dumps(body).encode() if body is not None else None
-    req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=30) as response:
-        return json.loads(response.read())
 
 
 class TestStaticFrontend:
