@@ -845,16 +845,38 @@ class _Compiled:
             latency = jnp.where(chosen_mean > 0, chosen_mean, 0.0)
         if target_kinds == {SINK}:
             return self._deliver_sink(state, t + latency, created, indices[choice])
-        if lat_means.any():
-            return self._into_transit(state, indices[choice], t + latency, created)
-        return self._arrive_server(
-            state,
-            indices[choice],
-            t,
-            created,
-            0,
-            self._usvc(u, self.U_SVC1),
-            params,
+
+        def to_server(state):
+            if lat_means.any():
+                return self._into_transit(
+                    state, indices[choice], t + latency, created
+                )
+            return self._arrive_server(
+                state,
+                indices[choice],
+                t,
+                created,
+                0,
+                self._usvc(u, self.U_SVC1),
+                params,
+            )
+
+        if target_kinds == {SERVER}:
+            return to_server(state)
+        # Mixed server/sink targets ("done or continue" — probabilistic
+        # feedback loops): both destinations are computed predicated and
+        # selected by the chosen target's kind.
+        is_sink = jnp.asarray(
+            [ref.kind == SINK for ref in router.targets]
+        )[choice]
+        sank = self._deliver_sink(state, t + latency, created, indices[choice])
+        served = to_server(state)
+        return jax.tree_util.tree_map(
+            lambda sink_leaf, server_leaf: jnp.where(
+                is_sink, sink_leaf, server_leaf
+            ),
+            sank,
+            served,
         )
 
     def _through_limiter(self, state, t, created, u, l: int, params):
@@ -1652,6 +1674,11 @@ def run_ensemble(
             reduced["tr_dropped"] = jnp.sum(final["tr_dropped"], axis=0)
         return reduced
 
+    if checkpoint_every_s is not None and checkpoint_callback is None:
+        raise ValueError(
+            "checkpoint_every_s without checkpoint_callback would take no "
+            "snapshots (pass a callback to receive them)"
+        )
     checkpointing = (
         checkpoint_every_s is not None
         or checkpoint_callback is not None

@@ -453,15 +453,22 @@ class EnsembleModel:
                     raise ValueError(
                         f"router[{i}] targets a remote — partitioned mode only"
                     )
-            # Homogeneous server/sink sets, plus (partitioned) sink+remote
-            # mixes, which model "stay local or hop to the neighbor".
-            allowed = kinds in ({SERVER}, {SINK}, set()) or (
+            # Server/sink sets (including mixes — "done or continue", e.g.
+            # probabilistic feedback loops), plus (partitioned)
+            # sink+remote mixes, which model "stay local or hop to the
+            # neighbor".
+            allowed = kinds <= {SERVER, SINK} or (
                 allow_remote and kinds <= {SINK, REMOTE}
             )
             if not allowed:
                 raise ValueError(
-                    f"router[{i}] targets must be all servers, all sinks, or "
+                    f"router[{i}] targets must be servers and/or sinks, or "
                     "(partitioned) sinks+remotes"
+                )
+            if kinds == {SERVER, SINK} and router.policy == "least_outstanding":
+                raise ValueError(
+                    f"router[{i}]: least_outstanding needs all-server "
+                    "targets (sinks have no outstanding work)"
                 )
             if REMOTE in kinds and router.policy != "random":
                 raise ValueError(

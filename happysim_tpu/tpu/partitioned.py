@@ -75,6 +75,7 @@ class PartitionedCheckpoint:
     model_fingerprint: str = ""
     window_s: float = 0.0
     max_events_per_window: int = 0
+    outbox_capacity: int = 0
 
     def save(self, path: str) -> None:
         meta = {
@@ -86,6 +87,7 @@ class PartitionedCheckpoint:
             "model_fingerprint": self.model_fingerprint,
             "window_s": self.window_s,
             "max_events_per_window": self.max_events_per_window,
+            "outbox_capacity": self.outbox_capacity,
         }
         save_checkpoint_npz(path, meta, self.state)
 
@@ -258,6 +260,7 @@ def _run_partitioned_segmented(
     fingerprint: str,
     window_s: float,
     max_events_per_window: int,
+    outbox_capacity: int,
     checkpoint_every_windows: Optional[int],
     checkpoint_callback,
     resume_from: Optional[PartitionedCheckpoint],
@@ -278,6 +281,9 @@ def _run_partitioned_segmented(
                 resume_from.max_events_per_window,
                 max_events_per_window,
             ),
+            # A capacity mismatch would otherwise only surface as an
+            # obscure scan-carry shape error deep inside the jit.
+            "outbox_capacity": (resume_from.outbox_capacity, outbox_capacity),
         }
         bad = {k: v for k, v in mismatches.items() if v[0] != v[1]}
         if bad:
@@ -350,6 +356,7 @@ def _run_partitioned_segmented(
                     model_fingerprint=fingerprint,
                     window_s=window_s,
                     max_events_per_window=max_events_per_window,
+                    outbox_capacity=outbox_capacity,
                 )
             )
 
@@ -520,6 +527,11 @@ def run_partitioned(
         raise RuntimeError("shard_map construction failed")
 
     param_specs = {k: P(PARTITION_AXIS) for k in params}
+    if checkpoint_every_windows is not None and checkpoint_callback is None:
+        raise ValueError(
+            "checkpoint_every_windows without checkpoint_callback would "
+            "take no snapshots (pass a callback to receive them)"
+        )
     checkpointing = (
         checkpoint_every_windows is not None
         or checkpoint_callback is not None
@@ -550,6 +562,7 @@ def run_partitioned(
             fingerprint=model_fingerprint(model),
             window_s=window_s,
             max_events_per_window=max_events_per_window,
+            outbox_capacity=outbox_capacity,
             checkpoint_every_windows=checkpoint_every_windows,
             checkpoint_callback=checkpoint_callback,
             resume_from=resume_from,

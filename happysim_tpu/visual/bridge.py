@@ -278,6 +278,10 @@ class SimulationBridge:
             self.sim.control.reset()
             with self._lock:
                 self._events.clear()
+                # Serials restart with the world: clients track seq from 0
+                # after a reset (live SSE streams reconnect — their
+                # server-side cursor is past every future event).
+                self._event_serial = 0
                 self._logs.clear()
                 self._edge_counts.clear()
                 self._last_target = None

@@ -223,3 +223,46 @@ class TestPipeline:
 
         with pytest.raises(ValueError):
             pipeline_model(rate=1.0, service_means=[])
+
+
+class TestMixedRouter:
+    """Routers may mix server and sink targets ("done or continue"),
+    enabling probabilistic feedback loops — an M/M/1 with Bernoulli(q)
+    feedback is a Jackson network with effective arrival rate
+    lam/(1-q) and sojourn counted once per external job."""
+
+    def test_feedback_loop_matches_jackson_theory(self, mesh):
+        lam, mu, q = 4.0, 10.0, 0.5
+        model = EnsembleModel(horizon_s=80.0, warmup_s=10.0)
+        src = model.source(rate=lam)
+        srv = model.server(service_mean=1.0 / mu, queue_capacity=256)
+        snk = model.sink()
+        router = model.router(policy="random")
+        model.connect(src, srv)
+        model.connect(srv, router)
+        model.connect(router, snk)       # prob 1-q: leave
+        model.connect(router, srv)       # prob q: go around again
+        result = run_ensemble(
+            model, n_replicas=256, seed=0, mesh=mesh, max_events=4096
+        )
+        # Effective load: lam_eff = lam/(1-q); per-visit sojourn
+        # 1/(mu - lam_eff); mean visits 1/(1-q).
+        lam_eff = lam / (1.0 - q)
+        expected = (1.0 / (mu - lam_eff)) / (1.0 - q)
+        assert result.truncated_replicas == 0
+        assert result.sink_mean_latency_s[0] == pytest.approx(expected, rel=0.1)
+        # Server sees ~1/(1-q) starts per external arrival.
+        assert result.server_completed[0] > 1.5 * result.sink_count[0]
+
+    def test_least_outstanding_rejects_sink_mix(self):
+        model = EnsembleModel(horizon_s=10.0)
+        src = model.source(rate=1.0)
+        srv = model.server()
+        snk = model.sink()
+        router = model.router(policy="least_outstanding")
+        model.connect(src, router)
+        model.connect(srv, snk)
+        model.connect(router, srv)
+        model.connect(router, snk)
+        with pytest.raises(ValueError, match="least_outstanding"):
+            model.validate()
