@@ -8,7 +8,10 @@ correctness oracle.
 """
 
 from happysim_tpu.tpu.mesh import (
+    HOST_AXIS,
     REPLICA_AXIS,
+    distributed_initialize,
+    host_replica_mesh,
     pad_to_multiple,
     replica_mesh,
     replica_sharding,
@@ -45,7 +48,10 @@ __all__ = [
     "PartitionedCheckpoint",
     "PartitionedResult",
     "partition_mesh",
+    "HOST_AXIS",
     "REPLICA_AXIS",
+    "distributed_initialize",
+    "host_replica_mesh",
     "pad_to_multiple",
     "replica_mesh",
     "replica_sharding",

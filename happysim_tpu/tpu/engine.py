@@ -1435,7 +1435,13 @@ def _run_ensemble_segmented(
             "model_fingerprint": (resume_from.model_fingerprint, fingerprint),
             "params_fingerprint": (resume_from.params_fingerprint, p_fingerprint),
         }
-        bad = {k: v for k, v in mismatches.items() if v[0] != v[1]}
+        # Empty fingerprints = "unknown" (checkpoint predates the field):
+        # skip those rather than reject older files.
+        bad = {
+            k: v
+            for k, v in mismatches.items()
+            if v[0] != v[1] and not (k.endswith("fingerprint") and v[0] == "")
+        }
         if bad:
             raise ValueError(
                 f"resume_from does not match this run: {bad} "

@@ -4,6 +4,15 @@ The replica axis is the one big data-parallel dimension of a DES ensemble
 (SURVEY.md §2.5: ParallelRunner replicas → vmap lanes → chips). We shard it
 over a 1-D mesh named "replicas"; metric reductions then ride the ICI as
 ``psum``-style collectives inserted by XLA.
+
+Multi-host (SURVEY §5.8): on a multi-slice / multi-host deployment, call
+:func:`distributed_initialize` once per host process (it wraps
+``jax.distributed.initialize``), then build either the flat
+:func:`replica_mesh` over the GLOBAL device list or the 2-D
+:func:`host_replica_mesh` whose outer "hosts" axis maps to DCN and inner
+"replicas" axis to ICI — reductions then tree up within each slice over
+ICI before one cross-host hop. ``replica_sharding`` understands both
+layouts, so ``run_ensemble(..., mesh=...)`` needs no call-site changes.
 """
 
 from __future__ import annotations
@@ -15,17 +24,94 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 REPLICA_AXIS = "replicas"
+HOST_AXIS = "hosts"
+
+
+def distributed_initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join a multi-host JAX runtime (no-op for single-process runs).
+
+    Wraps ``jax.distributed.initialize``; with no arguments the cluster
+    environment (TPU pod metadata, or JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID) is auto-detected, which is the
+    normal path on Cloud TPU pods. Returns True when a multi-process
+    runtime is active afterwards, False when this stays a single-process
+    run. Idempotent for the no-arg form; EXPLICIT-argument failures
+    propagate — a mistyped coordinator address silently degrading to N
+    independent single-process runs would produce wrong statistics on
+    every host with no error.
+    """
+    explicit = any(
+        value is not None
+        for value in (coordinator_address, num_processes, process_id)
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        if explicit:
+            raise
+        # No-arg form: already initialized, or no cluster env to detect —
+        # both leave jax.process_count() reporting the truth below.
+    return jax.process_count() > 1
 
 
 def replica_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """1-D mesh over all (or the given) devices, axis name "replicas"."""
+    """1-D mesh over all (or the given) devices, axis name "replicas".
+
+    Under an initialized multi-host runtime ``jax.devices()`` is the
+    GLOBAL list, so this mesh already spans every host.
+    """
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (REPLICA_AXIS,))
 
 
+def host_replica_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    n_hosts: Optional[int] = None,
+) -> Mesh:
+    """2-D (hosts, replicas) mesh: outer axis per host (DCN), inner axis
+    the host's local devices (ICI).
+
+    ``n_hosts`` defaults to ``jax.process_count()``; pass it explicitly
+    to emulate a multi-host layout on a single process (tests do this on
+    the virtual CPU mesh). Device order is grouped host-major so each
+    mesh row is one host's slice.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_hosts is None:
+        n_hosts = max(jax.process_count(), 1)
+    if len(devices) % n_hosts:
+        raise ValueError(
+            f"{len(devices)} devices do not split evenly over {n_hosts} hosts"
+        )
+    # Group by owning process, not list order: the global device list is
+    # not guaranteed host-contiguous, and an interleaved reshape would
+    # silently invert the hosts=DCN / replicas=ICI mapping (every
+    # intra-row reduction crossing DCN). Single-process emulation
+    # (n_hosts > process_count) keeps the given order.
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    grid = np.asarray(devices).reshape(n_hosts, len(devices) // n_hosts)
+    return Mesh(grid, (HOST_AXIS, REPLICA_AXIS))
+
+
 def replica_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (replica) dimension across the mesh."""
+    """Shard the leading (replica) dimension across the whole mesh.
+
+    For the 2-D host/replica mesh the leading dim is sharded over BOTH
+    axes (host-major), so each host owns a contiguous replica slab and
+    cross-host traffic is one reduction hop over DCN.
+    """
+    if HOST_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P((HOST_AXIS, REPLICA_AXIS)))
     return NamedSharding(mesh, P(REPLICA_AXIS))
 
 

@@ -285,7 +285,20 @@ def _run_partitioned_segmented(
             # obscure scan-carry shape error deep inside the jit.
             "outbox_capacity": (resume_from.outbox_capacity, outbox_capacity),
         }
-        bad = {k: v for k, v in mismatches.items() if v[0] != v[1]}
+        # Default-valued meta in OPTIONAL fields = "unknown" (checkpoint
+        # predates the field): skip those rather than reject older files.
+        # seed/n_replicas/etc. are always recorded, so 0 there is real.
+        optional_defaults = {
+            "model_fingerprint": "",
+            "window_s": 0.0,
+            "max_events_per_window": 0,
+            "outbox_capacity": 0,
+        }
+        bad = {
+            k: v
+            for k, v in mismatches.items()
+            if v[0] != v[1] and v[0] != optional_defaults.get(k, object())
+        }
         if bad:
             raise ValueError(
                 f"resume_from does not match this run: {bad} "

@@ -1,5 +1,20 @@
 """Shared small utilities (the task template's ``utils/`` tier)."""
 
+from happysim_tpu.utils.filename import sanitize_filename
+from happysim_tpu.utils.humanize import (
+    humanize_count,
+    humanize_duration,
+    humanize_rate,
+)
+from happysim_tpu.utils.ids import get_id
 from happysim_tpu.utils.stats import percentile_nearest_rank, stable_seed
 
-__all__ = ["percentile_nearest_rank", "stable_seed"]
+__all__ = [
+    "get_id",
+    "humanize_count",
+    "humanize_duration",
+    "humanize_rate",
+    "percentile_nearest_rank",
+    "sanitize_filename",
+    "stable_seed",
+]

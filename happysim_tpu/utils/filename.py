@@ -1,0 +1,24 @@
+"""Filesystem-safe name mangling.
+
+Parity target: ``happysimulator/utils/filename.py:10`` — artifact
+writers (charts, checkpoints, trace dumps) name files after entity or
+scenario names, which may carry arbitrary characters.
+"""
+
+from __future__ import annotations
+
+import re
+
+_UNSAFE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def sanitize_filename(name: str, max_length: int = 255) -> str:
+    """Reduce ``name`` to a portable filename.
+
+    Every run of characters outside [A-Za-z0-9._-] collapses to one
+    underscore; leading/trailing dots and underscores are stripped (a
+    leading dot would hide the file); the result is truncated to
+    ``max_length`` and never empty ("unnamed" as a last resort).
+    """
+    safe = _UNSAFE.sub("_", name).strip("._")
+    return safe[:max_length] or "unnamed"

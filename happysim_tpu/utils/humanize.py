@@ -1,0 +1,62 @@
+"""Human-readable formatting for durations, rates, and counts.
+
+Parity target: the reference's duration formatting helpers
+(``happysimulator/utils/duration.py``) — its Duration class itself maps
+to :class:`happysim_tpu.core.temporal.Duration`; the presentation-side
+formatting lives here.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from happysim_tpu.core.temporal import Duration, Instant
+
+def humanize_duration(value: Union[Duration, Instant, int, float]) -> str:
+    """Format a duration (or seconds) with a natural unit.
+
+    Sub-second values pick ns/us/ms; seconds print as ``1.234s``; longer
+    spans break into ``2m 3.5s`` / ``1h 02m``. Unit selection uses the
+    POST-rounding threshold 999.5 so values just under a decade boundary
+    promote to the next unit ("1s") instead of printing "1e+03ms".
+    """
+    if isinstance(value, (Duration, Instant)):
+        seconds = value.to_seconds()
+    else:
+        seconds = float(value)
+    sign = "-" if seconds < 0 else ""
+    seconds = abs(seconds)
+    if seconds == 0:
+        return "0s"
+    if seconds < 60:
+        for factor, unit in ((1e9, "ns"), (1e6, "us"), (1e3, "ms")):
+            scaled = seconds * factor
+            if scaled < 999.5:  # "%.3g" would round anything above to 1e+03
+                return f"{sign}{scaled:.3g}{unit}"
+        return f"{sign}{seconds:.3g}s"
+    minutes, rem = divmod(seconds, 60.0)
+    if minutes < 60:
+        return f"{sign}{int(minutes)}m {rem:.3g}s"
+    hours, minutes = divmod(int(minutes), 60)
+    return f"{sign}{hours}h {minutes:02d}m"
+
+
+def humanize_count(n: Union[int, float]) -> str:
+    """Format a count with k/M/B suffixes: 1234 -> '1.23k'.
+
+    The suffix is chosen post-rounding (>= 0.9995 of the threshold), so
+    999_999 prints "1M", never "1e+03k".
+    """
+    n = float(n)
+    sign = "-" if n < 0 else ""
+    n = abs(n)
+    for threshold, suffix in ((1e9, "B"), (1e6, "M"), (1e3, "k")):
+        scaled = n / threshold
+        if scaled >= 0.9995:  # rounds to >= 1.00 at 3 significant digits
+            return f"{sign}{scaled:.3g}{suffix}"
+    return f"{sign}{n:.4g}"
+
+
+def humanize_rate(per_second: Union[int, float]) -> str:
+    """Format an events-per-second rate: 18_700_000 -> '18.7M/s'."""
+    return f"{humanize_count(per_second)}/s"

@@ -1,0 +1,23 @@
+"""Process-unique, sortable identifiers.
+
+Parity target: ``happysimulator/utils/ids.py:15`` (monotone zero-padded
+hex ids for event/trace identification). The itertools counter is
+atomic under both the GIL and free-threaded CPython, so no lock is
+needed on the fast path.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+_ID_DIGITS = 12
+_counter = itertools.count()
+
+
+def get_id() -> str:
+    """Next process-unique id: uppercase hex, zero-padded to 12 digits.
+
+    Monotone within a process, so ids sort in allocation order —
+    convenient for trace files and log correlation.
+    """
+    return format(next(_counter), f"0{_ID_DIGITS}X")

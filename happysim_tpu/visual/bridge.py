@@ -101,6 +101,10 @@ class SimulationBridge:
         self._play_gen = 0
         self._play_lock = threading.Lock()
         self.closed = False
+        # Bumped on reset(): event serials restart at 0, so every live
+        # stream must re-zero its cursor or it would filter out all
+        # future events (its old cursor exceeds every new seq).
+        self.reset_generation = 0
 
     def close(self) -> None:
         """Detach everything: log handler, event hook, code debugger.
@@ -278,10 +282,11 @@ class SimulationBridge:
             self.sim.control.reset()
             with self._lock:
                 self._events.clear()
-                # Serials restart with the world: clients track seq from 0
-                # after a reset (live SSE streams reconnect — their
-                # server-side cursor is past every future event).
+                # Serials restart with the world; reset_generation tells
+                # every live stream (any tab, not just the one that
+                # clicked reset) to re-zero its cursor.
                 self._event_serial = 0
+                self.reset_generation += 1
                 self._logs.clear()
                 self._edge_counts.clear()
                 self._last_target = None

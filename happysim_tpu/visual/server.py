@@ -122,8 +122,15 @@ def _make_handler(bridge: SimulationBridge):
             self.end_headers()
             since = int(query.get("since", 0))
             trace_cursor = 0
+            reset_gen = bridge.reset_generation
             try:
                 while not bridge.closed:
+                    if bridge.reset_generation != reset_gen:
+                        # Serials restarted: a stale cursor would filter
+                        # out every future event on THIS stream too.
+                        reset_gen = bridge.reset_generation
+                        since = 0
+                        trace_cursor = 0
                     payload = self._poll_payload(since, trace_cursor)
                     trace_cursor = payload["trace_cursor"]
                     for event in payload["events"]:

@@ -266,3 +266,31 @@ class TestMixedRouter:
         model.connect(router, snk)
         with pytest.raises(ValueError, match="least_outstanding"):
             model.validate()
+
+
+class TestMultiHostMesh:
+    def test_host_replica_mesh_matches_flat_mesh(self):
+        """run_ensemble accepts the 2-D (hosts, replicas) mesh with no
+        call-site changes, and threefry lane streams make the result
+        identical to the flat 1-D mesh (layout independence — the same
+        oracle the sharding-invariance tests use)."""
+        import jax
+
+        from happysim_tpu.tpu.mesh import host_replica_mesh, replica_mesh
+
+        devices = jax.devices("cpu")[:8]
+        model = mm1_model(lam=8.0, mu=10.0, horizon_s=20.0, warmup_s=4.0)
+        flat = run_ensemble(
+            model, n_replicas=64, seed=0, mesh=replica_mesh(devices)
+        )
+        hosted = run_ensemble(
+            model,
+            n_replicas=64,
+            seed=0,
+            mesh=host_replica_mesh(devices, n_hosts=2),
+        )
+        assert hosted.sink_count == flat.sink_count
+        assert hosted.server_mean_wait_s[0] == pytest.approx(
+            flat.server_mean_wait_s[0], abs=1e-6
+        )
+        assert hosted.simulated_events == flat.simulated_events

@@ -1,0 +1,78 @@
+"""Multi-host mesh construction and sharding math (SURVEY §5.8).
+
+Runs on the virtual 8-device CPU backend: a 2-host x 4-device layout is
+emulated by passing n_hosts explicitly (the real multi-host path differs
+only in where the device list comes from — jax.distributed makes
+jax.devices() global).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu.mesh import (
+    HOST_AXIS,
+    REPLICA_AXIS,
+    distributed_initialize,
+    host_replica_mesh,
+    pad_to_multiple,
+    replica_mesh,
+    replica_sharding,
+)
+
+
+@pytest.fixture(scope="module")
+def devices():
+    return jax.devices("cpu")[:8]
+
+
+class TestHostReplicaMesh:
+    def test_two_hosts_by_four_devices(self, devices):
+        mesh = host_replica_mesh(devices, n_hosts=2)
+        assert mesh.axis_names == (HOST_AXIS, REPLICA_AXIS)
+        assert mesh.devices.shape == (2, 4)
+        assert mesh.size == 8
+        # Host-major grouping: each row is one host's contiguous slice.
+        assert list(mesh.devices[0]) == list(devices[:4])
+        assert list(mesh.devices[1]) == list(devices[4:])
+
+    def test_uneven_split_rejected(self, devices):
+        with pytest.raises(ValueError, match="do not split evenly"):
+            host_replica_mesh(devices, n_hosts=3)
+
+    def test_defaults_to_process_count(self, devices):
+        # Single-process test runtime: one host row spanning everything.
+        mesh = host_replica_mesh(devices)
+        assert mesh.devices.shape == (1, 8)
+
+    def test_replica_sharding_spans_both_axes(self, devices):
+        mesh = host_replica_mesh(devices, n_hosts=2)
+        sharding = replica_sharding(mesh)
+        # The leading dim shards over hosts x replicas: 8 distinct shards,
+        # host-major — replica block i lives on device grid[i // 4, i % 4].
+        arr = jax.device_put(np.arange(16.0), sharding)
+        assert len(arr.addressable_shards) == 8
+        for i, shard in enumerate(
+            sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
+        ):
+            assert shard.data.shape == (2,)
+            assert shard.device == mesh.devices[i // 4, i % 4]
+
+    def test_flat_mesh_sharding_unchanged(self, devices):
+        mesh = replica_mesh(devices)
+        sharding = replica_sharding(mesh)
+        arr = jax.device_put(np.arange(8.0), sharding)
+        assert len(arr.addressable_shards) == 8
+
+    def test_pad_to_multiple_uses_total_size(self, devices):
+        mesh = host_replica_mesh(devices, n_hosts=2)
+        assert pad_to_multiple(13, mesh.size) == 16
+
+
+class TestDistributedInitialize:
+    def test_single_process_noop(self):
+        # No cluster environment: stays single-process, returns False,
+        # and is safe to call repeatedly.
+        assert distributed_initialize() is False
+        assert distributed_initialize() is False
+        assert jax.process_count() == 1

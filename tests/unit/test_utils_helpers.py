@@ -1,0 +1,81 @@
+"""utils tier: ids, humanize, filename sanitization."""
+
+import threading
+
+from happysim_tpu.core.temporal import Duration
+from happysim_tpu.utils import (
+    get_id,
+    humanize_count,
+    humanize_duration,
+    humanize_rate,
+    sanitize_filename,
+)
+
+
+class TestIds:
+    def test_monotone_and_sortable(self):
+        ids = [get_id() for _ in range(100)]
+        assert ids == sorted(ids)
+        assert len(set(ids)) == 100
+        assert all(len(i) == 12 for i in ids)
+        int(ids[0], 16)  # valid hex
+
+    def test_thread_safety(self):
+        collected = []
+
+        def grab():
+            collected.extend(get_id() for _ in range(500))
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(collected)) == len(collected) == 4000
+
+
+class TestHumanize:
+    def test_duration_units(self):
+        assert humanize_duration(0) == "0s"
+        assert humanize_duration(3.5e-9) == "3.5ns"
+        assert humanize_duration(42e-6) == "42us"
+        assert humanize_duration(0.0123) == "12.3ms"
+        assert humanize_duration(1.5) == "1.5s"
+        assert humanize_duration(123.4) == "2m 3.4s"
+        assert humanize_duration(3721) == "1h 02m"
+        assert humanize_duration(-0.25).startswith("-250")
+
+    def test_duration_accepts_temporal_types(self):
+        assert humanize_duration(Duration.from_seconds(0.5)) == "500ms"
+
+    def test_count_and_rate(self):
+        assert humanize_count(950) == "950"
+        assert humanize_count(1234) == "1.23k"
+        assert humanize_count(18_700_000) == "18.7M"
+        assert humanize_count(3_000_000_000) == "3B"
+        assert humanize_rate(134_580) == "135k/s"
+
+    def test_decade_boundaries_promote_units(self):
+        """Values just under a boundary must round UP a unit, never print
+        scientific notation ('1e+03ms')."""
+        assert humanize_duration(0.9999) == "1s"
+        assert humanize_duration(9.999e-7) == "1us"
+        assert humanize_duration(999.6e-9) == "1us"
+        assert humanize_count(999_999) == "1M"
+        assert humanize_count(999_999_999) == "1B"
+
+
+class TestSanitizeFilename:
+    def test_replaces_unsafe_runs_with_one_underscore(self):
+        assert sanitize_filename("a b/c:d*e") == "a_b_c_d_e"
+
+    def test_strips_hiding_dots_and_edges(self):
+        assert sanitize_filename("..hidden..") == "hidden"
+        assert sanitize_filename("_x_") == "x"
+
+    def test_never_empty_and_bounded(self):
+        assert sanitize_filename("///") == "unnamed"
+        assert len(sanitize_filename("x" * 1000)) == 255
+
+    def test_keeps_safe_names_verbatim(self):
+        assert sanitize_filename("run-01.checkpoint.npz") == "run-01.checkpoint.npz"
