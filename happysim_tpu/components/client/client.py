@@ -87,6 +87,12 @@ class Client(Entity):
     def in_flight_count(self) -> int:
         return len(self._in_flight)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: outstanding requests' response/timeout
+        events died with the cleared heap; forget them. Cumulative
+        success/failure/latency stats survive."""
+        self._in_flight.clear()
+
     @property
     def average_response_time(self) -> float:
         if not self.response_times_s:

@@ -126,6 +126,21 @@ class ConnectionPool(Entity):
             idle_reaped=self.idle_reaped,
         )
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: holders of active connections, pending
+        dials, and queued waiters all died with the cleared heap. Active
+        AND idle connections close — an idle connection's reap timer died
+        too, so keeping it would exempt it from ``idle_timeout`` forever;
+        the next run re-dials fresh, exactly like a cold pool. Cumulative
+        counters survive."""
+        self.connections_closed += len(self._active) + len(self._idle)
+        self._active.clear()
+        self._idle.clear()
+        self._dialing = 0
+        self._abandoned_dials.clear()
+        self._dial_id_of.clear()
+        self._waiters.clear()
+
     # -- acquire / release -------------------------------------------------
     def acquire(self) -> tuple[SimFuture, list[Event]]:
         """(future resolving to a Connection, events to schedule).

@@ -47,6 +47,13 @@ class PooledClient(Entity):
     def downstream_entities(self) -> list[Entity]:
         return [self.pool]
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: outstanding requests (and the pool's
+        active connections/dials backing them) died with the cleared
+        heap. A ghost in_flight would pin the client at its limit."""
+        self.in_flight = 0
+        self.pool.reset_in_flight()
+
     def send_request(self, payload: Any = None, at: Optional[Instant] = None) -> Event:
         time = at if at is not None else (self.now if self._clock is not None else Instant.Epoch)
         return Event(

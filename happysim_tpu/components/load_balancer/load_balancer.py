@@ -118,6 +118,14 @@ class LoadBalancer(Entity):
     def downstream_entities(self) -> list[Entity]:
         return self.backends
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: forwarded requests' completion hooks died
+        with the cleared heap, so per-backend in-flight counts return to 0
+        (a ghost count would skew least-outstanding routing forever).
+        Cumulative totals and health state survive."""
+        for info in self._backends.values():
+            info.in_flight = 0
+
     # -- routing -----------------------------------------------------------
     def handle_event(self, event: Event):
         self.requests_received += 1

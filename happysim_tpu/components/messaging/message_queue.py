@@ -151,6 +151,32 @@ class MessageQueue(Entity):
     def in_flight_count(self) -> int:
         return len(self._in_flight)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: delivered-but-unacked messages AND
+        redelivery-parked messages return to the pending queue (in
+        sequence order, ahead of later publishes) — their consumers,
+        visibility timers, and redelivery timers all died with the
+        cleared heap, so without this they would stay invisible forever
+        (and permanently count against capacity). Counters and redelivery
+        attempt counts survive."""
+        # schedule_redelivery() parks messages OUTSIDE both _in_flight and
+        # _pending_queue (invisible, waiting on a now-dead timer).
+        stuck = set(self._in_flight) | {
+            mid for mid in self._redelivery_scheduled if mid in self._messages
+        }
+        # Ids are sequential ("<queue>-<n>"), so the numeric suffix is the
+        # publish order.
+        for message_id in sorted(
+            stuck, key=lambda mid: int(mid.rsplit("-", 1)[1]), reverse=True
+        ):
+            msg = self._messages[message_id]
+            msg.state = MessageState.PENDING
+            msg.consumer = None
+            self._pending_queue.appendleft(message_id)
+        self._in_flight.clear()
+        self._visibility_timers.clear()
+        self._redelivery_scheduled.clear()
+
     @property
     def consumer_count(self) -> int:
         return len(self._consumers)

@@ -90,6 +90,12 @@ class IdempotencyStore(Entity):
     def in_flight_count(self) -> int:
         return len(self._in_flight)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: forwarded-but-unsettled requests died
+        with the cleared heap; their keys unblock (a ghost key would
+        dedupe-reject every retry of it forever). The seen-cache survives."""
+        self._in_flight.clear()
+
     # -- request path ------------------------------------------------------
     def handle_event(self, event: Event):
         kind = event.event_type

@@ -97,6 +97,11 @@ class NetworkLink(Entity):
             return 0.0
         return min(1.0, (self._bytes_in_flight * 8) / self.bandwidth_bps)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: packets mid-transit died with the cleared
+        heap, so their bytes leave the utilization signal. Totals survive."""
+        self._bytes_in_flight = 0
+
     @property
     def link_stats(self) -> NetworkLinkStats:
         return NetworkLinkStats(

@@ -61,6 +61,13 @@ class Queue(Entity):
         if hasattr(self.policy, "set_clock"):
             self.policy.set_clock(lambda: clock.now)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: buffered items' poll/delivery events died
+        with the cleared heap, so the buffer empties too. Cumulative
+        enqueue/dequeue/drop counters survive."""
+        self.policy.clear()
+        self._pending_drop_events.clear()
+
     @property
     def depth(self) -> int:
         return len(self.policy)

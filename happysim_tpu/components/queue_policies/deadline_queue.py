@@ -84,13 +84,15 @@ class DeadlineQueue(RankedHeapPolicy):
     def pop(self) -> Any:
         now_s = self._now_s()
         while self._heap:
-            deadline, _, item = heapq.heappop(self._heap)
+            deadline, tiebreak, item = heapq.heappop(self._heap)
             if self.drop_expired and now_s is not None and deadline < now_s:
                 self.expired += 1
                 if self.on_drop is not None:
                     self.on_drop(item)
                 continue
             self.popped += 1
+            # Same exact-undo snapshot the base pop records.
+            self._pop_keys.remember(item, (deadline, tiebreak))
             return item
         return None
 

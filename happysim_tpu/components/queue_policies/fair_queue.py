@@ -142,11 +142,23 @@ class WeightedFairQueue(QueuePolicy):
         self._requeue_tiebreak = itertools.count()
         self._virtual_now = 0.0
         self._last_finish: dict[str, float] = {}
-        # Snapshot of recently popped items' finish tags so requeue can
-        # restore the EXACT tag even if other pops advanced _virtual_now
+        # Snapshot of recently popped items' exact heap keys (finish,
+        # tiebreak) plus the pop-time virtual clock, so requeue can
+        # restore the EXACT key even if other pops advanced _virtual_now
         # in between (e.g. a multi-slot driver poll). Bounded: the driver
         # only ever requeues items it popped moments ago.
         self._popped_finish = PopSnapshots()
+        # Pushes consume _virtual_now into finish tags; a requeue run may
+        # only REWIND the virtual clock when the run undoes a CONTIGUOUS
+        # SUFFIX of the pop history with no push in between — a pop that
+        # stays consumed (delivered) legitimately advanced the clock, and
+        # a push already baked the advanced clock into a finish tag.
+        self._push_seq = 0
+        self._pop_seq = 0
+        # Consecutive-requeue run state (reset by any push or pop):
+        # earliest undone pop's seq + pop-time clock, and the run length.
+        self._run_first: Optional[tuple[int, float, int]] = None
+        self._run_len = 0
 
     def set_weight(self, flow: str, weight: float) -> None:
         if weight <= 0:
@@ -156,6 +168,8 @@ class WeightedFairQueue(QueuePolicy):
     def push(self, item: Any) -> None:
         import heapq
 
+        self._push_seq += 1
+        self._run_first, self._run_len = None, 0
         key = self._flow_key(item)
         weight = self.weights.get(key, self.default_weight)
         start = max(self._virtual_now, self._last_finish.get(key, 0.0))
@@ -168,24 +182,56 @@ class WeightedFairQueue(QueuePolicy):
 
         if not self._heap:
             return None
-        finish, _, item = heapq.heappop(self._heap)
+        finish, tiebreak, item = heapq.heappop(self._heap)
+        vnow_before = self._virtual_now
+        self._run_first, self._run_len = None, 0
         # max(): popping a snapshot-requeued item must not REWIND virtual
         # time — that would hand artificially early finish tags to flows
         # that push after the rewind, letting them jump earlier arrivals.
         self._virtual_now = max(self._virtual_now, finish)
-        self._popped_finish.remember(item, finish)
+        self._popped_finish.remember(
+            item, (finish, tiebreak, self._pop_seq, vnow_before, self._push_seq)
+        )
+        self._pop_seq += 1
         return item
 
     def requeue(self, item: Any) -> None:
-        """Undo a pop: re-enter at the item's OWN popped finish tag (not
-        _virtual_now, which a later pop may have advanced past it) with a
-        low-range tiebreak, so the item precedes equal-finish peers it
-        originally beat and multiple same-instant requeues keep their pop
-        order."""
+        """Undo a pop: re-enter with the item's EXACT popped heap key —
+        its own finish tag (not _virtual_now, which a later pop may have
+        advanced past it) AND its original tiebreak, so arbitrary
+        interleavings of undo batches reproduce the untouched order (a
+        fresh low-range tiebreak inverts equal-finish items across
+        successive batches — see RankedHeapPolicy.requeue).
+
+        The virtual clock rewinds to the run's earliest pop-time value
+        ONLY once the consecutive requeues cover every pop from that one
+        to the latest — i.e. the run is a pure undo of a pop suffix with
+        no intervening push. A pop that stays consumed (the driver
+        delivered it) legitimately advanced the clock: "pop A, pop B,
+        deliver B, requeue A" must NOT rewind below B's finish, or a
+        later push could jump items that queued before it."""
         import heapq
 
-        finish = self._popped_finish.take(item, self._virtual_now)
-        heapq.heappush(self._heap, (finish, next(self._requeue_tiebreak), item))
+        snapshot = self._popped_finish.take(item)
+        if snapshot is None:
+            self._run_first, self._run_len = None, 0
+            heapq.heappush(
+                self._heap, (self._virtual_now, next(self._requeue_tiebreak), item)
+            )
+            return
+        finish, tiebreak, pop_seq, vnow_before, push_seq = snapshot
+        if self._run_first is None:
+            self._run_first = (pop_seq, vnow_before, push_seq)
+        self._run_len += 1
+        first_seq, first_vnow, first_push_seq = self._run_first
+        covers_suffix = (
+            pop_seq == first_seq + self._run_len - 1  # requeues in pop order
+            and self._pop_seq - first_seq == self._run_len
+            and first_push_seq == self._push_seq
+        )
+        if covers_suffix:
+            self._virtual_now = min(self._virtual_now, first_vnow)
+        heapq.heappush(self._heap, (finish, tiebreak, item))
 
     def peek(self) -> Any:
         return self._heap[0][2] if self._heap else None
@@ -200,3 +246,6 @@ class WeightedFairQueue(QueuePolicy):
         self._tiebreak = itertools.count(2**33)
         self._requeue_tiebreak = itertools.count()
         self._popped_finish.clear()
+        self._push_seq = 0
+        self._pop_seq = 0
+        self._run_first, self._run_len = None, 0

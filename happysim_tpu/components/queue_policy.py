@@ -160,16 +160,24 @@ class RankedHeapPolicy(QueuePolicy):
     """Base for heap policies ordered by ``(rank(item), tiebreak)`` where
     the rank is a pure function of the item (priority, deadline, ...).
 
-    Tiebreak ranges are segregated: pushes draw from a high counter,
-    requeues from a low one, so a requeued item re-enters AHEAD of every
-    equal-rank pushed peer — it popped first, so it sorted first; the undo
-    restores that — and successive requeues keep their pop order.
+    Requeue restores the popped item's EXACT heap key from a pop-time
+    snapshot, so the undo is literal: the item re-enters with the very
+    (rank, tiebreak) it held, and any interleaving of undo batches
+    reproduces the untouched queue. (A fresh low-range tiebreak — the
+    previous design — breaks across SUCCESSIVE undo batches: the counter
+    only grows, so the second batch's true head lands behind the first
+    batch's equal-rank items. The differential fuzz in
+    ``tests/unit/test_queue_policy_fuzz.py`` catches this in seconds.)
+    A requeue of an item this queue never popped — driver misuse, or a
+    snapshot evicted past the bound — falls back to a low-range tiebreak
+    that still precedes every pushed peer.
     """
 
     def __init__(self):
         self._heap: list[tuple[float, int, Any]] = []
         self._tiebreak = itertools.count(2**33)
         self._requeue_tiebreak = itertools.count()
+        self._pop_keys = PopSnapshots()
 
     def _rank_of(self, item: Any) -> float:
         raise NotImplementedError
@@ -181,13 +189,16 @@ class RankedHeapPolicy(QueuePolicy):
         self._heap_push(item)
 
     def requeue(self, item: Any) -> None:
-        """Undo a pop: same rank, low-range tiebreak."""
-        heapq.heappush(
-            self._heap, (self._rank_of(item), next(self._requeue_tiebreak), item)
-        )
+        """Undo a pop: restore the exact pop-time (rank, tiebreak)."""
+        key = self._pop_keys.take(item)
+        if key is None:
+            key = (self._rank_of(item), next(self._requeue_tiebreak))
+        heapq.heappush(self._heap, (*key, item))
 
     def pop(self) -> Any:
-        return heapq.heappop(self._heap)[2]
+        rank, tiebreak, item = heapq.heappop(self._heap)
+        self._pop_keys.remember(item, (rank, tiebreak))
+        return item
 
     def peek(self) -> Any:
         return self._heap[0][2]
@@ -199,6 +210,7 @@ class RankedHeapPolicy(QueuePolicy):
         self._heap.clear()
         self._tiebreak = itertools.count(2**33)
         self._requeue_tiebreak = itertools.count()
+        self._pop_keys.clear()
 
 
 class PriorityQueue(RankedHeapPolicy):

@@ -69,6 +69,11 @@ class QueuedResource(Entity):
     def handle_queued_event(self, event: Event):
         raise NotImplementedError
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: drop buffered work whose delivery events
+        died with the cleared heap. Cumulative queue counters survive."""
+        self.queue.reset_in_flight()
+
     # -- event flow --------------------------------------------------------
     def handle_event(self, event: Event):
         """Incoming requests are enqueued; the driver pulls them back out."""

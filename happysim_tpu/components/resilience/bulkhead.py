@@ -56,6 +56,13 @@ class Bulkhead(Entity):
     def active_count(self) -> int:
         return self._active
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: admitted requests' completions and queued
+        requests' delivery events died with the cleared heap. Ghost active
+        counts would permanently exhaust the permits. Counters survive."""
+        self._active = 0
+        self._queue.clear()
+
     @property
     def queue_depth(self) -> int:
         return len(self._queue)

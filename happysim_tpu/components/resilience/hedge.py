@@ -53,6 +53,11 @@ class Hedge(Entity):
     def in_flight_count(self) -> int:
         return len(self._in_flight)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: primaries/hedges in flight died with the
+        cleared heap; forget their race bookkeeping. Win counters survive."""
+        self._in_flight.clear()
+
     @property
     def stats(self) -> HedgeStats:
         return HedgeStats(

@@ -110,6 +110,14 @@ class Resource(Entity):
             max_waiters=self.max_waiters,
         )
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: grant holders and queued waiters died
+        with the cleared heap — their releases will never come, so held
+        capacity returns and the wait queue empties. Totals survive."""
+        self._in_use = 0.0
+        self._waiters.clear()
+        self._wait_started.clear()
+
     # -- acquisition -------------------------------------------------------
     def acquire(self, amount: float = 1.0) -> SimFuture:
         """Future resolving with a :class:`Grant` once capacity is free."""

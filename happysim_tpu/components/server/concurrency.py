@@ -27,6 +27,13 @@ class ConcurrencyModel(ABC):
     @abstractmethod
     def active(self) -> float: ...
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: the tracked requests' continuations died
+        with the cleared event heap, so the in-flight count returns to 0.
+        Models with extra bookkeeping override."""
+        while self.active > 0:
+            self.release()
+
 
 class FixedConcurrency(ConcurrencyModel):
     """At most ``limit`` requests in flight."""

@@ -71,6 +71,14 @@ class Server(QueuedResource):
         """Pending queue depth (QueueDepthScaling's input signal)."""
         return self.queue_depth
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: free the concurrency slots of requests
+        whose continuations died with the cleared heap (a stale slot at
+        concurrency=1 would queue the entire next run behind a ghost).
+        started/completed/busy counters survive."""
+        super().reset_in_flight()
+        self.concurrency.reset_in_flight()
+
     def handle_queued_event(self, event: Event):
         self.concurrency.acquire(event)
         self.requests_started += 1

@@ -76,6 +76,14 @@ class Barrier(SyncPrimitive):
     def generation(self) -> int:
         return self._generation
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: parked parties died with the cleared
+        heap; the generation advances so stale arrivals cannot trip the
+        next cycle. Counters survive."""
+        self._waiters.clear()
+        self._generation += 1
+        self._broken = False
+
     @property
     def stats(self) -> BarrierStats:
         return BarrierStats(

@@ -74,6 +74,11 @@ class Condition(SyncPrimitive):
     def waiters(self) -> int:
         return len(self._waiters)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: parked waiters died with the cleared
+        heap; notifications would wake ghosts. Counters survive."""
+        self._waiters.clear()
+
     @property
     def stats(self) -> ConditionStats:
         return ConditionStats(

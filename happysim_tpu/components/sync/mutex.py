@@ -65,6 +65,14 @@ class Mutex(SyncPrimitive):
     def waiters(self) -> int:
         return len(self._waiters)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: the holder and queued waiters died with
+        the cleared heap — unlock and empty the wait queue, or the mutex
+        deadlocks every post-reset acquire. Counters survive."""
+        self._locked = False
+        self._owner = None
+        self._waiters.clear()
+
     @property
     def stats(self) -> MutexStats:
         return MutexStats(

@@ -88,6 +88,15 @@ class RWLock(SyncPrimitive):
     def waiters(self) -> int:
         return len(self._waiters)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: readers/writer and queued waiters died
+        with the cleared heap — clear the lock state or it deadlocks.
+        Counters survive."""
+        self._active_readers = 0
+        self._write_locked = False
+        self._waiters.clear()
+        self._waiting_writers = 0
+
     @property
     def stats(self) -> RWLockStats:
         return RWLockStats(

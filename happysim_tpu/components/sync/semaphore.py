@@ -66,6 +66,13 @@ class Semaphore(SyncPrimitive):
     def waiters(self) -> int:
         return len(self._waiters)
 
+    def reset_in_flight(self) -> None:
+        """Simulation-reset hook: holders and queued waiters died with the
+        cleared heap — restore all permits and empty the wait queue.
+        Counters survive."""
+        self._available = self._capacity
+        self._waiters.clear()
+
     @property
     def stats(self) -> SemaphoreStats:
         return SemaphoreStats(

@@ -71,7 +71,9 @@ class SimulationControl:
     def reset(self) -> None:
         """Rewind: clear heap, re-prime sources/probes, replay pre-run events.
 
-        Entity state is intentionally NOT reset (matches the reference).
+        Cumulative entity state is intentionally NOT reset (matches the
+        reference); transient in-flight bookkeeping IS, via each entity's
+        opt-in ``reset_in_flight()`` — see ``Simulation._reset``.
         """
         self._paused = False
         self._pause_requested = False

@@ -336,6 +336,18 @@ class Simulation:
             self._event_heap.push(probe.start(self._start))
         if self.fault_schedule is not None:
             self._event_heap.push(self.fault_schedule.start(self._start))
+        # Clearing the heap killed every in-flight continuation, so any
+        # entity bookkeeping that counts them (a server's occupied
+        # concurrency slot, a queue's buffered-but-undelivered work) now
+        # tracks ghosts — a Server at concurrency=1 would queue the whole
+        # next run behind a request that no longer exists. Entities that
+        # hold such state opt in via ``reset_in_flight()``; cumulative
+        # counters (completions, drops, busy time) survive, matching the
+        # reference's keep-entity-state reset semantics.
+        for entity in self.entities:
+            hook = getattr(entity, "reset_in_flight", None)
+            if callable(hook):
+                hook()
         replay, self._pre_run_specs = self._pre_run_specs, []
         for spec in replay:
             clone = Event(

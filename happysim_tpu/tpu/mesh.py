@@ -96,9 +96,11 @@ def host_replica_mesh(
     # Group by owning process, not list order: the global device list is
     # not guaranteed host-contiguous, and an interleaved reshape would
     # silently invert the hosts=DCN / replicas=ICI mapping (every
-    # intra-row reduction crossing DCN). Single-process emulation
-    # (n_hosts > process_count) keeps the given order.
-    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    # intra-row reduction crossing DCN). The sort is STABLE and keyed on
+    # process_index alone, so single-process emulation (n_hosts >
+    # process_count, all devices on one process) keeps the caller's
+    # device order — a custom per-host layout reshapes as given.
+    devices = sorted(devices, key=lambda d: d.process_index)
     grid = np.asarray(devices).reshape(n_hosts, len(devices) // n_hosts)
     return Mesh(grid, (HOST_AXIS, REPLICA_AXIS))
 

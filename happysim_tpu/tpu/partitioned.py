@@ -72,10 +72,14 @@ class PartitionedCheckpoint:
     n_replicas: int
     seed: int
     state: dict  # partition-major np arrays (P, R, ...)
+    # The defaults are impossible-by-construction sentinels meaning "the
+    # checkpoint predates this field", NOT plausible values: resume
+    # validation skips sentinel fields but must reject any REAL mismatch
+    # (a run's legitimate outbox_capacity=0 is still checked).
     model_fingerprint: str = ""
-    window_s: float = 0.0
-    max_events_per_window: int = 0
-    outbox_capacity: int = 0
+    window_s: float = -1.0
+    max_events_per_window: int = -1
+    outbox_capacity: int = -1
 
     def save(self, path: str) -> None:
         meta = {
@@ -285,14 +289,16 @@ def _run_partitioned_segmented(
             # obscure scan-carry shape error deep inside the jit.
             "outbox_capacity": (resume_from.outbox_capacity, outbox_capacity),
         }
-        # Default-valued meta in OPTIONAL fields = "unknown" (checkpoint
+        # Sentinel-valued meta in OPTIONAL fields = "unknown" (checkpoint
         # predates the field): skip those rather than reject older files.
-        # seed/n_replicas/etc. are always recorded, so 0 there is real.
+        # The sentinels are impossible real values (negative counts, empty
+        # fingerprint), so a legitimately-recorded 0 is still validated.
+        # seed/n_replicas/etc. are always recorded and always checked.
         optional_defaults = {
             "model_fingerprint": "",
-            "window_s": 0.0,
-            "max_events_per_window": 0,
-            "outbox_capacity": 0,
+            "window_s": -1.0,
+            "max_events_per_window": -1,
+            "outbox_capacity": -1,
         }
         bad = {
             k: v
@@ -407,6 +413,11 @@ def run_partitioned(
     """
     if not model.remotes:
         raise ValueError("run_partitioned needs at least one model.remote(...)")
+    if outbox_capacity < 1:
+        raise ValueError(
+            f"outbox_capacity={outbox_capacity} must be >= 1: every remote "
+            "edge sends through the fixed-capacity outbox ring"
+        )
     min_latency = min(r.latency_s for r in model.remotes)
     if window_s > min_latency + 1e-9:
         raise ValueError(

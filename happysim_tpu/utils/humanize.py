@@ -33,10 +33,15 @@ def humanize_duration(value: Union[Duration, Instant, int, float]) -> str:
             scaled = seconds * factor
             if scaled < 999.5:  # "%.3g" would round anything above to 1e+03
                 return f"{sign}{scaled:.3g}{unit}"
-        return f"{sign}{seconds:.3g}s"
+        if f"{seconds:.3g}" != "60":  # 59.96 promotes to "1m 0s", not "60s"
+            return f"{sign}{seconds:.3g}s"
     minutes, rem = divmod(seconds, 60.0)
+    rem_str = f"{rem:.3g}"
+    if rem_str == "60":  # post-rounding carry: never print "1m 60s"
+        minutes += 1
+        rem_str = "0"
     if minutes < 60:
-        return f"{sign}{int(minutes)}m {rem:.3g}s"
+        return f"{sign}{int(minutes)}m {rem_str}s"
     hours, minutes = divmod(int(minutes), 60)
     return f"{sign}{hours}h {minutes:02d}m"
 

@@ -156,6 +156,11 @@ class SimulationBridge:
             "pending_events": control_state.pending_events,
             "is_paused": control_state.is_paused,
             "is_completed": control_state.is_completed,
+            # Bumped by reset(): polling clients compare it to their last
+            # seen value and re-zero event/trace cursors, exactly like the
+            # SSE stream does server-side — a reset in one tab must not
+            # leave another tab filtering on stale high cursors forever.
+            "reset_generation": self.reset_generation,
             "entities": {
                 name: serialize_entity(entity)
                 for name, entity in self.topology.entities.items()
@@ -292,4 +297,7 @@ class SimulationBridge:
                 self._last_target = None
                 self._entity_history.clear()
                 self._last_snapshot_s = -1.0
+                # Trace cursors re-zero with the generation bump, so the
+                # debugger's buffer and seq counter restart too.
+                self.code_debugger.reset_traces()
             return self.state()

@@ -168,6 +168,16 @@ class CodeDebugger:
         self._step_mode = step
         self._resume_gate.set()
 
+    def reset_traces(self) -> None:
+        """Clear the trace buffer and restart seq numbering. Paired with
+        ``bridge.reset()``: clients re-zero their trace cursors when the
+        reset generation bumps, so retained pre-reset traces (and their
+        high seqs) must not survive or the dead run's execution replays
+        into the fresh one."""
+        with self._lock:
+            self._traces.clear()
+            self._trace_seq = 0
+
     def drain_traces(self) -> list[ExecutionTrace]:
         """Destructive read of the whole buffer. Single-consumer only —
         a second poller steals traces; concurrent consumers (multiple

@@ -195,6 +195,26 @@ class TestCodeStepping:
         )
         bridge.close()
 
+    def test_reset_clears_traces_and_restarts_seq(self):
+        """bridge.reset() restarts the trace stream with the event stream:
+        clients re-zero their cursors on the generation bump, so retained
+        pre-reset traces (with their high seqs) must not replay into the
+        fresh run."""
+        sim, server, sink, _ = build_sim(duration=1.0)
+        bridge = SimulationBridge(sim)
+        bridge.code_debugger.activate_entity(server)
+        bridge.run_all()
+        stale, cursor = bridge.code_debugger.traces_since(0)
+        assert stale and cursor > 0
+        bridge.reset()
+        replayed, cursor = bridge.code_debugger.traces_since(0)
+        assert replayed == [] and cursor == 0
+        # Fresh run: seqs restart from 1, matching the re-zeroed cursor.
+        bridge.run_all()
+        fresh, _ = bridge.code_debugger.traces_since(0)
+        assert fresh and fresh[0].seq == 1
+        bridge.close()
+
     def test_code_breakpoint_blocks_until_continue(self):
         sim, server, sink, _ = build_sim(duration=1.0)
         bridge = SimulationBridge(sim)

@@ -1,0 +1,165 @@
+"""B-tree structural edge cases: split cascades, depth growth, delete
+bookkeeping, scan boundaries, and cost accounting.
+
+Parity target: ``happysimulator/components/storage/btree.py`` (order-based
+splits, per-level page costs); complements the happy-path coverage in
+``tests/unit/test_storage.py``.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from happysim_tpu.components.storage import BTree
+
+
+def scan_sync(tree: BTree, **kwargs) -> list:
+    """Drive the cost-yielding scan generator to its return value."""
+    gen = tree.scan(**kwargs)
+    try:
+        while True:
+            next(gen)
+    except StopIteration as stop:
+        return stop.value
+
+
+def fill(tree: BTree, n: int, *, shuffled: bool = False, seed: int = 0):
+    keys = [f"k{i:05d}" for i in range(n)]
+    if shuffled:
+        random.Random(seed).shuffle(keys)
+    for key in keys:
+        tree.put_sync(key, key.upper())
+    return sorted(keys)
+
+
+class TestSplitsAndDepth:
+    def test_root_splits_exactly_at_order(self):
+        tree = BTree("t", order=4)
+        for i in range(3):  # order-1 keys fit in the root
+            tree.put_sync(f"k{i}", i)
+        assert tree.depth == 1 and tree.stats.node_splits == 0
+        tree.put_sync("k3", 3)  # the order-th key forces the first split
+        assert tree.depth == 2
+        assert tree.stats.node_splits >= 1
+
+    def test_depth_grows_logarithmically(self):
+        tree = BTree("t", order=4)
+        fill(tree, 500, shuffled=True)
+        # order 4 => depth bounded by ~log2(500) + slack; a linear-depth
+        # bug (split not reattaching children) blows way past this.
+        assert tree.depth <= 12
+        assert tree.size == 500
+
+    def test_sorted_and_shuffled_inserts_agree(self):
+        a, b = BTree("a", order=6), BTree("b", order=6)
+        keys = fill(a, 300)
+        fill(b, 300, shuffled=True, seed=7)
+        assert [k for k, _ in scan_sync(a)] == keys
+        assert [k for k, _ in scan_sync(b)] == keys
+
+    def test_min_order_three(self):
+        tree = BTree("t", order=3)
+        keys = fill(tree, 100, shuffled=True)
+        assert [k for k, _ in scan_sync(tree)] == keys
+        with pytest.raises(ValueError):
+            BTree("bad", order=2)
+
+
+class TestUpdatesAndDeletes:
+    def test_update_does_not_grow_size(self):
+        tree = BTree("t", order=4)
+        tree.put_sync("k", 1)
+        tree.put_sync("k", 2)
+        assert tree.size == 1
+        assert tree.get_sync("k") == 2
+
+    def test_delete_internal_routing_finds_leaf_copy(self):
+        """Separator keys are routing copies; deleting a key that also
+        appears as a separator must remove the LEAF record."""
+        tree = BTree("t", order=4)
+        keys = fill(tree, 64)
+        for key in keys:
+            assert tree.delete_sync(key), key
+        assert tree.size == 0
+        assert scan_sync(tree) == []
+
+    def test_delete_missing_returns_false_and_counts(self):
+        tree = BTree("t", order=4)
+        tree.put_sync("a", 1)
+        assert not tree.delete_sync("zz")
+        assert tree.size == 1
+        assert tree.stats.deletes == 1
+
+    def test_reinsert_after_delete(self):
+        tree = BTree("t", order=4)
+        fill(tree, 32)
+        tree.delete_sync("k00010")
+        assert tree.get_sync("k00010") is None
+        tree.put_sync("k00010", "back")
+        assert tree.get_sync("k00010") == "back"
+
+    def test_random_interleaved_ops_match_dict(self):
+        tree = BTree("t", order=5)
+        oracle: dict[str, int] = {}
+        rng = random.Random(3)
+        for step in range(800):
+            key = f"k{rng.randint(0, 120):04d}"
+            action = rng.random()
+            if action < 0.55:
+                oracle[key] = step
+                tree.put_sync(key, step)
+            elif action < 0.8:
+                existed = key in oracle
+                oracle.pop(key, None)
+                assert tree.delete_sync(key) == existed
+            else:
+                assert tree.get_sync(key) == oracle.get(key)
+        assert tree.size == len(oracle)
+        assert [k for k, _ in scan_sync(tree)] == sorted(oracle)
+
+
+class TestScanBoundaries:
+    def test_scan_range_is_inclusive_exclusive(self):
+        tree = BTree("t", order=4)
+        fill(tree, 20)
+        keys = [k for k, _ in scan_sync(tree, start_key="k00005", end_key="k00010")]
+        assert keys == [f"k{i:05d}" for i in range(5, 10)]
+
+    def test_scan_open_ends(self):
+        tree = BTree("t", order=4)
+        all_keys = fill(tree, 10)
+        assert [k for k, _ in scan_sync(tree, start_key="k00007")] == all_keys[7:]
+        assert [k for k, _ in scan_sync(tree, end_key="k00003")] == all_keys[:3]
+
+    def test_scan_empty_tree(self):
+        assert scan_sync(BTree("t", order=4)) == []
+
+    def test_scan_range_outside_keys(self):
+        tree = BTree("t", order=4)
+        fill(tree, 5)
+        assert scan_sync(tree, start_key="zzz") == []
+
+
+class TestCostModel:
+    def test_get_latency_tracks_depth(self):
+        tree = BTree("t", order=4, page_read_latency=0.001)
+        fill(tree, 200, shuffled=True)
+        gen = tree.get("k00100")
+        first_cost = next(gen)
+        assert first_cost == pytest.approx(tree.depth * 0.001)
+
+    def test_put_pays_write_after_read(self):
+        tree = BTree("t", order=4, page_read_latency=0.001, page_write_latency=0.004)
+        costs = list(tree.put("a", 1))
+        assert costs[0] == pytest.approx(tree.depth * 0.001, abs=1e-9) or costs
+        assert any(c == pytest.approx(0.004) or c >= 0.004 for c in costs)
+
+    def test_hit_miss_accounting(self):
+        tree = BTree("t", order=4)
+        tree.put_sync("a", 1)
+        tree.get_sync("a")
+        tree.get_sync("missing")
+        assert tree.stats.hits == 1
+        assert tree.stats.misses == 1

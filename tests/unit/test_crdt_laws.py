@@ -1,0 +1,248 @@
+"""CRDT algebraic laws: merge must be commutative, associative, and
+idempotent, and every replica schedule must converge.
+
+These laws ARE the correctness contract of state-based CRDTs — a merge
+that violates any of them diverges silently under gossip reordering or
+redelivery. Exercised with randomized op schedules over every CRDT type.
+
+Reference analogue: the per-type unit files
+``happysimulator/tests/unit/test_g_counter.py`` / ``test_pn_counter.py`` /
+``test_lww_register.py`` / ``test_or_set.py`` (directed cases); this file
+adds the law-based randomized coverage.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from happysim_tpu.components.crdt import GCounter, LWWRegister, ORSet, PNCounter
+
+
+def clone(crdt):
+    """Deep copy through the wire format (also exercises serialization)."""
+    return type(crdt).from_dict(crdt.to_dict())
+
+
+def make(kind: str, node_id: str):
+    return {
+        "g_counter": GCounter,
+        "pn_counter": PNCounter,
+        "lww": LWWRegister,
+        "or_set": ORSet,
+    }[kind](node_id)
+
+
+def random_ops(crdt, rng: random.Random, n_ops: int = 12) -> None:
+    """Apply a random local-op schedule appropriate to the type."""
+    if isinstance(crdt, GCounter):
+        for _ in range(n_ops):
+            crdt.increment(rng.randint(1, 5))
+    elif isinstance(crdt, PNCounter):
+        for _ in range(n_ops):
+            if rng.random() < 0.6:
+                crdt.increment(rng.randint(1, 5))
+            else:
+                crdt.decrement(rng.randint(1, 3))
+    elif isinstance(crdt, LWWRegister):
+        for _ in range(n_ops):
+            crdt.set(rng.randint(0, 99), timestamp=rng.randint(1, 50))
+    elif isinstance(crdt, ORSet):
+        for _ in range(n_ops):
+            element = f"e{rng.randint(0, 5)}"
+            if rng.random() < 0.65:
+                crdt.add(element)
+            else:
+                crdt.remove(element)
+    else:  # pragma: no cover
+        raise AssertionError(type(crdt))
+
+
+def observed(crdt):
+    """The convergent observable state (value; ORSet: the element set)."""
+    if isinstance(crdt, ORSet):
+        return crdt.value
+    if isinstance(crdt, LWWRegister):
+        return (crdt.value, crdt.timestamp)
+    return crdt.value
+
+
+KINDS = ["g_counter", "pn_counter", "lww", "or_set"]
+
+
+@pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.parametrize("seed", range(4))
+class TestMergeLaws:
+    def _two(self, kind, seed):
+        rng = random.Random(seed)
+        a, b = make(kind, "A"), make(kind, "B")
+        random_ops(a, rng)
+        random_ops(b, rng)
+        return a, b
+
+    def test_commutative(self, kind, seed):
+        a, b = self._two(kind, seed)
+        ab, ba = clone(a), clone(b)
+        ab.merge(clone(b))
+        ba.merge(clone(a))
+        assert observed(ab) == observed(ba)
+
+    def test_associative(self, kind, seed):
+        a, b = self._two(kind, seed)
+        c = make(kind, "C")
+        random_ops(c, random.Random(seed + 100))
+        left = clone(a)
+        left.merge(clone(b))
+        left.merge(clone(c))
+        bc = clone(b)
+        bc.merge(clone(c))
+        right = clone(a)
+        right.merge(bc)
+        assert observed(left) == observed(right)
+
+    def test_idempotent(self, kind, seed):
+        a, _ = self._two(kind, seed)
+        merged = clone(a)
+        merged.merge(clone(a))
+        assert observed(merged) == observed(a)
+        merged.merge(clone(a))  # re-delivery of the same state
+        assert observed(merged) == observed(a)
+
+    def test_serialization_roundtrip_preserves_state(self, kind, seed):
+        a, _ = self._two(kind, seed)
+        assert observed(clone(a)) == observed(a)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.parametrize("seed", range(3))
+def test_replicas_converge_under_random_gossip(kind, seed):
+    """N replicas, random ops, then enough random pairwise merges that the
+    union of states reaches everyone: all observables must agree."""
+    rng = random.Random(seed)
+    replicas = [make(kind, f"N{i}") for i in range(4)]
+    for replica in replicas:
+        random_ops(replica, rng)
+    # Random gossip until closure, then a deterministic full round so
+    # every replica has definitely absorbed every other.
+    for _ in range(12):
+        i, j = rng.sample(range(4), 2)
+        replicas[i].merge(clone(replicas[j]))
+    for i in range(4):
+        for j in range(4):
+            if i != j:
+                replicas[i].merge(clone(replicas[j]))
+    first = observed(replicas[0])
+    for replica in replicas[1:]:
+        assert observed(replica) == first, (
+            f"replicas diverged: {observed(replica)!r} != {first!r}"
+        )
+
+
+class TestORSetSemantics:
+    def test_add_wins_over_concurrent_remove(self):
+        a, b = ORSet("A"), ORSet("B")
+        a.add("x")
+        b.merge(clone(a))
+        # Concurrently: A removes x (observing its tag), B re-adds x.
+        a.remove("x")
+        b.add("x")
+        a.merge(clone(b))
+        b.merge(clone(a))
+        assert "x" in a.value and "x" in b.value  # the unseen add survives
+
+    def test_observed_remove_holds_without_concurrent_add(self):
+        a, b = ORSet("A"), ORSet("B")
+        a.add("x")
+        b.merge(clone(a))
+        b.remove("x")
+        a.merge(clone(b))
+        assert "x" not in a.value and "x" not in b.value
+
+    def test_re_add_after_remove_is_visible(self):
+        a = ORSet("A")
+        a.add("x")
+        a.remove("x")
+        a.add("x")
+        assert a.contains("x")
+
+    def test_remove_unseen_element_is_noop(self):
+        a = ORSet("A")
+        a.remove("ghost")
+        assert a.value == frozenset()
+
+    def test_tag_counter_survives_roundtrip(self):
+        """from_dict must resume tagging past existing own tags, or a
+        restored replica mints tags that collide with its tombstones and
+        fresh adds get silently deleted."""
+        a = ORSet("A")
+        a.add("x")
+        a.remove("x")
+        restored = clone(a)
+        restored.add("x")
+        assert restored.contains("x")
+
+
+class TestLWWSemantics:
+    def test_higher_timestamp_wins(self):
+        a, b = LWWRegister("A"), LWWRegister("B")
+        a.set("old", timestamp=1)
+        b.set("new", timestamp=2)
+        a.merge(clone(b))
+        assert a.value == "new"
+
+    def test_lower_timestamp_loses_even_if_merged_later(self):
+        a, b = LWWRegister("A"), LWWRegister("B")
+        a.set("winner", timestamp=9)
+        b.set("loser", timestamp=3)
+        a.merge(clone(b))
+        assert a.value == "winner"
+
+    def test_equal_timestamp_tiebreak_is_symmetric(self):
+        """Concurrent same-timestamp writes must converge to the SAME
+        winner on both replicas (writer-id ordering), whichever side
+        merges first."""
+        a, b = LWWRegister("A"), LWWRegister("B")
+        a.set("from_a", timestamp=5)
+        b.set("from_b", timestamp=5)
+        a.merge(clone(b))
+        b.merge(clone(a))
+        assert a.value == b.value
+
+    def test_unset_register_adopts_any_write(self):
+        a, b = LWWRegister("A"), LWWRegister("B")
+        b.set(42, timestamp=1)
+        a.merge(clone(b))
+        assert a.value == 42
+
+
+class TestCounterSemantics:
+    def test_gcounter_merge_takes_per_node_max(self):
+        a, b = GCounter("A"), GCounter("B")
+        a.increment(3)
+        b.merge(clone(a))  # b sees A=3
+        a.increment(2)  # A=5 locally
+        b.increment(7)  # B=7
+        a.merge(clone(b))
+        assert a.value == 12  # max(A)=5 + max(B)=7, no double count
+        assert a.node_value("A") == 5 and a.node_value("B") == 7
+
+    def test_gcounter_rejects_negative(self):
+        counter = GCounter("A")
+        with pytest.raises(ValueError):
+            counter.increment(-1)
+
+    def test_pncounter_value_can_go_negative(self):
+        counter = PNCounter("A")
+        counter.decrement(5)
+        counter.increment(2)
+        assert counter.value == -3
+        assert counter.increments == 2 and counter.decrements == 5
+
+    def test_pncounter_concurrent_inc_dec_all_count(self):
+        a, b = PNCounter("A"), PNCounter("B")
+        a.increment(10)
+        b.decrement(4)
+        a.merge(clone(b))
+        b.merge(clone(a))
+        assert a.value == b.value == 6

@@ -1,0 +1,170 @@
+"""Event-lifecycle edges: hook ordering, one-shot firing, cancellation,
+and drop-unwind interactions.
+
+The composite tests (`test_hook_composition.py`) wire whole component
+stacks; these pin the CORE contracts those stacks rely on — hooks fire in
+registration order, exactly once, at the finish time (not schedule time);
+cancellation is lazy and idempotent; transfer moves rather than copies;
+a drop fires deferred hooks ahead of live ones.
+
+Parity target: ``happysimulator/core/event.py`` hook/cancel semantics and
+``happysimulator/tests/unit/test_event.py``.
+"""
+
+from __future__ import annotations
+
+from happysim_tpu import ConstantLatency, Instant, Server, Simulation, Sink, Source
+from happysim_tpu.core.event import Event
+
+
+def _instant(seconds: float) -> Instant:
+    return Instant.from_seconds(seconds)
+
+
+class TestHookOrdering:
+    def test_hooks_fire_in_registration_order(self):
+        order = []
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        event.add_completion_hook(lambda t: order.append("first") or None)
+        event.add_completion_hook(lambda t: order.append("second") or None)
+        event.add_completion_hook(lambda t: order.append("third") or None)
+        event._finish(None)
+        assert order == ["first", "second", "third"]
+
+    def test_hooks_fire_exactly_once(self):
+        calls = []
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        event.add_completion_hook(lambda t: calls.append(t) or None)
+        event._finish(None)
+        event._finish(None)  # one-shot: list was swapped out
+        assert len(calls) == 1
+
+    def test_hooks_receive_finish_time_not_schedule_time(self):
+        """A generator handler finishes LATER than the event's time; hooks
+        must see the completion instant (latency accounting depends on it)."""
+        seen = []
+        sink = Sink("sink")
+        server = Server(
+            "srv", service_time=ConstantLatency(0.25), downstream=sink
+        )
+        request = Event(_instant(0.0), "req", target=server)
+        request.add_completion_hook(lambda t: seen.append(t.to_seconds()) or None)
+        sim = Simulation(entities=[server, sink], end_time=_instant(2.0))
+        sim.schedule(request)
+        sim.run()
+        assert seen == [0.25]
+
+    def test_hook_produced_events_are_scheduled(self):
+        sink = Sink("sink")
+        event = Event(_instant(0.5), "op", target=Sink("other"))
+        event.add_completion_hook(
+            lambda t: Event(t, "follow_up", target=sink)
+        )
+        produced = event._finish(None)
+        assert [e.event_type for e in produced] == ["follow_up"]
+        assert produced[0].target is sink
+
+    def test_later_hook_sees_earlier_hooks_side_effects(self):
+        state = {}
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        event.add_completion_hook(lambda t: state.update(a=1) or None)
+        event.add_completion_hook(
+            lambda t: state.update(saw_a=("a" in state)) or None
+        )
+        event._finish(None)
+        assert state["saw_a"] is True
+
+
+class TestTransferHooks:
+    def test_transfer_moves_not_copies(self):
+        calls = []
+        inbound = Event(_instant(1.0), "in", target=Sink("a"))
+        inbound.add_completion_hook(lambda t: calls.append("x") or None)
+        relay = Event(_instant(1.0), "out", target=Sink("b"))
+        inbound.transfer_hooks(relay)
+        inbound._finish(None)  # must NOT fire the moved hook
+        assert calls == []
+        relay._finish(None)
+        assert calls == ["x"]
+
+    def test_transfer_preserves_order_after_recipients_own_hooks(self):
+        order = []
+        inbound = Event(_instant(1.0), "in", target=Sink("a"))
+        inbound.add_completion_hook(lambda t: order.append("moved") or None)
+        relay = Event(_instant(1.0), "out", target=Sink("b"))
+        relay.add_completion_hook(lambda t: order.append("own") or None)
+        inbound.transfer_hooks(relay)
+        relay._finish(None)
+        assert order == ["own", "moved"]
+
+
+class TestCancellation:
+    def test_cancelled_event_is_skipped_by_the_loop(self):
+        sink = Sink("sink")
+        sim = Simulation(entities=[sink], end_time=_instant(1.0))
+        event = Event(_instant(0.5), "op", target=sink)
+        sim.schedule(event)
+        event.cancel()
+        sim.run()
+        assert sink.events_received == 0
+
+    def test_cancel_is_idempotent(self):
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        event.cancel()
+        event.cancel()
+        assert event.cancelled
+
+    def test_cancelled_events_hooks_do_not_fire_via_loop(self):
+        calls = []
+        sink = Sink("sink")
+        sim = Simulation(entities=[sink], end_time=_instant(1.0))
+        event = Event(_instant(0.5), "op", target=sink)
+        event.add_completion_hook(lambda t: calls.append(t) or None)
+        sim.schedule(event)
+        event.cancel()
+        sim.run()
+        assert calls == []
+
+    def test_cancel_after_completion_changes_nothing(self):
+        calls = []
+        sink = Sink("sink")
+        sim = Simulation(entities=[sink], end_time=_instant(1.0))
+        event = Event(_instant(0.2), "op", target=sink)
+        event.add_completion_hook(lambda t: calls.append(t) or None)
+        sim.schedule(event)
+        sim.run()
+        event.cancel()
+        assert len(calls) == 1
+        assert sink.events_received == 1
+
+
+class TestDropUnwind:
+    def test_drop_marks_metadata_and_fires_hooks(self):
+        seen = []
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        event.add_completion_hook(lambda t: seen.append(event.dropped_by) or None)
+        event.complete_as_dropped(_instant(2.0), "queue_full")
+        assert seen == ["queue_full"]
+        assert event.dropped_by == "queue_full"
+
+    def test_deferred_hooks_fire_before_live_ones_on_drop(self):
+        order = []
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        event.context["_deferred_hooks"] = [
+            lambda t: order.append("deferred") or None
+        ]
+        event.add_completion_hook(lambda t: order.append("live") or None)
+        event.complete_as_dropped(_instant(2.0), "drop")
+        assert order == ["deferred", "live"]
+
+    def test_untouched_event_reports_not_dropped(self):
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        assert event.dropped_by is None
+
+    def test_drop_hooks_fire_once_even_if_finished_later(self):
+        calls = []
+        event = Event(_instant(1.0), "op", target=Sink("s"))
+        event.add_completion_hook(lambda t: calls.append("hook") or None)
+        event.complete_as_dropped(_instant(2.0), "drop")
+        event._finish(None)
+        assert calls == ["hook"]

@@ -1,0 +1,323 @@
+"""Boundary conditions of every cache-eviction policy.
+
+The consolidated datastore tests cover the happy paths; these target the
+edges where eviction policies classically go wrong: empty evict, single
+key, re-insert of an evicted key, remove-then-evict bookkeeping, tie
+breaks, segment-bound demotions (SLRU/2Q), hand wraparound (CLOCK), and
+expiry boundaries (TTL).
+
+Reference analogue: the per-policy cases in
+``happysimulator/tests/unit/test_eviction_policies.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu.components.datastore.eviction_policies import (
+    ClockEviction,
+    FIFOEviction,
+    LFUEviction,
+    LRUEviction,
+    RandomEviction,
+    SampledLRUEviction,
+    SLRUEviction,
+    TTLEviction,
+    TwoQueueEviction,
+)
+
+ALL_POLICIES = [
+    LRUEviction,
+    LFUEviction,
+    FIFOEviction,
+    lambda: RandomEviction(seed=7),
+    SLRUEviction,
+    lambda: SampledLRUEviction(sample_size=3, seed=7),
+    ClockEviction,
+    TwoQueueEviction,
+    lambda: TTLEviction(ttl=10.0, clock_func=lambda: 0.0),
+]
+
+IDS = [
+    "lru", "lfu", "fifo", "random", "slru", "sampled_lru", "clock", "2q", "ttl",
+]
+
+
+@pytest.mark.parametrize("factory", ALL_POLICIES, ids=IDS)
+class TestCommonBoundaries:
+    def test_evict_on_empty_returns_none(self, factory):
+        assert factory().evict() is None
+
+    def test_single_key_evicts_then_empty(self, factory):
+        policy = factory()
+        policy.on_insert("only")
+        assert policy.evict() == "only"
+        assert policy.evict() is None
+
+    def test_evicted_key_is_forgotten(self, factory):
+        """After eviction the policy holds no record: a later evict must
+        never return the same key twice."""
+        policy = factory()
+        for key in ("a", "b", "c"):
+            policy.on_insert(key)
+        victims = [policy.evict() for _ in range(3)]
+        assert sorted(victims) == ["a", "b", "c"]
+        assert policy.evict() is None
+
+    def test_remove_makes_key_unevictable(self, factory):
+        policy = factory()
+        policy.on_insert("a")
+        policy.on_insert("b")
+        policy.on_remove("a")
+        assert policy.evict() == "b"
+        assert policy.evict() is None
+
+    def test_remove_unknown_key_is_noop(self, factory):
+        policy = factory()
+        policy.on_insert("a")
+        policy.on_remove("ghost")
+        assert policy.evict() == "a"
+
+    def test_access_unknown_key_is_noop(self, factory):
+        policy = factory()
+        policy.on_access("ghost")
+        assert policy.evict() is None
+
+    def test_reinsert_after_eviction_is_fresh(self, factory):
+        policy = factory()
+        policy.on_insert("a")
+        policy.evict()
+        policy.on_insert("a")
+        assert policy.evict() == "a"
+
+    def test_clear_empties_all_bookkeeping(self, factory):
+        policy = factory()
+        for key in ("a", "b"):
+            policy.on_insert(key)
+        policy.on_access("a")
+        policy.clear()
+        assert policy.evict() is None
+
+    def test_duplicate_insert_does_not_double_track(self, factory):
+        policy = factory()
+        policy.on_insert("a")
+        policy.on_insert("a")
+        assert policy.evict() == "a"
+        assert policy.evict() is None
+
+
+class TestLRUOrder:
+    def test_access_refreshes_recency(self):
+        policy = LRUEviction()
+        for key in ("a", "b", "c"):
+            policy.on_insert(key)
+        policy.on_access("a")  # a becomes most recent
+        assert policy.evict() == "b"
+        assert policy.evict() == "c"
+        assert policy.evict() == "a"
+
+    def test_reinsert_refreshes_recency(self):
+        policy = LRUEviction()
+        policy.on_insert("a")
+        policy.on_insert("b")
+        policy.on_insert("a")  # upsert counts as a touch
+        assert policy.evict() == "b"
+
+
+class TestLFUTies:
+    def test_frequency_orders_victims(self):
+        policy = LFUEviction()
+        for key in ("a", "b"):
+            policy.on_insert(key)
+        policy.on_access("a")
+        assert policy.evict() == "b"
+
+    def test_insertion_order_breaks_frequency_ties(self):
+        policy = LFUEviction()
+        for key in ("x", "y", "z"):
+            policy.on_insert(key)  # all count 0
+        assert policy.evict() == "x"
+        assert policy.evict() == "y"
+
+    def test_evicted_key_restarts_at_zero(self):
+        policy = LFUEviction()
+        policy.on_insert("a")
+        for _ in range(5):
+            policy.on_access("a")
+        policy.on_insert("b")
+        assert policy.evict() == "b"  # b is colder
+        policy.on_insert("b")
+        policy.on_access("b")
+        policy.on_insert("c")
+        assert policy.evict() == "c"
+
+
+class TestFIFOOrder:
+    def test_access_does_not_refresh(self):
+        policy = FIFOEviction()
+        policy.on_insert("a")
+        policy.on_insert("b")
+        policy.on_access("a")  # FIFO ignores touches
+        assert policy.evict() == "a"
+
+
+class TestTTLBoundaries:
+    def test_exactly_at_ttl_is_not_expired(self):
+        now = {"t": 0.0}
+        policy = TTLEviction(ttl=10.0, clock_func=lambda: now["t"])
+        policy.on_insert("a")
+        now["t"] = 10.0  # age == ttl: strictly-greater contract
+        assert not policy.is_expired("a")
+        now["t"] = 10.0000001
+        assert policy.is_expired("a")
+
+    def test_expired_keys_evict_before_fresh_ones(self):
+        now = {"t": 0.0}
+        policy = TTLEviction(ttl=5.0, clock_func=lambda: now["t"])
+        policy.on_insert("old")
+        now["t"] = 6.0
+        policy.on_insert("fresh")
+        assert policy.evict() == "old"
+
+    def test_no_expired_falls_back_to_insertion_order(self):
+        policy = TTLEviction(ttl=100.0, clock_func=lambda: 0.0)
+        policy.on_insert("first")
+        policy.on_insert("second")
+        assert policy.evict() == "first"
+
+    def test_get_expired_keys_lists_all(self):
+        now = {"t": 0.0}
+        policy = TTLEviction(ttl=1.0, clock_func=lambda: now["t"])
+        policy.on_insert("a")
+        policy.on_insert("b")
+        now["t"] = 2.0
+        policy.on_insert("c")
+        assert sorted(policy.get_expired_keys()) == ["a", "b"]
+
+
+class TestSLRUSegments:
+    def test_one_touch_keys_never_displace_working_set(self):
+        policy = SLRUEviction(protected_ratio=0.5)
+        policy.on_insert("hot")
+        policy.on_access("hot")  # promoted to protected
+        for i in range(5):  # a scan of one-touch keys
+            policy.on_insert(f"scan{i}")
+        victims = [policy.evict() for _ in range(5)]
+        assert "hot" not in victims
+
+    def test_promotion_demotes_protected_lru_at_bound(self):
+        policy = SLRUEviction(protected_ratio=0.5)
+        for key in ("a", "b", "c", "d"):
+            policy.on_insert(key)
+        policy.on_access("a")  # protected: [a]
+        policy.on_access("b")  # max_protected = 2 -> protected: [a, b]
+        policy.on_access("c")  # over bound: a demotes to probationary
+        assert policy.protected_size <= 2
+        # a went back to probationary, so it is evictable before b/c.
+        victims = [policy.evict(), policy.evict()]
+        assert "a" in victims
+
+    def test_protected_exhausts_after_probationary(self):
+        policy = SLRUEviction()
+        policy.on_insert("p")
+        policy.on_access("p")
+        assert policy.probationary_size == 0
+        assert policy.evict() == "p"  # falls back to protected
+
+
+class TestClockHand:
+    def test_second_chance_spares_referenced_key(self):
+        policy = ClockEviction()
+        for key in ("a", "b", "c"):
+            policy.on_insert(key)
+        # All ref bits are set on insert; first sweep clears them, so the
+        # first victim is the first unreferenced key the hand meets.
+        first = policy.evict()
+        policy.on_access("b") if first != "b" else policy.on_access("c")
+        second = policy.evict()
+        assert second != first
+        assert policy.size == 1
+
+    def test_hand_stays_valid_after_remove(self):
+        policy = ClockEviction()
+        for key in ("a", "b", "c", "d"):
+            policy.on_insert(key)
+        policy.evict()
+        policy.on_remove("c") if policy.size and "c" in policy._ref_bits else None
+        # Whatever remains must still evict cleanly to empty.
+        drained = []
+        while policy.size:
+            drained.append(policy.evict())
+        assert len(drained) == len(set(drained))
+        assert policy.evict() is None
+
+    def test_all_referenced_still_terminates(self):
+        policy = ClockEviction()
+        for key in ("a", "b"):
+            policy.on_insert(key)
+        policy.on_access("a")
+        policy.on_access("b")
+        assert policy.evict() in ("a", "b")
+
+
+class TestTwoQueue:
+    def test_one_hit_wonders_wash_out_of_kin(self):
+        policy = TwoQueueEviction(kin_ratio=0.5)
+        policy.on_insert("hot")
+        policy.on_access("hot")  # promoted to Am
+        for i in range(4):
+            policy.on_insert(f"cold{i}")
+        victims = [policy.evict() for _ in range(4)]
+        assert "hot" not in victims
+
+    def test_promotion_requires_second_touch(self):
+        policy = TwoQueueEviction(kin_ratio=0.25)
+        policy.on_insert("once")
+        policy.on_insert("twice")
+        policy.on_access("twice")
+        assert policy.evict() == "once"  # still in Kin; "twice" is in Am
+
+    def test_am_lru_order(self):
+        policy = TwoQueueEviction(kin_ratio=0.25)
+        for key in ("a", "b"):
+            policy.on_insert(key)
+            policy.on_access(key)  # both in Am
+        policy.on_access("a")  # a most recent
+        assert policy.evict() == "b"
+
+
+class TestSampledLRU:
+    def test_small_population_degenerates_to_exact_lru(self):
+        policy = SampledLRUEviction(sample_size=10, seed=1)
+        for key in ("a", "b", "c"):
+            policy.on_insert(key)
+        policy.on_access("a")
+        # Sample covers the whole population: exact LRU victim.
+        assert policy.evict() == "b"
+
+    def test_seeded_runs_reproduce(self):
+        def run():
+            policy = SampledLRUEviction(sample_size=2, seed=42)
+            for i in range(10):
+                policy.on_insert(f"k{i}")
+            return [policy.evict() for _ in range(10)]
+
+        assert run() == run()
+
+
+class TestRandomEviction:
+    def test_seeded_runs_reproduce(self):
+        def run():
+            policy = RandomEviction(seed=5)
+            for i in range(8):
+                policy.on_insert(f"k{i}")
+            return [policy.evict() for _ in range(8)]
+
+        assert run() == run()
+
+    def test_every_key_eventually_evicted_once(self):
+        policy = RandomEviction(seed=11)
+        keys = {f"k{i}" for i in range(6)}
+        for key in keys:
+            policy.on_insert(key)
+        assert {policy.evict() for _ in range(6)} == keys

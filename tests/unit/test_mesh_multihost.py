@@ -36,6 +36,13 @@ class TestHostReplicaMesh:
         assert list(mesh.devices[0]) == list(devices[:4])
         assert list(mesh.devices[1]) == list(devices[4:])
 
+    def test_single_process_emulation_keeps_caller_order(self, devices):
+        """All devices on one process: the process_index sort is stable,
+        so a custom layout (here: reversed) reshapes exactly as given."""
+        mesh = host_replica_mesh(list(reversed(devices)), n_hosts=2)
+        assert list(mesh.devices[0]) == list(reversed(devices))[:4]
+        assert list(mesh.devices[1]) == list(reversed(devices))[4:]
+
     def test_uneven_split_rejected(self, devices):
         with pytest.raises(ValueError, match="do not split evenly"):
             host_replica_mesh(devices, n_hosts=3)

@@ -1,0 +1,280 @@
+"""Differential fuzz over every queue policy's push/pop/requeue contract.
+
+The driver's requeue contract (``QueuePolicy.requeue``): a pop followed by
+requeue(s) in POP order is a no-op — the queue must behave as if the pops
+never happened. This property killed three rounds of WFQ/FIFO bugs
+(commits 5a13b06, e517076, 47020f8); this fuzz hammers it with random
+interleavings so the NEXT policy added can't silently reintroduce the
+bug class.
+
+Protocol: two instances of the same policy receive the identical random
+push/pop stream; instance B additionally suffers random injected
+"pop k, then requeue those k in pop order" undo sequences between ops.
+After the stream, both are drained; the drain orders must match exactly.
+
+Reference analogue: the requeue-race regression tests of
+``happysimulator/tests/unit/test_queue_policies.py`` (directed cases);
+this file generalizes them to arbitrary interleavings.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+import pytest
+
+from happysim_tpu.components.queue_policies import (
+    AdaptiveLIFO,
+    CoDelQueue,
+    DeadlineQueue,
+    FairQueue,
+    REDQueue,
+    WeightedFairQueue,
+)
+from happysim_tpu.components.queue_policy import (
+    FIFOQueue,
+    LIFOQueue,
+    PriorityQueue,
+)
+from happysim_tpu.core.temporal import Instant
+
+
+@dataclass
+class Job:
+    uid: int
+    priority: float = 0.0
+    deadline: float = float("inf")
+    flow: str = "f0"
+
+    __hash__ = object.__hash__
+
+
+class FrozenClock:
+    """A clock the fuzz advances explicitly (CoDel sojourn baselines)."""
+
+    def __init__(self):
+        self.now_s = 0.0
+
+    def __call__(self) -> Instant:
+        return Instant.from_seconds(self.now_s)
+
+
+def _make_policy(name: str, clock: FrozenClock):
+    """Fresh policy under test. Parameters are chosen so that pop() is a
+    pure dequeue (no time-based drops) — drop behavior has its own
+    directed tests; the fuzz targets ORDERING under requeue races."""
+    if name == "fifo":
+        return FIFOQueue()
+    if name == "lifo":
+        return LIFOQueue()
+    if name == "priority":
+        return PriorityQueue()
+    if name == "deadline":
+        return DeadlineQueue()  # no clock => nothing expires
+    if name == "codel":
+        # Enormous target: no sojourn ever exceeds it, so pop == popleft.
+        return CoDelQueue(target_delay=1e9, interval=1e9, clock_func=clock)
+    if name == "red":
+        # Thresholds above any depth the fuzz reaches: no early drops
+        # (push-time drops would be symmetric anyway, but acceptance is
+        # asserted to match between instances).
+        return REDQueue(min_threshold=10_000, max_threshold=20_000, seed=7)
+    if name == "adaptive_lifo":
+        return AdaptiveLIFO(congestion_threshold=18, recovery_threshold=9)
+    if name == "fair":
+        return FairQueue(flow_key=lambda job: job.flow)
+    if name == "wfq":
+        return WeightedFairQueue(
+            weights={"f0": 1.0, "f1": 2.5, "f2": 0.5},
+            flow_key=lambda job: job.flow,
+        )
+    raise AssertionError(name)
+
+
+POLICIES = [
+    "fifo",
+    "lifo",
+    "priority",
+    "deadline",
+    "codel",
+    "red",
+    "adaptive_lifo",
+    "fair",
+    "wfq",
+]
+
+# AdaptiveLIFO's exact-undo (mode/hysteresis rollback) only holds for a
+# single un-interleaved pop+requeue — a 2-pop batch moves the op counter,
+# and a threshold crossing inside the batch legitimately latches. Every
+# other policy supports multi-item undo batches in pop order.
+MAX_UNDO_K = {"adaptive_lifo": 1}
+
+
+def _drain(policy) -> list:
+    out = []
+    while len(policy):
+        out.append(policy.pop())
+    return out
+
+
+def _run_differential(name: str, seed: int, n_ops: int = 400) -> None:
+    rng = random.Random(seed)
+    clock = FrozenClock()
+    plain = _make_policy(name, clock)
+    raced = _make_policy(name, clock)
+    max_k = MAX_UNDO_K.get(name, 3)
+
+    uid = 0
+    live = 0  # items currently queued (identical for both instances)
+    for _ in range(n_ops):
+        # Maybe torture the raced instance with an undo batch first.
+        if live and rng.random() < 0.45:
+            k = min(live, rng.randint(1, max_k))
+            popped = [raced.pop() for _ in range(k)]
+            for job in popped:  # requeues arrive in POP order
+                raced.requeue(job)
+            assert len(raced) == len(plain), (
+                f"{name}: undo batch changed the depth"
+            )
+
+        if live == 0 or rng.random() < 0.6:
+            job = Job(
+                uid=uid,
+                priority=float(rng.randint(0, 2)),
+                deadline=float(rng.randint(100, 200)),
+                flow=f"f{rng.randint(0, 2)}",
+            )
+            uid += 1
+            accepted_plain = plain.push(job)
+            accepted_raced = raced.push(job)
+            assert accepted_plain == accepted_raced, (
+                f"{name}: push acceptance diverged after an undo batch"
+            )
+            if accepted_plain is not False:
+                live += 1
+        else:
+            a = plain.pop()
+            b = raced.pop()
+            assert a is b, (
+                f"{name}: pop order diverged after an undo batch "
+                f"(plain={a and a.uid}, raced={b and b.uid})"
+            )
+            live -= 1
+        clock.now_s += rng.random() * 0.1
+
+    plain_rest = _drain(plain)
+    raced_rest = _drain(raced)
+    assert [j.uid for j in plain_rest] == [j.uid for j in raced_rest], (
+        f"{name}: final drain order diverged"
+    )
+
+
+@pytest.mark.parametrize("name", POLICIES)
+@pytest.mark.parametrize("seed", range(5))
+def test_requeue_is_invisible_under_random_interleavings(name, seed):
+    _run_differential(name, seed)
+
+
+@pytest.mark.parametrize("name", POLICIES)
+def test_no_item_lost_or_duplicated(name):
+    """Conservation: drained items == accepted pushes minus delivered pops,
+    with no duplicates, even under heavy injected undo churn."""
+    rng = random.Random(99)
+    clock = FrozenClock()
+    policy = _make_policy(name, clock)
+    max_k = MAX_UNDO_K.get(name, 3)
+
+    accepted: set[int] = set()
+    delivered: list[int] = []
+    for uid in range(200):
+        job = Job(
+            uid=uid,
+            priority=float(rng.randint(0, 2)),
+            deadline=float(rng.randint(100, 200)),
+            flow=f"f{rng.randint(0, 2)}",
+        )
+        if policy.push(job) is not False:
+            accepted.add(uid)
+        if len(policy) and rng.random() < 0.5:
+            k = min(len(policy), rng.randint(1, max_k))
+            popped = [policy.pop() for _ in range(k)]
+            if rng.random() < 0.5:
+                for item in popped:
+                    policy.requeue(item)
+            else:
+                delivered.extend(item.uid for item in popped)
+        clock.now_s += 0.01
+
+    remaining = [job.uid for job in _drain(policy)]
+    assert sorted(remaining + delivered) == sorted(accepted), (
+        f"{name}: items lost or duplicated under requeue churn"
+    )
+    assert len(set(remaining)) == len(remaining)
+
+
+def test_wfq_delivered_pop_blocks_virtual_clock_rewind():
+    """pop A, pop B, deliver B, requeue A: B's pop legitimately advanced
+    the virtual clock (B is gone), so the requeue must NOT rewind below
+    B's finish — a rewind would hand a new flow a finish tag that jumps
+    items queued before it."""
+    wfq = WeightedFairQueue(flow_key=lambda job: job.flow)
+    early = Job(uid=0, flow="a")
+    late = Job(uid=1, flow="a")  # same flow: finish 1.0 then 2.0
+    queued_first = Job(uid=2, flow="b")  # finish 1.0, pushed after early
+    wfq.push(early)
+    wfq.push(late)
+    popped_early = wfq.pop()  # finish 1.0, vnow 0 -> 1
+    popped_late = wfq.pop()  # finish 2.0, vnow -> 2; stays delivered
+    assert popped_early is early and popped_late is late
+    wfq.requeue(early)  # NOT a full suffix undo: late stays consumed
+    wfq.push(queued_first)
+    # Without the suffix guard vnow would have rewound to 0 and
+    # queued_first's finish (1.0 from vnow 0) would TIE early's restored
+    # tag; with vnow still 2.0 its finish is 3.0 and early pops first.
+    assert wfq.pop() is early
+    assert wfq.pop() is queued_first
+
+
+def test_wfq_full_undo_batch_rewinds_virtual_clock():
+    """pop A, pop B, requeue A, requeue B (the driver's same-instant race,
+    in pop order) is a COMPLETE suffix undo: the virtual clock returns to
+    its pre-batch value, so future pushes get the tags of an untouched
+    queue."""
+    wfq = WeightedFairQueue(flow_key=lambda job: job.flow)
+    a = Job(uid=0, flow="a")
+    b = Job(uid=1, flow="b")
+    wfq.push(a)
+    wfq.push(b)
+    first, second = wfq.pop(), wfq.pop()
+    wfq.requeue(first)
+    wfq.requeue(second)
+    assert wfq._virtual_now == 0.0
+    fresh = Job(uid=2, flow="c")
+    wfq.push(fresh)  # tag computed from the restored clock
+    assert [wfq.pop().uid for _ in range(3)] == [0, 1, 2]
+
+
+@pytest.mark.parametrize("name", POLICIES)
+def test_single_pop_requeue_roundtrip_preserves_head(name):
+    """The k=1 contract at every reachable state: pop + requeue, then the
+    next pop returns the SAME item."""
+    rng = random.Random(5)
+    clock = FrozenClock()
+    policy = _make_policy(name, clock)
+    for uid in range(60):
+        policy.push(
+            Job(
+                uid=uid,
+                priority=float(rng.randint(0, 2)),
+                deadline=float(rng.randint(100, 200)),
+                flow=f"f{rng.randint(0, 2)}",
+            )
+        )
+        if rng.random() < 0.7:
+            head = policy.pop()
+            policy.requeue(head)
+            again = policy.pop()
+            assert again is head, f"{name}: requeue did not restore the head"
+            policy.requeue(again)
+        clock.now_s += 0.01

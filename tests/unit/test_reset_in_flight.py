@@ -1,0 +1,242 @@
+"""The simulation-reset in-flight protocol.
+
+``Simulation._reset`` clears the event heap, killing every in-flight
+continuation and completion hook. Any entity bookkeeping that counts that
+in-flight work (a server's occupied slot, a backend's in_flight, a held
+mutex) would otherwise track ghosts forever — at capacity 1 that means a
+post-reset run starves completely. Entities opt in via
+``reset_in_flight()``: transient in-flight state clears, cumulative
+counters survive (the reference's keep-entity-state reset semantics,
+``happysimulator/core/simulation.py:240-282``).
+"""
+
+from __future__ import annotations
+
+from happysim_tpu import (
+    ConstantLatency,
+    ExponentialLatency,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.components.client.connection_pool import ConnectionPool
+from happysim_tpu.components.load_balancer import LoadBalancer
+from happysim_tpu.components.messaging import MessageQueue
+from happysim_tpu.components.resilience.bulkhead import Bulkhead
+from happysim_tpu.components.resilience.hedge import Hedge
+from happysim_tpu.components.resource import Resource
+from happysim_tpu.components.server.concurrency import (
+    FixedConcurrency,
+    WeightedConcurrency,
+)
+from happysim_tpu.components.sync import Mutex, RWLock, Semaphore
+from happysim_tpu.core.event import Event
+
+
+def _mm1(duration=1.0, concurrency=1):
+    sink = Sink("sink")
+    server = Server(
+        "srv",
+        concurrency=concurrency,
+        service_time=ExponentialLatency(0.05, seed=3),
+        downstream=sink,
+    )
+    source = Source.poisson(rate=30.0, target=server, stop_after=duration, seed=9)
+    sim = Simulation(
+        sources=[source],
+        entities=[server, sink],
+        end_time=Instant.from_seconds(duration),
+    )
+    return sim, server, sink
+
+
+class TestServerGhostSlot:
+    def test_reset_frees_midflight_concurrency_slot(self):
+        """The bug this protocol exists for: a request in service when the
+        horizon hits holds a slot; reset kills its continuation; without
+        the hook the whole second run queues behind the ghost."""
+        sim, server, sink = _mm1()
+        sim.run()
+        first_completed = server.requests_completed
+        assert first_completed > 0
+        sim.control.reset()
+        assert server.concurrency.active == 0
+        assert server.queue_depth == 0
+        # Cumulative counters survived the reset.
+        assert server.requests_completed == first_completed
+        sim.run()
+        assert server.requests_completed > first_completed
+        assert sink.events_received > first_completed
+
+    def test_reset_clears_buffered_queue_items(self):
+        sim, server, _ = _mm1(concurrency=1)
+        sim.control.pause()
+        sim.run()
+        sim.control.step(40)  # mid-burst: some arrivals are buffered
+        sim.control.reset()
+        assert server.queue_depth == 0
+        summary = sim.run()
+        assert summary.completed
+
+
+class TestConcurrencyModels:
+    def test_fixed_releases_all(self):
+        model = FixedConcurrency(limit=3)
+        model.acquire()
+        model.acquire()
+        model.reset_in_flight()
+        assert model.active == 0
+        assert model.has_capacity()
+
+    def test_weighted_clamps_to_zero(self):
+        model = WeightedConcurrency(capacity=4.0, cost_fn=lambda e: 2.5)
+        model.acquire(object())
+        model.reset_in_flight()
+        assert model.active == 0
+
+
+class TestLoadBalancerGhosts:
+    def test_backend_in_flight_zeroes_but_totals_survive(self):
+        sink_a, sink_b = Sink("a"), Sink("b")
+        lb = LoadBalancer("lb")
+        lb.add_backend(sink_a)
+        lb.add_backend(sink_b)
+        info = lb.backend_info("a")
+        info.in_flight = 5  # ghosts of hooks that died with the heap
+        info.total_requests = 7
+        lb.reset_in_flight()
+        assert info.in_flight == 0
+        assert info.total_requests == 7
+
+
+class TestPoolAndResource:
+    def test_pool_closes_active_and_clears_dials(self):
+        pool = ConnectionPool("pool", target=Sink("t"), max_connections=2)
+        conn = object.__new__(type("C", (), {}))
+        pool._active[1] = conn
+        pool._dialing = 1
+        closed_before = pool.connections_closed
+        pool.reset_in_flight()
+        assert pool.active_connections == 0
+        assert pool._dialing == 0
+        assert pool.connections_closed == closed_before + 1
+
+    def test_resource_returns_held_capacity(self):
+        resource = Resource("r", capacity=2.0)
+        Simulation(entities=[resource], end_time=Instant.from_seconds(1.0))
+        resource.acquire(2.0)  # grant resolves immediately
+        assert resource.available == 0.0
+        resource.reset_in_flight()
+        assert resource.available == 2.0
+        assert resource.waiting == 0
+
+    def test_bulkhead_restores_permits(self):
+        bulkhead = Bulkhead("b", downstream=Sink("s"), max_concurrent=2)
+        bulkhead._active = 2
+        bulkhead.reset_in_flight()
+        assert bulkhead.available_permits == 2
+
+    def test_hedge_forgets_races(self):
+        hedge = Hedge("h", downstream=Sink("s"), hedge_delay=0.1)
+        hedge._in_flight[1] = {"done": False}
+        hedge.reset_in_flight()
+        assert hedge.in_flight_count == 0
+
+
+class TestSyncPrimitives:
+    def test_mutex_unlocks(self):
+        mutex = Mutex("m")
+        Simulation(entities=[mutex], end_time=Instant.from_seconds(1.0))
+        mutex.acquire("owner")
+        assert mutex.is_locked
+        mutex.reset_in_flight()
+        assert not mutex.is_locked
+        assert mutex.owner is None
+
+    def test_semaphore_restores_permits(self):
+        sem = Semaphore("s", initial_count=2)
+        Simulation(entities=[sem], end_time=Instant.from_seconds(1.0))
+        sem.acquire()
+        sem.acquire()
+        sem.reset_in_flight()
+        assert sem.available == 2
+
+    def test_rwlock_clears_readers_and_writer(self):
+        lock = RWLock("rw")
+        Simulation(entities=[lock], end_time=Instant.from_seconds(1.0))
+        lock.acquire_read()
+        lock.reset_in_flight()
+        assert lock.active_readers == 0
+        assert not lock.is_write_locked
+
+
+class TestMessageQueue:
+    def test_unacked_messages_return_to_pending_in_order(self):
+        queue = MessageQueue("q", auto_redelivery=False)
+        consumer = Sink("c")
+        queue.subscribe(consumer)
+        for i in range(3):
+            queue.publish(Event(Instant.Epoch, f"m{i}", target=queue))
+        first = queue.poll()
+        second = queue.poll()
+        assert queue.in_flight_count == 2
+        assert first is not None and second is not None
+        queue.reset_in_flight()
+        assert queue.in_flight_count == 0
+        # Stuck messages lead the pending queue, oldest first.
+        redelivered = queue.poll()
+        assert redelivered.context["metadata"]["message_id"].endswith("-1")
+
+
+class TestMessageQueueRedeliveryPark:
+    def test_redelivery_parked_message_is_rescued(self):
+        """schedule_redelivery parks a message outside BOTH queues waiting
+        on a timer; after reset the timer is gone — the message must come
+        back to pending, not orphan forever against capacity."""
+        queue = MessageQueue("q", auto_redelivery=False, redelivery_delay=1.0)
+        queue.subscribe(Sink("c"))
+        queue.publish(Event(Instant.Epoch, "m", target=queue))
+        delivered = queue.poll()
+        message_id = delivered.context["metadata"]["message_id"]
+        timer = queue.schedule_redelivery(message_id)
+        assert timer is not None
+        assert queue.in_flight_count == 0 and queue.pending_count == 0
+        queue.reset_in_flight()
+        assert queue.pending_count == 1
+        redelivered = queue.poll()
+        assert redelivered.context["metadata"]["message_id"] == message_id
+
+
+class TestPoolIdleReset:
+    def test_idle_connections_close_on_reset(self):
+        """Idle connections' reap timers died with the heap; keeping them
+        would exempt them from idle_timeout forever."""
+        pool = ConnectionPool(
+            "pool", target=Sink("t"), max_connections=4, idle_timeout=5.0
+        )
+        conn = object()
+        pool._idle.append(conn)
+        closed_before = pool.connections_closed
+        pool.reset_in_flight()
+        assert pool.idle_connections == 0
+        assert pool.total_connections == 0
+        assert pool.connections_closed == closed_before + 1
+
+
+class TestSimulationWiring:
+    def test_reset_calls_hook_on_every_entity(self):
+        calls = []
+
+        class Probe(Sink):
+            def reset_in_flight(self):
+                calls.append(self.name)
+
+        sim = Simulation(
+            entities=[Probe("p1"), Probe("p2")],
+            end_time=Instant.from_seconds(0.1),
+        )
+        sim.run()
+        sim.control.reset()
+        assert calls == ["p1", "p2"]

@@ -1,0 +1,114 @@
+"""Window-assignment edge cases for the stream processor's window types.
+
+The integration tests drive whole pipelines; these pin the pure window
+math — boundary membership, overlap counts, float-boundary behavior,
+watermark close conditions — where off-by-one-slide bugs live.
+
+Parity target: ``happysimulator/components/streaming/stream_processor.py``
+window semantics (tumbling/sliding/session assign + close).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu.components.streaming import (
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+)
+
+
+class TestTumblingAssignment:
+    def test_event_on_boundary_joins_the_later_window(self):
+        window = TumblingWindow(size_s=10.0)
+        assert window.assign_windows(10.0) == [(10.0, 20.0)]
+
+    def test_event_just_before_boundary_stays_in_earlier_window(self):
+        window = TumblingWindow(size_s=10.0)
+        assert window.assign_windows(9.999) == [(0.0, 10.0)]
+
+    def test_zero_time_event(self):
+        window = TumblingWindow(size_s=5.0)
+        assert window.assign_windows(0.0) == [(0.0, 5.0)]
+
+    def test_every_event_gets_exactly_one_window(self):
+        window = TumblingWindow(size_s=3.0)
+        for t in [0.0, 1.5, 2.999, 3.0, 7.2, 29.9]:
+            assigned = window.assign_windows(t)
+            assert len(assigned) == 1
+            start, end = assigned[0]
+            assert start <= t < end
+            assert end - start == pytest.approx(3.0)
+
+    def test_fractional_size(self):
+        window = TumblingWindow(size_s=0.25)
+        (start, end), = window.assign_windows(1.1)
+        assert start == pytest.approx(1.0)
+        assert end == pytest.approx(1.25)
+
+    def test_rejects_nonpositive_size(self):
+        with pytest.raises(ValueError):
+            TumblingWindow(size_s=0.0)
+
+
+class TestSlidingAssignment:
+    def test_overlap_count_is_size_over_slide(self):
+        window = SlidingWindow(size_s=10.0, slide_s=2.0)
+        # Mid-stream events belong to exactly size/slide = 5 windows.
+        assert len(window.assign_windows(20.0)) == 5
+        assert len(window.assign_windows(21.7)) == 5
+
+    def test_early_events_have_fewer_windows(self):
+        window = SlidingWindow(size_s=10.0, slide_s=2.0)
+        # Windows never start before 0 is not required — but starts are
+        # spaced by slide and each contains the event.
+        for start, end in window.assign_windows(1.0):
+            assert start <= 1.0 < end
+
+    def test_windows_are_sorted_and_spaced_by_slide(self):
+        window = SlidingWindow(size_s=6.0, slide_s=2.0)
+        assigned = window.assign_windows(13.0)
+        starts = [start for start, _ in assigned]
+        assert starts == sorted(starts)
+        diffs = {round(b - a, 9) for a, b in zip(starts, starts[1:])}
+        assert diffs == {2.0}
+
+    def test_boundary_event_excluded_from_ending_window(self):
+        window = SlidingWindow(size_s=4.0, slide_s=2.0)
+        # Window (8, 12) ends at 12; an event AT 12 must not join a window
+        # that ends at 12 (half-open [start, end)).
+        for start, end in window.assign_windows(12.0):
+            assert end > 12.0
+
+    def test_slide_equal_size_degenerates_to_tumbling(self):
+        sliding = SlidingWindow(size_s=5.0, slide_s=5.0)
+        tumbling = TumblingWindow(size_s=5.0)
+        for t in [0.0, 2.5, 4.999, 5.0, 12.0]:
+            assert sliding.assign_windows(t) == tumbling.assign_windows(t)
+
+    def test_rejects_slide_larger_than_size(self):
+        with pytest.raises(ValueError):
+            SlidingWindow(size_s=2.0, slide_s=3.0)
+
+
+class TestSessionAssignment:
+    def test_window_spans_gap_after_event(self):
+        window = SessionWindow(gap_s=30.0)
+        assert window.assign_windows(100.0) == [(100.0, 130.0)]
+
+    def test_rejects_nonpositive_gap(self):
+        with pytest.raises(ValueError):
+            SessionWindow(gap_s=0.0)
+
+
+@pytest.mark.parametrize(
+    "window",
+    [TumblingWindow(10.0), SlidingWindow(10.0, 5.0), SessionWindow(10.0)],
+    ids=["tumbling", "sliding", "session"],
+)
+class TestCloseCondition:
+    def test_closes_exactly_at_watermark(self, window):
+        assert not window.should_close(window_end=50.0, watermark_s=49.999)
+        assert window.should_close(window_end=50.0, watermark_s=50.0)
+        assert window.should_close(window_end=50.0, watermark_s=50.001)

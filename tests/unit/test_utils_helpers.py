@@ -64,6 +64,15 @@ class TestHumanize:
         assert humanize_count(999_999) == "1M"
         assert humanize_count(999_999_999) == "1B"
 
+    def test_minute_and_hour_boundaries_carry(self):
+        """The post-rounding promotion applies at EVERY unit step: a
+        remainder that formats as '60' carries into the next unit — never
+        '1m 60s' / '59m 60s' / '60s'."""
+        assert humanize_duration(59.96) == "1m 0s"
+        assert humanize_duration(119.96) == "2m 0s"
+        assert humanize_duration(3599.98) == "1h 00m"
+        assert humanize_duration(119.4) == "1m 59.4s"
+
 
 class TestSanitizeFilename:
     def test_replaces_unsafe_runs_with_one_underscore(self):
