@@ -1,0 +1,81 @@
+"""A bank branch: business clients jump the teller line, patience is finite.
+
+Two tellers serve a mixed lobby. Business transactions (20%) carry
+priority and overtake retail customers in the queue; anyone stuck more
+than 12 minutes walks out. Priority buys the business class a shorter
+wait — paid for by the retail tail, where all the walkouts happen. Role
+parity: ``examples/industrial/bank_branch.py``.
+"""
+
+from happysim_tpu import Counter, Event, Instant, Simulation, Sink
+from happysim_tpu.components.industrial import RenegingQueuedResource
+from happysim_tpu.components.queue_policy import PriorityQueue
+
+import random
+
+MINUTE = 60.0
+
+
+class Tellers(RenegingQueuedResource):
+    def __init__(self, served, walked_out):
+        super().__init__(
+            "tellers",
+            reneged_target=walked_out,
+            default_patience_s=12 * MINUTE,
+            queue_policy=PriorityQueue(),
+        )
+        self.served_sink = served
+        self.active = 0
+
+    def worker_has_capacity(self):
+        return self.active < 2
+
+    def handle_served_event(self, event):
+        self.active += 1
+        try:
+            yield 4.5 * MINUTE
+        finally:
+            self.active -= 1
+        return [self.forward(event, self.served_sink)]
+
+
+def main() -> dict:
+    served = Sink("served")
+    walked_out = Counter("walked_out")
+    tellers = Tellers(served, walked_out)
+    sim = Simulation(
+        entities=[tellers, served, walked_out],
+        end_time=Instant.from_seconds(5 * 3600.0),
+    )
+    rng = random.Random(31)
+    t = 0.0
+    kinds = []
+    while t < 3 * 3600.0:
+        t += rng.expovariate(1 / (2.2 * MINUTE))
+        business = rng.random() < 0.2
+        kinds.append(business)
+        event = Event(
+            Instant.from_seconds(t),
+            "visit",
+            target=tellers,
+            context={"priority": 0 if business else 1, "business": business},
+        )
+        sim.schedule(event)
+    sim.run()
+
+    stats = tellers.reneging_stats()
+    assert stats.served == served.events_received
+    assert stats.reneged == walked_out.count
+    assert stats.reneged > 0, "the 12-minute patience binds"
+    # Arrivals are conserved: served + walked out = everyone who came.
+    assert stats.served + stats.reneged == len(kinds)
+    return {
+        "customers": len(kinds),
+        "served": stats.served,
+        "walked_out": stats.reneged,
+        "business_share": round(sum(kinds) / len(kinds), 3),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,58 @@
+"""Grocery checkout: join-the-shortest-line beats picking at random.
+
+Same four registers, same shoppers, two policies: picking a register
+uniformly at random versus joining the one with the fewest carts
+(least-outstanding). Random assignment leaves some lines idle while
+others back up; shortest-line keeps all registers fed and cuts the mean
+wait substantially at identical utilization. Role parity:
+``examples/industrial/grocery_store.py``.
+"""
+
+from happysim_tpu import (
+    ExponentialLatency,
+    Instant,
+    LoadBalancer,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.components.load_balancer import LeastConnections, Random
+
+
+def run(strategy, seed):
+    sink = Sink("bagged")
+    registers = [
+        Server(
+            f"register{i}",
+            service_time=ExponentialLatency(55.0, seed=100 + i),
+            downstream=sink,
+        )
+        for i in range(4)
+    ]
+    front = LoadBalancer("front", strategy=strategy)
+    for register in registers:
+        front.add_backend(register)
+    shoppers = Source.poisson(rate=1 / 16.0, target=front, stop_after=7200.0, seed=seed)
+    sim = Simulation(
+        sources=[shoppers], entities=[front, *registers, sink],
+        end_time=Instant.from_seconds(9000.0),
+    )
+    sim.run()
+    return sink.latency_stats().mean_s, sink.events_received
+
+
+def main() -> dict:
+    random_mean, random_n = run(Random(seed=5), seed=33)
+    shortest_mean, shortest_n = run(LeastConnections(), seed=33)
+    assert shortest_mean < random_mean * 0.8, (shortest_mean, random_mean)
+    assert abs(random_n - shortest_n) < random_n * 0.1
+    return {
+        "random_mean_visit_s": round(random_mean, 1),
+        "shortest_line_mean_visit_s": round(shortest_mean, 1),
+        "speedup": round(random_mean / shortest_mean, 2),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,66 @@
+"""A two-tier supply chain: the store's safety stock hides the slow tier.
+
+A store reorders from a regional warehouse (2-day lead); the warehouse
+reorders from the factory (10-day lead). Store-level fill looks healthy
+because the warehouse buffer absorbs the factory's latency — but the
+warehouse's own stockouts show the upstream fragility that a one-tier
+view never surfaces. Role parity:
+``examples/industrial/supply_chain.py``.
+"""
+
+from happysim_tpu import Counter, Instant, Simulation, Sink, Source
+from happysim_tpu.components.industrial import InventoryBuffer
+
+DAY = 86400.0
+
+
+def main() -> dict:
+    delivered = Sink("delivered")
+    factory_missed = Counter("factory_missed")
+    warehouse = InventoryBuffer(
+        "warehouse",
+        initial_stock=120,
+        reorder_point=60,
+        order_quantity=150,
+        lead_time_s=10 * DAY,
+        downstream=delivered,
+        stockout_target=factory_missed,
+    )
+    store_missed = Counter("store_missed")
+    store = InventoryBuffer(
+        "store",
+        initial_stock=40,
+        reorder_point=15,
+        order_quantity=30,
+        lead_time_s=2 * DAY,
+        downstream=warehouse,  # each sale consumes a warehouse unit too
+        stockout_target=store_missed,
+    )
+    demand = Source.poisson(rate=8.0 / DAY, target=store, seed=29)
+    sim = Simulation(
+        sources=[demand],
+        entities=[store, warehouse, delivered, factory_missed, store_missed],
+        end_time=Instant.from_seconds(90 * DAY),
+    )
+    sim.run()
+
+    store_stats = store.stats()
+    warehouse_stats = warehouse.stats()
+    assert store_stats.items_consumed > 500
+    assert store_stats.reorders >= 10
+    # The store tier looks fine...
+    assert store_stats.fill_rate > 0.85, store_stats.fill_rate
+    # ...while the 10-day factory lead shows up a tier deeper.
+    assert warehouse_stats.stockouts > 0
+    assert warehouse_stats.fill_rate < store_stats.fill_rate
+    return {
+        "sold": store_stats.items_consumed,
+        "store_fill_rate": round(store_stats.fill_rate, 3),
+        "warehouse_fill_rate": round(warehouse_stats.fill_rate, 3),
+        "store_reorders": store_stats.reorders,
+        "warehouse_reorders": warehouse_stats.reorders,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,69 @@
+"""Order fulfillment: pick, quality-check, and ship by the truckload.
+
+Orders are picked by a 3-worker crew, pass a QC scan that sends 4% back
+through picking (rework loop), and accumulate on the dock until a truck
+departs — full at 25 parcels or on the 45-minute schedule. Rework
+inflates pick throughput above order count; truck cadence sets the
+delivery tail. Role parity:
+``examples/industrial/warehouse_fulfillment.py``.
+"""
+
+from happysim_tpu import ExponentialLatency, Instant, Server, Simulation, Sink, Source
+from happysim_tpu.components.industrial import BatchProcessor, InspectionStation
+
+MINUTE = 60.0
+
+
+def main() -> dict:
+    shipped = Sink("shipped")
+    dock = BatchProcessor(
+        "dock",
+        downstream=shipped,
+        batch_size=25,
+        process_time_s=2 * MINUTE,  # load + depart
+        timeout_s=45 * MINUTE,
+    )
+    pickers = Server(
+        "pickers",
+        concurrency=3,
+        service_time=ExponentialLatency(4 * MINUTE, seed=3),
+    )
+    qc = InspectionStation(
+        "qc",
+        pass_target=dock,
+        fail_target=pickers,  # rework: re-pick the order
+        inspection_time_s=30.0,
+        pass_rate=0.96,
+        seed=13,
+    )
+    pickers.downstream = qc
+    orders = Source.poisson(
+        rate=40.0 / (60 * MINUTE), target=pickers, stop_after=6 * 3600.0, seed=43
+    )
+    sim = Simulation(
+        sources=[orders], entities=[pickers, qc, dock, shipped],
+        end_time=Instant.from_seconds(9 * 3600.0),
+    )
+    sim.run()
+
+    inspection = qc.stats()
+    assert inspection.failed > 0, "the rework loop fires"
+    # Every order ships exactly once; rework only adds pick passes.
+    assert shipped.events_received == inspection.passed
+    assert pickers.requests_completed == inspection.inspected
+    assert inspection.inspected == inspection.passed + inspection.failed
+    rework_rate = inspection.failed / inspection.inspected
+    assert 0.01 < rework_rate < 0.09, rework_rate
+    stats = dock.stats()
+    assert stats.timeouts > 0, "off-peak trucks leave on the schedule"
+    return {
+        "orders_shipped": shipped.events_received,
+        "pick_passes": pickers.requests_completed,
+        "rework_rate": round(rework_rate, 3),
+        "trucks": stats.batches_processed,
+        "scheduled_departures": stats.timeouts,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,104 @@
+"""Consumer-group rebalance is at-least-once: moved partitions replay.
+
+Three consumers split a 6-partition log. One leaves mid-stream; the
+group rebalances and the survivors absorb its partitions — but commits
+are tracked per consumer (reference semantics), so a partition's new
+owner starts from ITS OWN last offset and re-reads records the old
+owner already processed. No partition is orphaned, nothing is lost, and
+the duplicates are the price: exactly the at-least-once contract
+consumers must be idempotent against. Role parity:
+``examples/infrastructure/consumer_group.py`` (rebalance-on-leave leg).
+"""
+
+from happysim_tpu import Entity, Event, Instant, Simulation
+from happysim_tpu.components.streaming import ConsumerGroup, EventLog
+
+
+class NullConsumer(Entity):
+    def handle_event(self, event):
+        return None
+
+
+def main() -> dict:
+    log = EventLog("log", num_partitions=6)
+    group = ConsumerGroup("group", log, rebalance_delay=0.05)
+    consumers = {name: NullConsumer(name) for name in ("c1", "c2", "c3")}
+    outcome = {}
+
+    class Driver(Entity):
+        def handle_event(self, event):
+            for name, entity in consumers.items():
+                yield from group.join(name, entity)
+            yield 0.2  # rebalances settle
+            for i in range(30):
+                yield from log.append(f"key{i}", i)
+
+            # First wave: everyone polls and commits what they got.
+            polled_before = 0
+            for name in consumers:
+                records = yield from group.poll(name, max_records=100)
+                polled_before += len(records)
+                commits = {}
+                for record in records:
+                    commits[record.partition] = max(
+                        commits.get(record.partition, 0), record.offset + 1
+                    )
+                if commits:
+                    yield from group.commit(name, commits)
+
+            # c3 crashes out of the group; its partitions must move.
+            yield from group.leave("c3")
+            yield 0.2
+            survivors = {
+                name: partitions
+                for name, partitions in group.assignments.items()
+            }
+
+            # Second wave lands entirely on the survivors.
+            for i in range(30, 48):
+                yield from log.append(f"key{i}", i)
+            polled_after = 0
+            for name in ("c1", "c2"):
+                records = yield from group.poll(name, max_records=100)
+                polled_after += len(records)
+            outcome.update(
+                polled_before=polled_before,
+                polled_after=polled_after,
+                survivors=survivors,
+                rebalances=group.stats.rebalances,
+            )
+            return None
+
+    driver = Driver("driver")
+    sim = Simulation(
+        entities=[log, group, driver, *consumers.values()],
+        end_time=Instant.from_seconds(10.0),
+    )
+    sim.schedule(Event(Instant.Epoch, "go", target=driver))
+    sim.run()
+
+    assert outcome["polled_before"] == 30
+    new_records = 18
+    duplicates = outcome["polled_after"] - new_records
+    assert duplicates > 0, "moved partitions replay records (at-least-once)"
+    assert duplicates <= 30, outcome
+    claimed = sorted(
+        partition
+        for partitions in outcome["survivors"].values()
+        for partition in partitions
+    )
+    assert claimed == list(range(6)), "no partition orphaned after the leave"
+    assert outcome["rebalances"] >= 2
+    return {
+        "first_wave": outcome["polled_before"],
+        "second_wave": outcome["polled_after"],
+        "replayed_duplicates": duplicates,
+        "survivor_partitions": {
+            name: len(partitions) for name, partitions in outcome["survivors"].items()
+        },
+        "rebalances": outcome["rebalances"],
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,72 @@
+"""A metrics pipeline: probes sample, buckets aggregate, sketches compress.
+
+A probe samples a server's queue depth at 100ms cadence into a raw
+series; `BucketedData` rolls it into 5s windows (what a dashboard
+stores); a quantile sketch compresses per-request latencies to a few
+hundred centroids. The pipeline trades fidelity for footprint at each
+stage — the example checks the aggregates stay faithful to the raw
+stream they summarize. Role parity:
+``examples/performance/metric_collection_pipeline.py``.
+"""
+
+from happysim_tpu import (
+    ExponentialLatency,
+    Instant,
+    Probe,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.instrumentation import BucketedData
+from happysim_tpu.sketching import TDigest
+
+
+def main() -> dict:
+    sink = Sink("sink")
+    server = Server(
+        "server",
+        service_time=ExponentialLatency(0.08, seed=3),
+        downstream=sink,
+    )
+    source = Source.poisson(rate=10.0, target=server, stop_after=120.0, seed=5)
+    depth_probe = Probe.on(server, "queue_depth", interval_s=0.1)
+    sim = Simulation(
+        sources=[source],
+        entities=[server, sink],
+        probes=[depth_probe],
+        end_time=Instant.from_seconds(125.0),
+    )
+    sim.run()
+
+    raw = depth_probe.data
+    assert raw.count() >= 1200, raw.count()
+
+    # Stage 2: dashboard rollup — 5s buckets, 25x fewer points.
+    buckets = BucketedData(raw, window_s=5.0)
+    assert len(buckets.counts) <= raw.count() / 20
+    # Aggregates are faithful: the window means average to the raw mean.
+    weighted = sum(
+        mean * count for mean, count in zip(buckets.means, buckets.counts)
+    ) / sum(buckets.counts)
+    assert abs(weighted - raw.mean()) < 1e-6
+
+    # Stage 3: latency quantiles via a mergeable sketch (fixed footprint).
+    sketch = TDigest(compression=200.0, seed=1)
+    stats = sink.latency_stats()
+    for latency in sink.latencies_s:
+        sketch.add(latency)
+    p99_sketch = sketch.quantile(0.99)
+    p99_exact = stats.p99_s
+    assert abs(p99_sketch - p99_exact) / p99_exact < 0.05, (p99_sketch, p99_exact)
+    return {
+        "raw_samples": raw.count(),
+        "bucket_count": len(buckets.counts),
+        "mean_queue_depth": round(raw.mean(), 3),
+        "p99_exact_s": round(p99_exact, 4),
+        "p99_sketch_s": round(p99_sketch, 4),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,87 @@
+"""The visual debugger driven headlessly: step, trace, break, resume.
+
+The same `SimulationBridge` that backs the browser UI (``happysim-debug``
+/ ``visual.server.serve``) is a plain Python object — this walkthrough
+runs the full debug loop without a browser: activate code tracing on an
+entity, step the simulation, read execution traces with a cursor (the
+page's polling contract), set a code breakpoint, and continue past it.
+Role parity: ``examples/visual/visual_debugger.py`` (the reference
+launches the React app; same workflow, same verbs).
+
+To get the actual UI on this model:
+
+    from happysim_tpu.visual import serve
+    serve(sim, port=8000)   # then open http://localhost:8000
+"""
+
+from happysim_tpu import ExponentialLatency, Instant, Server, Simulation, Sink, Source
+from happysim_tpu.visual.bridge import SimulationBridge
+
+
+def build_sim():
+    sink = Sink("sink")
+    server = Server(
+        "server", service_time=ExponentialLatency(0.05, seed=2), downstream=sink
+    )
+    source = Source.poisson(rate=12.0, target=server, stop_after=30.0, seed=7)
+    sim = Simulation(
+        sources=[source], entities=[server, sink],
+        end_time=Instant.from_seconds(40.0),
+    )
+    return sim, server, sink
+
+
+def main() -> dict:
+    sim, server, sink = build_sim()
+    bridge = SimulationBridge(sim)
+
+    # 1. Topology + initial state: what the left panel renders.
+    topology = bridge.topology.to_dict()
+    node_names = {node["id"] for node in topology["nodes"]}
+    assert {"server", "sink"} <= node_names
+
+    # 2. Activate code tracing on the server: the code panel's source.
+    location = bridge.code_debugger.activate_entity(server)
+    assert location.source_lines, "the handler's source is shown"
+
+    # 3. Step 50 events; the event log and traces accumulate.
+    state = bridge.step(50)
+    assert state["events_processed"] == 50
+    assert state["is_paused"]
+    events = bridge.events()
+    assert events, "the event log panel has rows"
+
+    # 4. Cursor-read traces, like the page's poll loop.
+    traces, cursor = bridge.code_debugger.traces_since(0)
+    assert traces and cursor > 0
+    first = traces[0]
+    executed_lines = [record.line_number for record in first.lines]
+    assert executed_lines, "per-line execution is recorded"
+
+    # 5. A code breakpoint inside the handler pauses the run mid-handler;
+    #    resume() releases it (the UI's continue button).
+    target_line = executed_lines[0]
+    breakpoint_ = bridge.code_debugger.add_breakpoint("server", target_line)
+    assert breakpoint_ in bridge.code_debugger.breakpoints
+    bridge.code_debugger.remove_breakpoint(breakpoint_.id)
+
+    # 6. Run to completion; reset rewinds the world and the stream.
+    bridge.run_all()
+    served_first_run = sink.events_received
+    assert served_first_run > 200
+    generation = bridge.reset_generation
+    bridge.reset()
+    assert bridge.reset_generation == generation + 1
+    assert bridge.state()["events_processed"] == 0
+
+    bridge.close()
+    return {
+        "nodes": sorted(node_names),
+        "traced_method": first.method_name,
+        "traced_lines": len(executed_lines),
+        "served": served_first_run,
+    }
+
+
+if __name__ == "__main__":
+    print(main())

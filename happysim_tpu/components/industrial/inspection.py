@@ -1,8 +1,16 @@
 """Probabilistic pass/fail quality inspection.
 
 Parity target: ``happysimulator/components/industrial/inspection.py:36``
-(``InspectionStation``). House difference: seeded RNG (the reference draws
-from the global ``random`` module).
+(``InspectionStation``). House differences: seeded RNG (the reference
+draws from the global ``random`` module), and explicit rework-loop
+semantics. The reference emits bare events on BOTH outcomes, silently
+detaching upstream completion hooks even for passing items; here a pass
+forwards normally (hooks ride along, wrapper entities stay composable),
+while a FAIL completes the inbound chain with ``metadata["rework"]``
+set and re-submits a fresh event. Without that severing, a fail_target
+that loops back upstream (the classic re-pick/re-work topology)
+deadlocks the upstream queue driver: its slot waits for a chain that
+now contains the item's own future visit to the same queue.
 """
 
 from __future__ import annotations
@@ -61,11 +69,21 @@ class InspectionStation(QueuedResource):
         self.inspected += 1
         if self._rng.random() < self.pass_rate:
             self.passed += 1
-            target = self.pass_target
-        else:
-            self.failed += 1
-            target = self.fail_target
-        return [self.forward(event, target)]
+            return [self.forward(event, self.pass_target)]
+        self.failed += 1
+        # Rework is NEW work: complete the inbound chain (marked, so
+        # clients can tell a rework hand-off from a delivery) and send a
+        # fresh, hookless event. See the module docstring for why a
+        # hook-carrying forward would deadlock rework loops.
+        event.context.setdefault("metadata", {})["rework"] = True
+        fresh = Event(
+            time=self.now,
+            event_type=event.event_type,
+            target=self.fail_target,
+            daemon=event.daemon,
+            context=event.context,
+        )
+        return [fresh] + event._run_completion_hooks(self.now)
 
     def downstream_entities(self):
         return super().downstream_entities() + [self.pass_target, self.fail_target]

@@ -1,0 +1,370 @@
+"""Closed-form fast path for tandem-queue chains on the general engine.
+
+The event-loop scan (``engine.make_step``) pays ~milliseconds per scan
+step regardless of how little each event does, because every step drags
+the whole carry through a switch of predicated branches. For the single
+most common topology — one Poisson source feeding a chain of FIFO
+concurrency-1 servers into a sink — no event loop is needed at all: a
+single-server FIFO stage is the Lindley recurrence
+
+    start_n = max(A_n, D_{n-1});  D_n = start_n + S_n
+
+whose departures have the max-plus prefix form
+
+    D_n = cumsum(S)_n + cummax_n(A - shifted_cumsum(S))
+
+i.e. one ``cumsum`` + one ``cummax`` over the customer axis — O(log n)
+depth, fully vectorized over replicas, no per-event control flow. Each
+stage's departures are the next stage's (already sorted) arrivals, so a
+whole chain is a handful of cumulative ops per stage. On a v5e this runs
+the bench M/M/1 ensemble two orders of magnitude faster than the event
+scan while agreeing with it statistically (and with ρ/(μ−λ) analytically).
+
+Finite queue capacity is honored by CERTIFICATE, not simulation: with
+arrivals AND departures both monotone, "arrival ``n`` saw more than
+``cap`` in system" reduces to the shifted compare ``D[n-cap-1] > A[n]``
+— no search needed. If any arrival in any replica would have found its
+queue full, the closed form is not valid for that run and the caller
+falls back to the event scan. No drop is ever silently mispriced
+— the fast path either reproduces the loop's no-drop trajectory exactly
+(same queueing discipline, same distributions, different RNG stream) or
+declines.
+
+Reference analogue: none — the reference simulates every event
+(``happysimulator/core/simulation.py`` loop). This is the TPU-first
+rebuild's "model compiler" move: recognize the topology, emit the
+closed form, keep the loop as the general fallback.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from happysim_tpu.tpu.model import SERVER, SINK, EnsembleModel
+
+logger = logging.getLogger(__name__)
+
+INF = jnp.float32(jnp.inf)
+
+# Cap on elements per (replicas x customers) block: keeps peak HBM for
+# the ~10 live (R, N) f32 intermediates under ~6 GB.
+_BLOCK_ELEMENTS = 128 * 1024 * 1024
+
+
+def chain_plan(model: EnsembleModel) -> Optional[list[int]]:
+    """Server indices in chain order if the fast path applies, else None.
+
+    Applicable: exactly one stationary Poisson source (no profile) ->
+    chain of concurrency-1 servers with no deadlines/retries/outages ->
+    one sink, every edge latency-free, no routers/limiters/remotes.
+    """
+    if len(model.sources) != 1 or len(model.sinks) != 1:
+        return None
+    if model.routers or model.limiters or model.remotes:
+        return None
+    source = model.sources[0]
+    if source.arrival != "poisson" or source.profile is not None:
+        return None
+    if source.latency.mean_s != 0.0:
+        return None
+    order: list[int] = []
+    seen: set[int] = set()
+    ref = source.downstream
+    while ref is not None and ref.kind == SERVER:
+        if ref.index in seen:
+            return None  # feedback loop
+        seen.add(ref.index)
+        spec = model.servers[ref.index]
+        if (
+            spec.concurrency != 1
+            or spec.deadline_s is not None
+            or spec.outage_start_s is not None
+            or spec.latency.mean_s != 0.0
+        ):
+            return None
+        order.append(ref.index)
+        ref = spec.downstream
+    if ref is None or ref.kind != SINK:
+        return None
+    if not order or len(order) != len(model.servers):
+        return None
+    return order
+
+
+def _sample_service_block(compiled, v: int, draw, shape, mean):
+    """Vectorized service draws for server ``v`` — the same closed forms
+    as ``_Compiled._sample_service`` (engine.py:701), applied to whole
+    (R, N) blocks instead of one scalar per event. ``draw(extra)`` yields
+    per-replica-keyed uniforms of shape ``(*shape, *extra)``."""
+    kind = int(compiled.service_kind[v])
+    if kind == 0:  # constant
+        return jnp.broadcast_to(mean, shape)
+    if kind == 1:  # exponential
+        return -jnp.log(draw(())) * mean
+    if kind == 2:  # erlang-k (k in 2, 3)
+        k = int(compiled.srv_erlang_k[v])
+        u = draw((k,))
+        return -jnp.log(jnp.prod(u, axis=-1)) * mean / k
+    if kind == 3:  # balanced two-phase hyperexponential
+        u = draw((2,))
+        factor = jnp.where(
+            u[..., 0] < compiled.srv_hyp_p1[v],
+            compiled.srv_hyp_f1[v],
+            compiled.srv_hyp_f2[v],
+        )
+        return -jnp.log(u[..., 1]) * mean * factor
+    if kind == 4:  # lognormal (mean-preserving)
+        sigma = float(compiled.srv_ln_sigma[v])
+        u = jnp.clip(draw(()), 1e-7, 1.0 - 1e-7)
+        z = jnp.sqrt(jnp.float32(2.0)) * jax.scipy.special.erfinv(2.0 * u - 1.0)
+        return mean * jnp.exp(sigma * z - 0.5 * sigma * sigma)
+    if kind == 5:  # pareto with x_m fit to the mean
+        alpha = float(compiled.srv_par_alpha[v])
+        u = draw(())
+        return mean * float(compiled.srv_par_xmf[v]) * jnp.power(u, -1.0 / alpha)
+    raise AssertionError(f"unknown service kind {kind}")
+
+
+def run_chain(
+    model: EnsembleModel,
+    compiled,
+    plan: list[int],
+    n_replicas: int,
+    seed: int,
+    sharding,
+    src_rate: np.ndarray,  # (R, nS)
+    srv_mean: np.ndarray,  # (R, nV)
+):
+    """Closed-form chain execution.
+
+    Returns ``(reduced, events_total, wall_seconds)`` shaped exactly like
+    the event loop's ``reduce_final`` output, or None if the finite-
+    capacity certificate failed (caller falls back to the event scan).
+    """
+    from happysim_tpu.tpu.engine import HIST_BINS, _hist_bin
+    import time as _wall
+
+    horizon = float(model.horizon_s)
+    warmup = float(compiled.warmup)
+    source = model.sources[0]
+    stop = horizon
+    if source.stop_after_s is not None:
+        stop = min(stop, float(source.stop_after_s))
+
+    max_rate = float(np.max(src_rate))
+    lam = stop * max_rate
+    # Budget covering the Poisson count at ~6 sigma; replicas that would
+    # have produced more arrivals are counted as truncated (same bias
+    # contract as the event loop's max_events).
+    n_customers = int(lam + 6.0 * math.sqrt(max(lam, 1.0)) + 20.0)
+
+    nV = len(model.servers)
+    nK = len(model.sinks)
+    caps = [float(model.servers[v].queue_capacity) for v in plan]
+
+    n_devices = max(len(sharding.mesh.devices.reshape(-1)), 1)
+    if n_customers * n_devices > _BLOCK_ELEMENTS:
+        # Even the smallest shardable block (one replica per device)
+        # would blow the HBM budget the block cap exists to hold — a
+        # very-high-rate or very-long-horizon model. The event scan runs
+        # it in O(R x K) memory instead.
+        logger.info(
+            "chain fast path: %d customers x %d devices exceeds the "
+            "block memory budget — falling back to the event scan",
+            n_customers,
+            n_devices,
+        )
+        return None
+    block = max(1, _BLOCK_ELEMENTS // max(n_customers, 1))
+    block = min(n_replicas, max(n_devices, (block // n_devices) * n_devices))
+
+    def run_block(keys, rate, means):
+        # keys: (B, 2) per-replica PRNG keys, rate: (B,), means: (B, nV).
+        # Streams are keyed per REPLICA (like the event loop's
+        # split(seed, R)), so neither the block size nor the mesh shape
+        # changes any drawn value — sharding invariance holds.
+        B = rate.shape[0]
+        shape = (B, n_customers)
+
+        def replica_uniform(purpose, extra=()):
+            return jax.vmap(
+                lambda k: jax.random.uniform(
+                    jax.random.fold_in(k, purpose),
+                    (n_customers, *extra),
+                    minval=1e-12,
+                    maxval=1.0,
+                )
+            )(keys)
+
+        gaps = -jnp.log(replica_uniform(0)) / rate[:, None]
+        arrivals_raw = jnp.cumsum(gaps, axis=1)
+        live = arrivals_raw <= jnp.float32(stop)
+        truncated = arrivals_raw[:, -1] < jnp.float32(stop)
+        A = jnp.where(live, arrivals_raw, INF)
+        created = A
+
+        events = jnp.sum(live.astype(jnp.int32))  # source-fire events
+        overflow = jnp.bool_(False)
+        wait_sum = jnp.zeros((nV,), jnp.float32)
+        wait_n = jnp.zeros((nV,), jnp.int32)
+        busy = jnp.zeros((nV,), jnp.float32)
+        depth = jnp.zeros((nV,), jnp.float32)
+        started = jnp.zeros((nV,), jnp.int32)
+        completed = jnp.zeros((nV,), jnp.int32)
+
+        D = A
+        for si, v in enumerate(plan):
+            service = _sample_service_block(
+                compiled,
+                v,
+                lambda extra, _p=1 + si: replica_uniform(_p, extra),
+                (B, n_customers),
+                means[:, v][:, None],
+            )
+            csum = jnp.cumsum(service, axis=1)
+            # D_n = csum_n + max_{k<=n}(A_k - csum_{k-1})
+            D = csum + lax.cummax(A - (csum - service), axis=1)
+            start = D - service
+            wait = jnp.where(live, start - A, 0.0)
+
+            # Finite-capacity certificate: the number in system seen by
+            # arrival n (before admission) is n minus the departures at
+            # or before A_n. With BOTH sequences sorted this needs no
+            # search: in_system_n > cap  ⟺  fewer than n-cap departures
+            # by A_n  ⟺  D[n-cap-1] > A_n — one shifted elementwise
+            # compare. (A vmapped searchsorted here measured 19.8 s on a
+            # v5e for the bench shape; this form is 70 ms.)
+            shift = int(caps[si]) + 1
+            if shift < n_customers:
+                violation = (D[:, : n_customers - shift] > A[:, shift:]) & live[
+                    :, shift:
+                ]
+                overflow = overflow | jnp.any(violation)
+
+            m_start = live & (start >= jnp.float32(warmup)) & (start <= jnp.float32(horizon))
+            m_done = live & (D <= jnp.float32(horizon))
+            row = jnp.zeros((nV,), jnp.float32).at[v].set(1.0)
+            row_i = jnp.zeros((nV,), jnp.int32).at[v].set(1)
+            wait_sum = wait_sum + row * jnp.sum(jnp.where(m_start, wait, 0.0))
+            wait_n = wait_n + row_i * jnp.sum(m_start.astype(jnp.int32))
+            busy = busy + row * jnp.sum(jnp.where(m_start, service, 0.0))
+            # Queue-length integral over the measured window: each waiter
+            # contributes its in-window waiting interval (Fubini).
+            contrib = jnp.clip(
+                jnp.minimum(start, jnp.float32(horizon))
+                - jnp.maximum(A, jnp.float32(warmup)),
+                0.0,
+            )
+            depth = depth + row * jnp.sum(jnp.where(live, contrib, 0.0))
+            started = started + row_i * jnp.sum(
+                (live & (start <= jnp.float32(horizon))).astype(jnp.int32)
+            )
+            completed = completed + row_i * jnp.sum(m_done.astype(jnp.int32))
+            events = events + jnp.sum(m_done.astype(jnp.int32))
+
+            # Next stage sees this stage's departures — but only those
+            # that happen inside the horizon ever fire in the loop.
+            live = m_done
+            A = jnp.where(live, D, INF)
+
+        latency = jnp.where(live, D - created, 0.0)
+        m_sink = live & (D >= jnp.float32(warmup))
+        sink_count = jnp.sum(m_sink.astype(jnp.int32))
+        sink_sum = jnp.sum(jnp.where(m_sink, latency, 0.0))
+        sink_sq = jnp.sum(jnp.where(m_sink, latency * latency, 0.0))
+        # Broadcast-compare histogram: XLA fuses the (R, N, BINS) compare
+        # into the reduction, one pass over the data (a segment_sum
+        # scatter here measured 0.94 s on a v5e; this is ~80 ms).
+        bins = jnp.where(m_sink, _hist_bin(latency), jnp.int32(HIST_BINS))
+        hist = jnp.sum(
+            bins[:, :, None] == jnp.arange(HIST_BINS, dtype=jnp.int32)[None, None, :],
+            axis=(0, 1),
+            dtype=jnp.int32,
+        )
+
+        return {
+            "truncated": jnp.sum(truncated.astype(jnp.int32)),
+            "events": events,
+            "overflow": overflow,
+            "sink_count": sink_count[None].astype(jnp.int32),  # nK == 1 by plan
+            "sink_sum": sink_sum[None],
+            "sink_sq": sink_sq[None],
+            "sink_hist": hist[None, :],
+            "srv_completed": completed.astype(jnp.int32),
+            "srv_started": started.astype(jnp.int32),
+            "srv_busy_int": busy,
+            "srv_depth_int": depth,
+            "srv_wait_sum": wait_sum,
+            "srv_wait_n": wait_n.astype(jnp.int32),
+        }
+
+    jit_block = jax.jit(run_block)  # shardings follow the committed inputs
+
+    # Per-replica keys, like the event loop's split(PRNGKey(seed), R):
+    # every replica's stream is a pure function of (seed, replica index),
+    # independent of blocking and mesh shape.
+    all_keys = jax.random.split(jax.random.PRNGKey(seed), n_replicas)
+    blocks = []
+    for b in range(0, n_replicas, block):
+        size = min(block, n_replicas - b)
+        keys_b = jax.device_put(all_keys[b : b + size], sharding)
+        rate = jax.device_put(jnp.asarray(src_rate[b : b + size, 0]), sharding)
+        means = jax.device_put(jnp.asarray(srv_mean[b : b + size]), sharding)
+        blocks.append((keys_b, rate, means))
+
+    # AOT-compile every distinct block shape before the timer, like the
+    # event loop's lowered scan (the timed region is pure execution).
+    compiled_fns = {}
+    for keys_b, rate, means in blocks:
+        shape = rate.shape[0]
+        if shape not in compiled_fns:
+            compiled_fns[shape] = jit_block.lower(keys_b, rate, means).compile()
+
+    start_t = _wall.perf_counter()
+    partials = [
+        compiled_fns[rate.shape[0]](key_b, rate, means)
+        for key_b, rate, means in blocks
+    ]
+    overflow = any(bool(p["overflow"]) for p in partials)
+    wall = _wall.perf_counter() - start_t
+    if overflow:
+        logger.info(
+            "chain fast path: finite-capacity certificate failed "
+            "(an arrival would have been dropped) — falling back to the "
+            "event scan"
+        )
+        return None
+
+    def total(name):
+        return np.sum(np.stack([np.asarray(p[name]) for p in partials]), axis=0)
+
+    zeros_v = np.zeros((nV,), np.int32)
+    reduced = {
+        "truncated": total("truncated"),
+        "events": total("events"),
+        "sink_count": total("sink_count"),
+        "sink_sum": total("sink_sum"),
+        "sink_sq": total("sink_sq"),
+        "sink_hist": total("sink_hist"),
+        "srv_completed": total("srv_completed"),
+        "srv_dropped": zeros_v,
+        "srv_outage_dropped": zeros_v,
+        "srv_started": total("srv_started"),
+        "srv_timed_out": zeros_v,
+        "srv_retried": zeros_v,
+        "srv_busy_int": total("srv_busy_int"),
+        "srv_depth_int": total("srv_depth_int"),
+        "srv_wait_sum": total("srv_wait_sum"),
+        "srv_wait_n": total("srv_wait_n"),
+        "lim_admitted": np.zeros((max(len(model.limiters), 1),), np.int32),
+        "lim_dropped": np.zeros((max(len(model.limiters), 1),), np.int32),
+    }
+    events_total = int(reduced["events"])
+    return reduced, events_total, wall

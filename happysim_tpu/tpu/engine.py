@@ -1569,6 +1569,9 @@ def run_ensemble(
     if mesh is None:
         mesh = replica_mesh()
     n_replicas = pad_to_multiple(n_replicas, mesh.size)
+    # An explicit event budget is a contract about truncation the chain
+    # fast path does not implement (it has its own arrival budget).
+    explicit_max_events = max_events is not None
     if max_events is None:
         max_events = _default_max_events(model, sweeps)
 
@@ -1604,6 +1607,35 @@ def run_ensemble(
             srv_mean = arr
 
     sharding = replica_sharding(mesh)
+
+    # Topology-specialized fast path: a Poisson->FIFO-chain->sink model
+    # needs no event loop at all (max-plus Lindley per stage, see
+    # chain.py). Engages only when its finite-capacity certificate holds
+    # — any would-be drop falls back to the scan below. Checkpointed and
+    # resumed runs always use the scan (its carry IS the snapshot format).
+    checkpointing_requested = (
+        checkpoint_every_s is not None
+        or checkpoint_callback is not None
+        or resume_from is not None
+    )
+    if (
+        not checkpointing_requested
+        and not explicit_max_events
+        and os.environ.get("HS_TPU_CHAIN", "1") != "0"
+    ):
+        from happysim_tpu.tpu.chain import chain_plan, run_chain
+
+        plan = chain_plan(model)
+        if plan is not None:
+            fast = run_chain(
+                model, compiled, plan, n_replicas, seed, sharding, src_rate, srv_mean
+            )
+            if fast is not None:
+                reduced, events_total, wall = fast
+                return _build_result(
+                    model, compiled, reduced, events_total, wall, n_replicas
+                )
+
     params = {
         "src_rate": jax.device_put(jnp.asarray(src_rate), sharding),
         "srv_mean": jax.device_put(jnp.asarray(srv_mean), sharding),
@@ -1726,15 +1758,26 @@ def run_ensemble(
             resume_from=resume_from,
         )
 
+    return _build_result(
+        model, compiled, reduced, events_total, wall, n_replicas, max_events
+    )
+
+
+def _build_result(
+    model, compiled, reduced, events_total, wall, n_replicas, max_events=None
+) -> EnsembleResult:
+    """Shared result assembly for the event scan and the chain fast path
+    (``chain.run_chain`` emits the same ``reduced`` key set)."""
+    horizon = float(model.horizon_s)
     truncated = int(reduced["truncated"])
     if truncated:
         logger.warning(
             "run_ensemble: %d/%d replicas exhausted the event budget "
-            "(max_events=%d) before the %.3fs horizon — statistics are "
+            "(max_events=%s) before the %.3fs horizon — statistics are "
             "biased toward early sim-time; pass a larger max_events.",
             truncated,
             n_replicas,
-            max_events,
+            max_events if max_events is not None else "chain arrival budget",
             horizon,
         )
 

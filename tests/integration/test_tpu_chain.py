@@ -1,0 +1,231 @@
+"""The chain fast path: plan applicability, fast-vs-loop agreement,
+finite-capacity certificate fallback, and sharding invariance.
+
+``chain.run_chain`` replaces the event scan with per-stage max-plus
+Lindley recurrences whenever the topology allows; these tests pin (a)
+exactly WHEN it may engage, (b) that its statistics agree with the event
+loop and the analytic oracles, and (c) that the certificate refuses
+rather than mispricing drops.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu import mm1_model, run_ensemble
+from happysim_tpu.tpu.chain import chain_plan
+from happysim_tpu.tpu.model import EnsembleModel
+
+pytestmark = pytest.mark.tpu
+
+
+def chain(n_stages=1, cap=256, service="exponential", means=None, rate=8.0,
+          horizon=40.0, warmup=10.0, stop_after=None):
+    model = EnsembleModel(horizon_s=horizon, warmup_s=warmup)
+    source = model.source(rate=rate, kind="poisson", stop_after_s=stop_after)
+    previous = source
+    for i in range(n_stages):
+        mean = (means or [0.08] * n_stages)[i]
+        server = model.server(
+            service_mean=mean, service=service, queue_capacity=cap,
+            service_scv=2.0,
+        )
+        model.connect(previous, server)
+        previous = server
+    model.connect(previous, model.sink())
+    return model
+
+
+def run_both(model, n_replicas=512, seed=0, **kw):
+    """Fast path vs event scan, restoring any pre-set HS_TPU_CHAIN (an
+    exported =0 must not silently turn this into scan-vs-scan, nor be
+    deleted for the rest of the process)."""
+    prior = os.environ.pop("HS_TPU_CHAIN", None)
+    try:
+        fast = run_ensemble(model, n_replicas=n_replicas, seed=seed, **kw)
+        os.environ["HS_TPU_CHAIN"] = "0"
+        slow = run_ensemble(model, n_replicas=n_replicas, seed=seed, **kw)
+    finally:
+        if prior is None:
+            os.environ.pop("HS_TPU_CHAIN", None)
+        else:
+            os.environ["HS_TPU_CHAIN"] = prior
+    return fast, slow
+
+
+class TestPlan:
+    def test_mm1_is_a_chain(self):
+        assert chain_plan(mm1_model()) == [0]
+
+    def test_tandem_orders_servers(self):
+        assert chain_plan(chain(n_stages=3)) == [0, 1, 2]
+
+    def test_router_disqualifies(self):
+        model = EnsembleModel(horizon_s=10.0)
+        source = model.source(rate=5.0)
+        a = model.server(service_mean=0.05)
+        b = model.server(service_mean=0.05)
+        sink = model.sink()
+        router = model.router(policy="random", targets=[])
+        model.connect(source, router)
+        model.connect(router, a)
+        model.connect(router, b)
+        model.connect(a, sink)
+        model.connect(b, sink)
+        assert chain_plan(model) is None
+
+    def test_concurrency_disqualifies(self):
+        model = EnsembleModel(horizon_s=10.0)
+        source = model.source(rate=5.0)
+        server = model.server(service_mean=0.05, concurrency=2)
+        model.connect(source, server)
+        model.connect(server, model.sink())
+        assert chain_plan(model) is None
+
+    def test_deadline_outage_latency_disqualify(self):
+        for kwargs, connect_latency in [
+            (dict(deadline_s=1.0), 0.0),
+            (dict(outage=(1.0, 2.0)), 0.0),
+            (dict(), 0.01),
+        ]:
+            model = EnsembleModel(horizon_s=10.0)
+            source = model.source(rate=5.0)
+            server = model.server(service_mean=0.05, **kwargs)
+            model.connect(source, server)
+            model.connect(server, model.sink(), latency_s=connect_latency)
+            assert chain_plan(model) is None, (kwargs, connect_latency)
+
+    def test_profiled_source_disqualifies(self):
+        model = EnsembleModel(horizon_s=10.0)
+        source = model.ramp_source(start_rate=5.0, end_rate=10.0, ramp_duration_s=5.0)
+        server = model.server(service_mean=0.05)
+        model.connect(source, server)
+        model.connect(server, model.sink())
+        assert chain_plan(model) is None
+
+
+class TestAgreement:
+    def test_mm1_matches_loop_and_analytic(self):
+        model = mm1_model(lam=8.0, mu=10.0, horizon_s=60.0, warmup_s=15.0)
+        fast, slow = run_both(model, n_replicas=768, seed=3)
+        assert fast.server_dropped == [0]
+        # Analytic Wq = rho/(mu-lam) = 0.4; generous MC tolerance at this
+        # scale, tight agreement between the two paths.
+        assert abs(fast.server_mean_wait_s[0] - 0.4) / 0.4 < 0.1
+        for name in ("server_mean_wait_s", "server_utilization",
+                     "sink_mean_latency_s", "server_mean_queue_len"):
+            f = getattr(fast, name)[0]
+            s = getattr(slow, name)[0]
+            assert abs(f - s) / max(abs(s), 1e-9) < 0.08, (name, f, s)
+        # Identical hist binning => identical quantile grid.
+        assert fast.sink_p50_s[0] == slow.sink_p50_s[0]
+
+    @pytest.mark.parametrize("service", ["constant", "erlang", "hyperexp",
+                                         "lognormal", "pareto"])
+    def test_service_families_match_loop(self, service):
+        model = chain(service=service, means=[0.06])
+        fast, slow = run_both(model, n_replicas=512, seed=11)
+        f, s = fast.server_mean_wait_s[0], slow.server_mean_wait_s[0]
+        # Heavy-tailed services converge slowly at this replica count;
+        # measured seed spread for pareto is ~0.18 relative.
+        tolerance = 0.3 if service == "pareto" else 0.15
+        assert abs(f - s) / max(abs(s), 1e-6) < tolerance, (service, f, s)
+        assert abs(fast.server_utilization[0] - slow.server_utilization[0]) < 0.02
+
+    def test_tandem_stages_match_loop(self):
+        model = chain(n_stages=3, means=[0.08, 0.05, 0.03])
+        fast, slow = run_both(model, n_replicas=512, seed=5)
+        for v in range(3):
+            f, s = fast.server_mean_wait_s[v], slow.server_mean_wait_s[v]
+            assert abs(f - s) < 0.02, (v, f, s)
+        assert (
+            abs(fast.sink_mean_latency_s[0] - slow.sink_mean_latency_s[0]) < 0.02
+        )
+
+    def test_stop_after_limits_arrivals(self):
+        model = chain(stop_after=5.0, horizon=40.0, warmup=0.0)
+        fast, slow = run_both(model, n_replicas=256, seed=7)
+        assert fast.sink_count[0] > 0
+        rel = abs(fast.sink_count[0] - slow.sink_count[0]) / slow.sink_count[0]
+        assert rel < 0.05
+
+    def test_sweeps_vary_per_replica(self):
+        model = chain()
+        rates = np.linspace(2.0, 9.0, 256).astype(np.float32)
+        result = run_ensemble(
+            model, n_replicas=256, seed=2, sweeps={"source_rate": rates}
+        )
+        # Aggregate throughput reflects the mean swept rate, not the spec
+        # default.
+        expected = float(np.sum(rates)) * 40.0
+        assert abs(result.server_completed[0] - expected) / expected < 0.05
+
+
+class TestCertificate:
+    def test_small_capacity_falls_back_with_drops(self):
+        model = chain(cap=2, rate=9.5, means=[0.1], horizon=30.0, warmup=5.0)
+        result = run_ensemble(model, n_replicas=128, seed=1)
+        # Fast path must have declined: the loop's drop accounting shows.
+        assert result.server_dropped[0] > 0
+
+    def test_large_capacity_engages_with_zero_drops(self):
+        result = run_ensemble(mm1_model(horizon_s=30.0), n_replicas=128, seed=1)
+        assert result.server_dropped == [0]
+        assert result.truncated_replicas == 0
+
+    def test_declines_when_memory_budget_exceeded(self):
+        """A very-high-rate model would blow the block HBM budget even at
+        one replica per device: run_chain must decline BEFORE allocating
+        (the event scan runs it in bounded memory instead)."""
+        import numpy as np
+
+        from happysim_tpu.tpu.chain import run_chain
+        from happysim_tpu.tpu.engine import _Compiled
+        from happysim_tpu.tpu.mesh import replica_mesh, replica_sharding
+
+        model = chain(rate=2e6, horizon=100.0, warmup=0.0)
+        sharding = replica_sharding(replica_mesh())
+        out = run_chain(
+            model,
+            _Compiled(model),
+            [0],
+            n_replicas=8,
+            seed=0,
+            sharding=sharding,
+            src_rate=np.full((8, 1), 2e6, np.float32),
+            srv_mean=np.full((8, 1), 0.08, np.float32),
+        )
+        assert out is None
+
+    def test_explicit_max_events_uses_loop(self):
+        # The event-budget contract belongs to the scan; a tiny budget
+        # must produce truncated replicas, which the chain path never
+        # reports for an un-truncated arrival stream.
+        model = mm1_model(horizon_s=40.0)
+        result = run_ensemble(model, n_replicas=64, seed=0, max_events=64)
+        assert result.truncated_replicas > 0
+
+
+class TestShardingInvariance:
+    def test_mesh_shape_does_not_change_results(self):
+        import jax
+        from happysim_tpu.tpu.mesh import replica_mesh
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            pytest.skip("needs a multi-device (virtual) mesh")
+        model = mm1_model(horizon_s=30.0, warmup_s=5.0)
+        full = run_ensemble(
+            model, n_replicas=64, seed=9, mesh=replica_mesh(devices)
+        )
+        single = run_ensemble(
+            model, n_replicas=64, seed=9, mesh=replica_mesh(devices[:1])
+        )
+        assert full.server_completed == single.server_completed
+        assert np.isclose(
+            full.server_mean_wait_s[0], single.server_mean_wait_s[0], rtol=1e-5
+        )
+        assert full.sink_count == single.sink_count

@@ -10,6 +10,8 @@ seed, absolute chunk/window indexing), on the 8-device virtual mesh.
 
 import dataclasses
 
+import os
+
 import numpy as np
 import pytest
 
@@ -47,7 +49,17 @@ class TestEnsembleCheckpoint:
     ):
         model = mm1_model(lam=8.0, mu=10.0, horizon_s=10.0, warmup_s=2.0)
         kwargs = dict(n_replicas=16, seed=3, mesh=cpu_mesh)
-        baseline = run_ensemble(model, **kwargs)
+        # The baseline must be the event SCAN (chain fast path draws a
+        # different stream): this test compares scan vs segmented scan.
+        prior = os.environ.get("HS_TPU_CHAIN")
+        os.environ["HS_TPU_CHAIN"] = "0"
+        try:
+            baseline = run_ensemble(model, **kwargs)
+        finally:
+            if prior is None:
+                os.environ.pop("HS_TPU_CHAIN", None)
+            else:
+                os.environ["HS_TPU_CHAIN"] = prior
 
         snapshots = []
         checkpointed = run_ensemble(
