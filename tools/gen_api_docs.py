@@ -34,6 +34,7 @@ PAGES: dict[str, list[str]] = {
     "visual": ["happysim_tpu.visual"],
     "logging": ["happysim_tpu.logging_config"],
     "tpu": ["happysim_tpu.tpu"],
+    "utils": ["happysim_tpu.utils"],
     "components-primitives": [
         "happysim_tpu.components.queue",
         "happysim_tpu.components.queue_driver",
@@ -43,43 +44,29 @@ PAGES: dict[str, list[str]] = {
         "happysim_tpu.components.common",
         "happysim_tpu.components.random_router",
     ],
-    "components-server-client": [
-        "happysim_tpu.components.server",
-        "happysim_tpu.components.client",
-        "happysim_tpu.components.load_balancer",
-    ],
+    "components-server": ["happysim_tpu.components.server"],
+    "components-client": ["happysim_tpu.components.client"],
+    "components-load-balancer": ["happysim_tpu.components.load_balancer"],
     "components-network": ["happysim_tpu.components.network"],
     "components-consensus": ["happysim_tpu.components.consensus"],
-    "components-replication-crdt": [
-        "happysim_tpu.components.replication",
-        "happysim_tpu.components.crdt",
-    ],
-    "components-datastore-storage": [
-        "happysim_tpu.components.datastore",
-        "happysim_tpu.components.storage",
-    ],
-    "components-streaming-messaging": [
-        "happysim_tpu.components.streaming",
-        "happysim_tpu.components.messaging",
-    ],
-    "components-resilience-ratelimit": [
-        "happysim_tpu.components.resilience",
-        "happysim_tpu.components.rate_limiter",
-        "happysim_tpu.components.queue_policies",
-    ],
-    "components-microservice-deployment": [
-        "happysim_tpu.components.microservice",
-        "happysim_tpu.components.deployment",
-        "happysim_tpu.components.scheduling",
-    ],
+    "components-replication": ["happysim_tpu.components.replication"],
+    "components-crdt": ["happysim_tpu.components.crdt"],
+    "components-datastore": ["happysim_tpu.components.datastore"],
+    "components-storage": ["happysim_tpu.components.storage"],
+    "components-streaming": ["happysim_tpu.components.streaming"],
+    "components-messaging": ["happysim_tpu.components.messaging"],
+    "components-resilience": ["happysim_tpu.components.resilience"],
+    "components-rate-limiter": ["happysim_tpu.components.rate_limiter"],
+    "components-queue-policies": ["happysim_tpu.components.queue_policies"],
+    "components-microservice": ["happysim_tpu.components.microservice"],
+    "components-deployment": ["happysim_tpu.components.deployment"],
+    "components-scheduling": ["happysim_tpu.components.scheduling"],
     "components-infrastructure": ["happysim_tpu.components.infrastructure"],
     "components-industrial": ["happysim_tpu.components.industrial"],
     "components-behavior": ["happysim_tpu.components.behavior"],
-    "components-sync-sketching": [
-        "happysim_tpu.components.sync",
-        "happysim_tpu.components.sketching",
-        "happysim_tpu.components.advertising",
-    ],
+    "components-sync": ["happysim_tpu.components.sync"],
+    "components-sketching": ["happysim_tpu.components.sketching"],
+    "components-advertising": ["happysim_tpu.components.advertising"],
 }
 
 
