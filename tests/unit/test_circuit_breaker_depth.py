@@ -1,0 +1,184 @@
+"""Circuit-breaker state-machine depth: the full transition matrix,
+half-open probe limiting, lazy recovery, and counter hygiene.
+
+The composite resilience tests drive one happy path; these pin every
+edge of CLOSED -> OPEN -> HALF_OPEN -> {CLOSED, OPEN} where breaker
+bugs live (stale-era outcomes, probe floods, counter leaks across
+transitions).
+
+Parity target: ``happysimulator/tests/unit/test_circuit_breaker.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu import Instant, Simulation, Sink
+from happysim_tpu.components.resilience.circuit_breaker import (
+    CircuitBreaker,
+    CircuitState,
+)
+
+
+def make(failure_threshold=3, success_threshold=2, recovery_timeout=10.0,
+         half_open_max_probes=1):
+    breaker = CircuitBreaker(
+        "breaker",
+        downstream=Sink("backend"),
+        failure_threshold=failure_threshold,
+        success_threshold=success_threshold,
+        recovery_timeout=recovery_timeout,
+        half_open_max_probes=half_open_max_probes,
+    )
+    sim = Simulation(entities=[breaker], end_time=Instant.from_seconds(1000.0))
+    return breaker, sim
+
+
+def advance(sim, seconds: float) -> None:
+    sim._clock.update(sim.now + seconds)
+
+
+class TestClosedToOpen:
+    def test_opens_exactly_at_threshold(self):
+        breaker, _ = make(failure_threshold=3)
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state is CircuitState.CLOSED
+        breaker.record_failure()
+        assert breaker.state is CircuitState.OPEN
+
+    def test_success_resets_the_failure_streak(self):
+        breaker, _ = make(failure_threshold=3)
+        breaker.record_failure()
+        breaker.record_failure()
+        breaker.record_success()  # consecutive-failure counter resets
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state is CircuitState.CLOSED
+        breaker.record_failure()
+        assert breaker.state is CircuitState.OPEN
+
+    def test_transition_counter_increments_once(self):
+        breaker, _ = make(failure_threshold=1)
+        breaker.record_failure()
+        assert breaker.state_transitions == 1
+        breaker.record_failure()  # already open: no double transition
+        assert breaker.state_transitions == 1
+
+
+class TestRecovery:
+    def test_half_open_exactly_at_timeout(self):
+        breaker, sim = make(failure_threshold=1, recovery_timeout=10.0)
+        breaker.record_failure()
+        advance(sim, 9.999)
+        assert breaker.state is CircuitState.OPEN
+        advance(sim, 0.002)
+        assert breaker.state is CircuitState.HALF_OPEN
+
+    def test_half_open_success_threshold_closes(self):
+        breaker, sim = make(
+            failure_threshold=1, success_threshold=2, recovery_timeout=1.0
+        )
+        breaker.record_failure()
+        advance(sim, 1.1)
+        assert breaker.state is CircuitState.HALF_OPEN
+        breaker.record_success()
+        assert breaker.state is CircuitState.HALF_OPEN
+        breaker.record_success()
+        assert breaker.state is CircuitState.CLOSED
+
+    def test_half_open_failure_reopens_immediately(self):
+        breaker, sim = make(failure_threshold=1, recovery_timeout=1.0)
+        breaker.record_failure()
+        advance(sim, 1.1)
+        assert breaker.state is CircuitState.HALF_OPEN
+        breaker.record_failure()
+        assert breaker.state is CircuitState.OPEN
+        # And the recovery clock restarted: still open at +0.9.
+        advance(sim, 0.9)
+        assert breaker.state is CircuitState.OPEN
+        advance(sim, 0.2)
+        assert breaker.state is CircuitState.HALF_OPEN
+
+    def test_reopen_clears_success_progress(self):
+        breaker, sim = make(
+            failure_threshold=1, success_threshold=2, recovery_timeout=1.0
+        )
+        breaker.record_failure()
+        advance(sim, 1.1)
+        breaker.record_success()  # 1 of 2
+        breaker.record_failure()  # reopen
+        advance(sim, 1.1)
+        assert breaker.state is CircuitState.HALF_OPEN
+        breaker.record_success()  # progress must restart at 1 of 2
+        assert breaker.state is CircuitState.HALF_OPEN
+        breaker.record_success()
+        assert breaker.state is CircuitState.CLOSED
+
+
+class TestForcedTransitions:
+    def test_force_open_rejects(self):
+        breaker, sim = make()
+        breaker.force_open()
+        assert breaker.state is CircuitState.OPEN
+
+    def test_force_close_from_open(self):
+        breaker, sim = make(failure_threshold=1)
+        breaker.record_failure()
+        breaker.force_close()
+        assert breaker.state is CircuitState.CLOSED
+
+    def test_reset_clears_counters(self):
+        breaker, sim = make(failure_threshold=3)
+        breaker.record_failure()
+        breaker.record_failure()
+        breaker.reset()
+        assert breaker.failure_count == 0
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state is CircuitState.CLOSED
+
+
+class TestEventFlow:
+    def _wire(self, **kwargs):
+        sink = Sink("backend")
+        breaker = CircuitBreaker("breaker", downstream=sink, **kwargs)
+        sim = Simulation(
+            entities=[breaker, sink], end_time=Instant.from_seconds(1000.0)
+        )
+        return breaker, sink, sim
+
+    def _request(self, sim, breaker, at):
+        from happysim_tpu.core.event import Event
+
+        sim.schedule(Event(Instant.from_seconds(at), "req", target=breaker))
+
+    def test_open_circuit_drops_requests(self):
+        breaker, sink, sim = self._wire(failure_threshold=1, call_timeout=None)
+        breaker.force_open()
+        self._request(sim, breaker, 0.5)
+        sim.run()
+        assert sink.events_received == 0
+        assert breaker.requests_rejected == 1
+
+    def test_closed_circuit_forwards(self):
+        breaker, sink, sim = self._wire(call_timeout=None)
+        self._request(sim, breaker, 0.5)
+        sim.run()
+        assert sink.events_received == 1
+        assert breaker.requests_allowed == 1
+
+    def test_half_open_probe_cap(self):
+        breaker, sink, sim = self._wire(
+            failure_threshold=1,
+            recovery_timeout=1.0,
+            call_timeout=None,
+            half_open_max_probes=1,
+        )
+        breaker.record_failure()
+        # Two same-instant requests after recovery: only ONE probes.
+        self._request(sim, breaker, 1.5)
+        self._request(sim, breaker, 1.5)
+        sim.run()
+        assert breaker.requests_allowed == 1
+        assert breaker.requests_rejected == 1

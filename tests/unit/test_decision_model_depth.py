@@ -1,0 +1,188 @@
+"""Decision-model contracts: argmax vs softmax, rule priority, the
+satisficing scan, conformity blending, and composite voting.
+
+Parity target: the per-model cases of
+``happysimulator/tests/unit/test_behavior_decision.py``.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import Counter
+
+import pytest
+
+from happysim_tpu.components.behavior import (
+    BoundedRationalityModel,
+    Choice,
+    CompositeModel,
+    DecisionContext,
+    PersonalityTraits,
+    Rule,
+    RuleBasedModel,
+    SocialInfluenceModel,
+    UtilityModel,
+)
+from happysim_tpu.components.behavior.state import AgentState
+
+
+def context(choices, *, traits=None, social=None, stimulus=None):
+    return DecisionContext(
+        traits=PersonalityTraits.big_five(**(traits or {})),
+        state=AgentState(),
+        choices=[Choice(c) if isinstance(c, str) else c for c in choices],
+        stimulus=stimulus or {},
+        social_context=social or {},
+    )
+
+
+PRICE = {"cheap": 0.9, "mid": 0.5, "pricey": 0.1}
+
+
+def utility(choice, _context):
+    return PRICE[choice.action]
+
+
+class TestUtilityModel:
+    def test_zero_temperature_is_argmax(self):
+        model = UtilityModel(utility)
+        rng = random.Random(1)
+        for _ in range(10):
+            assert model.decide(context(PRICE), rng).action == "cheap"
+
+    def test_softmax_spreads_with_temperature(self):
+        model = UtilityModel(utility, temperature=2.0)
+        rng = random.Random(2)
+        picks = Counter(model.decide(context(PRICE), rng).action for _ in range(500))
+        assert set(picks) == set(PRICE)  # high temperature: all explored
+        assert picks["cheap"] > picks["pricey"]  # ...still biased by utility
+
+    def test_low_temperature_concentrates(self):
+        cold = UtilityModel(utility, temperature=0.05)
+        rng = random.Random(3)
+        picks = Counter(cold.decide(context(PRICE), rng).action for _ in range(300))
+        assert picks["cheap"] > 290
+
+    def test_empty_choices_abstains(self):
+        assert UtilityModel(utility).decide(context([]), random.Random(1)) is None
+
+
+class TestRuleBasedModel:
+    RULES = [
+        Rule(condition=lambda ctx: ctx.stimulus.get("sale", False), action="cheap",
+             priority=10),
+        Rule(condition=lambda ctx: True, action="mid", priority=1),
+    ]
+
+    def test_highest_priority_match_wins(self):
+        model = RuleBasedModel(self.RULES)
+        picked = model.decide(
+            context(PRICE, stimulus={"sale": True}), random.Random(1)
+        )
+        assert picked.action == "cheap"
+
+    def test_falls_through_to_lower_priority(self):
+        model = RuleBasedModel(self.RULES)
+        assert model.decide(context(PRICE), random.Random(1)).action == "mid"
+
+    def test_default_action_when_nothing_matches(self):
+        model = RuleBasedModel(
+            [Rule(condition=lambda ctx: False, action="cheap")],
+            default_action="pricey",
+        )
+        assert model.decide(context(PRICE), random.Random(1)).action == "pricey"
+
+    def test_no_match_no_default_abstains(self):
+        model = RuleBasedModel([Rule(condition=lambda ctx: False, action="cheap")])
+        assert model.decide(context(PRICE), random.Random(1)) is None
+
+    def test_fired_rule_with_absent_action_abstains(self):
+        """Documented short-circuit: a rule that fires but names an
+        action outside the choice set abstains — no fall-through to
+        lower rules or the default."""
+        model = RuleBasedModel(
+            [Rule(condition=lambda ctx: True, action="not_offered")],
+            default_action="mid",
+        )
+        assert model.decide(context(PRICE), random.Random(1)) is None
+
+
+class TestBoundedRationality:
+    def test_high_aspiration_degenerates_to_best(self):
+        model = BoundedRationalityModel(utility, aspiration=5.0)  # unreachable
+        assert model.decide(context(PRICE), random.Random(4)).action == "cheap"
+
+    def test_low_aspiration_takes_first_good_enough(self):
+        model = BoundedRationalityModel(utility, aspiration=0.4)
+        picks = Counter(
+            model.decide(context(PRICE), random.Random(seed)).action
+            for seed in range(200)
+        )
+        # cheap and mid both clear 0.4; scan order is random, so both
+        # appear — the satisficer does NOT always find the optimum.
+        assert picks["mid"] > 0 and picks["cheap"] > 0
+        assert picks["pricey"] == 0
+
+    def test_zero_aspiration_is_random_first_hit(self):
+        model = BoundedRationalityModel(utility, aspiration=0.0)
+        picks = Counter(
+            model.decide(context(PRICE), random.Random(seed)).action
+            for seed in range(300)
+        )
+        assert all(picks[a] > 50 for a in PRICE)
+
+
+class TestSocialInfluence:
+    def test_unanimous_peers_pull_an_agreeable_agent(self):
+        model = SocialInfluenceModel(utility, conformity_weight=1.0)
+        social = {"peer_actions": {"pricey": 50}}
+        picks = Counter(
+            model.decide(
+                context(PRICE, traits={"agreeableness": 1.0}, social=social),
+                random.Random(seed),
+            ).action
+            for seed in range(300)
+        )
+        assert picks["pricey"] > 250  # pressure 1.0: peers dominate
+
+    def test_disagreeable_agent_ignores_peers(self):
+        model = SocialInfluenceModel(utility, conformity_weight=1.0)
+        social = {"peer_actions": {"pricey": 50}}
+        picks = Counter(
+            model.decide(
+                context(PRICE, traits={"agreeableness": 0.0}, social=social),
+                random.Random(seed),
+            ).action
+            for seed in range(300)
+        )
+        assert picks["cheap"] > picks["pricey"]
+
+    def test_no_peer_signal_reduces_to_utility_sampling(self):
+        model = SocialInfluenceModel(utility, conformity_weight=0.5)
+        picks = Counter(
+            model.decide(
+                context(PRICE, traits={"agreeableness": 0.5}), random.Random(seed)
+            ).action
+            for seed in range(300)
+        )
+        assert picks["cheap"] > picks["pricey"]
+
+
+class TestCompositeModel:
+    def test_weighted_vote_wins(self):
+        always_cheap = UtilityModel(lambda c, _: 1.0 if c.action == "cheap" else 0.0)
+        always_mid = UtilityModel(lambda c, _: 1.0 if c.action == "mid" else 0.0)
+        model = CompositeModel([(always_cheap, 1.0), (always_mid, 2.0)])
+        assert model.decide(context(PRICE), random.Random(1)).action == "mid"
+
+    def test_tie_goes_to_first_voter(self):
+        always_cheap = UtilityModel(lambda c, _: 1.0 if c.action == "cheap" else 0.0)
+        always_mid = UtilityModel(lambda c, _: 1.0 if c.action == "mid" else 0.0)
+        model = CompositeModel([(always_cheap, 1.0), (always_mid, 1.0)])
+        assert model.decide(context(PRICE), random.Random(1)).action == "cheap"
+
+    def test_abstaining_submodel_casts_no_vote(self):
+        abstainer = RuleBasedModel([Rule(condition=lambda ctx: False, action="x")])
+        always_mid = UtilityModel(lambda c, _: 1.0 if c.action == "mid" else 0.0)
+        model = CompositeModel([(abstainer, 5.0), (always_mid, 1.0)])
+        assert model.decide(context(PRICE), random.Random(1)).action == "mid"

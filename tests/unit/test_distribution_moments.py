@@ -1,0 +1,143 @@
+"""Moment conformance for every latency distribution family.
+
+Each family promises a mean and a squared coefficient of variation
+(SCV); queueing results computed from them (Pollaczek-Khinchine, the
+TPU service twins) are only as right as these moments. Sampled mean and
+SCV must match the configured values within Monte-Carlo tolerance for
+EVERY family, plus each family's shape-specific signatures.
+
+Parity target: ``happysimulator/tests/unit/test_distributions.py``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.distributions.latency_distribution import (
+    ConstantLatency,
+    ErlangLatency,
+    ExponentialLatency,
+    HyperExponentialLatency,
+    LogNormalLatency,
+    ParetoLatency,
+    PercentileFittedLatency,
+    ShiftedLatency,
+    UniformLatency,
+)
+
+N = 40_000
+NOW = Instant.Epoch
+
+
+def draw(dist, n=N):
+    return [dist.get_latency(NOW).to_seconds() for _ in range(n)]
+
+
+def moments(samples):
+    mean = sum(samples) / len(samples)
+    var = sum((s - mean) ** 2 for s in samples) / len(samples)
+    return mean, (var / (mean * mean) if mean else 0.0)
+
+
+CASES = {
+    "constant": (lambda: ConstantLatency(0.2), 0.2, 0.0),
+    "exponential": (lambda: ExponentialLatency(0.2, seed=1), 0.2, 1.0),
+    "uniform": (lambda: UniformLatency(0.1, 0.3, seed=2), 0.2, 1.0 / 12.0),
+    "erlang2": (lambda: ErlangLatency(0.2, k=2, seed=3), 0.2, 0.5),
+    "erlang3": (lambda: ErlangLatency(0.2, k=3, seed=4), 0.2, 1.0 / 3.0),
+    "hyperexp": (lambda: HyperExponentialLatency(0.2, scv=3.0, seed=5), 0.2, 3.0),
+    "lognormal": (lambda: LogNormalLatency(0.2, scv=1.5, seed=6), 0.2, 1.5),
+    "pareto": (lambda: ParetoLatency(0.2, alpha=3.5, seed=7), 0.2, None),
+}
+# uniform(0.1, 0.3): var = (0.3-0.1)^2/12 = 1/300; scv = var/0.04 = 1/12.
+
+
+@pytest.mark.parametrize("family", sorted(CASES), ids=sorted(CASES))
+class TestMoments:
+    def test_mean_matches_configuration(self, family):
+        factory, mean, _ = CASES[family]
+        sampled_mean, _ = moments(draw(factory()))
+        tolerance = 0.10 if family == "pareto" else 0.03  # heavy tail
+        assert sampled_mean == pytest.approx(mean, rel=tolerance)
+
+    def test_scv_matches_family(self, family):
+        factory, _, scv = CASES[family]
+        if scv is None:
+            pytest.skip("pareto SCV checked separately (slow convergence)")
+        _, sampled_scv = moments(draw(factory()))
+        assert sampled_scv == pytest.approx(scv, abs=max(0.1 * scv, 0.02))
+
+    def test_samples_are_positive(self, family):
+        factory, _, _ = CASES[family]
+        assert all(s >= 0.0 for s in draw(factory(), n=2000))
+
+    def test_seeded_streams_reproduce(self, family):
+        factory, _, _ = CASES[family]
+        assert draw(factory(), n=50) == draw(factory(), n=50)
+
+
+class TestShapeSignatures:
+    def test_erlang_less_variable_than_exponential(self):
+        _, scv_erl = moments(draw(ErlangLatency(0.2, k=3, seed=1)))
+        _, scv_exp = moments(draw(ExponentialLatency(0.2, seed=1)))
+        assert scv_erl < scv_exp * 0.6
+
+    def test_hyperexp_more_variable_than_exponential(self):
+        _, scv_hyp = moments(draw(HyperExponentialLatency(0.2, scv=4.0, seed=2)))
+        assert scv_hyp > 2.0
+
+    def test_pareto_tail_heavier_than_exponential(self):
+        pareto = sorted(draw(ParetoLatency(0.2, alpha=2.2, seed=3)))
+        expo = sorted(draw(ExponentialLatency(0.2, seed=3)))
+        # Same mean, but the 99.9th percentile is far larger.
+        index = int(0.999 * N)
+        assert pareto[index] > expo[index] * 1.5
+
+    def test_pareto_minimum_is_xm(self):
+        alpha = 2.5
+        dist = ParetoLatency(0.2, alpha=alpha, seed=4)
+        x_m = 0.2 * (alpha - 1.0) / alpha
+        samples = draw(dist, n=5000)
+        assert min(samples) >= x_m * 0.999
+
+    def test_uniform_bounds_are_hard(self):
+        samples = draw(UniformLatency(0.1, 0.3, seed=5), n=5000)
+        assert 0.1 <= min(samples) and max(samples) <= 0.3
+
+    def test_lognormal_median_below_mean(self):
+        samples = sorted(draw(LogNormalLatency(0.2, scv=2.0, seed=6)))
+        median = samples[N // 2]
+        assert median < 0.2  # right-skew signature
+
+
+class TestWrappers:
+    def test_shifted_adds_a_floor(self):
+        base = ExponentialLatency(0.1, seed=7)
+        shifted = ShiftedLatency(base, 0.05)
+        samples = draw(shifted, n=5000)
+        assert min(samples) >= 0.05
+        mean, _ = moments(samples)
+        assert mean == pytest.approx(0.15, rel=0.05)
+
+    def test_percentile_fitted_single_point_exact(self):
+        """One point pins the exponential exactly: the sampled quantile
+        at that percentile matches the given value."""
+        dist = PercentileFittedLatency({0.5: 0.010}, seed=8)
+        expected_mean = 0.010 / math.log(2.0)
+        assert dist.fitted_mean_seconds == pytest.approx(expected_mean)
+        samples = sorted(draw(dist))
+        assert samples[int(0.5 * N)] == pytest.approx(0.010, rel=0.05)
+
+    def test_percentile_fitted_least_squares_compromises(self):
+        """Multiple inconsistent points: the fit is the documented least
+        squares over v = m * (-ln(1-p)), between the per-point means."""
+        points = {0.5: 0.010, 0.99: 0.200}
+        dist = PercentileFittedLatency(points, seed=9)
+        per_point = [v / -math.log1p(-p) for p, v in points.items()]
+        assert min(per_point) <= dist.fitted_mean_seconds <= max(per_point)
+        mean, scv = moments(draw(dist))
+        assert mean == pytest.approx(dist.fitted_mean_seconds, rel=0.03)
+        assert scv == pytest.approx(1.0, abs=0.1)  # it samples an exponential

@@ -1,0 +1,133 @@
+"""Crash/pause fault semantics driven end-to-end against real servers,
+and the WAL sync-policy decision matrix.
+
+Pins what a crashed/paused flag actually DOES to traffic (requests die
+with hooks unwound, recovery restores service, pause == bounded crash)
+and the exact fsync cadence each WAL policy promises.
+
+Parity target: ``happysimulator/tests/unit/test_node_faults.py`` and
+``test_wal.py`` policy cases.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    FaultSchedule,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.components.storage import SyncEveryWrite, SyncOnBatch, SyncPeriodic
+from happysim_tpu.faults import CrashNode, PauseNode
+
+
+def schedule_of(faults):
+    schedule = FaultSchedule()
+    for fault in faults:
+        schedule.add(fault)
+    return schedule
+
+
+def world(*faults, rate=20.0, stop=4.0, horizon=6.0):
+    sink = Sink("sink")
+    server = Server(
+        "server", service_time=ConstantLatency(0.001), downstream=sink
+    )
+    source = Source.poisson(rate=rate, target=server, stop_after=stop, seed=5)
+    sim = Simulation(
+        sources=[source],
+        entities=[server, sink],
+        end_time=Instant.from_seconds(horizon),
+        fault_schedule=schedule_of(faults),
+    )
+    sim.run()
+    return server, sink
+
+
+class TestCrashNode:
+    def test_permanent_crash_stops_service(self):
+        server, sink = world(CrashNode("server", at=2.0))
+        # ~2s of a 4s arrival window served, the rest dead.
+        assert 0 < sink.events_received < 20.0 * 4.0 * 0.75
+        baseline_server, baseline_sink = world()
+        assert sink.events_received < baseline_sink.events_received
+
+    def test_restart_resumes_service(self):
+        server, sink = world(CrashNode("server", at=1.0, restart_at=2.0))
+        _, baseline = world()
+        # Roughly the 1s outage's worth of traffic is lost, no more.
+        lost = baseline.events_received - sink.events_received
+        assert 20.0 * 0.5 < lost < 20.0 * 2.0
+
+    def test_crashed_requests_unwind_not_hang(self):
+        """Requests arriving during the crash complete as dropped — their
+        completion hooks fire (metadata marked) instead of leaking."""
+        outcomes = []
+        sink = Sink("sink")
+        server = Server("server", service_time=ConstantLatency(0.001), downstream=sink)
+        sim = Simulation(
+            sources=[],
+            entities=[server, sink],
+            end_time=Instant.from_seconds(5.0),
+            fault_schedule=schedule_of([CrashNode("server", at=1.0)]),
+        )
+        from happysim_tpu.core.event import Event
+
+        for at in (0.5, 2.0):
+            request = Event(Instant.from_seconds(at), "req", target=server)
+            request.add_completion_hook(
+                lambda t, r=request: outcomes.append(r.dropped_by) or None
+            )
+            sim.schedule(request)
+        sim.run()
+        assert len(outcomes) == 2
+        assert outcomes[0] is None  # before the crash: clean completion
+        assert outcomes[1] is not None  # during: dropped with a reason
+
+    def test_pause_equals_bounded_crash(self):
+        _, paused = world(PauseNode("server", start=1.0, end=2.0))
+        _, crashed = world(CrashNode("server", at=1.0, restart_at=2.0))
+        assert paused.events_received == crashed.events_received
+
+
+class TestWALSyncPolicies:
+    def test_every_write_always_syncs(self):
+        policy = SyncEveryWrite()
+        assert policy.should_sync(1, 0.0)
+        assert policy.should_sync(0, 0.0)
+
+    def test_batch_boundary_exact(self):
+        policy = SyncOnBatch(batch_size=8)
+        assert not policy.should_sync(7, 100.0)  # time is irrelevant
+        assert policy.should_sync(8, 0.0)
+        assert policy.should_sync(9, 0.0)
+
+    def test_periodic_boundary_exact(self):
+        policy = SyncPeriodic(interval_s=5.0)
+        assert not policy.should_sync(10_000, 4.999)  # count is irrelevant
+        assert policy.should_sync(0, 5.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SyncOnBatch(batch_size=0)
+        with pytest.raises(ValueError):
+            SyncPeriodic(interval_s=0.0)
+
+    @pytest.mark.parametrize(
+        "policy,writes,elapsed,expected",
+        [
+            (SyncEveryWrite(), 1, 0.0, True),
+            (SyncOnBatch(4), 3, 9.0, False),
+            (SyncOnBatch(4), 4, 0.0, True),
+            (SyncPeriodic(2.0), 99, 1.9, False),
+            (SyncPeriodic(2.0), 0, 2.1, True),
+        ],
+        ids=["every", "batch-under", "batch-at", "periodic-under", "periodic-over"],
+    )
+    def test_matrix(self, policy, writes, elapsed, expected):
+        assert policy.should_sync(writes, elapsed) is expected

@@ -1,0 +1,148 @@
+"""Infrastructure-model math at the edges: disk latency curves, GC
+pause scaling, congestion-control algebra.
+
+Complements ``test_infrastructure.py`` (which drives the entities in
+simulations) with the pure latency/windowing formulas where the
+hardware models' shape lives.
+
+Parity target: per-profile cases of
+``happysimulator/tests/unit/test_disk_io.py`` / ``test_gc.py`` /
+``test_tcp.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu.components.infrastructure import (
+    AIMD,
+    HDD,
+    NVMe,
+    SSD,
+    ConcurrentGC,
+    Cubic,
+    GenerationalGC,
+    StopTheWorld,
+)
+
+
+class TestDiskProfiles:
+    def test_hdd_dominated_by_seek_not_transfer(self):
+        hdd = HDD(seed=1)
+        small = hdd.read_latency_s(4096, queue_depth=1)
+        assert small > 0.004  # at least the rotational latency
+        # A 4KB transfer at 150MB/s is ~27us — mechanics dominate 100x.
+        assert small > 100 * (4096 / 150e6)
+
+    def test_ssd_faster_than_hdd_slower_than_nvme(self):
+        hdd, ssd, nvme = HDD(seed=1), SSD(), NVMe()
+        size = 4096
+        assert (
+            nvme.read_latency_s(size, 1)
+            < ssd.read_latency_s(size, 1)
+            < hdd.read_latency_s(size, 1)
+        )
+
+    def test_ssd_write_slower_than_read(self):
+        ssd = SSD()
+        assert ssd.write_latency_s(4096, 1) > ssd.read_latency_s(4096, 1)
+
+    def test_hdd_queue_penalty_is_linear(self):
+        hdd = HDD(seed=2, seek_time_s=0.0)  # remove seek jitter
+        base = hdd.read_latency_s(0, 1)
+        assert hdd.read_latency_s(0, 11) == pytest.approx(base * 4.0)  # 1+0.3*10
+
+    def test_nvme_flat_until_native_depth(self):
+        nvme = NVMe(native_queue_depth=32)
+        base = nvme.read_latency_s(4096, 1)
+        assert nvme.read_latency_s(4096, 32) == pytest.approx(base)
+        assert nvme.read_latency_s(4096, 64) > base
+
+    def test_ssd_log_scaling_is_sublinear(self):
+        ssd = SSD()
+        base = ssd.read_latency_s(4096, 1)
+        at_8 = ssd.read_latency_s(4096, 8)
+        at_64 = ssd.read_latency_s(4096, 64)
+        # Doubling depth 8->64 (8x) must cost less than 8x the depth-8 slope.
+        assert (at_64 - base) < 8 * (at_8 - base)
+
+    def test_transfer_term_scales_with_size(self):
+        nvme = NVMe()
+        small = nvme.read_latency_s(4096, 1)
+        large = nvme.read_latency_s(64 * 1024 * 1024, 1)
+        assert large > small * 100  # 64MB at 3.5GB/s ~ 18ms >> 10us
+
+    def test_hdd_seek_jitter_is_seeded(self):
+        a = [HDD(seed=9).read_latency_s(0, 1) for _ in range(3)]
+        b = [HDD(seed=9).read_latency_s(0, 1) for _ in range(3)]
+        assert a[0] == b[0]
+
+
+class TestGCStrategies:
+    def test_stop_the_world_pause_scales_with_pressure(self):
+        gc = StopTheWorld()
+        assert gc.pause_duration_s(0.9) > gc.pause_duration_s(0.1)
+
+    def test_concurrent_pauses_are_shorter(self):
+        stw, concurrent = StopTheWorld(), ConcurrentGC()
+        for pressure in (0.2, 0.5, 0.9):
+            assert concurrent.pause_duration_s(pressure) < stw.pause_duration_s(
+                pressure
+            )
+
+    def test_generational_pressure_threshold_picks_the_class(self):
+        gen = GenerationalGC(seed=4)
+        minors = [gen.pause_duration_s(0.5) for _ in range(20)]
+        majors = [gen.pause_duration_s(0.9) for _ in range(20)]
+        # Below the threshold every pause is a cheap minor; at or above
+        # it every pause is a major — an order of magnitude apart.
+        assert max(minors) < min(majors)
+        assert min(majors) > max(minors) * 3
+
+    def test_intervals_positive(self):
+        for strategy in (StopTheWorld(), ConcurrentGC(), GenerationalGC()):
+            assert strategy.collection_interval_s() > 0
+
+
+class TestCongestionControl:
+    def test_aimd_slow_start_doubles_below_ssthresh(self):
+        aimd = AIMD()
+        assert aimd.on_ack(cwnd=4.0, ssthresh=16.0) == pytest.approx(5.0)
+
+    def test_aimd_congestion_avoidance_above_ssthresh(self):
+        aimd = AIMD(additive_increase=1.0)
+        grown = aimd.on_ack(cwnd=16.0, ssthresh=8.0)
+        assert grown == pytest.approx(16.0 + 1.0 / 16.0)
+
+    def test_aimd_loss_halves(self):
+        aimd = AIMD(multiplicative_decrease=0.5)
+        cwnd, ssthresh = aimd.on_loss(cwnd=20.0)
+        assert cwnd == pytest.approx(10.0)
+        assert ssthresh == pytest.approx(10.0)
+
+    def test_aimd_sawtooth_converges_to_band(self):
+        aimd = AIMD()
+        cwnd, ssthresh = 1.0, 16.0
+        peaks = []
+        for _ in range(400):
+            cwnd = aimd.on_ack(cwnd, ssthresh)
+            if cwnd > 32.0:  # "link capacity": loss
+                peaks.append(cwnd)
+                cwnd, ssthresh = aimd.on_loss(cwnd)
+        # Sawtooth: every peak just above capacity, every trough at half.
+        assert all(32.0 < peak < 34.0 for peak in peaks[1:])
+
+    def test_cubic_reacts_less_than_aimd(self):
+        cubic = Cubic()
+        cwnd_cubic, _ = cubic.on_loss(cwnd=20.0)
+        cwnd_aimd, _ = AIMD().on_loss(cwnd=20.0)
+        assert cwnd_cubic > cwnd_aimd  # beta 0.7 vs 0.5
+
+    def test_cubic_growth_bounded_and_monotone(self):
+        cubic = Cubic()
+        cwnd = 10.0
+        previous = cwnd
+        for _ in range(50):
+            cwnd = cubic.on_ack(cwnd, ssthresh=5.0)
+            assert cwnd >= previous
+            previous = cwnd

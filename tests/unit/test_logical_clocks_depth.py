@@ -1,0 +1,177 @@
+"""Logical-clock law checks: Lamport monotonicity, vector-clock partial
+order axioms, and the HLC receive algorithm's four branches.
+
+The control/clock tests cover the happy paths; these pin the invariants
+message-ordering protocols build on, with randomized message exchanges
+as the oracle.
+
+Parity target: ``happysimulator/tests/unit/test_logical_clocks.py``.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from happysim_tpu.core.logical_clocks import (
+    HLCTimestamp,
+    HybridLogicalClock,
+    LamportClock,
+    VectorClock,
+)
+from happysim_tpu.core.temporal import Instant
+
+
+def ts(seconds: float) -> Instant:
+    return Instant.from_seconds(seconds)
+
+
+class TestLamport:
+    def test_tick_is_strictly_monotone(self):
+        clock = LamportClock()
+        values = [clock.tick() for _ in range(10)]
+        assert values == sorted(set(values))
+
+    def test_update_jumps_past_received(self):
+        clock = LamportClock(start=3)
+        assert clock.update(10) == 11
+        assert clock.time == 11
+
+    def test_update_with_stale_value_still_advances(self):
+        clock = LamportClock(start=8)
+        after = clock.update(2)
+        assert after > 8
+
+    def test_messages_order_causally(self):
+        """Randomized exchange: a message's send time is always strictly
+        below the receiver's clock after delivery."""
+        rng = random.Random(5)
+        clocks = [LamportClock() for _ in range(4)]
+        for _ in range(200):
+            sender, receiver = rng.sample(range(4), 2)
+            sent_at = clocks[sender].tick()
+            received_at = clocks[receiver].update(sent_at)
+            assert received_at > sent_at
+
+
+class TestVectorClockLaws:
+    def test_happened_before_is_irreflexive(self):
+        clock = VectorClock("a").increment()
+        assert not clock.happened_before(clock)
+
+    def test_happened_before_is_antisymmetric(self):
+        a = VectorClock("a").increment()
+        b = VectorClock("b")
+        b.merge(a.copy())  # receive from a (mutates b only)
+        assert a.happened_before(b)
+        assert not b.happened_before(a)
+
+    def test_happened_before_is_transitive(self):
+        a = VectorClock("a").increment()
+        b = VectorClock("b")
+        b.merge(a.copy())
+        c = VectorClock("c")
+        c.merge(b.copy())
+        assert a.happened_before(b) and b.happened_before(c)
+        assert a.happened_before(c)
+
+    def test_concurrency_is_symmetric(self):
+        a = VectorClock("a").increment()
+        b = VectorClock("b").increment()
+        assert a.is_concurrent(b) and b.is_concurrent(a)
+
+    def test_merge_dominates_both_inputs(self):
+        a = VectorClock("a").increment().increment()
+        b = VectorClock("b").increment()
+        a_before, b_before = a.copy(), b.copy()
+        merged = a.merge(b.copy())  # receive at a: max + own increment
+        assert merged.clocks["a"] == 3 and merged.clocks["b"] == 1
+        assert a_before.happened_before(merged)
+        assert b_before.happened_before(merged)
+
+    def test_randomized_exchange_never_misorders(self):
+        """Fuzz the core theorem: if a message chain connects x to y,
+        x.happened_before(y); disconnected updates stay concurrent."""
+        rng = random.Random(11)
+        nodes = {name: VectorClock(name) for name in "abcd"}
+        history: list[tuple[str, VectorClock]] = []
+        for _ in range(120):
+            name = rng.choice("abcd")
+            if history and rng.random() < 0.4:
+                _, snapshot = rng.choice(history)
+                nodes[name] = nodes[name].merge(snapshot)
+            nodes[name] = nodes[name].increment()
+            snapshot = nodes[name].copy()
+            for _, earlier in history[-10:]:
+                # No later snapshot may happen-before an earlier one.
+                assert not snapshot.happened_before(earlier)
+            history.append((name, snapshot))
+
+
+class TestHLC:
+    def test_physical_progress_resets_logical(self):
+        clock = HybridLogicalClock()
+        clock.now(ts(1.0))
+        clock.now(ts(1.0))  # same wall: logical grows
+        assert clock.timestamp.logical == 1
+        stamp = clock.now(ts(2.0))
+        assert stamp.logical == 0
+        assert stamp.wall == ts(2.0).nanoseconds
+
+    def test_stalled_wall_clock_still_orders_events(self):
+        clock = HybridLogicalClock()
+        stamps = [clock.now(ts(5.0)) for _ in range(5)]
+        assert [s.logical for s in stamps] == [0, 1, 2, 3, 4]
+        assert all(s.wall == ts(5.0).nanoseconds for s in stamps)
+
+    def test_receive_from_the_future_adopts_remote(self):
+        clock = HybridLogicalClock()
+        clock.now(ts(1.0))
+        remote = HLCTimestamp(wall=ts(9.0).nanoseconds, logical=7)
+        stamp = clock.receive(remote, ts(2.0))
+        assert stamp.wall == remote.wall
+        assert stamp.logical == 8
+
+    def test_receive_stale_remote_keeps_local_lead(self):
+        clock = HybridLogicalClock()
+        clock.now(ts(10.0))
+        stamp = clock.receive(HLCTimestamp(ts(1.0).nanoseconds, 99), ts(2.0))
+        assert stamp.wall == ts(10.0).nanoseconds
+        assert stamp.logical == 1  # local wall unchanged: logical bumps
+
+    def test_receive_with_fresh_physical_resets(self):
+        clock = HybridLogicalClock()
+        clock.now(ts(1.0))
+        stamp = clock.receive(HLCTimestamp(ts(2.0).nanoseconds, 5), ts(8.0))
+        assert stamp.wall == ts(8.0).nanoseconds
+        assert stamp.logical == 0
+
+    def test_receive_equal_walls_takes_max_logical(self):
+        clock = HybridLogicalClock()
+        clock.now(ts(3.0))  # local (3.0, 0)
+        remote = HLCTimestamp(ts(3.0).nanoseconds, 9)
+        stamp = clock.receive(remote, ts(3.0))
+        assert stamp.logical == 10
+
+    def test_happened_before_preserved_through_exchange(self):
+        """The HLC theorem: message timestamps are strictly increasing
+        along any causal chain, even with skewed physical clocks."""
+        rng = random.Random(3)
+        clocks = [HybridLogicalClock() for _ in range(3)]
+        skews = [0.0, -0.5, 0.3]
+        last: dict[int, HLCTimestamp] = {}
+        physical = 1.0
+        for _ in range(150):
+            physical += rng.random() * 0.01
+            sender, receiver = rng.sample(range(3), 2)
+            sent = clocks[sender].now(ts(physical + skews[sender]))
+            received = clocks[receiver].receive(sent, ts(physical + skews[receiver]))
+            assert (received.wall, received.logical) > (sent.wall, sent.logical)
+            if sender in last:
+                previous = last[sender]
+                assert (sent.wall, sent.logical) > (
+                    previous.wall,
+                    previous.logical,
+                )
+            last[sender] = sent

@@ -1,0 +1,209 @@
+"""Network-layer edges: every condition preset, partition semantics,
+link impairment math, and clone determinism.
+
+Parity target: the per-preset and partition cases of
+``happysimulator/tests/unit/test_network.py`` /
+``test_network_conditions.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu import Instant, Simulation, Sink
+from happysim_tpu.components.network import (
+    Network,
+    NetworkLink,
+    conditions,
+)
+from happysim_tpu.distributions.latency_distribution import ConstantLatency
+
+PRESETS = {
+    "local": (conditions.local_network, 0.0001, 0.0),
+    "datacenter": (conditions.datacenter_network, 0.0005, 0.0),
+    "cross_region": (conditions.cross_region_network, None, None),
+    "internet": (conditions.internet_network, None, None),
+    "satellite": (conditions.satellite_network, None, None),
+    "mobile_3g": (conditions.mobile_3g_network, None, None),
+    "mobile_4g": (conditions.mobile_4g_network, None, None),
+}
+
+
+@pytest.mark.parametrize("preset", sorted(PRESETS), ids=sorted(PRESETS))
+class TestConditionPresets:
+    def test_constructs_a_named_seeded_link(self, preset):
+        factory, _, _ = PRESETS[preset]
+        link = factory(seed=7)
+        assert isinstance(link, NetworkLink)
+        assert link.name
+        assert 0.0 <= link.packet_loss_rate < 0.5
+
+    def test_delivers_through_a_simulation(self, preset):
+        factory, _, _ = PRESETS[preset]
+        sink = Sink("sink")
+        link = factory(seed=3)
+        link.egress = sink
+        sim = Simulation(entities=[link, sink], end_time=Instant.from_seconds(120.0))
+        from happysim_tpu.core.event import Event
+
+        for i in range(50):
+            sim.schedule(Event(Instant.from_seconds(i * 0.5), "pkt", target=link))
+        sim.run()
+        delivered = sink.events_received
+        assert delivered == 50 - link.packets_dropped
+        if link.packet_loss_rate == 0.0:
+            assert delivered == 50
+        # Latency floor: nothing arrives faster than the base latency.
+        if delivered:
+            base = link.latency.get_latency(Instant.Epoch).to_seconds()
+            assert min(sink.latencies_s) >= base * 0.5
+
+
+class TestPresetOrdering:
+    def test_latency_ladder_is_sane(self):
+        """The presets' base latencies must preserve the physical
+        ordering: local < datacenter < cross_region < satellite."""
+
+        def base(factory):
+            return factory(seed=1).latency.get_latency(Instant.Epoch).to_seconds()
+
+        assert (
+            base(conditions.local_network)
+            < base(conditions.datacenter_network)
+            < base(conditions.cross_region_network)
+            < base(conditions.satellite_network)
+        )
+
+    def test_lossy_and_slow_wrappers(self):
+        lossy = conditions.lossy_network(loss_rate=0.3, seed=1)
+        assert lossy.packet_loss_rate == pytest.approx(0.3)
+        slow = conditions.slow_network(latency_seconds=0.5, seed=1)
+        assert slow.latency.get_latency(Instant.Epoch).to_seconds() >= 0.25
+
+
+class TestLinkMath:
+    def test_bandwidth_adds_serialization_delay(self):
+        sink = Sink("sink")
+        link = NetworkLink(
+            "thin", latency=ConstantLatency(0.01), bandwidth_bps=8_000, egress=sink
+        )
+        sim = Simulation(entities=[link, sink], end_time=Instant.from_seconds(10.0))
+        from happysim_tpu.core.event import Event
+
+        event = Event(
+            Instant.Epoch, "pkt", target=link,
+            context={"metadata": {"payload_size": 1000}},  # 8000 bits / 8000 bps = 1s
+        )
+        sim.schedule(event)
+        sim.run()
+        assert sink.latencies_s[0] == pytest.approx(1.01, abs=1e-6)
+
+    def test_zero_size_payload_pays_latency_only(self):
+        sink = Sink("sink")
+        link = NetworkLink(
+            "fat", latency=ConstantLatency(0.02), bandwidth_bps=1e9, egress=sink
+        )
+        sim = Simulation(entities=[link, sink], end_time=Instant.from_seconds(1.0))
+        from happysim_tpu.core.event import Event
+
+        sim.schedule(Event(Instant.Epoch, "pkt", target=link))
+        sim.run()
+        assert sink.latencies_s[0] == pytest.approx(0.02, abs=1e-9)
+
+    def test_loss_rate_statistics(self):
+        sink = Sink("sink")
+        link = NetworkLink(
+            "lossy", latency=ConstantLatency(0.001), packet_loss_rate=0.25,
+            egress=sink, seed=11,
+        )
+        sim = Simulation(entities=[link, sink], end_time=Instant.from_seconds(100.0))
+        from happysim_tpu.core.event import Event
+
+        for i in range(1000):
+            sim.schedule(Event(Instant.from_seconds(i * 0.01), "pkt", target=link))
+        sim.run()
+        assert link.packets_dropped == pytest.approx(250, abs=50)
+        assert sink.events_received == 1000 - link.packets_dropped
+
+    def test_clone_derives_deterministic_seed(self):
+        parent = NetworkLink(
+            "parent", latency=ConstantLatency(0.001), packet_loss_rate=0.5, seed=9
+        )
+        a1 = parent.clone("reverse")
+        a2 = parent.clone("reverse")
+        # Same clone name, same derived stream.
+        draws1 = [a1._rng.random() for _ in range(5)]
+        draws2 = [a2._rng.random() for _ in range(5)]
+        assert draws1 == draws2
+        # Different name => different stream.
+        b = parent.clone("other")
+        assert [b._rng.random() for _ in range(5)] != draws1
+
+    def test_clone_zeroes_stats(self):
+        parent = NetworkLink("parent", latency=ConstantLatency(0.001))
+        parent.packets_sent = 42
+        clone = parent.clone("fresh")
+        assert clone.packets_sent == 0
+
+
+def _mesh():
+    nodes = [Sink(name) for name in ("a", "b", "c")]
+    network = Network("net", default_link=conditions.local_network(seed=1))
+    sim = Simulation(
+        entities=[network, *nodes], end_time=Instant.from_seconds(10.0)
+    )
+    return network, dict(zip("abc", nodes)), sim
+
+
+class TestPartitions:
+    def test_partition_blocks_both_directions(self):
+        network, nodes, sim = _mesh()
+        network.partition([nodes["a"]], [nodes["b"]])
+        assert network.is_partitioned("a", "b")
+        assert network.is_partitioned("b", "a")
+        assert not network.is_partitioned("a", "c")
+
+    def test_asymmetric_partition_blocks_one_direction(self):
+        network, nodes, sim = _mesh()
+        network.partition([nodes["a"]], [nodes["b"]], asymmetric=True)
+        assert network.is_partitioned("a", "b")
+        assert not network.is_partitioned("b", "a")
+
+    def test_heal_restores_connectivity(self):
+        network, nodes, sim = _mesh()
+        partition = network.partition([nodes["a"]], [nodes["b"], nodes["c"]])
+        assert partition.is_active
+        partition.heal()
+        assert not partition.is_active
+        assert not network.is_partitioned("a", "b")
+
+    def test_heal_partition_clears_everything(self):
+        network, nodes, sim = _mesh()
+        network.partition([nodes["a"]], [nodes["b"]])
+        network.partition([nodes["b"]], [nodes["c"]], asymmetric=True)
+        network.heal_partition()
+        for src in "abc":
+            for dst in "abc":
+                assert not network.is_partitioned(src, dst)
+
+    def test_partitioned_send_is_dropped_not_delivered(self):
+        network, nodes, sim = _mesh()
+        network.partition([nodes["a"]], [nodes["b"]])
+        sim.schedule(network.send(nodes["a"], nodes["b"], "msg"))
+        sim.schedule(network.send(nodes["a"], nodes["c"], "msg"))
+        sim.run()
+        assert nodes["b"].events_received == 0
+        assert nodes["c"].events_received == 1
+
+    def test_traffic_matrix_tracks_per_pair(self):
+        network, nodes, sim = _mesh()
+        sim.schedule(network.send(nodes["a"], nodes["b"], "msg"))
+        sim.schedule(network.send(nodes["a"], nodes["b"], "msg"))
+        sim.schedule(network.send(nodes["b"], nodes["c"], "msg"))
+        sim.run()
+        matrix = {
+            (entry.source, entry.destination): entry.packets_sent
+            for entry in network.traffic_matrix()
+        }
+        assert matrix[("a", "b")] == 2
+        assert matrix[("b", "c")] == 1

@@ -1,0 +1,200 @@
+"""Boundary conformance for every rate-limiter policy.
+
+The composite tests drive limiters inside simulations; these pin the
+pure admission math at the edges where limiter bugs live: exact counts
+at window boundaries, fractional refill, capacity clamping,
+time_until_available honesty, and burst-vs-steady equivalence.
+
+Parity target: the per-policy cases of
+``happysimulator/tests/unit/test_rate_limiter.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu.components.rate_limiter import (
+    AdaptivePolicy,
+    FixedWindowPolicy,
+    LeakyBucketPolicy,
+    SlidingWindowPolicy,
+    TokenBucketPolicy,
+)
+from happysim_tpu.core.temporal import Instant
+
+
+def t(seconds: float) -> Instant:
+    return Instant.from_seconds(seconds)
+
+
+def admitted(policy, times) -> list[bool]:
+    return [policy.try_acquire(t(moment)) for moment in times]
+
+
+class TestTokenBucket:
+    def test_burst_exactly_capacity(self):
+        policy = TokenBucketPolicy(capacity=5.0, refill_rate=1.0)
+        results = admitted(policy, [0.0] * 6)
+        assert results == [True] * 5 + [False]
+
+    def test_fractional_refill_accumulates(self):
+        policy = TokenBucketPolicy(capacity=1.0, refill_rate=0.5)
+        assert policy.try_acquire(t(0.0))
+        assert not policy.try_acquire(t(1.0))  # only 0.5 tokens back
+        assert policy.try_acquire(t(2.0))  # 1.0 token at 2s
+
+    def test_refill_clamps_at_capacity(self):
+        policy = TokenBucketPolicy(capacity=3.0, refill_rate=100.0)
+        admitted(policy, [0.0, 0.0, 0.0])
+        # A long idle period cannot bank more than capacity.
+        results = admitted(policy, [1000.0] * 4)
+        assert results == [True, True, True, False]
+
+    def test_time_until_available_is_exact(self):
+        policy = TokenBucketPolicy(capacity=1.0, refill_rate=2.0)
+        policy.try_acquire(t(0.0))
+        wait = policy.time_until_available(t(0.0)).to_seconds()
+        assert wait == pytest.approx(0.5)
+        # And the promise holds: admission succeeds exactly then.
+        assert policy.try_acquire(t(wait))
+
+    def test_zero_wait_when_token_present(self):
+        policy = TokenBucketPolicy(capacity=1.0, refill_rate=1.0)
+        assert policy.time_until_available(t(0.0)).to_seconds() == 0.0
+
+    def test_steady_rate_matches_refill(self):
+        policy = TokenBucketPolicy(capacity=1.0, refill_rate=4.0)
+        times = [i * 0.05 for i in range(200)]  # 20/s offered for 10s
+        count = sum(admitted(policy, times))
+        assert count == pytest.approx(41, abs=2)  # 4/s + initial token
+
+
+class TestLeakyBucket:
+    def test_paces_at_leak_rate(self):
+        policy = LeakyBucketPolicy(leak_rate=2.0)
+        times = [i * 0.1 for i in range(100)]  # 10/s offered for 10s
+        count = sum(admitted(policy, times))
+        assert count == pytest.approx(20, abs=2)
+
+    def test_no_burst_banking(self):
+        """Unlike a token bucket, idle time banks nothing."""
+        policy = LeakyBucketPolicy(leak_rate=1.0)
+        policy.try_acquire(t(0.0))
+        results = admitted(policy, [100.0] * 3)
+        assert results == [True, False, False]
+
+    def test_time_until_available_honest(self):
+        policy = LeakyBucketPolicy(leak_rate=4.0)
+        assert policy.try_acquire(t(0.0))
+        wait = policy.time_until_available(t(0.0)).to_seconds()
+        assert 0.0 < wait <= 0.25 + 1e-9
+        assert policy.try_acquire(t(wait))
+
+
+class TestSlidingWindow:
+    def test_admits_exactly_max_in_any_window(self):
+        policy = SlidingWindowPolicy(window_size_seconds=1.0, max_requests=3)
+        assert admitted(policy, [0.0, 0.1, 0.2, 0.3]) == [True, True, True, False]
+
+    def test_slides_continuously_not_in_steps(self):
+        policy = SlidingWindowPolicy(window_size_seconds=1.0, max_requests=2)
+        assert policy.try_acquire(t(0.0))
+        assert policy.try_acquire(t(0.6))
+        assert not policy.try_acquire(t(0.9))
+        # At 1.001 the t=0 admission has left the window; one slot opens.
+        assert policy.try_acquire(t(1.001))
+        # But the 0.6 admission still occupies until 1.6.
+        assert not policy.try_acquire(t(1.5))
+        assert policy.try_acquire(t(1.601))
+
+    def test_no_boundary_double_burst(self):
+        """The fixed-window failure mode the sliding window exists to
+        prevent: 2x max around a boundary must NOT be admitted."""
+        policy = SlidingWindowPolicy(window_size_seconds=1.0, max_requests=4)
+        times = [0.7, 0.8, 0.9, 0.95, 1.05, 1.1, 1.2, 1.3]
+        assert sum(admitted(policy, times)) == 4
+
+
+class TestFixedWindow:
+    def test_resets_exactly_at_boundary(self):
+        policy = FixedWindowPolicy(requests_per_window=2, window_size=1.0)
+        assert admitted(policy, [0.0, 0.5, 0.9]) == [True, True, False]
+        assert policy.try_acquire(t(1.0))  # fresh window
+
+    def test_boundary_double_burst_is_the_known_tradeoff(self):
+        policy = FixedWindowPolicy(requests_per_window=4, window_size=1.0)
+        times = [0.7, 0.8, 0.9, 0.95, 1.05, 1.1, 1.2, 1.3]
+        # 2x max straddles the boundary — fixed windows allow it.
+        assert sum(admitted(policy, times)) == 8
+
+    def test_empty_windows_do_not_bank(self):
+        policy = FixedWindowPolicy(requests_per_window=1, window_size=1.0)
+        policy.try_acquire(t(0.0))
+        results = admitted(policy, [10.0, 10.1])
+        assert results == [True, False]
+
+
+class TestAdaptive:
+    def test_backpressure_halves_success_grows(self):
+        policy = AdaptivePolicy(initial_rate=8.0, min_rate=1.0, max_rate=16.0)
+        before = policy.current_rate
+        policy.record_backpressure(t(1.0))
+        halved = policy.current_rate
+        assert halved == pytest.approx(before / 2)
+        for i in range(50):
+            policy.record_success(t(2.0 + i))
+        assert policy.current_rate > halved
+
+    def test_rate_floor_and_ceiling(self):
+        policy = AdaptivePolicy(initial_rate=4.0, min_rate=2.0, max_rate=6.0)
+        for i in range(10):
+            policy.record_backpressure(t(float(i)))
+        assert policy.current_rate == pytest.approx(2.0)
+        for i in range(1000):
+            policy.record_success(t(20.0 + i * 0.01))
+        assert policy.current_rate <= 6.0 + 1e-9
+
+    def test_admission_follows_current_rate(self):
+        policy = AdaptivePolicy(initial_rate=2.0, min_rate=1.0, max_rate=4.0)
+        times = [i * 0.1 for i in range(100)]  # 10/s offered for 10s
+        count = sum(admitted(policy, times))
+        assert count <= 2.0 * 10 * 1.6  # bounded by ~current_rate x horizon
+
+
+@pytest.mark.parametrize(
+    "policy_factory",
+    [
+        lambda: TokenBucketPolicy(capacity=2.0, refill_rate=1.0),
+        lambda: LeakyBucketPolicy(leak_rate=1.0),
+        lambda: SlidingWindowPolicy(window_size_seconds=1.0, max_requests=2),
+        lambda: FixedWindowPolicy(requests_per_window=2, window_size=1.0),
+        lambda: AdaptivePolicy(initial_rate=2.0, min_rate=1.0, max_rate=4.0),
+    ],
+    ids=["token", "leaky", "sliding", "fixed", "adaptive"],
+)
+class TestPolicyConformance:
+    def test_long_run_rate_bounded_by_configured_limit(self, policy_factory):
+        """No policy may admit meaningfully above its configured rate
+        over a long horizon (2/s here), whatever the burst pattern."""
+        policy = policy_factory()
+        times = []
+        for second in range(30):
+            times.extend(second + i * 0.02 for i in range(20))  # bursts
+        count = sum(admitted(policy, times))
+        assert count <= 2.0 * 30 + 3, count
+
+    def test_time_until_available_nonnegative(self, policy_factory):
+        policy = policy_factory()
+        for moment in (0.0, 0.3, 1.7):
+            policy.try_acquire(t(moment))
+            assert policy.time_until_available(t(moment)).to_seconds() >= 0.0
+
+    def test_denial_then_promised_wait_admits(self, policy_factory):
+        policy = policy_factory()
+        now = 0.0
+        while policy.try_acquire(t(now)):
+            now += 1e-6
+        wait = policy.time_until_available(t(now)).to_seconds()
+        assert policy.try_acquire(t(now + wait + 1e-6)), (
+            "time_until_available under-promised"
+        )

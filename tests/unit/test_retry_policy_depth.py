@@ -1,0 +1,117 @@
+"""Retry-policy timing contracts.
+
+Pins the exact delay/should_retry math for all four policies — attempt
+numbering is 1-based and off-by-ones here silently double or halve
+retry storms.
+
+Parity target: ``happysimulator/tests/unit/test_retry.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu.components.client import (
+    DecorrelatedJitter,
+    ExponentialBackoff,
+    FixedRetry,
+    NoRetry,
+)
+
+
+class TestNoRetry:
+    def test_never_retries(self):
+        policy = NoRetry()
+        assert not policy.should_retry(1)
+        assert not policy.should_retry(99)
+        assert policy.delay(1) == 0.0
+
+
+class TestFixedRetry:
+    def test_total_attempts_not_retries(self):
+        """max_attempts counts ATTEMPTS: 3 means retry after 1 and 2 only."""
+        policy = FixedRetry(max_attempts=3, delay_s=0.5)
+        assert policy.should_retry(1)
+        assert policy.should_retry(2)
+        assert not policy.should_retry(3)
+
+    def test_constant_delay(self):
+        policy = FixedRetry(max_attempts=5, delay_s=0.25)
+        assert [policy.delay(a) for a in (1, 2, 3, 4)] == [0.25] * 4
+
+    def test_single_attempt_is_no_retry(self):
+        policy = FixedRetry(max_attempts=1)
+        assert not policy.should_retry(1)
+
+    def test_rejects_zero_attempts(self):
+        with pytest.raises(ValueError):
+            FixedRetry(max_attempts=0)
+
+
+class TestExponentialBackoff:
+    def test_doubling_sequence(self):
+        policy = ExponentialBackoff(
+            max_attempts=5, initial_delay=0.1, multiplier=2.0, max_delay=100.0
+        )
+        delays = [policy.delay(a) for a in (1, 2, 3, 4)]
+        assert delays == pytest.approx([0.1, 0.2, 0.4, 0.8])
+
+    def test_cap_binds(self):
+        policy = ExponentialBackoff(
+            max_attempts=10, initial_delay=1.0, multiplier=10.0, max_delay=5.0
+        )
+        assert policy.delay(1) == 1.0
+        assert policy.delay(2) == 5.0  # 10.0 capped
+        assert policy.delay(9) == 5.0
+
+    def test_jitter_bounded_by_base(self):
+        policy = ExponentialBackoff(
+            max_attempts=5, initial_delay=0.2, multiplier=2.0, jitter=True, seed=3
+        )
+        for attempt in (1, 2, 3):
+            base = 0.2 * 2.0 ** (attempt - 1)
+            for _ in range(20):
+                assert 0.0 <= policy.delay(attempt) <= base
+
+    def test_jitter_is_seeded(self):
+        a = ExponentialBackoff(max_attempts=3, jitter=True, seed=7)
+        b = ExponentialBackoff(max_attempts=3, jitter=True, seed=7)
+        assert [a.delay(1) for _ in range(5)] == [b.delay(1) for _ in range(5)]
+
+    def test_attempt_budget(self):
+        policy = ExponentialBackoff(max_attempts=4)
+        assert [policy.should_retry(a) for a in (1, 2, 3, 4)] == [
+            True, True, True, False,
+        ]
+
+
+class TestDecorrelatedJitter:
+    def test_delays_within_envelope(self):
+        policy = DecorrelatedJitter(
+            max_attempts=10, base_delay=0.1, max_delay=2.0, seed=5
+        )
+        previous = 0.1
+        for attempt in range(1, 9):
+            delay = policy.delay(attempt)
+            assert 0.1 <= delay <= min(2.0, previous * 3) + 1e-12
+            previous = delay
+
+    def test_cap_is_hard(self):
+        policy = DecorrelatedJitter(
+            max_attempts=50, base_delay=1.0, max_delay=3.0, seed=1
+        )
+        assert all(policy.delay(a) <= 3.0 for a in range(1, 40))
+
+    def test_seeded_reproducibility(self):
+        a = DecorrelatedJitter(max_attempts=5, seed=9)
+        b = DecorrelatedJitter(max_attempts=5, seed=9)
+        assert [a.delay(i) for i in (1, 2, 3)] == [b.delay(i) for i in (1, 2, 3)]
+
+    def test_spreads_a_retry_herd(self):
+        """Distinct seeds must decorrelate: 50 clients retrying after a
+        shared failure should not pile onto one instant."""
+        delays = {
+            round(DecorrelatedJitter(max_attempts=3, seed=s).delay(1), 6)
+            for s in range(50)
+        }
+        assert len(delays) > 40

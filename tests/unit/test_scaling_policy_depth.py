@@ -1,0 +1,118 @@
+"""Auto-scaling policy math at the boundaries.
+
+The deployment tests drive a whole AutoScaler loop; these pin the pure
+``evaluate`` contracts where sizing bugs live: clamping, rounding,
+empty fleets, threshold equality, and one-at-a-time queue scaling.
+
+Parity target: the policy cases of
+``happysimulator/tests/unit/test_auto_scaler.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu.components.deployment import (
+    QueueDepthScaling,
+    StepScaling,
+    TargetUtilization,
+)
+
+
+class FakeBackend:
+    def __init__(self, utilization=None, depth=None):
+        if utilization is not None:
+            self.utilization = utilization
+        if depth is not None:
+            self.depth = depth
+
+
+def fleet(*utilizations):
+    return [FakeBackend(utilization=u) for u in utilizations]
+
+
+class TestTargetUtilization:
+    def test_scales_out_proportionally(self):
+        policy = TargetUtilization(target=0.5)
+        # 4 instances at 100%: the load needs 8 at 50%.
+        assert policy.evaluate(fleet(1.0, 1.0, 1.0, 1.0), 4, 1, 100) == 8
+
+    def test_scales_in_proportionally(self):
+        policy = TargetUtilization(target=0.8)
+        # 8 instances at 20%: 0.2*8/0.8 = 2 carry the load at target.
+        assert policy.evaluate(fleet(*[0.2] * 8), 8, 1, 100) == 2
+
+    def test_at_target_holds(self):
+        policy = TargetUtilization(target=0.7)
+        assert policy.evaluate(fleet(0.7, 0.7), 2, 1, 10) == 2
+
+    def test_rounds_half_up(self):
+        policy = TargetUtilization(target=0.5)
+        # 3 * 0.75/0.5 = 4.5 exactly (binary-exact operands) -> 5.
+        assert policy.evaluate(fleet(0.75, 0.75, 0.75), 3, 1, 10) == 5
+
+    def test_clamps_to_bounds(self):
+        policy = TargetUtilization(target=0.1)
+        assert policy.evaluate(fleet(1.0), 1, 1, 5) == 5
+        policy = TargetUtilization(target=1.0)
+        assert policy.evaluate(fleet(0.01), 10, 3, 20) == 3
+
+    def test_empty_fleet_returns_min(self):
+        assert TargetUtilization(0.5).evaluate([], 0, 2, 10) == 2
+
+    def test_rejects_bad_target(self):
+        with pytest.raises(ValueError):
+            TargetUtilization(target=0.0)
+        with pytest.raises(ValueError):
+            TargetUtilization(target=1.5)
+
+
+class TestStepScaling:
+    POLICY = StepScaling(steps=[(0.9, 3), (0.7, 1), (0.2, 0), (0.0, -1)])
+
+    def test_highest_crossed_step_wins(self):
+        assert self.POLICY.evaluate(fleet(0.95), 5, 1, 20) == 8  # +3
+        assert self.POLICY.evaluate(fleet(0.75), 5, 1, 20) == 6  # +1
+
+    def test_threshold_equality_crosses(self):
+        assert self.POLICY.evaluate(fleet(0.9), 5, 1, 20) == 8
+
+    def test_idle_band_scales_in(self):
+        assert self.POLICY.evaluate(fleet(0.05), 5, 1, 20) == 4  # -1
+
+    def test_hold_band_holds(self):
+        assert self.POLICY.evaluate(fleet(0.4), 5, 1, 20) == 5  # the 0-step
+
+    def test_clamps_to_bounds(self):
+        assert self.POLICY.evaluate(fleet(0.99), 19, 1, 20) == 20
+        assert self.POLICY.evaluate(fleet(0.01), 1, 1, 20) == 1
+
+    def test_mean_over_fleet_not_max(self):
+        # One hot + three idle: mean 0.25 sits in the hold band.
+        assert self.POLICY.evaluate(fleet(1.0, 0.0, 0.0, 0.0), 4, 1, 20) == 4
+
+
+class TestQueueDepthScaling:
+    POLICY = QueueDepthScaling(scale_out_threshold=100, scale_in_threshold=10)
+
+    def backlog(self, *depths):
+        return [FakeBackend(depth=d) for d in depths]
+
+    def test_scale_out_one_at_a_time(self):
+        assert self.POLICY.evaluate(self.backlog(60, 50), 4, 1, 10) == 5
+
+    def test_scale_out_threshold_is_inclusive(self):
+        assert self.POLICY.evaluate(self.backlog(100), 4, 1, 10) == 5
+        assert self.POLICY.evaluate(self.backlog(99), 4, 1, 10) == 4
+
+    def test_scale_in_threshold_is_inclusive(self):
+        assert self.POLICY.evaluate(self.backlog(10), 4, 1, 10) == 3
+        assert self.POLICY.evaluate(self.backlog(11), 4, 1, 10) == 4
+
+    def test_respects_bounds(self):
+        assert self.POLICY.evaluate(self.backlog(1000), 10, 1, 10) == 10
+        assert self.POLICY.evaluate(self.backlog(0), 1, 1, 10) == 1
+
+    def test_backends_without_depth_are_ignored(self):
+        mixed = [FakeBackend(depth=200), FakeBackend(utilization=0.5)]
+        assert self.POLICY.evaluate(mixed, 2, 1, 10) == 3

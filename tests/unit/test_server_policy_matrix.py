@@ -1,0 +1,134 @@
+"""Server x queue-policy conformance matrix, in real simulations.
+
+Every ordering discipline must compose with the Server/Queue/driver
+stack without losing or duplicating work: conservation, capacity-drop
+accounting, hook unwinding on drops, and saturation draining — the
+same four invariants across all nine policies.
+
+Parity target: the policy-matrix cases of
+``happysimulator/tests/unit/test_server.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    Event,
+    Instant,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.components.queue_policies import (
+    AdaptiveLIFO,
+    CoDelQueue,
+    DeadlineQueue,
+    FairQueue,
+    REDQueue,
+    WeightedFairQueue,
+)
+from happysim_tpu.components.queue_policy import (
+    FIFOQueue,
+    LIFOQueue,
+    PriorityQueue,
+)
+
+POLICY_FACTORIES = {
+    "fifo": FIFOQueue,
+    "lifo": LIFOQueue,
+    "priority": PriorityQueue,
+    "deadline": lambda: DeadlineQueue(drop_expired=False),
+    "codel": lambda: CoDelQueue(target_delay=1e9, interval=1e9),
+    "red": lambda: REDQueue(min_threshold=10_000, max_threshold=20_000),
+    "adaptive_lifo": lambda: AdaptiveLIFO(congestion_threshold=1_000),
+    "fair": FairQueue,
+    "wfq": WeightedFairQueue,
+}
+
+IDS = sorted(POLICY_FACTORIES)
+
+
+def run_world(policy, *, rate=40.0, stop=3.0, service=0.01, capacity=None,
+              concurrency=1, horizon=20.0):
+    sink = Sink("sink")
+    server = Server(
+        "server",
+        concurrency=concurrency,
+        service_time=ConstantLatency(service),
+        queue_policy=policy,
+        queue_capacity=capacity,
+        downstream=sink,
+    )
+    source = Source.poisson(rate=rate, target=server, stop_after=stop, seed=13)
+    sim = Simulation(
+        sources=[source],
+        entities=[server, sink],
+        end_time=Instant.from_seconds(horizon),
+    )
+    summary = sim.run()
+    return server, sink, summary
+
+
+@pytest.mark.parametrize("name", IDS, ids=IDS)
+class TestPolicyMatrix:
+    def test_conservation_under_light_load(self, name):
+        server, sink, _ = run_world(POLICY_FACTORIES[name](), rate=10.0,
+                                    service=0.001)
+        assert sink.events_received == server.requests_completed
+        assert server.queue.dropped == 0
+        assert server.queue_depth == 0  # drained at the end
+
+    def test_saturation_drains_completely(self, name):
+        """Offered 3x service rate for 3s, then the horizon lets the
+        backlog drain: everything admitted must eventually complete."""
+        server, sink, _ = run_world(
+            POLICY_FACTORIES[name](), rate=120.0, service=0.025, horizon=40.0
+        )
+        admitted = server.queue.enqueued
+        assert server.requests_completed == admitted
+        assert sink.events_received == admitted
+        assert server.queue_depth == 0
+
+    def test_capacity_drops_are_accounted(self, name):
+        server, sink, _ = run_world(
+            POLICY_FACTORIES[name](), rate=200.0, service=0.05, capacity=5,
+            horizon=60.0,
+        )
+        assert server.queue.dropped > 0
+        # One fate per arrival: enqueued+dropped = arrivals; completed = enqueued.
+        assert server.requests_completed == server.queue.enqueued
+        assert sink.events_received == server.requests_completed
+
+    def test_dropped_requests_unwind_hooks(self, name):
+        """A capacity drop must fire the request's completion hooks with
+        the drop marker, so clients and wrappers never leak."""
+        policy = POLICY_FACTORIES[name]()
+        sink = Sink("sink")
+        server = Server(
+            "server",
+            service_time=ConstantLatency(1.0),
+            queue_policy=policy,
+            queue_capacity=1,
+            downstream=sink,
+        )
+        sim = Simulation(
+            entities=[server, sink], end_time=Instant.from_seconds(10.0)
+        )
+        fates = []
+        # All four arrive in the same instant; (time, insertion) ordering
+        # processes every enqueue before the first poll, so exactly one
+        # fits the capacity-1 queue and three drop.
+        for i in range(4):
+            request = Event(Instant.Epoch, "req", target=server)
+            request.add_completion_hook(
+                lambda t, r=request: fates.append(r.dropped_by) or None
+            )
+            sim.schedule(request)
+        sim.run()
+        assert len(fates) == 4, "every request's hooks fired exactly once"
+        drops = [fate for fate in fates if fate is not None]
+        assert len(drops) == 3
+        assert sink.events_received == 1

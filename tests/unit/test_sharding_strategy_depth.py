@@ -1,0 +1,112 @@
+"""Sharding-strategy math: determinism, balance, range boundaries, and
+consistent-hash stability under resharding.
+
+Parity target: the strategy cases of
+``happysimulator/tests/unit/test_sharded_store.py``.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import pytest
+
+from happysim_tpu.components.datastore import (
+    ConsistentHashSharding,
+    HashSharding,
+    RangeSharding,
+)
+
+KEYS = [f"user:{i:05d}" for i in range(2000)]
+
+
+class TestHashSharding:
+    def test_deterministic(self):
+        strategy = HashSharding()
+        assert [strategy.get_shard(k, 8) for k in KEYS[:50]] == [
+            strategy.get_shard(k, 8) for k in KEYS[:50]
+        ]
+
+    def test_all_shards_in_range(self):
+        strategy = HashSharding()
+        assert all(0 <= strategy.get_shard(k, 5) < 5 for k in KEYS)
+
+    def test_roughly_balanced(self):
+        strategy = HashSharding()
+        counts = Counter(strategy.get_shard(k, 8) for k in KEYS)
+        assert len(counts) == 8
+        assert max(counts.values()) < 2 * min(counts.values())
+
+    def test_full_reshard_on_count_change(self):
+        """The failure mode consistent hashing fixes: changing the shard
+        count moves MOST keys under plain modulo hashing."""
+        strategy = HashSharding()
+        moved = sum(
+            strategy.get_shard(k, 8) != strategy.get_shard(k, 9) for k in KEYS
+        )
+        assert moved > len(KEYS) * 0.6
+
+
+class TestRangeSharding:
+    def test_explicit_boundaries_partition_the_keyspace(self):
+        strategy = RangeSharding(boundaries=["g", "p"])
+        assert strategy.get_shard("apple", 3) == 0
+        assert strategy.get_shard("grape", 3) == 1
+        assert strategy.get_shard("zebra", 3) == 2
+
+    def test_boundary_key_goes_right(self):
+        strategy = RangeSharding(boundaries=["m"])
+        assert strategy.get_shard("m", 2) == 1
+        assert strategy.get_shard("lzzz", 2) == 0
+
+    def test_preserves_order_locality(self):
+        """Adjacent keys land in the same or adjacent shards — the whole
+        point of range sharding (scans touch few shards)."""
+        strategy = RangeSharding(boundaries=["b", "c", "d"])
+        ordered = sorted(KEYS[:100])
+        shards = [strategy.get_shard(k, 4) for k in ordered]
+        assert shards == sorted(shards)
+
+    def test_default_boundaries_cover_alphabet(self):
+        strategy = RangeSharding()
+        shards = {strategy.get_shard(k, 4) for k in ("apple", "mango", "zebra")}
+        assert all(0 <= s < 4 for s in shards)
+
+
+class TestConsistentHashSharding:
+    def test_deterministic_with_seed(self):
+        a = ConsistentHashSharding(virtual_nodes=50, seed=3)
+        b = ConsistentHashSharding(virtual_nodes=50, seed=3)
+        assert [a.get_shard(k, 8) for k in KEYS[:100]] == [
+            b.get_shard(k, 8) for k in KEYS[:100]
+        ]
+
+    def test_minimal_movement_on_growth(self):
+        """Adding one shard must move only ~1/(n+1) of keys — the
+        property plain modulo hashing lacks."""
+        strategy = ConsistentHashSharding(virtual_nodes=100, seed=5)
+        before = [strategy.get_shard(k, 8) for k in KEYS]
+        after = [strategy.get_shard(k, 9) for k in KEYS]
+        moved = sum(a != b for a, b in zip(before, after))
+        assert moved < len(KEYS) * 0.3  # ~1/9 expected, generous bound
+        # And every moved key went TO the new shard, not reshuffled.
+        assert all(b == 8 for a, b in zip(before, after) if a != b)
+
+    def test_balance_with_enough_vnodes(self):
+        strategy = ConsistentHashSharding(virtual_nodes=200, seed=7)
+        counts = Counter(strategy.get_shard(k, 6) for k in KEYS)
+        assert len(counts) == 6
+        assert max(counts.values()) < 3 * min(counts.values())
+
+    def test_few_vnodes_imbalance_is_real(self):
+        """With 1 vnode per shard the ring is lumpy — documents why the
+        default is 100."""
+        lumpy = ConsistentHashSharding(virtual_nodes=1, seed=2)
+        counts = Counter(lumpy.get_shard(k, 6) for k in KEYS)
+        smooth = ConsistentHashSharding(virtual_nodes=200, seed=2)
+        smooth_counts = Counter(smooth.get_shard(k, 6) for k in KEYS)
+
+        def spread(c):
+            return max(c.values()) / max(min(c.values()), 1)
+
+        assert spread(counts) > spread(smooth_counts)

@@ -1,0 +1,217 @@
+"""Sketch accuracy guarantees, checked against exact computations.
+
+Each sketch advertises an error bound (CMS overestimate-only within
+eps*N, bloom no-false-negatives, HLL ~1.04/sqrt(m), reservoir
+uniformity, t-digest tail accuracy). These tests measure the bound
+against brute-force ground truth on adversarial-ish workloads — a
+hashing regression shows up here as a blown bound, not a flaky test.
+
+Parity target: ``happysimulator/tests/unit/test_sketches.py``.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import Counter
+
+import pytest
+
+from happysim_tpu.sketching import (
+    BloomFilter,
+    CountMinSketch,
+    HyperLogLog,
+    ReservoirSampler,
+    TDigest,
+    TopK,
+)
+
+
+def zipf_stream(n_items, n_draws, seed, exponent=1.2):
+    rng = random.Random(seed)
+    weights = [1.0 / (k + 1) ** exponent for k in range(n_items)]
+    total = sum(weights)
+    cdf, acc = [], 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    out = []
+    for _ in range(n_draws):
+        u = rng.random()
+        lo, hi = 0, n_items - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if cdf[mid] < u:
+                lo = mid + 1
+            else:
+                hi = mid
+        out.append(f"item{lo}")
+    return out
+
+
+class TestCountMinSketch:
+    def test_never_underestimates(self):
+        stream = zipf_stream(500, 20_000, seed=1)
+        truth = Counter(stream)
+        sketch = CountMinSketch(width=512, depth=5, seed=2)
+        for item in stream:
+            sketch.add(item)
+        for item, count in truth.items():
+            assert sketch.estimate(item) >= count
+
+    def test_overestimate_within_eps_n(self):
+        stream = zipf_stream(500, 20_000, seed=3)
+        truth = Counter(stream)
+        width = 1024
+        sketch = CountMinSketch(width=width, depth=5, seed=4)
+        for item in stream:
+            sketch.add(item)
+        # CMS guarantee: error <= e/width * N with prob 1 - e^-depth.
+        bound = math.e / width * len(stream)
+        violations = sum(
+            sketch.estimate(item) - count > bound for item, count in truth.items()
+        )
+        assert violations <= len(truth) * 0.01
+
+    def test_unseen_item_estimate_is_small(self):
+        sketch = CountMinSketch(width=2048, depth=5, seed=5)
+        for item in zipf_stream(100, 5_000, seed=6):
+            sketch.add(item)
+        assert sketch.estimate("never-added") <= math.e / 2048 * 5_000 + 1
+
+    def test_top_k_finds_the_head(self):
+        stream = zipf_stream(300, 30_000, seed=7)
+        truth = Counter(stream)
+        sketch = CountMinSketch(width=2048, depth=5, seed=8, track_top=32)
+        for item in stream:
+            sketch.add(item)
+        top_true = {item for item, _ in truth.most_common(5)}
+        top_sketch = {est.item for est in sketch.top(5)}
+        assert len(top_true & top_sketch) >= 4
+
+    def test_weighted_adds(self):
+        sketch = CountMinSketch(width=512, depth=5, seed=9)
+        sketch.add("x", count=50)
+        sketch.add("x", count=25)
+        assert sketch.estimate("x") >= 75
+
+
+class TestBloomFilter:
+    def test_no_false_negatives_ever(self):
+        items = [f"key{i}" for i in range(5_000)]
+        bloom = BloomFilter(size_bits=64_000, num_hashes=5, seed=1)
+        for item in items:
+            bloom.add(item)
+        assert all(bloom.contains(item) for item in items)
+
+    def test_false_positive_rate_near_theory(self):
+        n, bits, hashes = 2_000, 32_768, 5
+        bloom = BloomFilter(size_bits=bits, num_hashes=hashes, seed=2)
+        for i in range(n):
+            bloom.add(f"present{i}")
+        theory = (1 - math.exp(-hashes * n / bits)) ** hashes
+        hits = sum(bloom.contains(f"absent{i}") for i in range(10_000))
+        assert hits / 10_000 < max(theory * 3, 0.02)
+
+    def test_saturated_filter_degrades_not_breaks(self):
+        bloom = BloomFilter(size_bits=256, num_hashes=3, seed=3)
+        for i in range(5_000):
+            bloom.add(f"k{i}")
+        # Saturated: everything looks present, but no negatives appear.
+        assert all(bloom.contains(f"k{i}") for i in range(0, 5_000, 97))
+
+
+class TestHyperLogLog:
+    @pytest.mark.parametrize("true_n", [100, 5_000, 100_000])
+    def test_relative_error_within_bound(self, true_n):
+        hll = HyperLogLog(precision=12, seed=1)
+        for i in range(true_n):
+            hll.add(f"user{i}")
+        sigma = 1.04 / math.sqrt(2**12)
+        assert hll.cardinality() == pytest.approx(true_n, rel=4 * sigma)
+
+    def test_duplicates_do_not_inflate(self):
+        hll = HyperLogLog(precision=12, seed=2)
+        for _ in range(50):
+            for i in range(1_000):
+                hll.add(f"user{i}")
+        assert hll.cardinality() == pytest.approx(1_000, rel=0.1)
+
+    def test_empty_is_zero(self):
+        assert HyperLogLog(precision=10).cardinality() == 0
+
+    def test_higher_precision_tightens(self):
+        errors = {}
+        for precision in (8, 14):
+            hll = HyperLogLog(precision=precision, seed=3)
+            for i in range(50_000):
+                hll.add(f"k{i}")
+            errors[precision] = abs(hll.cardinality() - 50_000) / 50_000
+        assert errors[14] < max(errors[8], 0.02)
+
+
+class TestReservoir:
+    def test_caps_at_capacity(self):
+        sampler = ReservoirSampler(capacity=50, seed=1)
+        for i in range(10_000):
+            sampler.add(i)
+        assert sampler.sample_size == 50
+
+    def test_below_capacity_keeps_everything(self):
+        sampler = ReservoirSampler(capacity=100, seed=2)
+        for i in range(30):
+            sampler.add(i)
+        assert sorted(sampler.sample()) == list(range(30))
+
+    def test_uniform_inclusion_probability(self):
+        """Every stream position must be retained ~capacity/n of the
+        time — early items must not be favored (the classic bug)."""
+        hits = Counter()
+        for trial in range(300):
+            sampler = ReservoirSampler(capacity=20, seed=trial)
+            for i in range(400):
+                sampler.add(i)
+            hits.update(sampler.sample())
+        # Expected hits per item: 300 * 20/400 = 15.
+        first_half = sum(hits[i] for i in range(200))
+        second_half = sum(hits[i] for i in range(200, 400))
+        assert first_half == pytest.approx(second_half, rel=0.15)
+
+
+class TestTDigestTails:
+    def test_extreme_quantiles_tighter_than_middle_rank_error(self):
+        rng = random.Random(5)
+        values = sorted(rng.expovariate(1.0) for _ in range(50_000))
+        digest = TDigest(compression=100.0, seed=6)
+        for v in values:
+            digest.add(v)
+        for q in (0.001, 0.5, 0.999):
+            exact = values[int(q * (len(values) - 1))]
+            estimate = digest.quantile(q)
+            # Rank error: where does the estimate fall in the sorted data?
+            import bisect
+
+            rank = bisect.bisect_left(values, estimate) / len(values)
+            tolerance = 0.005 if q in (0.001, 0.999) else 0.02
+            assert abs(rank - q) < tolerance, (q, rank, exact, estimate)
+
+    def test_min_max_are_exact(self):
+        digest = TDigest(compression=50.0, seed=7)
+        for v in (5.0, 1.0, 9.0, 3.0):
+            digest.add(v)
+        assert digest.quantile(0.0) == pytest.approx(1.0)
+        assert digest.quantile(1.0) == pytest.approx(9.0)
+
+
+class TestTopK:
+    def test_tracks_the_true_head_exactly(self):
+        stream = zipf_stream(1_000, 40_000, seed=9)
+        truth = Counter(stream)
+        topk = TopK(k=32, seed=10)
+        for item in stream:
+            topk.add(item)
+        top_true = [item for item, _ in truth.most_common(5)]
+        top_est = [est.item for est in topk.top(5)]
+        assert set(top_true) <= set(top_est) | set(top_true[-1:])
+        # The single heaviest item is always found.
+        assert top_est[0] == top_true[0]

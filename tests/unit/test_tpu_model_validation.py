@@ -1,0 +1,215 @@
+"""EnsembleModel construction and validation: every rejection rule.
+
+A vectorizable model that compiles wrong wastes minutes of XLA time
+before failing obscurely; ``validate()`` exists to fail fast with a
+named reason. Each rule gets a directed case — constructor-level and
+validate-level. Pure host-side Python: no jax involvement.
+
+Parity target: the builder-validation cases of
+``happysimulator/tests/unit/test_simulation_validation.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from happysim_tpu.tpu.model import EnsembleModel, mm1_model, pipeline_model
+
+
+def base():
+    return EnsembleModel(horizon_s=10.0)
+
+
+class TestConstructorRules:
+    def test_bad_service_kind(self):
+        with pytest.raises(ValueError, match="service kind"):
+            base().server(service="weibull")
+
+    def test_bad_concurrency(self):
+        with pytest.raises(ValueError, match="concurrency"):
+            base().server(concurrency=0)
+
+    def test_bad_queue_capacity(self):
+        with pytest.raises(ValueError, match="queue_capacity"):
+            base().server(queue_capacity=0)
+
+    def test_retries_require_deadline(self):
+        with pytest.raises(ValueError, match="deadline"):
+            base().server(max_retries=2)
+
+    def test_bad_deadline(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            base().server(deadline_s=0.0)
+
+    def test_erlang_k_bounds(self):
+        with pytest.raises(ValueError, match="erlang"):
+            base().server(service="erlang", service_k=5)
+
+    def test_hyperexp_needs_scv_above_one(self):
+        with pytest.raises(ValueError, match="scv"):
+            base().server(service="hyperexp", service_scv=0.8)
+
+    def test_pareto_needs_finite_mean(self):
+        with pytest.raises(ValueError, match="alpha"):
+            base().server(service="pareto", pareto_alpha=0.9)
+
+    def test_empty_outage_window(self):
+        with pytest.raises(ValueError, match="outage"):
+            base().server(outage=(5.0, 5.0))
+
+    def test_negative_outage_start(self):
+        with pytest.raises(ValueError, match="outage"):
+            base().server(outage=(-1.0, 2.0))
+
+    def test_limiter_needs_positive_rate_and_capacity(self):
+        with pytest.raises(ValueError, match="refill_rate"):
+            base().limiter(refill_rate=0.0, capacity=5.0)
+        with pytest.raises(ValueError, match="capacity"):
+            base().limiter(refill_rate=1.0, capacity=0.5)
+
+    def test_router_policy_checked(self):
+        with pytest.raises(ValueError, match="policy"):
+            base().router(policy="sticky")
+
+    def test_remote_needs_server_ingress(self):
+        model = base()
+        sink = model.sink()
+        with pytest.raises(ValueError, match="ingress"):
+            model.remote(ingress=sink, latency_s=0.1)
+
+
+class TestConnectRules:
+    def test_negative_edge_latency(self):
+        model = base()
+        source, server = model.source(rate=1.0), model.server()
+        with pytest.raises(ValueError, match="latency_s"):
+            model.connect(source, server, latency_s=-0.1)
+
+    def test_latency_into_limiter_rejected(self):
+        model = base()
+        source = model.source(rate=1.0)
+        limiter = model.limiter(refill_rate=1.0, capacity=5.0)
+        with pytest.raises(ValueError, match="limiter"):
+            model.connect(source, limiter, latency_s=0.5)
+
+    def test_latency_into_router_rejected(self):
+        model = base()
+        source = model.source(rate=1.0)
+        router = model.router()
+        with pytest.raises(ValueError, match="router"):
+            model.connect(source, router, latency_s=0.5)
+
+    def test_router_to_router_rejected(self):
+        model = base()
+        a, b = model.router(), model.router()
+        with pytest.raises(ValueError, match="single hop"):
+            model.connect(a, b)
+
+    def test_limiter_to_limiter_rejected(self):
+        model = base()
+        a = model.limiter(refill_rate=1.0, capacity=2.0)
+        b = model.limiter(refill_rate=1.0, capacity=2.0)
+        with pytest.raises(ValueError, match="chain"):
+            model.connect(a, b)
+
+    def test_sink_has_no_downstream(self):
+        model = base()
+        sink = model.sink()
+        with pytest.raises(ValueError, match="Sinks"):
+            model.connect(sink, model.server())
+
+    def test_bad_latency_kind(self):
+        model = base()
+        source, server = model.source(rate=1.0), model.server()
+        with pytest.raises(ValueError, match="latency kind"):
+            model.connect(source, server, latency_s=0.1, latency_kind="gamma")
+
+
+class TestValidateRules:
+    def test_needs_source_and_sink(self):
+        model = base()
+        model.sink()
+        with pytest.raises(ValueError, match="source"):
+            model.validate()
+        other = base()
+        other.source(rate=1.0)
+        with pytest.raises(ValueError, match="sink"):
+            other.validate()
+
+    def test_dangling_source(self):
+        model = base()
+        model.source(rate=1.0)
+        model.sink()
+        with pytest.raises(ValueError, match="no downstream"):
+            model.validate()
+
+    def test_dangling_server(self):
+        model = base()
+        source = model.source(rate=1.0)
+        server = model.server()
+        model.sink()
+        model.connect(source, server)
+        with pytest.raises(ValueError, match=r"server\[0\] has no downstream"):
+            model.validate()
+
+    def test_empty_router(self):
+        model = base()
+        source = model.source(rate=1.0)
+        router = model.router()
+        model.sink()
+        model.connect(source, router)
+        with pytest.raises(ValueError, match="no targets"):
+            model.validate()
+
+    def test_remote_requires_partitioned_mode(self):
+        model = base()
+        source = model.source(rate=1.0)
+        server = model.server()
+        sink = model.sink()
+        model.connect(source, server)
+        model.connect(server, sink)
+        model.remote(ingress=server, latency_s=0.1)
+        with pytest.raises(ValueError, match="run_partitioned"):
+            model.validate()
+        model.validate(allow_remote=True)  # partitioned mode accepts it
+
+    def test_least_outstanding_needs_server_targets(self):
+        model = base()
+        source = model.source(rate=1.0)
+        sink = model.sink()
+        router = model.router(policy="least_outstanding")
+        model.connect(source, router)
+        model.connect(router, sink)
+        with pytest.raises(ValueError, match="least_outstanding"):
+            model.validate()
+
+    def test_mixed_server_sink_router_is_legal(self):
+        model = base()
+        source = model.source(rate=1.0)
+        server = model.server()
+        sink = model.sink()
+        router = model.router(policy="random")
+        model.connect(source, server)
+        model.connect(server, router)
+        model.connect(router, sink)
+        model.connect(router, server)  # probabilistic feedback
+        model.validate()
+
+
+class TestFactories:
+    def test_mm1_model_validates(self):
+        mm1_model().validate()
+
+    def test_pipeline_model_validates(self):
+        pipeline_model(rate=5.0, service_means=[0.05, 0.04, 0.03]).validate()
+
+    def test_max_queue_capacity_is_fleet_max(self):
+        model = base()
+        source = model.source(rate=1.0)
+        a = model.server(queue_capacity=8)
+        b = model.server(queue_capacity=64)
+        sink = model.sink()
+        model.connect(source, a)
+        model.connect(a, b)
+        model.connect(b, sink)
+        assert model.max_queue_capacity == 64

@@ -2,6 +2,8 @@
 
 import threading
 
+import pytest
+
 from happysim_tpu.core.temporal import Duration
 from happysim_tpu.utils import (
     get_id,
@@ -88,3 +90,60 @@ class TestSanitizeFilename:
 
     def test_keeps_safe_names_verbatim(self):
         assert sanitize_filename("run-01.checkpoint.npz") == "run-01.checkpoint.npz"
+
+
+@pytest.mark.parametrize(
+    "seconds,expected",
+    [
+        (0, "0s"),
+        (1e-9, "1ns"),
+        (999e-9, "999ns"),
+        (1e-6, "1us"),
+        (2.5e-3, "2.5ms"),
+        (1.0, "1s"),
+        (59.4, "59.4s"),
+        (60.0, "1m 0s"),
+        (61.0, "1m 1s"),
+        (3599.0, "59m 59s"),
+        (3600.0, "1h 00m"),
+        (3660.0, "1h 01m"),
+        (7322.0, "2h 02m"),
+        (-1.5, "-1.5s"),
+    ],
+)
+def test_humanize_duration_matrix(seconds, expected):
+    assert humanize_duration(seconds) == expected
+
+
+@pytest.mark.parametrize(
+    "count,expected",
+    [
+        (0, "0"),
+        (999, "999"),
+        (1000, "1k"),
+        (1500, "1.5k"),
+        (2_000_000, "2M"),
+        (3_200_000_000, "3.2B"),
+        (-1500, "-1.5k"),
+    ],
+)
+def test_humanize_count_matrix(count, expected):
+    assert humanize_count(count) == expected
+
+
+@pytest.mark.parametrize(
+    "raw,expected_safe",
+    [
+        ("plain-name_01", "plain-name_01"),
+        ("a b", "a_b"),
+        ("a/b\\c", "a_b_c"),
+        ("..hidden", "hidden"),
+        ("trailing...", "trailing"),
+        ("", "unnamed"),
+    ],
+)
+def test_sanitize_filename_matrix(raw, expected_safe):
+    result = sanitize_filename(raw)
+    assert result == expected_safe
+    assert "/" not in result and "\\" not in result
+    assert not result.startswith(".")

@@ -81,8 +81,14 @@ def _submodules(pkg) -> list:
 
 
 def _first_line(obj) -> str:
+    """First SENTENCE of the first docstring paragraph — a wrapped first
+    sentence must not be cut mid-phrase at the physical newline."""
     doc = inspect.getdoc(obj) or ""
-    return doc.split("\n")[0] if doc else ""
+    if not doc:
+        return ""
+    paragraph = " ".join(doc.split("\n\n")[0].split())
+    sentence_end = paragraph.find(". ")
+    return paragraph[: sentence_end + 1] if sentence_end != -1 else paragraph
 
 
 def _signature(obj) -> str:
@@ -150,6 +156,13 @@ def main() -> None:
         text = "\n".join(body).rstrip() + "\n"
         (OUT / f"{page}.md").write_text(text)
         total_pages += 1
+    # Renamed/removed pages must not linger: mkdocs would keep building
+    # the stale content.
+    expected = {f"{page}.md" for page in PAGES}
+    for stale in OUT.glob("*.md"):
+        if stale.name not in expected:
+            stale.unlink()
+            print(f"removed stale page {stale.name}")
     print(f"wrote {total_pages} pages to {OUT}")
 
 
