@@ -47,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from happysim_tpu.tpu.model import SERVER, SINK, EnsembleModel
+from happysim_tpu.tpu.model import ROUTER, SERVER, SINK, EnsembleModel
 
 logger = logging.getLogger(__name__)
 
@@ -58,28 +58,24 @@ INF = jnp.float32(jnp.inf)
 _BLOCK_ELEMENTS = 128 * 1024 * 1024
 
 
-def chain_plan(model: EnsembleModel) -> Optional[list[int]]:
-    """Server indices in chain order if the fast path applies, else None.
-
-    Applicable: exactly one stationary Poisson source (no profile) ->
-    chain of concurrency-1 servers with no deadlines/retries/outages ->
-    one sink, every edge latency-free, no routers/limiters/remotes.
-    """
+def _source_ok(model: EnsembleModel) -> bool:
     if len(model.sources) != 1 or len(model.sinks) != 1:
-        return None
-    if model.routers or model.limiters or model.remotes:
-        return None
+        return False
+    if model.limiters or model.remotes:
+        return False
     source = model.sources[0]
     if source.arrival != "poisson" or source.profile is not None:
-        return None
-    if source.latency.mean_s != 0.0:
-        return None
+        return False
+    return source.latency.mean_s == 0.0
+
+
+def _walk_chain(model: EnsembleModel, ref, seen: set[int]) -> Optional[list[int]]:
+    """Follow server downstreams from ``ref`` to the sink; None if the
+    walk hits anything the closed form can't express."""
     order: list[int] = []
-    seen: set[int] = set()
-    ref = source.downstream
     while ref is not None and ref.kind == SERVER:
         if ref.index in seen:
-            return None  # feedback loop
+            return None  # feedback loop / shared server
         seen.add(ref.index)
         spec = model.servers[ref.index]
         if (
@@ -93,9 +89,65 @@ def chain_plan(model: EnsembleModel) -> Optional[list[int]]:
         ref = spec.downstream
     if ref is None or ref.kind != SINK:
         return None
+    return order
+
+
+def chain_plan(model: EnsembleModel) -> Optional[list[int]]:
+    """Server indices in chain order if the pure-chain fast path applies.
+
+    Applicable: exactly one stationary Poisson source (no profile) ->
+    chain of concurrency-1 servers with no deadlines/retries/outages ->
+    one sink, every edge latency-free, no routers/limiters/remotes.
+    """
+    if not _source_ok(model) or model.routers:
+        return None
+    order = _walk_chain(model, model.sources[0].downstream, set())
     if not order or len(order) != len(model.servers):
         return None
     return order
+
+
+def fanout_plan(model: EnsembleModel) -> Optional[dict]:
+    """source -> router -> parallel branches -> sink, if expressible.
+
+    Each router target is a sink (zero-latency pass-through) or the head
+    of a disjoint server chain ending at the sink. Random (uniform) and
+    round-robin policies only — least_outstanding is state-dependent.
+    Returns {"policy": ..., "branches": [[server indices], ...]}.
+    """
+    if not _source_ok(model) or len(model.routers) != 1:
+        return None
+    source = model.sources[0]
+    if source.downstream is None or source.downstream.kind != ROUTER:
+        return None
+    router = model.routers[source.downstream.index]
+    if router.policy not in ("random", "round_robin") or not router.targets:
+        return None
+    if any(edge.mean_s != 0.0 for edge in router.target_latencies):
+        return None
+    seen: set[int] = set()
+    branches: list[list[int]] = []
+    for target in router.targets:
+        if target.kind == SINK:
+            branches.append([])
+            continue
+        if target.kind != SERVER:
+            return None
+        branch = _walk_chain(model, target, seen)
+        if branch is None:
+            return None
+        branches.append(branch)
+    if len(seen) != len(model.servers):
+        return None  # servers outside the fan-out (unreachable or shared)
+    return {"policy": router.policy, "branches": branches}
+
+
+def fast_plan(model: EnsembleModel) -> Optional[dict]:
+    """Dispatch: the closed-form plan for this model, or None."""
+    chain = chain_plan(model)
+    if chain is not None:
+        return {"policy": None, "branches": [chain]}
+    return fanout_plan(model)
 
 
 def _sample_service_block(compiled, v: int, draw, shape, mean):
@@ -135,18 +187,20 @@ def _sample_service_block(compiled, v: int, draw, shape, mean):
 def run_chain(
     model: EnsembleModel,
     compiled,
-    plan: list[int],
+    plan,
     n_replicas: int,
     seed: int,
     sharding,
     src_rate: np.ndarray,  # (R, nS)
     srv_mean: np.ndarray,  # (R, nV)
 ):
-    """Closed-form chain execution.
+    """Closed-form chain / fan-out execution.
 
-    Returns ``(reduced, events_total, wall_seconds)`` shaped exactly like
-    the event loop's ``reduce_final`` output, or None if the finite-
-    capacity certificate failed (caller falls back to the event scan).
+    ``plan`` is ``fast_plan``'s dict (a bare server list is accepted for
+    the single-chain case). Returns ``(reduced, events_total,
+    wall_seconds)`` shaped exactly like the event loop's ``reduce_final``
+    output, or None if the finite-capacity certificate failed (caller
+    falls back to the event scan).
     """
     from happysim_tpu.tpu.engine import HIST_BINS, _hist_bin
     import time as _wall
@@ -165,9 +219,18 @@ def run_chain(
     # contract as the event loop's max_events).
     n_customers = int(lam + 6.0 * math.sqrt(max(lam, 1.0)) + 20.0)
 
+    if isinstance(plan, list):
+        plan = {"policy": None, "branches": [plan]}
+    branches: list[list[int]] = plan["branches"]
+    policy = plan["policy"]
+    n_branches = len(branches)
     nV = len(model.servers)
     nK = len(model.sinks)
-    caps = [float(model.servers[v].queue_capacity) for v in plan]
+    caps = {
+        v: float(model.servers[v].queue_capacity)
+        for branch in branches
+        for v in branch
+    }
 
     n_devices = max(len(sharding.mesh.devices.reshape(-1)), 1)
     if n_customers * n_devices > _BLOCK_ELEMENTS:
@@ -205,12 +268,33 @@ def run_chain(
 
         gaps = -jnp.log(replica_uniform(0)) / rate[:, None]
         arrivals_raw = jnp.cumsum(gaps, axis=1)
-        live = arrivals_raw <= jnp.float32(stop)
+        source_live = arrivals_raw <= jnp.float32(stop)
         truncated = arrivals_raw[:, -1] < jnp.float32(stop)
-        A = jnp.where(live, arrivals_raw, INF)
-        created = A
+        arrivals = jnp.where(source_live, arrivals_raw, INF)
+        created = arrivals
 
-        events = jnp.sum(live.astype(jnp.int32))  # source-fire events
+        # Branch assignment. A customer routed elsewhere is a PHANTOM on
+        # this branch: it keeps its slot in the (sorted) arrival sequence
+        # with zero service, which is exactly neutral to the Lindley
+        # recurrence — if the server is idle it "departs" on arrival, if
+        # busy it inherits the running departure level, so real customers
+        # after it see the same backlog either way. This keeps every
+        # branch's arrays rectangular with no compaction.
+        if n_branches == 1:
+            routed = [source_live]
+        elif policy == "round_robin":
+            lane = jnp.mod(
+                jnp.arange(n_customers, dtype=jnp.int32)[None, :], n_branches
+            )
+            routed = [source_live & (lane == b) for b in range(n_branches)]
+        else:  # random: uniform over targets (engine._route_choice)
+            pick = jnp.minimum(
+                (replica_uniform(1) * n_branches).astype(jnp.int32),
+                n_branches - 1,
+            )
+            routed = [source_live & (pick == b) for b in range(n_branches)]
+
+        events = jnp.sum(source_live.astype(jnp.int32))  # source-fire events
         overflow = jnp.bool_(False)
         wait_sum = jnp.zeros((nV,), jnp.float32)
         wait_n = jnp.zeros((nV,), jnp.int32)
@@ -218,73 +302,112 @@ def run_chain(
         depth = jnp.zeros((nV,), jnp.float32)
         started = jnp.zeros((nV,), jnp.int32)
         completed = jnp.zeros((nV,), jnp.int32)
+        # Branch sink masks are disjoint (each customer reaches the sink
+        # on exactly one branch), so per-customer bins/latency accumulate
+        # across branches and the expensive (B, N, BINS) compare-reduce
+        # runs ONCE at the end instead of once per branch.
+        bins_all = jnp.full((B, n_customers), HIST_BINS, jnp.int32)
+        latency_all = jnp.zeros((B, n_customers), jnp.float32)
 
-        D = A
-        for si, v in enumerate(plan):
-            service = _sample_service_block(
-                compiled,
-                v,
-                lambda extra, _p=1 + si: replica_uniform(_p, extra),
-                (B, n_customers),
-                means[:, v][:, None],
+        def sink_arrival(done_mask, done_time, latency_value, bins_acc, lat_acc):
+            m_sink = done_mask & (done_time >= jnp.float32(warmup))
+            bins_acc = jnp.where(m_sink, _hist_bin(latency_value), bins_acc)
+            lat_acc = jnp.where(m_sink, latency_value, lat_acc)
+            return bins_acc, lat_acc
+
+        purpose = 2  # 0 = gaps, 1 = route draw
+        for b, branch in enumerate(branches):
+            live = routed[b]
+            A = arrivals
+            D = A
+            if not branch:
+                # Router -> sink directly: zero-latency pass-through.
+                bins_all, latency_all = sink_arrival(
+                    live, A, jnp.zeros_like(A), bins_all, latency_all
+                )
+                continue
+            for v in branch:
+                service_raw = _sample_service_block(
+                    compiled,
+                    v,
+                    lambda extra, _p=purpose: replica_uniform(_p, extra),
+                    (B, n_customers),
+                    means[:, v][:, None],
+                )
+                purpose += 1
+                service = jnp.where(live, service_raw, 0.0)
+                csum = jnp.cumsum(service, axis=1)
+                # D_n = csum_n + max_{k<=n}(A_k - csum_{k-1})
+                D = csum + lax.cummax(A - (csum - service), axis=1)
+                start = D - service
+                wait = jnp.where(live, start - A, 0.0)
+
+                # Finite-capacity certificate: the number in system seen
+                # by arrival n (before admission) is n minus the
+                # departures at or before A_n. With BOTH sequences sorted
+                # this needs no search: in_system_n > cap  ⟺  fewer than
+                # n-cap departures by A_n  ⟺  D[n-cap-1] > A_n — one
+                # shifted elementwise compare. (A vmapped searchsorted
+                # here measured 19.8 s on a v5e; this form is 70 ms.)
+                # Under fan-out the index counts OTHER branches' phantoms
+                # too, so the check is a sound OVERESTIMATE: it can only
+                # fall back early, never admit a drop.
+                shift = int(caps[v]) + 1
+                if shift < n_customers:
+                    # Only an arrival that actually fires (this branch,
+                    # inside the horizon) can be dropped; the phantom
+                    # conservatism lives in the D index, not the mask.
+                    violation = (
+                        D[:, : n_customers - shift] > A[:, shift:]
+                    ) & live[:, shift:]
+                    overflow = overflow | jnp.any(violation)
+
+                m_start = (
+                    live
+                    & (start >= jnp.float32(warmup))
+                    & (start <= jnp.float32(horizon))
+                )
+                m_done = live & (D <= jnp.float32(horizon))
+                row = jnp.zeros((nV,), jnp.float32).at[v].set(1.0)
+                row_i = jnp.zeros((nV,), jnp.int32).at[v].set(1)
+                wait_sum = wait_sum + row * jnp.sum(jnp.where(m_start, wait, 0.0))
+                wait_n = wait_n + row_i * jnp.sum(m_start.astype(jnp.int32))
+                busy = busy + row * jnp.sum(jnp.where(m_start, service, 0.0))
+                # Queue-length integral over the measured window: each
+                # waiter contributes its in-window waiting interval.
+                contrib = jnp.clip(
+                    jnp.minimum(start, jnp.float32(horizon))
+                    - jnp.maximum(A, jnp.float32(warmup)),
+                    0.0,
+                )
+                depth = depth + row * jnp.sum(jnp.where(live, contrib, 0.0))
+                started = started + row_i * jnp.sum(
+                    (live & (start <= jnp.float32(horizon))).astype(jnp.int32)
+                )
+                completed = completed + row_i * jnp.sum(m_done.astype(jnp.int32))
+                events = events + jnp.sum(m_done.astype(jnp.int32))
+
+                # Next stage sees this stage's departures — but only
+                # those inside the horizon ever fire in the loop. The
+                # full D sequence stays (sorted) so later phantoms remain
+                # neutral.
+                live = m_done
+                A = D
+
+            bins_all, latency_all = sink_arrival(
+                live, D, jnp.where(live, D - created, 0.0), bins_all, latency_all
             )
-            csum = jnp.cumsum(service, axis=1)
-            # D_n = csum_n + max_{k<=n}(A_k - csum_{k-1})
-            D = csum + lax.cummax(A - (csum - service), axis=1)
-            start = D - service
-            wait = jnp.where(live, start - A, 0.0)
 
-            # Finite-capacity certificate: the number in system seen by
-            # arrival n (before admission) is n minus the departures at
-            # or before A_n. With BOTH sequences sorted this needs no
-            # search: in_system_n > cap  ⟺  fewer than n-cap departures
-            # by A_n  ⟺  D[n-cap-1] > A_n — one shifted elementwise
-            # compare. (A vmapped searchsorted here measured 19.8 s on a
-            # v5e for the bench shape; this form is 70 ms.)
-            shift = int(caps[si]) + 1
-            if shift < n_customers:
-                violation = (D[:, : n_customers - shift] > A[:, shift:]) & live[
-                    :, shift:
-                ]
-                overflow = overflow | jnp.any(violation)
-
-            m_start = live & (start >= jnp.float32(warmup)) & (start <= jnp.float32(horizon))
-            m_done = live & (D <= jnp.float32(horizon))
-            row = jnp.zeros((nV,), jnp.float32).at[v].set(1.0)
-            row_i = jnp.zeros((nV,), jnp.int32).at[v].set(1)
-            wait_sum = wait_sum + row * jnp.sum(jnp.where(m_start, wait, 0.0))
-            wait_n = wait_n + row_i * jnp.sum(m_start.astype(jnp.int32))
-            busy = busy + row * jnp.sum(jnp.where(m_start, service, 0.0))
-            # Queue-length integral over the measured window: each waiter
-            # contributes its in-window waiting interval (Fubini).
-            contrib = jnp.clip(
-                jnp.minimum(start, jnp.float32(horizon))
-                - jnp.maximum(A, jnp.float32(warmup)),
-                0.0,
-            )
-            depth = depth + row * jnp.sum(jnp.where(live, contrib, 0.0))
-            started = started + row_i * jnp.sum(
-                (live & (start <= jnp.float32(horizon))).astype(jnp.int32)
-            )
-            completed = completed + row_i * jnp.sum(m_done.astype(jnp.int32))
-            events = events + jnp.sum(m_done.astype(jnp.int32))
-
-            # Next stage sees this stage's departures — but only those
-            # that happen inside the horizon ever fire in the loop.
-            live = m_done
-            A = jnp.where(live, D, INF)
-
-        latency = jnp.where(live, D - created, 0.0)
-        m_sink = live & (D >= jnp.float32(warmup))
-        sink_count = jnp.sum(m_sink.astype(jnp.int32))
-        sink_sum = jnp.sum(jnp.where(m_sink, latency, 0.0))
-        sink_sq = jnp.sum(jnp.where(m_sink, latency * latency, 0.0))
+        m_sink_any = bins_all < jnp.int32(HIST_BINS)
+        sink_count = jnp.sum(m_sink_any.astype(jnp.int32))
+        sink_sum = jnp.sum(latency_all)
+        sink_sq = jnp.sum(latency_all * latency_all)
         # Broadcast-compare histogram: XLA fuses the (R, N, BINS) compare
         # into the reduction, one pass over the data (a segment_sum
         # scatter here measured 0.94 s on a v5e; this is ~80 ms).
-        bins = jnp.where(m_sink, _hist_bin(latency), jnp.int32(HIST_BINS))
         hist = jnp.sum(
-            bins[:, :, None] == jnp.arange(HIST_BINS, dtype=jnp.int32)[None, None, :],
+            bins_all[:, :, None]
+            == jnp.arange(HIST_BINS, dtype=jnp.int32)[None, None, :],
             axis=(0, 1),
             dtype=jnp.int32,
         )
@@ -293,7 +416,7 @@ def run_chain(
             "truncated": jnp.sum(truncated.astype(jnp.int32)),
             "events": events,
             "overflow": overflow,
-            "sink_count": sink_count[None].astype(jnp.int32),  # nK == 1 by plan
+            "sink_count": sink_count[None],  # nK == 1 by plan
             "sink_sum": sink_sum[None],
             "sink_sq": sink_sq[None],
             "sink_hist": hist[None, :],

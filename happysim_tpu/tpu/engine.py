@@ -1608,9 +1608,10 @@ def run_ensemble(
 
     sharding = replica_sharding(mesh)
 
-    # Topology-specialized fast path: a Poisson->FIFO-chain->sink model
-    # needs no event loop at all (max-plus Lindley per stage, see
-    # chain.py). Engages only when its finite-capacity certificate holds
+    # Topology-specialized fast path: Poisson->FIFO-chain->sink models
+    # and single-router fan-outs need no event loop at all (max-plus
+    # Lindley per stage, see chain.py). Engages only when the
+    # finite-capacity certificate holds
     # — any would-be drop falls back to the scan below. Checkpointed and
     # resumed runs always use the scan (its carry IS the snapshot format).
     checkpointing_requested = (
@@ -1623,9 +1624,9 @@ def run_ensemble(
         and not explicit_max_events
         and os.environ.get("HS_TPU_CHAIN", "1") != "0"
     ):
-        from happysim_tpu.tpu.chain import chain_plan, run_chain
+        from happysim_tpu.tpu.chain import fast_plan, run_chain
 
-        plan = chain_plan(model)
+        plan = fast_plan(model)
         if plan is not None:
             fast = run_chain(
                 model, compiled, plan, n_replicas, seed, sharding, src_rate, srv_mean

@@ -164,6 +164,75 @@ class TestAgreement:
         assert abs(result.server_completed[0] - expected) / expected < 0.05
 
 
+def fanout(policy="random", n_servers=3, cap=256, sink_branch=False,
+           rate=9.0, mean=0.25, horizon=40.0, warmup=10.0):
+    model = EnsembleModel(horizon_s=horizon, warmup_s=warmup)
+    source = model.source(rate=rate)
+    sink = model.sink()
+    router = model.router(policy=policy)
+    model.connect(source, router)
+    for _ in range(n_servers):
+        server = model.server(service_mean=mean, queue_capacity=cap)
+        model.connect(router, server)
+        model.connect(server, sink)
+    if sink_branch:
+        model.connect(router, sink)
+    return model
+
+
+class TestFanout:
+    def test_plan_recognizes_router_fanout(self):
+        from happysim_tpu.tpu.chain import fast_plan
+
+        plan = fast_plan(fanout(n_servers=3, sink_branch=True))
+        assert plan is not None
+        assert plan["policy"] == "random"
+        assert sorted(map(tuple, plan["branches"])) == [(), (0,), (1,), (2,)]
+
+    def test_least_outstanding_falls_back(self):
+        from happysim_tpu.tpu.chain import fast_plan
+
+        model = fanout(n_servers=2)
+        model.routers[0].policy = "least_outstanding"
+        assert fast_plan(model) is None
+
+    @pytest.mark.parametrize("policy", ["random", "round_robin"])
+    def test_fanout_matches_loop(self, policy):
+        model = fanout(policy=policy)
+        fast, slow = run_both(model, n_replicas=384, seed=2)
+        for v in range(3):
+            f, s = fast.server_mean_wait_s[v], slow.server_mean_wait_s[v]
+            assert abs(f - s) / max(abs(s), 1e-9) < 0.25, (policy, v, f, s)
+            assert abs(
+                fast.server_utilization[v] - slow.server_utilization[v]
+            ) < 0.03
+        rel = abs(fast.sink_count[0] - slow.sink_count[0]) / slow.sink_count[0]
+        assert rel < 0.02
+
+    def test_direct_sink_branch_passes_through(self):
+        model = fanout(n_servers=2, sink_branch=True)
+        fast, slow = run_both(model, n_replicas=256, seed=4)
+        rel = abs(fast.sink_count[0] - slow.sink_count[0]) / slow.sink_count[0]
+        assert rel < 0.03
+        # A third of the traffic bypasses the servers with zero latency,
+        # pulling the mean sojourn well below the served branches'.
+        assert fast.sink_mean_latency_s[0] < slow.sink_mean_latency_s[0] * 1.2
+
+    def test_round_robin_waits_less_than_random(self):
+        """Physics check: deterministic thinning (Erlang-k arrivals)
+        queues less than Poisson thinning at the same load."""
+        rr = run_ensemble(fanout(policy="round_robin"), n_replicas=384, seed=6)
+        rnd = run_ensemble(fanout(policy="random"), n_replicas=384, seed=6)
+        assert (
+            sum(rr.server_mean_wait_s) < sum(rnd.server_mean_wait_s) * 0.8
+        )
+
+    def test_fanout_capacity_certificate_falls_back(self):
+        model = fanout(cap=2, rate=11.0, mean=0.26, horizon=30.0, warmup=5.0)
+        result = run_ensemble(model, n_replicas=96, seed=1)
+        assert sum(result.server_dropped) > 0  # the loop's accounting ran
+
+
 class TestCertificate:
     def test_small_capacity_falls_back_with_drops(self):
         model = chain(cap=2, rate=9.5, means=[0.1], horizon=30.0, warmup=5.0)
