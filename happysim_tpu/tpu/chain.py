@@ -58,6 +58,14 @@ INF = jnp.float32(jnp.inf)
 _BLOCK_ELEMENTS = 128 * 1024 * 1024
 
 
+def _constant_edge(edge) -> Optional[float]:
+    """The edge's constant latency in seconds, or None if inexpressible
+    (exponential latencies reorder the stream)."""
+    if edge.mean_s == 0.0:
+        return 0.0
+    return float(edge.mean_s) if edge.kind == "constant" else None
+
+
 def _source_ok(model: EnsembleModel) -> bool:
     if len(model.sources) != 1 or len(model.sinks) != 1:
         return False
@@ -66,13 +74,17 @@ def _source_ok(model: EnsembleModel) -> bool:
     source = model.sources[0]
     if source.arrival != "poisson" or source.profile is not None:
         return False
-    return source.latency.mean_s == 0.0
+    return _constant_edge(source.latency) is not None
 
 
-def _walk_chain(model: EnsembleModel, ref, seen: set[int]) -> Optional[list[int]]:
+def _walk_chain(
+    model: EnsembleModel, ref, entry_latency: float, seen: set[int]
+) -> Optional[dict]:
     """Follow server downstreams from ``ref`` to the sink; None if the
-    walk hits anything the closed form can't express."""
-    order: list[int] = []
+    walk hits anything the closed form can't express. Returns
+    {"stages": [(server index, latency INTO it)], "exit_lat": float}."""
+    stages: list[tuple[int, float]] = []
+    latency_in = entry_latency
     while ref is not None and ref.kind == SERVER:
         if ref.index in seen:
             return None  # feedback loop / shared server
@@ -82,14 +94,17 @@ def _walk_chain(model: EnsembleModel, ref, seen: set[int]) -> Optional[list[int]
             spec.concurrency != 1
             or spec.deadline_s is not None
             or spec.outage_start_s is not None
-            or spec.latency.mean_s != 0.0
         ):
             return None
-        order.append(ref.index)
+        out_latency = _constant_edge(spec.latency)
+        if out_latency is None:
+            return None
+        stages.append((ref.index, latency_in))
+        latency_in = out_latency
         ref = spec.downstream
     if ref is None or ref.kind != SINK:
         return None
-    return order
+    return {"stages": stages, "exit_lat": latency_in}
 
 
 def chain_plan(model: EnsembleModel) -> Optional[list[int]]:
@@ -97,14 +112,23 @@ def chain_plan(model: EnsembleModel) -> Optional[list[int]]:
 
     Applicable: exactly one stationary Poisson source (no profile) ->
     chain of concurrency-1 servers with no deadlines/retries/outages ->
-    one sink, every edge latency-free, no routers/limiters/remotes.
+    one sink, constant-latency edges only, no routers/limiters/remotes.
     """
+    branch = _chain_branch(model)
+    if branch is None or not branch["stages"]:
+        return None
+    return [v for v, _ in branch["stages"]]
+
+
+def _chain_branch(model: EnsembleModel) -> Optional[dict]:
     if not _source_ok(model) or model.routers:
         return None
-    order = _walk_chain(model, model.sources[0].downstream, set())
-    if not order or len(order) != len(model.servers):
+    seen: set[int] = set()
+    entry = _constant_edge(model.sources[0].latency)
+    branch = _walk_chain(model, model.sources[0].downstream, entry, seen)
+    if branch is None or len(seen) != len(model.servers):
         return None
-    return order
+    return branch
 
 
 def fanout_plan(model: EnsembleModel) -> Optional[dict]:
@@ -123,17 +147,18 @@ def fanout_plan(model: EnsembleModel) -> Optional[dict]:
     router = model.routers[source.downstream.index]
     if router.policy not in ("random", "round_robin") or not router.targets:
         return None
-    if any(edge.mean_s != 0.0 for edge in router.target_latencies):
-        return None
     seen: set[int] = set()
-    branches: list[list[int]] = []
-    for target in router.targets:
+    branches: list[dict] = []
+    for target, edge in zip(router.targets, router.target_latencies):
+        entry = _constant_edge(edge)
+        if entry is None:
+            return None
         if target.kind == SINK:
-            branches.append([])
+            branches.append({"stages": [], "exit_lat": entry})
             continue
         if target.kind != SERVER:
             return None
-        branch = _walk_chain(model, target, seen)
+        branch = _walk_chain(model, target, entry, seen)
         if branch is None:
             return None
         branches.append(branch)
@@ -144,8 +169,8 @@ def fanout_plan(model: EnsembleModel) -> Optional[dict]:
 
 def fast_plan(model: EnsembleModel) -> Optional[dict]:
     """Dispatch: the closed-form plan for this model, or None."""
-    chain = chain_plan(model)
-    if chain is not None:
+    chain = _chain_branch(model)
+    if chain is not None and chain["stages"]:
         return {"policy": None, "branches": [chain]}
     return fanout_plan(model)
 
@@ -219,17 +244,24 @@ def run_chain(
     # contract as the event loop's max_events).
     n_customers = int(lam + 6.0 * math.sqrt(max(lam, 1.0)) + 20.0)
 
-    if isinstance(plan, list):
-        plan = {"policy": None, "branches": [plan]}
-    branches: list[list[int]] = plan["branches"]
+    if isinstance(plan, list):  # legacy bare server list (tests)
+        plan = {
+            "policy": None,
+            "branches": [{"stages": [(v, 0.0) for v in plan], "exit_lat": 0.0}],
+        }
+    branches: list[dict] = plan["branches"]
     policy = plan["policy"]
     n_branches = len(branches)
     nV = len(model.servers)
     nK = len(model.sinks)
+    transit_cap = int(getattr(model, "transit_capacity", 256))
+    has_transit = any(
+        lat > 0.0 for branch in branches for _, lat in branch["stages"]
+    )
     caps = {
         v: float(model.servers[v].queue_capacity)
         for branch in branches
-        for v in branch
+        for v, _ in branch["stages"]
     }
 
     n_devices = max(len(sharding.mesh.devices.reshape(-1)), 1)
@@ -320,13 +352,38 @@ def run_chain(
             live = routed[b]
             A = arrivals
             D = A
-            if not branch:
-                # Router -> sink directly: zero-latency pass-through.
+            if not branch["stages"]:
+                # Router -> sink directly (possibly across a latency
+                # edge): deliveries land at A + exit_lat — the engine
+                # never observes post-horizon sink deliveries.
+                done_time = A + jnp.float32(branch["exit_lat"])
+                live = live & (done_time <= jnp.float32(horizon))
                 bins_all, latency_all = sink_arrival(
-                    live, A, jnp.zeros_like(A), bins_all, latency_all
+                    live,
+                    done_time,
+                    jnp.full_like(A, branch["exit_lat"]),
+                    bins_all,
+                    latency_all,
                 )
                 continue
-            for v in branch:
+            for v, entry_lat in branch["stages"]:
+                if entry_lat > 0.0:
+                    # Constant-latency edge: the whole (sorted) stream
+                    # shifts by L; transit registers at the DESTINATION
+                    # hold at most transit_cap in-flight jobs, and a job
+                    # occupies one for exactly L. Same shifted-compare
+                    # certificate, on (departure, departure - L).
+                    if transit_cap < n_customers:
+                        in_transit_violation = (
+                            A[:, : n_customers - transit_cap]
+                            > A[:, transit_cap:] - jnp.float32(entry_lat)
+                        ) & live[:, transit_cap:]
+                        overflow = overflow | jnp.any(in_transit_violation)
+                    A = A + jnp.float32(entry_lat)
+                    # The transit-arrival event only fires inside the
+                    # horizon; later jobs never reach the server.
+                    live = live & (A <= jnp.float32(horizon))
+                    events = events + jnp.sum(live.astype(jnp.int32))
                 service_raw = _sample_service_block(
                     compiled,
                     v,
@@ -394,8 +451,15 @@ def run_chain(
                 live = m_done
                 A = D
 
+            exit_lat = jnp.float32(branch["exit_lat"])
+            done_time = D + exit_lat
+            live = live & (done_time <= jnp.float32(horizon))
             bins_all, latency_all = sink_arrival(
-                live, D, jnp.where(live, D - created, 0.0), bins_all, latency_all
+                live,
+                done_time,
+                jnp.where(live, done_time - created, 0.0),
+                bins_all,
+                latency_all,
             )
 
         m_sink_any = bins_all < jnp.int32(HIST_BINS)
@@ -489,5 +553,9 @@ def run_chain(
         "lim_admitted": np.zeros((max(len(model.limiters), 1),), np.int32),
         "lim_dropped": np.zeros((max(len(model.limiters), 1),), np.int32),
     }
+    if has_transit:
+        # No drops by certificate; the key must exist for the shared
+        # result assembly when compiled.has_transit.
+        reduced["tr_dropped"] = zeros_v
     events_total = int(reduced["events"])
     return reduced, events_total, wall
