@@ -64,15 +64,40 @@ class FaultSchedule(Entity):
         ctx = self._build_context(start_time)
         all_events: "list[Event]" = []
         for fault, handle in zip(self._faults, self._handles):
+            if handle.cancelled:
+                # Revoked before bootstrap: never expand into events (a
+                # cancel() on an empty handle used to be silently undone
+                # by this very arming step).
+                continue
             events = fault.generate_events(ctx)
             # attach() aliases the list: self-perpetuating faults append
             # their later events to it so cancel() reaches them.
             handle.attach(events)
+            for event in events:
+                self._meter(event)
             all_events.extend(events)
         logger.info(
             "[%s] %d fault(s) -> %d event(s)", self.name, len(self._faults), len(all_events)
         )
         return all_events
+
+    def _meter(self, event: "Event") -> None:
+        """Count lifecycle transitions when the event actually fires.
+
+        Completion hooks run post-invoke, so a cancelled event (revoked
+        before activation) never bumps the ledger — FaultStats reflect
+        what HAPPENED, not what was armed. Events a fault self-schedules
+        mid-run (e.g. RandomPartition's chain) bypass start() and are
+        not metered.
+        """
+        label = event.event_type
+        if label.endswith(".activate"):
+            transition = "activated"
+        elif label.endswith(".deactivate"):
+            transition = "deactivated"
+        else:
+            return
+        event.add_completion_hook(lambda _time: self._ledger.bump(transition))
 
     @property
     def stats(self) -> FaultStats:

@@ -17,6 +17,7 @@ Rebuild extension: ``backend`` selects the execution tier —
 
 from __future__ import annotations
 
+import multiprocessing
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -87,8 +88,17 @@ class ParallelRunner:
             )
         if self.backend == "inline" or len(configs) == 1:
             return [_execute_config(c) for c in configs]
-        pool_cls = ProcessPoolExecutor if self.backend == "process" else ThreadPoolExecutor
-        with pool_cls(max_workers=self.max_workers) as pool:
+        if self.backend == "process":
+            # Explicit spawn context: fork from a threaded parent (JAX
+            # spins up worker threads on import) is deadlock-prone and
+            # deprecated — Python 3.14 flips the default to spawn.
+            pool = ProcessPoolExecutor(
+                max_workers=self.max_workers,
+                mp_context=multiprocessing.get_context("spawn"),
+            )
+        else:
+            pool = ThreadPoolExecutor(max_workers=self.max_workers)
+        with pool:
             return list(pool.map(_execute_config, configs))
 
     def run_replicas(

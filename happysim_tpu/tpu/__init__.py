@@ -23,8 +23,15 @@ from happysim_tpu.tpu.engine import (
     hist_percentile,
     run_ensemble,
 )
+from happysim_tpu.tpu.faults import duty_cycle
 from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
-from happysim_tpu.tpu.model import EnsembleModel, mm1_model, pipeline_model
+from happysim_tpu.tpu.model import (
+    CorrelatedOutages,
+    EnsembleModel,
+    FaultSpec,
+    mm1_model,
+    pipeline_model,
+)
 from happysim_tpu.tpu.partitioned import (
     PARTITION_AXIS,
     PartitionedCheckpoint,
@@ -34,10 +41,13 @@ from happysim_tpu.tpu.partitioned import (
 )
 
 __all__ = [
+    "CorrelatedOutages",
     "EnsembleCheckpoint",
     "EnsembleModel",
     "EnsembleResult",
+    "FaultSpec",
     "MM1Result",
+    "duty_cycle",
     "hist_percentile",
     "mm1_model",
     "pipeline_model",

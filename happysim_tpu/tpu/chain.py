@@ -60,7 +60,10 @@ _BLOCK_ELEMENTS = 128 * 1024 * 1024
 
 def _constant_edge(edge) -> Optional[float]:
     """The edge's constant latency in seconds, or None if inexpressible
-    (exponential latencies reorder the stream)."""
+    (exponential latencies reorder the stream; packet loss thins it
+    stochastically, which the deterministic recurrence cannot price)."""
+    if edge.loss_p > 0.0:
+        return None
     if edge.mean_s == 0.0:
         return 0.0
     return float(edge.mean_s) if edge.kind == "constant" else None
@@ -70,6 +73,11 @@ def _source_ok(model: EnsembleModel) -> bool:
     if len(model.sources) != 1 or len(model.sinks) != 1:
         return False
     if model.limiters or model.remotes:
+        return False
+    # Correlated fault schedules can darken any subscribed server — the
+    # closed form has no notion of time-varying service, so decline the
+    # whole model up front.
+    if getattr(model, "correlated_faults", None) is not None:
         return False
     source = model.sources[0]
     if source.arrival != "poisson" or source.profile is not None:
@@ -94,6 +102,13 @@ def _walk_chain(
             spec.concurrency != 1
             or spec.deadline_s is not None
             or spec.outage_start_s is not None
+            # Chaos semantics are event-loop-only: stochastic/pinned
+            # fault windows, backoff retries, and hedged starts all
+            # change the departure process in ways the Lindley closed
+            # form cannot certify.
+            or spec.fault is not None
+            or spec.retry_backoff_s is not None
+            or spec.hedge_delay_s is not None
         ):
             return None
         out_latency = _constant_edge(spec.latency)
@@ -326,7 +341,10 @@ def run_chain(
             )
             routed = [source_live & (pick == b) for b in range(n_branches)]
 
-        events = jnp.sum(source_live.astype(jnp.int32))  # source-fire events
+        # Event accounting: per-term int32 partial sums (each bounded by
+        # one (B, N) reduction < 2^31), summed on the host in int64 so
+        # deep chains at full block size cannot overflow the counter.
+        events_terms = [jnp.sum(source_live.astype(jnp.int32))]  # source fires
         overflow = jnp.bool_(False)
         wait_sum = jnp.zeros((nV,), jnp.float32)
         wait_n = jnp.zeros((nV,), jnp.int32)
@@ -383,7 +401,7 @@ def run_chain(
                     # The transit-arrival event only fires inside the
                     # horizon; later jobs never reach the server.
                     live = live & (A <= jnp.float32(horizon))
-                    events = events + jnp.sum(live.astype(jnp.int32))
+                    events_terms.append(jnp.sum(live.astype(jnp.int32)))
                 service_raw = _sample_service_block(
                     compiled,
                     v,
@@ -442,7 +460,7 @@ def run_chain(
                     (live & (start <= jnp.float32(horizon))).astype(jnp.int32)
                 )
                 completed = completed + row_i * jnp.sum(m_done.astype(jnp.int32))
-                events = events + jnp.sum(m_done.astype(jnp.int32))
+                events_terms.append(jnp.sum(m_done.astype(jnp.int32)))
 
                 # Next stage sees this stage's departures — but only
                 # those inside the horizon ever fire in the loop. The
@@ -478,7 +496,7 @@ def run_chain(
 
         return {
             "truncated": jnp.sum(truncated.astype(jnp.int32)),
-            "events": events,
+            "events": jnp.stack(events_terms),
             "overflow": overflow,
             "sink_count": sink_count[None],  # nK == 1 by plan
             "sink_sum": sink_sum[None],
@@ -533,9 +551,19 @@ def run_chain(
         return np.sum(np.stack([np.asarray(p[name]) for p in partials]), axis=0)
 
     zeros_v = np.zeros((nV,), np.int32)
+    # The per-term event partials are summed in int64 (the device-side
+    # terms are individually < 2^31 by construction).
+    events_total = int(
+        np.sum(
+            np.concatenate(
+                [np.atleast_1d(np.asarray(p["events"])) for p in partials]
+            ),
+            dtype=np.int64,
+        )
+    )
     reduced = {
         "truncated": total("truncated"),
-        "events": total("events"),
+        "events": events_total,
         "sink_count": total("sink_count"),
         "sink_sum": total("sink_sum"),
         "sink_sq": total("sink_sq"),
@@ -557,5 +585,4 @@ def run_chain(
         # No drops by certificate; the key must exist for the shared
         # result assembly when compiled.has_transit.
         reduced["tr_dropped"] = zeros_v
-    events_total = int(reduced["events"])
     return reduced, events_total, wall

@@ -63,6 +63,7 @@ import math
 import os
 import time as _wall
 from dataclasses import dataclass
+from dataclasses import field as dataclasses_field
 from functools import partial
 from typing import Optional
 
@@ -74,6 +75,7 @@ from jax.sharding import Mesh
 
 logger = logging.getLogger("happysim_tpu.tpu.engine")
 
+from happysim_tpu.tpu.faults import FaultTable
 from happysim_tpu.tpu.mesh import pad_to_multiple, replica_mesh, replica_sharding
 from happysim_tpu.tpu.model import (
     LIMITER,
@@ -187,6 +189,7 @@ def model_fingerprint(model: EnsembleModel) -> str:
             model.limiters,
             len(model.sinks),
             model.remotes,
+            getattr(model, "correlated_faults", None),
         )
     )
     return hashlib.sha256(spec.encode()).hexdigest()[:16]
@@ -274,6 +277,18 @@ class EnsembleResult:
     limiter_dropped: list[int]
     # replicas whose event budget ran out before the horizon (bias warning)
     truncated_replicas: int = 0
+    # chaos accounting (all zero unless the model declares faults /
+    # resilience — see model.FaultSpec and tpu/faults.py):
+    # terminal losses to stochastic fault windows (retry budget exhausted
+    # or no client retry configured)
+    server_fault_dropped: list[int] = dataclasses_field(default_factory=list)
+    # client retries launched after fault-window rejections
+    server_fault_retried: list[int] = dataclasses_field(default_factory=list)
+    # hedged second attempts launched / won
+    server_hedged: list[int] = dataclasses_field(default_factory=list)
+    server_hedge_wins: list[int] = dataclasses_field(default_factory=list)
+    # packet-loss edge drops (whole model)
+    network_lost: int = 0
 
     def summary(self):
         from happysim_tpu.core.temporal import Instant
@@ -308,6 +323,13 @@ class EnsembleResult:
                 extra["outage_dropped"] = self.server_outage_dropped[index]
             if self.transit_dropped[index]:
                 extra["transit_dropped"] = self.transit_dropped[index]
+            if self.server_fault_dropped and self.server_fault_dropped[index]:
+                extra["fault_dropped"] = self.server_fault_dropped[index]
+            if self.server_fault_retried and self.server_fault_retried[index]:
+                extra["fault_retried"] = self.server_fault_retried[index]
+            if self.server_hedged and self.server_hedged[index]:
+                extra["hedged"] = self.server_hedged[index]
+                extra["hedge_wins"] = self.server_hedge_wins[index]
             entities.append(
                 EntitySummary(name=f"server[{index}]", kind="Server", extra=extra)
             )
@@ -415,7 +437,10 @@ class _Compiled:
                 self.srv_par_xmf[v] = (spec.pareto_alpha - 1.0) / spec.pareto_alpha
             if spec.deadline_s is not None:
                 self.srv_deadline[v] = spec.deadline_s
-                self.srv_max_retries[v] = spec.max_retries
+            # The attempt budget is shared by deadline retries and
+            # fault-rejection retries (a job re-issued for either reason
+            # spends from the same max_retries).
+            self.srv_max_retries[v] = spec.max_retries
             if spec.outage_start_s is not None:
                 self.srv_outage_start[v] = spec.outage_start_s
                 self.srv_outage_end[v] = spec.outage_end_s
@@ -437,6 +462,40 @@ class _Compiled:
             )
         self.n_svc_draws = max(draws_needed[k] for k in present)
 
+        # Stochastic fault schedules + client-side resilience
+        # (tpu/faults.py; spec types in model.FaultSpec). Everything here
+        # is compile-time gated: an unfaulted model traces to the exact
+        # same program as before.
+        self.faults = FaultTable(model)
+        self.has_faults = self.faults.has_faults
+        self.srv_concurrency = np.asarray(
+            [s.concurrency for s in servers] or [1], np.int32
+        )
+        self.srv_backoff = np.zeros((self.nV,), np.float32)
+        self.srv_jitter = np.zeros((self.nV,), np.float32)
+        self.srv_hedge = np.full((self.nV,), np.inf, np.float32)
+        self.flt_can_retry = np.zeros((self.nV,), np.bool_)
+        for v, spec in enumerate(servers):
+            if spec.retry_backoff_s is not None:
+                self.srv_backoff[v] = spec.retry_backoff_s
+            self.srv_jitter[v] = spec.retry_jitter
+            if spec.hedge_delay_s is not None:
+                self.srv_hedge[v] = spec.hedge_delay_s
+            self.flt_can_retry[v] = (
+                spec.fault is not None
+                and spec.fault.mode == "outage"
+                and spec.retry_backoff_s is not None
+                and spec.max_retries > 0
+            )
+        self.has_backoff = any(s.retry_backoff_s is not None for s in servers)
+        self.has_jitter = any(s.retry_jitter > 0.0 for s in servers)
+        self.has_hedge = any(s.hedge_delay_s is not None for s in servers)
+        self.has_fault_retries = bool(self.flt_can_retry.any())
+        # Attempt numbers ride with jobs whenever anything consumes them
+        # (deadline budgets or fault-rejection retry budgets).
+        self.has_attempts = self.has_deadlines or self.has_fault_retries
+        self.has_loss = any(e.loss_p > 0.0 for e in _all_edges(model))
+
         self.arrival_is_poisson = np.array(
             [s.arrival == "poisson" for s in model.sources], np.bool_
         )
@@ -456,10 +515,15 @@ class _Compiled:
         )
 
         # Whether ANY edge into a server carries latency (enables the
-        # transit registers + the transit-arrival branch).
-        self.has_transit = any(
-            edge.mean_s > 0 and dest is not None and self._reaches_server(dest)
-            for edge, dest in self._edges()
+        # transit registers + the transit-arrival branch). Backoff
+        # retries are delayed re-arrivals, so they ride the same
+        # registers and force them on.
+        self.has_transit = (
+            any(
+                edge.mean_s > 0 and dest is not None and self._reaches_server(dest)
+                for edge, dest in self._edges()
+            )
+            or self.has_backoff
         )
         self._build_profile_tables()
         self._assign_uniform_slots()
@@ -521,6 +585,28 @@ class _Compiled:
         else:
             self.U_SVC1 = None
             self.U_SVC2 = None
+        # Hedged requests need a SECOND service sample on both start
+        # paths (delivery arrival and completion queue-pull).
+        if self.model.servers and self.n_svc_draws > 0 and self.has_hedge:
+            self.U_HED1: Optional[int] = slot
+            slot += self.n_svc_draws
+            self.U_HED2: Optional[int] = slot
+            slot += self.n_svc_draws
+        else:
+            self.U_HED1 = None
+            self.U_HED2 = None
+        # One Bernoulli per lossy-edge crossing; one jitter draw per
+        # backoff computation (inert 0.5 when jitter is 0 everywhere).
+        if self.has_loss:
+            self.U_LOSS: Optional[int] = slot
+            slot += 1
+        else:
+            self.U_LOSS = None
+        if self.has_jitter:
+            self.U_JIT: Optional[int] = slot
+            slot += 1
+        else:
+            self.U_JIT = None
         self.n_draws = max(slot, 1)
 
     def _uslot(self, u, slot: Optional[int]):
@@ -603,17 +689,32 @@ class _Compiled:
             "sink_hist": jnp.zeros((self.nK, HIST_BINS), jnp.int32),
             "events": jnp.int32(0),
         }
-        if self.has_deadlines:
+        if self.has_attempts:
             state["srv_slot_attempt"] = jnp.zeros((self.nV, self.C), jnp.int32)
             state["srv_q_attempt"] = jnp.zeros((self.nV, self.K), jnp.int32)
         if self.has_transit:
             state["tr_time"] = jnp.full((self.nV, self.TR), INF)
             state["tr_created"] = jnp.zeros((self.nV, self.TR), jnp.float32)
             state["tr_dropped"] = jnp.zeros((self.nV,), jnp.int32)
+            if self.has_backoff:
+                state["tr_attempt"] = jnp.zeros((self.nV, self.TR), jnp.int32)
+        if self.has_faults:
+            # Per-replica fault timelines, drawn once from this lane's
+            # key (constant for the rest of the run — fault activation
+            # needs no events of its own).
+            state.update(self.faults.sample_state(key))
+            state["srv_fault_dropped"] = jnp.zeros((self.nV,), jnp.int32)
+            if self.has_fault_retries:
+                state["srv_fault_retried"] = jnp.zeros((self.nV,), jnp.int32)
+        if self.has_hedge:
+            state["srv_hedged"] = jnp.zeros((self.nV,), jnp.int32)
+            state["srv_hedge_wins"] = jnp.zeros((self.nV,), jnp.int32)
+        if self.has_loss:
+            state["net_lost"] = jnp.int32(0)
         return state
 
     def _qro_keys(self):
-        return _QRO_KEYS + (("srv_q_attempt",) if self.has_deadlines else ())
+        return _QRO_KEYS + (("srv_q_attempt",) if self.has_attempts else ())
 
     def _null_qpush(self):
         """The per-step queue-push descriptor, initially inert."""
@@ -624,7 +725,7 @@ class _Compiled:
             "created": jnp.float32(0.0),
             "enq": jnp.float32(0.0),
         }
-        if self.has_deadlines:
+        if self.has_attempts:
             desc["attempt"] = jnp.int32(0)
         return desc
 
@@ -643,7 +744,7 @@ class _Compiled:
                 "srv_q_created": jnp.where(mask, desc["created"], qro["srv_q_created"]),
                 "srv_q_enq": jnp.where(mask, desc["enq"], qro["srv_q_enq"]),
             }
-            if self.has_deadlines:
+            if self.has_attempts:
                 out["srv_q_attempt"] = jnp.where(
                     mask, desc["attempt"], qro["srv_q_attempt"]
                 )
@@ -656,7 +757,7 @@ class _Compiled:
             .at[desc["v"], slot]
             .set(desc["enq"], mode="drop"),
         }
-        if self.has_deadlines:
+        if self.has_attempts:
             out["srv_q_attempt"] = (
                 qro["srv_q_attempt"].at[desc["v"], slot].set(desc["attempt"], mode="drop")
             )
@@ -797,12 +898,49 @@ class _Compiled:
         return jnp.float32(edge.mean_s)
 
     # -- job delivery ------------------------------------------------------
+    def _edge_lost(self, u, t, loss_p, loss_start, loss_end):
+        """Bernoulli packet-loss verdict for one edge crossing at time t."""
+        lost = self._uslot(u, self.U_LOSS) < loss_p
+        return lost & (t >= loss_start) & (t < loss_end)
+
+    def _select_lost(self, state, lost, delivered):
+        """Vanish the delivery when the packet was lost (counted)."""
+        base = {**state, "net_lost": state["net_lost"] + lost.astype(jnp.int32)}
+        return jax.tree_util.tree_map(
+            lambda base_leaf, dlv_leaf: jnp.where(lost, base_leaf, dlv_leaf),
+            base,
+            delivered,
+        )
+
     def _deliver(self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params):
         """Deliver a job leaving some node at time t across ``edge``.
 
         ``u`` is the step's full uniform vector; the named slots
-        (U_ROUTE / U_LAT / U_SVC1) are read as needed.
+        (U_ROUTE / U_LAT / U_SVC1 / U_LOSS) are read as needed. A lossy
+        edge drops the crossing with probability ``edge.loss_p`` inside
+        its loss window — the job vanishes and ``net_lost`` counts it
+        (router per-target losses are handled at the router hop below,
+        after the choice is made).
         """
+        if edge.loss_p > 0.0:
+            # Validation confines loss to edges into sinks/servers, so
+            # exactly one Bernoulli is spent per crossing.
+            lost = self._edge_lost(
+                u,
+                t,
+                jnp.float32(edge.loss_p),
+                jnp.float32(edge.loss_start_s),
+                jnp.float32(edge.loss_end_s),
+            )
+            delivered = self._deliver_chosen(
+                state, t, created, u, dest, edge, params
+            )
+            return self._select_lost(state, lost, delivered)
+        return self._deliver_chosen(state, t, created, u, dest, edge, params)
+
+    def _deliver_chosen(
+        self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params
+    ):
         if dest.kind == LIMITER:
             return self._through_limiter(state, t, created, u, dest.index, params)
         if dest.kind == SINK:
@@ -813,7 +951,7 @@ class _Compiled:
                 latency = self._sample_edge(edge, self._uslot(u, self.U_LAT))
                 return self._into_transit(state, dest.index, t + latency, created)
             return self._arrive_server(
-                state, dest.index, t, created, 0, self._usvc(u, self.U_SVC1), params
+                state, dest.index, t, created, 0, u, params
             )
         # Router: one dynamic hop to a homogeneous target set. Edges INTO a
         # router are latency-free by construction (model.connect rejects
@@ -844,41 +982,66 @@ class _Compiled:
             )
         else:
             latency = jnp.where(chosen_mean > 0, chosen_mean, 0.0)
-        if target_kinds == {SINK}:
-            return self._deliver_sink(state, t + latency, created, indices[choice])
 
-        def to_server(state):
-            if lat_means.any():
-                return self._into_transit(
-                    state, indices[choice], t + latency, created
+        def finish(state):
+            if target_kinds == {SINK}:
+                return self._deliver_sink(
+                    state, t + latency, created, indices[choice]
                 )
-            return self._arrive_server(
-                state,
-                indices[choice],
-                t,
-                created,
-                0,
-                self._usvc(u, self.U_SVC1),
-                params,
+
+            def to_server(state):
+                if lat_means.any():
+                    return self._into_transit(
+                        state, indices[choice], t + latency, created
+                    )
+                return self._arrive_server(
+                    state,
+                    indices[choice],
+                    t,
+                    created,
+                    0,
+                    u,
+                    params,
+                )
+
+            if target_kinds == {SERVER}:
+                return to_server(state)
+            # Mixed server/sink targets ("done or continue" — probabilistic
+            # feedback loops): both destinations are computed predicated and
+            # selected by the chosen target's kind.
+            is_sink = jnp.asarray(
+                [ref.kind == SINK for ref in router.targets]
+            )[choice]
+            sank = self._deliver_sink(state, t + latency, created, indices[choice])
+            served = to_server(state)
+            return jax.tree_util.tree_map(
+                lambda sink_leaf, server_leaf: jnp.where(
+                    is_sink, sink_leaf, server_leaf
+                ),
+                sank,
+                served,
             )
 
-        if target_kinds == {SERVER}:
-            return to_server(state)
-        # Mixed server/sink targets ("done or continue" — probabilistic
-        # feedback loops): both destinations are computed predicated and
-        # selected by the chosen target's kind.
-        is_sink = jnp.asarray(
-            [ref.kind == SINK for ref in router.targets]
-        )[choice]
-        sank = self._deliver_sink(state, t + latency, created, indices[choice])
-        served = to_server(state)
-        return jax.tree_util.tree_map(
-            lambda sink_leaf, server_leaf: jnp.where(
-                is_sink, sink_leaf, server_leaf
-            ),
-            sank,
-            served,
+        loss_ps = np.asarray(
+            [e.loss_p for e in router.target_latencies], np.float32
         )
+        if loss_ps.any():
+            # Per-target packet loss: the router made its choice (and
+            # round-robin advanced), then the crossing is lost with the
+            # CHOSEN edge's probability inside its window.
+            lost = self._edge_lost(
+                u,
+                t,
+                jnp.asarray(loss_ps)[choice],
+                jnp.asarray(
+                    [e.loss_start_s for e in router.target_latencies], jnp.float32
+                )[choice],
+                jnp.asarray(
+                    [e.loss_end_s for e in router.target_latencies], jnp.float32
+                )[choice],
+            )
+            return self._select_lost(state, lost, finish(state))
+        return finish(state)
 
     def _through_limiter(self, state, t, created, u, l: int, params):
         """Token-bucket admission, inline (limiter edges are latency-free)."""
@@ -964,8 +1127,12 @@ class _Compiled:
             "sink_hist": state["sink_hist"] + hist_mask.astype(jnp.int32),
         }
 
-    def _into_transit(self, state, v, arrival_t, created):
-        """Park a job on a latency edge until its transit arrival fires."""
+    def _into_transit(self, state, v, arrival_t, created, attempt=0):
+        """Park a job on a latency edge until its transit arrival fires.
+
+        Backoff retries reuse the same registers (a retry IS a delayed
+        re-arrival); ``attempt`` rides along when the model has them.
+        """
         row = self._row(v, self.nV)
         free = jnp.isinf(state["tr_time"]) & row[:, None]
         has_free = jnp.any(free)
@@ -973,21 +1140,63 @@ class _Compiled:
         slot_mask = free & (
             jnp.arange(self.TR, dtype=jnp.int32)[None, :] == first_free[:, None]
         )
-        return {
+        out = {
             **state,
             "tr_time": jnp.where(slot_mask, arrival_t, state["tr_time"]),
             "tr_created": jnp.where(slot_mask, created, state["tr_created"]),
             "tr_dropped": state["tr_dropped"]
             + row.astype(jnp.int32) * (~has_free).astype(jnp.int32),
         }
+        if self.has_backoff:
+            out["tr_attempt"] = jnp.where(
+                slot_mask, jnp.int32(attempt) + jnp.int32(0), state["tr_attempt"]
+            )
+        return out
 
-    def _arrive_server(self, state, v, t, created, attempt, u_svc, params):
+    def _backoff_delay(self, u_jit, attempt, backoff, jitter):
+        """Exponential backoff with multiplicative +/- jitter/2 spread.
+
+        delay = backoff * 2^attempt * (1 + jitter * (u - 0.5)); the mean
+        is exactly backoff * 2^attempt, so analytic retry-storm oracles
+        stay closed-form whatever the jitter.
+        """
+        spread = 1.0 + jitter * (u_jit - jnp.float32(0.5))
+        return backoff * jnp.exp2(jnp.asarray(attempt, jnp.float32)) * spread
+
+    def _arrive_server(self, state, v, t, created, attempt, u, params):
+        """One job arriving at server ``v`` (which may be a traced index).
+
+        Beyond the base admit/enqueue/drop logic, this is where the
+        device-side chaos semantics live: stochastic fault windows
+        (drop-mode rejection with client retry/backoff, degrade-mode
+        capacity reduction + service inflation) and hedged service
+        starts. All of it is compile-time gated on the model's specs.
+        """
+        attempt = jnp.asarray(attempt, jnp.int32)
         row = self._row(v, self.nV)  # (nV,)
         row_i = row.astype(jnp.int32)
         row_f = row.astype(jnp.float32)
         slot_valid = jnp.asarray(self.slot_valid)  # (nV, C)
         done = state["srv_slot_done"]  # (nV, C)
         free = slot_valid & jnp.isinf(done) & row[:, None]
+        # Stochastic fault window state at t (constant registers drawn at
+        # init — one (nV, W) compare, no fault events).
+        if self.has_faults:
+            dark_v = self.faults.dark_vector(state, t)
+            if self.faults.has_degrade_cap:
+                # Capacity degradation: no NEW work starts while the
+                # window is open and >= limit jobs are already active
+                # (running jobs finish; the cap is on the ACTIVE count,
+                # not slot indices — completions free arbitrary slots).
+                limit = self._pick(
+                    self.faults.slot_limit(dark_v, self.srv_concurrency), row
+                )
+                busy_count = jnp.sum(
+                    (jnp.isfinite(done) & slot_valid & row[:, None]).astype(
+                        jnp.int32
+                    )
+                )
+                free = free & (busy_count < limit)
         has_free = jnp.any(free)
         # First free slot of the selected row (free is zero elsewhere).
         first_free_col = jnp.argmax(free, axis=1)  # (nV,)
@@ -995,7 +1204,27 @@ class _Compiled:
             free
             & (jnp.arange(self.C, dtype=jnp.int32)[None, :] == first_free_col[:, None])
         )
-        service = self._sample_service(u_svc, v, params)
+        service = self._sample_service(self._usvc(u, self.U_SVC1), v, params)
+        if self.has_faults and self.faults.has_degrade_lat:
+            # Service-latency inflation while degraded (host analogue:
+            # InjectLatency layering extra on a link).
+            infl = self._pick(self.faults.inflation_vector(dark_v), row)
+            service = service * infl
+        else:
+            infl = jnp.float32(1.0)
+        if self.has_hedge:
+            # Hedged request: a second attempt launches hedge_delay after
+            # the first; the slot is held for min(S1, delay + S2). The
+            # outcome is decided (and counted) at launch time.
+            hedge_delay = self._pick(jnp.asarray(self.srv_hedge), row)
+            service2 = (
+                self._sample_service(self._usvc(u, self.U_HED1), v, params) * infl
+            )
+            hedged = jnp.isfinite(hedge_delay) & (service > hedge_delay)
+            hedge_win = hedged & (hedge_delay + service2 < service)
+            service = jnp.where(
+                hedged, jnp.minimum(service, hedge_delay + service2), service
+            )
 
         # Brownout: a job arriving inside the outage window is lost
         # outright — no slot, no queue (host analogue: a PauseNode'd
@@ -1006,8 +1235,29 @@ class _Compiled:
             dark = (t >= out_start) & (t < out_end)
         else:
             dark = jnp.bool_(False)
-        admit_free = has_free & ~dark
-        slot_mask = slot_mask & ~dark
+        # Drop-mode stochastic fault: the arrival is rejected; with a
+        # retry budget + backoff it re-issues as a delayed re-arrival,
+        # else it is a terminal fault drop. Disjoint from the static
+        # brownout ledger: an arrival inside BOTH windows is only an
+        # outage drop (the loss-counter discipline below).
+        if self.has_faults:
+            flt_dark = (
+                jnp.any(dark_v & jnp.asarray(self.faults.drop_mode) & row) & ~dark
+            )
+        else:
+            flt_dark = jnp.bool_(False)
+        if self.has_fault_retries:
+            retry = (
+                flt_dark
+                & jnp.any(jnp.asarray(self.flt_can_retry) & row)
+                & (attempt < self._pick(jnp.asarray(self.srv_max_retries), row))
+            )
+        else:
+            retry = jnp.bool_(False)
+        fault_lost = flt_dark & ~retry
+        rejected = dark | flt_dark
+        admit_free = has_free & ~rejected
+        slot_mask = slot_mask & ~rejected
 
         q_len = self._pick(state["srv_q_len"], row)
         cap = self._pick(jnp.asarray(self.queue_cap), row)
@@ -1018,11 +1268,11 @@ class _Compiled:
             self.K,
         )
 
-        enq = (~dark) & (~has_free) & has_room
+        enq = (~rejected) & (~has_free) & has_room
         # Disjoint loss counters (like srv_timed_out): an in-window loss is
         # ONLY srv_outage_dropped — the host twin's server never sees those
         # arrivals, so its queue-full drop counter must not either.
-        drop = (~dark) & (~has_free) & (~has_room)
+        drop = (~rejected) & (~has_free) & (~has_room)
 
         measure = t >= jnp.float32(self.warmup)
         desc = {
@@ -1032,7 +1282,7 @@ class _Compiled:
             "created": created + jnp.float32(0.0),
             "enq": t + jnp.float32(0.0),
         }
-        if self.has_deadlines:
+        if self.has_attempts:
             desc["attempt"] = jnp.int32(attempt) + jnp.int32(0)
         out = {
             **state,
@@ -1051,9 +1301,45 @@ class _Compiled:
             "srv_outage_dropped": state["srv_outage_dropped"]
             + row_i * dark.astype(jnp.int32),
         }
-        if self.has_deadlines:
+        if self.has_attempts:
             out["srv_slot_attempt"] = jnp.where(
                 slot_mask, attempt, state["srv_slot_attempt"]
+            )
+        if self.has_faults:
+            out["srv_fault_dropped"] = (
+                state["srv_fault_dropped"] + row_i * fault_lost.astype(jnp.int32)
+            )
+        if self.has_hedge:
+            launched = admit_free & hedged
+            out["srv_hedged"] = state["srv_hedged"] + row_i * launched.astype(
+                jnp.int32
+            )
+            out["srv_hedge_wins"] = state["srv_hedge_wins"] + row_i * (
+                admit_free & hedge_win
+            ).astype(jnp.int32)
+        if self.has_fault_retries:
+            # Client retry: park the rejected job in this server's transit
+            # registers; it re-arrives after exponential backoff + jitter.
+            delay = self._backoff_delay(
+                self._uslot(u, self.U_JIT),
+                attempt,
+                self._pick(jnp.asarray(self.srv_backoff), row),
+                self._pick(jnp.asarray(self.srv_jitter), row),
+            )
+            parked = self._into_transit(
+                {
+                    **state,
+                    "srv_fault_retried": state["srv_fault_retried"] + row_i,
+                },
+                v,
+                t + delay,
+                created,
+                attempt + 1,
+            )
+            out = jax.tree_util.tree_map(
+                lambda park_leaf, out_leaf: jnp.where(retry, park_leaf, out_leaf),
+                parked,
+                out,
             )
         return out
 
@@ -1095,7 +1381,7 @@ class _Compiled:
         from_push = desc["pred"] & (desc["v"] == v) & (desc["slot"] == head)
         created = jnp.where(from_push, desc["created"], qro["srv_q_created"][v, head])
         enq = jnp.where(from_push, desc["enq"], qro["srv_q_enq"][v, head])
-        if self.has_deadlines:
+        if self.has_attempts:
             attempt = jnp.where(
                 from_push, desc["attempt"], qro["srv_q_attempt"][v, head]
             ).astype(jnp.int32)
@@ -1129,7 +1415,7 @@ class _Compiled:
         col_mask = jnp.arange(self.C, dtype=jnp.int32)[None, :] == k  # (1, C)
         slot_mask = row[:, None] & col_mask  # (nV, C)
         created = self._pick(state["srv_slot_created"], slot_mask)
-        if self.has_deadlines:
+        if self.has_attempts:
             attempt = self._pick(state["srv_slot_attempt"], slot_mask).astype(jnp.int32)
         else:
             attempt = jnp.int32(0)
@@ -1141,8 +1427,11 @@ class _Compiled:
         spec = self.model.servers[v]
         if spec.deadline_s is not None:
             # Deadline accounting: a completion whose sojourn blew the
-            # deadline is a timeout — retried (tail re-enqueue) while the
-            # budget lasts, else counted and discarded.
+            # deadline is a timeout — retried while the budget lasts,
+            # else counted and discarded. With retry_backoff_s the retry
+            # is a delayed re-arrival (exponential backoff + jitter)
+            # through the transit registers; without it, the legacy
+            # immediate tail re-enqueue.
             expired = (t - created) > jnp.float32(self.srv_deadline[v])
             can_retry = expired & (attempt < jnp.int32(self.srv_max_retries[v]))
             timed_out = expired & ~can_retry
@@ -1151,7 +1440,24 @@ class _Compiled:
                 "srv_timed_out": state["srv_timed_out"]
                 + row_i * timed_out.astype(jnp.int32),
             }
-            retried_state = self._enqueue_retry(state, v, t, created, attempt + 1)
+            if spec.retry_backoff_s is not None:
+                delay = self._backoff_delay(
+                    self._uslot(u, self.U_JIT),
+                    attempt,
+                    jnp.float32(spec.retry_backoff_s),
+                    jnp.float32(spec.retry_jitter),
+                )
+                retried_state = self._into_transit(
+                    {**state, "srv_retried": state["srv_retried"] + row_i},
+                    v,
+                    t + delay,
+                    created,
+                    attempt + 1,
+                )
+            else:
+                retried_state = self._enqueue_retry(
+                    state, v, t, created, attempt + 1
+                )
             forwarded_state = self._deliver(
                 state, t, created, u, spec.downstream, spec.latency, params
             )
@@ -1175,11 +1481,49 @@ class _Compiled:
         q_len = self._pick(state["srv_q_len"], row)
         slot_still_free = jnp.any(jnp.isinf(state["srv_slot_done"]) & slot_mask)
         has_queued = (q_len > 0) & slot_still_free
+        # Degrade-mode fault effects at pull time (v is static here, so
+        # unaffected servers skip all of this at trace time).
+        degraded_now = None
+        if self.has_faults and bool(self.faults.degrade[v]):
+            degraded_now = self.faults.dark_vector(state, t)[v]
+            if int(self.faults.cap_slots[v]) < spec.concurrency:
+                # Capacity reduction: the freed slot does not restart
+                # queued work while dark if >= limit jobs are still
+                # active (the cap is on the ACTIVE count, matching the
+                # admission gate in _arrive_server).
+                busy_now = jnp.sum(
+                    (
+                        jnp.isfinite(state["srv_slot_done"])
+                        & slot_valid
+                        & row[:, None]
+                    ).astype(jnp.int32)
+                )
+                has_queued = has_queued & ~(
+                    degraded_now
+                    & (busy_now >= jnp.int32(self.faults.cap_slots[v]))
+                )
         head = self._pick(state["srv_q_head"], row).astype(jnp.int32)
         queued_created, queued_enq, queued_attempt = self._read_queue_head(
             state, qro, v, head
         )
         service = self._sample_service(self._usvc(u, self.U_SVC2), v, params)
+        if degraded_now is not None and float(self.faults.lat_factor[v]) > 1.0:
+            service = service * jnp.where(
+                degraded_now, jnp.float32(self.faults.lat_factor[v]), 1.0
+            )
+        hedge_pull = None
+        if spec.hedge_delay_s is not None:
+            hedge_delay = jnp.float32(spec.hedge_delay_s)
+            service2 = self._sample_service(self._usvc(u, self.U_HED2), v, params)
+            if degraded_now is not None and float(self.faults.lat_factor[v]) > 1.0:
+                service2 = service2 * jnp.where(
+                    degraded_now, jnp.float32(self.faults.lat_factor[v]), 1.0
+                )
+            hedge_pull = service > hedge_delay
+            hedge_pull_win = hedge_pull & (hedge_delay + service2 < service)
+            service = jnp.where(
+                hedge_pull, jnp.minimum(service, hedge_delay + service2), service
+            )
         pull_mask = slot_mask & has_queued
         row_pull = row_i * has_queued.astype(jnp.int32)
         measure = t >= jnp.float32(self.warmup)
@@ -1202,10 +1546,18 @@ class _Compiled:
             "srv_wait_n": state["srv_wait_n"]
             + row_i * measured_pull.astype(jnp.int32),
         }
-        if self.has_deadlines:
+        if self.has_attempts:
             out["srv_slot_attempt"] = jnp.where(
                 pull_mask, queued_attempt, state["srv_slot_attempt"]
             )
+        if hedge_pull is not None:
+            launched = has_queued & hedge_pull
+            out["srv_hedged"] = state["srv_hedged"] + row_i * launched.astype(
+                jnp.int32
+            )
+            out["srv_hedge_wins"] = state["srv_hedge_wins"] + row_i * (
+                has_queued & hedge_pull_win
+            ).astype(jnp.int32)
         return out
 
     def _transit_arrive(self, v: int, state, qro, t, u, params):
@@ -1217,12 +1569,19 @@ class _Compiled:
             jnp.arange(self.TR, dtype=jnp.int32)[None, :] == k
         )
         created = self._pick(state["tr_created"], slot_mask)
+        if self.has_backoff:
+            # Backoff retries re-arrive through transit; their attempt
+            # number rides the register (fresh jobs parked by latency
+            # edges carry 0).
+            attempt = self._pick(state["tr_attempt"], slot_mask).astype(jnp.int32)
+        else:
+            attempt = 0
         state = {
             **state,
             "tr_time": jnp.where(slot_mask, INF, state["tr_time"]),
         }
         return self._arrive_server(
-            state, v, t, created, 0, self._usvc(u, self.U_SVC1), params
+            state, v, t, created, attempt, u, params
         )
 
     # -- the step ----------------------------------------------------------
@@ -1380,9 +1739,11 @@ def _default_max_events(model: EnsembleModel, sweeps) -> int:
     # Each job costs one source-fire plus, per server on its path, one
     # completion (plus one transit hop when edges carry latency); deadline
     # retries re-run service up to (1 + max_retries) times. 25% headroom
-    # covers Poisson variance and queue drain.
-    hops_per_server = 2 if any(
-        e.mean_s > 0 for e in _all_edges(model)
+    # covers Poisson variance and queue drain. Backoff retries travel
+    # through transit, so they cost the extra hop even on free edges.
+    hops_per_server = 2 if (
+        any(e.mean_s > 0 for e in _all_edges(model))
+        or any(s.retry_backoff_s is not None for s in model.servers)
     ) else 1
     retry_factor = 1 + max((s.max_retries for s in model.servers), default=0)
     events_per_job = 1 + hops_per_server * _max_server_chain(model) * retry_factor
@@ -1532,7 +1893,7 @@ def _run_ensemble_segmented(
             last_snapshot = _wall.perf_counter()
 
     reduced = reduce_jit(state)
-    events_total = int(reduced["events"])
+    events_total = int(np.asarray(reduced["events"]).sum(dtype=np.int64))
     wall = _wall.perf_counter() - start
     return reduced, events_total, wall
 
@@ -1692,7 +2053,10 @@ def run_ensemble(
         # Cross-replica reduction (psum over the mesh when sharded).
         reduced = {
             "truncated": jnp.sum((pending < horizon).astype(jnp.int32)),
-            "events": jnp.sum(final["events"]),
+            # Per-replica counters stay unsummed: a cross-replica int32
+            # sum wraps past 2^31 at headline scales (65k replicas x
+            # ~10^5 events); the host totals them in int64 instead.
+            "events": final["events"],
             "sink_count": jnp.sum(final["sink_count"], axis=0),
             "sink_sum": jnp.sum(final["sink_sum"], axis=0),
             "sink_sq": jnp.sum(final["sink_sq"], axis=0),
@@ -1712,6 +2076,19 @@ def run_ensemble(
         }
         if compiled.has_transit:
             reduced["tr_dropped"] = jnp.sum(final["tr_dropped"], axis=0)
+        if compiled.has_faults:
+            reduced["srv_fault_dropped"] = jnp.sum(
+                final["srv_fault_dropped"], axis=0
+            )
+            if compiled.has_fault_retries:
+                reduced["srv_fault_retried"] = jnp.sum(
+                    final["srv_fault_retried"], axis=0
+                )
+        if compiled.has_hedge:
+            reduced["srv_hedged"] = jnp.sum(final["srv_hedged"], axis=0)
+            reduced["srv_hedge_wins"] = jnp.sum(final["srv_hedge_wins"], axis=0)
+        if compiled.has_loss:
+            reduced["net_lost"] = jnp.sum(final["net_lost"])
         return reduced
 
     if checkpoint_every_s is not None and checkpoint_callback is None:
@@ -1740,7 +2117,9 @@ def run_ensemble(
         compiled_fn = run.lower(keys, params).compile()
         start = _wall.perf_counter()
         reduced = compiled_fn(keys, params)
-        events_total = int(reduced["events"])
+        # int64 on the host: the (R,) int32 fetch doubles as the
+        # completion barrier the timing depends on.
+        events_total = int(np.asarray(reduced["events"]).sum(dtype=np.int64))
         wall = _wall.perf_counter() - start
     else:
         reduced, events_total, wall = _run_ensemble_segmented(
@@ -1825,4 +2204,17 @@ def _build_result(
         limiter_admitted=[int(x) for x in host["lim_admitted"][:nL_real]],
         limiter_dropped=[int(x) for x in host["lim_dropped"][:nL_real]],
         truncated_replicas=truncated,
+        server_fault_dropped=_per_server(host, "srv_fault_dropped", nV_real),
+        server_fault_retried=_per_server(host, "srv_fault_retried", nV_real),
+        server_hedged=_per_server(host, "srv_hedged", nV_real),
+        server_hedge_wins=_per_server(host, "srv_hedge_wins", nV_real),
+        network_lost=int(host.get("net_lost", 0)),
     )
+
+
+def _per_server(host: dict, key: str, nV_real: int) -> list[int]:
+    """Per-server counter column, zeros when the model never tracked it
+    (the chain fast path and unfaulted scans omit the key)."""
+    if key not in host:
+        return [0] * nV_real
+    return [int(x) for x in host[key][:nV_real]]

@@ -1,0 +1,223 @@
+"""Device-side stochastic fault schedules for the TPU ensemble engine.
+
+The host fault layer (happysim_tpu/faults/) mutates live entities from
+heap events — inherently sequential, one timeline per run. This module
+is its vectorized counterpart: every replica draws its OWN fault
+timeline from its RNG lane at init, so a 65k-replica ensemble is a
+Monte-Carlo chaos rig — one launch answers "what is p99 under
+1%-probability correlated brownouts" instead of one hand-written
+schedule per run.
+
+Mechanics (all O(1) per event step, preserving the engine's contract):
+
+- A :class:`FaultTable` compiles the per-server :class:`~happysim_tpu.
+  tpu.model.FaultSpec` set into static arrays (rates, durations, modes,
+  degradation factors, participation flags) plus a compile-time window
+  budget ``W``.
+- :meth:`FaultTable.sample_state` draws, per replica, ``(nV, W)``
+  window start/end registers — inter-window gaps ~ Exp(rate) measured
+  from the previous window's end, durations ~ Exp(mean) or constant —
+  and, when the model declares :class:`~happysim_tpu.tpu.model.
+  CorrelatedOutages`, one shared ``(W_sh,)`` candidate sequence whose
+  windows fire by independent Bernoulli(trigger_p) draws. Deterministic
+  ``FaultSpec.windows`` pin the registers to the same constants in
+  every replica (the cross-validation hook against the host twins).
+- :meth:`FaultTable.dark_vector` answers "which servers are inside a
+  fault window at time t" as one ``(nV, W)`` elementwise compare — the
+  state never changes after init, so no fault events enter the
+  next-event candidate vector and the step stays one-event-per-scan.
+
+The schedule is a bounded sample: windows beyond ``max_windows`` per
+replica are never drawn. Size ``max_windows`` above
+``rate * horizon_s`` (plus a few sigma) or late sim-time runs fault-free
+and the measured duty cycle falls short of :func:`duty_cycle`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# fold_in salt separating the fault-schedule stream from the per-event /
+# per-chunk streams (both key on small monotone counters) and from the
+# initial-gap draw (which uses the replica key directly).
+FAULT_KEY_SALT = 0x7A057A57
+
+
+def duty_cycle(rate: float, mean_duration_s: float) -> float:
+    """Stationary fraction of time inside a fault window.
+
+    With gaps ~ Exp(rate) between windows and mean window length d, the
+    renewal cycle is 1/rate + d, of which d is dark.
+    """
+    if rate <= 0.0 or mean_duration_s <= 0.0:
+        return 0.0
+    return mean_duration_s / (1.0 / rate + mean_duration_s)
+
+
+class FaultTable:
+    """Static (compile-time) view of a model's stochastic fault config.
+
+    Built once per :class:`~happysim_tpu.tpu.engine._Compiled`; every
+    array is a host numpy constant baked into the traced program. The
+    only per-replica data are the window registers from
+    :meth:`sample_state`.
+    """
+
+    def __init__(self, model):
+        servers = model.servers
+        self.nV = max(len(servers), 1)
+        specs = [s.fault for s in servers]
+        self.has_faults = any(spec is not None for spec in specs)
+        self.shared = getattr(model, "correlated_faults", None)
+        self.has_shared = self.shared is not None and any(
+            spec is not None and spec.correlated for spec in specs
+        )
+
+        # Window budget: widest requirement across servers (deterministic
+        # schedules need exactly their own length).
+        widths = [1]
+        for spec in specs:
+            if spec is None:
+                continue
+            if spec.windows is not None:
+                widths.append(len(spec.windows))
+            elif spec.rate > 0.0:
+                widths.append(spec.max_windows)
+        self.W = max(widths)
+        self.W_sh = self.shared.max_windows if self.has_shared else 0
+
+        nV, W = self.nV, self.W
+        self.faulted = np.zeros((nV,), np.bool_)
+        self.stochastic = np.zeros((nV,), np.bool_)  # needs RNG sampling
+        self.rate = np.ones((nV,), np.float32)  # dummy 1.0 avoids div-by-0
+        self.mean_dur = np.ones((nV,), np.float32)
+        self.dur_const = np.zeros((nV,), np.bool_)
+        self.det_start = np.full((nV, W), np.inf, np.float32)
+        self.det_end = np.full((nV, W), np.inf, np.float32)
+        # Effects. drop_mode: in-window arrivals are rejected; otherwise
+        # (degrade) the window scales concurrency and inflates service.
+        self.drop_mode = np.zeros((nV,), np.bool_)
+        self.cap_slots = np.zeros((nV,), np.int32)
+        self.lat_factor = np.ones((nV,), np.float32)
+        self.participates = np.zeros((nV,), np.bool_)
+
+        for v, spec in enumerate(specs):
+            if spec is None:
+                continue
+            self.faulted[v] = True
+            self.drop_mode[v] = spec.mode == "outage"
+            self.lat_factor[v] = spec.latency_factor
+            # Usable slots while degraded (floor, but never "stuck at 0
+            # forever": factor 0 means no NEW work starts in-window).
+            self.cap_slots[v] = int(
+                np.floor(servers[v].concurrency * spec.capacity_factor)
+            )
+            self.participates[v] = spec.correlated
+            if spec.windows is not None:
+                for w, (start, end) in enumerate(spec.windows):
+                    self.det_start[v, w] = start
+                    self.det_end[v, w] = end
+            elif spec.rate > 0.0:
+                self.stochastic[v] = True
+                self.rate[v] = spec.rate
+                self.mean_dur[v] = spec.mean_duration_s
+                self.dur_const[v] = spec.duration == "constant"
+        self.degrade = self.faulted & ~self.drop_mode
+        self.has_degrade_cap = bool(
+            np.any(self.degrade & (self.cap_slots < np.asarray(
+                [s.concurrency for s in servers] or [1], np.int32)))
+        )
+        self.has_degrade_lat = bool(np.any(self.degrade & (self.lat_factor > 1.0)))
+
+    # -- per-replica sampling (init time) -----------------------------------
+    def sample_state(self, key):
+        """Draw one replica's window registers from its RNG lane.
+
+        Returns the state columns the engine carries: ``flt_start`` /
+        ``flt_end`` of shape (nV, W) (+inf rows for unfaulted servers)
+        and, with a correlated schedule, ``flt_sh_start`` /
+        ``flt_sh_end`` of shape (W_sh,) holding only the candidates the
+        Bernoulli trigger fired.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        fkey = jax.random.fold_in(key, FAULT_KEY_SALT)
+        state = {}
+
+        starts = jnp.asarray(self.det_start)
+        ends = jnp.asarray(self.det_end)
+        if bool(self.stochastic.any()):
+            u = jax.random.uniform(
+                jax.random.fold_in(fkey, 0),
+                (self.nV, self.W, 2),
+                minval=1e-12,
+                maxval=1.0,
+            )
+            gaps = -jnp.log(u[..., 0]) / jnp.asarray(self.rate)[:, None]
+            durs = jnp.where(
+                jnp.asarray(self.dur_const)[:, None],
+                jnp.asarray(self.mean_dur)[:, None],
+                -jnp.log(u[..., 1]) * jnp.asarray(self.mean_dur)[:, None],
+            )
+            # start_k = sum of gaps through k + durations BEFORE k.
+            sampled_start = jnp.cumsum(gaps, axis=1) + (
+                jnp.cumsum(durs, axis=1) - durs
+            )
+            sampled_end = sampled_start + durs
+            stoch = jnp.asarray(self.stochastic)[:, None]
+            starts = jnp.where(stoch, sampled_start, starts)
+            ends = jnp.where(stoch, sampled_end, ends)
+        state["flt_start"] = starts
+        state["flt_end"] = ends
+
+        if self.has_shared:
+            shared = self.shared
+            u = jax.random.uniform(
+                jax.random.fold_in(fkey, 1),
+                (self.W_sh, 3),
+                minval=1e-12,
+                maxval=1.0,
+            )
+            gaps = -jnp.log(u[:, 0]) / jnp.float32(shared.rate)
+            durs = -jnp.log(u[:, 1]) * jnp.float32(shared.mean_duration_s)
+            start = jnp.cumsum(gaps) + (jnp.cumsum(durs) - durs)
+            end = start + durs
+            # Candidates keep their slot on the timeline whether or not
+            # they fire — trigger_p thins the visible windows, exactly a
+            # Bernoulli over independent candidates.
+            fired = u[:, 2] < jnp.float32(shared.trigger_p)
+            state["flt_sh_start"] = jnp.where(fired, start, jnp.float32(jnp.inf))
+            state["flt_sh_end"] = jnp.where(fired, end, jnp.float32(jnp.inf))
+        return state
+
+    # -- step-time queries ---------------------------------------------------
+    def dark_vector(self, state, t):
+        """(nV,) bool: which servers are inside a fault window at t."""
+        import jax.numpy as jnp
+
+        dark = jnp.any(
+            (t >= state["flt_start"]) & (t < state["flt_end"]), axis=1
+        )
+        if self.has_shared:
+            shared_dark = jnp.any(
+                (t >= state["flt_sh_start"]) & (t < state["flt_sh_end"])
+            )
+            dark = dark | (jnp.asarray(self.participates) & shared_dark)
+        return dark
+
+    def slot_limit(self, dark_v, concurrency):
+        """(nV,) int32 usable-slot count given the dark vector."""
+        import jax.numpy as jnp
+
+        degraded = dark_v & jnp.asarray(self.degrade)
+        return jnp.where(
+            degraded, jnp.asarray(self.cap_slots), jnp.asarray(concurrency)
+        )
+
+    def inflation_vector(self, dark_v):
+        """(nV,) f32 service-time multiplier given the dark vector."""
+        import jax.numpy as jnp
+
+        degraded = dark_v & jnp.asarray(self.degrade)
+        return jnp.where(degraded, jnp.asarray(self.lat_factor), jnp.float32(1.0))

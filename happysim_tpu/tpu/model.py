@@ -85,10 +85,123 @@ class RateProfile:
 
 @dataclass(frozen=True)
 class EdgeLatency:
-    """Link latency applied while a job crosses an edge."""
+    """Link latency applied while a job crosses an edge.
+
+    ``loss_p`` is a per-crossing Bernoulli packet-loss probability,
+    active inside [``loss_start_s``, ``loss_end_s``) — the compiled twin
+    of the host ``InjectPacketLoss`` fault (faults/network_faults.py).
+    Lost jobs vanish (counted in ``EnsembleResult.network_lost``).
+    """
 
     mean_s: float = 0.0
     kind: str = "constant"  # or "exponential"
+    loss_p: float = 0.0
+    loss_start_s: float = 0.0
+    loss_end_s: float = float("inf")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Per-replica stochastic fault schedule for one server.
+
+    Each replica draws its OWN outage timeline from its RNG lane at
+    init: inter-window gaps ~ Exp(``rate``) (measured from the end of
+    the previous window), durations ~ Exp(``mean_duration_s``) or
+    constant. The stationary dark fraction is
+    ``mean_duration_s / (1/rate + mean_duration_s)``
+    (:func:`happysim_tpu.tpu.faults.duty_cycle`).
+
+    ``mode`` selects the in-window effect:
+      - ``"outage"``: arrivals are dropped (client retries may re-issue
+        them — see ``ServerSpec.retry_backoff_s``),
+      - ``"degrade"``: the server stays up but degraded —
+        ``capacity_factor`` scales the usable concurrency slots and
+        ``latency_factor`` inflates every service draw started
+        in-window (host twins: ReduceCapacity / InjectLatency).
+
+    ``windows`` pins an explicit deterministic schedule (identical in
+    every replica) instead of stochastic sampling — the cross-validation
+    hook against the host fault twins.
+
+    ``correlated=True`` additionally subscribes the server to the
+    model-level :class:`CorrelatedOutages` trigger schedule.
+
+    ``max_windows`` bounds the compiled schedule length; keep it above
+    ``rate * horizon_s`` or late windows are silently never drawn.
+    """
+
+    rate: float = 0.0
+    mean_duration_s: float = 0.0
+    duration: str = "exponential"  # or "constant"
+    mode: str = "outage"  # or "degrade"
+    capacity_factor: float = 1.0
+    latency_factor: float = 1.0
+    correlated: bool = False
+    max_windows: int = 4
+    windows: Optional[tuple] = None  # ((start, end), ...) deterministic
+
+    def validate(self, label: str) -> None:
+        if self.mode not in ("outage", "degrade"):
+            raise ValueError(f"{label}: fault mode {self.mode!r} not in "
+                             "('outage', 'degrade')")
+        if self.duration not in ("exponential", "constant"):
+            raise ValueError(f"{label}: fault duration {self.duration!r} "
+                             "not in ('exponential', 'constant')")
+        if self.windows is not None:
+            for w in self.windows:
+                start, end = w
+                if start < 0.0 or end <= start:
+                    raise ValueError(
+                        f"{label}: fault window [{start}, {end}) is empty "
+                        "or negative"
+                    )
+        elif not self.correlated:
+            if self.rate <= 0.0:
+                raise ValueError(f"{label}: stochastic fault needs rate > 0 "
+                                 "(or explicit windows=..., or correlated=True)")
+            if self.mean_duration_s <= 0.0:
+                raise ValueError(f"{label}: fault needs mean_duration_s > 0")
+        if self.max_windows < 1:
+            raise ValueError(f"{label}: max_windows must be >= 1")
+        if not 0.0 <= self.capacity_factor <= 1.0:
+            raise ValueError(f"{label}: capacity_factor must be in [0, 1]")
+        if self.latency_factor < 1.0:
+            raise ValueError(f"{label}: latency_factor must be >= 1")
+        if self.mode == "outage" and (
+            self.capacity_factor != 1.0 or self.latency_factor != 1.0
+        ):
+            raise ValueError(
+                f"{label}: capacity_factor/latency_factor require "
+                "mode='degrade' (an outage drops arrivals outright)"
+            )
+
+
+@dataclass(frozen=True)
+class CorrelatedOutages:
+    """Model-level correlated-failure schedule (shared Bernoulli trigger).
+
+    Each replica draws ONE shared sequence of candidate windows (gaps ~
+    Exp(``rate``), durations ~ Exp(``mean_duration_s``)); every candidate
+    independently fires with probability ``trigger_p``. While a fired
+    window is open, EVERY server whose :class:`FaultSpec` has
+    ``correlated=True`` is simultaneously dark — the "1%-probability
+    correlated brownout" scenario, one replica = one Monte-Carlo draw.
+    """
+
+    rate: float
+    mean_duration_s: float
+    trigger_p: float = 1.0
+    max_windows: int = 4
+
+    def validate(self) -> None:
+        if self.rate <= 0.0:
+            raise ValueError("correlated_outages: rate must be > 0")
+        if self.mean_duration_s <= 0.0:
+            raise ValueError("correlated_outages: mean_duration_s must be > 0")
+        if not 0.0 < self.trigger_p <= 1.0:
+            raise ValueError("correlated_outages: trigger_p must be in (0, 1]")
+        if self.max_windows < 1:
+            raise ValueError("correlated_outages: max_windows must be >= 1")
 
 
 @dataclass
@@ -124,6 +237,20 @@ class ServerSpec:
     # completes, new deliveries are lost; faults/node_faults.py).
     outage_start_s: Optional[float] = None
     outage_end_s: Optional[float] = None
+    # Stochastic (or pinned) fault schedule — see FaultSpec.
+    fault: Optional[FaultSpec] = None
+    # Client-side resilience. retry_backoff_s turns every retry (deadline
+    # expiry AND fault-window rejection) into a delayed re-arrival after
+    # backoff * 2^attempt, spread by +/- retry_jitter/2 multiplicatively;
+    # None keeps the legacy immediate tail re-enqueue for deadline
+    # retries and makes fault rejections terminal drops.
+    retry_backoff_s: Optional[float] = None
+    retry_jitter: float = 0.0
+    # Hedged requests: if the primary attempt hasn't completed after
+    # hedge_delay_s, a second attempt launches and the FIRST completion
+    # wins (both run against this server's service distribution; the
+    # slot is held for min(S1, delay + S2)).
+    hedge_delay_s: Optional[float] = None
 
 
 @dataclass
@@ -196,6 +323,8 @@ class EnsembleModel:
         self.limiters: list[LimiterSpec] = []
         self.sinks: list[SinkSpec] = []
         self.remotes: list[RemoteSpec] = []
+        # Shared Bernoulli-trigger schedule for correlated=True faults.
+        self.correlated_faults: Optional[CorrelatedOutages] = None
 
     # -- builders ----------------------------------------------------------
     def source(
@@ -262,6 +391,10 @@ class EnsembleModel:
         service_scv: float = 2.0,
         pareto_alpha: float = 2.5,
         outage: Optional[tuple] = None,
+        fault: Optional[FaultSpec] = None,
+        retry_backoff_s: Optional[float] = None,
+        retry_jitter: float = 0.0,
+        hedge_delay_s: Optional[float] = None,
     ) -> NodeRef:
         if service not in SERVICE_KINDS:
             raise ValueError(f"service kind {service!r} not in {SERVICE_KINDS}")
@@ -273,8 +406,30 @@ class EnsembleModel:
             raise ValueError("deadline_s must be > 0")
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
-        if max_retries > 0 and deadline_s is None:
-            raise ValueError("max_retries requires a deadline_s")
+        label = f"server[{len(self.servers)}]"
+        fault_can_retry = (
+            fault is not None
+            and fault.mode == "outage"
+            and retry_backoff_s is not None
+        )
+        if max_retries > 0 and deadline_s is None and not fault_can_retry:
+            raise ValueError(
+                "max_retries requires a deadline_s (timeout retries) or an "
+                "outage-mode fault plus retry_backoff_s (rejection retries)"
+            )
+        if fault is not None:
+            fault.validate(label)
+        if retry_backoff_s is not None:
+            if retry_backoff_s <= 0:
+                raise ValueError("retry_backoff_s must be > 0")
+            if max_retries < 1:
+                raise ValueError("retry_backoff_s requires max_retries >= 1")
+        if not 0.0 <= retry_jitter <= 1.0:
+            raise ValueError("retry_jitter must be in [0, 1]")
+        if retry_jitter > 0.0 and retry_backoff_s is None:
+            raise ValueError("retry_jitter requires retry_backoff_s")
+        if hedge_delay_s is not None and hedge_delay_s <= 0:
+            raise ValueError("hedge_delay_s must be > 0")
         if service == "erlang" and service_k not in (2, 3):
             raise ValueError("erlang supports service_k in (2, 3)")
         if service in ("hyperexp", "lognormal") and service_scv <= (
@@ -304,9 +459,36 @@ class EnsembleModel:
                 pareto_alpha=pareto_alpha,
                 outage_start_s=outage[0] if outage is not None else None,
                 outage_end_s=outage[1] if outage is not None else None,
+                fault=fault,
+                retry_backoff_s=retry_backoff_s,
+                retry_jitter=retry_jitter,
+                hedge_delay_s=hedge_delay_s,
             )
         )
         return NodeRef(SERVER, len(self.servers) - 1)
+
+    def correlated_outages(
+        self,
+        rate: float,
+        mean_duration_s: float,
+        trigger_p: float = 1.0,
+        max_windows: int = 4,
+    ) -> CorrelatedOutages:
+        """Install the shared Bernoulli-trigger outage schedule.
+
+        Servers opt in with ``fault=FaultSpec(correlated=True, ...)``;
+        during a fired window every subscribed server applies its own
+        fault ``mode`` simultaneously.
+        """
+        spec = CorrelatedOutages(
+            rate=rate,
+            mean_duration_s=mean_duration_s,
+            trigger_p=trigger_p,
+            max_windows=max_windows,
+        )
+        spec.validate()
+        self.correlated_faults = spec
+        return spec
 
     def router(self, policy: str = "random", targets: Sequence[NodeRef] = ()) -> NodeRef:
         if policy not in ROUTER_POLICIES:
@@ -354,6 +536,8 @@ class EnsembleModel:
         downstream: NodeRef,
         latency_s: float = 0.0,
         latency_kind: str = "constant",
+        loss_p: float = 0.0,
+        loss_window: Optional[tuple] = None,
     ) -> None:
         """Wire ``origin`` -> ``downstream``; the edge may carry latency.
 
@@ -361,27 +545,47 @@ class EnsembleModel:
         ``latency_s``). Limiter admission is instantaneous, so edges INTO
         a limiter must be latency-free (put the latency on the limiter's
         own downstream edge instead).
+
+        ``loss_p`` drops each crossing with that probability while
+        ``loss_window`` (a ``(start_s, end_s)`` pair; default: the whole
+        run) is open — the compiled InjectPacketLoss twin. Like latency,
+        loss belongs on router/limiter DOWNSTREAM edges, never on edges
+        into them (one lossy edge per delivery hop, so each crossing
+        spends exactly one Bernoulli draw).
         """
         if latency_s < 0:
             raise ValueError("latency_s must be >= 0")
         if latency_kind not in LATENCY_KINDS:
             raise ValueError(f"latency kind {latency_kind!r} not in {LATENCY_KINDS}")
-        if downstream.kind == LIMITER and latency_s > 0:
+        if not 0.0 <= loss_p < 1.0:
+            raise ValueError("loss_p must be in [0, 1)")
+        if loss_window is not None:
+            if loss_p == 0.0:
+                raise ValueError("loss_window requires loss_p > 0")
+            if loss_window[1] <= loss_window[0]:
+                raise ValueError(f"loss_window is empty: {loss_window}")
+        if downstream.kind == LIMITER and (latency_s > 0 or loss_p > 0):
             raise ValueError(
-                "edges into a limiter must be latency-free; put the latency "
-                "on the limiter's downstream edge"
+                "edges into a limiter must be latency- and loss-free; put "
+                "the latency/loss on the limiter's downstream edge"
             )
-        if downstream.kind == ROUTER and latency_s > 0:
+        if downstream.kind == ROUTER and (latency_s > 0 or loss_p > 0):
             raise ValueError(
-                "edges into a router must be latency-free; put the latency "
-                "on the router's per-target edges instead"
+                "edges into a router must be latency- and loss-free; put "
+                "the latency/loss on the router's per-target edges instead"
             )
-        if downstream.kind == REMOTE and latency_s > 0:
+        if downstream.kind == REMOTE and (latency_s > 0 or loss_p > 0):
             raise ValueError(
-                "edges into a remote are latency-free; the remote itself "
-                "carries the cross-partition latency"
+                "edges into a remote are latency- and loss-free; the remote "
+                "itself carries the cross-partition latency"
             )
-        edge = EdgeLatency(mean_s=latency_s, kind=latency_kind)
+        edge = EdgeLatency(
+            mean_s=latency_s,
+            kind=latency_kind,
+            loss_p=loss_p,
+            loss_start_s=loss_window[0] if loss_window else 0.0,
+            loss_end_s=loss_window[1] if loss_window else float("inf"),
+        )
         if origin.kind == SOURCE:
             self.sources[origin.index].downstream = downstream
             self.sources[origin.index].latency = edge
@@ -427,9 +631,18 @@ class EnsembleModel:
                 source.downstream.index
             ].targets:
                 raise ValueError(f"router targeted by source[{i}] has no targets")
+        if self.correlated_faults is not None:
+            self.correlated_faults.validate()
         for i, server in enumerate(self.servers):
             if server.downstream is None:
                 raise ValueError(f"server[{i}] has no downstream")
+            if server.fault is not None:
+                server.fault.validate(f"server[{i}]")
+                if server.fault.correlated and self.correlated_faults is None:
+                    raise ValueError(
+                        f"server[{i}]: fault.correlated=True but the model "
+                        "has no correlated_outages() schedule"
+                    )
             if server.downstream.kind == ROUTER and not self.routers[
                 server.downstream.index
             ].targets:

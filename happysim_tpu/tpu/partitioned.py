@@ -133,6 +133,14 @@ class _PartitionCompiled(_Compiled):
     def __init__(self, model: EnsembleModel, outbox_capacity: int):
         self.OB = outbox_capacity
         super().__init__(model, allow_remote=True)
+        for i, router in enumerate(model.routers):
+            if any(t.kind == REMOTE for t in router.targets) and any(
+                e.loss_p > 0.0 for e in router.target_latencies
+            ):
+                raise ValueError(
+                    f"router[{i}]: per-target packet loss on a sink/remote "
+                    "mixed router is not supported in partitioned mode"
+                )
         # Remote arrivals land in the transit registers, so they (and the
         # transit-arrival branch) are always on in partitioned mode.
         self.has_transit = True
@@ -379,7 +387,9 @@ def _run_partitioned_segmented(
                 )
             )
 
-    events_total = int(jnp.sum(state["events"]))
+    # Host int64: a device-side int32 sum over per-replica counters
+    # wraps past 2^31 at headline scales (same fix as the scan path).
+    events_total = int(np.asarray(state["events"]).sum(dtype=np.int64))
     wall = _wall.perf_counter() - start
     return state, events_total, wall
 
@@ -568,7 +578,8 @@ def run_partitioned(
         compiled_fn = run.lower(keys, params).compile()
         start = _wall.perf_counter()
         final = compiled_fn(keys, params)
-        events_total = int(jnp.sum(final["events"]))
+        # Host int64 total; the fetch is also the completion barrier.
+        events_total = int(np.asarray(final["events"]).sum(dtype=np.int64))
         wall = _wall.perf_counter() - start
     else:
         final, events_total, wall = _run_partitioned_segmented(

@@ -86,17 +86,71 @@ class TestPlan:
         assert chain_plan(model) is None
 
     def test_deadline_outage_latency_disqualify(self):
-        for kwargs, connect_latency in [
-            (dict(deadline_s=1.0), 0.0),
-            (dict(outage=(1.0, 2.0)), 0.0),
-            (dict(), 0.01),
+        for kwargs, connect_latency, latency_kind in [
+            (dict(deadline_s=1.0), 0.0, "constant"),
+            (dict(outage=(1.0, 2.0)), 0.0, "constant"),
+            # Exponential sink-edge latency reorders the stream.
+            (dict(), 0.01, "exponential"),
         ]:
             model = EnsembleModel(horizon_s=10.0)
             source = model.source(rate=5.0)
             server = model.server(service_mean=0.05, **kwargs)
             model.connect(source, server)
-            model.connect(server, model.sink(), latency_s=connect_latency)
+            model.connect(
+                server, model.sink(), latency_s=connect_latency,
+                latency_kind=latency_kind,
+            )
             assert chain_plan(model) is None, (kwargs, connect_latency)
+
+    def test_constant_sink_edge_latency_qualifies(self):
+        """A constant server->sink latency is a pure shift of the
+        departure stream — _walk_chain carries it as exit_lat."""
+        model = EnsembleModel(horizon_s=10.0)
+        source = model.source(rate=5.0)
+        server = model.server(service_mean=0.05)
+        model.connect(source, server)
+        model.connect(server, model.sink(), latency_s=0.01)
+        assert chain_plan(model) == [0]
+
+    def test_fault_backoff_hedge_loss_disqualify(self):
+        """Chaos semantics must push the model onto the event scan."""
+        from happysim_tpu.tpu.chain import fast_plan
+        from happysim_tpu.tpu.model import FaultSpec
+
+        cases = [
+            dict(fault=FaultSpec(windows=((1.0, 2.0),))),
+            dict(fault=FaultSpec(rate=0.1, mean_duration_s=1.0)),
+            dict(
+                fault=FaultSpec(rate=0.1, mean_duration_s=1.0),
+                retry_backoff_s=0.1, max_retries=2,
+            ),
+            dict(deadline_s=1.0, retry_backoff_s=0.1, max_retries=1),
+            dict(hedge_delay_s=0.2),
+        ]
+        for kwargs in cases:
+            model = EnsembleModel(horizon_s=10.0)
+            source = model.source(rate=5.0)
+            server = model.server(service_mean=0.05, **kwargs)
+            model.connect(source, server)
+            model.connect(server, model.sink())
+            assert chain_plan(model) is None, kwargs
+            assert fast_plan(model) is None, kwargs
+        # Lossy edges and correlated schedules also decline.
+        model = EnsembleModel(horizon_s=10.0)
+        source = model.source(rate=5.0)
+        server = model.server(service_mean=0.05)
+        model.connect(source, server, loss_p=0.1)
+        model.connect(server, model.sink())
+        assert fast_plan(model) is None
+        model = EnsembleModel(horizon_s=10.0)
+        model.correlated_outages(rate=0.1, mean_duration_s=1.0)
+        source = model.source(rate=5.0)
+        server = model.server(
+            service_mean=0.05, fault=FaultSpec(correlated=True)
+        )
+        model.connect(source, server)
+        model.connect(server, model.sink())
+        assert fast_plan(model) is None
 
     def test_profiled_source_disqualifies(self):
         model = EnsembleModel(horizon_s=10.0)
@@ -123,6 +177,10 @@ class TestAgreement:
         # Identical hist binning => identical quantile grid.
         assert fast.sink_p50_s[0] == slow.sink_p50_s[0]
 
+    # Ten XLA compiles (5 families x both paths): the slowest agreement
+    # sweep in the file — tier-2 only. test_mm1_matches_loop_and_analytic
+    # and test_tandem_stages_match_loop anchor the fast suite.
+    @pytest.mark.slow
     @pytest.mark.parametrize("service", ["constant", "erlang", "hyperexp",
                                          "lognormal", "pareto"])
     def test_service_families_match_loop(self, service):
@@ -187,7 +245,12 @@ class TestFanout:
         plan = fast_plan(fanout(n_servers=3, sink_branch=True))
         assert plan is not None
         assert plan["policy"] == "random"
-        assert sorted(map(tuple, plan["branches"])) == [(), (0,), (1,), (2,)]
+        # Branches are {"stages": [(server, entry_lat)], "exit_lat": ...}
+        # dicts; the sink pass-through branch has no stages.
+        assert sorted(
+            tuple(v for v, _ in branch["stages"]) for branch in plan["branches"]
+        ) == [(), (0,), (1,), (2,)]
+        assert all(branch["exit_lat"] == 0.0 for branch in plan["branches"])
 
     def test_least_outstanding_falls_back(self):
         from happysim_tpu.tpu.chain import fast_plan
@@ -196,6 +259,9 @@ class TestFanout:
         model.routers[0].policy = "least_outstanding"
         assert fast_plan(model) is None
 
+    # Four compiles (2 policies x both paths); the certificate and
+    # sink-branch tests keep fan-out covered in the fast suite.
+    @pytest.mark.slow
     @pytest.mark.parametrize("policy", ["random", "round_robin"])
     def test_fanout_matches_loop(self, policy):
         model = fanout(policy=policy)

@@ -1,0 +1,464 @@
+"""Device-side chaos engineering vs the host fault twins.
+
+The acceptance contract for the TPU fault subsystem (tpu/faults.py):
+
+1. On an IDENTICAL deterministic schedule, the TPU path and the host
+   event loop agree exactly on per-replica drop counts and within 1% on
+   mean latency (outage windows ≙ PauseNode; service inflation ≙ a
+   windowed InjectLatency-style distribution).
+2. With stochastic faults across >= 4096 replicas, the ensemble drop
+   count matches the configured rate/duration analytically within 3
+   sigma (exponential gaps + exponential durations form a two-state
+   Markov chain with closed-form occupation-time moments).
+3. The chain fast path provably declines every faulted model (see also
+   test_tpu_chain.TestPlan::test_fault_backoff_hedge_loss_disqualify)
+   — the scan's accounting, which the closed form cannot produce, shows
+   up in the results.
+4. Client resilience semantics (retry/backoff budgets, hedging, packet
+   loss) obey their analytic contracts.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    FaultSchedule,
+    Instant,
+    PauseNode,
+    Server,
+    Simulation,
+    Sink,
+    Source,
+)
+from happysim_tpu.core.entity import Entity
+from happysim_tpu.core.temporal import Duration
+from happysim_tpu.distributions.latency_distribution import LatencyDistribution
+from happysim_tpu.tpu.engine import run_ensemble
+from happysim_tpu.tpu.faults import duty_cycle
+from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+
+    from happysim_tpu.tpu.mesh import replica_mesh
+
+    return replica_mesh(jax.devices("cpu")[:8])
+
+
+class Relay(Entity):
+    """Pass-through hop (the PauseNode target)."""
+
+    def __init__(self, name, downstream):
+        super().__init__(name)
+        self.downstream = downstream
+
+    def handle_event(self, event):
+        return [self.forward(event, self.downstream)]
+
+    def downstream_entities(self):
+        return [self.downstream]
+
+
+class WindowedInflation(LatencyDistribution):
+    """Constant service time, multiplied by ``factor`` inside [start, end)
+    — the host twin of FaultSpec(mode="degrade", latency_factor=...)."""
+
+    def __init__(self, base_s: float, factor: float, start: float, end: float):
+        self.base_s = base_s
+        self.factor = factor
+        self.window = (start, end)
+
+    def get_latency(self, time: Instant) -> Duration:
+        t = time.to_seconds()
+        scale = self.factor if self.window[0] <= t < self.window[1] else 1.0
+        return Duration.from_seconds(self.base_s * scale)
+
+    def mean(self) -> Duration:
+        return Duration.from_seconds(self.base_s)
+
+
+class TestDeterministicCrossValidation:
+    """Pinned FaultSpec.windows vs the host loop, same schedule."""
+
+    RATE = 10.0
+    HORIZON = 100.0
+    # Window edges sit mid-gap between the 0.1 s-spaced deterministic
+    # arrivals, so float32 time accumulation on the device can never
+    # flip an arrival across a boundary the float64 host loop kept.
+    WINDOW = (20.05, 40.05)
+
+    def test_outage_drops_match_host_pause_exactly(self, mesh):
+        sink = Sink("sink")
+        server = Server(
+            "srv", service_time=ConstantLatency(0.05), downstream=sink,
+            queue_capacity=256,
+        )
+        relay = Relay("relay", server)
+        source = Source.constant(rate=self.RATE, target=relay, stop_after=self.HORIZON)
+        faults = FaultSchedule()
+        faults.add(PauseNode("relay", start=self.WINDOW[0], end=self.WINDOW[1]))
+        sim = Simulation(
+            sources=[source],
+            entities=[relay, server, sink],
+            fault_schedule=faults,
+            end_time=Instant.from_seconds(self.HORIZON + 10),
+        )
+        sim.run()
+
+        model = EnsembleModel(horizon_s=self.HORIZON + 10)
+        src = model.source(rate=self.RATE, kind="constant", stop_after_s=self.HORIZON)
+        srv = model.server(
+            concurrency=1, service_mean=0.05, service="constant",
+            queue_capacity=256,
+            fault=FaultSpec(windows=(self.WINDOW,), mode="outage"),
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=64, seed=1, mesh=mesh)
+
+        # Every replica runs the identical deterministic schedule, so the
+        # aggregate must be an exact per-replica multiple.
+        assert result.server_fault_dropped[0] % result.n_replicas == 0
+        per_replica_dropped = result.server_fault_dropped[0] // result.n_replicas
+        window_span = self.WINDOW[1] - self.WINDOW[0]
+        assert per_replica_dropped == pytest.approx(
+            self.RATE * window_span, abs=2
+        )
+        # Host twin: drops = offered - delivered (PauseNode swallows the
+        # in-window deliveries before the server sees them).
+        host_offered = int(self.RATE * self.HORIZON)
+        host_dropped = host_offered - sink.events_received
+        assert per_replica_dropped == host_dropped
+        assert result.sink_count[0] // result.n_replicas == sink.events_received
+        # Static-outage and queue-full counters stay disjoint from the
+        # stochastic-fault ledger.
+        assert result.server_outage_dropped[0] == 0
+        assert result.server_dropped[0] == 0
+        # Mean latency parity (trivially the constant service here, but
+        # asserted against the host number, not the constant).
+        host_mean = sink.latency_stats().mean_s
+        assert result.sink_mean_latency_s[0] == pytest.approx(host_mean, rel=0.01)
+
+    def test_latency_inflation_matches_host_within_1pct(self, mesh):
+        """Degrade-mode service inflation vs a host windowed distribution.
+
+        rate 10/s, base service 0.05 s, inflation 3x over [20, 40):
+        in-window the server needs 0.15 s per 0.1 s arrival gap, so a
+        queue builds and drains — mean latency is dominated by the fault
+        dynamics, and both paths are deterministic.
+        """
+        base, factor = 0.05, 3.0
+        sink = Sink("sink")
+        server = Server(
+            "srv",
+            service_time=WindowedInflation(base, factor, *self.WINDOW),
+            downstream=sink,
+            queue_capacity=1024,
+        )
+        source = Source.constant(rate=self.RATE, target=server, stop_after=self.HORIZON)
+        sim = Simulation(
+            sources=[source],
+            entities=[server, sink],
+            end_time=Instant.from_seconds(self.HORIZON + 10),
+        )
+        sim.run()
+        host_mean = sink.latency_stats().mean_s
+        assert host_mean > base * 1.5  # the fault actually dominated
+
+        model = EnsembleModel(horizon_s=self.HORIZON + 10)
+        src = model.source(rate=self.RATE, kind="constant", stop_after_s=self.HORIZON)
+        srv = model.server(
+            concurrency=1, service_mean=base, service="constant",
+            queue_capacity=1024,
+            fault=FaultSpec(
+                windows=(self.WINDOW,), mode="degrade", latency_factor=factor
+            ),
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=64, seed=2, mesh=mesh)
+
+        assert result.sink_count[0] // result.n_replicas == sink.events_received
+        assert result.sink_mean_latency_s[0] == pytest.approx(host_mean, rel=0.01)
+        # Degrade mode never rejects work.
+        assert result.server_fault_dropped[0] == 0
+
+
+class TestStochasticEnsemble:
+    def test_drop_rate_matches_duty_cycle_within_3_sigma(self, mesh):
+        """>= 4096 replicas, each with its own Exp-gap/Exp-duration fault
+        timeline: total fault drops vs the two-state-Markov closed form.
+
+        Up->down rate r, down->up rate m: stationary dark fraction
+        d = r/(r+m) (== duty_cycle), startup correction for a process
+        born "up", occupation-time variance 2rm/(r+m)^3 per second.
+        """
+        r_up, m_down = 0.2, 1.0  # mean up 5 s, mean dark 1 s
+        lam, horizon, replicas = 4.0, 30.0, 4096
+        model = EnsembleModel(horizon_s=horizon)
+        src = model.source(rate=lam, kind="poisson")
+        srv = model.server(
+            service_mean=0.02, queue_capacity=512,
+            fault=FaultSpec(
+                rate=r_up, mean_duration_s=1.0 / m_down, max_windows=24
+            ),
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=replicas, seed=3, mesh=mesh)
+
+        d = duty_cycle(r_up, 1.0 / m_down)
+        assert d == pytest.approx(r_up / (r_up + m_down))
+        rate_sum = r_up + m_down
+        expected_dark = d * horizon - d / rate_sum * (
+            1.0 - math.exp(-rate_sum * horizon)
+        )
+        var_dark = 2.0 * r_up * m_down / rate_sum**3 * horizon
+        mean_drops = replicas * lam * expected_dark
+        # Poisson thinning over a random dark time: Var = lam^2 Var[T] +
+        # lam E[T] per replica.
+        sigma = math.sqrt(replicas * (lam**2 * var_dark + lam * expected_dark))
+        drops = result.server_fault_dropped[0]
+        assert abs(drops - mean_drops) < 3.0 * sigma, (
+            drops, mean_drops, sigma
+        )
+        # Replica independence sanity: the same model without faults
+        # delivers everything.
+        assert result.truncated_replicas == 0
+
+    def test_correlated_trigger_darkens_only_subscribers(self, mesh):
+        model = EnsembleModel(horizon_s=60.0)
+        model.correlated_outages(rate=0.1, mean_duration_s=2.0, trigger_p=1.0)
+        src = model.source(rate=6.0)
+        subscribed = model.server(
+            service_mean=0.05, queue_capacity=256,
+            fault=FaultSpec(correlated=True),
+        )
+        bystander = model.server(service_mean=0.05, queue_capacity=256)
+        router = model.router(policy="round_robin")
+        sink = model.sink()
+        model.connect(src, router)
+        model.connect(router, subscribed)
+        model.connect(router, bystander)
+        model.connect(subscribed, sink)
+        model.connect(bystander, sink)
+        result = run_ensemble(model, n_replicas=256, seed=4, mesh=mesh)
+        assert result.server_fault_dropped[0] > 0
+        assert result.server_fault_dropped[1] == 0
+
+    def test_correlated_trigger_hits_all_subscribers_together(self, mesh):
+        """Both subscribers share ONE trigger per replica: their drop
+        counts agree far more tightly than independent schedules would
+        (round-robin halves the stream symmetrically)."""
+        model = EnsembleModel(horizon_s=60.0)
+        model.correlated_outages(rate=0.1, mean_duration_s=2.0, trigger_p=0.5)
+        src = model.source(rate=8.0, kind="constant")
+        a = model.server(
+            service_mean=0.05, queue_capacity=256, fault=FaultSpec(correlated=True)
+        )
+        b = model.server(
+            service_mean=0.05, queue_capacity=256, fault=FaultSpec(correlated=True)
+        )
+        router = model.router(policy="round_robin")
+        sink = model.sink()
+        model.connect(src, router)
+        model.connect(router, a)
+        model.connect(router, b)
+        model.connect(a, sink)
+        model.connect(b, sink)
+        result = run_ensemble(model, n_replicas=256, seed=5, mesh=mesh)
+        drops = result.server_fault_dropped
+        assert drops[0] > 0 and drops[1] > 0
+        # Same windows, alternating deterministic arrivals: the split can
+        # differ by at most one arrival per window edge.
+        assert abs(drops[0] - drops[1]) / max(drops) < 0.05
+
+
+class TestCapacityDegrade:
+    """mode='degrade' with capacity_factor: the cap is on the ACTIVE job
+    count (host twin ReduceCapacity), not on which slots are used."""
+
+    def test_capacity_factor_halves_throughput_and_utilization(self, mesh):
+        """Full-horizon window, concurrency 4 at factor 0.5: the server
+        runs exactly like a 2-slot server under saturating load."""
+        horizon, service = 20.0, 0.1
+        model = EnsembleModel(horizon_s=horizon)
+        src = model.source(rate=40.0, kind="constant", stop_after_s=horizon)
+        srv = model.server(
+            concurrency=4, service_mean=service, service="constant",
+            queue_capacity=1024,
+            fault=FaultSpec(
+                windows=((0.0, horizon + 1.0),), mode="degrade",
+                capacity_factor=0.5,
+            ),
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=32, seed=12, mesh=mesh)
+        # 2 usable slots x 1/0.1 per-slot rate = 20/s against 40/s offered.
+        completed = result.server_completed[0] / result.n_replicas
+        assert completed == pytest.approx(2.0 / service * horizon, rel=0.03)
+        # Busy integral sees 2-of-4 slots occupied the whole run.
+        assert result.server_utilization[0] == pytest.approx(0.5, rel=0.05)
+        # Degrade mode rejects nothing; excess work queues.
+        assert result.server_fault_dropped[0] == 0
+        assert result.server_mean_queue_len[0] > 10.0
+
+    def test_capacity_factor_zero_freezes_starts_in_window(self, mesh):
+        """factor 0.0 over [5, 10): nothing STARTS in-window (running
+        work finishes), the backlog queues and drains afterwards —
+        nothing is lost."""
+        horizon, rate = 30.0, 8.0
+        window = (5.05, 10.05)
+        model = EnsembleModel(horizon_s=horizon)
+        src = model.source(rate=rate, kind="constant", stop_after_s=20.0)
+        srv = model.server(
+            concurrency=2, service_mean=0.05, service="constant",
+            queue_capacity=1024,
+            fault=FaultSpec(windows=(window,), mode="degrade", capacity_factor=0.0),
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=32, seed=13, mesh=mesh)
+        offered = int(rate * 20.0)
+        # Conservation: the frozen window only delays work.
+        assert result.sink_count[0] / result.n_replicas == pytest.approx(
+            offered, abs=2
+        )
+        assert result.server_fault_dropped[0] == 0
+        assert result.server_dropped[0] == 0
+        # The ~40 in-window arrivals all waited: mean wait well above the
+        # no-fault twin's (which is ~0 at this load).
+        assert result.server_mean_wait_s[0] > 0.2
+
+
+class TestResilience:
+    def test_retry_budget_accounting_is_exact(self, mesh):
+        """A full-horizon outage rejects every attempt: each arrival
+        spends its entire budget (max_retries parks) then drops once."""
+        horizon, rate, retries = 30.0, 10.0, 2
+        model = EnsembleModel(horizon_s=horizon)
+        src = model.source(rate=rate, kind="constant", stop_after_s=horizon - 2.0)
+        srv = model.server(
+            service_mean=0.05, queue_capacity=256,
+            fault=FaultSpec(windows=((0.0, horizon + 1.0),), mode="outage"),
+            retry_backoff_s=0.01, max_retries=retries,
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=32, seed=6, mesh=mesh)
+        assert result.sink_count[0] == 0
+        assert result.server_fault_dropped[0] > 0
+        assert result.server_fault_retried[0] == retries * result.server_fault_dropped[0]
+        assert result.truncated_replicas == 0
+
+    def test_backoff_retry_recovers_window_rejections(self, mesh):
+        """With a finite window, client retries carry rejected arrivals
+        past the outage: deliveries strictly beat the no-retry twin."""
+        def build(with_retries: bool):
+            model = EnsembleModel(horizon_s=60.0)
+            src = model.source(rate=8.0, kind="constant", stop_after_s=50.0)
+            kwargs = dict(retry_backoff_s=0.5, max_retries=4) if with_retries else {}
+            srv = model.server(
+                service_mean=0.02, queue_capacity=512,
+                fault=FaultSpec(windows=((10.0, 12.0), (30.0, 33.0))),
+                **kwargs,
+            )
+            model.connect(src, srv)
+            model.connect(srv, model.sink())
+            return model
+
+        retrying = run_ensemble(build(True), n_replicas=64, seed=7, mesh=mesh)
+        dropping = run_ensemble(build(False), n_replicas=64, seed=7, mesh=mesh)
+        assert retrying.sink_count[0] > dropping.sink_count[0]
+        assert retrying.server_fault_dropped[0] < dropping.server_fault_dropped[0]
+        # backoff 0.5 * 2^a clears the 2 s window within the budget; the
+        # 3 s window needs the later attempts too.
+        assert retrying.server_fault_retried[0] > 0
+
+    def test_hedging_cuts_the_tail(self, mesh):
+        """Hedged M/M/1: effective service min(S1, d + S2) thins the
+        exponential tail, so p99 drops while the mean barely moves."""
+        def build(hedge):
+            model = EnsembleModel(horizon_s=40.0, warmup_s=5.0)
+            src = model.source(rate=4.0)
+            srv = model.server(
+                service_mean=0.1, queue_capacity=512,
+                hedge_delay_s=0.2 if hedge else None,
+            )
+            model.connect(src, srv)
+            model.connect(srv, model.sink())
+            return model
+
+        hedged = run_ensemble(build(True), n_replicas=512, seed=8, mesh=mesh)
+        plain = run_ensemble(build(False), n_replicas=512, seed=8, mesh=mesh)
+        assert hedged.sink_p99_s[0] < plain.sink_p99_s[0]
+        assert hedged.server_hedge_wins[0] <= hedged.server_hedged[0]
+        # P(S > d) = exp(-d/mean) = exp(-2) of starts launch a hedge.
+        starts = hedged.server_completed[0]
+        frac = hedged.server_hedged[0] / starts
+        assert frac == pytest.approx(math.exp(-2.0), rel=0.1)
+        assert plain.server_hedged == [0]
+
+    def test_packet_loss_rate_within_3_sigma(self, mesh):
+        p, rate, stop = 0.2, 10.0, 28.0
+        model = EnsembleModel(horizon_s=30.0)
+        src = model.source(rate=rate, kind="constant", stop_after_s=stop)
+        srv = model.server(service_mean=0.001, service="constant", queue_capacity=256)
+        model.connect(src, srv, loss_p=p)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=256, seed=9, mesh=mesh)
+        # Conservation pins the crossing count exactly (service drains
+        # well before the horizon): every crossing either vanished or
+        # reached the sink. The loss count is then Binomial(crossings, p).
+        crossings = result.network_lost + result.sink_count[0]
+        # ~rate*stop per replica (the final tick can round off the stop).
+        assert crossings / result.n_replicas == pytest.approx(rate * stop, abs=2)
+        expected = crossings * p
+        sigma = math.sqrt(crossings * p * (1.0 - p))
+        assert abs(result.network_lost - expected) < 3.0 * sigma
+
+    def test_loss_window_bounds_the_bernoulli(self, mesh):
+        # Window edges mid-gap between the 0.1 s-spaced arrivals: exactly
+        # 50 in-window crossings per replica, immune to float32 rounding.
+        p, rate, window = 0.5, 10.0, (5.05, 10.05)
+        model = EnsembleModel(horizon_s=30.0)
+        src = model.source(rate=rate, kind="constant", stop_after_s=28.0)
+        srv = model.server(service_mean=0.001, service="constant", queue_capacity=256)
+        model.connect(src, srv, loss_p=p, loss_window=window)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=256, seed=10, mesh=mesh)
+        in_window = 256 * int(rate * (window[1] - window[0]))
+        expected = in_window * p
+        sigma = math.sqrt(in_window * p * (1.0 - p))
+        assert abs(result.network_lost - expected) < 3.0 * sigma
+
+
+class TestScanFallback:
+    def test_faulted_chain_shape_runs_on_the_event_scan(self, mesh):
+        """An otherwise chain-eligible M/M/1 with a fault spec must fall
+        back: the fault ledger (which the closed form cannot produce) is
+        populated and the analytic M/M/1 mean still holds outside the
+        windows' influence at low duty."""
+        from happysim_tpu.tpu.chain import fast_plan
+
+        model = EnsembleModel(horizon_s=40.0, warmup_s=10.0)
+        src = model.source(rate=8.0)
+        srv = model.server(
+            service_mean=0.05, queue_capacity=512,
+            fault=FaultSpec(rate=0.05, mean_duration_s=0.5),
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        assert fast_plan(model) is None
+        result = run_ensemble(model, n_replicas=128, seed=11, mesh=mesh)
+        assert result.server_fault_dropped[0] > 0
+        assert result.simulated_events > 0

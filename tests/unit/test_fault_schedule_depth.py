@@ -1,0 +1,180 @@
+"""FaultSchedule / ledger edge cases: overlapping windows, revocation
+before activation, and what FaultStats reports after the run unwinds.
+
+Sister files: test_fault_depth.py (builders, handles, context,
+ReduceCapacity mechanics) and test_fault_semantics_depth.py (crash/pause
+equivalences). This file pins the SCHEDULE's bookkeeping contract:
+stats count transitions that actually fired, never armed-but-revoked
+ones, and overlapping flag-flip windows keep their documented
+last-write-wins semantics.
+"""
+
+import pytest
+
+from happysim_tpu import (
+    ConstantLatency,
+    CrashNode,
+    FaultSchedule,
+    PauseNode,
+    ReduceCapacity,
+    Resource,
+    Simulation,
+    Source,
+)
+from happysim_tpu.core.callback_entity import CallbackEntity
+
+
+def record_sim(faults, duration=5.0, rate=10.0):
+    """Constant 10/s stream into a recording node; returns receipt times."""
+    received = []
+
+    def record(event):
+        received.append(event.time.to_seconds())
+
+    node = CallbackEntity("node", record)
+    source = Source.constant(rate=rate, target=node, stop_after=duration)
+    sim = Simulation(
+        sources=[source], entities=[node], fault_schedule=faults, duration=duration
+    )
+    return sim, received
+
+
+class TestOverlappingWindows:
+    def test_overlap_is_last_write_wins_not_union(self):
+        """Two PauseNode windows [1, 3) and [2, 4): the first deactivate
+        at t=3 re-enables the node even though the second window is
+        still open — flag-flip semantics, documented, not a union."""
+        faults = FaultSchedule()
+        faults.add(PauseNode("node", start=1.0, end=3.0))
+        faults.add(PauseNode("node", start=2.0, end=4.0))
+        sim, received = record_sim(faults)
+        sim.run()
+        assert not [t for t in received if 1.0 <= t < 3.0]
+        # Re-enabled by the earlier window's end despite the open overlap.
+        assert [t for t in received if 3.0 <= t < 4.0]
+        stats = faults.stats
+        assert stats.faults_scheduled == 2
+        assert stats.faults_activated == 2
+        assert stats.faults_deactivated == 2
+
+    def test_nested_window_swallowed_by_outer(self):
+        """[1, 4) containing [2, 3): the inner deactivate at t=3 wakes
+        the node a second early — same last-write-wins contract."""
+        faults = FaultSchedule()
+        faults.add(PauseNode("node", start=1.0, end=4.0))
+        faults.add(PauseNode("node", start=2.0, end=3.0))
+        sim, received = record_sim(faults)
+        sim.run()
+        assert not [t for t in received if 1.0 <= t < 3.0]
+        assert [t for t in received if 3.0 <= t < 4.0]
+
+    def test_overlapping_capacity_windows_restore_healthy_value(self):
+        """Both ReduceCapacity windows captured the healthy capacity at
+        bootstrap, so whichever restore runs last lands on it."""
+        resource = Resource("pool", capacity=8.0)
+        faults = FaultSchedule()
+        faults.add(ReduceCapacity("pool", factor=0.5, start=1.0, end=3.0))
+        faults.add(ReduceCapacity("pool", factor=0.25, start=2.0, end=4.0))
+        node = CallbackEntity("node", lambda event: None)
+        source = Source.constant(rate=10.0, target=node, stop_after=6.0)
+        sim = Simulation(
+            sources=[source],
+            entities=[node, resource],
+            fault_schedule=faults,
+            duration=6.0,
+        )
+        sim.run()
+        assert resource.capacity == 8.0
+
+
+class TestRevokeBeforeFire:
+    def test_cancel_before_start_suppresses_everything(self):
+        faults = FaultSchedule()
+        handle = faults.add(PauseNode("node", start=1.0, end=3.0))
+        handle.cancel()
+        sim, received = record_sim(faults)
+        sim.run()
+        # The window never fired: the stream is uninterrupted.
+        assert [t for t in received if 1.0 <= t < 3.0]
+        stats = faults.stats
+        assert stats.faults_scheduled == 1
+        assert stats.faults_cancelled == 1
+        assert stats.faults_activated == 0
+        assert stats.faults_deactivated == 0
+
+    def test_cancel_after_activation_freezes_the_fault(self):
+        """Revoking between activate and deactivate cancels the pending
+        deactivate: the node stays dark and the ledger shows the
+        asymmetry (activated=1, deactivated=0)."""
+        faults = FaultSchedule()
+        handle = faults.add(PauseNode("node", start=1.0, end=3.0))
+        received = []
+
+        def record(event):
+            received.append(event.time.to_seconds())
+
+        node = CallbackEntity("node", record)
+        source = Source.constant(rate=10.0, target=node, stop_after=5.0)
+        from happysim_tpu.faults.fault import one_shot
+
+        sim = Simulation(
+            sources=[source],
+            entities=[node],
+            fault_schedule=faults,
+            duration=5.0,
+        )
+        cancel_event = one_shot(2.0, "test.revoke", lambda event: handle.cancel())
+        sim.schedule(cancel_event)
+        sim.run()
+        # Paused at 1.0 and NEVER resumed (the deactivate was revoked).
+        assert not [t for t in received if t >= 1.0]
+        stats = faults.stats
+        assert stats.faults_activated == 1
+        assert stats.faults_deactivated == 0
+        assert stats.faults_cancelled == 1
+
+
+class TestStatsAfterUnwind:
+    def test_full_window_lifecycle_counts(self):
+        faults = FaultSchedule()
+        faults.add(PauseNode("node", start=1.0, end=2.0))
+        sim, _ = record_sim(faults)
+        sim.run()
+        stats = faults.stats
+        assert (
+            stats.faults_scheduled,
+            stats.faults_activated,
+            stats.faults_deactivated,
+            stats.faults_cancelled,
+        ) == (1, 1, 1, 0)
+
+    def test_window_open_at_end_of_run_never_deactivates(self):
+        faults = FaultSchedule()
+        faults.add(PauseNode("node", start=1.0, end=99.0))
+        sim, _ = record_sim(faults, duration=5.0)
+        sim.run()
+        stats = faults.stats
+        assert stats.faults_activated == 1
+        assert stats.faults_deactivated == 0
+
+    def test_one_shot_crash_is_not_a_window_transition(self):
+        """CrashNode events carry no .activate/.deactivate labels — the
+        window ledger ignores them by design (scheduled still counts)."""
+        faults = FaultSchedule()
+        faults.add(CrashNode("node", at=1.0, restart_at=2.0))
+        sim, _ = record_sim(faults)
+        sim.run()
+        stats = faults.stats
+        assert stats.faults_scheduled == 1
+        assert stats.faults_activated == 0
+        assert stats.faults_deactivated == 0
+
+    def test_stats_before_start_are_all_armed(self):
+        faults = FaultSchedule()
+        faults.add(PauseNode("node", start=1.0, end=2.0))
+        faults.add(PauseNode("node", start=3.0, end=4.0))
+        stats = faults.stats
+        assert stats.faults_scheduled == 2
+        assert stats.faults_activated == 0
+        assert stats.faults_deactivated == 0
+        assert stats.faults_cancelled == 0

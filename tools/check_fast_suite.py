@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Tier-1 gate: run the fast test suite and fail loudly on ANY red test.
+
+This is the ROADMAP.md tier-1 command as a one-shot tool, so a stale
+"N tests pass" snapshot can never ship again: run it before committing
+(or wire it into CI) and it exits non-zero if anything fails, errors,
+or the collection itself breaks.
+
+Usage:
+    python tools/check_fast_suite.py            # full tier-1 (-m 'not slow')
+    python tools/check_fast_suite.py -m 'not tpu'   # extra deselects
+    python tools/check_fast_suite.py --timeout 1200
+
+Everything after the script name is forwarded to pytest verbatim (the
+defaults below still apply unless overridden).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE_ARGS = [
+    "tests/",
+    "-q",
+    "--continue-on-collection-errors",
+    "-p", "no:cacheprovider",
+    "-p", "no:xdist",
+    "-p", "no:randomly",
+]
+
+SUMMARY_RE = re.compile(
+    r"(?P<failed>\d+) failed|(?P<passed>\d+) passed|(?P<errors>\d+) errors?"
+)
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=1800.0,
+        help="kill the suite after this many seconds (default 1800)",
+    )
+    args, pytest_extra = parser.parse_known_args(argv)
+
+    cmd = [sys.executable, "-m", "pytest", *BASE_ARGS]
+    if not any(arg == "-m" for arg in pytest_extra):
+        cmd += ["-m", "not slow"]
+    cmd += pytest_extra
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    print(f"$ {' '.join(cmd)}", flush=True)
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO_ROOT, env=env, timeout=args.timeout,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"FAST SUITE: TIMEOUT after {args.timeout:.0f}s", file=sys.stderr)
+        return 2
+
+    tail = proc.stdout.splitlines()[-30:]
+    print("\n".join(tail))
+
+    counts = {"failed": 0, "passed": 0, "errors": 0}
+    for match in SUMMARY_RE.finditer(proc.stdout):
+        for key, value in match.groupdict().items():
+            if value is not None:
+                counts[key] = int(value)
+
+    if proc.returncode != 0 or counts["failed"] or counts["errors"]:
+        print(
+            f"FAST SUITE: RED — rc={proc.returncode}, "
+            f"{counts['failed']} failed, {counts['errors']} errors, "
+            f"{counts['passed']} passed",
+            file=sys.stderr,
+        )
+        return 1
+    if counts["passed"] == 0:
+        print("FAST SUITE: nothing ran — collection is broken", file=sys.stderr)
+        return 1
+    print(f"FAST SUITE: GREEN — {counts['passed']} passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
