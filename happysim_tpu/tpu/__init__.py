@@ -21,6 +21,7 @@ from happysim_tpu.tpu.engine import (
     EnsembleCheckpoint,
     EnsembleResult,
     hist_percentile,
+    macro_block_len,
     run_ensemble,
 )
 from happysim_tpu.tpu.faults import duty_cycle
@@ -49,6 +50,7 @@ __all__ = [
     "MM1Result",
     "duty_cycle",
     "hist_percentile",
+    "macro_block_len",
     "mm1_model",
     "pipeline_model",
     "run_ensemble",

@@ -45,6 +45,13 @@ Performance architecture (why the hot path is O(1) per event, not O(K)):
   ``uniform((CHUNK, n_draws))`` per outer step replaces a per-event
   ``fold_in`` + ``uniform`` (windowed/partitioned mode keeps the per-event
   counter-keyed stream, which must stay monotone across window reruns).
+- The ensemble hot loop is MACRO-STEPPED: chunks of ``macro_block_len()``
+  fused event steps run under a ``lax.while_loop`` that exits as soon as
+  every replica in the batch has drained (next event past the horizon) —
+  heterogeneous sweeps stop paying the full worst-case event budget.
+  Bit-identical to the flat fixed-length scan (skipped steps are no-ops
+  and RNG chunks are keyed by absolute block index); see the
+  "Performance model" section of docs/tpu-engine.md.
 
 Semantics parity (host twins): Source ticks + profiles (load/source.py,
 load/profile.py), Server concurrency + FIFO queue + drop-on-full
@@ -98,8 +105,53 @@ HIST_DECADES = 8.0
 # Rate-profile integral tables: grid resolution over [0, horizon].
 PROFILE_GRID_POINTS = 512
 
-# Events per uniform-generation chunk in ensemble mode.
+# Events per uniform-generation chunk in ensemble mode. This is also the
+# default MACRO-BLOCK length: the hot loop runs blocks of this many fused
+# event steps between early-exit checks, and the RNG stream is keyed
+# (absolute block index, row-within-block) — so the block length is part
+# of the stream layout. For a FIXED block length, results are bit-identical
+# across early-exit on/off and across checkpoint segmentation; CHANGING the
+# block length is a (statistically valid) reseeding, which resume rejects.
 RNG_CHUNK = 32
+
+
+def macro_block_len(model: Optional["EnsembleModel"] = None) -> int:
+    """Macro-block length K: event steps fused per RNG chunk and per
+    early-exit check. Precedence: ``HS_TPU_MACRO_BLOCK`` env override >
+    ``EnsembleModel.macro_block`` > :data:`RNG_CHUNK`."""
+    raw = os.environ.get("HS_TPU_MACRO_BLOCK")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            logger.warning("ignoring non-integer HS_TPU_MACRO_BLOCK=%r", raw)
+    if model is not None and getattr(model, "macro_block", None):
+        return max(1, int(model.macro_block))
+    return RNG_CHUNK
+
+
+def _early_exit_enabled() -> bool:
+    """``HS_TPU_EARLY_EXIT=0`` forces the flat fixed-length chunk scan
+    (the A/B lever bench.py uses; results are bit-identical either way
+    because skipped steps are side-effect-free no-ops)."""
+    return os.environ.get("HS_TPU_EARLY_EXIT", "1") != "0"
+
+
+def _donation_enabled() -> bool:
+    """Whether jitted entry points donate the state carry buffers.
+
+    Donation lets XLA alias the carry in place across segment calls, so
+    a segmented/checkpointed 65k-replica run holds ONE copy of its state
+    in HBM instead of two. Auto mode enables it on accelerator backends
+    and skips CPU, where XLA ignores donation and warns on every call;
+    ``HS_TPU_DONATE=1``/``0`` forces either way."""
+    mode = os.environ.get("HS_TPU_DONATE", "auto")
+    if mode in ("0", "1"):
+        return mode == "1"
+    try:
+        return jax.default_backend() != "cpu"
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return False
 
 # Queue-ring write strategy: "dense" (one-hot masked write, O(K)) or
 # "scatter" (predicated `.at[].set(mode="drop")`). Dense is the default
@@ -126,11 +178,20 @@ def _hist_bin(latency):
 
 
 def hist_percentile(hist: np.ndarray, q: float) -> float:
-    """Host-side percentile estimate from the log-spaced histogram."""
-    total = hist.sum()
+    """Host-side percentile estimate from the log-spaced histogram.
+
+    ``q`` must lie in [0, 1]; the empty histogram maps to 0.0. The
+    target count is clamped into [1, total] so q=0 resolves to the
+    FIRST occupied bin (not bin 0 regardless of where the mass sits)
+    and q=1 to the last occupied bin even with float roundoff in
+    ``total * q``.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"percentile q must be in [0, 1], got {q!r}")
+    total = int(hist.sum())
     if total == 0:
         return 0.0
-    target = total * q
+    target = min(max(total * q, 1.0), float(total))
     cumulative = np.cumsum(hist)
     bin_index = int(np.searchsorted(cumulative, target))
     bin_index = min(bin_index, HIST_BINS - 1)
@@ -228,6 +289,10 @@ class EnsembleCheckpoint:
     state: dict  # replica-major np arrays (the vmapped scan carry)
     model_fingerprint: str = ""
     params_fingerprint: str = ""  # resolved sweeps (src_rate/srv_mean)
+    # Macro-block length the run was keyed with (part of the RNG stream
+    # layout). 0 = unknown (checkpoint predates the field): resume skips
+    # the check rather than rejecting older files.
+    macro_block: int = 0
 
     def save(self, path: str) -> None:
         meta = {
@@ -238,6 +303,7 @@ class EnsembleCheckpoint:
             "max_events": self.max_events,
             "model_fingerprint": self.model_fingerprint,
             "params_fingerprint": self.params_fingerprint,
+            "macro_block": self.macro_block,
         }
         save_checkpoint_npz(path, meta, self.state)
 
@@ -1326,10 +1392,16 @@ class _Compiled:
                 self._pick(jnp.asarray(self.srv_backoff), row),
                 self._pick(jnp.asarray(self.srv_jitter), row),
             )
+            # Counter discipline (matches _enqueue_retry's has_room gate):
+            # a retry that found every transit register occupied never
+            # re-arrives — _into_transit books it as tr_dropped, and it
+            # must NOT count as retried.
+            tr_free = jnp.any(jnp.isinf(state["tr_time"]) & row[:, None])
             parked = self._into_transit(
                 {
                     **state,
-                    "srv_fault_retried": state["srv_fault_retried"] + row_i,
+                    "srv_fault_retried": state["srv_fault_retried"]
+                    + row_i * tr_free.astype(jnp.int32),
                 },
                 v,
                 t + delay,
@@ -1447,8 +1519,15 @@ class _Compiled:
                     jnp.float32(spec.retry_backoff_s),
                     jnp.float32(spec.retry_jitter),
                 )
+                # Same has-room gate as _enqueue_retry: an overflowed
+                # retry is a transit drop, not a booked retry.
+                tr_free = jnp.any(jnp.isinf(state["tr_time"]) & row[:, None])
                 retried_state = self._into_transit(
-                    {**state, "srv_retried": state["srv_retried"] + row_i},
+                    {
+                        **state,
+                        "srv_retried": state["srv_retried"]
+                        + row_i * tr_free.astype(jnp.int32),
+                    },
                     v,
                     t + delay,
                     created,
@@ -1778,6 +1857,7 @@ def _run_ensemble_segmented(
     n_replicas: int,
     seed: int,
     max_events: int,
+    macro_block: int,
     checkpoint_every_s: Optional[float],
     checkpoint_callback,
     resume_from: Optional[EnsembleCheckpoint],
@@ -1796,13 +1876,16 @@ def _run_ensemble_segmented(
             "n_chunks": (resume_from.n_chunks, n_chunks),
             "model_fingerprint": (resume_from.model_fingerprint, fingerprint),
             "params_fingerprint": (resume_from.params_fingerprint, p_fingerprint),
+            "macro_block": (resume_from.macro_block, macro_block),
         }
-        # Empty fingerprints = "unknown" (checkpoint predates the field):
-        # skip those rather than reject older files.
+        # Empty fingerprints / macro_block 0 = "unknown" (checkpoint
+        # predates the field): skip those rather than reject older files.
         bad = {
             k: v
             for k, v in mismatches.items()
-            if v[0] != v[1] and not (k.endswith("fingerprint") and v[0] == "")
+            if v[0] != v[1]
+            and not (k.endswith("fingerprint") and v[0] == "")
+            and not (k == "macro_block" and v[0] == 0)
         }
         if bad:
             raise ValueError(
@@ -1821,6 +1904,15 @@ def _run_ensemble_segmented(
         out_shardings=sharding,
     )
 
+    # Donate the state carry into every segment runner (and the final
+    # reduce): the carry is consumed exactly once per call, so XLA can
+    # alias it in place instead of holding old+new copies — at 65k
+    # replicas the donated path roughly halves the peak HBM the segment
+    # loop pins, raising the max replica count per chip. keys/params are
+    # REUSED across segment calls and must never be donated.
+    donate = _donation_enabled()
+    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+
     def make_seg_runner(n: int):
         def run_seg(state, keys, params, offset):
             return jax.vmap(
@@ -1831,6 +1923,7 @@ def _run_ensemble_segmented(
             run_seg,
             in_shardings=(sharding, sharding, sharding, None),
             out_shardings=sharding,
+            **jit_kwargs,
         )
 
     # Prepare state and AOT-compile every segment shape BEFORE the timer,
@@ -1858,7 +1951,9 @@ def _run_ensemble_segmented(
             make_seg_runner(rem).lower(state, keys, params, offset0).compile()
         )
     reduce_jit = (
-        jax.jit(reduce_final, in_shardings=(sharding,)).lower(state).compile()
+        jax.jit(reduce_final, in_shardings=(sharding,), **jit_kwargs)
+        .lower(state)
+        .compile()
     )
 
     start = _wall.perf_counter()
@@ -1888,6 +1983,7 @@ def _run_ensemble_segmented(
                 state={k: np.asarray(v) for k, v in state.items()},
                 model_fingerprint=fingerprint,
                 params_fingerprint=p_fingerprint,
+                macro_block=macro_block,
             )
             checkpoint_callback(snapshot)
             last_snapshot = _wall.perf_counter()
@@ -2008,19 +2104,39 @@ def run_ensemble(
 
     horizon = float(model.horizon_s)
     step = compiled.make_step(horizon, external_u=True)
-    n_chunks = -(-max_events // RNG_CHUNK)
+    macro = macro_block_len(model)
+    early_exit = _early_exit_enabled()
+    n_chunks = -(-max_events // macro)
+
+    def replica_halted(state):
+        """True once this replica's next event is past the horizon (or
+        nonexistent). Halted is ABSORBING: a frozen state can only keep
+        producing the same past-horizon candidates, so every further
+        step is a no-op and the lane is done for good."""
+        t_min = jnp.min(compiled.next_candidates(state))
+        return jnp.isinf(t_min) | (t_min > jnp.float32(horizon))
 
     def replica_chunks(key, state, p, offset, n: int):
-        """Advance one replica by ``n`` chunks from absolute chunk
-        ``offset``. One batched uniform per chunk instead of a per-event
-        fold_in + draw (threefry amortization); keying on the ABSOLUTE
-        index keeps streams identical across segmentation/resume."""
+        """Advance one replica by up to ``n`` macro-blocks of ``macro``
+        fused event steps, from absolute block ``offset``.
+
+        One batched uniform per block instead of a per-event fold_in +
+        draw (threefry amortization); keying on the ABSOLUTE index keeps
+        streams identical across segmentation/resume AND across early
+        exit. Early exit: the while_loop stops as soon as the replica is
+        halted — under vmap the loop runs until EVERY replica in the
+        batch is done, so heterogeneous sweeps (mixed rho, faulted
+        replicas, deadline models) stop paying the full worst-case event
+        budget once their slowest lane finishes. Skipped steps were
+        side-effect-free no-ops, so results are bit-identical to the
+        flat fixed-length scan (HS_TPU_EARLY_EXIT=0 keeps that path
+        reachable for A/B measurement)."""
 
         def chunk_body(carry, c):
             chunk_key = jax.random.fold_in(key, c)
             U = jax.random.uniform(
                 chunk_key,
-                (RNG_CHUNK, compiled.n_draws),
+                (macro, compiled.n_draws),
                 minval=1e-12,
                 maxval=1.0,
             )
@@ -2032,10 +2148,25 @@ def run_ensemble(
             )
             return carry, None
 
-        (state, _), _ = lax.scan(
-            chunk_body,
-            (state, p),
-            jnp.arange(n, dtype=jnp.uint32) + offset,
+        if not early_exit:
+            (state, _), _ = lax.scan(
+                chunk_body,
+                (state, p),
+                jnp.arange(n, dtype=jnp.uint32) + offset,
+            )
+            return state
+
+        def blocks_cond(carry):
+            s, _p, c = carry
+            return (c < jnp.uint32(n)) & ~replica_halted(s)
+
+        def blocks_body(carry):
+            s, p, c = carry
+            (s, p), _ = chunk_body((s, p), offset + c)
+            return (s, p, c + jnp.uint32(1))
+
+        state, _, _ = lax.while_loop(
+            blocks_cond, blocks_body, (state, p, jnp.uint32(0))
         )
         return state
 
@@ -2103,7 +2234,12 @@ def run_ensemble(
     )
     if not checkpointing:
 
-        @jax.jit
+        # keys/params are consumed exactly once; donating them lets XLA
+        # reuse their buffers during the run (state itself is born inside
+        # the jit, where lax.scan/while_loop carries already alias).
+        jit_kwargs = {"donate_argnums": (0, 1)} if _donation_enabled() else {}
+
+        @partial(jax.jit, **jit_kwargs)
         def run(keys, params):
             def one_replica(key, p):
                 state = compiled.init_state(key, p)
@@ -2133,6 +2269,7 @@ def run_ensemble(
             n_replicas=n_replicas,
             seed=seed,
             max_events=max_events,
+            macro_block=macro,
             checkpoint_every_s=checkpoint_every_s,
             checkpoint_callback=checkpoint_callback,
             resume_from=resume_from,

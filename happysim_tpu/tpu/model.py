@@ -155,11 +155,15 @@ class FaultSpec:
                         f"{label}: fault window [{start}, {end}) is empty "
                         "or negative"
                     )
-        elif not self.correlated:
-            if self.rate <= 0.0:
+        else:
+            if not self.correlated and self.rate <= 0.0:
                 raise ValueError(f"{label}: stochastic fault needs rate > 0 "
                                  "(or explicit windows=..., or correlated=True)")
-            if self.mean_duration_s <= 0.0:
+            # A correlated spec may carry its OWN stochastic windows on
+            # top of the shared schedule (rate > 0) — those still need a
+            # positive duration, or every sampled window is empty and
+            # the configured rate silently never fires.
+            if self.rate > 0.0 and self.mean_duration_s <= 0.0:
                 raise ValueError(f"{label}: fault needs mean_duration_s > 0")
         if self.max_windows < 1:
             raise ValueError(f"{label}: max_windows must be >= 1")
@@ -301,6 +305,13 @@ class EnsembleModel:
     window [warmup_s, horizon_s], removing the empty-start transient bias.
     Server started/completed/dropped counters remain whole-run, so
     ``server_completed == sink_count`` only holds when ``warmup_s == 0``.
+
+    ``macro_block`` tunes the ensemble engine's hot loop: the number of
+    fused event steps per RNG chunk / early-exit check (None = the
+    engine default, currently 32). It is part of the per-replica RNG
+    stream layout, so changing it re-seeds the run — statistically
+    valid, but not bit-identical — and checkpoints record it so resume
+    rejects a mismatch. Ignored by the partitioned executor.
     """
 
     def __init__(
@@ -308,15 +319,20 @@ class EnsembleModel:
         horizon_s: float = 60.0,
         warmup_s: float = 0.0,
         transit_capacity: int = 256,
+        macro_block: Optional[int] = None,
     ):
         if warmup_s < 0.0 or warmup_s >= horizon_s:
             raise ValueError("warmup_s must satisfy 0 <= warmup_s < horizon_s")
         if transit_capacity < 1:
             raise ValueError("transit_capacity must be >= 1")
+        if macro_block is not None and macro_block < 1:
+            raise ValueError("macro_block must be >= 1 (or None for default)")
         self.horizon_s = horizon_s
         self.warmup_s = warmup_s
         # Bounded in-flight slots per server for latency-carrying edges.
         self.transit_capacity = transit_capacity
+        # Ensemble-engine macro-block length override (see class docstring).
+        self.macro_block = macro_block
         self.sources: list[SourceSpec] = []
         self.servers: list[ServerSpec] = []
         self.routers: list[RouterSpec] = []

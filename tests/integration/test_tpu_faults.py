@@ -462,3 +462,39 @@ class TestScanFallback:
         result = run_ensemble(model, n_replicas=128, seed=11, mesh=mesh)
         assert result.server_fault_dropped[0] > 0
         assert result.simulated_events > 0
+
+
+class TestRetryCounterDiscipline:
+    """Retry counters must only book retries that actually re-arrived:
+    a retry that found every transit register occupied vanishes into
+    tr_dropped and must NOT count as retried (the has_room discipline
+    of the legacy immediate re-enqueue path, applied to backoff)."""
+
+    def test_transit_overflow_not_counted_as_fault_retried(self, mesh):
+        # Deterministic: constant arrivals at t=1..10 all inside the
+        # pinned outage window; backoff 1000s (jitter 0) parks retries
+        # far past the horizon, so the 2 transit registers never free —
+        # exactly 2 retries park per replica, the other 8 overflow.
+        model = EnsembleModel(horizon_s=10.0, transit_capacity=2)
+        src = model.source(rate=1.0, kind="constant")
+        srv = model.server(
+            concurrency=1,
+            service_mean=0.05,
+            fault=FaultSpec(windows=((0.0, 100.0),), mode="outage"),
+            retry_backoff_s=1000.0,
+            max_retries=5,
+        )
+        model.connect(src, srv)
+        model.connect(srv, model.sink())
+        result = run_ensemble(model, n_replicas=8, seed=0, mesh=mesh)
+
+        n = result.n_replicas
+        assert result.server_fault_retried[0] == 2 * n, (
+            "fault_retried must count only PARKED retries (2 transit "
+            "slots), not every rejection"
+        )
+        assert result.transit_dropped[0] == 8 * n
+        # Rejections with retry budget left are never terminal drops.
+        assert result.server_fault_dropped[0] == 0
+        assert result.sink_count[0] == 0
+        assert result.truncated_replicas == 0

@@ -1,0 +1,190 @@
+"""Macro-block boundary tests for the adaptive (early-exit) event scan.
+
+The contract: the while_loop-driven macro-stepped path is a pure
+wall-time optimization — for a FIXED macro-block length K, results are
+bit-identical to the flat fixed-length chunk scan whatever K is (even
+when K does not divide max_events), however early the ensemble drains,
+and across checkpoint/resume segmentation. K itself is part of the RNG
+stream layout, so resume REJECTS a mismatched K instead of silently
+splicing two different streams.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu import EnsembleModel, mm1_model, run_ensemble
+from happysim_tpu.tpu.engine import RNG_CHUNK, macro_block_len
+
+EXCLUDED_FIELDS = {"wall_seconds", "events_per_second"}  # timing-dependent
+
+
+def assert_results_identical(a, b):
+    for field in dataclasses.fields(a):
+        if field.name in EXCLUDED_FIELDS:
+            continue
+        left = getattr(a, field.name)
+        right = getattr(b, field.name)
+        if isinstance(left, np.ndarray):
+            assert np.array_equal(left, right), field.name
+        else:
+            assert left == right, (
+                f"{field.name}: {left!r} != {right!r} — early exit changed "
+                "the simulation, not just the wall time"
+            )
+
+
+def _run(early_exit: str, monkeypatch, **kwargs):
+    monkeypatch.setenv("HS_TPU_EARLY_EXIT", early_exit)
+    model = kwargs.pop("model", None) or mm1_model(
+        lam=8.0, mu=10.0, horizon_s=10.0, warmup_s=2.0
+    )
+    return run_ensemble(model, n_replicas=16, seed=3, **kwargs)
+
+
+class TestMacroBlockBoundary:
+    def test_k_not_dividing_max_events_bit_identical(self, cpu_mesh, monkeypatch):
+        """K=7 with max_events=40: the last macro-block covers only 5 of
+        its 7 budgeted events — the ragged tail must not change results
+        between the flat scan and the early-exit while_loop."""
+        monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "7")
+        flat = _run("0", monkeypatch, mesh=cpu_mesh, max_events=40)
+        early = _run("1", monkeypatch, mesh=cpu_mesh, max_events=40)
+        assert_results_identical(flat, early)
+
+    def test_default_k_bit_identical(self, cpu_mesh, monkeypatch):
+        flat = _run("0", monkeypatch, mesh=cpu_mesh, max_events=400)
+        early = _run("1", monkeypatch, mesh=cpu_mesh, max_events=400)
+        assert_results_identical(flat, early)
+
+    def test_all_replicas_done_at_step_zero(self, cpu_mesh, monkeypatch):
+        """First scheduled event already beyond the horizon: the
+        while_loop must exit before running a single block, and match
+        the flat scan's all-no-op result exactly."""
+        def empty_model():
+            model = EnsembleModel(horizon_s=1.0)
+            src = model.source(rate=0.001, kind="constant")  # first gap 1000s
+            srv = model.server(service_mean=0.1)
+            snk = model.sink()
+            model.connect(src, srv)
+            model.connect(srv, snk)
+            return model
+
+        flat = _run("0", monkeypatch, model=empty_model(), mesh=cpu_mesh, max_events=64)
+        early = _run("1", monkeypatch, model=empty_model(), mesh=cpu_mesh, max_events=64)
+        assert_results_identical(flat, early)
+        assert early.simulated_events == 0
+        assert early.truncated_replicas == 0
+        assert early.sink_count == [0]
+
+    def test_checkpoint_mid_run_resumes_bit_identically(
+        self, cpu_mesh, monkeypatch
+    ):
+        """A checkpoint taken mid-run under a non-default macro-block
+        (K=7, so segment boundaries land mid-way through the old
+        32-event chunk grid) must resume into the exact uninterrupted
+        trajectory, with the early-exit path active on both sides."""
+        monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "7")
+        monkeypatch.setenv("HS_TPU_EARLY_EXIT", "1")
+        monkeypatch.setenv("HS_TPU_CHAIN", "0")  # baseline must be the scan
+        model = mm1_model(lam=8.0, mu=10.0, horizon_s=10.0, warmup_s=2.0)
+        kwargs = dict(n_replicas=16, seed=3, mesh=cpu_mesh)
+        baseline = run_ensemble(model, **kwargs)
+
+        snapshots = []
+        checkpointed = run_ensemble(
+            model,
+            **kwargs,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+        )
+        assert_results_identical(baseline, checkpointed)
+        assert snapshots and all(
+            0 < s.chunk_index < s.n_chunks for s in snapshots
+        )
+        middle = snapshots[len(snapshots) // 2]
+        assert middle.macro_block == 7
+
+        resumed = run_ensemble(model, **kwargs, resume_from=middle)
+        assert_results_identical(baseline, resumed)
+
+    def test_resume_rejects_macro_block_mismatch(self, cpu_mesh, monkeypatch):
+        """Resuming under a different K would splice two RNG stream
+        layouts mid-run with no shape error — must be rejected."""
+        monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "8")
+        model = mm1_model(lam=8.0, mu=10.0, horizon_s=10.0)
+        snapshots = []
+        run_ensemble(
+            model,
+            n_replicas=16,
+            seed=3,
+            mesh=cpu_mesh,
+            checkpoint_callback=snapshots.append,
+        )
+        monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "4")
+        with pytest.raises(ValueError, match="macro_block|n_chunks"):
+            run_ensemble(
+                model, n_replicas=16, seed=3, mesh=cpu_mesh,
+                resume_from=snapshots[0],
+            )
+
+    def test_legacy_checkpoint_without_macro_block_resumes(
+        self, cpu_mesh, monkeypatch
+    ):
+        """Checkpoints written before the macro_block field default to 0
+        ("unknown") and must still resume under the default K."""
+        monkeypatch.setenv("HS_TPU_CHAIN", "0")
+        model = mm1_model(lam=8.0, mu=10.0, horizon_s=10.0, warmup_s=2.0)
+        kwargs = dict(n_replicas=16, seed=3, mesh=cpu_mesh)
+        baseline = run_ensemble(model, **kwargs)
+        snapshots = []
+        run_ensemble(
+            model,
+            **kwargs,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+        )
+        legacy = dataclasses.replace(
+            snapshots[len(snapshots) // 2], macro_block=0
+        )
+        resumed = run_ensemble(model, **kwargs, resume_from=legacy)
+        assert_results_identical(baseline, resumed)
+
+
+class TestMacroBlockKnob:
+    def test_env_overrides_model_overrides_default(self, monkeypatch):
+        model = mm1_model(lam=8.0, mu=10.0, horizon_s=5.0)
+        monkeypatch.delenv("HS_TPU_MACRO_BLOCK", raising=False)
+        assert macro_block_len(model) == RNG_CHUNK
+        model.macro_block = 12
+        assert macro_block_len(model) == 12
+        monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "5")
+        assert macro_block_len(model) == 5
+        monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "not-a-number")
+        assert macro_block_len(model) == 12  # garbage env ignored
+        monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "-3")
+        assert macro_block_len(model) == 1  # clamped
+
+    def test_model_rejects_bad_macro_block(self):
+        with pytest.raises(ValueError, match="macro_block"):
+            EnsembleModel(horizon_s=1.0, macro_block=0)
+
+    def test_donation_forced_on_cpu_stays_bit_identical(
+        self, cpu_mesh, monkeypatch
+    ):
+        """HS_TPU_DONATE=1 on the CPU backend: XLA ignores the donation
+        (with a warning) — results must be unchanged, proving the
+        donated call signature itself is sound."""
+        monkeypatch.setenv("HS_TPU_CHAIN", "0")
+        model = mm1_model(lam=8.0, mu=10.0, horizon_s=8.0)
+        kwargs = dict(n_replicas=16, seed=5, mesh=cpu_mesh)
+        baseline = run_ensemble(model, **kwargs)
+        monkeypatch.setenv("HS_TPU_DONATE", "1")
+        donated = run_ensemble(
+            model,
+            **kwargs,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=lambda snapshot: None,
+        )
+        assert_results_identical(baseline, donated)

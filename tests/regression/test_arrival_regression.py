@@ -1,0 +1,75 @@
+"""Arrival-time regression pin for the ensemble event scan.
+
+The engine's RNG contract — per-replica threefry lanes, chunk streams
+keyed by ABSOLUTE macro-block index — means a pinned (model, seed,
+n_replicas, max_events) tuple must reproduce the exact same event
+history on every run, whatever the execution strategy (flat scan,
+early-exit while_loop, segmented/checkpointed, donated carries). These
+goldens were recorded from the CPU backend at macro-block 32; any drift
+means the stream layout or the event semantics changed, which silently
+invalidates every recorded BENCH/accuracy trajectory.
+"""
+
+import pytest
+
+from happysim_tpu.tpu.engine import run_ensemble
+from happysim_tpu.tpu.model import mm1_model
+
+# Pinned run: 32-replica M/M/1 (lam=8, mu=10), 12s horizon, 2s warmup,
+# explicit event budget (forces the general event scan, not the chain
+# closed form).
+GOLDEN = {
+    "sink_count": [2492],
+    "simulated_events": 5958,
+    "server_completed": [2908],
+    "truncated_replicas": 0,
+    "sink_mean_latency_s": 0.5099316837316914,
+    "server_mean_wait_s": 0.4089576791578921,
+    "sink_p50_s": 0.3548133892335753,
+    "sink_p99_s": 1.7782794100389228,
+}
+
+
+def _pinned_run():
+    model = mm1_model(lam=8.0, mu=10.0, horizon_s=12.0, warmup_s=2.0)
+    return run_ensemble(model, n_replicas=32, seed=11, max_events=480)
+
+
+@pytest.mark.parametrize("early_exit", ["1", "0"])
+def test_pinned_seed_reproduces_goldens(early_exit, monkeypatch):
+    monkeypatch.setenv("HS_TPU_EARLY_EXIT", early_exit)
+    result = _pinned_run()
+    assert result.sink_count == GOLDEN["sink_count"]
+    assert result.simulated_events == GOLDEN["simulated_events"]
+    assert result.server_completed == GOLDEN["server_completed"]
+    assert result.truncated_replicas == GOLDEN["truncated_replicas"]
+    # Float accumulators: identical op order on the same backend is
+    # bit-reproducible; the tolerance only allows for cross-platform
+    # fused-multiply-add differences, not statistical drift.
+    assert result.sink_mean_latency_s[0] == pytest.approx(
+        GOLDEN["sink_mean_latency_s"], rel=1e-6
+    )
+    assert result.server_mean_wait_s[0] == pytest.approx(
+        GOLDEN["server_mean_wait_s"], rel=1e-6
+    )
+    assert result.sink_p50_s[0] == pytest.approx(GOLDEN["sink_p50_s"], rel=1e-9)
+    assert result.sink_p99_s[0] == pytest.approx(GOLDEN["sink_p99_s"], rel=1e-9)
+
+
+def test_macro_block_is_part_of_the_stream_contract(monkeypatch):
+    """A different macro-block length is a RESEEDING: it must still be a
+    valid sample path (same analytic regime) but not the golden stream —
+    guarding against someone changing the default K and assuming the
+    recorded trajectories still apply."""
+    monkeypatch.setenv("HS_TPU_MACRO_BLOCK", "16")
+    result = _pinned_run()
+    assert result.truncated_replicas == 0
+    assert result.sink_count != GOLDEN["sink_count"] or (
+        result.sink_mean_latency_s[0]
+        != pytest.approx(GOLDEN["sink_mean_latency_s"], rel=1e-12)
+    )
+    # Still the same queue: mean within 30% of the pinned-run value
+    # (loose — 32 replicas x 10s is a small sample).
+    assert result.sink_mean_latency_s[0] == pytest.approx(
+        GOLDEN["sink_mean_latency_s"], rel=0.3
+    )

@@ -1,0 +1,98 @@
+"""Throughput-floor regression: bench.py must not regress below 80% of
+the recorded round-5 trajectory (BENCH_r05.json).
+
+Runs the real benchmark as a subprocess WITHOUT the test harness's CPU
+pin, so it lands on the TPU when one is reachable; skipped (not failed)
+when the hardware is absent — a CPU-fallback number compared against a
+TPU trajectory would always be red and would say nothing about the code.
+Marked slow: one full bench is several minutes of compile + run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BENCH_R05 = os.path.join(REPO_ROOT, "BENCH_r05.json")
+FLOOR_FRACTION = 0.8
+
+
+def _r05_entries() -> dict:
+    """metric -> value from the recorded trajectory's JSON lines."""
+    if not os.path.exists(BENCH_R05):
+        pytest.skip("no BENCH_r05.json trajectory recorded")
+    with open(BENCH_R05) as fh:
+        recorded = json.load(fh)
+    entries = {}
+    for line in recorded.get("tail", "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in obj and "value" in obj:
+            entries[obj["metric"]] = obj["value"]
+    parsed = recorded.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        entries.setdefault(parsed["metric"], parsed["value"])
+    if not entries:
+        pytest.skip("BENCH_r05.json carries no parseable bench lines")
+    return entries
+
+
+def _run_bench() -> list[dict]:
+    env = dict(os.environ)
+    # Undo the conftest CPU pin: this test measures the real device.
+    env.pop("JAX_PLATFORMS", None)
+    env["HS_BENCH_TPU_WAIT_S"] = "0"  # single probe; fall back fast
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        env=env,
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=2400,
+    )
+    assert proc.returncode == 0, (
+        f"bench.py failed rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    )
+    lines = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            lines.append(json.loads(line))
+    assert lines, f"bench.py emitted no JSON lines\n{proc.stdout[-2000:]}"
+    return lines
+
+
+def test_events_per_sec_per_chip_floor():
+    recorded = _r05_entries()
+    fresh = _run_bench()
+    if any("device_fallback" in entry for entry in fresh):
+        pytest.skip("TPU unreachable: CPU-fallback numbers are not comparable")
+
+    compared = 0
+    failures = []
+    for entry in fresh:
+        metric = entry.get("metric", "")
+        if metric not in recorded:
+            continue  # new entries (hetero/multichip) have no r05 floor
+        floor = FLOOR_FRACTION * recorded[metric]
+        compared += 1
+        if entry["value"] < floor:
+            failures.append(
+                f"{metric}: {entry['value']:.3g} < {FLOOR_FRACTION:.0%} of "
+                f"r05 {recorded[metric]:.3g}"
+            )
+    assert compared > 0, (
+        f"no fresh metric matched the r05 trajectory: "
+        f"fresh={[e.get('metric') for e in fresh]} vs recorded={list(recorded)}"
+    )
+    assert not failures, "throughput regression:\n" + "\n".join(failures)

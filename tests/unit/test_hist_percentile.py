@@ -1,0 +1,72 @@
+"""Edge cases for the log-spaced histogram percentile estimator
+(engine.hist_percentile): empty histograms, the q=0 / q=1 endpoints,
+and out-of-range q."""
+
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu.engine import (
+    HIST_BINS,
+    HIST_DECADES,
+    HIST_LO_LOG10,
+    hist_percentile,
+)
+
+
+def _bin_center(index: int) -> float:
+    frac = (index + 0.5) / HIST_BINS
+    return float(10 ** (HIST_LO_LOG10 + frac * HIST_DECADES))
+
+
+class TestHistPercentile:
+    def test_empty_histogram_is_zero(self):
+        hist = np.zeros(HIST_BINS, np.int32)
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert hist_percentile(hist, q) == 0.0
+
+    def test_q_one_hits_last_occupied_bin(self):
+        hist = np.zeros(HIST_BINS, np.int32)
+        hist[10] = 90
+        hist[63] = 10
+        assert hist_percentile(hist, 1.0) == pytest.approx(_bin_center(63))
+
+    def test_q_one_all_mass_in_final_bin_no_index_error(self):
+        hist = np.zeros(HIST_BINS, np.int32)
+        hist[HIST_BINS - 1] = 5
+        value = hist_percentile(hist, 1.0)
+        assert value == pytest.approx(_bin_center(HIST_BINS - 1))
+        assert np.isfinite(value)
+
+    def test_q_zero_hits_first_occupied_bin(self):
+        """q=0 must resolve to where the mass STARTS, not bin 0: before
+        the clamp fix, searchsorted matched target=0 against the leading
+        zero-count bins and returned the lowest decade regardless."""
+        hist = np.zeros(HIST_BINS, np.int32)
+        hist[42] = 7
+        assert hist_percentile(hist, 0.0) == pytest.approx(_bin_center(42))
+
+    def test_single_sample_all_quantiles_agree(self):
+        hist = np.zeros(HIST_BINS, np.int32)
+        hist[17] = 1
+        for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+            assert hist_percentile(hist, q) == pytest.approx(_bin_center(17))
+
+    def test_median_of_two_bins(self):
+        hist = np.zeros(HIST_BINS, np.int32)
+        hist[20] = 50
+        hist[60] = 50
+        assert hist_percentile(hist, 0.5) == pytest.approx(_bin_center(20))
+        assert hist_percentile(hist, 0.51) == pytest.approx(_bin_center(60))
+
+    @pytest.mark.parametrize("q", [-0.01, 1.01, 2.0, float("nan")])
+    def test_out_of_range_q_rejected(self, q):
+        hist = np.ones(HIST_BINS, np.int32)
+        with pytest.raises(ValueError, match="q must be in"):
+            hist_percentile(hist, q)
+
+    def test_monotone_in_q(self):
+        rng = np.random.default_rng(0)
+        hist = rng.integers(0, 100, HIST_BINS).astype(np.int64)
+        qs = np.linspace(0.0, 1.0, 21)
+        values = [hist_percentile(hist, float(q)) for q in qs]
+        assert values == sorted(values)

@@ -13,7 +13,12 @@ from __future__ import annotations
 
 import pytest
 
-from happysim_tpu.tpu.model import EnsembleModel, mm1_model, pipeline_model
+from happysim_tpu.tpu.model import (
+    EnsembleModel,
+    FaultSpec,
+    mm1_model,
+    pipeline_model,
+)
 
 
 def base():
@@ -36,6 +41,17 @@ class TestConstructorRules:
     def test_retries_require_deadline(self):
         with pytest.raises(ValueError, match="deadline"):
             base().server(max_retries=2)
+
+    def test_correlated_fault_with_own_rate_needs_duration(self):
+        """correlated=True must not bypass the duration check when the
+        spec ALSO declares its own stochastic windows (rate > 0): a zero
+        mean duration makes every sampled window empty, so the
+        configured rate would silently never fire."""
+        with pytest.raises(ValueError, match="mean_duration_s"):
+            base().server(fault=FaultSpec(rate=0.5, correlated=True))
+
+    def test_correlated_fault_without_own_rate_is_valid(self):
+        base().server(fault=FaultSpec(correlated=True))  # shared schedule only
 
     def test_bad_deadline(self):
         with pytest.raises(ValueError, match="deadline_s"):
