@@ -237,21 +237,11 @@ def bench_hetero_sweep(devices) -> dict:
     import numpy as np
 
     from happysim_tpu.tpu import run_ensemble
-    from happysim_tpu.tpu.model import EnsembleModel
 
     mu = 10.0
-    model = EnsembleModel(horizon_s=HETERO_HORIZON_S, warmup_s=20.0)
-    src = model.source(rate=9.5)  # swept per replica below
-    srv = model.server(
-        concurrency=1,
-        service_mean=1.0 / mu,
-        queue_capacity=256,
-        deadline_s=8.0,  # ~e^-4 of sojourns even at rho=0.95: retries rare,
-        max_retries=2,   # but the budget must still pay the x3 retry factor
-    )
-    snk = model.sink()
-    model.connect(src, srv)
-    model.connect(srv, snk)
+    # deadline_s=8.0 is ~e^-4 of sojourns even at rho=0.95: retries are
+    # rare, but the budget must still pay the x3 retry factor.
+    model = _hetero_model()
     sweeps = {
         "source_rate": np.linspace(0.1 * mu, 0.95 * mu, HETERO_REPLICAS).astype(
             np.float32
@@ -300,6 +290,101 @@ def bench_hetero_sweep(devices) -> dict:
         "simulated_events": early.simulated_events,
         "wall_seconds": round(early.wall_seconds, 6),
         "flat_wall_seconds": round(flat.wall_seconds, 6),
+        "device": str(devices[0]),
+        "n_devices": len(devices),
+    }
+
+
+def _hetero_model(telemetry_windows: int = 0):
+    """The hetero ρ-sweep deadline M/M/1 (shared by the early-exit and
+    telemetry entries; the deadline keeps both runs on the event scan,
+    so telemetry on/off is an apples-to-apples program comparison)."""
+    from happysim_tpu.tpu.model import EnsembleModel
+
+    mu = 10.0
+    model = EnsembleModel(horizon_s=HETERO_HORIZON_S, warmup_s=20.0)
+    src = model.source(rate=9.5)  # swept per replica by the caller
+    srv = model.server(
+        concurrency=1,
+        service_mean=1.0 / mu,
+        queue_capacity=256,
+        deadline_s=8.0,
+        max_retries=2,
+    )
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    if telemetry_windows:
+        model.telemetry(window_s=HETERO_HORIZON_S / telemetry_windows)
+    return model
+
+
+def bench_telemetry_overhead(devices) -> dict:
+    """Windowed-telemetry cost on the ρ-sweep workload: the SAME model
+    with and without a 64-window TelemetrySpec. Telemetry is
+    observation-only — it adds no RNG draws — so the simulated counters
+    must be bit-identical between the two runs (asserted here: a
+    divergence means the buffers perturbed the simulation); the wall
+    ratio is the enabled-path overhead the docs quote.
+    """
+    import numpy as np
+
+    from happysim_tpu.tpu import run_ensemble
+
+    mu = 10.0
+    sweeps = {
+        "source_rate": np.linspace(
+            0.1 * mu, 0.95 * mu, HETERO_REPLICAS
+        ).astype(np.float32)
+    }
+
+    def run(windows: int):
+        return run_ensemble(
+            _hetero_model(telemetry_windows=windows),
+            n_replicas=HETERO_REPLICAS,
+            seed=0,
+            sweeps=sweeps,
+        )
+
+    disabled = run(0)
+    enabled = run(64)
+    overhead = enabled.wall_seconds / max(disabled.wall_seconds, 1e-9)
+    bit_identical = bool(
+        disabled.simulated_events == enabled.simulated_events
+        and disabled.sink_count == enabled.sink_count
+        and disabled.sink_mean_latency_s == enabled.sink_mean_latency_s
+        and disabled.server_completed == enabled.server_completed
+        and disabled.server_timed_out == enabled.server_timed_out
+    )
+    assert bit_identical, (
+        "telemetry perturbed the simulation: disabled-path results must be "
+        "bit-identical to the telemetry run's counters"
+    )
+    ts = enabled.timeseries
+    series_consistent = bool(
+        ts is not None
+        and ts.sink_count.sum(axis=0).tolist() == enabled.sink_count
+    )
+    label = (
+        f"simulated-events/sec (CPU fallback, 64-window telemetry, {HETERO_REPLICAS}-replica rho sweep)"
+        if DEVICE_FALLBACK
+        else f"simulated-events/sec/chip (64-window telemetry, {HETERO_REPLICAS // 1000}k-replica rho sweep)"
+    )
+    return {
+        "metric": label,
+        "value": round(enabled.events_per_second, 0),
+        "unit": "events/sec",
+        "vs_baseline": round(enabled.events_per_second / REFERENCE_EVENTS_PER_SEC, 2),
+        "telemetry_windows": 64,
+        "telemetry_overhead": round(overhead, 3),
+        "disabled_events_per_sec": round(disabled.events_per_second, 0),
+        "bit_identical": bit_identical,
+        "series_consistent": series_consistent,
+        "n_replicas": enabled.n_replicas,
+        "horizon_s": enabled.horizon_s,
+        "simulated_events": enabled.simulated_events,
+        "wall_seconds": round(enabled.wall_seconds, 6),
+        "disabled_wall_seconds": round(disabled.wall_seconds, 6),
         "device": str(devices[0]),
         "n_devices": len(devices),
     }
@@ -455,17 +540,20 @@ def main() -> int:
     kernel = bench_kernel(devices)
     engine = bench_general_engine(devices)
     hetero = bench_hetero_sweep(devices)
+    telemetry = bench_telemetry_overhead(devices)
     multichip = bench_multichip(devices)
     if DEVICE_FALLBACK:
         note = "TPU unreachable at bench time; CPU fallback at reduced scale"
         kernel["device_fallback"] = note
         engine["device_fallback"] = note
         hetero["device_fallback"] = note
+        telemetry["device_fallback"] = note
         engine["north_star_ok"] = False  # per-chip target is a TPU claim
     # The general-engine entry stays LAST: trajectory tooling that keys
     # on the final JSON line keeps comparing like with like across rounds.
     print(json.dumps(kernel))
     print(json.dumps(hetero))
+    print(json.dumps(telemetry))
     print(json.dumps(multichip))
     print(json.dumps(engine))
     return 0

@@ -40,14 +40,22 @@ from happysim_tpu.tpu.partitioned import (
     partition_mesh,
     run_partitioned,
 )
+from happysim_tpu.tpu.telemetry import (
+    DEFAULT_METRICS,
+    EnsembleTimeseries,
+    TelemetrySpec,
+)
 
 __all__ = [
     "CorrelatedOutages",
+    "DEFAULT_METRICS",
     "EnsembleCheckpoint",
     "EnsembleModel",
     "EnsembleResult",
+    "EnsembleTimeseries",
     "FaultSpec",
     "MM1Result",
+    "TelemetrySpec",
     "duty_cycle",
     "hist_percentile",
     "macro_block_len",

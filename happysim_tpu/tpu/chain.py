@@ -74,6 +74,13 @@ def _source_ok(model: EnsembleModel) -> bool:
         return False
     if model.limiters or model.remotes:
         return False
+    # Windowed telemetry needs the event scan's per-event accounting
+    # sites; the closed form has no per-window scatter targets, so a
+    # telemetry model soundly declines (it also keeps the RNG-stream
+    # contract: telemetry runs are bit-identical to the same model's
+    # telemetry-free SCAN run, not to the chain's different stream).
+    if getattr(model, "telemetry_spec", None) is not None:
+        return False
     # Correlated fault schedules can darken any subscribed server — the
     # closed form has no notion of time-varying service, so decline the
     # whole model up front.

@@ -84,6 +84,11 @@ logger = logging.getLogger("happysim_tpu.tpu.engine")
 
 from happysim_tpu.tpu.faults import FaultTable
 from happysim_tpu.tpu.mesh import pad_to_multiple, replica_mesh, replica_sharding
+from happysim_tpu.tpu.telemetry import (
+    EnsembleTimeseries,
+    build_timeseries,
+    window_edges,
+)
 from happysim_tpu.tpu.model import (
     LIMITER,
     ROUTER,
@@ -239,20 +244,24 @@ def model_fingerprint(model: EnsembleModel) -> str:
     plausible but wrong statistics with no shape error to catch it."""
     import hashlib
 
-    spec = repr(
-        (
-            model.horizon_s,
-            model.warmup_s,
-            model.transit_capacity,
-            model.sources,
-            model.servers,
-            model.routers,
-            model.limiters,
-            len(model.sinks),
-            model.remotes,
-            getattr(model, "correlated_faults", None),
-        )
+    items = (
+        model.horizon_s,
+        model.warmup_s,
+        model.transit_capacity,
+        model.sources,
+        model.servers,
+        model.routers,
+        model.limiters,
+        len(model.sinks),
+        model.remotes,
+        getattr(model, "correlated_faults", None),
     )
+    # Telemetry buffers change the compiled program; appended only when
+    # present so telemetry-free fingerprints stay stable across versions.
+    telemetry = getattr(model, "telemetry_spec", None)
+    if telemetry is not None:
+        items = items + (telemetry,)
+    spec = repr(items)
     return hashlib.sha256(spec.encode()).hexdigest()[:16]
 
 
@@ -293,6 +302,14 @@ class EnsembleCheckpoint:
     # layout). 0 = unknown (checkpoint predates the field): resume skips
     # the check rather than rejecting older files.
     macro_block: int = 0
+    # TelemetrySpec signature the run was compiled with (the windowed
+    # buffers ride the state, so resuming under a different spec would
+    # be a silent shape/meaning mismatch). "" means telemetry-free —
+    # including checkpoints that predate the field, whose state carries
+    # no buffers — so unlike macro_block == 0 there is NO skip: "" only
+    # matches a telemetry-free run, and resuming a legacy checkpoint
+    # into a telemetry model is (correctly) rejected.
+    telemetry: str = ""
 
     def save(self, path: str) -> None:
         meta = {
@@ -304,6 +321,7 @@ class EnsembleCheckpoint:
             "model_fingerprint": self.model_fingerprint,
             "params_fingerprint": self.params_fingerprint,
             "macro_block": self.macro_block,
+            "telemetry": self.telemetry,
         }
         save_checkpoint_npz(path, meta, self.state)
 
@@ -355,6 +373,9 @@ class EnsembleResult:
     server_hedge_wins: list[int] = dataclasses_field(default_factory=list)
     # packet-loss edge drops (whole model)
     network_lost: int = 0
+    # Time-resolved per-window series (models with a TelemetrySpec only;
+    # see tpu/telemetry.py — None otherwise).
+    timeseries: Optional[EnsembleTimeseries] = None
 
     def summary(self):
         from happysim_tpu.core.temporal import Instant
@@ -409,6 +430,28 @@ class EnsembleResult:
                         "dropped": self.limiter_dropped[index],
                     },
                 )
+            )
+        # Whole-model chaos accounting: network_lost and the fault/hedge
+        # totals have no per-entity home (losses happen on edges; totals
+        # matter for "how much chaos did this run absorb"), so they get
+        # a model-level entity — previously they never reached the
+        # summary at all.
+        chaos_extra = {}
+        if self.network_lost:
+            chaos_extra["network_lost"] = self.network_lost
+        for label, per_server in (
+            ("fault_dropped", self.server_fault_dropped),
+            ("fault_retried", self.server_fault_retried),
+            ("hedged", self.server_hedged),
+            ("hedge_wins", self.server_hedge_wins),
+            ("transit_dropped", self.transit_dropped),
+        ):
+            total = sum(per_server)
+            if total:
+                chaos_extra[f"total_{label}"] = total
+        if chaos_extra:
+            entities.append(
+                EntitySummary(name="model", kind="Chaos", extra=chaos_extra)
             )
         return SimulationSummary(
             start_time=Instant.Epoch,
@@ -583,16 +626,205 @@ class _Compiled:
         # Whether ANY edge into a server carries latency (enables the
         # transit registers + the transit-arrival branch). Backoff
         # retries are delayed re-arrivals, so they ride the same
-        # registers and force them on.
+        # registers and force them on. A router with any latency-carrying
+        # target edge AND any server target also forces them on: the
+        # delivery hop dispatches on lat_means.any() at trace time, so a
+        # server chosen behind a latency-free edge still parks in transit
+        # (with zero latency) whenever a SIBLING edge carries latency —
+        # previously that shape (e.g. router -> {sink@10ms, server@0}) hit
+        # a KeyError on the missing registers.
         self.has_transit = (
             any(
                 edge.mean_s > 0 and dest is not None and self._reaches_server(dest)
                 for edge, dest in self._edges()
             )
+            or any(
+                any(e.mean_s > 0 for e in r.target_latencies)
+                and any(t.kind == SERVER for t in r.targets)
+                for r in model.routers
+            )
             or self.has_backoff
         )
+        self._init_telemetry(model)
         self._build_profile_tables()
         self._assign_uniform_slots()
+
+    # -- windowed telemetry (tpu/telemetry.py) ------------------------------
+    def _init_telemetry(self, model: EnsembleModel) -> None:
+        """Compile-time telemetry gating. Every ``tel_*`` buffer and every
+        scatter-add below exists only when the model carries a
+        :class:`~happysim_tpu.tpu.telemetry.TelemetrySpec`; a telemetry-free
+        model traces to the exact same program as before this subsystem
+        existed (asserted by tests and the bench A/B entry)."""
+        self.telemetry = getattr(model, "telemetry_spec", None)
+        self.has_telemetry = self.telemetry is not None
+        if not self.has_telemetry:
+            self.nW = 0
+            return
+        self.telemetry.validate(model.horizon_s)
+        self.nW = self.telemetry.n_windows(model.horizon_s)
+        requested = set(self.telemetry.metrics)
+        # "spread" needs the per-window counts too; "faults" is a
+        # reduce-time integral over the sampled fault registers.
+        self.tel_throughput = bool({"throughput", "spread"} & requested)
+        self.tel_spread = "spread" in requested
+        self.tel_latency = "latency" in requested
+        self.tel_queue = "queue" in requested
+        self.tel_util = "utilization" in requested
+        self.tel_rates = "rates" in requested
+        self.tel_faults = "faults" in requested and self.has_faults
+        lo, hi = window_edges(self.telemetry.window_s, self.nW)
+        self.tel_lo = lo  # (nW,) float32 window starts
+        self.tel_hi = hi  # (nW,) float32 window ends, hi[-1] = +inf
+        # Buffer keys reduced by a plain cross-replica device sum
+        # (tel_sink_count is handled separately: the spread metric keeps
+        # it per-replica and the host sums in int64).
+        keys: list[str] = []
+        if self.tel_latency:
+            keys += ["tel_sink_sum", "tel_sink_hist"]
+        if self.tel_queue:
+            keys.append("tel_srv_depth_int")
+        if self.tel_util:
+            keys.append("tel_srv_busy_int")
+        # The sink buffers (notably the (nW, nK, HIST_BINS) histogram)
+        # are too big to flow through the cond/switch per-leaf selects
+        # (see "Performance architecture"): like the queue rings, they
+        # stay OUT of branch-visible state — _deliver_sink records at
+        # most one delivery per step in a tiny ``_tspush`` descriptor
+        # and the single masked add lands outside the switch.
+        sink_keys: list[str] = []
+        if self.tel_throughput:
+            sink_keys.append("tel_sink_count")
+        if self.tel_latency:
+            sink_keys += ["tel_sink_sum", "tel_sink_hist"]
+        self.tel_sink_keys = tuple(sink_keys)
+        if self.tel_rates:
+            keys += ["tel_srv_completed", "tel_srv_dropped"]
+            if self.has_deadlines:
+                keys += ["tel_srv_timed_out", "tel_srv_retried"]
+            if self.has_outages:
+                keys.append("tel_srv_outage_dropped")
+            if self.has_faults:
+                keys.append("tel_srv_fault_dropped")
+            if self.has_fault_retries:
+                keys.append("tel_srv_fault_retried")
+            if self.has_hedge:
+                keys += ["tel_srv_hedged", "tel_srv_hedge_wins"]
+            if self.model.limiters:
+                keys += ["tel_lim_admitted", "tel_lim_dropped"]
+            if self.has_transit:
+                keys.append("tel_tr_dropped")
+            if self.has_loss:
+                keys.append("tel_net_lost")
+        self.tel_sum_keys = tuple(keys)
+
+    def _tel_init_state(self) -> dict:
+        """Zeroed per-replica window buffers (ride the normal carry)."""
+        nW, nV, nK, nL = self.nW, self.nV, self.nK, self.nL
+        state = {}
+        if self.tel_throughput:
+            state["tel_sink_count"] = jnp.zeros((nW, nK), jnp.int32)
+        if self.tel_latency:
+            state["tel_sink_sum"] = jnp.zeros((nW, nK), jnp.float32)
+            state["tel_sink_hist"] = jnp.zeros((nW, nK, HIST_BINS), jnp.int32)
+        if self.tel_queue:
+            state["tel_srv_depth_int"] = jnp.zeros((nW, nV), jnp.float32)
+        if self.tel_util:
+            state["tel_srv_busy_int"] = jnp.zeros((nW, nV), jnp.float32)
+        if self.tel_rates:
+            state["tel_srv_completed"] = jnp.zeros((nW, nV), jnp.int32)
+            state["tel_srv_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_deadlines:
+                state["tel_srv_timed_out"] = jnp.zeros((nW, nV), jnp.int32)
+                state["tel_srv_retried"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_outages:
+                state["tel_srv_outage_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_faults:
+                state["tel_srv_fault_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_fault_retries:
+                state["tel_srv_fault_retried"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_hedge:
+                state["tel_srv_hedged"] = jnp.zeros((nW, nV), jnp.int32)
+                state["tel_srv_hedge_wins"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.model.limiters:
+                state["tel_lim_admitted"] = jnp.zeros((nW, nL), jnp.int32)
+                state["tel_lim_dropped"] = jnp.zeros((nW, nL), jnp.int32)
+            if self.has_transit:
+                state["tel_tr_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_loss:
+                state["tel_net_lost"] = jnp.zeros((nW,), jnp.int32)
+        return state
+
+    def _tel_windex(self, t):
+        """Scalar int32 index of the window containing sim-time ``t``
+        (start-inclusive; clipped so post-grid times land in the last
+        window — see telemetry.window_index, the host twin). The ONE
+        place the window-assignment arithmetic lives on device: every
+        scatter site derives from it, so the "windowed sums equal
+        whole-run counters" invariant cannot drift site by site."""
+        return jnp.clip(
+            (t / jnp.float32(self.telemetry.window_s)).astype(jnp.int32),
+            0,
+            self.nW - 1,
+        )
+
+    def _tel_wrow(self, t):
+        """(nW,) bool one-hot of the window containing sim-time ``t``."""
+        return jnp.arange(self.nW, dtype=jnp.int32) == self._tel_windex(t)
+
+    def _tel_overlap(self, lo, hi):
+        """(nW,) float32 seconds of ``[lo, hi)`` inside each window.
+
+        The last window is open-ended (tel_hi[-1] = +inf), so the pieces
+        always sum to ``hi - lo`` exactly in real arithmetic — the
+        per-window time-integrals total their whole-run counterparts up
+        to float32 re-association."""
+        return jnp.clip(
+            jnp.minimum(hi, jnp.asarray(self.tel_hi))
+            - jnp.maximum(lo, jnp.asarray(self.tel_lo)),
+            0.0,
+            None,
+        )
+
+    def _tel_count(self, state, key: str, wrow, row, pred):
+        """One windowed counter bump: buffer[w, i] += (pred & row[i])."""
+        mask = wrow[:, None] & row[None, :]
+        return state[key] + mask.astype(jnp.int32) * jnp.asarray(
+            pred, jnp.int32
+        )
+
+    def _tel_fault_integral(self, final):
+        """(nW, nV) expected dark seconds per window, summed over
+        replicas — computed from the sampled fault registers at reduce
+        time because fault activation has no events (an event-driven
+        integral would miss windows opening/closing between events).
+        Own-window and shared correlated-window overlaps add; a replica
+        whose own window coincides with a fired shared window counts
+        the coincidence twice (documented upper bound)."""
+        horizon = jnp.float32(self.model.horizon_s)
+        lo = jnp.asarray(self.tel_lo)[None, :, None, None]  # (1, nW, 1, 1)
+        hi = jnp.minimum(jnp.asarray(self.tel_hi), horizon)[None, :, None, None]
+        starts = final["flt_start"][:, None, :, :]  # (R, 1, nV, W)
+        ends = jnp.minimum(final["flt_end"], horizon)[:, None, :, :]
+        dark = jnp.sum(
+            jnp.clip(jnp.minimum(ends, hi) - jnp.maximum(starts, lo), 0.0, None),
+            axis=-1,
+        )  # (R, nW, nV)
+        if self.faults.has_shared:
+            sh_start = final["flt_sh_start"][:, None, None, :]  # (R, 1, 1, Wsh)
+            sh_end = jnp.minimum(final["flt_sh_end"], horizon)[:, None, None, :]
+            shared = jnp.sum(
+                jnp.clip(
+                    jnp.minimum(sh_end, hi) - jnp.maximum(sh_start, lo),
+                    0.0,
+                    None,
+                ),
+                axis=-1,
+            )  # (R, nW, 1)
+            dark = dark + shared * jnp.asarray(
+                self.faults.participates, jnp.float32
+            )
+        return jnp.sum(dark, axis=0)
 
     def _edges(self):
         for s in self.model.sources:
@@ -777,6 +1009,8 @@ class _Compiled:
             state["srv_hedge_wins"] = jnp.zeros((self.nV,), jnp.int32)
         if self.has_loss:
             state["net_lost"] = jnp.int32(0)
+        if self.has_telemetry:
+            state.update(self._tel_init_state())
         return state
 
     def _qro_keys(self):
@@ -827,6 +1061,39 @@ class _Compiled:
             out["srv_q_attempt"] = (
                 qro["srv_q_attempt"].at[desc["v"], slot].set(desc["attempt"], mode="drop")
             )
+        return out
+
+    def _null_tspush(self):
+        """The per-step sink-telemetry descriptor, initially inert."""
+        return {
+            "pred": jnp.bool_(False),
+            "k": jnp.int32(0),
+            "w": jnp.int32(0),
+            "bin": jnp.int32(0),
+            "lat": jnp.float32(0.0),
+        }
+
+    def _tel_apply_sink(self, tso, desc):
+        """The step's one sink-telemetry write, OUTSIDE all cond/switch
+        (an inert descriptor adds zero everywhere)."""
+        wrow = (
+            jnp.arange(self.nW, dtype=jnp.int32) == desc["w"]
+        ) & desc["pred"]
+        krow = jnp.arange(self.nK, dtype=jnp.int32) == desc["k"]
+        mask2 = wrow[:, None] & krow[None, :]
+        out = {}
+        if self.tel_throughput:
+            out["tel_sink_count"] = tso["tel_sink_count"] + mask2.astype(
+                jnp.int32
+            )
+        if self.tel_latency:
+            out["tel_sink_sum"] = (
+                tso["tel_sink_sum"] + mask2.astype(jnp.float32) * desc["lat"]
+            )
+            bin_row = jnp.arange(HIST_BINS, dtype=jnp.int32) == desc["bin"]
+            out["tel_sink_hist"] = tso["tel_sink_hist"] + (
+                mask2[:, :, None] & bin_row[None, None, :]
+            ).astype(jnp.int32)
         return out
 
     def _initial_gaps(self, key, params):
@@ -969,9 +1236,13 @@ class _Compiled:
         lost = self._uslot(u, self.U_LOSS) < loss_p
         return lost & (t >= loss_start) & (t < loss_end)
 
-    def _select_lost(self, state, lost, delivered):
+    def _select_lost(self, state, lost, delivered, t):
         """Vanish the delivery when the packet was lost (counted)."""
         base = {**state, "net_lost": state["net_lost"] + lost.astype(jnp.int32)}
+        if self.has_telemetry and self.tel_rates:
+            base["tel_net_lost"] = state["tel_net_lost"] + self._tel_wrow(
+                t
+            ).astype(jnp.int32) * lost.astype(jnp.int32)
         return jax.tree_util.tree_map(
             lambda base_leaf, dlv_leaf: jnp.where(lost, base_leaf, dlv_leaf),
             base,
@@ -1001,7 +1272,7 @@ class _Compiled:
             delivered = self._deliver_chosen(
                 state, t, created, u, dest, edge, params
             )
-            return self._select_lost(state, lost, delivered)
+            return self._select_lost(state, lost, delivered, t)
         return self._deliver_chosen(state, t, created, u, dest, edge, params)
 
     def _deliver_chosen(
@@ -1106,7 +1377,7 @@ class _Compiled:
                     [e.loss_end_s for e in router.target_latencies], jnp.float32
                 )[choice],
             )
-            return self._select_lost(state, lost, finish(state))
+            return self._select_lost(state, lost, finish(state), t)
         return finish(state)
 
     def _through_limiter(self, state, t, created, u, l: int, params):
@@ -1129,6 +1400,14 @@ class _Compiled:
             "lim_dropped": state["lim_dropped"]
             + row.astype(jnp.int32) * (~admit).astype(jnp.int32),
         }
+        if self.has_telemetry and self.tel_rates:
+            wrow = self._tel_wrow(t)
+            state["tel_lim_admitted"] = self._tel_count(
+                state, "tel_lim_admitted", wrow, row, admit
+            )
+            state["tel_lim_dropped"] = self._tel_count(
+                state, "tel_lim_dropped", wrow, row, ~admit
+            )
         delivered = self._deliver(
             state, t, created, u, limiter.downstream, limiter.latency, params
         )
@@ -1185,13 +1464,27 @@ class _Compiled:
         hist_mask = row[:, None] & (
             jnp.arange(HIST_BINS, dtype=jnp.int32)[None, :] == _hist_bin(latency)
         )
-        return {
+        out = {
             **state,
             "sink_count": state["sink_count"] + row_i,
             "sink_sum": state["sink_sum"] + row_f * latency,
             "sink_sq": state["sink_sq"] + row_f * latency * latency,
             "sink_hist": state["sink_hist"] + hist_mask.astype(jnp.int32),
         }
+        if self.has_telemetry and self.tel_sink_keys:
+            # At most one sink delivery per step: describe it (window by
+            # ARRIVAL time, masked like the whole-run accumulators) and
+            # let the masked add land OUTSIDE the cond/switch — the
+            # (nW, nK, HIST_BINS) histogram is far too big to flow
+            # through per-leaf branch selects (same move as _qpush).
+            out["_tspush"] = {
+                "pred": jnp.any(row),
+                "k": jnp.int32(sink_index) + jnp.int32(0),
+                "w": self._tel_windex(arrival_t),
+                "bin": _hist_bin(latency),
+                "lat": latency + jnp.float32(0.0),
+            }
+        return out
 
     def _into_transit(self, state, v, arrival_t, created, attempt=0):
         """Park a job on a latency edge until its transit arrival fires.
@@ -1216,6 +1509,13 @@ class _Compiled:
         if self.has_backoff:
             out["tr_attempt"] = jnp.where(
                 slot_mask, jnp.int32(attempt) + jnp.int32(0), state["tr_attempt"]
+            )
+        if self.has_telemetry and self.tel_rates:
+            # Booked at the would-be arrival window (the send time is not
+            # threaded here; _tel_wrow clips post-horizon arrivals into
+            # the last window, so the per-window sum still matches).
+            out["tel_tr_dropped"] = self._tel_count(
+                state, "tel_tr_dropped", self._tel_wrow(arrival_t), row, ~has_free
             )
         return out
 
@@ -1383,6 +1683,38 @@ class _Compiled:
             out["srv_hedge_wins"] = state["srv_hedge_wins"] + row_i * (
                 admit_free & hedge_win
             ).astype(jnp.int32)
+        if self.has_telemetry:
+            wrow = self._tel_wrow(t)
+            if self.tel_util:
+                # Busy time attributed to the windows the service interval
+                # actually spans (sums to the whole-run busy integral).
+                overlap = self._tel_overlap(t, t + service)
+                out["tel_srv_busy_int"] = state["tel_srv_busy_int"] + jnp.where(
+                    admit_free & measure, 1.0, 0.0
+                ) * overlap[:, None] * row_f[None, :]
+            if self.tel_rates:
+                out["tel_srv_dropped"] = self._tel_count(
+                    state, "tel_srv_dropped", wrow, row, drop
+                )
+                if self.has_outages:
+                    out["tel_srv_outage_dropped"] = self._tel_count(
+                        state, "tel_srv_outage_dropped", wrow, row, dark
+                    )
+                if self.has_faults:
+                    out["tel_srv_fault_dropped"] = self._tel_count(
+                        state, "tel_srv_fault_dropped", wrow, row, fault_lost
+                    )
+                if self.has_hedge:
+                    out["tel_srv_hedged"] = self._tel_count(
+                        state, "tel_srv_hedged", wrow, row, admit_free & hedged
+                    )
+                    out["tel_srv_hedge_wins"] = self._tel_count(
+                        state,
+                        "tel_srv_hedge_wins",
+                        wrow,
+                        row,
+                        admit_free & hedge_win,
+                    )
         if self.has_fault_retries:
             # Client retry: park the rejected job in this server's transit
             # registers; it re-arrives after exponential backoff + jitter.
@@ -1397,12 +1729,21 @@ class _Compiled:
             # re-arrives — _into_transit books it as tr_dropped, and it
             # must NOT count as retried.
             tr_free = jnp.any(jnp.isinf(state["tr_time"]) & row[:, None])
+            booked = {
+                **state,
+                "srv_fault_retried": state["srv_fault_retried"]
+                + row_i * tr_free.astype(jnp.int32),
+            }
+            if self.has_telemetry and self.tel_rates:
+                booked["tel_srv_fault_retried"] = self._tel_count(
+                    state,
+                    "tel_srv_fault_retried",
+                    self._tel_wrow(t),
+                    row,
+                    tr_free,
+                )
             parked = self._into_transit(
-                {
-                    **state,
-                    "srv_fault_retried": state["srv_fault_retried"]
-                    + row_i * tr_free.astype(jnp.int32),
-                },
+                booked,
                 v,
                 t + delay,
                 created,
@@ -1435,7 +1776,7 @@ class _Compiled:
             "enq": t + jnp.float32(0.0),
             "attempt": jnp.int32(attempt) + jnp.int32(0),
         }
-        return {
+        out = {
             **state,
             "_qpush": desc,
             "srv_q_len": state["srv_q_len"] + row_i * has_room.astype(jnp.int32),
@@ -1444,6 +1785,15 @@ class _Compiled:
             "srv_dropped": state["srv_dropped"]
             + row_i * (~has_room).astype(jnp.int32),
         }
+        if self.has_telemetry and self.tel_rates:
+            wrow = self._tel_wrow(t)
+            out["tel_srv_retried"] = self._tel_count(
+                state, "tel_srv_retried", wrow, row, has_room
+            )
+            out["tel_srv_dropped"] = self._tel_count(
+                state, "tel_srv_dropped", wrow, row, ~has_room
+            )
+        return out
 
     def _read_queue_head(self, state, qro, v: int, head):
         """O(1) gather of the head item's metadata, forwarding a same-step
@@ -1496,6 +1846,10 @@ class _Compiled:
             "srv_slot_done": jnp.where(slot_mask, INF, state["srv_slot_done"]),
             "srv_completed": state["srv_completed"] + row_i,
         }
+        if self.has_telemetry and self.tel_rates:
+            state["tel_srv_completed"] = self._tel_count(
+                state, "tel_srv_completed", self._tel_wrow(t), row, True
+            )
         spec = self.model.servers[v]
         if spec.deadline_s is not None:
             # Deadline accounting: a completion whose sojourn blew the
@@ -1512,6 +1866,10 @@ class _Compiled:
                 "srv_timed_out": state["srv_timed_out"]
                 + row_i * timed_out.astype(jnp.int32),
             }
+            if self.has_telemetry and self.tel_rates:
+                state["tel_srv_timed_out"] = self._tel_count(
+                    state, "tel_srv_timed_out", self._tel_wrow(t), row, timed_out
+                )
             if spec.retry_backoff_s is not None:
                 delay = self._backoff_delay(
                     self._uslot(u, self.U_JIT),
@@ -1522,12 +1880,17 @@ class _Compiled:
                 # Same has-room gate as _enqueue_retry: an overflowed
                 # retry is a transit drop, not a booked retry.
                 tr_free = jnp.any(jnp.isinf(state["tr_time"]) & row[:, None])
+                booked = {
+                    **state,
+                    "srv_retried": state["srv_retried"]
+                    + row_i * tr_free.astype(jnp.int32),
+                }
+                if self.has_telemetry and self.tel_rates:
+                    booked["tel_srv_retried"] = self._tel_count(
+                        state, "tel_srv_retried", self._tel_wrow(t), row, tr_free
+                    )
                 retried_state = self._into_transit(
-                    {
-                        **state,
-                        "srv_retried": state["srv_retried"]
-                        + row_i * tr_free.astype(jnp.int32),
-                    },
+                    booked,
                     v,
                     t + delay,
                     created,
@@ -1637,6 +2000,24 @@ class _Compiled:
             out["srv_hedge_wins"] = state["srv_hedge_wins"] + row_i * (
                 has_queued & hedge_pull_win
             ).astype(jnp.int32)
+        if self.has_telemetry:
+            wrow = self._tel_wrow(t)
+            if self.tel_util:
+                overlap = self._tel_overlap(t, t + service)
+                out["tel_srv_busy_int"] = state["tel_srv_busy_int"] + jnp.where(
+                    measured_pull, 1.0, 0.0
+                ) * overlap[:, None] * row.astype(jnp.float32)[None, :]
+            if self.tel_rates and hedge_pull is not None:
+                out["tel_srv_hedged"] = self._tel_count(
+                    state, "tel_srv_hedged", wrow, row, has_queued & hedge_pull
+                )
+                out["tel_srv_hedge_wins"] = self._tel_count(
+                    state,
+                    "tel_srv_hedge_wins",
+                    wrow,
+                    row,
+                    has_queued & hedge_pull_win,
+                )
         return out
 
     def _transit_arrive(self, v: int, state, qro, t, u, params):
@@ -1706,6 +2087,10 @@ class _Compiled:
             )
         )
         qro_keys = self._qro_keys()
+        # Sink-telemetry buffers are held out of the branch-visible state
+        # exactly like the queue rings (big arrays must not flow through
+        # predicated branch selects); empty tuple when telemetry is off.
+        tso_keys = self.tel_sink_keys if self.has_telemetry else ()
 
         def step(carry, x):
             if windowed:
@@ -1714,8 +2099,15 @@ class _Compiled:
                 state, params = carry
                 limit = horizon
             qro = {k: state[k] for k in qro_keys}
-            small = {k: v for k, v in state.items() if k not in qro_keys}
+            tso = {k: state[k] for k in tso_keys}
+            small = {
+                k: v
+                for k, v in state.items()
+                if k not in qro_keys and k not in tso_keys
+            }
             small["_qpush"] = self._null_qpush()
+            if tso_keys:
+                small["_tspush"] = self._null_tspush()
 
             candidates = self.next_candidates(small)
             event_index = jnp.argmin(candidates)
@@ -1740,7 +2132,8 @@ class _Compiled:
                 # Only the post-warmup portion of the interval counts toward
                 # the depth integral (handles intervals straddling the cutoff).
                 warmup = jnp.float32(self.warmup)
-                dt = jnp.maximum(t_next - jnp.maximum(s["t"], warmup), 0.0)
+                measured_lo = jnp.maximum(s["t"], warmup)
+                dt = jnp.maximum(t_next - measured_lo, 0.0)
                 s = {
                     **s,
                     "srv_depth_int": s["srv_depth_int"]
@@ -1748,6 +2141,14 @@ class _Compiled:
                     "t": t_next,
                     "events": s["events"] + 1,
                 }
+                if self.has_telemetry and self.tel_queue:
+                    # The same measured interval, split across the window
+                    # edges it spans (sums to the whole-run integral).
+                    overlap = self._tel_overlap(measured_lo, t_next)
+                    s["tel_srv_depth_int"] = s["tel_srv_depth_int"] + (
+                        overlap[:, None]
+                        * s["srv_q_len"].astype(jnp.float32)[None, :]
+                    )
                 return lax.switch(event_index, branches, s, qro, t_next, u, params)
 
             small = lax.cond(done, lambda s: s, process, small)
@@ -1755,6 +2156,10 @@ class _Compiled:
             # the (nV, K) arrays never flow through per-leaf selects.
             desc = small.pop("_qpush")
             state = {**small, **self._apply_qpush(qro, desc)}
+            if tso_keys:
+                # Likewise the step's one sink-telemetry write.
+                tdesc = state.pop("_tspush")
+                state.update(self._tel_apply_sink(tso, tdesc))
             return ((state, params, limit) if windowed else (state, params)), None
 
         return step
@@ -1858,6 +2263,7 @@ def _run_ensemble_segmented(
     seed: int,
     max_events: int,
     macro_block: int,
+    telemetry_sig: str,
     checkpoint_every_s: Optional[float],
     checkpoint_callback,
     resume_from: Optional[EnsembleCheckpoint],
@@ -1877,6 +2283,11 @@ def _run_ensemble_segmented(
             "model_fingerprint": (resume_from.model_fingerprint, fingerprint),
             "params_fingerprint": (resume_from.params_fingerprint, p_fingerprint),
             "macro_block": (resume_from.macro_block, macro_block),
+            # Telemetry buffers ride the state, so a spec mismatch is a
+            # silent shape/meaning error; "" on BOTH sides (telemetry-free
+            # run resuming a pre-telemetry or telemetry-free checkpoint)
+            # passes the plain equality check.
+            "telemetry": (resume_from.telemetry, telemetry_sig),
         }
         # Empty fingerprints / macro_block 0 = "unknown" (checkpoint
         # predates the field): skip those rather than reject older files.
@@ -1984,6 +2395,7 @@ def _run_ensemble_segmented(
                 model_fingerprint=fingerprint,
                 params_fingerprint=p_fingerprint,
                 macro_block=macro_block,
+                telemetry=telemetry_sig,
             )
             checkpoint_callback(snapshot)
             last_snapshot = _wall.perf_counter()
@@ -2220,6 +2632,20 @@ def run_ensemble(
             reduced["srv_hedge_wins"] = jnp.sum(final["srv_hedge_wins"], axis=0)
         if compiled.has_loss:
             reduced["net_lost"] = jnp.sum(final["net_lost"])
+        if compiled.has_telemetry:
+            for key in compiled.tel_sum_keys:
+                reduced[key] = jnp.sum(final[key], axis=0)
+            if compiled.tel_throughput:
+                # "spread" keeps the (R, nW, nK) counts per replica: the
+                # host computes mean/p10/p90 across replicas AND the
+                # int64 totals; otherwise sum over replicas on device.
+                reduced["tel_sink_count"] = (
+                    final["tel_sink_count"]
+                    if compiled.tel_spread
+                    else jnp.sum(final["tel_sink_count"], axis=0)
+                )
+            if compiled.tel_faults:
+                reduced["tel_fault_int"] = compiled._tel_fault_integral(final)
         return reduced
 
     if checkpoint_every_s is not None and checkpoint_callback is None:
@@ -2270,6 +2696,9 @@ def run_ensemble(
             seed=seed,
             max_events=max_events,
             macro_block=macro,
+            telemetry_sig=(
+                compiled.telemetry.signature() if compiled.has_telemetry else ""
+            ),
             checkpoint_every_s=checkpoint_every_s,
             checkpoint_callback=checkpoint_callback,
             resume_from=resume_from,
@@ -2301,6 +2730,13 @@ def _build_result(
     host = {k: np.asarray(v) for k, v in reduced.items()}
     nV_real = len(model.servers)
     nL_real = len(model.limiters)
+    # Windowed telemetry series (the chain fast path declines telemetry
+    # models, so a telemetry run always reaches here via the event scan).
+    timeseries = None
+    if compiled.has_telemetry and any(k.startswith("tel_") for k in host):
+        timeseries = build_timeseries(
+            compiled.telemetry, compiled, host, n_replicas
+        )
     sink_count = host["sink_count"].astype(np.int64)
     with np.errstate(divide="ignore", invalid="ignore"):
         sink_mean = np.where(sink_count > 0, host["sink_sum"] / sink_count, 0.0)
@@ -2346,6 +2782,7 @@ def _build_result(
         server_hedged=_per_server(host, "srv_hedged", nV_real),
         server_hedge_wins=_per_server(host, "srv_hedge_wins", nV_real),
         network_lost=int(host.get("net_lost", 0)),
+        timeseries=timeseries,
     )
 
 

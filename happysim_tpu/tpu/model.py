@@ -26,6 +26,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Sequence, Union
 
+from happysim_tpu.tpu.telemetry import DEFAULT_METRICS, TelemetrySpec
+
 SOURCE = "source"
 SERVER = "server"
 SINK = "sink"
@@ -341,6 +343,10 @@ class EnsembleModel:
         self.remotes: list[RemoteSpec] = []
         # Shared Bernoulli-trigger schedule for correlated=True faults.
         self.correlated_faults: Optional[CorrelatedOutages] = None
+        # Device-side windowed telemetry (see tpu/telemetry.py); None
+        # keeps the compiled program bit-identical to a telemetry-free
+        # build.
+        self.telemetry_spec: Optional[TelemetrySpec] = None
 
     # -- builders ----------------------------------------------------------
     def source(
@@ -534,6 +540,29 @@ class EnsembleModel:
         self.sinks.append(SinkSpec())
         return NodeRef(SINK, len(self.sinks) - 1)
 
+    def telemetry(
+        self,
+        window_s: float,
+        metrics: Sequence[str] = DEFAULT_METRICS,
+    ) -> TelemetrySpec:
+        """Enable device-side windowed telemetry (tpu/telemetry.py).
+
+        The compiled step scatter-adds into ``(n_windows, ...)`` state
+        buffers at the existing accounting sites, yielding per-window
+        throughput, latency percentiles, queue/utilization integrals,
+        drop/retry/loss rates, cross-replica spread, and fault-window
+        occupancy as :attr:`EnsembleResult.timeseries`. ``window_s``
+        must tile the horizon into >= 2 and <= 4096 windows. Telemetry
+        adds no RNG draws, so the simulated trajectory on the event
+        scan is bit-identical to the same model without it (the chain
+        fast path declines telemetry models, and the partitioned
+        executor rejects them).
+        """
+        spec = TelemetrySpec(window_s=float(window_s), metrics=tuple(metrics))
+        spec.validate(self.horizon_s)
+        self.telemetry_spec = spec
+        return spec
+
     def remote(self, ingress: NodeRef, latency_s: float) -> NodeRef:
         """Cross-partition egress: jobs exit here and arrive at the
         NEIGHBOR partition's ``ingress`` server after ``latency_s``
@@ -649,6 +678,8 @@ class EnsembleModel:
                 raise ValueError(f"router targeted by source[{i}] has no targets")
         if self.correlated_faults is not None:
             self.correlated_faults.validate()
+        if self.telemetry_spec is not None:
+            self.telemetry_spec.validate(self.horizon_s)
         for i, server in enumerate(self.servers):
             if server.downstream is None:
                 raise ValueError(f"server[{i}] has no downstream")

@@ -423,6 +423,16 @@ def run_partitioned(
     """
     if not model.remotes:
         raise ValueError("run_partitioned needs at least one model.remote(...)")
+    if getattr(model, "telemetry_spec", None) is not None:
+        # Soundly decline rather than emit half-wired buffers: the
+        # partitioned window barrier has its own depth-integral close-out
+        # and cross-partition reduce paths that do not thread the
+        # telemetry buffers yet.
+        raise ValueError(
+            "windowed telemetry is not supported by run_partitioned; "
+            "use run_ensemble (replica data parallelism) for telemetry "
+            "models or drop the TelemetrySpec"
+        )
     if outbox_capacity < 1:
         raise ValueError(
             f"outbox_capacity={outbox_capacity} must be >= 1: every remote "

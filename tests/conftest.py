@@ -40,7 +40,9 @@ _TPU_TEST_FILES = {
     "test_mm1_queue.py",
     "test_tpu_checkpoint.py",
     "test_tpu_macro_block.py",
+    "test_tpu_telemetry.py",
     "test_arrival_regression.py",
+    "test_telemetry_regression.py",
 }
 # Long host-side suites (examples execute end-to-end, some on the TPU path).
 _SLOW_TEST_FILES = {"test_examples.py"}

@@ -1,0 +1,450 @@
+"""Device-side windowed telemetry: the observation-only contract.
+
+Three properties anchor the subsystem (ISSUE 3 acceptance criteria):
+
+1. EXACT MERGE — a telemetry-enabled model's per-window counter series
+   sum exactly to the whole-run ``EnsembleResult`` counters and
+   ``sink_hist`` (integer scatter-adds partition the same events the
+   whole-run accumulators see).
+2. OBSERVATION ONLY — telemetry adds no RNG draws and no dynamics, so
+   the simulated trajectory is bit-identical to the same model without
+   a spec (on the event scan), and a telemetry-free model traces to the
+   exact same program as before the subsystem existed.
+3. DURABILITY — the buffers ride the scan carry, so mid-run checkpoint
+   + resume reproduces the uninterrupted run's series exactly, and a
+   spec mismatch at resume is rejected like ``macro_block``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu import (
+    EnsembleModel,
+    FaultSpec,
+    mm1_model,
+    run_ensemble,
+    run_partitioned,
+)
+from happysim_tpu.tpu.chain import fast_plan
+from happysim_tpu.tpu.engine import _Compiled, model_fingerprint
+
+
+def _mm1(telemetry_window=None, **model_kwargs):
+    model = mm1_model(
+        lam=8.0, mu=10.0, horizon_s=12.0, warmup_s=2.0, **model_kwargs
+    )
+    if telemetry_window is not None:
+        model.telemetry(window_s=telemetry_window)
+    return model
+
+
+def _chaos_model(telemetry_window=None):
+    """Every accounting site live at once: limiter admission, transit
+    latency, deadline retries, stochastic outage faults with backoff
+    retries, packet loss."""
+    model = EnsembleModel(horizon_s=20.0)
+    src = model.source(rate=6.0)
+    lim = model.limiter(refill_rate=5.0, capacity=4.0)
+    srv = model.server(
+        concurrency=1,
+        service_mean=0.12,
+        queue_capacity=4,
+        deadline_s=1.5,
+        max_retries=2,
+        fault=FaultSpec(rate=0.2, mean_duration_s=1.0, mode="outage"),
+        retry_backoff_s=0.05,
+        retry_jitter=0.5,
+    )
+    snk = model.sink()
+    model.connect(src, lim)
+    model.connect(lim, srv, latency_s=0.01)
+    model.connect(srv, snk, loss_p=0.05)
+    if telemetry_window is not None:
+        model.telemetry(window_s=telemetry_window)
+    return model
+
+
+SIM_FIELDS_EXCLUDED = {"wall_seconds", "events_per_second", "timeseries"}
+
+
+def assert_simulation_identical(a, b):
+    """Every simulation-output field bit-identical (timing + the series
+    themselves excluded — telemetry must not change the simulation)."""
+    for field in dataclasses.fields(a):
+        if field.name in SIM_FIELDS_EXCLUDED:
+            continue
+        left, right = getattr(a, field.name), getattr(b, field.name)
+        if isinstance(left, np.ndarray):
+            assert np.array_equal(left, right), field.name
+        else:
+            assert left == right, f"{field.name}: {left!r} != {right!r}"
+
+
+class TestExactMerge:
+    def test_mm1_window_sums_equal_whole_run(self):
+        result = run_ensemble(
+            _mm1(telemetry_window=1.5), n_replicas=32, seed=11, max_events=480
+        )
+        ts = result.timeseries
+        assert ts is not None and ts.n_windows == 8
+        assert ts.sink_count.sum(axis=0).tolist() == result.sink_count
+        assert np.array_equal(ts.sink_hist.sum(axis=0), result.sink_hist)
+        assert ts.server_completed.sum(axis=0).tolist() == result.server_completed
+        # Float integrals re-associate but must agree tightly.
+        denominator = result.n_replicas * ts.measured_len_s
+        whole_depth = result.server_mean_queue_len[0] * denominator.sum()
+        windowed_depth = (
+            np.asarray(ts.server_mean_queue_len)[:, 0] * denominator
+        ).sum()
+        assert windowed_depth == pytest.approx(whole_depth, rel=1e-5)
+        whole_busy = result.server_utilization[0] * denominator.sum()
+        windowed_busy = (
+            np.asarray(ts.server_utilization)[:, 0] * denominator
+        ).sum()
+        assert windowed_busy == pytest.approx(whole_busy, rel=1e-5)
+
+    def test_chaos_counters_all_partition_exactly(self):
+        result = run_ensemble(_chaos_model(telemetry_window=2.0), n_replicas=64, seed=7)
+        ts = result.timeseries
+        pairs = [
+            (ts.sink_count, result.sink_count),
+            (ts.server_completed, result.server_completed),
+            (ts.server_dropped, result.server_dropped),
+            (ts.server_timed_out, result.server_timed_out),
+            (ts.server_retried, result.server_retried),
+            (ts.server_fault_dropped, result.server_fault_dropped),
+            (ts.server_fault_retried, result.server_fault_retried),
+            (ts.limiter_admitted, result.limiter_admitted),
+            (ts.limiter_dropped, result.limiter_dropped),
+            (ts.transit_dropped, result.transit_dropped),
+        ]
+        for series, whole in pairs:
+            assert series.sum(axis=0).tolist() == whole
+        assert int(ts.network_lost.sum()) == result.network_lost
+        assert np.array_equal(ts.sink_hist.sum(axis=0), result.sink_hist)
+        # Something actually happened on every counter family this model
+        # exercises, or the test proves nothing.
+        assert result.network_lost > 0
+        assert result.server_fault_dropped[0] > 0
+        assert result.server_fault_retried[0] > 0
+        assert result.limiter_dropped[0] > 0
+
+    def test_fault_occupancy_tracks_duty_cycle(self):
+        from happysim_tpu.tpu.faults import duty_cycle
+
+        result = run_ensemble(_chaos_model(telemetry_window=2.0), n_replicas=256, seed=5)
+        occupancy = np.asarray(result.timeseries.fault_occupancy)[:, 0]
+        expected = duty_cycle(0.2, 1.0)
+        # Early windows: renewal process not yet truncated by max_windows;
+        # 256 replicas x 2s windows gives a loose-but-real gate.
+        assert occupancy[:5].mean() == pytest.approx(expected, rel=0.5)
+        assert (occupancy >= 0.0).all() and (occupancy <= 1.0).all()
+
+    def test_spread_percentiles_bracket_the_mean(self):
+        result = run_ensemble(
+            _mm1(telemetry_window=1.5), n_replicas=64, seed=2, max_events=960
+        )
+        ts = result.timeseries
+        busy = slice(2, ts.n_windows)  # post-warmup windows
+        assert (
+            ts.replica_throughput_p10[busy, 0]
+            <= ts.replica_throughput_mean[busy, 0]
+        ).all()
+        assert (
+            ts.replica_throughput_mean[busy, 0]
+            <= ts.replica_throughput_p90[busy, 0]
+        ).all()
+        # Mean per-replica rate times replicas times window length must
+        # rebuild the aggregate counts.
+        rebuilt = (
+            ts.replica_throughput_mean[:, 0]
+            * result.n_replicas
+            * ts.window_len_s
+        )
+        np.testing.assert_allclose(rebuilt, ts.sink_count[:, 0], rtol=1e-6)
+
+
+class TestRouterTopologies:
+    """Sink deliveries with TRACED sink indices (router choices) must
+    window correctly, including the mixed sink/server feedback shape
+    whose sink edge carries the only latency in the model (the shape
+    that exposed the has_transit router gap fixed in this PR)."""
+
+    @staticmethod
+    def _feedback_model(telemetry: bool):
+        model = EnsembleModel(horizon_s=10.0)
+        src = model.source(rate=6.0)
+        srv = model.server(service_mean=0.05, queue_capacity=32)
+        snk = model.sink()
+        rtr = model.router(policy="random")
+        model.connect(src, srv)
+        model.connect(srv, rtr)
+        model.connect(rtr, snk, latency_s=0.02)  # only latency edge
+        model.connect(rtr, srv)  # latency-free feedback to the server
+        if telemetry:
+            model.telemetry(window_s=1.0)
+        return model
+
+    def test_mixed_feedback_router_with_sink_edge_latency(self):
+        result = run_ensemble(
+            self._feedback_model(True), n_replicas=16, seed=4, max_events=2000
+        )
+        base = run_ensemble(
+            self._feedback_model(False), n_replicas=16, seed=4, max_events=2000
+        )
+        ts = result.timeseries
+        assert ts.sink_count.sum(axis=0).tolist() == result.sink_count
+        assert np.array_equal(ts.sink_hist.sum(axis=0), result.sink_hist)
+        assert_simulation_identical(result, base)
+
+    def test_two_sink_fanout_windows_each_sink(self):
+        def build(telemetry: bool):
+            model = EnsembleModel(horizon_s=8.0)
+            src = model.source(rate=5.0)
+            sink_a, sink_b = model.sink(), model.sink()
+            rtr = model.router(policy="round_robin")
+            model.connect(src, rtr)
+            model.connect(rtr, sink_a)
+            model.connect(rtr, sink_b, latency_s=0.01)
+            if telemetry:
+                model.telemetry(window_s=1.0)
+            return model
+
+        result = run_ensemble(build(True), n_replicas=16, seed=9, max_events=400)
+        base = run_ensemble(build(False), n_replicas=16, seed=9, max_events=400)
+        ts = result.timeseries
+        assert ts.sink_count.shape == (8, 2)
+        assert ts.sink_count.sum(axis=0).tolist() == result.sink_count
+        assert np.array_equal(ts.sink_hist.sum(axis=0), result.sink_hist)
+        assert_simulation_identical(result, base)
+
+
+class TestObservationOnly:
+    def test_simulation_bit_identical_with_and_without_telemetry(self):
+        with_tel = run_ensemble(
+            _mm1(telemetry_window=1.5), n_replicas=32, seed=11, max_events=480
+        )
+        without = run_ensemble(_mm1(), n_replicas=32, seed=11, max_events=480)
+        assert with_tel.timeseries is not None and without.timeseries is None
+        assert_simulation_identical(with_tel, without)
+
+    def test_chaos_simulation_bit_identical(self):
+        with_tel = run_ensemble(_chaos_model(telemetry_window=2.0), n_replicas=32, seed=7)
+        without = run_ensemble(_chaos_model(), n_replicas=32, seed=7)
+        assert_simulation_identical(with_tel, without)
+
+    def test_telemetry_free_model_traces_identical_program(self):
+        """A model that never had a spec and one whose spec was cleared
+        must produce the same jaxpr, with no telemetry buffers in the
+        carry — the compile-time gate leaves zero residue."""
+
+        def step_jaxpr(model):
+            compiled = _Compiled(model)
+            key = jax.random.PRNGKey(0)
+            params = {
+                "src_rate": jnp.full((compiled.nS,), 8.0),
+                "srv_mean": jnp.full((compiled.nV,), 0.1),
+            }
+            state = compiled.init_state(key, params)
+            step = compiled.make_step(float(model.horizon_s), external_u=True)
+            return str(
+                jax.make_jaxpr(step)(
+                    (state, params), jnp.full((compiled.n_draws,), 0.5)
+                )
+            )
+
+        never = _mm1()
+        cleared = _mm1(telemetry_window=1.0)
+        cleared.telemetry_spec = None
+        enabled = _mm1(telemetry_window=1.0)
+        assert step_jaxpr(never) == step_jaxpr(cleared)
+        assert step_jaxpr(never) != step_jaxpr(enabled)
+        free_state = _Compiled(never).init_state(
+            jax.random.PRNGKey(0),
+            {"src_rate": jnp.full((1,), 8.0), "srv_mean": jnp.full((1,), 0.1)},
+        )
+        assert not any(key.startswith("tel_") for key in free_state)
+
+    def test_telemetry_free_fingerprint_unchanged(self):
+        """Telemetry joins the model fingerprint only when present, so
+        existing telemetry-free checkpoints stay resumable."""
+        assert model_fingerprint(_mm1()) != model_fingerprint(
+            _mm1(telemetry_window=1.0)
+        )
+        cleared = _mm1(telemetry_window=1.0)
+        cleared.telemetry_spec = None
+        assert model_fingerprint(_mm1()) == model_fingerprint(cleared)
+
+
+class TestExecutorRouting:
+    def test_chain_fast_path_declines_telemetry(self):
+        chain_eligible = mm1_model(lam=8.0, mu=10.0, horizon_s=10.0)
+        assert fast_plan(chain_eligible) is not None
+        chain_eligible.telemetry(window_s=1.0)
+        assert fast_plan(chain_eligible) is None
+        # And run_ensemble still produces the series via the event scan.
+        result = run_ensemble(chain_eligible, n_replicas=8, seed=0)
+        assert result.timeseries is not None
+        assert result.timeseries.sink_count.sum(axis=0).tolist() == result.sink_count
+
+    def test_partitioned_rejects_telemetry(self):
+        model = EnsembleModel(horizon_s=2.0)
+        src = model.source(rate=5.0)
+        srv = model.server(service_mean=0.05)
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        model.remote(ingress=srv, latency_s=0.5)
+        model.telemetry(window_s=0.5)
+        with pytest.raises(ValueError, match="telemetry"):
+            run_partitioned(model, window_s=0.5)
+
+    def test_metric_subset_allocates_only_requested_buffers(self):
+        model = _mm1()
+        model.telemetry(window_s=1.5, metrics=("latency",))
+        compiled = _Compiled(model)
+        state = compiled.init_state(
+            jax.random.PRNGKey(0),
+            {"src_rate": jnp.full((1,), 8.0), "srv_mean": jnp.full((1,), 0.1)},
+        )
+        tel_keys = {key for key in state if key.startswith("tel_")}
+        assert tel_keys == {"tel_sink_sum", "tel_sink_hist"}
+        result = run_ensemble(model, n_replicas=8, seed=0, max_events=200)
+        ts = result.timeseries
+        assert ts.sink_p99_s is not None and ts.sink_count is None
+        assert ts.server_mean_queue_len is None and ts.server_completed is None
+
+
+class TestCheckpointDurability:
+    KW = dict(n_replicas=16, seed=3, max_events=400)
+
+    def test_mid_run_resume_reproduces_series_exactly(self):
+        baseline = run_ensemble(_mm1(telemetry_window=1.5), **self.KW)
+        snapshots = []
+        checkpointed = run_ensemble(
+            _mm1(telemetry_window=1.5),
+            **self.KW,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+        )
+        assert checkpointed.timeseries == baseline.timeseries
+        assert snapshots and all(
+            0 < snap.chunk_index < snap.n_chunks for snap in snapshots
+        )
+        middle = snapshots[len(snapshots) // 2]
+        assert middle.telemetry.startswith("window_s=1.5;")
+        assert any(key.startswith("tel_") for key in middle.state)
+        resumed = run_ensemble(
+            _mm1(telemetry_window=1.5), **self.KW, resume_from=middle
+        )
+        assert resumed.timeseries == baseline.timeseries
+        assert_simulation_identical(resumed, baseline)
+
+    def test_npz_round_trip_preserves_buffers(self, tmp_path):
+        from happysim_tpu.tpu import EnsembleCheckpoint
+
+        snapshots = []
+        baseline = run_ensemble(
+            _mm1(telemetry_window=1.5),
+            **self.KW,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+        )
+        middle = snapshots[len(snapshots) // 2]
+        path = str(tmp_path / "telemetry-checkpoint")
+        middle.save(path)
+        loaded = EnsembleCheckpoint.load(path)
+        assert loaded.telemetry == middle.telemetry
+        resumed = run_ensemble(
+            _mm1(telemetry_window=1.5), **self.KW, resume_from=loaded
+        )
+        assert resumed.timeseries == baseline.timeseries
+
+    def test_resume_rejects_spec_mismatch(self):
+        snapshots = []
+        run_ensemble(
+            _mm1(telemetry_window=1.5),
+            **self.KW,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+        )
+        middle = snapshots[len(snapshots) // 2]
+        with pytest.raises(ValueError, match="telemetry|fingerprint"):
+            run_ensemble(
+                _mm1(telemetry_window=3.0), **self.KW, resume_from=middle
+            )
+        with pytest.raises(ValueError, match="telemetry|fingerprint"):
+            run_ensemble(_mm1(), **self.KW, resume_from=middle)
+
+    def test_legacy_telemetry_free_checkpoint_still_resumes(self):
+        """Pre-telemetry checkpoints load with telemetry="" and resume
+        into telemetry-free runs unchanged."""
+        snapshots = []
+        baseline = run_ensemble(
+            _mm1(),
+            **self.KW,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+        )
+        legacy = dataclasses.replace(
+            snapshots[len(snapshots) // 2], telemetry=""
+        )
+        resumed = run_ensemble(_mm1(), **self.KW, resume_from=legacy)
+        assert_simulation_identical(resumed, baseline)
+
+
+class TestShardingInvariance:
+    def test_series_identical_across_mesh_layouts(self, cpu_mesh):
+        """Same seed on a 1-device and an 8-device mesh: the windowed
+        buffers shard on the replica axis like every other state leaf,
+        so the series must be bit-identical (the engine's sharding
+        oracle, extended to telemetry)."""
+        kwargs = dict(n_replicas=16, seed=3, max_events=400)
+        single = run_ensemble(_mm1(telemetry_window=1.5), **kwargs)
+        sharded = run_ensemble(
+            _mm1(telemetry_window=1.5), **kwargs, mesh=cpu_mesh
+        )
+        assert sharded.timeseries == single.timeseries
+        assert_simulation_identical(sharded, single)
+
+
+class TestInstrumentationBridge:
+    def test_to_data_feeds_existing_tooling(self):
+        from happysim_tpu.instrumentation.data import Data
+
+        result = run_ensemble(
+            _mm1(telemetry_window=1.5), n_replicas=16, seed=3, max_events=400
+        )
+        datasets = result.timeseries.to_data()
+        p99 = datasets["sink[0].p99_s"]
+        assert isinstance(p99, Data) and len(p99) == 8
+        np.testing.assert_allclose(
+            p99.times_s, result.timeseries.window_start_s
+        )
+        # The existing bucketing/statistics pipeline consumes it as-is.
+        assert p99.max() >= p99.mean() >= 0.0
+        assert len(p99.bucket(3.0)) >= 2
+
+    def test_to_dataframe_schema(self):
+        pandas = pytest.importorskip("pandas")
+
+        result = run_ensemble(
+            _mm1(telemetry_window=1.5), n_replicas=16, seed=3, max_events=400
+        )
+        frame = result.timeseries.to_dataframe()
+        assert isinstance(frame, pandas.DataFrame)
+        assert len(frame) == 8
+        for column in (
+            "window_start_s",
+            "sink[0].count",
+            "sink[0].p99_s",
+            "server[0].mean_queue_len",
+            "server[0].utilization",
+            "server[0].completed",
+        ):
+            assert column in frame.columns
+        assert frame["sink[0].count"].sum() == result.sink_count[0]

@@ -1,0 +1,95 @@
+"""Pinned-seed golden for the windowed-telemetry scatter-adds.
+
+The same pinned tuple as tests/regression/test_arrival_regression.py
+(32-replica M/M/1, lam=8 mu=10, 12s horizon, 2s warmup, seed 11,
+max_events=480 — the explicit budget forces the event scan), with a
+16-window spec. The windowed goldens were recorded on the CPU backend
+at macro-block 32. Two things are pinned:
+
+1. The per-window counter/percentile series themselves — drift means
+   the window-assignment arithmetic or an accounting site moved.
+2. The merge identity: windowed totals sum EXACTLY to the whole-run
+   counters/histogram, which in turn still match the telemetry-free
+   goldens — proving telemetry never perturbs the simulation it
+   observes.
+"""
+
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu.engine import run_ensemble
+from happysim_tpu.tpu.model import mm1_model
+
+# Whole-run goldens shared with test_arrival_regression.py (the pinned
+# stream is the same — telemetry adds no draws).
+GOLDEN_WHOLE = {
+    "sink_count": [2492],
+    "simulated_events": 5958,
+    "server_completed": [2908],
+}
+
+# 16-window series goldens (window_s = 0.75 over the 12s horizon).
+GOLDEN_SINK_COUNTS = [
+    0, 0, 63, 180, 203, 187, 170, 193,
+    186, 198, 194, 179, 169, 207, 163, 200,
+]
+GOLDEN_SERVER_COMPLETED = [
+    125, 185, 169, 180, 203, 187, 170, 193,
+    186, 198, 194, 179, 169, 207, 163, 200,
+]
+GOLDEN_P99_S = [
+    0.0, 0.0, 0.8912509381, 1.1220184543,
+    1.77827941, 1.4125375446, 1.77827941, 1.77827941,
+    1.1220184543, 1.4125375446, 1.77827941, 2.2387211386,
+    1.77827941, 1.77827941, 1.77827941, 1.77827941,
+]
+
+
+def _pinned_run():
+    model = mm1_model(lam=8.0, mu=10.0, horizon_s=12.0, warmup_s=2.0)
+    model.telemetry(window_s=0.75)  # 16 windows
+    return run_ensemble(model, n_replicas=32, seed=11, max_events=480)
+
+
+@pytest.mark.parametrize("early_exit", ["1", "0"])
+def test_pinned_seed_reproduces_windowed_goldens(early_exit, monkeypatch):
+    monkeypatch.setenv("HS_TPU_EARLY_EXIT", early_exit)
+    result = _pinned_run()
+    ts = result.timeseries
+    assert ts is not None and ts.n_windows == 16
+
+    # The series themselves.
+    assert ts.sink_count[:, 0].tolist() == GOLDEN_SINK_COUNTS
+    assert ts.server_completed[:, 0].tolist() == GOLDEN_SERVER_COMPLETED
+    np.testing.assert_allclose(ts.sink_p99_s[:, 0], GOLDEN_P99_S, rtol=1e-9)
+
+    # The merge identity: windowed totals == whole-run counters, and the
+    # whole-run counters == the telemetry-free goldens.
+    assert result.sink_count == GOLDEN_WHOLE["sink_count"]
+    assert result.simulated_events == GOLDEN_WHOLE["simulated_events"]
+    assert result.server_completed == GOLDEN_WHOLE["server_completed"]
+    assert result.truncated_replicas == 0
+    assert ts.sink_count.sum(axis=0).tolist() == result.sink_count
+    assert ts.server_completed.sum(axis=0).tolist() == result.server_completed
+    assert np.array_equal(ts.sink_hist.sum(axis=0), result.sink_hist)
+
+    # First two windows end before the 2s warmup: sink measurement is
+    # masked there while whole-run server completions are not.
+    assert ts.sink_count[:2, 0].tolist() == [0, 0]
+    assert ts.server_completed[0, 0] > 0
+
+
+def test_windowed_histogram_merges_into_whole_run_percentiles():
+    """p50/p99 computed from the MERGED windowed histograms must equal
+    the whole-run percentile numbers — the histogram partition is exact,
+    not just the counts."""
+    from happysim_tpu.tpu.engine import hist_percentile
+
+    result = _pinned_run()
+    merged = result.timeseries.sink_hist.sum(axis=0)
+    assert hist_percentile(merged[0], 0.5) == pytest.approx(
+        result.sink_p50_s[0], rel=1e-12
+    )
+    assert hist_percentile(merged[0], 0.99) == pytest.approx(
+        result.sink_p99_s[0], rel=1e-12
+    )

@@ -1,0 +1,93 @@
+"""EnsembleResult.summary() must surface the whole-model chaos ledger.
+
+Regression guard for the accounting gap where ``network_lost`` and the
+fault/hedge totals were computed by the engine but never reached the
+:class:`~happysim_tpu.instrumentation.summary.SimulationSummary` — a
+chaos run's summary looked identical to a clean run's.
+"""
+
+import numpy as np
+
+from happysim_tpu.tpu.engine import HIST_BINS, EnsembleResult
+
+
+def _result(**overrides) -> EnsembleResult:
+    base = dict(
+        n_replicas=4,
+        horizon_s=10.0,
+        simulated_events=100,
+        wall_seconds=0.5,
+        events_per_second=200.0,
+        sink_count=[40],
+        sink_mean_latency_s=[0.2],
+        sink_p50_s=[0.1],
+        sink_p99_s=[0.9],
+        sink_hist=np.zeros((1, HIST_BINS), np.int32),
+        server_completed=[42],
+        server_dropped=[1],
+        server_outage_dropped=[0],
+        server_utilization=[0.5],
+        server_mean_wait_s=[0.05],
+        server_mean_queue_len=[0.4],
+        server_timed_out=[0],
+        server_retried=[0],
+        transit_dropped=[0],
+        limiter_admitted=[],
+        limiter_dropped=[],
+    )
+    base.update(overrides)
+    return EnsembleResult(**base)
+
+
+def _chaos_entities(summary):
+    return [e for e in summary.entities if e.kind == "Chaos"]
+
+
+def test_clean_run_has_no_chaos_entity():
+    assert _chaos_entities(_result().summary()) == []
+
+
+def test_network_lost_reaches_summary():
+    summary = _result(network_lost=257).summary()
+    (chaos,) = _chaos_entities(summary)
+    assert chaos.extra["network_lost"] == 257
+    # And it survives the dict serialization the analysis layer uses.
+    assert any(
+        entity.get("network_lost") == 257
+        for entity in summary.to_dict()["entities"]
+    )
+
+
+def test_fault_and_hedge_totals_reach_summary():
+    summary = _result(
+        server_fault_dropped=[3, 5],
+        server_fault_retried=[7, 0],
+        server_hedged=[2, 2],
+        server_hedge_wins=[1, 0],
+        server_completed=[42, 10],
+        server_dropped=[1, 0],
+        server_outage_dropped=[0, 0],
+        server_utilization=[0.5, 0.1],
+        server_mean_wait_s=[0.05, 0.0],
+        server_mean_queue_len=[0.4, 0.0],
+        server_timed_out=[0, 0],
+        server_retried=[0, 0],
+        transit_dropped=[0, 4],
+    ).summary()
+    (chaos,) = _chaos_entities(summary)
+    assert chaos.extra == {
+        "total_fault_dropped": 8,
+        "total_fault_retried": 7,
+        "total_hedged": 4,
+        "total_hedge_wins": 1,
+        "total_transit_dropped": 4,
+    }
+
+
+def test_zero_totals_stay_silent():
+    summary = _result(
+        server_fault_dropped=[0],
+        server_hedged=[0],
+        network_lost=0,
+    ).summary()
+    assert _chaos_entities(summary) == []

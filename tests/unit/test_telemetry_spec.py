@@ -1,0 +1,113 @@
+"""Window-edge semantics and validation for the telemetry spec.
+
+The device-side window assignment is ``floor(t / window_s)`` in float32,
+start-inclusive, clipped into ``[0, n_windows)``;
+:func:`~happysim_tpu.tpu.telemetry.window_index` is the host twin of
+exactly that arithmetic, so these tests pin the boundary contract the
+compiled scatter-adds follow without compiling anything.
+"""
+
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu.model import EnsembleModel, mm1_model
+from happysim_tpu.tpu.telemetry import (
+    DEFAULT_METRICS,
+    MAX_WINDOWS,
+    TelemetrySpec,
+    measured_window_lengths,
+    window_edges,
+    window_index,
+)
+
+
+class TestValidation:
+    def test_rejects_nonpositive_window(self):
+        for bad in (0.0, -1.0):
+            with pytest.raises(ValueError, match="window_s"):
+                TelemetrySpec(window_s=bad).validate(10.0)
+
+    def test_rejects_single_window_degenerate_spec(self):
+        """window_s >= horizon yields one window — that is just the
+        whole-run aggregate the engine already reports, so it is
+        rejected rather than silently duplicating it."""
+        with pytest.raises(ValueError, match="single window"):
+            TelemetrySpec(window_s=10.0).validate(10.0)
+        with pytest.raises(ValueError, match="single window"):
+            TelemetrySpec(window_s=50.0).validate(10.0)
+
+    def test_rejects_more_than_max_windows(self):
+        too_fine = 10.0 / (MAX_WINDOWS + 1)
+        with pytest.raises(ValueError, match="windows"):
+            TelemetrySpec(window_s=too_fine).validate(10.0)
+        # Exactly MAX_WINDOWS is fine.
+        TelemetrySpec(window_s=10.0 / MAX_WINDOWS).validate(10.0)
+
+    def test_rejects_unknown_and_empty_metrics(self):
+        with pytest.raises(ValueError, match="unknown telemetry metrics"):
+            TelemetrySpec(window_s=1.0, metrics=("latency", "bogus")).validate(10.0)
+        with pytest.raises(ValueError, match="empty"):
+            TelemetrySpec(window_s=1.0, metrics=()).validate(10.0)
+
+    def test_model_telemetry_validates_at_call(self):
+        model = mm1_model(horizon_s=10.0)
+        with pytest.raises(ValueError):
+            model.telemetry(window_s=0.0)
+        assert model.telemetry_spec is None
+        spec = model.telemetry(window_s=2.0)
+        assert model.telemetry_spec is spec
+        assert spec.metrics == DEFAULT_METRICS
+
+    def test_model_validate_checks_spec(self):
+        """A spec smuggled past the builder (set directly) still fails
+        model.validate(), which the engine calls before compiling."""
+        model = mm1_model(horizon_s=10.0)
+        model.telemetry_spec = TelemetrySpec(window_s=-1.0)
+        with pytest.raises(ValueError, match="window_s"):
+            model.validate()
+
+
+class TestWindowMath:
+    def test_n_windows_ceils_indivisible_horizon(self):
+        # 10 / 3 -> 4 windows, the last one 1s short.
+        assert TelemetrySpec(window_s=3.0).n_windows(10.0) == 4
+        assert TelemetrySpec(window_s=2.5).n_windows(10.0) == 4
+        # Float-noise guard: 0.1 * 100 must be 100 windows, not 101.
+        assert TelemetrySpec(window_s=0.1).n_windows(10.0) == 100
+
+    def test_boundary_event_belongs_to_later_window(self):
+        """Window w covers [w*window_s, (w+1)*window_s): an event landing
+        exactly on an edge is start-inclusive."""
+        assert window_index(0.0, 1.0, 8) == 0
+        assert window_index(3.0, 1.0, 8) == 3
+        assert window_index(2.999999, 1.0, 8) == 2
+        # Power-of-two window: boundary products are exact in float32.
+        assert window_index(1.5, 0.5, 8) == 3
+
+    def test_horizon_end_event_clips_into_last_window(self):
+        # t == horizon (the inclusive measurement end) must not index
+        # out of range when the horizon is a window multiple.
+        assert window_index(8.0, 1.0, 8) == 7
+        assert window_index(1e9, 1.0, 8) == 7
+        assert window_index(-0.5, 1.0, 8) == 0
+
+    def test_edges_last_window_open_then_clamped(self):
+        lo, hi = window_edges(3.0, 4)
+        np.testing.assert_allclose(lo, [0.0, 3.0, 6.0, 9.0])
+        assert np.isinf(hi[-1]) and hi[2] == 9.0
+        lo_c, hi_c = window_edges(3.0, 4, horizon_s=10.0)
+        assert hi_c[-1] == np.float32(10.0)  # short last window
+
+    def test_measured_lengths_respect_warmup_and_horizon(self):
+        # horizon 10, warmup 2, window 3: [0,3) has 1 measured second,
+        # the full windows 3, and the short last window [9,10) has 1.
+        lengths = measured_window_lengths(3.0, 4, horizon_s=10.0, warmup_s=2.0)
+        np.testing.assert_allclose(lengths, [1.0, 3.0, 3.0, 1.0])
+
+    def test_signature_roundtrip_identity(self):
+        a = TelemetrySpec(window_s=1.5, metrics=("latency", "rates"))
+        b = TelemetrySpec(window_s=1.5, metrics=("latency", "rates"))
+        c = TelemetrySpec(window_s=1.5, metrics=("rates", "latency"))
+        assert a.signature() == b.signature()
+        assert a.signature() != c.signature()
+        assert EnsembleModel(horizon_s=4.0).telemetry_spec is None
