@@ -22,9 +22,16 @@ from happysim_tpu.tpu.engine import (
     EnsembleResult,
     hist_percentile,
     macro_block_len,
+    maybe_enable_compile_cache,
     run_ensemble,
 )
 from happysim_tpu.tpu.faults import duty_cycle
+from happysim_tpu.tpu.kernels import (
+    KERNEL_ENV,
+    kernel_decision,
+    kernel_plan,
+    pallas_available,
+)
 from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
 from happysim_tpu.tpu.model import (
     CorrelatedOutages,
@@ -56,10 +63,15 @@ __all__ = [
     "FaultSpec",
     "MM1Result",
     "TelemetrySpec",
+    "KERNEL_ENV",
     "duty_cycle",
     "hist_percentile",
+    "kernel_decision",
+    "kernel_plan",
     "macro_block_len",
+    "maybe_enable_compile_cache",
     "mm1_model",
+    "pallas_available",
     "pipeline_model",
     "run_ensemble",
     "run_mm1_ensemble",

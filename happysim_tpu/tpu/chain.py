@@ -245,9 +245,9 @@ def run_chain(
 
     ``plan`` is ``fast_plan``'s dict (a bare server list is accepted for
     the single-chain case). Returns ``(reduced, events_total,
-    wall_seconds)`` shaped exactly like the event loop's ``reduce_final``
-    output, or None if the finite-capacity certificate failed (caller
-    falls back to the event scan).
+    wall_seconds, compile_seconds)`` shaped exactly like the event
+    loop's ``reduce_final`` output, or None if the finite-capacity
+    certificate failed (caller falls back to the event scan).
     """
     from happysim_tpu.tpu.engine import HIST_BINS, _hist_bin
     import time as _wall
@@ -294,7 +294,9 @@ def run_chain(
         # it in O(R x K) memory instead.
         logger.info(
             "chain fast path: %d customers x %d devices exceeds the "
-            "block memory budget — falling back to the event scan",
+            "block memory budget — falling back to the event scan "
+            "(HS_TPU_PALLAS selects the scan's fused-kernel vs lax step; "
+            "HS_TPU_EARLY_EXIT=0 forces its flat chunk scan)",
             n_customers,
             n_devices,
         )
@@ -532,12 +534,15 @@ def run_chain(
         blocks.append((keys_b, rate, means))
 
     # AOT-compile every distinct block shape before the timer, like the
-    # event loop's lowered scan (the timed region is pure execution).
+    # event loop's lowered scan (the timed region is pure execution; the
+    # trace+compile cost is reported separately as compile_seconds).
+    compile_start = _wall.perf_counter()
     compiled_fns = {}
     for keys_b, rate, means in blocks:
         shape = rate.shape[0]
         if shape not in compiled_fns:
             compiled_fns[shape] = jit_block.lower(keys_b, rate, means).compile()
+    compile_seconds = _wall.perf_counter() - compile_start
 
     start_t = _wall.perf_counter()
     partials = [
@@ -550,7 +555,8 @@ def run_chain(
         logger.info(
             "chain fast path: finite-capacity certificate failed "
             "(an arrival would have been dropped) — falling back to the "
-            "event scan"
+            "event scan (HS_TPU_PALLAS selects the scan's fused-kernel "
+            "vs lax step; HS_TPU_EARLY_EXIT=0 forces its flat chunk scan)"
         )
         return None
 
@@ -592,4 +598,4 @@ def run_chain(
         # No drops by certificate; the key must exist for the shared
         # result assembly when compiled.has_transit.
         reduced["tr_dropped"] = zeros_v
-    return reduced, events_total, wall
+    return reduced, events_total, wall, compile_seconds

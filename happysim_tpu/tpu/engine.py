@@ -158,6 +158,60 @@ def _donation_enabled() -> bool:
     except RuntimeError:  # pragma: no cover - no backend at all
         return False
 
+_COMPILE_CACHE_WIRED: Optional[str] = None
+
+
+def maybe_enable_compile_cache() -> Optional[str]:
+    """Wire JAX's persistent compilation cache when ``HS_TPU_COMPILE_CACHE``
+    names a directory, so repeated bench/CI invocations stop re-lowering
+    identical topologies (the macro-stepped scan retraces per
+    (model, macro, budget) shape — the cache makes that a disk hit).
+
+    Idempotent: the first call wires the cache, later calls (and calls
+    without the env var) are no-ops. Returns the active cache dir, or
+    None when disabled."""
+    global _COMPILE_CACHE_WIRED
+    path = os.environ.get("HS_TPU_COMPILE_CACHE", "").strip()
+    if not path:
+        return _COMPILE_CACHE_WIRED
+    if _COMPILE_CACHE_WIRED is not None:
+        return _COMPILE_CACHE_WIRED
+    knobs = {
+        "jax_compilation_cache_dir": path,
+        # Cache every program: simulation steps are cheap to store and
+        # expensive to re-lower, and short CI programs would otherwise
+        # fall under the default write thresholds.
+        "jax_persistent_cache_min_compile_time_secs": 0.0,
+        "jax_persistent_cache_min_entry_size_bytes": -1,
+    }
+    prior = {}
+    try:
+        # Resolve the reset hook FIRST: if this jaxlib lacks it, nothing
+        # has been touched yet ("not wired" must mean exactly that — a
+        # partially-applied config would cache with default thresholds
+        # while claiming to be off).
+        from jax.experimental.compilation_cache import compilation_cache
+
+        for name, value in knobs.items():
+            prior[name] = getattr(jax.config, name)
+            jax.config.update(name, value)
+        # Any compile that ran before this point (module-level jnp
+        # constants compile at import) latched the cache subsystem as
+        # "no dir configured"; reset so the next compile re-initializes
+        # against the directory we just wired.
+        compilation_cache.reset_cache()
+    except Exception as error:  # pragma: no cover - older jaxlib knobs
+        for name, value in prior.items():
+            try:
+                jax.config.update(name, value)
+            except Exception:
+                pass
+        logger.warning("HS_TPU_COMPILE_CACHE not wired: %s", error)
+        return None
+    _COMPILE_CACHE_WIRED = path
+    return path
+
+
 # Queue-ring write strategy: "dense" (one-hot masked write, O(K)) or
 # "scatter" (predicated `.at[].set(mode="drop")`). Dense is the default
 # on EVERY backend: on TPU v5e the vmapped drop-mode scatter silently
@@ -376,6 +430,15 @@ class EnsembleResult:
     # Time-resolved per-window series (models with a TelemetrySpec only;
     # see tpu/telemetry.py — None otherwise).
     timeseries: Optional[EnsembleTimeseries] = None
+    # AOT trace+compile seconds, kept OUT of wall_seconds (the throughput
+    # denominator is pure execution; see docs/tpu-engine.md).
+    compile_seconds: float = 0.0
+    # Which engine actually ran: "chain" (closed form), "scan" (lax event
+    # step), or "scan+pallas" (fused macro-block kernel, tpu/kernels/).
+    engine_path: str = "scan"
+    # Why the Pallas kernel did NOT run (names HS_TPU_PALLAS; "" when the
+    # kernel ran or the run never reached the scan dispatch).
+    kernel_decline: str = ""
 
     def summary(self):
         from happysim_tpu.core.temporal import Instant
@@ -2351,6 +2414,7 @@ def _run_ensemble_segmented(
         chunk_done = 0
 
     offset0 = jnp.uint32(0)
+    compile_start = _wall.perf_counter()
     runners = {
         seg_chunks: make_seg_runner(seg_chunks)
         .lower(state, keys, params, offset0)
@@ -2366,15 +2430,22 @@ def _run_ensemble_segmented(
         .lower(state)
         .compile()
     )
+    compile_seconds = _wall.perf_counter() - compile_start
 
     start = _wall.perf_counter()
     last_snapshot = _wall.perf_counter()
     while chunk_done < n_chunks:
         n_seg = min(seg_chunks, n_chunks - chunk_done)
         if n_seg not in runners:  # unaligned resume point
+            lazy_start = _wall.perf_counter()
             runners[n_seg] = (
                 make_seg_runner(n_seg).lower(state, keys, params, offset0).compile()
             )
+            # Book the lazy compile as compile time, not run time: the
+            # wall/ throughput denominator stays pure execution.
+            lazy = _wall.perf_counter() - lazy_start
+            compile_seconds += lazy
+            start += lazy
         state = runners[n_seg](state, keys, params, jnp.uint32(chunk_done))
         chunk_done += n_seg
         # A callback without an interval means "snapshot every segment".
@@ -2403,7 +2474,7 @@ def _run_ensemble_segmented(
     reduced = reduce_jit(state)
     events_total = int(np.asarray(reduced["events"]).sum(dtype=np.int64))
     wall = _wall.perf_counter() - start
-    return reduced, events_total, wall
+    return reduced, events_total, wall, compile_seconds
 
 
 def run_ensemble(
@@ -2435,6 +2506,7 @@ def run_ensemble(
     ``wall_seconds`` includes the snapshot fetches.
     """
     compiled = _Compiled(model)
+    maybe_enable_compile_cache()
     if mesh is None:
         mesh = replica_mesh()
     n_replicas = pad_to_multiple(n_replicas, mesh.size)
@@ -2501,9 +2573,16 @@ def run_ensemble(
                 model, compiled, plan, n_replicas, seed, sharding, src_rate, srv_mean
             )
             if fast is not None:
-                reduced, events_total, wall = fast
+                reduced, events_total, wall, compile_s = fast
                 return _build_result(
-                    model, compiled, reduced, events_total, wall, n_replicas
+                    model,
+                    compiled,
+                    reduced,
+                    events_total,
+                    wall,
+                    n_replicas,
+                    compile_seconds=compile_s,
+                    engine_path="chain",
                 )
 
     params = {
@@ -2519,6 +2598,23 @@ def run_ensemble(
     macro = macro_block_len(model)
     early_exit = _early_exit_enabled()
     n_chunks = -(-max_events // macro)
+
+    # Fused macro-block kernel dispatch (tpu/kernels/): bit-identical to
+    # the lax step on every shape it claims, sound decline elsewhere. The
+    # decline note rides EnsembleResult.kernel_decline so a declined
+    # model always names the engine path that actually ran.
+    from happysim_tpu.tpu.kernels import (
+        build_block_step,
+        kernel_decision,
+        kernel_interpret_mode,
+        pad_replicas,
+    )
+
+    use_pallas, kernel_note = kernel_decision(
+        model, mesh=mesh, checkpointing=checkpointing_requested, macro=macro
+    )
+    if kernel_note and os.environ.get("HS_TPU_PALLAS") == "1":
+        logger.info("run_ensemble: %s", kernel_note)
 
     def replica_halted(state):
         """True once this replica's next event is past the horizon (or
@@ -2653,30 +2749,97 @@ def run_ensemble(
             "checkpoint_every_s without checkpoint_callback would take no "
             "snapshots (pass a callback to receive them)"
         )
-    checkpointing = (
-        checkpoint_every_s is not None
-        or checkpoint_callback is not None
-        or resume_from is not None
-    )
-    if not checkpointing:
+    if not checkpointing_requested:
 
         # keys/params are consumed exactly once; donating them lets XLA
         # reuse their buffers during the run (state itself is born inside
         # the jit, where lax.scan/while_loop carries already alias).
         jit_kwargs = {"donate_argnums": (0, 1)} if _donation_enabled() else {}
 
-        @partial(jax.jit, **jit_kwargs)
-        def run(keys, params):
-            def one_replica(key, p):
-                state = compiled.init_state(key, p)
-                return replica_chunks(key, state, p, jnp.uint32(0), n_chunks)
+        if use_pallas:
+            # Fused-kernel path: the macro-block loop runs at BATCH level
+            # (the kernel consumes the whole replica-tiled state), with
+            # the same absolute-block RNG keying and the same early-exit
+            # contract as the vmapped lax path — skipped blocks are
+            # no-ops per lane, so results are bit-identical.
+            block_step, kmeta = build_block_step(
+                compiled,
+                horizon,
+                macro,
+                n_replicas,
+                interpret=kernel_interpret_mode(),
+            )
+            n_padded = kmeta["padded_replicas"]
 
-            return reduce_final(jax.vmap(one_replica)(keys, params))
+            @partial(jax.jit, **jit_kwargs)
+            def run(keys, params):
+                if n_padded != n_replicas:
+                    # Edge-padding duplicates the last replica's key and
+                    # params; the clone lanes simulate redundantly and
+                    # are sliced away before reduction.
+                    keys = pad_replicas(keys, n_padded)
+                    params = pad_replicas(params, n_padded)
+                state = jax.vmap(compiled.init_state)(keys, params)
+                # The per-replica PRNG key leaf is dead under external_u
+                # (blocks are keyed from `keys` below) — keep it out of
+                # the kernel's VMEM working set.
+                key_leaf = state.pop("key")
+
+                def chunk(kstate, c):
+                    U = jax.vmap(
+                        lambda k: jax.random.uniform(
+                            jax.random.fold_in(k, c),
+                            (macro, compiled.n_draws),
+                            minval=1e-12,
+                            maxval=1.0,
+                        )
+                    )(keys)
+                    return block_step(kstate, U, params)
+
+                if early_exit:
+
+                    def blocks_cond(carry):
+                        kstate, c = carry
+                        halted = jax.vmap(replica_halted)(kstate)
+                        return (c < jnp.uint32(n_chunks)) & ~jnp.all(halted)
+
+                    def blocks_body(carry):
+                        kstate, c = carry
+                        return chunk(kstate, c), c + jnp.uint32(1)
+
+                    state, _ = lax.while_loop(
+                        blocks_cond, blocks_body, (state, jnp.uint32(0))
+                    )
+                else:
+                    state, _ = lax.scan(
+                        lambda kstate, c: (chunk(kstate, c), None),
+                        state,
+                        jnp.arange(n_chunks, dtype=jnp.uint32),
+                    )
+                final = {**state, "key": key_leaf}
+                if n_padded != n_replicas:
+                    final = jax.tree_util.tree_map(
+                        lambda leaf: leaf[:n_replicas], final
+                    )
+                return reduce_final(final)
+
+        else:
+
+            @partial(jax.jit, **jit_kwargs)
+            def run(keys, params):
+                def one_replica(key, p):
+                    state = compiled.init_state(key, p)
+                    return replica_chunks(key, state, p, jnp.uint32(0), n_chunks)
+
+                return reduce_final(jax.vmap(one_replica)(keys, params))
 
         # AOT-compile so the timed region is pure execution (and the
         # ensemble only runs once; a device->host fetch is the completion
-        # barrier).
+        # barrier). The trace+compile cost is reported separately as
+        # compile_seconds — never folded into the throughput denominator.
+        compile_start = _wall.perf_counter()
         compiled_fn = run.lower(keys, params).compile()
+        compile_seconds = _wall.perf_counter() - compile_start
         start = _wall.perf_counter()
         reduced = compiled_fn(keys, params)
         # int64 on the host: the (R,) int32 fetch doubles as the
@@ -2684,7 +2847,7 @@ def run_ensemble(
         events_total = int(np.asarray(reduced["events"]).sum(dtype=np.int64))
         wall = _wall.perf_counter() - start
     else:
-        reduced, events_total, wall = _run_ensemble_segmented(
+        reduced, events_total, wall, compile_seconds = _run_ensemble_segmented(
             compiled,
             replica_chunks,
             reduce_final,
@@ -2705,12 +2868,30 @@ def run_ensemble(
         )
 
     return _build_result(
-        model, compiled, reduced, events_total, wall, n_replicas, max_events
+        model,
+        compiled,
+        reduced,
+        events_total,
+        wall,
+        n_replicas,
+        max_events,
+        compile_seconds=compile_seconds,
+        engine_path="scan+pallas" if use_pallas else "scan",
+        kernel_decline=kernel_note,
     )
 
 
 def _build_result(
-    model, compiled, reduced, events_total, wall, n_replicas, max_events=None
+    model,
+    compiled,
+    reduced,
+    events_total,
+    wall,
+    n_replicas,
+    max_events=None,
+    compile_seconds: float = 0.0,
+    engine_path: str = "scan",
+    kernel_decline: str = "",
 ) -> EnsembleResult:
     """Shared result assembly for the event scan and the chain fast path
     (``chain.run_chain`` emits the same ``reduced`` key set)."""
@@ -2783,6 +2964,9 @@ def _build_result(
         server_hedge_wins=_per_server(host, "srv_hedge_wins", nV_real),
         network_lost=int(host.get("net_lost", 0)),
         timeseries=timeseries,
+        compile_seconds=compile_seconds,
+        engine_path=engine_path,
+        kernel_decline=kernel_decline,
     )
 
 

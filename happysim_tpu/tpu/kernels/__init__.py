@@ -1,0 +1,55 @@
+"""Hand-fused Pallas kernels for the ensemble engine's hot loop.
+
+The general event scan (:mod:`happysim_tpu.tpu.engine`) expresses one
+event step as ~dozens of small XLA ops (register argmin -> branch switch
+-> RNG-slot reads -> masked accounting updates). Each op streams the
+per-replica register file through HBM, so a macro-block of K steps pays
+K full round-trips over state that would comfortably fit on-chip.
+
+:func:`build_block_step` fuses the WHOLE macro-block into one Pallas
+kernel: a tile of replicas' register files (wake-time registers, queue
+rings, histograms, counters) is loaded into VMEM once, all K fused
+event steps run against the resident tile, and the updated registers are
+written back once. The kernel body drives the engine's own traced step
+closure, so the float op order per lane is identical to the lax path by
+construction — results are bit-identical, and ``HS_TPU_PALLAS=0`` /
+``=1`` is a pure A/B lever (see docs/guides/tpu-kernels.md).
+
+Coverage starts with chain-shaped and M/M/1-shaped models (single
+source -> server chain -> sink; no routers/limiters/chaos). Everything
+else *soundly declines* to the lax step via :func:`kernel_plan` — the
+same pattern as ``chain.fast_plan`` — so correctness never depends on
+kernel coverage.
+"""
+
+from happysim_tpu.tpu.kernels.event_step import (
+    VMEM_TILE_BUDGET_BYTES,
+    build_block_step,
+    choose_tile,
+    pad_replicas,
+    replica_tile_bytes,
+)
+from happysim_tpu.tpu.kernels.support import (
+    KERNEL_ENV,
+    env_override,
+    kernel_decision,
+    kernel_env_mode,
+    kernel_interpret_mode,
+    kernel_plan,
+    pallas_available,
+)
+
+__all__ = [
+    "KERNEL_ENV",
+    "VMEM_TILE_BUDGET_BYTES",
+    "build_block_step",
+    "choose_tile",
+    "env_override",
+    "kernel_decision",
+    "kernel_env_mode",
+    "kernel_interpret_mode",
+    "kernel_plan",
+    "pad_replicas",
+    "pallas_available",
+    "replica_tile_bytes",
+]

@@ -1,0 +1,258 @@
+"""The fused macro-block event-step kernel (Pallas TPU).
+
+One kernel invocation advances a TILE of replicas by ``macro`` fused
+event steps with the whole per-replica register file resident in VMEM:
+
+- inputs: every state leaf (wake-time registers, queue rings, counter
+  and histogram accumulators), the block's pre-drawn uniform rows
+  ``(tile, macro, n_draws)``, and the per-replica parameter arrays;
+- body: the engine's OWN single-event step closure
+  (``_Compiled.make_step(external_u=True)``) vmapped over the tile and
+  unrolled ``macro`` times as a static Python loop — next-wake argmin,
+  event-type dispatch, and all int32 accounting/histogram updates run
+  against the VMEM-resident tile instead of streaming each register
+  array through HBM once per step;
+- outputs: the updated state leaves, aliased onto the inputs so the
+  register file is updated in place in HBM.
+
+Reusing the traced step closure is the bit-identity guarantee: the
+kernel performs the exact op sequence of the lax path per lane (same
+RNG slot layout, same float op order), so ``HS_TPU_PALLAS=0/1`` is a
+pure A/B lever. The RNG block is drawn OUTSIDE the kernel by the same
+``fold_in(key, block_index)`` + ``uniform`` the lax path uses.
+
+Tiling/padding: the replica axis is split into power-of-two tiles sized
+so one tile's in+out register file fits the VMEM budget; a replica
+count that is not a tile multiple is edge-padded (the padded lanes
+duplicate the last replica and are sliced away before reduction).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# One tile's working set (state in + state out + uniforms + params) must
+# fit comfortably under the ~16 MB/core VMEM with headroom for Mosaic's
+# own buffers and double-buffered grid streaming.
+VMEM_TILE_BUDGET_BYTES = 4 * 1024 * 1024
+
+# Tiles wider than this stop helping: the VPU lane width is saturated
+# long before, and bigger tiles only raise VMEM pressure.
+MAX_TILE = 512
+
+
+def replica_tile_bytes(leaves) -> int:
+    """Bytes ONE replica's copy of ``leaves`` occupies, for per-replica
+    arrays/ShapeDtypeStructs (shapes WITHOUT the replica axis — e.g. the
+    ``init_state`` template). This is the sizing primitive
+    :func:`build_block_step` feeds into :func:`choose_tile`."""
+    return sum(
+        int(np.prod(leaf.shape, dtype=np.int64)) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in leaves
+    )
+
+
+def choose_tile(
+    n_replicas: int,
+    bytes_per_replica: int,
+    budget: int = VMEM_TILE_BUDGET_BYTES,
+) -> int:
+    """Largest power-of-two tile (<= MAX_TILE, <= n_replicas) whose
+    working set fits the VMEM budget; never below 1."""
+    if n_replicas < 1:
+        raise ValueError("n_replicas must be >= 1")
+    cap = min(n_replicas, MAX_TILE, max(budget // max(bytes_per_replica, 1), 1))
+    return 1 << max(int(math.floor(math.log2(cap))), 0)
+
+
+def padded_replica_count(n_replicas: int, tile: int) -> int:
+    """Replica count rounded up to a whole number of tiles."""
+    return ((n_replicas + tile - 1) // tile) * tile
+
+
+def pad_replicas(tree, n_target: int):
+    """Edge-pad every leaf's leading (replica) axis up to ``n_target``.
+
+    Padding duplicates the LAST replica row — the padded lanes simulate
+    redundantly and are sliced away before any reduction, so zero-filled
+    lanes (which would be live, divergent simulations) never exist.
+    """
+
+    def pad(leaf):
+        extra = n_target - leaf.shape[0]
+        if extra <= 0:
+            return leaf
+        return jnp.concatenate(
+            [leaf, jnp.repeat(leaf[-1:], extra, axis=0)], axis=0
+        )
+
+    return jax.tree_util.tree_map(pad, tree)
+
+
+def build_block_step(
+    compiled,
+    horizon: float,
+    macro: int,
+    n_replicas: int,
+    interpret: bool,
+    tile: Optional[int] = None,
+):
+    """Build the fused macro-block kernel for ``compiled``.
+
+    Returns ``(fn, meta)``: ``fn(state, U, params) -> state`` advances
+    every replica by one macro-block (``state`` excludes the unused
+    per-replica PRNG ``key`` leaf; all leading axes must equal
+    ``meta["padded_replicas"]``), and ``meta`` records the chosen
+    ``tile``, ``padded_replicas``, and ``bytes_per_replica`` for the
+    caller's padding/accounting.
+    """
+    from jax.experimental import pallas as pl
+
+    step = compiled.make_step(horizon, external_u=True)
+
+    # Working-set estimate from the init-state template (state counted
+    # twice: the aliased outputs still occupy a VMEM tile during the
+    # kernel) plus the uniform block and the parameter rows.
+    template = jax.eval_shape(
+        lambda: compiled.init_state(
+            jnp.zeros((2,), jnp.uint32),
+            {
+                "src_rate": jnp.zeros((compiled.nS,), jnp.float32),
+                "srv_mean": jnp.zeros((compiled.nV,), jnp.float32),
+            },
+        )
+    )
+    template.pop("key")
+    names = tuple(sorted(template))
+    state_leaves = [template[k] for k in names]
+    per_replica = (
+        2 * replica_tile_bytes(state_leaves)
+        + macro * compiled.n_draws * 4
+        + (compiled.nS + compiled.nV) * 4
+    )
+    if tile is None:
+        tile = choose_tile(n_replicas, per_replica)
+    padded = padded_replica_count(n_replicas, tile)
+    meta = {
+        "tile": tile,
+        "padded_replicas": padded,
+        "bytes_per_replica": per_replica,
+    }
+
+    param_names = ("src_rate", "srv_mean")
+
+    def tile_block(state, U, params):
+        # The engine's one-event step, vmapped over the resident tile.
+        # ``external_u`` supplies the pre-drawn slot row; params are
+        # per-replica and flow through untouched.
+        def one_step(state_row, params_row, u_row):
+            (new_state, _), _ = step((state_row, params_row), u_row)
+            return new_state
+
+        vstep = jax.vmap(one_step)
+        # Static unroll: ``macro`` is a compile-time constant (the RNG
+        # chunk length), so each step indexes U with a static offset —
+        # no dynamic slicing for Mosaic to lower.
+        for k in range(macro):
+            state = vstep(state, params, U[:, k, :])
+        return state
+
+    # Trace the tile block ONCE to a jaxpr and hoist its closed-over
+    # constants (slot-valid masks, queue caps, ... — numpy arrays baked
+    # into the step closure) into explicit kernel inputs: Pallas kernel
+    # bodies may not capture array constants. 0-d consts ride as (1,)
+    # rows so every kernel operand has a leading axis.
+    closed = jax.make_jaxpr(tile_block)(
+        {
+            k: jnp.zeros((tile,) + leaf.shape, leaf.dtype)
+            for k, leaf in template.items()
+        },
+        jnp.zeros((tile, macro, compiled.n_draws), jnp.float32),
+        {
+            "src_rate": jnp.zeros((tile, compiled.nS), jnp.float32),
+            "srv_mean": jnp.zeros((tile, compiled.nV), jnp.float32),
+        },
+    )
+    const_dims = tuple(np.ndim(c) for c in closed.consts)
+    const_vals = [
+        jnp.asarray(c).reshape((1,)) if np.ndim(c) == 0 else jnp.asarray(c)
+        for c in closed.consts
+    ]
+
+    def kernel(*refs):
+        n_state = len(names)
+        n_in = n_state + 1 + len(param_names) + len(const_vals)
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in:]
+        flat_args = [ref[...] for ref in in_refs[: n_state + 1 + len(param_names)]]
+        consts = [
+            ref[...].reshape(()) if dim == 0 else ref[...]
+            for dim, ref in zip(const_dims, in_refs[n_state + 1 + len(param_names):])
+        ]
+        out_flat = jax.core.eval_jaxpr(closed.jaxpr, consts, *flat_args)
+        for ref, val in zip(out_refs, out_flat):
+            ref[...] = val
+
+    def block_fn(state: dict, U, params: dict) -> dict:
+        leaves = [state[k] for k in names]
+        inputs = leaves + [U] + [params[k] for k in param_names]
+        if any(leaf.shape[0] != padded for leaf in inputs):
+            raise ValueError(
+                "block kernel inputs must be padded to "
+                f"{padded} replicas (tile={tile}); see pad_replicas"
+            )
+
+        def spec(leaf):
+            ndim = leaf.ndim
+            return pl.BlockSpec(
+                (tile,) + tuple(leaf.shape[1:]),
+                lambda i, _nd=ndim: (i,) + (0,) * (_nd - 1),
+            )
+
+        def const_spec(leaf):
+            # Hoisted step constants are replica-independent: every grid
+            # step sees the same (whole) block.
+            ndim = leaf.ndim
+            return pl.BlockSpec(
+                tuple(leaf.shape), lambda i, _nd=ndim: (0,) * _nd
+            )
+
+        call_kwargs = {}
+        if not interpret:  # pragma: no cover - exercised on TPU hardware
+            try:
+                from jax.experimental.pallas import tpu as pltpu
+
+                params_cls = getattr(
+                    pltpu, "TPUCompilerParams", None
+                ) or getattr(pltpu, "CompilerParams", None)
+                if params_cls is not None:
+                    # Tiles are independent replica slabs.
+                    call_kwargs["compiler_params"] = params_cls(
+                        dimension_semantics=("parallel",)
+                    )
+            except Exception:
+                pass
+        out = pl.pallas_call(
+            kernel,
+            grid=(padded // tile,),
+            in_specs=[spec(leaf) for leaf in inputs]
+            + [const_spec(c) for c in const_vals],
+            out_specs=[spec(leaf) for leaf in leaves],
+            out_shape=[
+                jax.ShapeDtypeStruct(leaf.shape, leaf.dtype) for leaf in leaves
+            ],
+            # In-place register-file update: each state input aliases its
+            # output, so the macro-block holds ONE copy of the ensemble
+            # state in HBM (the lax path gets the same from scan carries).
+            input_output_aliases={i: i for i in range(len(leaves))},
+            interpret=interpret,
+            **call_kwargs,
+        )(*inputs, *const_vals)
+        return dict(zip(names, out))
+
+    return block_fn, meta

@@ -1,0 +1,206 @@
+"""Capability probe + sound decline predicate for the Pallas kernel path.
+
+The kernel claims only the topologies it provably runs; everything else
+declines with a human-readable reason that names the ``HS_TPU_PALLAS``
+escape hatch, so a declined model always tells the user which engine
+path actually executed. This mirrors ``chain.fast_plan``'s contract:
+correctness never depends on kernel coverage, because the general lax
+event step is the mandatory fallback and the two paths are bit-identical
+on every supported shape.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Optional
+
+from happysim_tpu.tpu.model import SERVER, SINK, EnsembleModel
+
+KERNEL_ENV = "HS_TPU_PALLAS"
+
+# The kernel unrolls the macro-block inside its body (static Python
+# loop: Mosaic-friendly, no dynamic xs slicing). Past this length the
+# unroll would bloat compile time for no locality gain, so the path
+# declines and the lax scan runs.
+MAX_UNROLL_MACRO = 128
+
+
+@contextmanager
+def env_override(name: str, value: Optional[str]):
+    """Set (``None`` = unset) an env var for the block, restoring the
+    prior state on exit — the one copy of the save/set/restore dance the
+    kernel A/B levers (``HS_TPU_PALLAS``, ``HS_TPU_EARLY_EXIT``) need."""
+    prior = os.environ.get(name)
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
+    try:
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prior
+
+
+def pallas_available() -> bool:
+    """Whether ``jax.experimental.pallas`` imports in this environment."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+    except Exception:  # pragma: no cover - jaxlib without pallas
+        return False
+    return True
+
+
+def kernel_env_mode() -> str:
+    """``HS_TPU_PALLAS`` resolved to "0" (off), "1" (on where supported),
+    or "auto" (on on TPU backends when the model shape is supported).
+    Unrecognized values fall back to auto — loudly, so a user who set
+    ``HS_TPU_PALLAS=true`` is not told the variable is unset."""
+    raw = os.environ.get(KERNEL_ENV, "").strip()
+    if raw in ("0", "1"):
+        return raw
+    if raw:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not '0' or '1'; treating as auto", KERNEL_ENV, raw
+        )
+    return "auto"
+
+
+def kernel_interpret_mode() -> bool:
+    """Pallas interpret mode off-TPU: the kernel runs as a jaxpr
+    interpreter on CPU — slow, but bit-identical, which is what the
+    tier-1 equivalence tests and the bench A/B assert."""
+    import jax
+
+    try:
+        return jax.default_backend() != "tpu"
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return True
+
+
+def _decline(reason: str) -> tuple[None, str]:
+    return (
+        None,
+        f"Pallas kernel declined ({reason}); the lax event step ran — "
+        f"{KERNEL_ENV}=1 forces the kernel only on supported shapes, "
+        f"{KERNEL_ENV}=0 silences this note",
+    )
+
+
+def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
+    """The kernel's supported-shape predicate: ``(plan, reason)``.
+
+    Supported: exactly one source (Poisson or constant arrivals, no rate
+    profile) feeding a chain of FIFO servers (any concurrency, any
+    service family, optional deadlines/immediate retries, constant or
+    exponential edges with or without latency) into exactly one sink.
+    Routers, limiters, remotes, telemetry, and all chaos semantics
+    (faults, backoff retries, hedging, outage windows, packet loss)
+    decline — they exercise dynamic gathers and branch shapes the kernel
+    does not claim yet. The decline is SOUND: the caller must run the
+    lax step, never a partial kernel.
+    """
+    if model.routers:
+        return _decline("model has routers")
+    if model.limiters:
+        return _decline("model has limiters")
+    if model.remotes:
+        return _decline("model has remote egress nodes")
+    if getattr(model, "telemetry_spec", None) is not None:
+        return _decline("model has windowed telemetry")
+    if getattr(model, "correlated_faults", None) is not None:
+        return _decline("model has a correlated-outage schedule")
+    if len(model.sources) != 1:
+        return _decline(f"{len(model.sources)} sources (kernel supports 1)")
+    if len(model.sinks) != 1:
+        return _decline(f"{len(model.sinks)} sinks (kernel supports 1)")
+    source = model.sources[0]
+    if source.profile is not None and source.profile.kind != "constant":
+        return _decline("source has a rate profile")
+    for index, server in enumerate(model.servers):
+        label = f"server[{index}]"
+        if server.fault is not None:
+            return _decline(f"{label} has a stochastic fault schedule")
+        if server.hedge_delay_s is not None:
+            return _decline(f"{label} hedges requests")
+        if server.retry_backoff_s is not None:
+            return _decline(f"{label} retries with backoff")
+        if server.outage_start_s is not None:
+            return _decline(f"{label} has a brownout window")
+    for origin, edge in _edges(model):
+        if edge.loss_p > 0.0:
+            return _decline(f"{origin} edge carries packet loss")
+    # The topology must be a single linear chain ending at the sink.
+    seen: list[int] = []
+    ref = source.downstream
+    while ref is not None and ref.kind == SERVER:
+        if ref.index in seen:
+            return _decline("server chain has a feedback loop")
+        seen.append(ref.index)
+        ref = model.servers[ref.index].downstream
+    if ref is None or ref.kind != SINK:
+        return _decline("source path does not end at a sink")
+    if len(seen) != len(model.servers):
+        return _decline("servers outside the source->sink chain")
+    shape = "mm1" if len(seen) == 1 else "chain"
+    return {"shape": shape, "servers": seen}, ""
+
+
+def _edges(model: EnsembleModel):
+    for i, s in enumerate(model.sources):
+        yield f"source[{i}]", s.latency
+    for i, v in enumerate(model.servers):
+        yield f"server[{i}]", v.latency
+
+
+def kernel_decision(
+    model: EnsembleModel,
+    mesh,
+    checkpointing: bool,
+    macro: int,
+) -> tuple[bool, str]:
+    """Runtime dispatch: should THIS run use the Pallas block kernel?
+
+    Returns ``(use_kernel, note)``; the note is surfaced on
+    ``EnsembleResult.kernel_decline`` so a declined run names the path
+    that executed and the flag that controls it.
+    """
+    mode = kernel_env_mode()
+    if mode == "0":
+        return False, f"{KERNEL_ENV}=0: Pallas kernel disabled; lax event step ran"
+    if not pallas_available():
+        return False, (
+            "jax.experimental.pallas unavailable in this jaxlib; lax event "
+            f"step ran ({KERNEL_ENV} has no effect here)"
+        )
+    if checkpointing:
+        return False, (
+            "checkpoint/resume runs use the segmented lax scan (its carry "
+            f"IS the snapshot format); {KERNEL_ENV} does not apply"
+        )
+    if mesh is not None and mesh.size > 1:
+        return False, (
+            f"{mesh.size}-device mesh: the kernel path is single-device "
+            f"for now; lax event step ran ({KERNEL_ENV} cannot override)"
+        )
+    if macro > MAX_UNROLL_MACRO:
+        return False, (
+            f"macro_block={macro} exceeds the kernel unroll bound "
+            f"{MAX_UNROLL_MACRO}; lax event step ran (lower "
+            f"HS_TPU_MACRO_BLOCK or unset {KERNEL_ENV})"
+        )
+    plan, reason = kernel_plan(model)
+    if plan is None:
+        return False, reason
+    if mode == "auto" and kernel_interpret_mode():
+        return False, (
+            f"{KERNEL_ENV} not set to 1: the kernel auto-engages on TPU "
+            f"backends only (set {KERNEL_ENV}=1 to force interpret mode "
+            "off-TPU); lax event step ran"
+        )
+    return True, ""

@@ -740,6 +740,21 @@ class EnsembleModel:
                     "(sinks have no outstanding work)"
                 )
 
+    def kernel_supported(self) -> tuple[bool, str]:
+        """Whether the fused Pallas event-step kernel claims this
+        topology (chain-shaped / M/M/1-shaped; see tpu/kernels/).
+
+        Returns ``(supported, reason)``; the reason is "" when supported
+        and otherwise names the declining feature plus the
+        ``HS_TPU_PALLAS`` escape hatch. Unsupported models always run
+        the (bit-identical contract aside) general lax event step — the
+        kernel never partially engages.
+        """
+        from happysim_tpu.tpu.kernels.support import kernel_plan
+
+        plan, reason = kernel_plan(self)
+        return plan is not None, reason
+
     @property
     def max_concurrency(self) -> int:
         return max((s.concurrency for s in self.servers), default=1)

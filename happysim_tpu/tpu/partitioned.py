@@ -431,7 +431,10 @@ def run_partitioned(
         raise ValueError(
             "windowed telemetry is not supported by run_partitioned; "
             "use run_ensemble (replica data parallelism) for telemetry "
-            "models or drop the TelemetrySpec"
+            "models or drop the TelemetrySpec. run_ensemble executes "
+            "telemetry models on its event scan (the lax step — the "
+            "HS_TPU_PALLAS fused kernel declines telemetry too, and "
+            "HS_TPU_EARLY_EXIT=0 forces the scan's flat chunk loop)"
         )
     if outbox_capacity < 1:
         raise ValueError(

@@ -43,6 +43,11 @@ _TPU_TEST_FILES = {
     "test_tpu_telemetry.py",
     "test_arrival_regression.py",
     "test_telemetry_regression.py",
+    "test_tpu_pallas.py",
+    "test_kernel_event_step.py",
+    "test_kernel_regression.py",
+    "test_engine_path_reasons.py",
+    "test_tpu_mesh.py",
 }
 # Long host-side suites (examples execute end-to-end, some on the TPU path).
 _SLOW_TEST_FILES = {"test_examples.py"}

@@ -25,7 +25,17 @@ from happysim_tpu.tpu import (
     run_partitioned,
 )
 
-EXCLUDED_FIELDS = {"wall_seconds", "events_per_second"}  # timing-dependent
+EXCLUDED_FIELDS = {
+    # timing-dependent
+    "wall_seconds",
+    "events_per_second",
+    "compile_seconds",
+    # engine-path provenance: a checkpointed run legitimately reports
+    # a different path/decline note than its uninterrupted twin (the
+    # SIMULATION must match bit-for-bit; the route taken may differ)
+    "engine_path",
+    "kernel_decline",
+}
 
 
 def assert_results_identical(a, b):

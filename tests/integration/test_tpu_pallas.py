@@ -1,0 +1,188 @@
+"""Integration tier for the Pallas kernel path through ``run_ensemble``.
+
+Full-run bit-identity: ``HS_TPU_PALLAS=1`` (fused macro-block kernel,
+interpret mode on CPU) vs ``HS_TPU_PALLAS=0`` (lax event step) must
+produce IDENTICAL results — same RNG stream, same float op order per
+lane — across M/M/1 and deadline/retry sweep shapes, with and without
+early exit, including the replica-padding path (transit-edge chains get
+block-level bit-identity in tests/unit/test_kernel_event_step.py).
+Unsupported shapes and checkpointed runs decline soundly to the lax
+step.
+
+Runs are cached per (scenario, flags) so each compiled program is paid
+for once per session.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas")
+
+import jax
+
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import EnsembleModel, mm1_model
+
+# Tiny macro-block: the kernel unrolls it in-body, and interpret-mode
+# compile time scales with the unroll (the A/B contract only needs the
+# SAME block length on both paths; tier-1 wall time is the constraint).
+MACRO = 2
+
+
+def _mm1():
+    model = mm1_model(lam=5.0, mu=9.0, horizon_s=4.0, queue_capacity=16)
+    model.macro_block = MACRO
+    return model, {"n_replicas": 6, "max_events": 160}
+
+
+def _deadline_sweep():
+    model = EnsembleModel(horizon_s=4.0, macro_block=MACRO)
+    src = model.source(rate=4.0)
+    srv = model.server(
+        service_mean=0.15, queue_capacity=16, deadline_s=0.5, max_retries=2
+    )
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    sweeps = {
+        "source_rate": np.linspace(1.0, 6.0, 4).astype(np.float32)
+    }
+    return model, {"n_replicas": 4, "max_events": 256, "sweeps": sweeps}
+
+
+_SCENARIOS = {
+    "mm1": _mm1,
+    "deadline_sweep": _deadline_sweep,
+}
+_CACHE = {}
+
+
+def _run(scenario: str, pallas: bool, early_exit: bool = True, seed: int = 7):
+    key = (scenario, pallas, early_exit, seed)
+    if key in _CACHE:
+        return _CACHE[key]
+    from happysim_tpu.tpu.kernels import env_override
+
+    model, kwargs = _SCENARIOS[scenario]()
+    mesh = replica_mesh(jax.devices("cpu")[:1])
+    with env_override("HS_TPU_PALLAS", "1" if pallas else "0"), env_override(
+        "HS_TPU_EARLY_EXIT", "1" if early_exit else "0"
+    ):
+        result = run_ensemble(model, seed=seed, mesh=mesh, **kwargs)
+    _CACHE[key] = result
+    return result
+
+
+def _assert_bit_identical(kernel_result, lax_result):
+    assert kernel_result.engine_path == "scan+pallas", (
+        kernel_result.kernel_decline
+    )
+    assert lax_result.engine_path == "scan"
+    assert kernel_result.simulated_events == lax_result.simulated_events
+    assert kernel_result.sink_count == lax_result.sink_count
+    assert kernel_result.sink_mean_latency_s == lax_result.sink_mean_latency_s
+    assert kernel_result.sink_p99_s == lax_result.sink_p99_s
+    np.testing.assert_array_equal(kernel_result.sink_hist, lax_result.sink_hist)
+    assert kernel_result.server_completed == lax_result.server_completed
+    assert kernel_result.server_dropped == lax_result.server_dropped
+    assert kernel_result.server_mean_wait_s == lax_result.server_mean_wait_s
+    assert kernel_result.server_utilization == lax_result.server_utilization
+    assert kernel_result.server_timed_out == lax_result.server_timed_out
+    assert kernel_result.server_retried == lax_result.server_retried
+    assert kernel_result.truncated_replicas == lax_result.truncated_replicas
+
+
+class TestBitIdentity:
+    def test_mm1_padded_replicas(self):
+        """R=6 rides the padding path (tile 4 -> 8 lanes) on the kernel
+        side; results still match the unpadded lax run exactly."""
+        _assert_bit_identical(_run("mm1", True), _run("mm1", False))
+
+    def test_deadline_retry_sweep(self):
+        """Per-replica rate sweeps + deadline retries (the hetero-bench
+        shape) stay bit-identical through the kernel."""
+        _assert_bit_identical(
+            _run("deadline_sweep", True), _run("deadline_sweep", False)
+        )
+
+    def test_flat_scan_matches_too(self):
+        """HS_TPU_EARLY_EXIT=0: the kernel's batch-level flat chunk loop
+        equals the lax flat scan (and both equal the early-exit runs)."""
+        kernel_flat = _run("mm1", True, early_exit=False)
+        lax_flat = _run("mm1", False, early_exit=False)
+        _assert_bit_identical(kernel_flat, lax_flat)
+        lax_early = _run("mm1", False)
+        assert kernel_flat.simulated_events == lax_early.simulated_events
+        assert kernel_flat.sink_count == lax_early.sink_count
+        assert (
+            kernel_flat.sink_mean_latency_s == lax_early.sink_mean_latency_s
+        )
+
+
+class TestSoundDecline:
+    def test_faulted_model_declines_to_lax(self, monkeypatch):
+        from happysim_tpu.tpu.model import FaultSpec
+
+        model = EnsembleModel(horizon_s=2.0, macro_block=MACRO)
+        src = model.source(rate=4.0)
+        srv = model.server(
+            service_mean=0.05,
+            queue_capacity=8,
+            fault=FaultSpec(rate=0.5, mean_duration_s=0.2),
+        )
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        result = run_ensemble(
+            model,
+            n_replicas=4,
+            seed=3,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            max_events=96,
+        )
+        assert result.engine_path == "scan"
+        assert "fault" in result.kernel_decline
+        assert "HS_TPU_PALLAS" in result.kernel_decline
+
+    def test_checkpointing_declines_to_segmented_scan(self, monkeypatch):
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        snapshots = []
+        model, kwargs = _mm1()
+        result = run_ensemble(
+            model,
+            n_replicas=4,
+            seed=5,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            max_events=64,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+        )
+        assert result.engine_path == "scan"
+        assert "checkpoint" in result.kernel_decline
+        # The segmented runner reports its AOT compiles separately.
+        assert result.compile_seconds > 0.0
+
+    def test_multi_device_mesh_declines(self, monkeypatch, cpu_mesh):
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        model, _ = _mm1()
+        result = run_ensemble(
+            model, n_replicas=8, seed=2, mesh=cpu_mesh, max_events=64
+        )
+        assert result.engine_path == "scan"
+        assert "mesh" in result.kernel_decline
+
+
+class TestCompileSplit:
+    def test_compile_seconds_separated_from_wall(self):
+        kernel_result = _run("mm1", True)
+        lax_result = _run("mm1", False)
+        for result in (kernel_result, lax_result):
+            assert result.compile_seconds > 0.0
+            assert result.wall_seconds > 0.0
+            # Sanity of the split: events/sec is computed from the pure
+            # execution wall, so the two fields must be independent.
+            assert result.events_per_second == pytest.approx(
+                result.simulated_events / result.wall_seconds
+            )

@@ -68,7 +68,17 @@ def _chaos_model(telemetry_window=None):
     return model
 
 
-SIM_FIELDS_EXCLUDED = {"wall_seconds", "events_per_second", "timeseries"}
+SIM_FIELDS_EXCLUDED = {
+    "wall_seconds",
+    "events_per_second",
+    "timeseries",
+    "compile_seconds",
+    # Engine-path provenance: the telemetry run's kernel-decline note
+    # names telemetry while its twin's names whatever else declined —
+    # the SIMULATION fields are what must match.
+    "engine_path",
+    "kernel_decline",
+}
 
 
 def assert_simulation_identical(a, b):

@@ -1,0 +1,117 @@
+"""Pinned-seed goldens for the Pallas kernel path (regression tier).
+
+The kernel contract is BIT-identity with the lax event step, and both
+share the per-replica RNG stream layout (fold_in(key, block) + chunked
+uniforms, absolute block keying). These goldens pin that whole stack:
+a change to the slot layout, the block keying, or the kernel's op order
+shows up here as an exact-count mismatch — not as a silent statistical
+drift.
+
+Golden provenance: seed=123, 8 replicas, M/M/1 lam=6 mu=10 horizon=6s
+queue_capacity=16, macro_block=4, max_events=192, recorded on the CPU
+interpret path (which is bit-identical to the compiled TPU kernel by
+construction — the kernel body IS the traced step closure).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas")
+
+import jax
+
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import mm1_model
+
+GOLDEN = {
+    "simulated_events": 654,
+    "sink_count": [323],
+    "server_completed": [323],
+    "server_dropped": [0],
+    "truncated_replicas": 0,
+    "sink_mean_latency_s": 0.18174977494467154,
+    "sink_p50_s": 0.14125375446227553,
+    "sink_p99_s": 0.5623413251903491,
+    "server_mean_wait_s": 0.09317086382610042,
+    # Non-empty log-histogram bins (bin index -> count).
+    "hist_nonzero": {
+        12: 1, 26: 4, 27: 2, 28: 4, 29: 2, 30: 5, 31: 7, 32: 5, 33: 4,
+        34: 6, 35: 12, 36: 13, 37: 15, 38: 22, 39: 25, 40: 24, 41: 22,
+        42: 31, 43: 26, 44: 21, 45: 43, 46: 17, 47: 11, 48: 1,
+    },
+}
+
+
+def _pinned_run(pallas: bool):
+    from happysim_tpu.tpu.kernels import env_override
+
+    model = mm1_model(lam=6.0, mu=10.0, horizon_s=6.0, queue_capacity=16)
+    model.macro_block = 4
+    with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+        return run_ensemble(
+            model,
+            n_replicas=8,
+            seed=123,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            max_events=192,
+        )
+
+
+@pytest.fixture(scope="module")
+def kernel_result():
+    return _pinned_run(True)
+
+
+def test_kernel_path_engaged(kernel_result):
+    assert kernel_result.engine_path == "scan+pallas", (
+        kernel_result.kernel_decline
+    )
+
+
+def test_exact_counts_match_golden(kernel_result):
+    assert kernel_result.simulated_events == GOLDEN["simulated_events"]
+    assert kernel_result.sink_count == GOLDEN["sink_count"]
+    assert kernel_result.server_completed == GOLDEN["server_completed"]
+    assert kernel_result.server_dropped == GOLDEN["server_dropped"]
+    assert kernel_result.truncated_replicas == GOLDEN["truncated_replicas"]
+
+
+def test_latency_statistics_match_golden(kernel_result):
+    # Float64 host reductions over pinned float32 device values: exact
+    # to tight tolerance (the division order is fixed).
+    assert kernel_result.sink_mean_latency_s[0] == pytest.approx(
+        GOLDEN["sink_mean_latency_s"], rel=1e-12
+    )
+    assert kernel_result.sink_p50_s[0] == pytest.approx(
+        GOLDEN["sink_p50_s"], rel=1e-12
+    )
+    assert kernel_result.sink_p99_s[0] == pytest.approx(
+        GOLDEN["sink_p99_s"], rel=1e-12
+    )
+    assert kernel_result.server_mean_wait_s[0] == pytest.approx(
+        GOLDEN["server_mean_wait_s"], rel=1e-12
+    )
+
+
+def test_histogram_matches_golden_exactly(kernel_result):
+    hist = np.asarray(kernel_result.sink_hist[0])
+    expected = np.zeros_like(hist)
+    for bin_index, count in GOLDEN["hist_nonzero"].items():
+        expected[bin_index] = count
+    np.testing.assert_array_equal(hist, expected)
+
+
+def test_lax_path_reproduces_the_same_golden(kernel_result):
+    """The other half of the A/B: the lax step on the same pinned seed
+    produces the same numbers (bit-identity, asserted on the goldens so
+    a joint drift of both paths is still caught)."""
+    lax_result = _pinned_run(False)
+    assert lax_result.engine_path == "scan"
+    assert lax_result.simulated_events == GOLDEN["simulated_events"]
+    assert lax_result.sink_count == GOLDEN["sink_count"]
+    assert lax_result.sink_mean_latency_s == kernel_result.sink_mean_latency_s
+    assert lax_result.server_mean_wait_s == kernel_result.server_mean_wait_s
+    np.testing.assert_array_equal(
+        np.asarray(lax_result.sink_hist), np.asarray(kernel_result.sink_hist)
+    )

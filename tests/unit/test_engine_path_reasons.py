@@ -1,0 +1,122 @@
+"""Decline reasons are SURFACED, not just logged (ISSUE 4 satellite).
+
+A kernel-declined model must tell the user which engine path actually
+ran and which flag controls it: ``EnsembleResult.kernel_decline`` names
+``HS_TPU_PALLAS``, and the ``run_partitioned`` telemetry rejection names
+the scan-path escape hatches (``HS_TPU_PALLAS``, ``HS_TPU_EARLY_EXIT``).
+"""
+
+import pytest
+
+import jax
+
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import EnsembleModel
+
+
+def _router_model():
+    """Two-server random fan-out: kernel-unsupported, scan-supported."""
+    model = EnsembleModel(horizon_s=1.0)
+    src = model.source(rate=4.0)
+    first = model.server(service_mean=0.05, queue_capacity=4)
+    second = model.server(service_mean=0.05, queue_capacity=4)
+    router = model.router(policy="random", targets=[first, second])
+    snk = model.sink()
+    model.connect(src, router)
+    model.connect(first, snk)
+    model.connect(second, snk)
+    return model
+
+
+def test_kernel_decline_reason_reaches_result(monkeypatch):
+    """Forcing HS_TPU_PALLAS=1 on an unsupported shape soundly runs the
+    lax scan AND surfaces the decline (naming the flag) on the result."""
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _router_model(),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=32,
+    )
+    assert result.engine_path == "scan"
+    assert "router" in result.kernel_decline
+    assert "HS_TPU_PALLAS" in result.kernel_decline
+    assert "lax" in result.kernel_decline
+
+
+def test_kernel_disabled_note_is_surfaced(monkeypatch):
+    """HS_TPU_PALLAS=0's note reaches the result too (decision-level —
+    the run itself is covered by the forced-on test above, and a second
+    compiled program here would only re-pay XLA)."""
+    from happysim_tpu.tpu.kernels import kernel_decision
+
+    monkeypatch.setenv("HS_TPU_PALLAS", "0")
+    use, note = kernel_decision(
+        _router_model(),
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        checkpointing=False,
+        macro=32,
+    )
+    assert not use and "HS_TPU_PALLAS=0" in note
+
+
+def test_partitioned_telemetry_rejection_names_flags():
+    from happysim_tpu.tpu.partitioned import run_partitioned
+
+    model = EnsembleModel(horizon_s=2.0)
+    src = model.source(rate=4.0)
+    srv = model.server(service_mean=0.05)
+    snk = model.sink()
+    egress = model.remote(ingress=srv, latency_s=0.5)
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    del egress
+    model.telemetry(window_s=0.5)
+    with pytest.raises(ValueError) as excinfo:
+        run_partitioned(model, window_s=0.25)
+    message = str(excinfo.value)
+    assert "HS_TPU_PALLAS" in message
+    assert "HS_TPU_EARLY_EXIT" in message
+    assert "run_ensemble" in message
+
+
+def test_compile_cache_noop_without_env(monkeypatch):
+    """Without HS_TPU_COMPILE_CACHE the helper must not touch jax config
+    (the suite would otherwise start writing cache files everywhere)."""
+    from happysim_tpu.tpu import maybe_enable_compile_cache
+
+    monkeypatch.delenv("HS_TPU_COMPILE_CACHE", raising=False)
+    import happysim_tpu.tpu.engine as engine
+
+    before = engine._COMPILE_CACHE_WIRED
+    assert maybe_enable_compile_cache() == before
+    assert engine._COMPILE_CACHE_WIRED == before
+
+
+def test_chain_decline_log_names_flags(caplog):
+    """The chain fast path's certificate fallback tells the user which
+    scan flavor ran (flag names in the log record)."""
+    import logging
+
+    from happysim_tpu.tpu.model import mm1_model
+
+    # Overloaded M/M/1 with a tiny queue: the certificate must fail and
+    # the run must fall back to the scan (drops prove the loop ran).
+    model = mm1_model(lam=9.0, mu=10.0, horizon_s=8.0, queue_capacity=1)
+    with caplog.at_level(logging.INFO, logger="happysim_tpu.tpu.chain"):
+        result = run_ensemble(
+            model,
+            n_replicas=8,
+            seed=1,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+        )
+    assert result.engine_path == "scan"
+    assert result.server_dropped[0] > 0
+    fallback_logs = [
+        r.getMessage() for r in caplog.records if "falling back" in r.getMessage()
+    ]
+    assert fallback_logs, "expected the chain certificate fallback log"
+    assert any("HS_TPU_PALLAS" in m for m in fallback_logs)
+    assert any("HS_TPU_EARLY_EXIT" in m for m in fallback_logs)

@@ -1,0 +1,375 @@
+"""Unit tier for the fused Pallas event-step kernel (tpu/kernels/).
+
+Interpret-mode equivalence on CPU: one kernel invocation (a macro-block
+of fused event steps on a replica tile) must be BIT-IDENTICAL to the lax
+path's ``lax.scan`` over the same step closure and the same uniform
+block. Plus the pure-host pieces: tile selection, replica padding, and
+the sound-decline predicate.
+
+CI runs this file as its own gate step with ``HS_TPU_PALLAS=1`` (see
+.github/workflows/tests.yml); it must skip cleanly when
+``jax.experimental.pallas`` is unavailable.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from happysim_tpu.tpu.engine import _Compiled
+from happysim_tpu.tpu.kernels import (
+    build_block_step,
+    choose_tile,
+    kernel_plan,
+    pad_replicas,
+    replica_tile_bytes,
+)
+from happysim_tpu.tpu.kernels.event_step import padded_replica_count
+from happysim_tpu.tpu.model import EnsembleModel, mm1_model
+
+
+def _mm1(horizon=3.0):
+    return mm1_model(lam=5.0, mu=9.0, horizon_s=horizon, queue_capacity=8)
+
+
+def _chain_with_transit():
+    model = EnsembleModel(horizon_s=2.0)
+    src = model.source(rate=4.0)
+    first = model.server(service_mean=0.05, queue_capacity=8)
+    second = model.server(service_mean=0.07, queue_capacity=8, service="erlang")
+    snk = model.sink()
+    model.connect(src, first, latency_s=0.02, latency_kind="exponential")
+    model.connect(first, second, latency_s=0.01)
+    model.connect(second, snk)
+    return model
+
+
+def _init_batch(compiled, n_replicas, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_replicas)
+    params = {
+        "src_rate": jnp.broadcast_to(
+            jnp.asarray([s.rate for s in compiled.model.sources], jnp.float32),
+            (n_replicas, compiled.nS),
+        ),
+        "srv_mean": jnp.broadcast_to(
+            jnp.asarray(
+                [s.service_mean_s for s in compiled.model.servers] or [1.0],
+                jnp.float32,
+            ),
+            (n_replicas, compiled.nV),
+        ),
+    }
+    state = jax.vmap(compiled.init_state)(keys, params)
+    state.pop("key")
+    return keys, params, state
+
+
+def _lax_block(compiled, horizon, state, U, params):
+    """The reference macro-block: scan of the SAME step closure per lane."""
+    step = compiled.make_step(horizon, external_u=True)
+
+    def one(state_row, u_rows, params_row):
+        (out, _), _ = lax.scan(step, (state_row, params_row), u_rows)
+        return out
+
+    return jax.vmap(one)(state, U, params)
+
+
+# Two fused steps are enough to prove in-kernel chaining; interpret-mode
+# XLA build time scales with the unroll, and tier-1 wall time is tight.
+MACRO = 2
+
+
+# One topology here: the transit chain exercises the superset of state
+# leaves (two servers, erlang family, transit registers). The M/M/1 shape
+# gets block-level coverage from the consecutive-blocks test below and
+# full-run coverage from the integration + regression tiers — a second
+# parametrized compile would only re-pay the interpret-mode XLA build.
+@pytest.mark.parametrize("build", [_chain_with_transit])
+def test_block_kernel_bit_identical_to_lax_scan(build):
+    """One fused kernel call == the lax scan, leaf by leaf, bit for bit."""
+    model = build()
+    compiled = _Compiled(model)
+    horizon = float(model.horizon_s)
+    n_replicas = 4
+    keys, params, state = _init_batch(compiled, n_replicas)
+    U = jax.vmap(
+        lambda k: jax.random.uniform(
+            jax.random.fold_in(k, 0),
+            (MACRO, compiled.n_draws),
+            minval=1e-12,
+            maxval=1.0,
+        )
+    )(keys)
+
+    block_fn, meta = build_block_step(
+        compiled, horizon, MACRO, n_replicas, interpret=True
+    )
+    assert meta["padded_replicas"] == n_replicas  # power-of-two count
+    kernel_out = block_fn(state, U, params)
+    lax_out = _lax_block(compiled, horizon, state, U, params)
+
+    assert set(kernel_out) == set(lax_out)
+    for name in sorted(lax_out):
+        np.testing.assert_array_equal(
+            np.asarray(kernel_out[name]),
+            np.asarray(lax_out[name]),
+            err_msg=f"leaf {name} diverged",
+        )
+
+
+def test_block_kernel_consecutive_blocks_stay_identical():
+    """Chaining kernel blocks (state fed back in) tracks the lax chain."""
+    model = _mm1()
+    compiled = _Compiled(model)
+    horizon = float(model.horizon_s)
+    keys, params, state = _init_batch(compiled, 4, seed=9)
+    block_fn, _ = build_block_step(compiled, horizon, MACRO, 4, interpret=True)
+    k_state, l_state = state, state
+    for block_index in range(2):
+        U = jax.vmap(
+            lambda k, _c=block_index: jax.random.uniform(
+                jax.random.fold_in(k, _c),
+                (MACRO, compiled.n_draws),
+                minval=1e-12,
+                maxval=1.0,
+            )
+        )(keys)
+        k_state = block_fn(k_state, U, params)
+        l_state = _lax_block(compiled, horizon, l_state, U, params)
+    for name in sorted(l_state):
+        np.testing.assert_array_equal(
+            np.asarray(k_state[name]), np.asarray(l_state[name]), err_msg=name
+        )
+
+
+def test_padded_replicas_slice_back_exactly():
+    """A non-tile-multiple replica count edge-pads, runs, and slices back
+    to per-replica results identical to the unpadded lax block."""
+    model = _mm1()
+    compiled = _Compiled(model)
+    horizon = float(model.horizon_s)
+    n_replicas = 5  # tile 4 -> padded 8
+    keys, params, state = _init_batch(compiled, n_replicas, seed=2)
+    U = jax.vmap(
+        lambda k: jax.random.uniform(
+            jax.random.fold_in(k, 0),
+            (MACRO, compiled.n_draws),
+            minval=1e-12,
+            maxval=1.0,
+        )
+    )(keys)
+    block_fn, meta = build_block_step(
+        compiled, horizon, MACRO, n_replicas, interpret=True
+    )
+    assert meta["tile"] == 4 and meta["padded_replicas"] == 8
+    padded_state = pad_replicas(state, 8)
+    padded_U = pad_replicas(U, 8)
+    padded_params = pad_replicas(params, 8)
+    out = block_fn(padded_state, padded_U, padded_params)
+    sliced = {k: np.asarray(v)[:n_replicas] for k, v in out.items()}
+    lax_out = _lax_block(compiled, horizon, state, U, params)
+    for name in sorted(lax_out):
+        np.testing.assert_array_equal(
+            sliced[name], np.asarray(lax_out[name]), err_msg=name
+        )
+
+
+def test_block_kernel_rejects_unpadded_inputs():
+    model = _mm1()
+    compiled = _Compiled(model)
+    keys, params, state = _init_batch(compiled, 5)
+    U = jnp.zeros((5, MACRO, compiled.n_draws), jnp.float32)
+    block_fn, _ = build_block_step(
+        compiled, float(model.horizon_s), MACRO, 5, interpret=True
+    )
+    with pytest.raises(ValueError, match="padded"):
+        block_fn(state, U, params)
+
+
+class TestTiling:
+    def test_replica_tile_bytes_sums_per_replica_leaves(self):
+        leaves = [
+            jnp.zeros((4, 8), jnp.float32),  # 128 B
+            jnp.zeros((), jnp.int32),  # 4 B (scalar state leaf, e.g. "t")
+            jnp.zeros((80,), jnp.int32),  # 320 B (one histogram row)
+        ]
+        assert replica_tile_bytes(leaves) == 128 + 4 + 320
+
+    def test_choose_tile_power_of_two_within_budget(self):
+        assert choose_tile(1024, 1000, budget=10_000) == 8
+        assert choose_tile(1024, 1, budget=1 << 30) == 512  # MAX_TILE cap
+        assert choose_tile(6, 1, budget=1 << 30) == 4
+        assert choose_tile(1, 10**9, budget=1) == 1  # never below one
+
+    def test_choose_tile_rejects_empty_ensembles(self):
+        with pytest.raises(ValueError):
+            choose_tile(0, 100)
+
+    def test_padded_replica_count(self):
+        assert padded_replica_count(8, 4) == 8
+        assert padded_replica_count(9, 4) == 12
+        assert padded_replica_count(1, 1) == 1
+
+    def test_pad_replicas_edge_duplicates_last_row(self):
+        tree = {"a": jnp.arange(6.0).reshape(3, 2), "b": jnp.arange(3)}
+        padded = pad_replicas(tree, 5)
+        assert padded["a"].shape == (5, 2)
+        np.testing.assert_array_equal(np.asarray(padded["a"][3:]), [[4, 5], [4, 5]])
+        np.testing.assert_array_equal(np.asarray(padded["b"][3:]), [2, 2])
+
+    def test_pad_replicas_noop_when_aligned(self):
+        tree = {"a": jnp.arange(4.0)}
+        padded = pad_replicas(tree, 4)
+        np.testing.assert_array_equal(np.asarray(padded["a"]), np.arange(4.0))
+
+
+class TestDeclinePredicate:
+    def test_mm1_and_chain_are_supported(self):
+        plan, reason = kernel_plan(_mm1())
+        assert plan == {"shape": "mm1", "servers": [0]} and reason == ""
+        plan, reason = kernel_plan(_chain_with_transit())
+        assert plan == {"shape": "chain", "servers": [0, 1]} and reason == ""
+
+    def test_deadline_retry_chain_is_supported(self):
+        model = EnsembleModel(horizon_s=5.0)
+        src = model.source(rate=4.0)
+        srv = model.server(service_mean=0.1, deadline_s=2.0, max_retries=1)
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        plan, _ = kernel_plan(model)
+        assert plan is not None
+
+    @pytest.mark.parametrize(
+        "mutate, fragment",
+        [
+            (lambda m: m.router(targets=[]), "router"),
+            (lambda m: m.limiter(refill_rate=5.0, capacity=5.0), "limiter"),
+            (lambda m: m.telemetry(window_s=1.0), "telemetry"),
+            (
+                lambda m: m.correlated_outages(rate=0.1, mean_duration_s=1.0),
+                "correlated",
+            ),
+            (lambda m: m.sink(), "sinks"),
+            (
+                lambda m: m.source(rate=1.0),
+                "sources",
+            ),
+        ],
+    )
+    def test_declines_unsupported_features(self, mutate, fragment):
+        model = _mm1()
+        mutate(model)
+        plan, reason = kernel_plan(model)
+        assert plan is None
+        assert fragment in reason
+        # Every decline names the engine path that ran and its flag.
+        assert "HS_TPU_PALLAS" in reason and "lax" in reason
+
+    def test_declines_chaos_servers(self):
+        from happysim_tpu.tpu.model import FaultSpec
+
+        model = EnsembleModel(horizon_s=5.0)
+        src = model.source(rate=4.0)
+        srv = model.server(
+            service_mean=0.1,
+            fault=FaultSpec(rate=0.05, mean_duration_s=0.5),
+        )
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        plan, reason = kernel_plan(model)
+        assert plan is None and "fault" in reason
+
+    def test_declines_packet_loss_and_profiles(self):
+        model = _mm1()
+        model.servers[0].latency = type(model.servers[0].latency)(
+            mean_s=0.0, loss_p=0.1
+        )
+        plan, reason = kernel_plan(model)
+        assert plan is None and "loss" in reason
+
+        ramped = EnsembleModel(horizon_s=5.0)
+        src = ramped.ramp_source(1.0, 5.0, 2.0)
+        snk = ramped.sink()
+        srv = ramped.server(service_mean=0.1)
+        ramped.connect(src, srv)
+        ramped.connect(srv, snk)
+        plan, reason = kernel_plan(ramped)
+        assert plan is None and "profile" in reason
+
+    def test_model_kernel_supported_mirror(self):
+        ok, reason = _mm1().kernel_supported()
+        assert ok and reason == ""
+        model = _mm1()
+        model.limiter(refill_rate=1.0, capacity=2.0)
+        ok, reason = model.kernel_supported()
+        assert not ok and "HS_TPU_PALLAS" in reason
+
+
+class TestKernelDecision:
+    def _mesh(self, n=1):
+        import jax
+
+        from happysim_tpu.tpu.mesh import replica_mesh
+
+        return replica_mesh(jax.devices("cpu")[:n])
+
+    def test_env_off(self, monkeypatch):
+        from happysim_tpu.tpu.kernels import kernel_decision
+
+        monkeypatch.setenv("HS_TPU_PALLAS", "0")
+        use, note = kernel_decision(
+            _mm1(), mesh=self._mesh(), checkpointing=False, macro=32
+        )
+        assert not use and "HS_TPU_PALLAS=0" in note
+
+    def test_forced_on_cpu_uses_interpret(self, monkeypatch):
+        from happysim_tpu.tpu.kernels import kernel_decision
+
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        use, note = kernel_decision(
+            _mm1(), mesh=self._mesh(), checkpointing=False, macro=32
+        )
+        assert use and note == ""
+
+    def test_auto_declines_off_tpu(self, monkeypatch):
+        from happysim_tpu.tpu.kernels import kernel_decision
+
+        monkeypatch.delenv("HS_TPU_PALLAS", raising=False)
+        use, note = kernel_decision(
+            _mm1(), mesh=self._mesh(), checkpointing=False, macro=32
+        )
+        assert not use and "auto-engages on TPU" in note
+
+    def test_checkpointing_declines(self, monkeypatch):
+        from happysim_tpu.tpu.kernels import kernel_decision
+
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        use, note = kernel_decision(
+            _mm1(), mesh=self._mesh(), checkpointing=True, macro=32
+        )
+        assert not use and "checkpoint" in note
+
+    def test_multi_device_mesh_declines(self, monkeypatch):
+        from happysim_tpu.tpu.kernels import kernel_decision
+
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        use, note = kernel_decision(
+            _mm1(), mesh=self._mesh(8), checkpointing=False, macro=32
+        )
+        assert not use and "mesh" in note
+
+    def test_oversized_macro_block_declines(self, monkeypatch):
+        from happysim_tpu.tpu.kernels import kernel_decision
+
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        use, note = kernel_decision(
+            _mm1(), mesh=self._mesh(), checkpointing=False, macro=1024
+        )
+        assert not use and "macro_block" in note

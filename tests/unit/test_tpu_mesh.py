@@ -1,0 +1,97 @@
+"""Edge-case unit tier for tpu/mesh.py (ISSUE 4 satellite).
+
+``pad_to_multiple`` boundary inputs, single-device mesh/sharding
+construction, and the padded-replica truncation accounting
+(``truncated_replicas``) round-tripping through ``run_ensemble``.
+"""
+
+import jax
+import pytest
+
+from happysim_tpu.tpu.mesh import (
+    HOST_AXIS,
+    REPLICA_AXIS,
+    pad_to_multiple,
+    replica_mesh,
+    replica_sharding,
+    replicated_sharding,
+)
+from happysim_tpu.tpu.model import mm1_model
+
+
+class TestPadToMultiple:
+    def test_already_aligned_is_identity(self):
+        assert pad_to_multiple(8, 4) == 8
+        assert pad_to_multiple(4, 4) == 4
+        assert pad_to_multiple(65536, 8) == 65536
+
+    def test_zero_remainder_degenerates(self):
+        assert pad_to_multiple(0, 4) == 0
+        assert pad_to_multiple(0, 1) == 0
+
+    def test_single_device_never_pads(self):
+        for n in (1, 3, 5, 17):
+            assert pad_to_multiple(n, 1) == n
+
+    def test_rounds_up_not_down(self):
+        assert pad_to_multiple(5, 4) == 8
+        assert pad_to_multiple(9, 8) == 16
+        assert pad_to_multiple(1, 8) == 8
+
+
+class TestSingleDeviceMesh:
+    def test_replica_mesh_single_device(self):
+        mesh = replica_mesh(jax.devices("cpu")[:1])
+        assert mesh.size == 1
+        assert mesh.axis_names == (REPLICA_AXIS,)
+        assert HOST_AXIS not in mesh.axis_names
+
+    def test_replica_sharding_single_device(self):
+        mesh = replica_mesh(jax.devices("cpu")[:1])
+        sharding = replica_sharding(mesh)
+        assert sharding.spec == jax.sharding.PartitionSpec(REPLICA_AXIS)
+        # On one device the sharding is trivially addressable-complete.
+        assert sharding.is_fully_addressable
+
+    def test_replicated_sharding_spec_is_empty(self):
+        mesh = replica_mesh(jax.devices("cpu")[:1])
+        assert replicated_sharding(mesh).spec == jax.sharding.PartitionSpec()
+
+
+class TestPaddedTruncationRoundTrip:
+    """Replica padding + event-budget truncation through run_ensemble:
+    the padded lanes are REAL simulations, so the truncation census must
+    count over the padded total, and an ample budget reports zero."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return replica_mesh(jax.devices("cpu")[:4])
+
+    def test_padded_count_and_full_truncation(self, mesh):
+        from happysim_tpu.tpu import run_ensemble
+
+        # 5 requested replicas pad to 8 on the 4-device mesh; a 2-event
+        # budget truncates EVERY lane, padded ones included.
+        result = run_ensemble(
+            mm1_model(horizon_s=20.0),
+            n_replicas=5,
+            seed=0,
+            mesh=mesh,
+            max_events=2,
+        )
+        assert result.n_replicas == 8
+        assert result.truncated_replicas == 8
+
+    def test_ample_budget_reports_zero_truncation(self, mesh):
+        from happysim_tpu.tpu import run_ensemble
+
+        result = run_ensemble(
+            mm1_model(lam=2.0, mu=10.0, horizon_s=2.0),
+            n_replicas=5,
+            seed=0,
+            mesh=mesh,
+            max_events=128,
+        )
+        assert result.n_replicas == 8
+        assert result.truncated_replicas == 0
+        assert result.engine_path == "scan"  # explicit budget skips chain

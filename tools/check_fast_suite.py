@@ -26,7 +26,6 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 BASE_ARGS = [
-    "tests/",
     "-q",
     "--continue-on-collection-errors",
     "-p", "no:cacheprovider",
@@ -35,7 +34,8 @@ BASE_ARGS = [
 ]
 
 SUMMARY_RE = re.compile(
-    r"(?P<failed>\d+) failed|(?P<passed>\d+) passed|(?P<errors>\d+) errors?"
+    r"(?P<failed>\d+) failed|(?P<passed>\d+) passed"
+    r"|(?P<errors>\d+) errors?|(?P<skipped>\d+) skipped"
 )
 
 
@@ -50,6 +50,36 @@ def main(argv: list[str]) -> int:
     args, pytest_extra = parser.parse_known_args(argv)
 
     cmd = [sys.executable, "-m", "pytest", *BASE_ARGS]
+
+    # The whole fast tier by default; an explicit test path in the extra
+    # args narrows the gate to that subset (CI's kernel-equivalence step
+    # runs `check_fast_suite.py tests/unit/test_kernel_event_step.py`).
+    # Paths resolve against the REPO ROOT too — pytest runs with
+    # cwd=REPO_ROOT, so an invoker-relative spelling like
+    # `./tests/unit/...` from another directory must still narrow the
+    # gate rather than silently widening it to the full suite. Values
+    # consumed by option flags (-k docs, -p no:xdist, ...) are NOT
+    # paths even when a same-named repo entry happens to exist.
+    _VALUE_FLAGS = {"-k", "-m", "-o", "-p", "-W", "--deselect", "--ignore"}
+
+    def _test_paths(args: list[str]) -> list[str]:
+        paths, skip_next = [], False
+        for arg in args:
+            if skip_next:
+                skip_next = False
+                continue
+            if arg.startswith("-"):
+                skip_next = arg in _VALUE_FLAGS
+                continue
+            target = arg.split("::", 1)[0]
+            if os.path.exists(os.path.join(REPO_ROOT, target)) or os.path.exists(
+                target
+            ):
+                paths.append(arg)
+        return paths
+
+    if not _test_paths(pytest_extra):
+        cmd += ["tests/"]
     if not any(arg == "-m" for arg in pytest_extra):
         cmd += ["-m", "not slow"]
     cmd += pytest_extra
@@ -68,7 +98,7 @@ def main(argv: list[str]) -> int:
     tail = proc.stdout.splitlines()[-30:]
     print("\n".join(tail))
 
-    counts = {"failed": 0, "passed": 0, "errors": 0}
+    counts = {"failed": 0, "passed": 0, "errors": 0, "skipped": 0}
     for match in SUMMARY_RE.finditer(proc.stdout):
         for key, value in match.groupdict().items():
             if value is not None:
@@ -82,9 +112,14 @@ def main(argv: list[str]) -> int:
             file=sys.stderr,
         )
         return 1
-    if counts["passed"] == 0:
+    if counts["passed"] == 0 and counts["skipped"] == 0:
         print("FAST SUITE: nothing ran — collection is broken", file=sys.stderr)
         return 1
+    if counts["passed"] == 0:
+        # An all-skip subset (e.g. the kernel-equivalence step on a
+        # jaxlib without pallas) is a clean skip, not a broken gate.
+        print(f"FAST SUITE: GREEN — 0 passed, {counts['skipped']} skipped")
+        return 0
     print(f"FAST SUITE: GREEN — {counts['passed']} passed")
     return 0
 
