@@ -439,6 +439,69 @@ class EnsembleResult:
     # Why the Pallas kernel did NOT run (names HS_TPU_PALLAS; "" when the
     # kernel ran or the run never reached the scan dispatch).
     kernel_decline: str = ""
+    # Engine observability (see engine_report()): macro-block length the
+    # hot loop ran with (0 on the block-free chain path), the per-run
+    # block budget, total macro-blocks actually retired across replicas
+    # (device-counted in the carry — early exit makes this < budget *
+    # replicas on heterogeneous sweeps), and the occupancy histogram
+    # {blocks_run: n_replicas}. On a resumed run the counters cover the
+    # resumed portion only (they are provenance, not simulation state).
+    macro_block: int = 0
+    max_blocks: int = 0
+    blocks_total: int = 0
+    block_occupancy: dict = dataclasses_field(default_factory=dict)
+    # Replica lanes the kernel path actually ran after edge-padding to a
+    # tile multiple (== n_replicas off the kernel path / when aligned).
+    padded_replicas: int = 0
+
+    def engine_report(self) -> dict:
+        """Machine-readable engine provenance: which path ran, why the
+        kernel did or did not engage, where the time went (compile vs
+        run), and the device-counted macro-block occupancy.
+
+        Every engine path exposes the block-occupancy counters: the
+        chain closed form reports ``blocks_total == 0`` (it runs no
+        event loop at all), the scan paths report per-replica
+        early-exit occupancy. ``profiler_scopes`` names the
+        ``jax.named_scope`` annotations a device trace attributes
+        simulator stages to (docs/tpu-engine.md "Profiling the
+        engine").
+        """
+        padded = self.padded_replicas or self.n_replicas
+        budget = self.max_blocks * self.n_replicas
+        report = {
+            "engine_path": self.engine_path,
+            "kernel_decline": self.kernel_decline,
+            "compile_seconds": self.compile_seconds,
+            "run_seconds": self.wall_seconds,
+            "events_per_second": self.events_per_second,
+            "macro_block": self.macro_block,
+            "max_blocks": self.max_blocks,
+            "blocks_total": self.blocks_total,
+            "block_occupancy": dict(self.block_occupancy),
+            "events_per_block": (
+                self.simulated_events / self.blocks_total
+                if self.blocks_total
+                else 0.0
+            ),
+            # Fraction of the block budget the early exit actually spent.
+            "early_exit_occupancy": (
+                self.blocks_total / budget if budget else 0.0
+            ),
+            "padded_replicas": padded,
+            "padded_lane_fraction": (
+                (padded - self.n_replicas) / padded if padded else 0.0
+            ),
+            "profiler_scopes": ("hs.macro_block", "hs.kernel", "hs.reduce"),
+        }
+        if self.kernel_decline:
+            report["escape_hatches"] = {
+                "HS_TPU_PALLAS": "0=lax step, 1=force kernel on supported "
+                "shapes (interpret mode off-TPU), unset=auto on TPU",
+                "HS_TPU_EARLY_EXIT": "0=flat fixed-length chunk scan, "
+                "unset/1=early-exit macro-blocks",
+            }
+        return report
 
     def summary(self):
         from happysim_tpu.core.temporal import Instant
@@ -516,6 +579,21 @@ class EnsembleResult:
             entities.append(
                 EntitySummary(name="model", kind="Chaos", extra=chaos_extra)
             )
+        # Engine provenance: which path ran, and — when the kernel
+        # declined — the reason plus the escape hatches, so a summary
+        # consumer never has to guess which program produced the numbers.
+        engine_extra = {"engine_path": self.engine_path}
+        if self.blocks_total:
+            engine_extra["macro_blocks_run"] = self.blocks_total
+        if self.kernel_decline:
+            engine_extra["kernel_decline"] = self.kernel_decline
+            engine_extra["escape_hatches"] = (
+                "HS_TPU_PALLAS (kernel on/off), "
+                "HS_TPU_EARLY_EXIT (flat vs early-exit scan)"
+            )
+        entities.append(
+            EntitySummary(name="engine", kind="Engine", extra=engine_extra)
+        )
         return SimulationSummary(
             start_time=Instant.Epoch,
             end_time=Instant.from_seconds(self.horizon_s),
@@ -2389,6 +2467,8 @@ def _run_ensemble_segmented(
 
     def make_seg_runner(n: int):
         def run_seg(state, keys, params, offset):
+            # (state, per-replica blocks-run this segment) — the block
+            # counts accumulate on the host across segments.
             return jax.vmap(
                 lambda key, s, p: replica_chunks(key, s, p, offset, n)
             )(keys, state, params)
@@ -2396,7 +2476,7 @@ def _run_ensemble_segmented(
         return jax.jit(
             run_seg,
             in_shardings=(sharding, sharding, sharding, None),
-            out_shardings=sharding,
+            out_shardings=(sharding, sharding),
             **jit_kwargs,
         )
 
@@ -2434,6 +2514,13 @@ def _run_ensemble_segmented(
 
     start = _wall.perf_counter()
     last_snapshot = _wall.perf_counter()
+    # Per-replica macro-block occupancy: the device arrays are collected
+    # and summed on the host only after the loop, so the provenance
+    # counter adds no per-segment host sync (a fetch here would stop
+    # segment k+1 from being enqueued while k executes). Provenance, not
+    # simulation state: a resumed run counts only its own segments — see
+    # EnsembleResult.engine_report().
+    seg_blocks_parts = []
     while chunk_done < n_chunks:
         n_seg = min(seg_chunks, n_chunks - chunk_done)
         if n_seg not in runners:  # unaligned resume point
@@ -2446,7 +2533,10 @@ def _run_ensemble_segmented(
             lazy = _wall.perf_counter() - lazy_start
             compile_seconds += lazy
             start += lazy
-        state = runners[n_seg](state, keys, params, jnp.uint32(chunk_done))
+        state, seg_blocks = runners[n_seg](
+            state, keys, params, jnp.uint32(chunk_done)
+        )
+        seg_blocks_parts.append(seg_blocks)
         chunk_done += n_seg
         # A callback without an interval means "snapshot every segment".
         every = (
@@ -2474,6 +2564,11 @@ def _run_ensemble_segmented(
     reduced = reduce_jit(state)
     events_total = int(np.asarray(reduced["events"]).sum(dtype=np.int64))
     wall = _wall.perf_counter() - start
+    reduced = dict(reduced)
+    reduced["blocks_run"] = sum(
+        (np.asarray(part, dtype=np.int64) for part in seg_blocks_parts),
+        np.zeros((n_replicas,), np.int64),
+    )
     return reduced, events_total, wall, compile_seconds
 
 
@@ -2611,10 +2706,17 @@ def run_ensemble(
     )
 
     use_pallas, kernel_note = kernel_decision(
-        model, mesh=mesh, checkpointing=checkpointing_requested, macro=macro
+        model,
+        mesh=mesh,
+        checkpointing=checkpointing_requested,
+        macro=macro,
+        # The compiled state template lets the decision include the
+        # telemetry buffers / fault registers in its VMEM budget check.
+        compiled=compiled,
     )
     if kernel_note and os.environ.get("HS_TPU_PALLAS") == "1":
         logger.info("run_ensemble: %s", kernel_note)
+    kernel_padded = 0  # set by the kernel path (edge-padding provenance)
 
     def replica_halted(state):
         """True once this replica's next event is past the horizon (or
@@ -2626,7 +2728,10 @@ def run_ensemble(
 
     def replica_chunks(key, state, p, offset, n: int):
         """Advance one replica by up to ``n`` macro-blocks of ``macro``
-        fused event steps, from absolute block ``offset``.
+        fused event steps, from absolute block ``offset``. Returns
+        ``(state, blocks_run)`` — the int32 count of macro-blocks this
+        replica actually executed is the engine's own occupancy counter
+        (surfaced via ``EnsembleResult.engine_report()``).
 
         One batched uniform per block instead of a per-event fold_in +
         draw (threefry amortization); keying on the ABSOLUTE index keeps
@@ -2642,18 +2747,21 @@ def run_ensemble(
 
         def chunk_body(carry, c):
             chunk_key = jax.random.fold_in(key, c)
-            U = jax.random.uniform(
-                chunk_key,
-                (macro, compiled.n_draws),
-                minval=1e-12,
-                maxval=1.0,
-            )
-            carry, _ = lax.scan(
-                step,
-                carry,
-                U,
-                unroll=2,  # measured best on v5e (2: +24%, 4: regression)
-            )
+            # hs.macro_block: one fused block of `macro` event steps —
+            # the hot loop's unit of work in a device trace.
+            with jax.named_scope("hs.macro_block"):
+                U = jax.random.uniform(
+                    chunk_key,
+                    (macro, compiled.n_draws),
+                    minval=1e-12,
+                    maxval=1.0,
+                )
+                carry, _ = lax.scan(
+                    step,
+                    carry,
+                    U,
+                    unroll=2,  # measured best on v5e (2: +24%, 4: regression)
+                )
             return carry, None
 
         if not early_exit:
@@ -2662,7 +2770,7 @@ def run_ensemble(
                 (state, p),
                 jnp.arange(n, dtype=jnp.uint32) + offset,
             )
-            return state
+            return state, jnp.int32(n)
 
         def blocks_cond(carry):
             s, _p, c = carry
@@ -2673,12 +2781,17 @@ def run_ensemble(
             (s, p), _ = chunk_body((s, p), offset + c)
             return (s, p, c + jnp.uint32(1))
 
-        state, _, _ = lax.while_loop(
+        state, _, blocks = lax.while_loop(
             blocks_cond, blocks_body, (state, p, jnp.uint32(0))
         )
-        return state
+        return state, blocks.astype(jnp.int32)
 
     def reduce_final(final):
+        # hs.reduce: the cross-replica reduction stage in a device trace.
+        with jax.named_scope("hs.reduce"):
+            return _reduce_final_impl(final)
+
+    def _reduce_final_impl(final):
         # A replica is truncated if the event budget ran out while it still
         # had work scheduled before the horizon (the engine is
         # work-conserving, so pending work always surfaces in src_next, an
@@ -2770,6 +2883,7 @@ def run_ensemble(
                 interpret=kernel_interpret_mode(),
             )
             n_padded = kmeta["padded_replicas"]
+            kernel_padded = n_padded
 
             @partial(jax.jit, **jit_kwargs)
             def run(keys, params):
@@ -2786,29 +2900,53 @@ def run_ensemble(
                 key_leaf = state.pop("key")
 
                 def chunk(kstate, c):
-                    U = jax.vmap(
-                        lambda k: jax.random.uniform(
-                            jax.random.fold_in(k, c),
-                            (macro, compiled.n_draws),
-                            minval=1e-12,
-                            maxval=1.0,
-                        )
-                    )(keys)
-                    return block_step(kstate, U, params)
+                    with jax.named_scope("hs.macro_block"):
+                        U = jax.vmap(
+                            lambda k: jax.random.uniform(
+                                jax.random.fold_in(k, c),
+                                (macro, compiled.n_draws),
+                                minval=1e-12,
+                                maxval=1.0,
+                            )
+                        )(keys)
+                        return block_step(kstate, U, params)
 
                 if early_exit:
+                    # Per-lane occupancy accumulates in the carry: a lane
+                    # counts a block iff it was still live when the block
+                    # launched — exactly the lax path's per-replica
+                    # while_loop trip count, so the counter is itself
+                    # bit-identical across engine paths.
+
+                    # The halted mask rides the carry so each block pays
+                    # ONE next-candidate min-reduction (cond reads it,
+                    # body refreshes it after stepping), not one in the
+                    # cond plus another for the occupancy count.
 
                     def blocks_cond(carry):
-                        kstate, c = carry
-                        halted = jax.vmap(replica_halted)(kstate)
+                        _kstate, c, _occ, halted = carry
                         return (c < jnp.uint32(n_chunks)) & ~jnp.all(halted)
 
                     def blocks_body(carry):
-                        kstate, c = carry
-                        return chunk(kstate, c), c + jnp.uint32(1)
+                        kstate, c, occ, halted = carry
+                        occ = occ + (~halted).astype(jnp.int32)
+                        kstate = chunk(kstate, c)
+                        return (
+                            kstate,
+                            c + jnp.uint32(1),
+                            occ,
+                            jax.vmap(replica_halted)(kstate),
+                        )
 
-                    state, _ = lax.while_loop(
-                        blocks_cond, blocks_body, (state, jnp.uint32(0))
+                    state, _, blocks, _ = lax.while_loop(
+                        blocks_cond,
+                        blocks_body,
+                        (
+                            state,
+                            jnp.uint32(0),
+                            jnp.zeros((n_padded,), jnp.int32),
+                            jax.vmap(replica_halted)(state),
+                        ),
                     )
                 else:
                     state, _ = lax.scan(
@@ -2816,12 +2954,16 @@ def run_ensemble(
                         state,
                         jnp.arange(n_chunks, dtype=jnp.uint32),
                     )
+                    blocks = jnp.full((n_padded,), n_chunks, jnp.int32)
                 final = {**state, "key": key_leaf}
                 if n_padded != n_replicas:
                     final = jax.tree_util.tree_map(
                         lambda leaf: leaf[:n_replicas], final
                     )
-                return reduce_final(final)
+                    blocks = blocks[:n_replicas]
+                reduced = reduce_final(final)
+                reduced["blocks_run"] = blocks
+                return reduced
 
         else:
 
@@ -2831,7 +2973,10 @@ def run_ensemble(
                     state = compiled.init_state(key, p)
                     return replica_chunks(key, state, p, jnp.uint32(0), n_chunks)
 
-                return reduce_final(jax.vmap(one_replica)(keys, params))
+                final, blocks = jax.vmap(one_replica)(keys, params)
+                reduced = reduce_final(final)
+                reduced["blocks_run"] = blocks
+                return reduced
 
         # AOT-compile so the timed region is pure execution (and the
         # ensemble only runs once; a device->host fetch is the completion
@@ -2878,6 +3023,9 @@ def run_ensemble(
         compile_seconds=compile_seconds,
         engine_path="scan+pallas" if use_pallas else "scan",
         kernel_decline=kernel_note,
+        macro_block=macro,
+        max_blocks=n_chunks,
+        padded_replicas=kernel_padded or n_replicas,
     )
 
 
@@ -2892,9 +3040,13 @@ def _build_result(
     compile_seconds: float = 0.0,
     engine_path: str = "scan",
     kernel_decline: str = "",
+    macro_block: int = 0,
+    max_blocks: int = 0,
+    padded_replicas: int = 0,
 ) -> EnsembleResult:
     """Shared result assembly for the event scan and the chain fast path
-    (``chain.run_chain`` emits the same ``reduced`` key set)."""
+    (``chain.run_chain`` emits the same ``reduced`` key set; the chain
+    path runs no macro-blocks, so its occupancy counters stay zero)."""
     horizon = float(model.horizon_s)
     truncated = int(reduced["truncated"])
     if truncated:
@@ -2911,6 +3063,15 @@ def _build_result(
     host = {k: np.asarray(v) for k, v in reduced.items()}
     nV_real = len(model.servers)
     nL_real = len(model.limiters)
+    # Device-counted macro-block occupancy -> host histogram
+    # {blocks_run: n_replicas} (engine_report()'s occupancy counters).
+    blocks_total = 0
+    block_occupancy: dict = {}
+    if "blocks_run" in host:
+        per_replica_blocks = host.pop("blocks_run").astype(np.int64)
+        blocks_total = int(per_replica_blocks.sum())
+        values, counts = np.unique(per_replica_blocks, return_counts=True)
+        block_occupancy = {int(v): int(c) for v, c in zip(values, counts)}
     # Windowed telemetry series (the chain fast path declines telemetry
     # models, so a telemetry run always reaches here via the event scan).
     timeseries = None
@@ -2967,6 +3128,11 @@ def _build_result(
         compile_seconds=compile_seconds,
         engine_path=engine_path,
         kernel_decline=kernel_decline,
+        macro_block=macro_block,
+        max_blocks=max_blocks,
+        blocks_total=blocks_total,
+        block_occupancy=block_occupancy,
+        padded_replicas=padded_replicas or n_replicas,
     )
 
 

@@ -15,11 +15,17 @@ closure, so the float op order per lane is identical to the lax path by
 construction — results are bit-identical, and ``HS_TPU_PALLAS=0`` /
 ``=1`` is a pure A/B lever (see docs/guides/tpu-kernels.md).
 
-Coverage starts with chain-shaped and M/M/1-shaped models (single
-source -> server chain -> sink; no routers/limiters/chaos). Everything
-else *soundly declines* to the lax step via :func:`kernel_plan` — the
-same pattern as ``chain.fast_plan`` — so correctness never depends on
-kernel coverage.
+Coverage: chain-shaped and M/M/1-shaped models (single source -> server
+chain -> sink), including per-server stochastic fault schedules and
+windowed telemetry — the ``(nW, ...)`` telemetry buffers and ``(nV, W)``
+fault registers are ordinary state leaves, so they ride the
+VMEM-resident tile and the scatter-adds are the engine's own traced
+accounting sites (the realistic "faulted model with telemetry on"
+configuration runs on the fast path). Routers, limiters, correlated
+outages, backoff/hedge resilience, packet loss, and telemetry shapes
+that exceed the VMEM tile budget *soundly decline* to the lax step via
+:func:`kernel_plan` / :func:`kernel_decision` — the same pattern as
+``chain.fast_plan`` — so correctness never depends on kernel coverage.
 """
 
 from happysim_tpu.tpu.kernels.event_step import (
@@ -28,6 +34,8 @@ from happysim_tpu.tpu.kernels.event_step import (
     choose_tile,
     pad_replicas,
     replica_tile_bytes,
+    replica_working_set_bytes,
+    state_template,
 )
 from happysim_tpu.tpu.kernels.support import (
     KERNEL_ENV,
@@ -52,4 +60,6 @@ __all__ = [
     "pad_replicas",
     "pallas_available",
     "replica_tile_bytes",
+    "replica_working_set_bytes",
+    "state_template",
 ]

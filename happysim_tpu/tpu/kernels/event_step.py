@@ -4,7 +4,9 @@ One kernel invocation advances a TILE of replicas by ``macro`` fused
 event steps with the whole per-replica register file resident in VMEM:
 
 - inputs: every state leaf (wake-time registers, queue rings, counter
-  and histogram accumulators), the block's pre-drawn uniform rows
+  and histogram accumulators, the ``(nW, ...)`` windowed-telemetry
+  buffers and the ``(nV, W)`` fault-window registers when the model
+  declares them), the block's pre-drawn uniform rows
   ``(tile, macro, n_draws)``, and the per-replica parameter arrays;
 - body: the engine's OWN single-event step closure
   (``_Compiled.make_step(external_u=True)``) vmapped over the tile and
@@ -25,6 +27,11 @@ Tiling/padding: the replica axis is split into power-of-two tiles sized
 so one tile's in+out register file fits the VMEM budget; a replica
 count that is not a tile multiple is edge-padded (the padded lanes
 duplicate the last replica and are sliced away before reduction).
+Telemetry buffers count toward the same budget — the tile shrinks as
+``nW`` grows, and a register file that exceeds the budget even at
+tile=1 is DECLINED by :func:`~happysim_tpu.tpu.kernels.support.
+kernel_decision` (with a budget-naming reason) rather than silently
+spilled to HBM.
 """
 
 from __future__ import annotations
@@ -75,6 +82,44 @@ def padded_replica_count(n_replicas: int, tile: int) -> int:
     return ((n_replicas + tile - 1) // tile) * tile
 
 
+def state_template(compiled) -> dict:
+    """One replica's state leaves as ``ShapeDtypeStruct``s (the unused
+    per-replica PRNG ``key`` leaf excluded — blocks are keyed outside
+    the kernel). Includes every compile-time-gated leaf the model
+    declares: fault-window registers, telemetry window buffers, transit
+    registers, attempt columns."""
+    template = jax.eval_shape(
+        lambda: compiled.init_state(
+            jnp.zeros((2,), jnp.uint32),
+            {
+                "src_rate": jnp.zeros((compiled.nS,), jnp.float32),
+                "srv_mean": jnp.zeros((compiled.nV,), jnp.float32),
+            },
+        )
+    )
+    template.pop("key")
+    return template
+
+
+def replica_working_set_bytes(compiled, macro: int, template=None) -> int:
+    """Bytes of VMEM one replica pins during a fused macro-block: state
+    counted twice (the aliased outputs still occupy a tile during the
+    kernel) plus the uniform block and the parameter rows. This is the
+    sizing every consumer must share — :func:`build_block_step` for the
+    tile choice and ``kernel_decision`` for the tile=1 budget decline —
+    so telemetry buffers and fault registers can never be counted by
+    one and forgotten by the other. Pass a precomputed
+    :func:`state_template` to skip the eval_shape trace."""
+    if template is None:
+        template = state_template(compiled)
+    leaves = list(template.values())
+    return (
+        2 * replica_tile_bytes(leaves)
+        + macro * compiled.n_draws * 4
+        + (compiled.nS + compiled.nV) * 4
+    )
+
+
 def pad_replicas(tree, n_target: int):
     """Edge-pad every leaf's leading (replica) axis up to ``n_target``.
 
@@ -115,26 +160,11 @@ def build_block_step(
 
     step = compiled.make_step(horizon, external_u=True)
 
-    # Working-set estimate from the init-state template (state counted
-    # twice: the aliased outputs still occupy a VMEM tile during the
-    # kernel) plus the uniform block and the parameter rows.
-    template = jax.eval_shape(
-        lambda: compiled.init_state(
-            jnp.zeros((2,), jnp.uint32),
-            {
-                "src_rate": jnp.zeros((compiled.nS,), jnp.float32),
-                "srv_mean": jnp.zeros((compiled.nV,), jnp.float32),
-            },
-        )
-    )
-    template.pop("key")
+    # Working-set estimate shared with kernel_decision's budget decline
+    # (telemetry buffers and fault registers included via the template).
+    template = state_template(compiled)
     names = tuple(sorted(template))
-    state_leaves = [template[k] for k in names]
-    per_replica = (
-        2 * replica_tile_bytes(state_leaves)
-        + macro * compiled.n_draws * 4
-        + (compiled.nS + compiled.nV) * 4
-    )
+    per_replica = replica_working_set_bytes(compiled, macro, template)
     if tile is None:
         tile = choose_tile(n_replicas, per_replica)
     padded = padded_replica_count(n_replicas, tile)
@@ -237,22 +267,26 @@ def build_block_step(
                     )
             except Exception:
                 pass
-        out = pl.pallas_call(
-            kernel,
-            grid=(padded // tile,),
-            in_specs=[spec(leaf) for leaf in inputs]
-            + [const_spec(c) for c in const_vals],
-            out_specs=[spec(leaf) for leaf in leaves],
-            out_shape=[
-                jax.ShapeDtypeStruct(leaf.shape, leaf.dtype) for leaf in leaves
-            ],
-            # In-place register-file update: each state input aliases its
-            # output, so the macro-block holds ONE copy of the ensemble
-            # state in HBM (the lax path gets the same from scan carries).
-            input_output_aliases={i: i for i in range(len(leaves))},
-            interpret=interpret,
-            **call_kwargs,
-        )(*inputs, *const_vals)
+        # hs.kernel: a device trace attributes the fused block's time to
+        # the simulator's kernel stage (docs/tpu-engine.md "Profiling
+        # the engine").
+        with jax.named_scope("hs.kernel"):
+            out = pl.pallas_call(
+                kernel,
+                grid=(padded // tile,),
+                in_specs=[spec(leaf) for leaf in inputs]
+                + [const_spec(c) for c in const_vals],
+                out_specs=[spec(leaf) for leaf in leaves],
+                out_shape=[
+                    jax.ShapeDtypeStruct(leaf.shape, leaf.dtype) for leaf in leaves
+                ],
+                # In-place register-file update: each state input aliases its
+                # output, so the macro-block holds ONE copy of the ensemble
+                # state in HBM (the lax path gets the same from scan carries).
+                input_output_aliases={i: i for i in range(len(leaves))},
+                interpret=interpret,
+                **call_kwargs,
+            )(*inputs, *const_vals)
         return dict(zip(names, out))
 
     return block_fn, meta

@@ -97,13 +97,21 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
 
     Supported: exactly one source (Poisson or constant arrivals, no rate
     profile) feeding a chain of FIFO servers (any concurrency, any
-    service family, optional deadlines/immediate retries, constant or
-    exponential edges with or without latency) into exactly one sink.
-    Routers, limiters, remotes, telemetry, and all chaos semantics
-    (faults, backoff retries, hedging, outage windows, packet loss)
-    decline — they exercise dynamic gathers and branch shapes the kernel
-    does not claim yet. The decline is SOUND: the caller must run the
-    lax step, never a partial kernel.
+    service family, optional deadlines/immediate retries, per-server
+    stochastic fault schedules — outage OR degrade windows, with or
+    without fault-rejection retries — constant or exponential edges with
+    or without latency) into exactly one sink, with or without windowed
+    telemetry: the ``(nW, ...)`` telemetry buffers and the ``(nV, W)``
+    fault registers are ordinary state leaves, so they ride the
+    VMEM-resident tile and the kernel's scatter-adds are the engine's
+    own traced accounting sites (bit-identity holds with telemetry on
+    AND off). Routers, limiters, correlated (shared-trigger) outages,
+    backoff retries, hedging, deterministic brownout windows, and packet
+    loss still decline — they exercise dynamic gathers and branch shapes
+    the kernel does not claim yet. The decline is SOUND: the caller must
+    run the lax step, never a partial kernel. (Telemetry shapes whose
+    buffers do not fit the VMEM tile budget are declined by
+    :func:`kernel_decision`, which sees the compiled state template.)
     """
     if model.routers:
         return _decline("model has routers")
@@ -111,8 +119,6 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
         return _decline("model has limiters")
     if model.remotes:
         return _decline("model has remote egress nodes")
-    if getattr(model, "telemetry_spec", None) is not None:
-        return _decline("model has windowed telemetry")
     if getattr(model, "correlated_faults", None) is not None:
         return _decline("model has a correlated-outage schedule")
     if len(model.sources) != 1:
@@ -124,8 +130,6 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
         return _decline("source has a rate profile")
     for index, server in enumerate(model.servers):
         label = f"server[{index}]"
-        if server.fault is not None:
-            return _decline(f"{label} has a stochastic fault schedule")
         if server.hedge_delay_s is not None:
             return _decline(f"{label} hedges requests")
         if server.retry_backoff_s is not None:
@@ -163,12 +167,18 @@ def kernel_decision(
     mesh,
     checkpointing: bool,
     macro: int,
+    compiled=None,
 ) -> tuple[bool, str]:
     """Runtime dispatch: should THIS run use the Pallas block kernel?
 
     Returns ``(use_kernel, note)``; the note is surfaced on
     ``EnsembleResult.kernel_decline`` so a declined run names the path
     that executed and the flag that controls it.
+
+    ``compiled`` (an ``engine._Compiled``, optional) enables the VMEM
+    budget check: a per-replica register file — telemetry window buffers
+    included — that exceeds the tile budget even at tile=1 declines with
+    a budget-naming reason instead of silently spilling VMEM.
     """
     mode = kernel_env_mode()
     if mode == "0":
@@ -197,6 +207,26 @@ def kernel_decision(
     plan, reason = kernel_plan(model)
     if plan is None:
         return False, reason
+    if compiled is not None:
+        from happysim_tpu.tpu.kernels.event_step import (
+            VMEM_TILE_BUDGET_BYTES,
+            replica_working_set_bytes,
+        )
+
+        per_replica = replica_working_set_bytes(compiled, macro)
+        if per_replica > VMEM_TILE_BUDGET_BYTES:
+            telemetry_note = (
+                f" (telemetry nW={compiled.nW} windows — grow window_s "
+                "or trim TelemetrySpec.metrics)"
+                if getattr(compiled, "has_telemetry", False)
+                else ""
+            )
+            return False, (
+                f"per-replica VMEM working set {per_replica} B exceeds the "
+                f"{VMEM_TILE_BUDGET_BYTES} B tile budget even at "
+                f"tile=1{telemetry_note}; lax event step ran "
+                f"({KERNEL_ENV} cannot override a budget decline)"
+            )
     if mode == "auto" and kernel_interpret_mode():
         return False, (
             f"{KERNEL_ENV} not set to 1: the kernel auto-engages on TPU "

@@ -35,6 +35,13 @@ EXCLUDED_FIELDS = {
     # SIMULATION must match bit-for-bit; the route taken may differ)
     "engine_path",
     "kernel_decline",
+    # block-occupancy provenance: a resumed run counts only its own
+    # post-resume macro-blocks (engine_report observability, not state)
+    "macro_block",
+    "max_blocks",
+    "blocks_total",
+    "block_occupancy",
+    "padded_replicas",
 }
 
 

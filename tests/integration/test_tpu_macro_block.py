@@ -27,6 +27,13 @@ EXCLUDED_FIELDS = {
     # SIMULATION must match bit-for-bit; the route taken may differ)
     "engine_path",
     "kernel_decline",
+    # block-occupancy provenance: flat-vs-early twins legitimately run
+    # different block counts (engine_report observability, not state)
+    "macro_block",
+    "max_blocks",
+    "blocks_total",
+    "block_occupancy",
+    "padded_replicas",
 }
 
 

@@ -3,11 +3,13 @@
 Full-run bit-identity: ``HS_TPU_PALLAS=1`` (fused macro-block kernel,
 interpret mode on CPU) vs ``HS_TPU_PALLAS=0`` (lax event step) must
 produce IDENTICAL results — same RNG stream, same float op order per
-lane — across M/M/1 and deadline/retry sweep shapes, with and without
-early exit, including the replica-padding path (transit-edge chains get
+lane — across M/M/1, deadline/retry sweep, and faulted+telemetry shapes
+(simulation counters AND telemetry series), with and without early
+exit, including the replica-padding path (transit-edge chains get
 block-level bit-identity in tests/unit/test_kernel_event_step.py).
 Unsupported shapes and checkpointed runs decline soundly to the lax
-step.
+step, and checkpoint/resume round-trips the telemetry buffers onto the
+kernel run's exact numbers.
 
 Runs are cached per (scenario, flags) so each compiled program is paid
 for once per session.
@@ -51,9 +53,29 @@ def _deadline_sweep():
     return model, {"n_replicas": 4, "max_events": 256, "sweeps": sweeps}
 
 
+def _faulted_telemetry():
+    """The PR-6 production shape: stochastic fault windows AND an
+    8-window telemetry spec, both riding the VMEM-resident tile."""
+    from happysim_tpu.tpu.model import FaultSpec
+
+    model = EnsembleModel(horizon_s=4.0, macro_block=MACRO)
+    src = model.source(rate=5.0)
+    srv = model.server(
+        service_mean=0.1,
+        queue_capacity=16,
+        fault=FaultSpec(rate=0.5, mean_duration_s=0.3),
+    )
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    model.telemetry(window_s=0.5)
+    return model, {"n_replicas": 6, "max_events": 96}
+
+
 _SCENARIOS = {
     "mm1": _mm1,
     "deadline_sweep": _deadline_sweep,
+    "faulted_telemetry": _faulted_telemetry,
 }
 _CACHE = {}
 
@@ -119,9 +141,95 @@ class TestBitIdentity:
             kernel_flat.sink_mean_latency_s == lax_early.sink_mean_latency_s
         )
 
+    def test_faulted_telemetry_runs_the_kernel_bit_identically(self):
+        """PR-6 tentpole: the faulted model WITH telemetry on is
+        accepted (not declined) and stays bit-identical to the lax path
+        — simulation counters AND every telemetry series."""
+        kernel_r = _run("faulted_telemetry", True)
+        lax_r = _run("faulted_telemetry", False)
+        _assert_bit_identical(kernel_r, lax_r)
+        assert kernel_r.server_fault_dropped == lax_r.server_fault_dropped
+        kts, lts = kernel_r.timeseries, lax_r.timeseries
+        assert kts is not None and lts is not None
+        np.testing.assert_array_equal(kts.sink_count, lts.sink_count)
+        np.testing.assert_array_equal(kts.sink_hist, lts.sink_hist)
+        np.testing.assert_array_equal(kts.sink_p99_s, lts.sink_p99_s)
+        np.testing.assert_array_equal(
+            kts.server_fault_dropped, lts.server_fault_dropped
+        )
+        np.testing.assert_array_equal(
+            kts.server_mean_queue_len, lts.server_mean_queue_len
+        )
+
+    def test_engine_report_occupancy_matches_across_paths(self):
+        """The device-counted macro-block occupancy is itself
+        bit-identical between the kernel's batch-level loop and the lax
+        per-replica while_loop, and the kernel path reports its
+        edge-padding provenance."""
+        kernel_r = _run("faulted_telemetry", True)
+        lax_r = _run("faulted_telemetry", False)
+        k_report = kernel_r.engine_report()
+        l_report = lax_r.engine_report()
+        assert k_report["engine_path"] == "scan+pallas"
+        assert k_report["blocks_total"] == l_report["blocks_total"] > 0
+        assert k_report["block_occupancy"] == l_report["block_occupancy"]
+        assert sum(k_report["block_occupancy"].values()) == kernel_r.n_replicas
+        # R=6 pads to the 4-lane tile -> 8 lanes, 25% padded.
+        assert k_report["padded_replicas"] == 8
+        assert k_report["padded_lane_fraction"] == pytest.approx(0.25)
+        assert l_report["padded_replicas"] == lax_r.n_replicas
+
+
+class TestCheckpointResumeUnderKernelTelemetry:
+    def test_resume_round_trips_the_buffers_identically(self, monkeypatch):
+        """Checkpoint/resume (segmented lax scan — the kernel declines
+        checkpointing) must reproduce the kernel run of the SAME
+        faulted+telemetry model bit-for-bit: the telemetry buffers and
+        fault registers round-trip through the snapshot and land on the
+        same numbers the VMEM tile produced."""
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        kernel_r = _run("faulted_telemetry", True)
+        snapshots = []
+        model, kwargs = _faulted_telemetry()
+        mesh = replica_mesh(jax.devices("cpu")[:1])
+        seg_r = run_ensemble(
+            model,
+            seed=7,
+            mesh=mesh,
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+            **kwargs,
+        )
+        assert seg_r.engine_path == "scan"
+        assert "checkpoint" in seg_r.kernel_decline
+        assert snapshots, "expected at least one mid-run snapshot"
+        # The snapshot carries the telemetry buffers and fault registers.
+        assert any(k.startswith("tel_") for k in snapshots[0].state)
+        assert "flt_start" in snapshots[0].state
+        model, kwargs = _faulted_telemetry()
+        resumed = run_ensemble(
+            model, seed=7, mesh=mesh, resume_from=snapshots[0], **kwargs
+        )
+        for result in (seg_r, resumed):
+            assert result.simulated_events == kernel_r.simulated_events
+            assert result.sink_count == kernel_r.sink_count
+            assert result.sink_mean_latency_s == kernel_r.sink_mean_latency_s
+            assert (
+                result.server_fault_dropped == kernel_r.server_fault_dropped
+            )
+            np.testing.assert_array_equal(
+                result.timeseries.sink_count, kernel_r.timeseries.sink_count
+            )
+            np.testing.assert_array_equal(
+                result.timeseries.sink_hist, kernel_r.timeseries.sink_hist
+            )
+
 
 class TestSoundDecline:
-    def test_faulted_model_declines_to_lax(self, monkeypatch):
+    def test_correlated_outages_decline_to_lax(self, monkeypatch):
+        """Per-server fault schedules ride the kernel now; the SHARED
+        correlated-outage trigger still declines (soundly, to the lax
+        step, with the reason surfaced)."""
         from happysim_tpu.tpu.model import FaultSpec
 
         model = EnsembleModel(horizon_s=2.0, macro_block=MACRO)
@@ -129,11 +237,12 @@ class TestSoundDecline:
         srv = model.server(
             service_mean=0.05,
             queue_capacity=8,
-            fault=FaultSpec(rate=0.5, mean_duration_s=0.2),
+            fault=FaultSpec(rate=0.5, mean_duration_s=0.2, correlated=True),
         )
         snk = model.sink()
         model.connect(src, srv)
         model.connect(srv, snk)
+        model.correlated_outages(rate=0.2, mean_duration_s=0.5)
         monkeypatch.setenv("HS_TPU_PALLAS", "1")
         result = run_ensemble(
             model,
@@ -143,7 +252,7 @@ class TestSoundDecline:
             max_events=96,
         )
         assert result.engine_path == "scan"
-        assert "fault" in result.kernel_decline
+        assert "correlated" in result.kernel_decline
         assert "HS_TPU_PALLAS" in result.kernel_decline
 
     def test_checkpointing_declines_to_segmented_scan(self, monkeypatch):

@@ -73,11 +73,16 @@ SIM_FIELDS_EXCLUDED = {
     "events_per_second",
     "timeseries",
     "compile_seconds",
-    # Engine-path provenance: the telemetry run's kernel-decline note
-    # names telemetry while its twin's names whatever else declined —
-    # the SIMULATION fields are what must match.
+    # Engine-path provenance: the two runs may take different engine
+    # routes — the SIMULATION fields are what must match.
     "engine_path",
     "kernel_decline",
+    # block-occupancy provenance (engine_report observability, not state)
+    "macro_block",
+    "max_blocks",
+    "blocks_total",
+    "block_occupancy",
+    "padded_replicas",
 }
 
 

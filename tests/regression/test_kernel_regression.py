@@ -115,3 +115,108 @@ def test_lax_path_reproduces_the_same_golden(kernel_result):
     np.testing.assert_array_equal(
         np.asarray(lax_result.sink_hist), np.asarray(kernel_result.sink_hist)
     )
+
+
+# ---------------------------------------------------------------------------
+# Faulted + telemetry chain (PR 6): the production configuration the kernel
+# now accepts. Provenance: seed=123, 8 replicas, source rate=6 ->
+# server(mean=0.08, cap=16, FaultSpec(rate=0.4, mean_duration_s=0.4)) ->
+# server(mean=0.05, cap=16) -> sink, horizon=6s, 12-window telemetry
+# (window_s=0.5), macro_block=4, max_events=192, CPU interpret path.
+# ---------------------------------------------------------------------------
+
+FAULTED_TEL_GOLDEN = {
+    "simulated_events": 810,
+    "sink_count": [251],
+    "server_completed": [253, 251],
+    "server_fault_dropped": [48, 0],
+    "truncated_replicas": 0,
+    "sink_mean_latency_s": 0.18096154809473045,
+    "sink_p99_s": 0.5623413251903491,
+    # Per-window sink deliveries and p99(t) — the time-resolved goldens.
+    "window_sink_count": [12, 33, 28, 22, 17, 12, 10, 20, 25, 22, 31, 19],
+    "window_p99_s": [
+        0.2818382931264455, 0.4466835921509635, 0.3548133892335753,
+        0.2818382931264455, 0.3548133892335753, 0.5623413251903491,
+        0.4466835921509635, 0.5623413251903491, 0.3548133892335753,
+        0.3548133892335753, 0.5623413251903491, 0.5623413251903491,
+    ],
+}
+
+
+def _pinned_faulted_telemetry_run(pallas: bool):
+    from happysim_tpu.tpu.kernels import env_override
+    from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+    model = EnsembleModel(horizon_s=6.0, macro_block=4)
+    src = model.source(rate=6.0)
+    first = model.server(
+        service_mean=0.08,
+        queue_capacity=16,
+        fault=FaultSpec(rate=0.4, mean_duration_s=0.4),
+    )
+    second = model.server(service_mean=0.05, queue_capacity=16)
+    snk = model.sink()
+    model.connect(src, first)
+    model.connect(first, second)
+    model.connect(second, snk)
+    model.telemetry(window_s=0.5)
+    with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+        return run_ensemble(
+            model,
+            n_replicas=8,
+            seed=123,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            max_events=192,
+        )
+
+
+@pytest.fixture(scope="module", params=[True, False], ids=["pallas", "lax"])
+def faulted_tel_result(request):
+    """BOTH engine paths, each asserted against the SAME golden — a
+    joint drift of kernel and lax cannot slip through."""
+    return _pinned_faulted_telemetry_run(request.param), request.param
+
+
+def test_faulted_telemetry_engine_path(faulted_tel_result):
+    result, pallas = faulted_tel_result
+    if pallas:
+        assert result.engine_path == "scan+pallas", result.kernel_decline
+        assert result.kernel_decline == ""
+    else:
+        assert result.engine_path == "scan"
+
+
+def test_faulted_telemetry_counts_match_golden(faulted_tel_result):
+    result, _ = faulted_tel_result
+    g = FAULTED_TEL_GOLDEN
+    assert result.simulated_events == g["simulated_events"]
+    assert result.sink_count == g["sink_count"]
+    assert result.server_completed == g["server_completed"]
+    assert result.server_fault_dropped == g["server_fault_dropped"]
+    assert result.truncated_replicas == g["truncated_replicas"]
+    assert result.sink_mean_latency_s[0] == pytest.approx(
+        g["sink_mean_latency_s"], rel=1e-12
+    )
+    assert result.sink_p99_s[0] == pytest.approx(g["sink_p99_s"], rel=1e-12)
+
+
+def test_faulted_telemetry_timeseries_matches_golden(faulted_tel_result):
+    result, _ = faulted_tel_result
+    ts = result.timeseries
+    assert ts is not None and ts.n_windows == 12
+    assert ts.sink_count[:, 0].tolist() == FAULTED_TEL_GOLDEN["window_sink_count"]
+    np.testing.assert_allclose(
+        ts.sink_p99_s[:, 0],
+        FAULTED_TEL_GOLDEN["window_p99_s"],
+        rtol=1e-12,
+    )
+    # Windowed sums equal the whole-run counters exactly — the invariant
+    # that pins every scatter site to the engine's own accounting.
+    assert ts.sink_count.sum(axis=0).tolist() == result.sink_count
+    np.testing.assert_array_equal(
+        ts.sink_hist.sum(axis=0), np.asarray(result.sink_hist)
+    )
+    assert ts.server_fault_dropped.sum(axis=0).tolist() == (
+        result.server_fault_dropped
+    )

@@ -91,3 +91,52 @@ def test_zero_totals_stay_silent():
         network_lost=0,
     ).summary()
     assert _chaos_entities(summary) == []
+
+
+# -- engine provenance entity (PR 6) ----------------------------------------
+
+
+def _engine_entities(summary):
+    return [e for e in summary.entities if e.kind == "Engine"]
+
+
+def test_engine_entity_always_present_with_path():
+    (engine,) = _engine_entities(_result(engine_path="chain").summary())
+    assert engine.extra["engine_path"] == "chain"
+    assert "kernel_decline" not in engine.extra
+
+
+def test_engine_entity_names_escape_hatches_on_decline():
+    summary = _result(
+        engine_path="scan",
+        kernel_decline="Pallas kernel declined (model has routers); ...",
+        blocks_total=96,
+    ).summary()
+    (engine,) = _engine_entities(summary)
+    assert engine.extra["macro_blocks_run"] == 96
+    assert "routers" in engine.extra["kernel_decline"]
+    assert "HS_TPU_PALLAS" in engine.extra["escape_hatches"]
+    assert "HS_TPU_EARLY_EXIT" in engine.extra["escape_hatches"]
+
+
+def test_engine_report_exposes_occupancy_and_hatches():
+    result = _result(
+        engine_path="scan+pallas",
+        macro_block=32,
+        max_blocks=25,
+        blocks_total=80,
+        block_occupancy={20: 4},
+        padded_replicas=8,
+    )
+    report = result.engine_report()
+    assert report["engine_path"] == "scan+pallas"
+    assert report["block_occupancy"] == {20: 4}
+    assert report["events_per_block"] == 100 / 80
+    assert report["early_exit_occupancy"] == 80 / (25 * 4)
+    assert report["padded_lane_fraction"] == 0.5
+    assert "escape_hatches" not in report  # kernel ran: nothing declined
+    declined = _result(kernel_decline="declined (whatever)")
+    assert set(declined.engine_report()["escape_hatches"]) == {
+        "HS_TPU_PALLAS",
+        "HS_TPU_EARLY_EXIT",
+    }

@@ -27,9 +27,10 @@ from happysim_tpu.tpu.kernels import (
     kernel_plan,
     pad_replicas,
     replica_tile_bytes,
+    replica_working_set_bytes,
 )
 from happysim_tpu.tpu.kernels.event_step import padded_replica_count
-from happysim_tpu.tpu.model import EnsembleModel, mm1_model
+from happysim_tpu.tpu.model import EnsembleModel, FaultSpec, mm1_model
 
 
 def _mm1(horizon=3.0):
@@ -45,6 +46,19 @@ def _chain_with_transit():
     model.connect(src, first, latency_s=0.02, latency_kind="exponential")
     model.connect(first, second, latency_s=0.01)
     model.connect(second, snk)
+    return model
+
+
+def _faulted_telemetry_chain():
+    """The production shape this PR moves onto the fast path: stochastic
+    fault windows (outage + degrade) AND windowed telemetry buffers,
+    both riding the VMEM tile as ordinary state leaves."""
+    model = _chain_with_transit()
+    model.servers[0].fault = FaultSpec(rate=0.8, mean_duration_s=0.2)
+    model.servers[1].fault = FaultSpec(
+        rate=0.5, mean_duration_s=0.3, mode="degrade", latency_factor=2.0
+    )
+    model.telemetry(window_s=0.5)
     return model
 
 
@@ -84,12 +98,16 @@ def _lax_block(compiled, horizon, state, U, params):
 MACRO = 2
 
 
-# One topology here: the transit chain exercises the superset of state
-# leaves (two servers, erlang family, transit registers). The M/M/1 shape
-# gets block-level coverage from the consecutive-blocks test below and
-# full-run coverage from the integration + regression tiers — a second
-# parametrized compile would only re-pay the interpret-mode XLA build.
-@pytest.mark.parametrize("build", [_chain_with_transit])
+# Two topologies: the transit chain exercises the superset of the base
+# state leaves (two servers, erlang family, transit registers) WITHOUT
+# telemetry, and the faulted+telemetry chain adds the fault registers +
+# windowed buffers — so bit-identity is asserted with telemetry off AND
+# on at block level. The M/M/1 shape gets block-level coverage from the
+# consecutive-blocks test below and full-run coverage from the
+# integration + regression tiers.
+@pytest.mark.parametrize(
+    "build", [_chain_with_transit, _faulted_telemetry_chain]
+)
 def test_block_kernel_bit_identical_to_lax_scan(build):
     """One fused kernel call == the lax scan, leaf by leaf, bit for bit."""
     model = build()
@@ -228,6 +246,71 @@ class TestTiling:
         np.testing.assert_array_equal(np.asarray(padded["a"]), np.arange(4.0))
 
 
+class TestVmemBudgetSizing:
+    """PR-6 rider: the tile choice must account for the telemetry
+    buffers, and a register file that cannot fit even one replica in the
+    budget DECLINES (naming the budget) instead of silently spilling."""
+
+    def test_working_set_grows_with_telemetry_windows(self):
+        base = replica_working_set_bytes(_Compiled(_mm1()), MACRO)
+        small = _mm1()
+        small.telemetry(window_s=small.horizon_s / 4)
+        big = _mm1()
+        big.telemetry(window_s=big.horizon_s / 64)
+        small_bytes = replica_working_set_bytes(_Compiled(small), MACRO)
+        big_bytes = replica_working_set_bytes(_Compiled(big), MACRO)
+        assert base < small_bytes < big_bytes
+        # The latency histogram dominates: 64 windows x 80 bins x int32,
+        # counted twice (aliased outputs occupy their own tile).
+        assert big_bytes - base >= 2 * 64 * 80 * 4
+
+    def test_tile_choice_pinned_at_the_budget_boundary(self):
+        """The chosen tile is exactly choose_tile() of the
+        telemetry-inclusive working set — pinned on both sides of a
+        power-of-two budget boundary via an explicit budget."""
+        model = _mm1()
+        model.telemetry(window_s=model.horizon_s / 16)
+        compiled = _Compiled(model)
+        per_replica = replica_working_set_bytes(compiled, MACRO)
+        # Budget exactly 8 working sets -> tile 8; one byte less -> 4.
+        assert choose_tile(512, per_replica, budget=8 * per_replica) == 8
+        assert choose_tile(512, per_replica, budget=8 * per_replica - 1) == 4
+        # And build_block_step's default-budget tile matches the shared
+        # sizing primitive (telemetry buffers included, not forgotten).
+        _fn, meta = build_block_step(
+            compiled, float(model.horizon_s), MACRO, 512, interpret=True
+        )
+        assert meta["bytes_per_replica"] == per_replica
+        assert meta["tile"] == choose_tile(512, per_replica)
+
+    def test_over_budget_telemetry_declines_naming_the_budget(self, monkeypatch):
+        from happysim_tpu.tpu.kernels import event_step, kernel_decision
+        from happysim_tpu.tpu.mesh import replica_mesh
+
+        model = _mm1()
+        model.telemetry(window_s=model.horizon_s / 64)
+        compiled = _Compiled(model)
+        per_replica = replica_working_set_bytes(compiled, 32)
+        mesh = replica_mesh(jax.devices("cpu")[:1])
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        # Under the real budget this shape is accepted...
+        use, note = kernel_decision(
+            model, mesh=mesh, checkpointing=False, macro=32, compiled=compiled
+        )
+        assert use and note == ""
+        # ...and with the budget pinched below one working set it
+        # declines, naming the budget and the telemetry shape.
+        monkeypatch.setattr(
+            event_step, "VMEM_TILE_BUDGET_BYTES", per_replica - 1
+        )
+        use, note = kernel_decision(
+            model, mesh=mesh, checkpointing=False, macro=32, compiled=compiled
+        )
+        assert not use
+        assert "VMEM" in note and "budget" in note and "tile=1" in note
+        assert "nW=64" in note  # the decline names the telemetry shape
+
+
 class TestDeclinePredicate:
     def test_mm1_and_chain_are_supported(self):
         plan, reason = kernel_plan(_mm1())
@@ -250,7 +333,6 @@ class TestDeclinePredicate:
         [
             (lambda m: m.router(targets=[]), "router"),
             (lambda m: m.limiter(refill_rate=5.0, capacity=5.0), "limiter"),
-            (lambda m: m.telemetry(window_s=1.0), "telemetry"),
             (
                 lambda m: m.correlated_outages(rate=0.1, mean_duration_s=1.0),
                 "correlated",
@@ -271,20 +353,35 @@ class TestDeclinePredicate:
         # Every decline names the engine path that ran and its flag.
         assert "HS_TPU_PALLAS" in reason and "lax" in reason
 
-    def test_declines_chaos_servers(self):
-        from happysim_tpu.tpu.model import FaultSpec
+    def test_telemetry_and_faulted_chains_are_supported(self):
+        """The two PR-6 removals: "model has windowed telemetry" and
+        "has a stochastic fault schedule" are no longer decline reasons
+        — the buffers ride the VMEM tile instead."""
+        telemetry_model = _mm1()
+        telemetry_model.telemetry(window_s=1.0)
+        plan, reason = kernel_plan(telemetry_model)
+        assert plan is not None and reason == ""
 
+        plan, reason = kernel_plan(_faulted_telemetry_chain())
+        assert plan == {"shape": "chain", "servers": [0, 1]} and reason == ""
+
+    def test_declines_resilient_chaos_servers(self):
+        """Fault schedules ride the kernel, but the RESILIENCE semantics
+        (backoff retries, hedging) still decline — their dynamic branch
+        shapes are not claimed yet."""
         model = EnsembleModel(horizon_s=5.0)
         src = model.source(rate=4.0)
         srv = model.server(
             service_mean=0.1,
             fault=FaultSpec(rate=0.05, mean_duration_s=0.5),
+            retry_backoff_s=0.1,
+            max_retries=2,
         )
         snk = model.sink()
         model.connect(src, srv)
         model.connect(srv, snk)
         plan, reason = kernel_plan(model)
-        assert plan is None and "fault" in reason
+        assert plan is None and "backoff" in reason
 
     def test_declines_packet_loss_and_profiles(self):
         model = _mm1()
