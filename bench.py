@@ -537,6 +537,138 @@ def bench_kernel_telemetry(devices) -> dict:
     }
 
 
+def bench_kernel_router(devices) -> dict:
+    """The ISSUE-11 shape on the fast path: a ρ-sweep load-balancer
+    fan-out (1 source -> round_robin router -> 4 servers -> fan-in ->
+    sink, per-target latency edges), fused-kernel vs lax-step A/B.
+    Bit-identity is asserted on the counters INCLUDING the per-server
+    completion spread — the routing trace itself — so a route-choice or
+    rr_next divergence inside the kernel cannot hide behind aggregate
+    sink stats. The explicit max_events budget keeps both runs on the
+    event scan (the chain closed form handles constant-edge fan-outs).
+    """
+    import jax
+    import numpy as np
+
+    from happysim_tpu.tpu import run_ensemble
+    from happysim_tpu.tpu.kernels import env_override, pallas_available
+    from happysim_tpu.tpu.mesh import replica_mesh
+
+    if not pallas_available():
+        return {
+            "metric": "simulated-events/sec (kernel-path router fan-out)",
+            "skipped": "jax.experimental.pallas unavailable in this jaxlib",
+        }
+
+    from happysim_tpu.tpu.model import EnsembleModel
+
+    mu = 10.0
+    n_servers = 4
+
+    def build():
+        model = EnsembleModel(
+            horizon_s=PALLAS_HORIZON_S,
+            warmup_s=PALLAS_HORIZON_S / 4,
+            transit_capacity=16,
+        )
+        model.macro_block = PALLAS_MACRO_BLOCK
+        src = model.source(rate=9.5)  # swept per replica below
+        servers = [
+            model.server(
+                concurrency=1, service_mean=1.0 / mu, queue_capacity=256
+            )
+            for _ in range(n_servers)
+        ]
+        router = model.router(policy="round_robin")
+        snk = model.sink()
+        model.connect(src, router)
+        for index, server in enumerate(servers):
+            # Constant and exponential per-target edges alternate, so
+            # the U_LAT slot and the transit registers are both live.
+            model.connect(
+                router,
+                server,
+                latency_s=0.005,
+                latency_kind="exponential" if index % 2 else "constant",
+            )
+            model.connect(server, snk)
+        return model
+
+    # Fleet rho sweep: the OFFERED load per server is rate / n_servers,
+    # so sweeping rate over [0.1, 0.95] * n_servers * mu walks each
+    # 4-server fleet replica from idle to near-saturation.
+    sweeps = {
+        "source_rate": np.linspace(
+            0.1 * n_servers * mu, 0.95 * n_servers * mu, PALLAS_REPLICAS
+        ).astype(np.float32)
+    }
+    # Each job: source fire + transit arrival + completion = 3 events.
+    max_events = int(4.0 * 0.95 * n_servers * mu * PALLAS_HORIZON_S) + 64
+    mesh = replica_mesh(jax.devices()[:1])  # kernel path is single-device
+
+    def run(pallas: bool):
+        with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+            return run_ensemble(
+                build(),
+                n_replicas=PALLAS_REPLICAS,
+                seed=0,
+                mesh=mesh,
+                sweeps=sweeps,
+                max_events=max_events,
+            )
+
+    lax_r = run(False)
+    kernel_r = run(True)
+    assert kernel_r.engine_path == "scan+pallas", kernel_r.kernel_decline
+    assert kernel_r.kernel_shape == "router"
+    assert lax_r.engine_path == "scan"
+    bit_identical = bool(
+        lax_r.simulated_events == kernel_r.simulated_events
+        and lax_r.sink_count == kernel_r.sink_count
+        and lax_r.sink_mean_latency_s == kernel_r.sink_mean_latency_s
+        and lax_r.server_completed == kernel_r.server_completed
+        and lax_r.server_dropped == kernel_r.server_dropped
+        and lax_r.transit_dropped == kernel_r.transit_dropped
+        and (np.asarray(lax_r.sink_hist) == np.asarray(kernel_r.sink_hist)).all()
+    )
+    assert bit_identical, (
+        "router fan-out diverged between the Pallas kernel and the lax "
+        "event step — the routing trace (per-server counters) must be "
+        "bit-identical per lane"
+    )
+    speedup = lax_r.wall_seconds / max(kernel_r.wall_seconds, 1e-9)
+    label = (
+        f"simulated-events/sec (CPU fallback, INTERPRETED kernel, {PALLAS_REPLICAS}-replica 4-server LB fan-out rho sweep)"
+        if DEVICE_FALLBACK
+        else f"simulated-events/sec/chip (Pallas kernel, {PALLAS_REPLICAS // 1000}k-replica 4-server LB fan-out rho sweep)"
+    )
+    return {
+        "metric": label,
+        "value": round(kernel_r.events_per_second, 0),
+        "unit": "events/sec",
+        "vs_baseline": round(
+            kernel_r.events_per_second / REFERENCE_EVENTS_PER_SEC, 2
+        ),
+        "lax_events_per_sec": round(lax_r.events_per_second, 0),
+        "kernel_vs_lax_speedup": round(speedup, 3),
+        "bit_identical": bit_identical,
+        "router_policy": "round_robin",
+        "n_servers": n_servers,
+        "kernel_shape": kernel_r.kernel_shape,
+        "fanout_completed": [int(c) for c in kernel_r.server_completed],
+        "macro_block": PALLAS_MACRO_BLOCK,
+        "n_replicas": kernel_r.n_replicas,
+        "horizon_s": kernel_r.horizon_s,
+        "simulated_events": kernel_r.simulated_events,
+        "wall_seconds": round(kernel_r.wall_seconds, 6),
+        "lax_wall_seconds": round(lax_r.wall_seconds, 6),
+        "compile_seconds": round(kernel_r.compile_seconds, 6),
+        "lax_compile_seconds": round(lax_r.compile_seconds, 6),
+        "device": str(devices[0]),
+        "n_devices": len(devices),
+    }
+
+
 def bench_pallas_kernel(devices) -> dict:
     """Fused-kernel vs lax-step A/B on the same M/M/1 event-scan
     workload. The two paths are BIT-IDENTICAL by contract (the kernel
@@ -814,6 +946,7 @@ def main() -> int:
     telemetry = bench_telemetry_overhead(devices)
     pallas = bench_pallas_kernel(devices)
     ktel = bench_kernel_telemetry(devices)
+    krouter = bench_kernel_router(devices)
     multichip = bench_multichip(devices)
     if DEVICE_FALLBACK:
         note = "TPU unreachable at bench time; CPU fallback at reduced scale"
@@ -823,6 +956,7 @@ def main() -> int:
         telemetry["device_fallback"] = note
         pallas["device_fallback"] = note
         ktel["device_fallback"] = note
+        krouter["device_fallback"] = note
         engine["north_star_ok"] = False  # per-chip target is a TPU claim
     # The general-engine entry stays LAST: trajectory tooling that keys
     # on the final JSON line keeps comparing like with like across rounds.
@@ -831,6 +965,7 @@ def main() -> int:
     print(json.dumps(telemetry))
     print(json.dumps(pallas))
     print(json.dumps(ktel))
+    print(json.dumps(krouter))
     print(json.dumps(multichip))
     print(json.dumps(engine))
     return 0

@@ -315,6 +315,13 @@ def model_fingerprint(model: EnsembleModel) -> str:
     telemetry = getattr(model, "telemetry_spec", None)
     if telemetry is not None:
         items = items + (telemetry,)
+    # Router weights likewise (RouterSpec.weights is repr=False so
+    # unweighted router checkpoints keep their pre-weighted-policy
+    # fingerprints; a weighted model's weights DO change the compiled
+    # step, so they must land in the digest).
+    weights = tuple(r.weights for r in model.routers if r.weights)
+    if weights:
+        items = items + (("router_weights",) + weights,)
     spec = repr(items)
     return hashlib.sha256(spec.encode()).hexdigest()[:16]
 
@@ -439,6 +446,10 @@ class EnsembleResult:
     # Why the Pallas kernel did NOT run (names HS_TPU_PALLAS; "" when the
     # kernel ran or the run never reached the scan dispatch).
     kernel_decline: str = ""
+    # Which kernel_plan shape the Pallas path engaged on ("mm1", "chain",
+    # or "router"; "" off the kernel path) — coverage provenance for
+    # engine_report() consumers tracking which topology class ran fused.
+    kernel_shape: str = ""
     # Engine observability (see engine_report()): macro-block length the
     # hot loop ran with (0 on the block-free chain path), the per-run
     # block budget, total macro-blocks actually retired across replicas
@@ -472,6 +483,7 @@ class EnsembleResult:
         report = {
             "engine_path": self.engine_path,
             "kernel_decline": self.kernel_decline,
+            "kernel_shape": self.kernel_shape,
             "compile_seconds": self.compile_seconds,
             "run_seconds": self.wall_seconds,
             "events_per_second": self.events_per_second,
@@ -993,9 +1005,10 @@ class _Compiled:
         """Compile-time map of draw slots the topology can consume.
 
         Slots: arrival gap (any Poisson source), router choice (any
-        "random"-policy router), edge latency (any exponential edge with
-        positive mean), and two service-draw windows (a delivery arrival
-        and a completion's queue pull can both sample service in one step).
+        "random"- or "weighted"-policy router — both spend one uniform
+        per hop), edge latency (any exponential edge with positive
+        mean), and two service-draw windows (a delivery arrival and a
+        completion's queue pull can both sample service in one step).
         An M/M/1 ends up with 3 draws/step instead of a fixed 8.
         """
         slot = 0
@@ -1004,7 +1017,7 @@ class _Compiled:
             slot += 1
         else:
             self.U_GAP = None
-        if any(r.policy == "random" for r in self.model.routers):
+        if any(r.policy in ("random", "weighted") for r in self.model.routers):
             self.U_ROUTE: Optional[int] = slot
             slot += 1
         else:
@@ -1566,6 +1579,20 @@ class _Compiled:
         if router.policy == "random":
             return jnp.minimum(
                 (self._uslot(u, self.U_ROUTE) * n).astype(jnp.int32), n - 1
+            )
+        if router.policy == "weighted":
+            # Static per-target weights: choice i iff u lands in
+            # [cum[i-1], cum[i]). cum is a compile-time constant and
+            # cum[-1] == 1.0 with u < 1, so the count of thresholds at
+            # or below u is already in [0, n-1]; the min is float-
+            # roundoff armor only.
+            weights = np.asarray(router.weights, np.float64)
+            cum = jnp.asarray((np.cumsum(weights) / weights.sum()), jnp.float32)
+            return jnp.minimum(
+                jnp.sum(
+                    (self._uslot(u, self.U_ROUTE) >= cum).astype(jnp.int32)
+                ),
+                n - 1,
             )
         if router.policy == "round_robin":
             return jnp.mod(state["rr_next"][router_index], n)
@@ -2702,9 +2729,13 @@ def run_ensemble(
         build_block_step,
         kernel_decision,
         kernel_interpret_mode,
+        kernel_plan,
         pad_replicas,
     )
 
+    # One shape analysis serves both the dispatch decision and the
+    # engine_report() provenance ("mm1" / "chain" / "router").
+    kplan = kernel_plan(model)
     use_pallas, kernel_note = kernel_decision(
         model,
         mesh=mesh,
@@ -2713,10 +2744,12 @@ def run_ensemble(
         # The compiled state template lets the decision include the
         # telemetry buffers / fault registers in its VMEM budget check.
         compiled=compiled,
+        plan=kplan,
     )
     if kernel_note and os.environ.get("HS_TPU_PALLAS") == "1":
         logger.info("run_ensemble: %s", kernel_note)
     kernel_padded = 0  # set by the kernel path (edge-padding provenance)
+    kernel_shape = kplan[0]["shape"] if use_pallas and kplan[0] else ""
 
     def replica_halted(state):
         """True once this replica's next event is past the horizon (or
@@ -3023,6 +3056,7 @@ def run_ensemble(
         compile_seconds=compile_seconds,
         engine_path="scan+pallas" if use_pallas else "scan",
         kernel_decline=kernel_note,
+        kernel_shape=kernel_shape,
         macro_block=macro,
         max_blocks=n_chunks,
         padded_replicas=kernel_padded or n_replicas,
@@ -3040,6 +3074,7 @@ def _build_result(
     compile_seconds: float = 0.0,
     engine_path: str = "scan",
     kernel_decline: str = "",
+    kernel_shape: str = "",
     macro_block: int = 0,
     max_blocks: int = 0,
     padded_replicas: int = 0,
@@ -3128,6 +3163,7 @@ def _build_result(
         compile_seconds=compile_seconds,
         engine_path=engine_path,
         kernel_decline=kernel_decline,
+        kernel_shape=kernel_shape,
         macro_block=macro_block,
         max_blocks=max_blocks,
         blocks_total=blocks_total,

@@ -16,16 +16,22 @@ construction — results are bit-identical, and ``HS_TPU_PALLAS=0`` /
 ``=1`` is a pure A/B lever (see docs/guides/tpu-kernels.md).
 
 Coverage: chain-shaped and M/M/1-shaped models (single source -> server
-chain -> sink), including per-server stochastic fault schedules and
-windowed telemetry — the ``(nW, ...)`` telemetry buffers and ``(nV, W)``
-fault registers are ordinary state leaves, so they ride the
-VMEM-resident tile and the scatter-adds are the engine's own traced
-accounting sites (the realistic "faulted model with telemetry on"
-configuration runs on the fast path). Routers, limiters, correlated
-outages, backoff/hedge resilience, packet loss, and telemetry shapes
-that exceed the VMEM tile budget *soundly decline* to the lax step via
-:func:`kernel_plan` / :func:`kernel_decision` — the same pattern as
-``chain.fast_plan`` — so correctness never depends on kernel coverage.
+chain -> sink) AND single-router load-balancer fan-outs (source ->
+random/round_robin/weighted router -> N servers -> fan-in -> sink, with
+per-target latency edges), including per-server stochastic fault
+schedules and windowed telemetry — the ``(nW, ...)`` telemetry buffers,
+``(nV, W)`` fault registers, and router state (``rr_next`` cursor,
+fan-out queue rings, transit registers) are ordinary state leaves, so
+they ride the VMEM-resident tile and the scatter-adds are the engine's
+own traced accounting sites (the realistic "load-balanced faulted model
+with telemetry on" configuration runs on the fast path). Adaptive
+(least_outstanding) routing, >1 router, mixed router targets, feedback
+loops, limiters, correlated outages, backoff/hedge resilience, packet
+loss, and telemetry shapes that exceed the VMEM tile budget *soundly
+decline* to the lax step via :func:`kernel_plan` /
+:func:`kernel_decision` — the same pattern as ``chain.fast_plan`` — so
+correctness never depends on kernel coverage, and every decline names
+the specific feature.
 """
 
 from happysim_tpu.tpu.kernels.event_step import (
@@ -39,6 +45,7 @@ from happysim_tpu.tpu.kernels.event_step import (
 )
 from happysim_tpu.tpu.kernels.support import (
     KERNEL_ENV,
+    KERNEL_ROUTER_POLICIES,
     env_override,
     kernel_decision,
     kernel_env_mode,
@@ -49,6 +56,7 @@ from happysim_tpu.tpu.kernels.support import (
 
 __all__ = [
     "KERNEL_ENV",
+    "KERNEL_ROUTER_POLICIES",
     "VMEM_TILE_BUDGET_BYTES",
     "build_block_step",
     "choose_tile",

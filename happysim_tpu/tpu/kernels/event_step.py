@@ -6,7 +6,9 @@ event steps with the whole per-replica register file resident in VMEM:
 - inputs: every state leaf (wake-time registers, queue rings, counter
   and histogram accumulators, the ``(nW, ...)`` windowed-telemetry
   buffers and the ``(nV, W)`` fault-window registers when the model
-  declares them), the block's pre-drawn uniform rows
+  declares them, and — on router fan-outs — the ``(nR,)`` round-robin
+  cursor plus the fan-out's per-server queue rings and ``(nV, TR)``
+  transit registers), the block's pre-drawn uniform rows
   ``(tile, macro, n_draws)``, and the per-replica parameter arrays;
 - body: the engine's OWN single-event step closure
   (``_Compiled.make_step(external_u=True)``) vmapped over the tile and
@@ -87,7 +89,13 @@ def state_template(compiled) -> dict:
     per-replica PRNG ``key`` leaf excluded — blocks are keyed outside
     the kernel). Includes every compile-time-gated leaf the model
     declares: fault-window registers, telemetry window buffers, transit
-    registers, attempt columns."""
+    registers, attempt columns, and the router state (the ``(nR,)``
+    round-robin cursor rides unconditionally; a fan-out's real VMEM
+    cost is its ``(nV, K)`` queue rings and ``(nV, TR)`` transit
+    registers scaling with the N fan-out servers). Deriving the
+    template from ``compiled.init_state`` is what keeps the tile-sizing
+    math honest by construction — any state leaf a future feature adds
+    is counted here the moment it exists."""
     template = jax.eval_shape(
         lambda: compiled.init_state(
             jnp.zeros((2,), jnp.uint32),

@@ -15,7 +15,7 @@ import os
 from contextlib import contextmanager
 from typing import Optional
 
-from happysim_tpu.tpu.model import SERVER, SINK, EnsembleModel
+from happysim_tpu.tpu.model import ROUTER, SERVER, SINK, EnsembleModel
 
 KERNEL_ENV = "HS_TPU_PALLAS"
 
@@ -96,25 +96,37 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     """The kernel's supported-shape predicate: ``(plan, reason)``.
 
     Supported: exactly one source (Poisson or constant arrivals, no rate
-    profile) feeding a chain of FIFO servers (any concurrency, any
-    service family, optional deadlines/immediate retries, per-server
+    profile) feeding EITHER a chain of FIFO servers (any concurrency,
+    any service family, optional deadlines/immediate retries, per-server
     stochastic fault schedules — outage OR degrade windows, with or
     without fault-rejection retries — constant or exponential edges with
-    or without latency) into exactly one sink, with or without windowed
-    telemetry: the ``(nW, ...)`` telemetry buffers and the ``(nV, W)``
-    fault registers are ordinary state leaves, so they ride the
-    VMEM-resident tile and the kernel's scatter-adds are the engine's
-    own traced accounting sites (bit-identity holds with telemetry on
-    AND off). Routers, limiters, correlated (shared-trigger) outages,
-    backoff retries, hedging, deterministic brownout windows, and packet
-    loss still decline — they exercise dynamic gathers and branch shapes
-    the kernel does not claim yet. The decline is SOUND: the caller must
-    run the lax step, never a partial kernel. (Telemetry shapes whose
-    buffers do not fit the VMEM tile budget are declined by
-    :func:`kernel_decision`, which sees the compiled state template.)
+    or without latency) OR a single load-balancing router fanning out
+    over N servers that fan back in at the sink (``random`` /
+    ``round_robin`` / ``weighted`` policies, per-target latency edges of
+    either kind — the router hop's per-lane divergence stays inside the
+    traced step closure the kernel drives, so the ragged work is
+    VMEM-resident), ending at exactly one sink, with or without windowed
+    telemetry: the ``(nW, ...)`` telemetry buffers, the ``(nV, W)``
+    fault registers, the router's ``rr_next`` cursor, and the fan-out's
+    per-server queue rings / transit registers are ordinary state
+    leaves, so they ride the VMEM-resident tile and the kernel's
+    scatter-adds are the engine's own traced accounting sites
+    (bit-identity holds with telemetry on AND off). Remaining declines
+    are per-feature and actionable: adaptive (``least_outstanding``)
+    routing, >1 router, router→sink / mixed targets, feedback loops,
+    server chains behind the fan-out, limiters, correlated
+    (shared-trigger) outages, backoff retries, hedging, deterministic
+    brownout windows, and packet loss — they exercise dynamic gathers
+    and branch shapes the kernel does not claim yet. The decline is
+    SOUND: the caller must run the lax step, never a partial kernel.
+    (Telemetry shapes whose buffers do not fit the VMEM tile budget are
+    declined by :func:`kernel_decision`, which sees the compiled state
+    template.)
     """
-    if model.routers:
-        return _decline("model has routers")
+    if len(model.routers) > 1:
+        return _decline(
+            f"model has {len(model.routers)} routers (kernel supports 1)"
+        )
     if model.limiters:
         return _decline("model has limiters")
     if model.remotes:
@@ -139,6 +151,8 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     for origin, edge in _edges(model):
         if edge.loss_p > 0.0:
             return _decline(f"{origin} edge carries packet loss")
+    if model.routers:
+        return _router_plan(model)
     # The topology must be a single linear chain ending at the sink.
     seen: list[int] = []
     ref = source.downstream
@@ -155,11 +169,64 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     return {"shape": shape, "servers": seen}, ""
 
 
+# Router policies whose choice is a pure function of (uniform draw,
+# rr_next cursor) — compile-time constants aside. Adaptive policies
+# (least_outstanding reads live queue state across the fan-out) are not
+# claimed yet.
+KERNEL_ROUTER_POLICIES = ("random", "round_robin", "weighted")
+
+
+def _router_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
+    """The load-balancer fan-out shape: 1 source -> router -> N servers
+    -> fan-in -> 1 sink, with per-target latency edges. Everything this
+    helper declines names the specific router feature (not a blanket
+    "model has routers"), so the remaining decline list is actionable.
+    """
+    router = model.routers[0]
+    source = model.sources[0]
+    if source.downstream is None or source.downstream.kind != ROUTER:
+        return _decline("router is not fed directly by the source")
+    if router.policy not in KERNEL_ROUTER_POLICIES:
+        # No nested parens: _decline wraps the reason in its own pair.
+        return _decline(
+            f"router policy {router.policy!r} is adaptive — kernel supports "
+            + "/".join(KERNEL_ROUTER_POLICIES)
+        )
+    kinds = {t.kind for t in router.targets}
+    if kinds == {SERVER, SINK}:
+        return _decline(
+            "router has mixed sink/server targets (probabilistic exits)"
+        )
+    if SINK in kinds:
+        return _decline("router targets only sinks (no server fan-out)")
+    servers = [t.index for t in router.targets]
+    if len(set(servers)) != len(servers):
+        return _decline("router fan-out repeats a server target")
+    for index in servers:
+        down = model.servers[index].downstream
+        if down is not None and down.kind == ROUTER:
+            return _decline(
+                f"server[{index}] feeds back into the router (feedback loop)"
+            )
+        if down is not None and down.kind == SERVER:
+            return _decline(
+                f"server[{index}] chains to another server behind the router"
+            )
+        if down is None or down.kind != SINK:
+            return _decline(f"server[{index}] fan-in does not end at the sink")
+    if len(servers) != len(model.servers):
+        return _decline("servers outside the router fan-out")
+    return {"shape": "router", "servers": servers, "policy": router.policy}, ""
+
+
 def _edges(model: EnsembleModel):
     for i, s in enumerate(model.sources):
         yield f"source[{i}]", s.latency
     for i, v in enumerate(model.servers):
         yield f"server[{i}]", v.latency
+    for i, r in enumerate(model.routers):
+        for j, edge in enumerate(r.target_latencies):
+            yield f"router[{i}].target[{j}]", edge
 
 
 def kernel_decision(
@@ -168,6 +235,7 @@ def kernel_decision(
     checkpointing: bool,
     macro: int,
     compiled=None,
+    plan: Optional[tuple[Optional[dict], str]] = None,
 ) -> tuple[bool, str]:
     """Runtime dispatch: should THIS run use the Pallas block kernel?
 
@@ -179,6 +247,11 @@ def kernel_decision(
     budget check: a per-replica register file — telemetry window buffers
     included — that exceeds the tile budget even at tile=1 declines with
     a budget-naming reason instead of silently spilling VMEM.
+
+    ``plan`` (optional) is a precomputed :func:`kernel_plan` result for
+    this model; passing it keeps the caller's plan provenance (e.g.
+    ``EnsembleResult.kernel_shape``) and the dispatch decision reading
+    ONE shape analysis instead of two.
     """
     mode = kernel_env_mode()
     if mode == "0":
@@ -204,8 +277,8 @@ def kernel_decision(
             f"{MAX_UNROLL_MACRO}; lax event step ran (lower "
             f"HS_TPU_MACRO_BLOCK or unset {KERNEL_ENV})"
         )
-    plan, reason = kernel_plan(model)
-    if plan is None:
+    approved, reason = plan if plan is not None else kernel_plan(model)
+    if approved is None:
         return False, reason
     if compiled is not None:
         from happysim_tpu.tpu.kernels.event_step import (

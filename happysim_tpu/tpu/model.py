@@ -43,7 +43,7 @@ ARRIVAL_KINDS = ("poisson", "constant")
 # for a finite-variance P-K oracle). Host twins live in
 # happysim_tpu/distributions/latency_distribution.py.
 SERVICE_KINDS = ("exponential", "constant", "erlang", "hyperexp", "lognormal", "pareto")
-ROUTER_POLICIES = ("random", "round_robin", "least_outstanding")
+ROUTER_POLICIES = ("random", "round_robin", "least_outstanding", "weighted")
 LATENCY_KINDS = ("constant", "exponential")
 
 
@@ -264,6 +264,15 @@ class RouterSpec:
     policy: str = "random"
     targets: list[NodeRef] = field(default_factory=list)
     target_latencies: list[EdgeLatency] = field(default_factory=list)
+    # Per-target routing weights ("weighted" policy only): target i is
+    # chosen with probability weights[i] / sum(weights). Empty for every
+    # other policy; length-checked against the final target list at
+    # model.validate() time (targets may be wired after router()).
+    # repr=False keeps pre-existing router checkpoints' model
+    # fingerprints stable (engine.model_fingerprint hashes the spec
+    # reprs and appends weights separately only when present — the same
+    # discipline as the telemetry_spec field).
+    weights: tuple[float, ...] = field(default=(), repr=False)
 
 
 @dataclass
@@ -512,15 +521,36 @@ class EnsembleModel:
         self.correlated_faults = spec
         return spec
 
-    def router(self, policy: str = "random", targets: Sequence[NodeRef] = ()) -> NodeRef:
+    def router(
+        self,
+        policy: str = "random",
+        targets: Sequence[NodeRef] = (),
+        weights: Optional[Sequence[float]] = None,
+    ) -> NodeRef:
+        """Routing node. ``weights`` (``"weighted"`` policy only) gives
+        each target probability ``w_i / sum(w)`` — the static-weight
+        load-balancer strategy (host analogue: the weighted picks in
+        components/load_balancer/strategies.py). Targets wired later via
+        :meth:`connect` must be matched by the weights length, checked
+        at :meth:`validate` time."""
         if policy not in ROUTER_POLICIES:
             raise ValueError(f"router policy {policy!r} not in {ROUTER_POLICIES}")
+        if weights is not None and policy != "weighted":
+            raise ValueError(
+                f"router weights require policy='weighted' (got {policy!r})"
+            )
+        if policy == "weighted":
+            if not weights:
+                raise ValueError("policy='weighted' requires weights=(...)")
+            if any(w <= 0.0 for w in weights):
+                raise ValueError("router weights must all be > 0")
         targets = list(targets)
         self.routers.append(
             RouterSpec(
                 policy=policy,
                 targets=targets,
                 target_latencies=[EdgeLatency() for _ in targets],
+                weights=tuple(float(w) for w in weights) if weights else (),
             )
         )
         return NodeRef(ROUTER, len(self.routers) - 1)
@@ -739,10 +769,19 @@ class EnsembleModel:
                     f"router[{i}]: least_outstanding requires server targets "
                     "(sinks have no outstanding work)"
                 )
+            if router.policy == "weighted" and len(router.weights) != len(
+                router.targets
+            ):
+                raise ValueError(
+                    f"router[{i}]: weighted policy has {len(router.weights)} "
+                    f"weights for {len(router.targets)} targets (wire every "
+                    "target before running, or pass targets to router())"
+                )
 
     def kernel_supported(self) -> tuple[bool, str]:
         """Whether the fused Pallas event-step kernel claims this
-        topology (chain-shaped / M/M/1-shaped; see tpu/kernels/).
+        topology (chain-shaped / M/M/1-shaped / single-router
+        load-balancer fan-outs with static policies; see tpu/kernels/).
 
         Returns ``(supported, reason)``; the reason is "" when supported
         and otherwise names the declining feature plus the

@@ -141,6 +141,28 @@ class TestLoadBalancedFleet:
         assert spread < (1.3 if policy == "least_outstanding" else 1.15)
         assert result.sink_count[0] > 0
 
+    def test_weighted_spreads_by_weight(self, mesh):
+        """weights=(1, 3) routes ~25%/75% of jobs (ISSUE 11: the static
+        weighted policy — the host LB strategies' weighted pick)."""
+        model = EnsembleModel(horizon_s=30.0)
+        src = model.source(rate=24.0)
+        servers = [
+            model.server(concurrency=2, service_mean=0.05, queue_capacity=128)
+            for _ in range(2)
+        ]
+        snk = model.sink()
+        router = model.router(
+            policy="weighted", targets=servers, weights=(1.0, 3.0)
+        )
+        model.connect(src, router)
+        for server in servers:
+            model.connect(server, snk)
+        result = run_ensemble(model, n_replicas=128, seed=0, mesh=mesh)
+        completed = np.array(result.server_completed, float)
+        assert completed.sum() > 0
+        share = completed[1] / completed.sum()
+        assert share == pytest.approx(0.75, abs=0.02)
+
     def test_least_outstanding_waits_least(self, mesh):
         rnd = run_ensemble(self._fleet("random"), n_replicas=192, seed=1, mesh=mesh)
         lo = run_ensemble(

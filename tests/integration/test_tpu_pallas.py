@@ -3,9 +3,10 @@
 Full-run bit-identity: ``HS_TPU_PALLAS=1`` (fused macro-block kernel,
 interpret mode on CPU) vs ``HS_TPU_PALLAS=0`` (lax event step) must
 produce IDENTICAL results — same RNG stream, same float op order per
-lane — across M/M/1, deadline/retry sweep, and faulted+telemetry shapes
-(simulation counters AND telemetry series), with and without early
-exit, including the replica-padding path (transit-edge chains get
+lane — across M/M/1, deadline/retry sweep, faulted+telemetry, and
+router load-balancer fan-out shapes (simulation counters AND telemetry
+series), with and without early exit, including the replica-padding
+path (transit-edge chains and the weighted router policy get
 block-level bit-identity in tests/unit/test_kernel_event_step.py).
 Unsupported shapes and checkpointed runs decline soundly to the lax
 step, and checkpoint/resume round-trips the telemetry buffers onto the
@@ -72,10 +73,43 @@ def _faulted_telemetry():
     return model, {"n_replicas": 6, "max_events": 96}
 
 
+def _router_lb(policy, weights=None):
+    """ISSUE-11 load-balancer fan-out: 1 source -> router -> 4 servers
+    -> fan-in -> 1 sink, per-target latency edges (constant AND
+    exponential, plus a latency-free sibling). The explicit max_events
+    budget keeps BOTH runs on the event scan — without it the chain
+    closed form would swallow the constant-edge fan-out, and its RNG
+    stream differs."""
+    model = EnsembleModel(horizon_s=4.0, macro_block=MACRO, transit_capacity=8)
+    src = model.source(rate=6.0)
+    servers = [
+        model.server(service_mean=0.06, queue_capacity=16) for _ in range(4)
+    ]
+    router = model.router(policy=policy, weights=weights)
+    snk = model.sink()
+    model.connect(src, router)
+    edge_mix = [(0.01, "constant"), (0.02, "exponential"), (0.0, "constant")]
+    for index, server in enumerate(servers):
+        latency_s, kind = edge_mix[index % len(edge_mix)]
+        model.connect(router, server, latency_s=latency_s, latency_kind=kind)
+        model.connect(server, snk)
+    return model, {"n_replicas": 6, "max_events": 160}
+
+
+def _router_random():
+    return _router_lb("random")
+
+
+def _router_round_robin():
+    return _router_lb("round_robin")
+
+
 _SCENARIOS = {
     "mm1": _mm1,
     "deadline_sweep": _deadline_sweep,
     "faulted_telemetry": _faulted_telemetry,
+    "router_random": _router_random,
+    "router_round_robin": _router_round_robin,
 }
 _CACHE = {}
 
@@ -140,6 +174,31 @@ class TestBitIdentity:
         assert (
             kernel_flat.sink_mean_latency_s == lax_early.sink_mean_latency_s
         )
+
+    # slow: two extra scenarios x two compiled programs each — the CI
+    # kernel-equivalence gate (which includes the slow marker) and the
+    # nightly tier run these; tier-1 keeps the cheap router canary in
+    # test_engine_path_reasons instead.
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "scenario", ["router_random", "router_round_robin"]
+    )
+    def test_router_fanout_runs_the_kernel_bit_identically(self, scenario):
+        """ISSUE-11 tentpole: the load-balancer fan-out (random AND
+        round_robin, per-target latency edges) reports engine_path ==
+        "scan+pallas" and stays bit-identical to the lax step — sink
+        stats AND the per-server fan-out counters that prove the routing
+        choices themselves matched per lane."""
+        kernel_r = _run(scenario, True)
+        lax_r = _run(scenario, False)
+        _assert_bit_identical(kernel_r, lax_r)
+        assert kernel_r.kernel_shape == "router"
+        assert lax_r.kernel_shape == ""
+        # The fan-out actually spread work (every server saw jobs) and
+        # the per-server columns agree exactly across paths.
+        assert all(c > 0 for c in kernel_r.server_completed)
+        assert kernel_r.server_mean_queue_len == lax_r.server_mean_queue_len
+        assert kernel_r.transit_dropped == lax_r.transit_dropped
 
     def test_faulted_telemetry_runs_the_kernel_bit_identically(self):
         """PR-6 tentpole: the faulted model WITH telemetry on is

@@ -1,0 +1,174 @@
+"""Pinned-seed goldens for router topologies on the Pallas kernel path.
+
+ISSUE 11 moved the load-balancer fan-out (1 source -> router -> 4
+servers -> fan-in -> 1 sink, per-target latency edges) onto the fused
+kernel. These goldens pin the whole stack on BOTH engine paths — the
+per-server completion spread is the routing trace itself, so a change
+to the route-choice math, the U_ROUTE slot layout, the rr_next cursor
+update, or the kernel's op order shows up as an exact-count mismatch,
+not a silent statistical drift.
+
+Golden provenance: seed=123, 8 replicas, source rate=6 -> router
+(random / round_robin) -> 4 servers (service_mean=0.05, cap=16) ->
+sink, horizon=6s, per-target edges cycling (0.01 constant, 0.02
+exponential, latency-free), transit_capacity=8, macro_block=4,
+max_events=192, recorded on the CPU interpret path (bit-identical to
+the compiled TPU kernel by construction — the kernel body IS the traced
+step closure). The EXPLICIT max_events keeps both runs on the event
+scan: without it the chain closed form would swallow the constant-edge
+fan-out, and its RNG stream differs from the scan's.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas")
+
+import jax
+
+# slow: four compiled programs (2 policies x 2 engine paths) is ~a
+# minute of interpret-mode XLA on CPU — more than the tier-1 envelope
+# can absorb. The CI kernel-equivalence gate runs this file explicitly
+# (with the slow marker included) on every push/PR, and the nightly
+# slow tier replays it; `-m slow` locally does the same.
+pytestmark = pytest.mark.slow
+
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import EnsembleModel
+
+GOLDENS = {
+    "random": {
+        "simulated_events": 816,
+        "sink_count": [269],
+        "server_completed": [71, 60, 62, 76],
+        "transit_dropped": [0, 0, 0, 0],
+        "truncated_replicas": 0,
+        "sink_mean_latency_s": 0.0620783270513258,
+        "sink_p50_s": 0.0446683592150963,
+        "sink_p99_s": 0.2818382931264455,
+        "hist_nonzero": {
+            23: 2, 24: 3, 25: 1, 27: 2, 28: 3, 29: 3, 30: 10, 31: 11,
+            32: 18, 33: 15, 34: 20, 35: 24, 36: 39, 37: 19, 38: 25,
+            39: 18, 40: 25, 41: 18, 42: 8, 43: 2, 44: 3,
+        },
+    },
+    "round_robin": {
+        "simulated_events": 955,
+        "sink_count": [316],
+        "server_completed": [83, 79, 78, 76],
+        "transit_dropped": [0, 0, 0, 0],
+        "truncated_replicas": 0,
+        "sink_mean_latency_s": 0.05875542797619784,
+        "sink_p50_s": 0.0446683592150963,
+        "sink_p99_s": 0.1778279410038923,
+        "hist_nonzero": {
+            14: 1, 18: 1, 20: 1, 23: 1, 24: 2, 25: 4, 26: 4, 27: 1,
+            28: 1, 29: 4, 30: 9, 31: 16, 32: 17, 33: 26, 34: 20, 35: 26,
+            36: 31, 37: 43, 38: 24, 39: 29, 40: 21, 41: 17, 42: 14,
+            43: 3,
+        },
+    },
+}
+
+
+def _build(policy):
+    model = EnsembleModel(horizon_s=6.0, macro_block=4, transit_capacity=8)
+    src = model.source(rate=6.0)
+    servers = [
+        model.server(service_mean=0.05, queue_capacity=16) for _ in range(4)
+    ]
+    router = model.router(policy=policy)
+    snk = model.sink()
+    model.connect(src, router)
+    edge_mix = [(0.01, "constant"), (0.02, "exponential"), (0.0, "constant")]
+    for index, server in enumerate(servers):
+        latency_s, kind = edge_mix[index % len(edge_mix)]
+        model.connect(router, server, latency_s=latency_s, latency_kind=kind)
+        model.connect(server, snk)
+    return model
+
+
+def _pinned_run(policy: str, pallas: bool):
+    from happysim_tpu.tpu.kernels import env_override
+
+    with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+        return run_ensemble(
+            _build(policy),
+            n_replicas=8,
+            seed=123,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            max_events=192,
+        )
+
+
+@pytest.fixture(
+    scope="module",
+    params=[
+        ("random", True),
+        ("random", False),
+        ("round_robin", True),
+        ("round_robin", False),
+    ],
+    ids=["random-pallas", "random-lax", "rr-pallas", "rr-lax"],
+)
+def pinned(request):
+    """BOTH policies x BOTH engine paths, each asserted against the SAME
+    golden — a joint drift of kernel and lax cannot slip through."""
+    policy, pallas = request.param
+    return _pinned_run(policy, pallas), policy, pallas
+
+
+def test_engine_path(pinned):
+    result, _policy, pallas = pinned
+    if pallas:
+        assert result.engine_path == "scan+pallas", result.kernel_decline
+        assert result.kernel_decline == ""
+        assert result.kernel_shape == "router"
+    else:
+        assert result.engine_path == "scan"
+        assert result.kernel_shape == ""
+
+
+def test_exact_counts_match_golden(pinned):
+    result, policy, _pallas = pinned
+    golden = GOLDENS[policy]
+    assert result.simulated_events == golden["simulated_events"]
+    assert result.sink_count == golden["sink_count"]
+    # The per-server spread IS the routing trace (round_robin's is the
+    # near-even cursor walk; random's is the pinned uniform stream).
+    assert result.server_completed == golden["server_completed"]
+    assert result.transit_dropped == golden["transit_dropped"]
+    assert result.truncated_replicas == golden["truncated_replicas"]
+
+
+def test_latency_statistics_match_golden(pinned):
+    result, policy, _pallas = pinned
+    golden = GOLDENS[policy]
+    assert result.sink_mean_latency_s[0] == pytest.approx(
+        golden["sink_mean_latency_s"], rel=1e-12
+    )
+    assert result.sink_p50_s[0] == pytest.approx(
+        golden["sink_p50_s"], rel=1e-12
+    )
+    assert result.sink_p99_s[0] == pytest.approx(
+        golden["sink_p99_s"], rel=1e-12
+    )
+
+
+def test_histogram_matches_golden_exactly(pinned):
+    result, policy, _pallas = pinned
+    hist = np.asarray(result.sink_hist[0])
+    expected = np.zeros_like(hist)
+    for bin_index, count in GOLDENS[policy]["hist_nonzero"].items():
+        expected[bin_index] = count
+    np.testing.assert_array_equal(hist, expected)
+
+
+def test_round_robin_spread_is_cursor_even():
+    """Sanity on the golden itself: round_robin's completion spread is
+    near-even (max-min small vs totals), random's is visibly rougher —
+    the two policies' goldens cannot be accidentally swapped."""
+    rr = GOLDENS["round_robin"]["server_completed"]
+    rnd = GOLDENS["random"]["server_completed"]
+    assert max(rr) - min(rr) < max(rnd) - min(rnd)

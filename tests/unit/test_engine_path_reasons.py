@@ -15,13 +15,19 @@ from happysim_tpu.tpu.mesh import replica_mesh
 from happysim_tpu.tpu.model import EnsembleModel
 
 
-def _router_model():
-    """Two-server random fan-out: kernel-unsupported, scan-supported."""
-    model = EnsembleModel(horizon_s=1.0)
+def _router_model(policy="least_outstanding"):
+    """Two-server fan-out. The default ``least_outstanding`` policy is
+    ADAPTIVE (reads live queue state), so it stays kernel-unsupported
+    with a per-feature decline reason — the decline fixture for this
+    file now that random/round_robin/weighted fan-outs run the kernel
+    (ISSUE 11). macro_block=2: the random-policy variant compiles the
+    KERNEL under the CI gate's forced HS_TPU_PALLAS=1, and interpret
+    compile scales with the unroll (macro 32 costs two minutes)."""
+    model = EnsembleModel(horizon_s=1.0, macro_block=2)
     src = model.source(rate=4.0)
     first = model.server(service_mean=0.05, queue_capacity=4)
     second = model.server(service_mean=0.05, queue_capacity=4)
-    router = model.router(policy="random", targets=[first, second])
+    router = model.router(policy=policy, targets=[first, second])
     snk = model.sink()
     model.connect(src, router)
     model.connect(first, snk)
@@ -70,6 +76,34 @@ def test_removed_decline_reasons_no_longer_appear(monkeypatch):
     assert result.engine_path == "scan+pallas", result.kernel_decline
     assert result.kernel_decline == ""
     assert result.timeseries is not None
+
+
+def test_blanket_router_decline_removed(monkeypatch):
+    """ISSUE-11 contract: "model has routers" is no longer a decline
+    reason. A random-policy load-balancer fan-out is kernel-approved and
+    runs engine_path == "scan+pallas" when forced (explicit max_events
+    keeps it off the chain closed form); the remaining router declines
+    are per-feature (asserted in tests/unit/test_kernel_event_step.py).
+    """
+    pytest.importorskip("jax.experimental.pallas")
+    from happysim_tpu.tpu.kernels import kernel_plan
+
+    plan, reason = kernel_plan(_router_model(policy="random"))
+    assert plan is not None and reason == ""
+    assert plan["shape"] == "router"
+
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _router_model(policy="random"),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=32,
+    )
+    assert result.engine_path == "scan+pallas", result.kernel_decline
+    assert result.kernel_decline == ""
+    assert result.kernel_shape == "router"
+    assert result.engine_report()["kernel_shape"] == "router"
 
 
 def test_engine_report_names_escape_hatches_on_decline(monkeypatch):

@@ -109,12 +109,17 @@ def test_engine_entity_always_present_with_path():
 def test_engine_entity_names_escape_hatches_on_decline():
     summary = _result(
         engine_path="scan",
-        kernel_decline="Pallas kernel declined (model has routers); ...",
+        # A current per-feature reason (the blanket "model has routers"
+        # decline was removed in ISSUE 11 — fan-outs run the kernel now).
+        kernel_decline=(
+            "Pallas kernel declined (router policy 'least_outstanding' "
+            "is adaptive); ..."
+        ),
         blocks_total=96,
     ).summary()
     (engine,) = _engine_entities(summary)
     assert engine.extra["macro_blocks_run"] == 96
-    assert "routers" in engine.extra["kernel_decline"]
+    assert "router" in engine.extra["kernel_decline"]
     assert "HS_TPU_PALLAS" in engine.extra["escape_hatches"]
     assert "HS_TPU_EARLY_EXIT" in engine.extra["escape_hatches"]
 

@@ -211,6 +211,65 @@ class TestValidateRules:
         model.connect(router, server)  # probabilistic feedback
         model.validate()
 
+    def test_weighted_router_validates_weights(self):
+        model = base()
+        source = model.source(rate=1.0)
+        servers = [model.server(), model.server()]
+        sink = model.sink()
+        router = model.router(policy="weighted", weights=(1.0, 3.0))
+        model.connect(source, router)
+        for server in servers:
+            model.connect(router, server)
+            model.connect(server, sink)
+        model.validate()
+
+    def test_weighted_router_rejects_bad_weights(self):
+        with pytest.raises(ValueError, match="weights"):
+            base().router(policy="weighted")  # weights required
+        with pytest.raises(ValueError, match="> 0"):
+            base().router(policy="weighted", weights=(1.0, 0.0))
+        with pytest.raises(ValueError, match="policy='weighted'"):
+            base().router(policy="random", weights=(1.0, 2.0))
+
+    def test_weighted_weights_join_the_fingerprint_only_when_present(self):
+        """Different weights compile different steps -> different
+        digests; unweighted router models keep their pre-weighted-policy
+        fingerprints (RouterSpec.weights is repr=False and appended
+        separately — the telemetry_spec discipline)."""
+        from happysim_tpu.tpu.engine import model_fingerprint
+
+        def fleet(policy, weights=None):
+            model = base()
+            source = model.source(rate=1.0)
+            servers = [model.server(), model.server()]
+            sink = model.sink()
+            router = model.router(policy=policy, weights=weights)
+            model.connect(source, router)
+            for server in servers:
+                model.connect(router, server)
+                model.connect(server, sink)
+            return model
+
+        one_three = model_fingerprint(fleet("weighted", (1.0, 3.0)))
+        assert one_three != model_fingerprint(fleet("weighted", (3.0, 1.0)))
+        # An unweighted router's repr carries no weights field at all.
+        assert "weights" not in repr(fleet("random").routers[0])
+
+    def test_weighted_router_rejects_weight_target_mismatch(self):
+        """Targets wired AFTER router() must still match the weights
+        length — caught at validate() time, not silently renormalized."""
+        model = base()
+        source = model.source(rate=1.0)
+        servers = [model.server(), model.server(), model.server()]
+        sink = model.sink()
+        router = model.router(policy="weighted", weights=(1.0, 2.0))
+        model.connect(source, router)
+        for server in servers:
+            model.connect(router, server)
+            model.connect(server, sink)
+        with pytest.raises(ValueError, match="2 weights for 3 targets"):
+            model.validate()
+
 
 class TestFactories:
     def test_mm1_model_validates(self):

@@ -138,7 +138,10 @@ def _document_module(mod) -> list[str]:
                 for m, fn in sorted(vars(obj).items())
                 if not m.startswith("_") and inspect.isfunction(fn) and inspect.getdoc(fn)
             ]
-            for m, fn in methods[:8]:
+            # Cap stays well above the widest real class (EnsembleModel,
+            # 12 documented methods): a silent [:8] truncation evicted
+            # .telemetry from the page when .router grew past the cap.
+            for m, fn in methods[:16]:
                 lines.append(f"    - `.{m}{_signature(fn)}` — {_first_line(fn)}")
     lines.append("")
     return lines
