@@ -10,12 +10,23 @@ correctness oracle.
 from happysim_tpu.tpu.mesh import (
     HOST_AXIS,
     REPLICA_AXIS,
+    STATE_PARTITION_RULES,
     distributed_initialize,
+    ensemble_state_shardings,
+    ensemble_state_specs,
     host_replica_mesh,
+    match_partition_rules,
     pad_to_multiple,
     replica_mesh,
     replica_sharding,
     replicated_sharding,
+)
+from happysim_tpu.tpu.reduce import (
+    MAX_EXACT_REPLICAS,
+    host_f64,
+    host_i64,
+    sum_f32_fixed,
+    sum_i64_limbs,
 )
 from happysim_tpu.tpu.engine import (
     EnsembleCheckpoint,
@@ -64,8 +75,17 @@ __all__ = [
     "MM1Result",
     "TelemetrySpec",
     "KERNEL_ENV",
+    "MAX_EXACT_REPLICAS",
+    "STATE_PARTITION_RULES",
     "duty_cycle",
+    "ensemble_state_shardings",
+    "ensemble_state_specs",
     "hist_percentile",
+    "host_f64",
+    "host_i64",
+    "match_partition_rules",
+    "sum_f32_fixed",
+    "sum_i64_limbs",
     "kernel_decision",
     "kernel_plan",
     "macro_block_len",

@@ -48,6 +48,7 @@ import numpy as np
 from jax import lax
 
 from happysim_tpu.tpu.model import ROUTER, SERVER, SINK, EnsembleModel
+from happysim_tpu.tpu.reduce import N_LIMBS, host_i64, sum_i64_limbs
 
 logger = logging.getLogger(__name__)
 
@@ -351,8 +352,9 @@ def run_chain(
             routed = [source_live & (pick == b) for b in range(n_branches)]
 
         # Event accounting: per-term int32 partial sums (each bounded by
-        # one (B, N) reduction < 2^31), summed on the host in int64 so
-        # deep chains at full block size cannot overflow the counter.
+        # one (B, N) reduction < 2^31), limb-summed on device after the
+        # block loop so deep chains at full block size cannot overflow
+        # the counter (tpu/reduce.py; the host only recombines limbs).
         events_terms = [jnp.sum(source_live.astype(jnp.int32))]  # source fires
         overflow = jnp.bool_(False)
         wait_sum = jnp.zeros((nV,), jnp.float32)
@@ -560,42 +562,58 @@ def run_chain(
         )
         return None
 
-    def total(name):
-        return np.sum(np.stack([np.asarray(p[name]) for p in partials]), axis=0)
+    # Cross-block merge ON DEVICE with the engine's shared reduce
+    # encodings (tpu/reduce.py): each block's int totals are < 2^31 by
+    # construction, so decomposing them into limbs and summing the limb
+    # columns across blocks is exact — the host only recombines the
+    # device-reduced limb totals (host_i64), matching the event scan's
+    # result path. Floats add across the (few) blocks in list order.
+    def total_i64(name):
+        return np.asarray(
+            sum_i64_limbs(jnp.stack([p[name] for p in partials]), axis=0)
+        )
 
-    zeros_v = np.zeros((nV,), np.int32)
-    # The per-term event partials are summed in int64 (the device-side
-    # terms are individually < 2^31 by construction).
-    events_total = int(
-        np.sum(
-            np.concatenate(
-                [np.atleast_1d(np.asarray(p["events"])) for p in partials]
+    def total_f(name):
+        return np.asarray(
+            jnp.sum(jnp.stack([p[name] for p in partials]), axis=0)
+        )
+
+    limb_zeros_v = np.zeros((N_LIMBS, nV), np.int32)
+    events_limbs = np.asarray(
+        sum_i64_limbs(
+            jnp.concatenate(
+                [jnp.atleast_1d(p["events"]) for p in partials]
             ),
-            dtype=np.int64,
+            axis=0,
         )
     )
+    events_total = int(host_i64(events_limbs))
     reduced = {
-        "truncated": total("truncated"),
-        "events": events_total,
-        "sink_count": total("sink_count"),
-        "sink_sum": total("sink_sum"),
-        "sink_sq": total("sink_sq"),
-        "sink_hist": total("sink_hist"),
-        "srv_completed": total("srv_completed"),
-        "srv_dropped": zeros_v,
-        "srv_outage_dropped": zeros_v,
-        "srv_started": total("srv_started"),
-        "srv_timed_out": zeros_v,
-        "srv_retried": zeros_v,
-        "srv_busy_int": total("srv_busy_int"),
-        "srv_depth_int": total("srv_depth_int"),
-        "srv_wait_sum": total("srv_wait_sum"),
-        "srv_wait_n": total("srv_wait_n"),
-        "lim_admitted": np.zeros((max(len(model.limiters), 1),), np.int32),
-        "lim_dropped": np.zeros((max(len(model.limiters), 1),), np.int32),
+        "truncated": total_f("truncated"),
+        "events": events_limbs,
+        "sink_count": total_i64("sink_count"),
+        "sink_sum": total_f("sink_sum"),
+        "sink_sq": total_f("sink_sq"),
+        "sink_hist": total_i64("sink_hist"),
+        "srv_completed": total_i64("srv_completed"),
+        "srv_dropped": limb_zeros_v,
+        "srv_outage_dropped": limb_zeros_v,
+        "srv_started": total_i64("srv_started"),
+        "srv_timed_out": limb_zeros_v,
+        "srv_retried": limb_zeros_v,
+        "srv_busy_int": total_f("srv_busy_int"),
+        "srv_depth_int": total_f("srv_depth_int"),
+        "srv_wait_sum": total_f("srv_wait_sum"),
+        "srv_wait_n": total_i64("srv_wait_n"),
+        "lim_admitted": np.zeros(
+            (N_LIMBS, max(len(model.limiters), 1)), np.int32
+        ),
+        "lim_dropped": np.zeros(
+            (N_LIMBS, max(len(model.limiters), 1)), np.int32
+        ),
     }
     if has_transit:
         # No drops by certificate; the key must exist for the shared
         # result assembly when compiled.has_transit.
-        reduced["tr_dropped"] = zeros_v
+        reduced["tr_dropped"] = limb_zeros_v
     return reduced, events_total, wall, compile_seconds

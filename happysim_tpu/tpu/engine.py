@@ -83,7 +83,19 @@ from jax.sharding import Mesh
 logger = logging.getLogger("happysim_tpu.tpu.engine")
 
 from happysim_tpu.tpu.faults import FaultTable
-from happysim_tpu.tpu.mesh import pad_to_multiple, replica_mesh, replica_sharding
+from happysim_tpu.tpu.mesh import (
+    ensemble_state_shardings,
+    pad_to_multiple,
+    replica_mesh,
+    replica_sharding,
+)
+from happysim_tpu.tpu.reduce import (
+    MAX_EXACT_REPLICAS,
+    host_f64,
+    host_i64,
+    sum_f32_fixed,
+    sum_i64_limbs,
+)
 from happysim_tpu.tpu.telemetry import (
     EnsembleTimeseries,
     build_timeseries,
@@ -109,6 +121,50 @@ HIST_DECADES = 8.0
 
 # Rate-profile integral tables: grid resolution over [0, horizon].
 PROFILE_GRID_POINTS = 512
+
+# Cross-replica reduction encodings (tpu/reduce.py): integer counters
+# reduce on device as exact int32-limb sums ("limb-encoded": a leading
+# (N_LIMBS,) axis the host recombines into int64 via host_i64), float
+# accumulators reduce as fixed-point limb sums against the exact
+# cross-replica max (mesh-shape bit-identical — float add order never
+# enters the reduction). The registries below are the single source of
+# truth for which reduce keys carry which encoding — reduce_final
+# encodes by them, _build_result decodes by them, and chain.run_chain
+# emits compatible encodings for the keys it produces.
+_I64_COUNTER_KEYS = frozenset({
+    "events",
+    "sink_count", "sink_hist",
+    "srv_completed", "srv_dropped", "srv_outage_dropped", "srv_started",
+    "srv_timed_out", "srv_retried", "srv_wait_n",
+    "srv_fault_dropped", "srv_fault_retried",
+    "srv_hedged", "srv_hedge_wins",
+    "lim_admitted", "lim_dropped",
+    "tr_dropped", "net_lost",
+    "blocks_total",
+})
+# Telemetry reduce keys that are float time-integrals / sums (everything
+# else under tel_ is an int counter and limb-encodes like the above).
+_TEL_FLOAT_KEYS = frozenset({
+    "tel_sink_sum", "tel_srv_depth_int", "tel_srv_busy_int",
+    "tel_fault_int",
+    "tel_spread_p10", "tel_spread_p90",
+})
+# Float accumulators reduced as fixed-point limb sums (decoded by
+# host_f64; the spread percentiles are plain device floats, not sums).
+_F64_SUM_KEYS = frozenset({
+    "sink_sum", "sink_sq",
+    "srv_busy_int", "srv_depth_int", "srv_wait_sum",
+    "tel_sink_sum", "tel_srv_depth_int", "tel_srv_busy_int",
+    "tel_fault_int",
+})
+
+
+def _is_i64_key(key: str) -> bool:
+    """Whether a reduce-output key is limb-encoded (see above)."""
+    if key in _I64_COUNTER_KEYS:
+        return True
+    return key.startswith("tel_") and key not in _TEL_FLOAT_KEYS
+
 
 # Events per uniform-generation chunk in ensemble mode. This is also the
 # default MACRO-BLOCK length: the hot loop runs blocks of this many fused
@@ -371,6 +427,12 @@ class EnsembleCheckpoint:
     # matches a telemetry-free run, and resuming a legacy checkpoint
     # into a telemetry model is (correctly) rejected.
     telemetry: str = ""
+    # Mesh the snapshot was taken under (devices on the replica mesh).
+    # PROVENANCE, not a contract: resume is resharding-aware, so a
+    # checkpoint written on an N-device mesh resumes on an M-device mesh
+    # bit-identically (the carry is redistributed; per-replica RNG
+    # streams are mesh-independent). 0 = unknown (older checkpoint).
+    mesh_devices: int = 0
 
     def save(self, path: str) -> None:
         meta = {
@@ -383,6 +445,7 @@ class EnsembleCheckpoint:
             "params_fingerprint": self.params_fingerprint,
             "macro_block": self.macro_block,
             "telemetry": self.telemetry,
+            "mesh_devices": self.mesh_devices,
         }
         save_checkpoint_npz(path, meta, self.state)
 
@@ -464,6 +527,19 @@ class EnsembleResult:
     # Replica lanes the kernel path actually ran after edge-padding to a
     # tile multiple (== n_replicas off the kernel path / when aligned).
     padded_replicas: int = 0
+    # Mesh provenance (engine_report()["mesh"]): the device mesh the
+    # replica axis was sharded over, the per-shard replica count, which
+    # cross-replica reduce path produced the numbers ("device-psum-tree"
+    # for the compiled on-device reduction under hs.reduce), and — on a
+    # resumed run — the seconds spent redistributing the checkpoint
+    # carry onto this mesh (device-to-device where the source state was
+    # still device-resident, host-staged for npz-loaded state).
+    mesh_devices: int = 1
+    mesh_axes: tuple = ()
+    mesh_shape: tuple = ()
+    per_shard_replicas: int = 0
+    reduce_path: str = "device-psum-tree"
+    redistribution_seconds: float = 0.0
 
     def engine_report(self) -> dict:
         """Machine-readable engine provenance: which path ran, why the
@@ -505,6 +581,14 @@ class EnsembleResult:
                 (padded - self.n_replicas) / padded if padded else 0.0
             ),
             "profiler_scopes": ("hs.macro_block", "hs.kernel", "hs.reduce"),
+            "mesh": {
+                "devices": self.mesh_devices,
+                "axes": tuple(self.mesh_axes),
+                "shape": tuple(self.mesh_shape),
+                "per_shard_replicas": self.per_shard_replicas,
+                "reduce_path": self.reduce_path,
+                "redistribution_seconds": self.redistribution_seconds,
+            },
         }
         if self.kernel_decline:
             report["escape_hatches"] = {
@@ -829,9 +913,9 @@ class _Compiled:
         lo, hi = window_edges(self.telemetry.window_s, self.nW)
         self.tel_lo = lo  # (nW,) float32 window starts
         self.tel_hi = hi  # (nW,) float32 window ends, hi[-1] = +inf
-        # Buffer keys reduced by a plain cross-replica device sum
-        # (tel_sink_count is handled separately: the spread metric keeps
-        # it per-replica and the host sums in int64).
+        # Buffer keys reduced on device by the shared limb/fixed-point
+        # encodings (tpu/reduce.py; tel_sink_count is handled separately
+        # because the spread metric also takes device percentiles of it).
         keys: list[str] = []
         if self.tel_latency:
             keys += ["tel_sink_sum", "tel_sink_hist"]
@@ -977,7 +1061,9 @@ class _Compiled:
             dark = dark + shared * jnp.asarray(
                 self.faults.participates, jnp.float32
             )
-        return jnp.sum(dark, axis=0)
+        # Cross-replica float reduction as a fixed-point limb sum: same
+        # bits on every mesh shape (tpu/reduce.py).
+        return sum_f32_fixed(dark, axis=0)
 
     def _edges(self):
         for s in self.model.sources:
@@ -2413,6 +2499,23 @@ def _all_edges(model: EnsembleModel):
         yield from r.target_latencies
 
 
+def _blocks_reduce(blocks, n_chunks: int) -> dict:
+    """Device-side macro-block occupancy provenance: the per-replica
+    blocks-run counts reduce to a bincount histogram plus a limb-encoded
+    total ON DEVICE (ints — exact on every mesh shape), replacing the
+    old host-side ``np.unique``/int64 sweep over the fetched (R,) array.
+    """
+    hist = (
+        jnp.zeros((n_chunks + 1,), jnp.int32)
+        .at[jnp.clip(blocks, 0, n_chunks)]
+        .add(1)
+    )
+    return {
+        "blocks_hist": hist,
+        "blocks_total": sum_i64_limbs(blocks, axis=0),
+    }
+
+
 # Target segment count for the checkpointing path (granularity of the
 # wall-clock checkpoint trigger; each boundary is a host sync point).
 CHECKPOINT_SEGMENTS = 32
@@ -2425,6 +2528,8 @@ def _run_ensemble_segmented(
     keys,
     params,
     sharding,
+    state_shardings,
+    mesh,
     *,
     n_chunks: int,
     n_replicas: int,
@@ -2439,7 +2544,16 @@ def _run_ensemble_segmented(
     """The checkpointing execution path: the chunk scan split into
     segments with a host sync (and optional carry snapshot) between them.
     Chunk indices are absolute, so segmentation does not perturb RNG
-    streams — results are bit-identical to the single-scan path."""
+    streams — results are bit-identical to the single-scan path.
+
+    Resume is RESHARDING-AWARE: the snapshot's carry is redistributed
+    onto THIS run's mesh via the per-leaf partition-rule shardings
+    (``state_shardings``), so a checkpoint written on an N-device mesh
+    resumes on an M-device mesh bit-identically — device-to-device when
+    the source leaves are still device-resident jax Arrays, host-staged
+    for npz-loaded numpy state. The redistribution seconds are returned
+    as provenance (engine_report()["mesh"]).
+    """
     fingerprint = model_fingerprint(compiled.model)
     p_fingerprint = params_fingerprint(params)
     if resume_from is not None:
@@ -2469,18 +2583,48 @@ def _run_ensemble_segmented(
         if bad:
             raise ValueError(
                 f"resume_from does not match this run: {bad} "
-                "(checkpoint value vs requested value)"
+                "(checkpoint value vs requested value; n_replicas counts "
+                "include mesh padding — pad_to_multiple(requested, "
+                "mesh.size) must equal the checkpoint's count)"
             )
+        # Shape validation BEFORE any device transfer: a tampered or
+        # truncated state array would otherwise surface as an opaque
+        # sharding/compile error deep in the segment runner.
+        missing = sorted(set(state_shardings) - set(resume_from.state))
+        if missing:
+            raise ValueError(
+                f"resume_from state is missing leaves {missing}: the "
+                "archive is truncated or hand-edited (fingerprints match, "
+                "so the model expects every compiled state leaf)"
+            )
+        for name, leaf in resume_from.state.items():
+            if name not in state_shardings:
+                raise ValueError(
+                    f"resume_from state carries unknown leaf {name!r}: "
+                    "not a state leaf of this model's compiled step "
+                    "(fingerprints match, so the archive itself is "
+                    "corrupt or hand-edited)"
+                )
+            shape = np.shape(leaf)
+            if not shape or shape[0] != n_replicas:
+                raise ValueError(
+                    f"resume_from state leaf {name!r} has shape {shape}: "
+                    f"expected a leading replica axis of {n_replicas} "
+                    "(the checkpoint's n_replicas) — the state cannot be "
+                    "redistributed onto this mesh"
+                )
 
     seg_chunks = max(1, -(-n_chunks // CHECKPOINT_SEGMENTS))
 
-    # Pin every state leaf to the replica sharding on BOTH sides of each
-    # segment: AOT-compiled calls reject sharding mismatches, and without
-    # the pin XLA's propagation may mark untouched leaves replicated on
-    # the init output while the runner emits them replica-sharded.
+    # Pin every state leaf to its partition-rule sharding on BOTH sides
+    # of each segment: AOT-compiled calls reject sharding mismatches,
+    # and without the pin XLA's propagation may mark untouched leaves
+    # replicated on the init output while the runner emits them
+    # replica-sharded. The per-leaf table (mesh.STATE_PARTITION_RULES)
+    # is validated at run_ensemble entry, so every leaf has a placement.
     init_all = jax.jit(
         lambda keys, params: jax.vmap(compiled.init_state)(keys, params),
-        out_shardings=sharding,
+        out_shardings=state_shardings,
     )
 
     # Donate the state carry into every segment runner (and the final
@@ -2502,19 +2646,30 @@ def _run_ensemble_segmented(
 
         return jax.jit(
             run_seg,
-            in_shardings=(sharding, sharding, sharding, None),
-            out_shardings=(sharding, sharding),
+            in_shardings=(state_shardings, sharding, sharding, None),
+            out_shardings=(state_shardings, sharding),
             **jit_kwargs,
         )
 
     # Prepare state and AOT-compile every segment shape BEFORE the timer,
     # mirroring the non-checkpoint path (whose timed region is pure
     # execution) so events_per_second stays comparable between paths.
+    redistribution_seconds = 0.0
     if resume_from is not None:
+        # Redistribute the snapshot carry onto THIS mesh: device_put
+        # against the per-leaf rule shardings moves data device-to-device
+        # when the source is a device-resident jax Array (an in-memory
+        # snapshot handed straight back), and stages through the host
+        # for npz-loaded numpy state. Timed as provenance — at 65k
+        # replicas this is the cost of moving the whole carry between
+        # mesh shapes.
+        redistribute_start = _wall.perf_counter()
         state = {
-            k: jax.device_put(jnp.asarray(v), sharding)
+            k: jax.device_put(v, state_shardings[k])
             for k, v in resume_from.state.items()
         }
+        state = jax.block_until_ready(state)
+        redistribution_seconds = _wall.perf_counter() - redistribute_start
         chunk_done = resume_from.chunk_index
     else:
         state = init_all(keys, params)
@@ -2533,21 +2688,29 @@ def _run_ensemble_segmented(
             make_seg_runner(rem).lower(state, keys, params, offset0).compile()
         )
     reduce_jit = (
-        jax.jit(reduce_final, in_shardings=(sharding,), **jit_kwargs)
+        jax.jit(reduce_final, in_shardings=(state_shardings,), **jit_kwargs)
         .lower(state)
+        .compile()
+    )
+    blocks_reduce_jit = (
+        jax.jit(
+            lambda blocks: _blocks_reduce(blocks, n_chunks),
+            in_shardings=(sharding,),
+        )
+        .lower(jax.ShapeDtypeStruct((n_replicas,), jnp.int32))
         .compile()
     )
     compile_seconds = _wall.perf_counter() - compile_start
 
     start = _wall.perf_counter()
     last_snapshot = _wall.perf_counter()
-    # Per-replica macro-block occupancy: the device arrays are collected
-    # and summed on the host only after the loop, so the provenance
-    # counter adds no per-segment host sync (a fetch here would stop
-    # segment k+1 from being enqueued while k executes). Provenance, not
-    # simulation state: a resumed run counts only its own segments — see
-    # EnsembleResult.engine_report().
-    seg_blocks_parts = []
+    # Per-replica macro-block occupancy accumulates as lazy DEVICE adds
+    # across segments (elementwise per replica — no cross-replica work
+    # and no per-segment host sync; a fetch here would stop segment k+1
+    # from being enqueued while k executes), then reduces on device
+    # after the loop. Provenance, not simulation state: a resumed run
+    # counts only its own segments — see EnsembleResult.engine_report().
+    blocks_acc = None
     while chunk_done < n_chunks:
         n_seg = min(seg_chunks, n_chunks - chunk_done)
         if n_seg not in runners:  # unaligned resume point
@@ -2563,7 +2726,9 @@ def _run_ensemble_segmented(
         state, seg_blocks = runners[n_seg](
             state, keys, params, jnp.uint32(chunk_done)
         )
-        seg_blocks_parts.append(seg_blocks)
+        blocks_acc = (
+            seg_blocks if blocks_acc is None else blocks_acc + seg_blocks
+        )
         chunk_done += n_seg
         # A callback without an interval means "snapshot every segment".
         every = (
@@ -2584,19 +2749,20 @@ def _run_ensemble_segmented(
                 params_fingerprint=p_fingerprint,
                 macro_block=macro_block,
                 telemetry=telemetry_sig,
+                mesh_devices=mesh.size,
             )
             checkpoint_callback(snapshot)
             last_snapshot = _wall.perf_counter()
 
-    reduced = reduce_jit(state)
-    events_total = int(np.asarray(reduced["events"]).sum(dtype=np.int64))
+    reduced = dict(reduce_jit(state))
+    if blocks_acc is not None:
+        reduced.update(blocks_reduce_jit(blocks_acc))
+    # The limb fetch doubles as the completion barrier; the host only
+    # recombines the 4 device-reduced limb totals (no cross-replica
+    # host arithmetic remains on this path).
+    events_total = int(host_i64(np.asarray(reduced["events"])))
     wall = _wall.perf_counter() - start
-    reduced = dict(reduced)
-    reduced["blocks_run"] = sum(
-        (np.asarray(part, dtype=np.int64) for part in seg_blocks_parts),
-        np.zeros((n_replicas,), np.int64),
-    )
-    return reduced, events_total, wall, compile_seconds
+    return reduced, events_total, wall, compile_seconds, redistribution_seconds
 
 
 def run_ensemble(
@@ -2632,6 +2798,16 @@ def run_ensemble(
     if mesh is None:
         mesh = replica_mesh()
     n_replicas = pad_to_multiple(n_replicas, mesh.size)
+    if n_replicas > MAX_EXACT_REPLICAS:
+        # The on-device limb reductions (tpu/reduce.py) are exact only
+        # while each 8-bit limb column stays under 2^31; past that they
+        # would wrap SILENTLY into plausible-but-wrong totals, so the
+        # bound fails loudly here instead.
+        raise ValueError(
+            f"n_replicas={n_replicas} exceeds the exact-reduction bound "
+            f"of {MAX_EXACT_REPLICAS} replicas (tpu/reduce.py limb sums "
+            "wrap past it); split the ensemble into multiple runs"
+        )
     # An explicit event budget is a contract about truncation the chain
     # fast path does not implement (it has its own arrival budget).
     explicit_max_events = max_events is not None
@@ -2671,6 +2847,30 @@ def run_ensemble(
 
     sharding = replica_sharding(mesh)
 
+    # Partition-rule table (mesh.STATE_PARTITION_RULES): every state
+    # leaf the compiled step carries must have a declared placement —
+    # validated HERE, once per run, so an undeclared leaf fails loudly
+    # at entry instead of silently replicating across the mesh. The
+    # per-leaf shardings drive the segmented path's jit pins and the
+    # resharding-aware checkpoint resume.
+    state_struct = jax.eval_shape(
+        compiled.init_state,
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+        {
+            "src_rate": jax.ShapeDtypeStruct((compiled.nS,), jnp.float32),
+            "srv_mean": jax.ShapeDtypeStruct((compiled.nV,), jnp.float32),
+        },
+    )
+    state_shardings = ensemble_state_shardings(mesh, tuple(state_struct))
+    mesh_axes = tuple(str(a) for a in mesh.axis_names)
+    mesh_shape = tuple(int(s) for s in np.shape(mesh.devices))
+    mesh_kwargs = dict(
+        mesh_devices=mesh.size,
+        mesh_axes=mesh_axes,
+        mesh_shape=mesh_shape,
+        per_shard_replicas=n_replicas // mesh.size,
+    )
+
     # Topology-specialized fast path: Poisson->FIFO-chain->sink models
     # and single-router fan-outs need no event loop at all (max-plus
     # Lindley per stage, see chain.py). Engages only when the
@@ -2705,6 +2905,7 @@ def run_ensemble(
                     n_replicas,
                     compile_seconds=compile_s,
                     engine_path="chain",
+                    **mesh_kwargs,
                 )
 
     params = {
@@ -2835,56 +3036,89 @@ def run_ensemble(
         )
         if compiled.has_transit:
             pending = jnp.minimum(pending, jnp.min(final["tr_time"], axis=(-2, -1)))
-        # Cross-replica reduction (psum over the mesh when sharded).
+
+        # Every cross-replica reduction happens HERE, on device, inside
+        # the compiled program (hs.reduce scope) — under a sharded mesh
+        # the limb sums lower to psum-tree collectives over the
+        # interconnect. Int counters limb-encode (exact int64 without
+        # x64 mode, no 2^31 wrap at 65k x 10^5 events); float
+        # accumulators quantize to fixed point against the exact
+        # cross-replica max and limb-sum the quanta, so every mesh
+        # shape produces identical bits (tpu/reduce.py). The encoding
+        # registries (_F64_SUM_KEYS / _is_i64_key) choose the encoder
+        # HERE and the decoder in _build_result, so a key only one side
+        # knows about fails at trace time instead of flowing through as
+        # an undecoded limb array.
         reduced = {
+            # Bounded by n_replicas: a plain int32 sum cannot wrap.
             "truncated": jnp.sum((pending < horizon).astype(jnp.int32)),
-            # Per-replica counters stay unsummed: a cross-replica int32
-            # sum wraps past 2^31 at headline scales (65k replicas x
-            # ~10^5 events); the host totals them in int64 instead.
+        }
+        per_replica = {
             "events": final["events"],
-            "sink_count": jnp.sum(final["sink_count"], axis=0),
-            "sink_sum": jnp.sum(final["sink_sum"], axis=0),
-            "sink_sq": jnp.sum(final["sink_sq"], axis=0),
-            "sink_hist": jnp.sum(final["sink_hist"], axis=0),
-            "srv_completed": jnp.sum(final["srv_completed"], axis=0),
-            "srv_dropped": jnp.sum(final["srv_dropped"], axis=0),
-            "srv_outage_dropped": jnp.sum(final["srv_outage_dropped"], axis=0),
-            "srv_started": jnp.sum(final["srv_started"], axis=0),
-            "srv_timed_out": jnp.sum(final["srv_timed_out"], axis=0),
-            "srv_retried": jnp.sum(final["srv_retried"], axis=0),
-            "srv_busy_int": jnp.sum(final["srv_busy_int"], axis=0),
-            "srv_depth_int": jnp.sum(final["srv_depth_int"], axis=0),
-            "srv_wait_sum": jnp.sum(final["srv_wait_sum"], axis=0),
-            "srv_wait_n": jnp.sum(final["srv_wait_n"], axis=0),
-            "lim_admitted": jnp.sum(final["lim_admitted"], axis=0),
-            "lim_dropped": jnp.sum(final["lim_dropped"], axis=0),
+            "sink_count": final["sink_count"],
+            "sink_sum": final["sink_sum"],
+            "sink_sq": final["sink_sq"],
+            "sink_hist": final["sink_hist"],
+            "srv_completed": final["srv_completed"],
+            "srv_dropped": final["srv_dropped"],
+            "srv_outage_dropped": final["srv_outage_dropped"],
+            "srv_started": final["srv_started"],
+            "srv_timed_out": final["srv_timed_out"],
+            "srv_retried": final["srv_retried"],
+            "srv_busy_int": final["srv_busy_int"],
+            "srv_depth_int": final["srv_depth_int"],
+            "srv_wait_sum": final["srv_wait_sum"],
+            "srv_wait_n": final["srv_wait_n"],
+            "lim_admitted": final["lim_admitted"],
+            "lim_dropped": final["lim_dropped"],
         }
         if compiled.has_transit:
-            reduced["tr_dropped"] = jnp.sum(final["tr_dropped"], axis=0)
+            per_replica["tr_dropped"] = final["tr_dropped"]
         if compiled.has_faults:
-            reduced["srv_fault_dropped"] = jnp.sum(
-                final["srv_fault_dropped"], axis=0
-            )
+            per_replica["srv_fault_dropped"] = final["srv_fault_dropped"]
             if compiled.has_fault_retries:
-                reduced["srv_fault_retried"] = jnp.sum(
-                    final["srv_fault_retried"], axis=0
-                )
+                per_replica["srv_fault_retried"] = final["srv_fault_retried"]
         if compiled.has_hedge:
-            reduced["srv_hedged"] = jnp.sum(final["srv_hedged"], axis=0)
-            reduced["srv_hedge_wins"] = jnp.sum(final["srv_hedge_wins"], axis=0)
+            per_replica["srv_hedged"] = final["srv_hedged"]
+            per_replica["srv_hedge_wins"] = final["srv_hedge_wins"]
         if compiled.has_loss:
-            reduced["net_lost"] = jnp.sum(final["net_lost"])
+            per_replica["net_lost"] = final["net_lost"]
         if compiled.has_telemetry:
             for key in compiled.tel_sum_keys:
-                reduced[key] = jnp.sum(final[key], axis=0)
+                per_replica[key] = final[key]
             if compiled.tel_throughput:
-                # "spread" keeps the (R, nW, nK) counts per replica: the
-                # host computes mean/p10/p90 across replicas AND the
-                # int64 totals; otherwise sum over replicas on device.
-                reduced["tel_sink_count"] = (
-                    final["tel_sink_count"]
-                    if compiled.tel_spread
-                    else jnp.sum(final["tel_sink_count"], axis=0)
+                per_replica["tel_sink_count"] = final["tel_sink_count"]
+        for key, arr in per_replica.items():
+            if key in _F64_SUM_KEYS:
+                reduced[key] = sum_f32_fixed(arr, axis=0)
+            elif _is_i64_key(key):
+                reduced[key] = sum_i64_limbs(arr, axis=0)
+            else:  # trace-time, so this can never ship silently
+                raise ValueError(
+                    f"reduce key {key!r} has no declared encoding: add it "
+                    "to _I64_COUNTER_KEYS or _F64_SUM_KEYS (engine.py) so "
+                    "_build_result knows how to decode it"
+                )
+        if compiled.has_telemetry:
+            if compiled.tel_spread:
+                # Cross-replica throughput spread ON DEVICE: p10/p90 as
+                # device percentiles of the raw per-replica counts (a
+                # global sort along the replica axis —
+                # value-deterministic, so mesh-shape bit-identity holds;
+                # the host scales by the window length, a monotone map
+                # that commutes with percentiles). The mean needs no
+                # extra reduction at all: it is the limb-exact
+                # tel_sink_count total over (n_replicas * window_len),
+                # computed elementwise in build_timeseries. The host
+                # used to fetch the whole (R, nW, nK) buffer and reduce
+                # with numpy — the last cross-replica host reduction on
+                # the telemetry path.
+                counts_f = final["tel_sink_count"].astype(jnp.float32)
+                reduced["tel_spread_p10"] = jnp.percentile(
+                    counts_f, 10.0, axis=0
+                )
+                reduced["tel_spread_p90"] = jnp.percentile(
+                    counts_f, 90.0, axis=0
                 )
             if compiled.tel_faults:
                 reduced["tel_fault_int"] = compiled._tel_fault_integral(final)
@@ -2908,15 +3142,41 @@ def run_ensemble(
             # the same absolute-block RNG keying and the same early-exit
             # contract as the vmapped lax path — skipped blocks are
             # no-ops per lane, so results are bit-identical.
+            #
+            # Mesh-first: the tile is planned PER SHARD (each device owns
+            # n_replicas / mesh.size lanes; the VMEM budget is per core),
+            # and on a >1-device mesh the kernel runs under shard_map —
+            # every shard drives the same Pallas program over its local
+            # replica slab, so the single-chip path is literally the
+            # mesh.size == 1 special case of this dispatch.
+            n_shards = mesh.size
+            per_shard = n_replicas // n_shards
             block_step, kmeta = build_block_step(
                 compiled,
                 horizon,
                 macro,
-                n_replicas,
+                per_shard,
                 interpret=kernel_interpret_mode(),
             )
-            n_padded = kmeta["padded_replicas"]
+            # Per-shard padding to a whole number of tiles; the global
+            # padded batch is one slab per shard. pad_replicas appends
+            # clone lanes at the global tail, which land on the last
+            # shard(s) and are sliced away before reduction.
+            n_padded = kmeta["padded_replicas"] * n_shards
             kernel_padded = n_padded
+            if n_shards > 1:
+                from jax.experimental.shard_map import shard_map
+
+                kspec = sharding.spec
+                block_call = shard_map(
+                    block_step,
+                    mesh=mesh,
+                    in_specs=(kspec, kspec, kspec),
+                    out_specs=kspec,
+                    check_rep=False,
+                )
+            else:
+                block_call = block_step
 
             @partial(jax.jit, **jit_kwargs)
             def run(keys, params):
@@ -2942,7 +3202,7 @@ def run_ensemble(
                                 maxval=1.0,
                             )
                         )(keys)
-                        return block_step(kstate, U, params)
+                        return block_call(kstate, U, params)
 
                 if early_exit:
                     # Per-lane occupancy accumulates in the carry: a lane
@@ -2995,7 +3255,7 @@ def run_ensemble(
                     )
                     blocks = blocks[:n_replicas]
                 reduced = reduce_final(final)
-                reduced["blocks_run"] = blocks
+                reduced.update(_blocks_reduce(blocks, n_chunks))
                 return reduced
 
         else:
@@ -3008,7 +3268,7 @@ def run_ensemble(
 
                 final, blocks = jax.vmap(one_replica)(keys, params)
                 reduced = reduce_final(final)
-                reduced["blocks_run"] = blocks
+                reduced.update(_blocks_reduce(blocks, n_chunks))
                 return reduced
 
         # AOT-compile so the timed region is pure execution (and the
@@ -3019,19 +3279,30 @@ def run_ensemble(
         compiled_fn = run.lower(keys, params).compile()
         compile_seconds = _wall.perf_counter() - compile_start
         start = _wall.perf_counter()
-        reduced = compiled_fn(keys, params)
-        # int64 on the host: the (R,) int32 fetch doubles as the
-        # completion barrier the timing depends on.
-        events_total = int(np.asarray(reduced["events"]).sum(dtype=np.int64))
+        # block_until_ready is the completion barrier the timing depends
+        # on; the cross-replica reductions already happened ON DEVICE
+        # inside the program (hs.reduce) — the host only recombines the
+        # fetched limb totals.
+        reduced = jax.block_until_ready(compiled_fn(keys, params))
+        events_total = int(host_i64(np.asarray(reduced["events"])))
         wall = _wall.perf_counter() - start
+        redistribution_seconds = 0.0
     else:
-        reduced, events_total, wall, compile_seconds = _run_ensemble_segmented(
+        (
+            reduced,
+            events_total,
+            wall,
+            compile_seconds,
+            redistribution_seconds,
+        ) = _run_ensemble_segmented(
             compiled,
             replica_chunks,
             reduce_final,
             keys,
             params,
             sharding,
+            state_shardings,
+            mesh,
             n_chunks=n_chunks,
             n_replicas=n_replicas,
             seed=seed,
@@ -3060,6 +3331,8 @@ def run_ensemble(
         macro_block=macro,
         max_blocks=n_chunks,
         padded_replicas=kernel_padded or n_replicas,
+        redistribution_seconds=redistribution_seconds,
+        **mesh_kwargs,
     )
 
 
@@ -3078,10 +3351,16 @@ def _build_result(
     macro_block: int = 0,
     max_blocks: int = 0,
     padded_replicas: int = 0,
+    mesh_devices: int = 1,
+    mesh_axes: tuple = (),
+    mesh_shape: tuple = (),
+    per_shard_replicas: int = 0,
+    redistribution_seconds: float = 0.0,
 ) -> EnsembleResult:
     """Shared result assembly for the event scan and the chain fast path
-    (``chain.run_chain`` emits the same ``reduced`` key set; the chain
-    path runs no macro-blocks, so its occupancy counters stay zero)."""
+    (``chain.run_chain`` emits the same ``reduced`` key set and the same
+    limb/tree encodings; the chain path runs no macro-blocks, so its
+    occupancy counters stay zero)."""
     horizon = float(model.horizon_s)
     truncated = int(reduced["truncated"])
     if truncated:
@@ -3095,18 +3374,31 @@ def _build_result(
             horizon,
         )
 
-    host = {k: np.asarray(v) for k, v in reduced.items()}
+    # Decode the device-reduced limb totals: int64 for counters, float64
+    # for the fixed-point float sums (host_i64/host_f64 weigh the 4
+    # per-limb totals — NOT cross-replica reductions; the replica axis
+    # was reduced on device under hs.reduce).
+    def _decode(k, v):
+        if _is_i64_key(k):
+            return host_i64(v)
+        if k in _F64_SUM_KEYS:
+            return host_f64(v)
+        return np.asarray(v)
+
+    host = {k: _decode(k, v) for k, v in reduced.items()}
     nV_real = len(model.servers)
     nL_real = len(model.limiters)
-    # Device-counted macro-block occupancy -> host histogram
-    # {blocks_run: n_replicas} (engine_report()'s occupancy counters).
+    # Device-counted macro-block occupancy: the bincount histogram and
+    # the limb total both reduced on device ({blocks_run: n_replicas}
+    # for engine_report()'s occupancy counters).
     blocks_total = 0
     block_occupancy: dict = {}
-    if "blocks_run" in host:
-        per_replica_blocks = host.pop("blocks_run").astype(np.int64)
-        blocks_total = int(per_replica_blocks.sum())
-        values, counts = np.unique(per_replica_blocks, return_counts=True)
-        block_occupancy = {int(v): int(c) for v, c in zip(values, counts)}
+    if "blocks_hist" in host:
+        hist_counts = host.pop("blocks_hist")
+        blocks_total = int(host.pop("blocks_total"))
+        block_occupancy = {
+            int(v): int(c) for v, c in enumerate(hist_counts) if c
+        }
     # Windowed telemetry series (the chain fast path declines telemetry
     # models, so a telemetry run always reaches here via the event scan).
     timeseries = None
@@ -3169,6 +3461,12 @@ def _build_result(
         blocks_total=blocks_total,
         block_occupancy=block_occupancy,
         padded_replicas=padded_replicas or n_replicas,
+        mesh_devices=mesh_devices,
+        mesh_axes=tuple(mesh_axes),
+        mesh_shape=tuple(mesh_shape),
+        per_shard_replicas=per_shard_replicas or n_replicas,
+        reduce_path="device-psum-tree",
+        redistribution_seconds=redistribution_seconds,
     )
 
 

@@ -243,6 +243,11 @@ def kernel_decision(
     ``EnsembleResult.kernel_decline`` so a declined run names the path
     that executed and the flag that controls it.
 
+    Multi-device 1-D replica meshes are SUPPORTED (mesh-first: the
+    engine shard_maps the kernel so each device fuses its local replica
+    slab; the tile plan is per shard). Only the 2-D hosts/replicas
+    layout declines.
+
     ``compiled`` (an ``engine._Compiled``, optional) enables the VMEM
     budget check: a per-replica register file — telemetry window buffers
     included — that exceeds the tile budget even at tile=1 declines with
@@ -267,10 +272,23 @@ def kernel_decision(
             f"IS the snapshot format); {KERNEL_ENV} does not apply"
         )
     if mesh is not None and mesh.size > 1:
-        return False, (
-            f"{mesh.size}-device mesh: the kernel path is single-device "
-            f"for now; lax event step ran ({KERNEL_ENV} cannot override)"
-        )
+        # Mesh-first: a 1-D replica mesh is the kernel's native layout —
+        # the batch shards over the replica axis and each device runs
+        # the same Pallas program over its local slab with a PER-SHARD
+        # tile plan (n_replicas / mesh.size lanes against the per-core
+        # VMEM budget). Only the 2-D hosts/replicas layout still
+        # declines: the kernel has no DCN-aware dispatch yet.
+        from happysim_tpu.tpu.mesh import HOST_AXIS
+
+        if HOST_AXIS in mesh.axis_names:
+            return False, (
+                f"2-D {'x'.join(str(s) for s in mesh.devices.shape)} "
+                "hosts/replicas mesh: the kernel shards the replica axis "
+                "of a 1-D mesh only (replica_mesh); the lax event step "
+                "ran — it shards over both axes. Flatten to a 1-D "
+                f"replica mesh to fuse ({KERNEL_ENV} cannot override "
+                "the layout)"
+            )
     if macro > MAX_UNROLL_MACRO:
         return False, (
             f"macro_block={macro} exceeds the kernel unroll bound "

@@ -17,6 +17,7 @@ layouts, so ``run_ensemble(..., mesh=...)`` needs no call-site changes.
 
 from __future__ import annotations
 
+import re
 from typing import Optional, Sequence
 
 import jax
@@ -124,3 +125,104 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def pad_to_multiple(n: int, devices: int) -> int:
     """Round replica count up so it divides evenly across devices."""
     return ((n + devices - 1) // devices) * devices
+
+
+# ---------------------------------------------------------------------------
+# Partition-rule table for the ensemble state pytree
+# ---------------------------------------------------------------------------
+#
+# The DrJAX-style ``match_partition_rules`` pattern: every state leaf the
+# compiled step carries is matched against this ordered (regex ->
+# placement) table, grouped by the subsystem that owns the leaf. Today
+# every leaf is per-replica data (leading axis = replica lane), so every
+# placement is "replica" — the table's value is the CONTRACT: a new
+# subsystem that adds a state leaf without declaring its placement fails
+# loudly at mesh-construction time instead of silently defaulting to
+# replicated (which would DUPLICATE per-replica state onto every device
+# and corrupt the psum-tree reductions that assume one owner per lane).
+#
+# Placements: "replica" shards the leading axis over the whole mesh
+# (host-major on the 2-D hosts/replicas mesh).
+STATE_PARTITION_RULES: tuple[tuple[str, str], ...] = (
+    # scalar per-replica carries (time, PRNG lane, event counter)
+    (r"^(t|key|events)$", "replica"),
+    # source registers + arrival state
+    (r"^src_", "replica"),
+    # server registers: slots, queue rings, counters, integrals,
+    # fault/hedge accounting (srv_fault_*, srv_hedge*)
+    (r"^srv_", "replica"),
+    # transit registers (latency edges + backoff re-arrivals)
+    (r"^tr_", "replica"),
+    # router round-robin cursor
+    (r"^rr_next$", "replica"),
+    # token-bucket limiter state
+    (r"^lim_", "replica"),
+    # sink accumulators (counts, latency moments, histogram)
+    (r"^sink_", "replica"),
+    # packet-loss counter
+    (r"^net_lost$", "replica"),
+    # sampled stochastic fault-window registers (incl. shared/correlated)
+    (r"^flt_", "replica"),
+    # windowed telemetry buffers (tpu/telemetry.py)
+    (r"^tel_", "replica"),
+)
+
+
+def match_partition_rules(
+    name: str,
+    rules: tuple[tuple[str, str], ...] = STATE_PARTITION_RULES,
+) -> str:
+    """First-match placement for one state leaf name.
+
+    Unknown leaves raise — "no rule" must never silently mean
+    "replicated" (see :data:`STATE_PARTITION_RULES`).
+    """
+    for pattern, placement in rules:
+        if re.search(pattern, name):
+            return placement
+    raise ValueError(
+        f"no partition rule matches state leaf {name!r}: add an entry to "
+        "happysim_tpu.tpu.mesh.STATE_PARTITION_RULES declaring how the "
+        "leaf shards over the replica mesh (unknown leaves fail loudly "
+        "rather than defaulting to replicated)"
+    )
+
+
+def ensemble_state_specs(
+    leaf_names: Sequence[str],
+    mesh: Optional[Mesh] = None,
+) -> dict:
+    """Per-leaf ``PartitionSpec`` table for a vmapped ensemble state.
+
+    ``mesh`` only selects the axis spelling (1-D replica vs 2-D
+    host/replica); pass None for the 1-D default. Every name must match
+    a rule — this is the validation gate ``run_ensemble`` runs once per
+    call, so a state leaf without a declared placement can never reach
+    the compiled program.
+    """
+    if mesh is not None and HOST_AXIS in mesh.axis_names:
+        replica_spec = P((HOST_AXIS, REPLICA_AXIS))
+    else:
+        replica_spec = P(REPLICA_AXIS)
+    specs = {}
+    for name in leaf_names:
+        placement = match_partition_rules(name)
+        # Single placement today; the elif chain is where a future
+        # replicated/model-parallel placement plugs in.
+        if placement == "replica":
+            specs[name] = replica_spec
+        else:  # pragma: no cover - no other placements declared yet
+            raise ValueError(
+                f"unknown placement {placement!r} for state leaf {name!r}"
+            )
+    return specs
+
+
+def ensemble_state_shardings(mesh: Mesh, leaf_names: Sequence[str]) -> dict:
+    """The spec table bound to a concrete mesh as ``NamedSharding``s
+    (what jit in/out_shardings and resharding-aware checkpoint resume
+    consume)."""
+    return {
+        name: NamedSharding(mesh, spec)
+        for name, spec in ensemble_state_specs(leaf_names, mesh).items()
+    }

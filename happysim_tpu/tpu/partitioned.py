@@ -388,7 +388,10 @@ def _run_partitioned_segmented(
             )
 
     # Host int64: a device-side int32 sum over per-replica counters
-    # wraps past 2^31 at headline scales (same fix as the scan path).
+    # wraps past 2^31 at headline scales. (run_ensemble's scan path has
+    # since moved to on-device limb sums — tpu/reduce.py; this executor
+    # is the entity-sharded special case and its partition counts are
+    # small, so the host fetch stays.)
     events_total = int(np.asarray(state["events"]).sum(dtype=np.int64))
     wall = _wall.perf_counter() - start
     return state, events_total, wall
@@ -407,6 +410,17 @@ def run_partitioned(
     resume_from: Optional[PartitionedCheckpoint] = None,
 ) -> PartitionedResult:
     """Execute ``model`` as one entity-sharded simulation per replica lane.
+
+    .. note::
+        ``run_partitioned`` is the ENTITY-SHARDED SPMD special case —
+        one logical simulation whose topology spans devices via
+        ``model.remote(...)`` ring edges. It is NOT the multi-chip
+        path: replica-parallel multi-chip execution is unified under
+        ``run_ensemble(mesh=...)``, which shards the replica axis over
+        a ``jax.sharding`` mesh, fuses per shard, and reduces on
+        device (docs/tpu-engine.md "Mesh execution"). Reach for this
+        executor only when a single model instance is too large or too
+        distributed for one device.
 
     Every partition (device) runs the same local topology; jobs delivered
     to a ``model.remote(...)`` node cross to the NEXT partition on the
@@ -429,12 +443,15 @@ def run_partitioned(
         # and cross-partition reduce paths that do not thread the
         # telemetry buffers yet.
         raise ValueError(
-            "windowed telemetry is not supported by run_partitioned; "
-            "use run_ensemble (replica data parallelism) for telemetry "
-            "models or drop the TelemetrySpec. run_ensemble executes "
-            "telemetry models on its event scan (the lax step — the "
-            "HS_TPU_PALLAS fused kernel declines telemetry too, and "
-            "HS_TPU_EARLY_EXIT=0 forces the scan's flat chunk loop)"
+            "windowed telemetry is not supported by run_partitioned — "
+            "this executor is the entity-sharded SPMD special case, not "
+            "the multi-chip path. Use the mesh-first engine instead: "
+            "run_ensemble(mesh=replica_mesh(...)) shards replicas over "
+            "any number of devices WITH telemetry, telemetry buffers "
+            "ride the VMEM tile on the fused kernel (HS_TPU_PALLAS "
+            "selects kernel vs lax step), windows merge on device under "
+            "hs.reduce, and HS_TPU_EARLY_EXIT=0 keeps the flat chunk "
+            "scan reachable for A/B"
         )
     if outbox_capacity < 1:
         raise ValueError(

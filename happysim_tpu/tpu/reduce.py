@@ -1,0 +1,162 @@
+"""Mesh-invariant on-device cross-replica reductions.
+
+Every number ``run_ensemble`` reports is a reduction over the replica
+axis — the axis the ``jax.sharding`` mesh shards. Two properties have to
+hold at production scale:
+
+1. **No int32 wrap.** Per-replica int32 counters summed across 65k+
+   replicas overflow 2^31 (65k replicas x ~10^5 events each is ~10^9+).
+   The engine used to dodge this by fetching the per-replica arrays and
+   summing on the host in int64 — a host-side cross-replica reduction
+   on the result path, exactly what a sharded engine must not do (the
+   fetch gathers every shard to one process).
+2. **Bit-identity across mesh shapes.** Float32 addition is not
+   associative, and XLA owes us no particular combine order: a sharded
+   ``jnp.sum`` reduces shard-locally and merges partials in
+   layout-dependent order (measured: 1-ulp drift between the 1- and
+   8-device mesh at 65k replicas on the CPU backend), and even an
+   explicitly spelled-out binary add tree is not safe — the algebraic
+   simplifier may factor surrounding elementwise multiplies through it
+   differently per layout (also measured). Checkpoint-resume across
+   mesh shapes and the 1-vs-N-device bench gates need the SAME bits
+   from every layout, so the result path must not depend on float add
+   order at all.
+
+Both are solved by reducing in INTEGER arithmetic on device, inside the
+compiled reduce (the ``hs.reduce`` profiler scope). Integer addition is
+associative, so any combine order — shard-local partials, psum trees
+over the interconnect, whatever XLA reassociates — produces identical
+bits:
+
+- :func:`sum_i64_limbs` emulates an exact int64 sum with int32-only
+  arithmetic (JAX's default x64-disabled mode): each value splits into
+  four 8-bit limbs, each limb column sums without overflow (exact for
+  up to 2^23 ~ 8.4M replicas — :data:`MAX_EXACT_REPLICAS`), and the
+  host recombines the four per-limb totals with :func:`host_i64`.
+- :func:`sum_f32_fixed` reduces non-negative float32 accumulators by
+  quantizing each per-replica value to fixed point against the exact
+  cross-replica maximum (float max IS associative, so the scale is
+  layout-invariant), limb-summing the integer quanta, and letting the
+  host rescale in float64 (:func:`host_f64`). Quantization error is
+  bounded by ``n_replicas / 2^31`` relative worst-case (sparse columns)
+  and ~``2^-30`` relative for dense data — below float32's own
+  sequential-sum error, and BIT-IDENTICAL on every mesh shape.
+
+These are the only reduction primitives the engine's result path is
+allowed to use across replicas; ``jnp.sum`` remains fine for bounded
+int32 counts (e.g. the truncation census, capped at n_replicas).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+#: Bits per limb in the int32-emulated int64 sum.
+LIMB_BITS = 8
+#: Limbs covering a non-negative int32 (4 x 8 = 32 bits >= 31).
+N_LIMBS = 4
+#: Replica-count bound for exactness: each 8-bit limb column sums to at
+#: most (2^8 - 1) * R, which must stay under 2^31.
+MAX_EXACT_REPLICAS = 1 << (31 - LIMB_BITS)
+
+_LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def sum_i64_limbs(x, axis: int = 0):
+    """Exact cross-replica sum of non-negative int32 values, returned as
+    ``(N_LIMBS, ...)`` int32 limb totals (host-recombined by
+    :func:`host_i64`).
+
+    The per-limb sums lower to psum-tree collectives over the replica
+    axis under a sharded layout; integer associativity makes the result
+    identical on every mesh shape. Exact while the reduced axis is at
+    most :data:`MAX_EXACT_REPLICAS` long (8.4M replicas — far above the
+    HBM ceiling for any real carry).
+    """
+    x = jnp.asarray(x, jnp.int32)
+    limbs = jnp.stack(
+        [(x >> (LIMB_BITS * i)) & _LIMB_MASK for i in range(N_LIMBS)]
+    )
+    return jnp.sum(limbs, axis=axis + 1)
+
+
+def host_i64(limbs) -> np.ndarray:
+    """Recombine :func:`sum_i64_limbs` output into int64 on the host.
+
+    This is NOT a cross-replica reduction — the replica axis was reduced
+    on device; the host only weighs the ``N_LIMBS`` per-limb totals.
+    """
+    limbs = np.asarray(limbs).astype(np.int64)
+    out = np.zeros(limbs.shape[1:], np.int64)
+    for i in range(N_LIMBS):
+        out += limbs[i] << (LIMB_BITS * i)
+    return out
+
+
+def _pow2_scale(m):
+    """Per-column power-of-two scale ``2^(29 - floor(log2(m)))`` built
+    by integer exponent surgery on the float32 bit pattern.
+
+    A power-of-two scale is the load-bearing choice: ``x * 2^k`` is
+    EXACT in float arithmetic (no rounding), so the quantization below
+    is a function of the VALUE of ``x`` alone — no XLA rewrite of the
+    multiply (distribution, factoring, fused forms) can change a single
+    quantum, where a general ``2^30 / m`` scale measurably did (sub-ulp
+    drift between differently-fused programs). ``m * scale`` lands in
+    ``[2^29, 2^30)``, int32-safe with rounding headroom. Zero columns
+    map to scale 0 (all quanta 0); subnormal ``m`` clamps to the max
+    finite exponent, which only costs resolution.
+    """
+    bits = lax.bitcast_convert_type(jnp.asarray(m, jnp.float32), jnp.int32)
+    biased = (bits >> 23) & 0xFF
+    # S's biased exponent: (29 - (biased - 127)) + 127, clipped into the
+    # normal-float exponent range.
+    s_biased = jnp.clip(283 - biased, 1, 254)
+    scale = lax.bitcast_convert_type(
+        (s_biased << 23).astype(jnp.int32), jnp.float32
+    )
+    return jnp.where(m > 0, scale, jnp.float32(0.0))
+
+
+def sum_f32_fixed(x, axis: int = 0) -> dict:
+    """Layout-invariant cross-replica sum of NON-NEGATIVE float32
+    accumulators, as ``{"q": (N_LIMBS, ...) int32, "scale": (...)
+    float32}`` (host-recombined by :func:`host_f64`).
+
+    Per column of the reduced axis: take the exact cross-replica max
+    ``m`` (float max is associative — same bits on every layout), scale
+    every value by the power-of-two ``2^(29 - floor(log2(m)))`` (exact
+    multiply — see :func:`_pow2_scale`), round to integer quanta, and
+    limb-sum the quanta exactly. Every float op happens BEFORE the
+    reduction and is exact; the reduction itself is integer, which no
+    XLA reassociation can perturb — so kernel vs lax program contexts
+    and every mesh shape all produce identical bits.
+
+    Accuracy: worst-case relative error ``~n_replicas / 2^30`` (one
+    replica holding all the mass), typically ``~2^-29`` for dense
+    columns — at or below the error float32 sequential summation itself
+    accumulates. All engine accumulators (latency sums/squares, busy and
+    depth time-integrals, telemetry window integrals) are non-negative
+    by construction; negative inputs are NOT supported.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    if axis != 0:
+        x = jnp.moveaxis(x, axis, 0)
+    m = jnp.max(x, axis=0)  # exact + layout-invariant (max associates)
+    scale = _pow2_scale(m)
+    q = jnp.round(x * scale[None]).astype(jnp.int32)
+    return {"q": sum_i64_limbs(q, axis=0), "scale": scale}
+
+
+def host_f64(packed) -> np.ndarray:
+    """Rescale a :func:`sum_f32_fixed` result into float64 on the host
+    (plain arrays pass through as float64 — the chain fast path emits
+    already-reduced float totals for the same keys)."""
+    if not isinstance(packed, dict):
+        return np.asarray(packed, np.float64)
+    scale = np.asarray(packed["scale"], np.float64)
+    q = host_i64(packed["q"]).astype(np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.where(scale > 0, q / np.maximum(scale, 1e-300), 0.0)

@@ -31,7 +31,8 @@ without a spec traces to the exact same program as before.
 Metric groups (``TelemetrySpec.metrics``):
 
 ``throughput``
-    Per-window sink delivery counts (summed on host in int64).
+    Per-window sink delivery counts (reduced on device as exact
+    int32-limb sums — ``tpu/reduce.py`` — and recombined into int64).
 ``latency``
     Per-window log-spaced latency histograms (-> p50(t)/p99(t) via
     :func:`~happysim_tpu.tpu.engine.hist_percentile`) plus latency sums
@@ -49,9 +50,11 @@ Metric groups (``TelemetrySpec.metrics``):
     timeouts, retries (deadline and fault), hedges + hedge wins,
     limiter admits/drops, transit drops, packet losses.
 ``spread``
-    Cross-replica spread of per-window throughput: the reduce keeps the
-    per-replica ``(R, nWindows, nSinks)`` counts (instead of summing on
-    device) and the host computes mean / p10 / p90 across replicas.
+    Cross-replica spread of per-window throughput: mean / p10 / p90
+    across replicas, computed INSIDE the compiled reduce (psum-tree
+    mean, device percentiles over the sharded replica axis) — the
+    per-replica ``(R, nWindows, nSinks)`` buffer never leaves the
+    device.
 ``faults``
     Per-window fault-window occupancy (expected fraction of dark time
     per server), computed at reduce time directly from the sampled
@@ -398,16 +401,30 @@ def build_timeseries(
         return np.asarray(host[key]).astype(np.int64)
 
     if "tel_sink_count" in host:
-        raw = np.asarray(host["tel_sink_count"]).astype(np.int64)
-        if raw.ndim == 3:  # (R, nW, nK): spread kept per-replica
-            ts.sink_count = raw.sum(axis=0)
+        # Device-reduced (nW, nK) totals (limb-decoded to int64 by the
+        # result assembly). The cross-replica spread — mean via the
+        # psum tree, p10/p90 via a device percentile — is computed
+        # inside the compiled reduce too, so the per-replica buffer is
+        # never fetched to the host.
+        ts.sink_count = np.asarray(host["tel_sink_count"]).astype(np.int64)
+        if "tel_spread_p10" in host:
+            # Mean per-replica rate = exact device-reduced totals over
+            # (n_replicas * window_len) — elementwise host math on
+            # already-reduced numbers, no per-replica fetch. Percentiles
+            # were taken on device over the raw counts; the window-length
+            # scaling is monotone, so it commutes with the percentile.
             with np.errstate(divide="ignore", invalid="ignore"):
-                per_replica = raw / window_len[None, :, None]
-            ts.replica_throughput_mean = per_replica.mean(axis=0)
-            ts.replica_throughput_p10 = np.percentile(per_replica, 10, axis=0)
-            ts.replica_throughput_p90 = np.percentile(per_replica, 90, axis=0)
-        else:
-            ts.sink_count = raw
+                ts.replica_throughput_mean = ts.sink_count / (
+                    n_replicas * window_len[:, None]
+                )
+                ts.replica_throughput_p10 = (
+                    np.asarray(host["tel_spread_p10"], np.float64)
+                    / window_len[:, None]
+                )
+                ts.replica_throughput_p90 = (
+                    np.asarray(host["tel_spread_p90"], np.float64)
+                    / window_len[:, None]
+                )
     if "tel_sink_hist" in host:
         hist = counts("tel_sink_hist")
         ts.sink_hist = hist

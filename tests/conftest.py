@@ -49,6 +49,7 @@ _TPU_TEST_FILES = {
     "test_kernel_regression.py",
     "test_engine_path_reasons.py",
     "test_tpu_mesh.py",
+    "test_tpu_mesh_resume.py",
 }
 # Long host-side suites (examples execute end-to-end, some on the TPU path).
 _SLOW_TEST_FILES = {"test_examples.py"}

@@ -22,6 +22,9 @@ EXCLUDED_FIELDS = {
     "wall_seconds",
     "events_per_second",
     "compile_seconds",
+    # resumed runs pay a carry-redistribution transfer; uninterrupted twins
+    # report 0.0 (timing provenance, not simulation state)
+    "redistribution_seconds",
     # engine-path provenance: a checkpointed run legitimately reports
     # a different path/decline note than its uninterrupted twin (the
     # SIMULATION must match bit-for-bit; the route taken may differ)

@@ -1,0 +1,222 @@
+"""Resharding-aware checkpoint resume across mesh shapes (ISSUE 13).
+
+The contract under test: an ``EnsembleCheckpoint`` written under an
+N-device replica mesh resumes under an M-device mesh and lands on the
+EXACT pinned-seed golden — the uninterrupted run's counters AND
+windowed telemetry series, bit for bit. This holds because
+
+- per-replica RNG streams are keyed by (seed, replica index, absolute
+  block index), independent of the mesh layout,
+- resume redistributes the carry onto the new mesh via the per-leaf
+  partition-rule shardings (host-staged for npz-loaded state), and
+- every cross-replica reduction is layout-invariant on device
+  (``tpu/reduce.py`` limb sums — no float add order, no host sums).
+
+The model is the north-star shape: a FAULTED deadline M/M/1 WITH
+windowed telemetry, so the fault registers, attempt columns, transit
+registers, and every ``(nW, ...)`` telemetry buffer all ride the
+redistributed carry.
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu import (
+    EnsembleCheckpoint,
+    replica_mesh,
+    run_ensemble,
+)
+from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+KWARGS = dict(n_replicas=16, seed=11, max_events=480)
+
+
+def _model():
+    model = EnsembleModel(horizon_s=12.0, warmup_s=2.0)
+    src = model.source(rate=8.0)
+    srv = model.server(
+        service_mean=0.1,
+        queue_capacity=64,
+        deadline_s=8.0,
+        max_retries=1,
+        fault=FaultSpec(rate=0.05, mean_duration_s=0.5),
+    )
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    model.telemetry(window_s=0.75)  # 16 windows
+    return model
+
+
+def _mesh(n: int):
+    return replica_mesh(jax.devices("cpu")[:n])
+
+
+@pytest.fixture(scope="module")
+def golden():
+    """The uninterrupted pinned-seed run (any mesh — layout-invariant)."""
+    return run_ensemble(_model(), **KWARGS, mesh=_mesh(1))
+
+
+def _mid_snapshot(n_devices: int) -> EnsembleCheckpoint:
+    snapshots = []
+    run_ensemble(
+        _model(),
+        **KWARGS,
+        mesh=_mesh(n_devices),
+        checkpoint_every_s=0.0,
+        checkpoint_callback=snapshots.append,
+    )
+    assert snapshots and all(
+        0 < s.chunk_index < s.n_chunks for s in snapshots
+    ), "snapshots must be strictly mid-run"
+    return snapshots[len(snapshots) // 2]
+
+
+# One checkpointed run per source mesh shape, shared module-wide (each
+# segmented run AOT-compiles several programs — the expensive part on
+# the CPU backend; the resumes themselves are cheap by comparison).
+@pytest.fixture(scope="module")
+def snap_1dev():
+    return _mid_snapshot(1)
+
+
+@pytest.fixture(scope="module")
+def snap_8dev():
+    return _mid_snapshot(8)
+
+
+def _assert_matches_golden(resumed, golden):
+    assert resumed.simulated_events == golden.simulated_events
+    assert resumed.sink_count == golden.sink_count
+    assert resumed.sink_mean_latency_s == golden.sink_mean_latency_s
+    assert resumed.server_completed == golden.server_completed
+    assert resumed.server_fault_dropped == golden.server_fault_dropped
+    assert resumed.server_timed_out == golden.server_timed_out
+    assert resumed.server_mean_wait_s == golden.server_mean_wait_s
+    np.testing.assert_array_equal(resumed.sink_hist, golden.sink_hist)
+    assert resumed.truncated_replicas == golden.truncated_replicas
+    # The windowed series — every field, including the float integrals.
+    assert resumed.timeseries == golden.timeseries
+
+
+class TestReshardingResume:
+    def test_1_to_8_device_resume_lands_on_the_golden(
+        self, golden, snap_1dev, tmp_path
+    ):
+        """Checkpoint on 1 device -> npz -> resume on 8 devices ->
+        exact golden counters + telemetry windows."""
+        assert snap_1dev.mesh_devices == 1  # provenance recorded
+        path = os.path.join(tmp_path, "mesh_resume.npz")
+        snap_1dev.save(path)
+        loaded = EnsembleCheckpoint.load(path)
+        assert loaded.mesh_devices == 1
+        resumed = run_ensemble(
+            _model(), **KWARGS, mesh=_mesh(8), resume_from=loaded
+        )
+        _assert_matches_golden(resumed, golden)
+        # Redistribution provenance: the resumed run reports the carry
+        # transfer and the mesh it landed on.
+        report = resumed.engine_report()["mesh"]
+        assert report["devices"] == 8
+        assert resumed.redistribution_seconds > 0.0
+        assert report["reduce_path"] == "device-psum-tree"
+
+    # slow: needs the second (8-device) checkpointed run — the CI mesh
+    # gate (which passes the everything-marker) and the nightly tier run
+    # these per push; tier-1 keeps the 1->8 direction + the mismatch
+    # rejections inside its wall-clock envelope.
+    @pytest.mark.slow
+    @pytest.mark.parametrize("resume_devs", [1, 4])
+    def test_8_device_snapshot_resumes_down_mesh(
+        self, golden, snap_8dev, resume_devs
+    ):
+        """8 -> 1 and 8 -> 4: the in-memory snapshot (no npz round
+        trip) redistributes down-mesh and lands on the golden."""
+        assert snap_8dev.mesh_devices == 8
+        resumed = run_ensemble(
+            _model(), **KWARGS, mesh=_mesh(resume_devs), resume_from=snap_8dev
+        )
+        _assert_matches_golden(resumed, golden)
+
+    def test_mismatch_shaped_state_rejects_with_leaf_name(self, snap_1dev):
+        """A tampered/truncated state array fails loudly BEFORE any
+        device transfer, naming the leaf and the expected replica axis."""
+        bad = dataclasses.replace(
+            snap_1dev,
+            state={
+                k: (v[: KWARGS["n_replicas"] // 2] if np.ndim(v) else v)
+                for k, v in snap_1dev.state.items()
+            },
+        )
+        with pytest.raises(ValueError, match="leading replica axis"):
+            run_ensemble(_model(), **KWARGS, resume_from=bad)
+
+    def test_unknown_state_leaf_rejects(self, snap_1dev):
+        bad = dataclasses.replace(
+            snap_1dev,
+            state={**snap_1dev.state, "not_a_leaf": np.zeros((16,), np.int32)},
+        )
+        with pytest.raises(ValueError, match="unknown leaf 'not_a_leaf'"):
+            run_ensemble(_model(), **KWARGS, resume_from=bad)
+
+    def test_missing_state_leaf_rejects(self, snap_1dev):
+        """A truncated archive (one state__ array deleted) fails loudly
+        naming the missing leaves instead of surfacing as a pytree
+        mismatch deep in the segment runner."""
+        state = dict(snap_1dev.state)
+        state.pop("flt_start")
+        bad = dataclasses.replace(snap_1dev, state=state)
+        with pytest.raises(ValueError, match=r"missing leaves \['flt_start'\]"):
+            run_ensemble(_model(), **KWARGS, resume_from=bad)
+
+
+def test_replica_count_beyond_exact_reduction_bound_rejects():
+    """The on-device limb reductions are exact to MAX_EXACT_REPLICAS;
+    past that the engine must refuse instead of silently wrapping."""
+    from happysim_tpu.tpu.reduce import MAX_EXACT_REPLICAS
+
+    with pytest.raises(ValueError, match="exact-reduction bound"):
+        run_ensemble(
+            _model(), n_replicas=MAX_EXACT_REPLICAS + 1, seed=0, max_events=8
+        )
+
+
+class TestMeshBitIdentity:
+    """The layout-invariance half of the contract: the SAME run on
+    different mesh shapes produces identical bits (which is what makes
+    'resume on another mesh' meaningful at all)."""
+
+    def test_faulted_telemetry_identical_on_1_4_8_devices(self, golden):
+        base = golden  # the 1-device run
+        for other in (
+            run_ensemble(_model(), **KWARGS, mesh=_mesh(n)) for n in (4, 8)
+        ):
+            assert other.sink_count == base.sink_count
+            assert other.simulated_events == base.simulated_events
+            assert other.sink_mean_latency_s == base.sink_mean_latency_s
+            assert other.server_mean_wait_s == base.server_mean_wait_s
+            assert other.server_utilization == base.server_utilization
+            assert other.timeseries == base.timeseries
+            assert other.blocks_total == base.blocks_total
+            assert other.block_occupancy == base.block_occupancy
+
+    @pytest.mark.slow
+    def test_north_star_scale_bit_identity_65k(self):
+        """The acceptance gate at headline scale: the faulted+telemetry
+        model at 65,536 replicas is bit-identical (counters and every
+        windowed series) between the 1-device and 8-device mesh. Slow —
+        nightly tier."""
+        kwargs = dict(n_replicas=65536, seed=1, max_events=192)
+        single = run_ensemble(_model(), **kwargs, mesh=_mesh(1))
+        sharded = run_ensemble(_model(), **kwargs, mesh=_mesh(8))
+        assert sharded.sink_count == single.sink_count
+        assert sharded.simulated_events == single.simulated_events
+        assert sharded.sink_mean_latency_s == single.sink_mean_latency_s
+        assert sharded.server_mean_wait_s == single.server_mean_wait_s
+        np.testing.assert_array_equal(sharded.sink_hist, single.sink_hist)
+        assert sharded.timeseries == single.timeseries

@@ -332,14 +332,37 @@ class TestSoundDecline:
         # The segmented runner reports its AOT compiles separately.
         assert result.compile_seconds > 0.0
 
-    def test_multi_device_mesh_declines(self, monkeypatch, cpu_mesh):
+    def test_multi_device_mesh_runs_the_kernel_bit_identically(
+        self, monkeypatch, cpu_mesh
+    ):
+        """Mesh-first (ISSUE 13): the 8-device replica mesh no longer
+        declines — the kernel runs per shard under shard_map and the
+        result is bit-identical to the single-device kernel run
+        (counters, floats, AND the occupancy provenance)."""
         monkeypatch.setenv("HS_TPU_PALLAS", "1")
         model, _ = _mm1()
-        result = run_ensemble(
+        sharded = run_ensemble(
             model, n_replicas=8, seed=2, mesh=cpu_mesh, max_events=64
         )
-        assert result.engine_path == "scan"
-        assert "mesh" in result.kernel_decline
+        assert sharded.engine_path == "scan+pallas", sharded.kernel_decline
+        assert sharded.mesh_devices == 8
+        assert sharded.per_shard_replicas == 1
+        single = run_ensemble(
+            model,
+            n_replicas=8,
+            seed=2,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            max_events=64,
+        )
+        assert single.engine_path == "scan+pallas"
+        # Direct comparison: everything the reduce produces matches.
+        assert sharded.simulated_events == single.simulated_events
+        assert sharded.sink_count == single.sink_count
+        assert sharded.sink_mean_latency_s == single.sink_mean_latency_s
+        assert sharded.server_mean_wait_s == single.server_mean_wait_s
+        np.testing.assert_array_equal(sharded.sink_hist, single.sink_hist)
+        assert sharded.blocks_total == single.blocks_total
+        assert sharded.block_occupancy == single.block_occupancy
 
 
 class TestCompileSplit:

@@ -73,6 +73,9 @@ SIM_FIELDS_EXCLUDED = {
     "events_per_second",
     "timeseries",
     "compile_seconds",
+    # resumed runs pay a carry-redistribution transfer; uninterrupted twins
+    # report 0.0 (timing provenance, not simulation state)
+    "redistribution_seconds",
     # Engine-path provenance: the two runs may take different engine
     # routes — the SIMULATION fields are what must match.
     "engine_path",

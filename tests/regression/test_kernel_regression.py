@@ -10,7 +10,10 @@ drift.
 Golden provenance: seed=123, 8 replicas, M/M/1 lam=6 mu=10 horizon=6s
 queue_capacity=16, macro_block=4, max_events=192, recorded on the CPU
 interpret path (which is bit-identical to the compiled TPU kernel by
-construction — the kernel body IS the traced step closure).
+construction — the kernel body IS the traced step closure). The float
+means were re-recorded for ISSUE 13's fixed-point device reduce
+(tpu/reduce.py): values moved ~1e-8 relative, and are now bit-stable
+across every mesh shape.
 """
 
 import numpy as np
@@ -30,10 +33,10 @@ GOLDEN = {
     "server_completed": [323],
     "server_dropped": [0],
     "truncated_replicas": 0,
-    "sink_mean_latency_s": 0.18174977494467154,
+    "sink_mean_latency_s": 0.1817497734683955,
     "sink_p50_s": 0.14125375446227553,
     "sink_p99_s": 0.5623413251903491,
-    "server_mean_wait_s": 0.09317086382610042,
+    "server_mean_wait_s": 0.09317086418954337,
     # Non-empty log-histogram bins (bin index -> count).
     "hist_nonzero": {
         12: 1, 26: 4, 27: 2, 28: 4, 29: 2, 30: 5, 31: 7, 32: 5, 33: 4,
@@ -131,7 +134,7 @@ FAULTED_TEL_GOLDEN = {
     "server_completed": [253, 251],
     "server_fault_dropped": [48, 0],
     "truncated_replicas": 0,
-    "sink_mean_latency_s": 0.18096154809473045,
+    "sink_mean_latency_s": 0.18096155189422972,
     "sink_p99_s": 0.5623413251903491,
     # Per-window sink deliveries and p99(t) — the time-resolved goldens.
     "window_sink_count": [12, 33, 28, 22, 17, 12, 10, 20, 25, 22, 31, 19],

@@ -14,7 +14,10 @@ sink, horizon=6s, per-target edges cycling (0.01 constant, 0.02
 exponential, latency-free), transit_capacity=8, macro_block=4,
 max_events=192, recorded on the CPU interpret path (bit-identical to
 the compiled TPU kernel by construction — the kernel body IS the traced
-step closure). The EXPLICIT max_events keeps both runs on the event
+step closure). The sink means were re-recorded for ISSUE 13's
+fixed-point device reduce (tpu/reduce.py): values moved ~1e-8 relative
+and are now bit-stable across every mesh shape.
+The EXPLICIT max_events keeps both runs on the event
 scan: without it the chain closed form would swallow the constant-edge
 fan-out, and its RNG stream differs from the scan's.
 """
@@ -44,7 +47,7 @@ GOLDENS = {
         "server_completed": [71, 60, 62, 76],
         "transit_dropped": [0, 0, 0, 0],
         "truncated_replicas": 0,
-        "sink_mean_latency_s": 0.0620783270513258,
+        "sink_mean_latency_s": 0.062078327937640225,
         "sink_p50_s": 0.0446683592150963,
         "sink_p99_s": 0.2818382931264455,
         "hist_nonzero": {
@@ -59,7 +62,7 @@ GOLDENS = {
         "server_completed": [83, 79, 78, 76],
         "transit_dropped": [0, 0, 0, 0],
         "truncated_replicas": 0,
-        "sink_mean_latency_s": 0.05875542797619784,
+        "sink_mean_latency_s": 0.05875542759895325,
         "sink_p50_s": 0.0446683592150963,
         "sink_p99_s": 0.1778279410038923,
         "hist_nonzero": {

@@ -106,6 +106,45 @@ def test_blanket_router_decline_removed(monkeypatch):
     assert result.engine_report()["kernel_shape"] == "router"
 
 
+def test_multi_device_mesh_runs_the_kernel(monkeypatch):
+    """ISSUE-13 contract: ">1-device mesh" is no longer a decline
+    reason. The faulted+telemetry canary on the 8-device virtual mesh
+    runs engine_path == "scan+pallas" (shard_map, per-shard tile) when
+    forced, and the mesh provenance reaches engine_report()."""
+    pytest.importorskip("jax.experimental.pallas")
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _faulted_telemetry_mm1(),
+        n_replicas=8,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:8]),
+        max_events=48,
+    )
+    assert result.engine_path == "scan+pallas", result.kernel_decline
+    assert result.kernel_decline == ""
+    report = result.engine_report()["mesh"]
+    assert report["devices"] == 8
+    assert report["per_shard_replicas"] == 1
+    assert report["reduce_path"] == "device-psum-tree"
+
+
+def test_host_mesh_decline_names_the_mesh_first_path(monkeypatch):
+    """The one remaining mesh decline (2-D hosts/replicas) names the
+    1-D mesh-first layout instead of the old single-device-only
+    advice."""
+    from happysim_tpu.tpu.kernels import kernel_decision
+    from happysim_tpu.tpu.mesh import host_replica_mesh
+
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    mesh = host_replica_mesh(jax.devices("cpu")[:8], n_hosts=2)
+    use, note = kernel_decision(
+        _faulted_telemetry_mm1(), mesh=mesh, checkpointing=False, macro=2
+    )
+    assert not use
+    assert "1-D" in note and "replica_mesh" in note
+    assert "single-device" not in note
+
+
 def test_engine_report_names_escape_hatches_on_decline(monkeypatch):
     monkeypatch.setenv("HS_TPU_PALLAS", "1")
     result = run_ensemble(

@@ -618,14 +618,35 @@ class TestKernelDecision:
         )
         assert not use and "checkpoint" in note
 
-    def test_multi_device_mesh_declines(self, monkeypatch):
+    def test_multi_device_replica_mesh_is_approved(self, monkeypatch):
+        """Mesh-first (ISSUE 13): a 1-D multi-device replica mesh no
+        longer declines — the engine shard_maps the kernel with a
+        per-shard tile plan, so single-chip is just mesh.size == 1."""
         from happysim_tpu.tpu.kernels import kernel_decision
 
         monkeypatch.setenv("HS_TPU_PALLAS", "1")
         use, note = kernel_decision(
             _mm1(), mesh=self._mesh(8), checkpointing=False, macro=32
         )
-        assert not use and "mesh" in note
+        assert use and note == ""
+
+    def test_host_replica_mesh_still_declines(self, monkeypatch):
+        """The 2-D hosts/replicas layout is the one mesh shape the
+        kernel does not claim; the decline names the 1-D mesh-first
+        path instead of the old single-device-only advice."""
+        import jax
+
+        from happysim_tpu.tpu.kernels import kernel_decision
+        from happysim_tpu.tpu.mesh import host_replica_mesh
+
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        mesh = host_replica_mesh(jax.devices("cpu")[:8], n_hosts=2)
+        use, note = kernel_decision(
+            _mm1(), mesh=mesh, checkpointing=False, macro=32
+        )
+        assert not use
+        assert "hosts/replicas" in note and "1-D" in note
+        assert "replica_mesh" in note
 
     def test_oversized_macro_block_declines(self, monkeypatch):
         from happysim_tpu.tpu.kernels import kernel_decision

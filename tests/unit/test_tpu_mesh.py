@@ -1,8 +1,11 @@
-"""Edge-case unit tier for tpu/mesh.py (ISSUE 4 satellite).
+"""Edge-case unit tier for tpu/mesh.py (ISSUE 4 satellite; ISSUE 13
+extended it with the partition-rule table tier).
 
 ``pad_to_multiple`` boundary inputs, single-device mesh/sharding
-construction, and the padded-replica truncation accounting
-(``truncated_replicas``) round-tripping through ``run_ensemble``.
+construction, the padded-replica truncation accounting
+(``truncated_replicas``) round-tripping through ``run_ensemble``, and
+the ``STATE_PARTITION_RULES`` table contract: every state leaf of the
+richest model shape gets a placement, unknown leaves fail loudly.
 """
 
 import jax
@@ -11,6 +14,11 @@ import pytest
 from happysim_tpu.tpu.mesh import (
     HOST_AXIS,
     REPLICA_AXIS,
+    STATE_PARTITION_RULES,
+    ensemble_state_shardings,
+    ensemble_state_specs,
+    host_replica_mesh,
+    match_partition_rules,
     pad_to_multiple,
     replica_mesh,
     replica_sharding,
@@ -95,3 +103,91 @@ class TestPaddedTruncationRoundTrip:
         assert result.n_replicas == 8
         assert result.truncated_replicas == 0
         assert result.engine_path == "scan"  # explicit budget skips chain
+
+
+def _rich_state_keys():
+    """State leaf names of the richest compiled shape: a faulted +
+    telemetry + router model (fan-out with a latency edge so the
+    transit registers exist, deadline so the attempt columns exist,
+    packet loss so net_lost exists)."""
+    import jax.numpy as jnp
+
+    from happysim_tpu.tpu.engine import _Compiled
+    from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+    model = EnsembleModel(horizon_s=4.0)
+    src = model.source(rate=4.0)
+    first = model.server(
+        service_mean=0.05,
+        queue_capacity=4,
+        deadline_s=1.0,
+        max_retries=1,
+        fault=FaultSpec(rate=0.1, mean_duration_s=0.2),
+    )
+    second = model.server(service_mean=0.05, queue_capacity=4)
+    router = model.router(policy="round_robin")
+    snk = model.sink()
+    model.connect(src, router)
+    model.connect(router, first, latency_s=0.01)  # -> transit registers
+    model.connect(router, second)
+    model.connect(first, snk, loss_p=0.01)  # -> net_lost
+    model.connect(second, snk)
+    model.telemetry(window_s=1.0)
+    compiled = _Compiled(model)
+    struct = jax.eval_shape(
+        compiled.init_state,
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+        {
+            "src_rate": jax.ShapeDtypeStruct((compiled.nS,), jnp.float32),
+            "srv_mean": jax.ShapeDtypeStruct((compiled.nV,), jnp.float32),
+        },
+    )
+    return tuple(struct)
+
+
+class TestPartitionRules:
+    """The ISSUE-13 partition-rule table: state leaf -> placement via
+    pattern matching, unknown leaves fail LOUDLY (a silent
+    default-to-replicated would duplicate per-replica state onto every
+    device and corrupt the on-device reductions)."""
+
+    def test_every_rich_model_leaf_has_a_rule(self):
+        keys = _rich_state_keys()
+        # The fixture really is the rich shape: faults, telemetry,
+        # transit, attempts, router cursor, and loss all present.
+        for expected in (
+            "flt_start", "tel_sink_count", "tr_time", "srv_q_attempt",
+            "rr_next", "net_lost", "key", "t", "events",
+        ):
+            assert expected in keys, f"fixture lost the {expected} leaf"
+        specs = ensemble_state_specs(keys)
+        assert set(specs) == set(keys)
+        replica_spec = jax.sharding.PartitionSpec(REPLICA_AXIS)
+        assert all(spec == replica_spec for spec in specs.values())
+
+    def test_unknown_leaf_fails_loudly(self):
+        with pytest.raises(ValueError, match="no partition rule matches"):
+            match_partition_rules("mystery_buffer")
+        with pytest.raises(ValueError, match="STATE_PARTITION_RULES"):
+            ensemble_state_specs(("t", "mystery_buffer"))
+
+    def test_rules_name_the_replica_placement(self):
+        # The table itself is all-replica today; the test pins that a
+        # future placement string must be threaded through the builder
+        # (which raises on anything it does not know).
+        assert all(
+            placement == "replica" for _, placement in STATE_PARTITION_RULES
+        )
+
+    def test_host_mesh_spells_both_axes(self):
+        mesh = host_replica_mesh(jax.devices("cpu")[:8], n_hosts=2)
+        specs = ensemble_state_specs(("t", "srv_completed"), mesh)
+        expected = jax.sharding.PartitionSpec((HOST_AXIS, REPLICA_AXIS))
+        assert specs["t"] == expected
+
+    def test_shardings_bind_the_mesh(self):
+        mesh = replica_mesh(jax.devices("cpu")[:4])
+        shardings = ensemble_state_shardings(mesh, ("t", "tel_sink_hist"))
+        for sharding in shardings.values():
+            assert sharding.mesh == mesh
+            assert sharding.spec == jax.sharding.PartitionSpec(REPLICA_AXIS)
