@@ -676,6 +676,201 @@ def bench_kernel_router(devices) -> dict:
     }
 
 
+def bench_kernel_chaos(devices) -> dict:
+    """The ISSUE-14 stack on the fast path: a faulted+resilient+lossy
+    router ρ-sweep (limiter admission -> round_robin fan-out over 4
+    servers with correlated outage-mode faults, backoff+jitter client
+    retries, hedged requests, and 5%-lossy latency edges, 64-window
+    telemetry), fused-kernel vs lax-step A/B. Bit-identity is asserted
+    on the chaos counters (retries, hedges, fault/limiter drops,
+    packet losses) AND on every windowed series — the whole chaos trace
+    must be identical per lane, so a divergence in any chaos branch
+    (a retry re-parking a transit register, a hedge race, a loss
+    Bernoulli slot) cannot hide behind aggregate sink stats. The
+    explicit max_events budget keeps both runs on the event scan.
+    """
+    import jax
+    import numpy as np
+
+    from happysim_tpu.tpu import run_ensemble
+    from happysim_tpu.tpu.kernels import env_override, pallas_available
+    from happysim_tpu.tpu.mesh import replica_mesh
+
+    if not pallas_available():
+        return {
+            "metric": "simulated-events/sec (kernel-path chaos stack)",
+            "skipped": "jax.experimental.pallas unavailable in this jaxlib",
+        }
+
+    from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+    mu = 10.0
+    n_servers = 4
+    n_windows = 64
+
+    def build():
+        model = EnsembleModel(
+            horizon_s=PALLAS_HORIZON_S,
+            transit_capacity=16,
+        )
+        model.macro_block = PALLAS_MACRO_BLOCK
+        src = model.source(rate=9.5)  # swept per replica below
+        # Light-touch admission: refill above the sweep's peak offered
+        # rate, so the bucket rejects bursts without capping the sweep.
+        lim = model.limiter(
+            refill_rate=1.3 * 0.95 * n_servers * mu, capacity=16.0
+        )
+        servers = [
+            model.server(
+                concurrency=1,
+                service_mean=1.0 / mu,
+                queue_capacity=256,
+                max_retries=2,
+                retry_backoff_s=0.02,
+                retry_jitter=0.5,
+                hedge_delay_s=0.3 / mu if index % 2 == 0 else None,
+                fault=FaultSpec(
+                    rate=0.05,
+                    mean_duration_s=0.5,
+                    correlated=True,
+                ),
+            )
+            for index in range(n_servers)
+        ]
+        model.correlated_outages(
+            rate=0.02, mean_duration_s=0.5, trigger_p=0.5
+        )
+        router = model.router(policy="round_robin")
+        snk = model.sink()
+        model.connect(src, lim)
+        model.connect(lim, router)
+        for index, server in enumerate(servers):
+            model.connect(
+                router,
+                server,
+                latency_s=0.005,
+                latency_kind="exponential" if index % 2 else "constant",
+                loss_p=0.05 if index % 2 == 0 else 0.0,
+            )
+            model.connect(server, snk)
+        model.telemetry(window_s=PALLAS_HORIZON_S / n_windows)
+        return model
+
+    # Fleet rho sweep: offered load per server is rate / n_servers.
+    sweeps = {
+        "source_rate": np.linspace(
+            0.1 * n_servers * mu, 0.95 * n_servers * mu, PALLAS_REPLICAS
+        ).astype(np.float32)
+    }
+    # Each job: source fire + transit arrival + completion = 3 events,
+    # plus fault-rejection retries re-crossing transit (max_retries=2).
+    max_events = int(6.0 * 0.95 * n_servers * mu * PALLAS_HORIZON_S) + 64
+    mesh = replica_mesh(jax.devices()[:1])  # 1-shard A/B (kernel is mesh-first)
+
+    def run(pallas: bool):
+        with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+            return run_ensemble(
+                build(),
+                n_replicas=PALLAS_REPLICAS,
+                seed=0,
+                mesh=mesh,
+                sweeps=sweeps,
+                max_events=max_events,
+            )
+
+    lax_r = run(False)
+    kernel_r = run(True)
+    assert kernel_r.engine_path == "scan+pallas", kernel_r.kernel_decline
+    assert kernel_r.kernel_shape == "router"
+    assert lax_r.engine_path == "scan"
+    counter_fields = (
+        "simulated_events",
+        "sink_count",
+        "sink_mean_latency_s",
+        "server_completed",
+        "server_dropped",
+        "server_retried",
+        "server_fault_dropped",
+        "server_fault_retried",
+        "server_hedged",
+        "server_hedge_wins",
+        "transit_dropped",
+        "limiter_admitted",
+        "limiter_dropped",
+        "network_lost",
+    )
+    bit_identical_counters = bool(
+        all(
+            np.array_equal(
+                np.asarray(getattr(lax_r, name)),
+                np.asarray(getattr(kernel_r, name)),
+            )
+            for name in counter_fields
+        )
+        and (
+            np.asarray(lax_r.sink_hist) == np.asarray(kernel_r.sink_hist)
+        ).all()
+    )
+    bit_identical_series = True
+    for name in lax_r.timeseries._ARRAY_FIELDS:
+        lax_series = getattr(lax_r.timeseries, name)
+        kernel_series = getattr(kernel_r.timeseries, name)
+        if lax_series is None:
+            bit_identical_series &= kernel_series is None
+            continue
+        bit_identical_series &= bool(
+            np.array_equal(
+                np.asarray(lax_series),
+                np.asarray(kernel_series),
+                equal_nan=True,
+            )
+        )
+    assert bit_identical_counters and bit_identical_series, (
+        "chaos stack diverged between the Pallas kernel and the lax "
+        "event step — the chaos trace (retry/hedge/loss counters and "
+        "every windowed series) must be bit-identical per lane"
+    )
+    speedup = lax_r.wall_seconds / max(kernel_r.wall_seconds, 1e-9)
+    label = (
+        f"simulated-events/sec (CPU fallback, INTERPRETED kernel, {PALLAS_REPLICAS}-replica chaos-stack LB fan-out rho sweep)"
+        if DEVICE_FALLBACK
+        else f"simulated-events/sec/chip (Pallas kernel, {PALLAS_REPLICAS // 1000}k-replica chaos-stack LB fan-out rho sweep)"
+    )
+    return {
+        "metric": label,
+        "value": round(kernel_r.events_per_second, 0),
+        "unit": "events/sec",
+        "vs_baseline": round(
+            kernel_r.events_per_second / REFERENCE_EVENTS_PER_SEC, 2
+        ),
+        "lax_events_per_sec": round(lax_r.events_per_second, 0),
+        "kernel_vs_lax_speedup": round(speedup, 3),
+        "bit_identical_counters": bit_identical_counters,
+        "bit_identical_series": bit_identical_series,
+        "kernel_shape": kernel_r.kernel_shape,
+        "kernel_chaos": list(kernel_r.kernel_chaos),
+        "n_windows": n_windows,
+        "chaos_totals": {
+            "fault_retried": int(sum(kernel_r.server_fault_retried)),
+            "fault_dropped": int(sum(kernel_r.server_fault_dropped)),
+            "hedged": int(sum(kernel_r.server_hedged)),
+            "hedge_wins": int(sum(kernel_r.server_hedge_wins)),
+            "limiter_dropped": int(sum(kernel_r.limiter_dropped)),
+            "network_lost": int(kernel_r.network_lost),
+        },
+        "macro_block": PALLAS_MACRO_BLOCK,
+        "n_replicas": kernel_r.n_replicas,
+        "horizon_s": kernel_r.horizon_s,
+        "simulated_events": kernel_r.simulated_events,
+        "wall_seconds": round(kernel_r.wall_seconds, 6),
+        "lax_wall_seconds": round(lax_r.wall_seconds, 6),
+        "compile_seconds": round(kernel_r.compile_seconds, 6),
+        "lax_compile_seconds": round(lax_r.compile_seconds, 6),
+        "device": str(devices[0]),
+        "n_devices": len(devices),
+    }
+
+
 def bench_pallas_kernel(devices) -> dict:
     """Fused-kernel vs lax-step A/B on the same M/M/1 event-scan
     workload. The two paths are BIT-IDENTICAL by contract (the kernel
@@ -1065,6 +1260,7 @@ def main() -> int:
     pallas = bench_pallas_kernel(devices)
     ktel = bench_kernel_telemetry(devices)
     krouter = bench_kernel_router(devices)
+    kchaos = bench_kernel_chaos(devices)
     multichip = bench_multichip_mesh(devices)
     if DEVICE_FALLBACK:
         note = "TPU unreachable at bench time; CPU fallback at reduced scale"
@@ -1075,6 +1271,7 @@ def main() -> int:
         pallas["device_fallback"] = note
         ktel["device_fallback"] = note
         krouter["device_fallback"] = note
+        kchaos["device_fallback"] = note
         engine["north_star_ok"] = False  # per-chip target is a TPU claim
     # The general-engine entry stays LAST: trajectory tooling that keys
     # on the final JSON line keeps comparing like with like across rounds.
@@ -1084,6 +1281,7 @@ def main() -> int:
     print(json.dumps(pallas))
     print(json.dumps(ktel))
     print(json.dumps(krouter))
+    print(json.dumps(kchaos))
     print(json.dumps(multichip))
     print(json.dumps(engine))
     return 0

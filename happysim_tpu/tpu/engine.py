@@ -513,6 +513,12 @@ class EnsembleResult:
     # or "router"; "" off the kernel path) — coverage provenance for
     # engine_report() consumers tracking which topology class ran fused.
     kernel_shape: str = ""
+    # The chaos dimension of that shape: which declared chaos/resilience
+    # features (model.chaos_features() names — "faults",
+    # "correlated_outages", "backoff_retries", "hedging", "brownouts",
+    # "packet_loss", "limiters", "telemetry") rode the VMEM tile on the
+    # kernel path. Empty off the kernel path or on a chaos-free model.
+    kernel_chaos: tuple = ()
     # Engine observability (see engine_report()): macro-block length the
     # hot loop ran with (0 on the block-free chain path), the per-run
     # block budget, total macro-blocks actually retired across replicas
@@ -560,6 +566,7 @@ class EnsembleResult:
             "engine_path": self.engine_path,
             "kernel_decline": self.kernel_decline,
             "kernel_shape": self.kernel_shape,
+            "kernel_chaos": tuple(self.kernel_chaos),
             "compile_seconds": self.compile_seconds,
             "run_seconds": self.wall_seconds,
             "events_per_second": self.events_per_second,
@@ -840,7 +847,7 @@ class _Compiled:
         # Attempt numbers ride with jobs whenever anything consumes them
         # (deadline budgets or fault-rejection retry budgets).
         self.has_attempts = self.has_deadlines or self.has_fault_retries
-        self.has_loss = any(e.loss_p > 0.0 for e in _all_edges(model))
+        self.has_loss = any(e.loss_p > 0.0 for e in model.iter_edges())
 
         self.arrival_is_poisson = np.array(
             [s.arrival == "poisson" for s in model.sources], np.bool_
@@ -1109,7 +1116,7 @@ class _Compiled:
         else:
             self.U_ROUTE = None
         if any(
-            e.mean_s > 0 and e.kind == "exponential" for e in _all_edges(self.model)
+            e.mean_s > 0 and e.kind == "exponential" for e in self.model.iter_edges()
         ):
             self.U_LAT: Optional[int] = slot
             slot += 1
@@ -2480,23 +2487,12 @@ def _default_max_events(model: EnsembleModel, sweeps) -> int:
     # covers Poisson variance and queue drain. Backoff retries travel
     # through transit, so they cost the extra hop even on free edges.
     hops_per_server = 2 if (
-        any(e.mean_s > 0 for e in _all_edges(model))
+        any(e.mean_s > 0 for e in model.iter_edges())
         or any(s.retry_backoff_s is not None for s in model.servers)
     ) else 1
     retry_factor = 1 + max((s.max_retries for s in model.servers), default=0)
     events_per_job = 1 + hops_per_server * _max_server_chain(model) * retry_factor
     return int(1.25 * events_per_job * total_jobs) + 64
-
-
-def _all_edges(model: EnsembleModel):
-    for s in model.sources:
-        yield s.latency
-    for v in model.servers:
-        yield v.latency
-    for l in model.limiters:
-        yield l.latency
-    for r in model.routers:
-        yield from r.target_latencies
 
 
 def _blocks_reduce(blocks, n_chunks: int) -> dict:
@@ -2951,6 +2947,11 @@ def run_ensemble(
         logger.info("run_ensemble: %s", kernel_note)
     kernel_padded = 0  # set by the kernel path (edge-padding provenance)
     kernel_shape = kplan[0]["shape"] if use_pallas and kplan[0] else ""
+    # The chaos dimension of the fused shape (engine_report provenance):
+    # which declared chaos features rode the VMEM tile this run.
+    kernel_chaos = (
+        tuple(kplan[0].get("chaos", ())) if use_pallas and kplan[0] else ()
+    )
 
     def replica_halted(state):
         """True once this replica's next event is past the horizon (or
@@ -3328,6 +3329,7 @@ def run_ensemble(
         engine_path="scan+pallas" if use_pallas else "scan",
         kernel_decline=kernel_note,
         kernel_shape=kernel_shape,
+        kernel_chaos=kernel_chaos,
         macro_block=macro,
         max_blocks=n_chunks,
         padded_replicas=kernel_padded or n_replicas,
@@ -3348,6 +3350,7 @@ def _build_result(
     engine_path: str = "scan",
     kernel_decline: str = "",
     kernel_shape: str = "",
+    kernel_chaos: tuple = (),
     macro_block: int = 0,
     max_blocks: int = 0,
     padded_replicas: int = 0,
@@ -3456,6 +3459,7 @@ def _build_result(
         engine_path=engine_path,
         kernel_decline=kernel_decline,
         kernel_shape=kernel_shape,
+        kernel_chaos=tuple(kernel_chaos),
         macro_block=macro_block,
         max_blocks=max_blocks,
         blocks_total=blocks_total,

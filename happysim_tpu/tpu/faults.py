@@ -31,6 +31,16 @@ The schedule is a bounded sample: windows beyond ``max_windows`` per
 replica are never drawn. Size ``max_windows`` above
 ``rate * horizon_s`` (plus a few sigma) or late sim-time runs fault-free
 and the measured duty cycle falls short of :func:`duty_cycle`.
+
+Kernel path: because the window registers are init-time state leaves
+(constant through the run) and :meth:`FaultTable.dark_vector` is pure
+elementwise work inside the traced step closure, the Pallas fused
+kernel claims fault schedules — correlated trigger registers included —
+as ordinary VMEM-tile residents (:func:`happysim_tpu.tpu.kernels.
+kernel_plan` records them under ``plan["chaos"]``; see
+:meth:`~happysim_tpu.tpu.model.EnsembleModel.chaos_features` for the
+full compile-time chaos descriptor the kernel claims feature by
+feature).
 """
 
 from __future__ import annotations

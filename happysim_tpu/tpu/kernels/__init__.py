@@ -18,20 +18,25 @@ construction — results are bit-identical, and ``HS_TPU_PALLAS=0`` /
 Coverage: chain-shaped and M/M/1-shaped models (single source -> server
 chain -> sink) AND single-router load-balancer fan-outs (source ->
 random/round_robin/weighted router -> N servers -> fan-in -> sink, with
-per-target latency edges), including per-server stochastic fault
-schedules and windowed telemetry — the ``(nW, ...)`` telemetry buffers,
-``(nV, W)`` fault registers, and router state (``rr_next`` cursor,
-fan-out queue rings, transit registers) are ordinary state leaves, so
-they ride the VMEM-resident tile and the scatter-adds are the engine's
-own traced accounting sites (the realistic "load-balanced faulted model
-with telemetry on" configuration runs on the fast path). Adaptive
-(least_outstanding) routing, >1 router, mixed router targets, feedback
-loops, limiters, correlated outages, backoff/hedge resilience, packet
-loss, and telemetry shapes that exceed the VMEM tile budget *soundly
-decline* to the lax step via :func:`kernel_plan` /
+per-target latency edges), with the WHOLE chaos stack riding either
+shape: per-server stochastic fault schedules, correlated
+(shared-Bernoulli) outages, backoff+jitter client retries, hedged
+requests, deterministic brownouts, per-edge packet loss, token-bucket
+limiters (pass-through hops on the source->sink path), and windowed
+telemetry. The ``(nW, ...)`` telemetry buffers, ``(nV, W)`` fault and
+``(W_sh,)`` trigger registers, limiter token columns, transit retry
+registers, and router state (``rr_next`` cursor, fan-out queue rings)
+are ordinary state leaves, so they ride the VMEM-resident tile, their
+RNG slots draw from the same fold_in(key, abs-block) uniform chunk as
+the lax path, and the scatter-adds are the engine's own traced
+accounting sites (the realistic "load-balanced resilient model with
+telemetry on" configuration runs on the fast path end to end). Adaptive
+(least_outstanding) routing, >1 router, rate profiles, mixed router
+targets, feedback loops, and register files that exceed the VMEM tile
+budget *soundly decline* to the lax step via :func:`kernel_plan` /
 :func:`kernel_decision` — the same pattern as ``chain.fast_plan`` — so
-correctness never depends on kernel coverage, and every decline names
-the specific feature.
+correctness never depends on kernel coverage, and the decline reason
+carries EVERY offending feature (``;``-joined).
 """
 
 from happysim_tpu.tpu.kernels.event_step import (

@@ -15,7 +15,7 @@ import os
 from contextlib import contextmanager
 from typing import Optional
 
-from happysim_tpu.tpu.model import ROUTER, SERVER, SINK, EnsembleModel
+from happysim_tpu.tpu.model import LIMITER, ROUTER, SERVER, SINK, EnsembleModel
 
 KERNEL_ENV = "HS_TPU_PALLAS"
 
@@ -97,76 +97,147 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
 
     Supported: exactly one source (Poisson or constant arrivals, no rate
     profile) feeding EITHER a chain of FIFO servers (any concurrency,
-    any service family, optional deadlines/immediate retries, per-server
-    stochastic fault schedules — outage OR degrade windows, with or
-    without fault-rejection retries — constant or exponential edges with
-    or without latency) OR a single load-balancing router fanning out
-    over N servers that fan back in at the sink (``random`` /
-    ``round_robin`` / ``weighted`` policies, per-target latency edges of
-    either kind — the router hop's per-lane divergence stays inside the
-    traced step closure the kernel drives, so the ragged work is
-    VMEM-resident), ending at exactly one sink, with or without windowed
-    telemetry: the ``(nW, ...)`` telemetry buffers, the ``(nV, W)``
-    fault registers, the router's ``rr_next`` cursor, and the fan-out's
-    per-server queue rings / transit registers are ordinary state
-    leaves, so they ride the VMEM-resident tile and the kernel's
-    scatter-adds are the engine's own traced accounting sites
-    (bit-identity holds with telemetry on AND off). Remaining declines
-    are per-feature and actionable: adaptive (``least_outstanding``)
-    routing, >1 router, router→sink / mixed targets, feedback loops,
-    server chains behind the fan-out, limiters, correlated
-    (shared-trigger) outages, backoff retries, hedging, deterministic
-    brownout windows, and packet loss — they exercise dynamic gathers
-    and branch shapes the kernel does not claim yet. The decline is
-    SOUND: the caller must run the lax step, never a partial kernel.
-    (Telemetry shapes whose buffers do not fit the VMEM tile budget are
-    declined by :func:`kernel_decision`, which sees the compiled state
-    template.)
+    any service family, optional deadlines/retries, constant or
+    exponential edges with or without latency) OR a single
+    load-balancing router fanning out over N servers that fan back in
+    at the sink (``random`` / ``round_robin`` / ``weighted`` policies,
+    per-target latency edges of either kind — the router hop's per-lane
+    divergence stays inside the traced step closure the kernel drives,
+    so the ragged work is VMEM-resident), ending at exactly one sink —
+    with the WHOLE chaos stack riding along on either shape: windowed
+    telemetry, per-server stochastic fault schedules (outage OR degrade
+    windows), correlated (shared-Bernoulli) outage schedules,
+    backoff+jitter client retries, hedged requests with
+    first-completion-wins, deterministic brownout windows, per-edge
+    packet loss, and token-bucket rate limiters anywhere on the
+    source->sink path (admission is a pass-through hop in the topology
+    walk). Every chaos feature is ordinary per-lane machinery: its
+    state (transit retry registers, hedge race slots, limiter
+    token/window state, ``(nV, W)`` fault and correlated-trigger
+    registers, ``(nW, ...)`` telemetry buffers) is ordinary state
+    leaves riding the VMEM-resident tile, and its RNG slots (retry
+    jitter, hedge service draws, loss Bernoullis) live in the same
+    ``fold_in(key, abs-block)`` uniform chunk the lax path draws — so
+    fusing the step closure fuses the chaos with per-lane bit-identity
+    by construction. The plan records the claimed features as
+    ``plan["chaos"]`` (:meth:`EnsembleModel.chaos_features`).
+
+    Remaining declines are per-feature and actionable — adaptive
+    (``least_outstanding``) routing, >1 router, remotes, rate profiles,
+    router→sink / mixed targets, feedback loops, server chains behind
+    the fan-out — and are COLLECTED: the reason string ``; ``-joins
+    every decline the model hits (first reason first), so a user fixes
+    the model in one pass instead of replaying whack-a-mole. The
+    decline is SOUND: the caller must run the lax step, never a partial
+    kernel. (Register files whose leaves do not fit the VMEM tile
+    budget are declined by :func:`kernel_decision`, which sees the
+    compiled state template and names the offending leaves.)
     """
+    reasons: list[str] = []
     if len(model.routers) > 1:
-        return _decline(
+        reasons.append(
             f"model has {len(model.routers)} routers (kernel supports 1)"
         )
-    if model.limiters:
-        return _decline("model has limiters")
     if model.remotes:
-        return _decline("model has remote egress nodes")
-    if getattr(model, "correlated_faults", None) is not None:
-        return _decline("model has a correlated-outage schedule")
+        reasons.append("model has remote egress nodes")
     if len(model.sources) != 1:
-        return _decline(f"{len(model.sources)} sources (kernel supports 1)")
+        reasons.append(f"{len(model.sources)} sources (kernel supports 1)")
     if len(model.sinks) != 1:
-        return _decline(f"{len(model.sinks)} sinks (kernel supports 1)")
+        reasons.append(f"{len(model.sinks)} sinks (kernel supports 1)")
+    if len(model.sources) == 1:
+        source = model.sources[0]
+        if source.profile is not None and source.profile.kind != "constant":
+            reasons.append("source has a rate profile")
+    plan: Optional[dict] = None
+    # The topology walks need the single source; run them even when
+    # feature reasons were already collected so EVERY decline surfaces.
+    if len(model.sources) == 1:
+        if len(model.routers) == 1:
+            plan = _router_plan(model, reasons)
+        elif not model.routers:
+            plan = _chain_plan(model, reasons)
+    if reasons:
+        # One pass may visit a structure twice (e.g. a repeated fan-out
+        # target re-walks its fan-in): dedupe, first occurrence first —
+        # message-pinning tests key on the leading reason.
+        return _decline("; ".join(dict.fromkeys(reasons)))
+    if plan is None:  # pragma: no cover - every walk above records a reason
+        return _decline("unsupported topology")
+    plan["chaos"] = model.chaos_features()
+    return plan, ""
+
+
+def _follow_limiters(
+    model: EnsembleModel, ref, visited: list[int], reasons: list[str]
+):
+    """Resolve a downstream ref through any token-bucket limiters.
+
+    Limiter admission is an inline pass-through in the compiled step
+    (``_through_limiter``: refill, admit-or-drop, deliver), so the
+    topology walks treat limiters as transparent hops. Visited limiter
+    indices accumulate in ``visited`` so the caller can detect limiters
+    outside the walked path; cycle detection is per-walk (a limiter
+    SHARED by several fan-in edges is legal and must not read as a
+    loop) and records a reason before resolving to ``None``."""
+    walk: set[int] = set()
+    while ref is not None and ref.kind == LIMITER:
+        if ref.index in walk:  # unreachable via connect(), which forbids
+            # limiter->limiter edges — guards hand-mutated specs.
+            reasons.append(f"limiter[{ref.index}] is on a feedback loop")
+            return None
+        walk.add(ref.index)
+        if ref.index not in visited:
+            visited.append(ref.index)
+        ref = model.limiters[ref.index].downstream
+    return ref
+
+
+def _limiters_outside(
+    model: EnsembleModel, visited: list[int], reasons: list[str]
+) -> None:
+    for index in range(len(model.limiters)):
+        if index not in visited:
+            reasons.append(
+                f"limiter[{index}] is outside the source->sink path"
+            )
+
+
+def _chain_plan(
+    model: EnsembleModel, reasons: list[str]
+) -> Optional[dict]:
+    """The linear source -> (limiter?) -> server chain -> sink shape.
+
+    Appends every structural decline to ``reasons`` (the caller joins);
+    returns the plan dict only when this walk added none."""
+    before = len(reasons)
     source = model.sources[0]
-    if source.profile is not None and source.profile.kind != "constant":
-        return _decline("source has a rate profile")
-    for index, server in enumerate(model.servers):
-        label = f"server[{index}]"
-        if server.hedge_delay_s is not None:
-            return _decline(f"{label} hedges requests")
-        if server.retry_backoff_s is not None:
-            return _decline(f"{label} retries with backoff")
-        if server.outage_start_s is not None:
-            return _decline(f"{label} has a brownout window")
-    for origin, edge in _edges(model):
-        if edge.loss_p > 0.0:
-            return _decline(f"{origin} edge carries packet loss")
-    if model.routers:
-        return _router_plan(model)
-    # The topology must be a single linear chain ending at the sink.
+    limiters: list[int] = []
     seen: list[int] = []
-    ref = source.downstream
+    ref = _follow_limiters(model, source.downstream, limiters, reasons)
     while ref is not None and ref.kind == SERVER:
         if ref.index in seen:
-            return _decline("server chain has a feedback loop")
+            reasons.append("server chain has a feedback loop")
+            break
         seen.append(ref.index)
-        ref = model.servers[ref.index].downstream
-    if ref is None or ref.kind != SINK:
-        return _decline("source path does not end at a sink")
-    if len(seen) != len(model.servers):
-        return _decline("servers outside the source->sink chain")
+        ref = _follow_limiters(
+            model, model.servers[ref.index].downstream, limiters, reasons
+        )
+    # A loop/limiter failure above already appended its reason, so this
+    # guard doubles as "the walk itself stayed clean".
+    if len(reasons) == before and (ref is None or ref.kind != SINK):
+        reasons.append("source path does not end at a sink")
+    # Membership checks only when the walk itself succeeded: a broken
+    # walk reaches fewer nodes by definition, and reporting that
+    # shortfall as a second problem would send the user chasing a
+    # phantom (every surfaced reason must be independently actionable).
+    if len(reasons) == before:
+        if len(seen) != len(model.servers):
+            reasons.append("servers outside the source->sink chain")
+        _limiters_outside(model, limiters, reasons)
+    if len(reasons) > before:
+        return None
     shape = "mm1" if len(seen) == 1 else "chain"
-    return {"shape": shape, "servers": seen}, ""
+    return {"shape": shape, "servers": seen}
 
 
 # Router policies whose choice is a pure function of (uniform draw,
@@ -176,57 +247,73 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
 KERNEL_ROUTER_POLICIES = ("random", "round_robin", "weighted")
 
 
-def _router_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
-    """The load-balancer fan-out shape: 1 source -> router -> N servers
-    -> fan-in -> 1 sink, with per-target latency edges. Everything this
-    helper declines names the specific router feature (not a blanket
-    "model has routers"), so the remaining decline list is actionable.
-    """
+def _router_plan(
+    model: EnsembleModel, reasons: list[str]
+) -> Optional[dict]:
+    """The load-balancer fan-out shape: 1 source -> (limiter?) -> router
+    -> N servers -> fan-in -> 1 sink, with per-target latency edges of
+    either kind (lossy ones included — the loss Bernoulli is an
+    ordinary RNG slot). Every structural decline names the specific
+    router feature (not a blanket "model has routers") and is APPENDED
+    rather than returned, so a model with several problems surfaces all
+    of them at once; the plan dict comes back only when this walk added
+    no reasons."""
+    before = len(reasons)
     router = model.routers[0]
     source = model.sources[0]
-    if source.downstream is None or source.downstream.kind != ROUTER:
-        return _decline("router is not fed directly by the source")
+    limiters: list[int] = []
+    fed = _follow_limiters(model, source.downstream, limiters, reasons)
+    fed_ok = fed is not None and fed.kind == ROUTER
+    if not fed_ok:
+        reasons.append("router is not fed by the source")
     if router.policy not in KERNEL_ROUTER_POLICIES:
         # No nested parens: _decline wraps the reason in its own pair.
-        return _decline(
+        reasons.append(
             f"router policy {router.policy!r} is adaptive — kernel supports "
             + "/".join(KERNEL_ROUTER_POLICIES)
         )
+    # Reasons from here down are STRUCTURAL (they change which nodes
+    # the walk can reach); the policy check above is orthogonal and
+    # must not suppress the membership checks below.
+    structure_before = len(reasons)
     kinds = {t.kind for t in router.targets}
     if kinds == {SERVER, SINK}:
-        return _decline(
+        reasons.append(
             "router has mixed sink/server targets (probabilistic exits)"
         )
-    if SINK in kinds:
-        return _decline("router targets only sinks (no server fan-out)")
-    servers = [t.index for t in router.targets]
+    elif SINK in kinds:
+        reasons.append("router targets only sinks (no server fan-out)")
+    servers = [t.index for t in router.targets if t.kind == SERVER]
     if len(set(servers)) != len(servers):
-        return _decline("router fan-out repeats a server target")
-    for index in servers:
-        down = model.servers[index].downstream
+        reasons.append("router fan-out repeats a server target")
+    for index in dict.fromkeys(servers):
+        down = _follow_limiters(
+            model, model.servers[index].downstream, limiters, reasons
+        )
         if down is not None and down.kind == ROUTER:
-            return _decline(
+            reasons.append(
                 f"server[{index}] feeds back into the router (feedback loop)"
             )
-        if down is not None and down.kind == SERVER:
-            return _decline(
+        elif down is not None and down.kind == SERVER:
+            reasons.append(
                 f"server[{index}] chains to another server behind the router"
             )
-        if down is None or down.kind != SINK:
-            return _decline(f"server[{index}] fan-in does not end at the sink")
-    if len(servers) != len(model.servers):
-        return _decline("servers outside the router fan-out")
-    return {"shape": "router", "servers": servers, "policy": router.policy}, ""
-
-
-def _edges(model: EnsembleModel):
-    for i, s in enumerate(model.sources):
-        yield f"source[{i}]", s.latency
-    for i, v in enumerate(model.servers):
-        yield f"server[{i}]", v.latency
-    for i, r in enumerate(model.routers):
-        for j, edge in enumerate(r.target_latencies):
-            yield f"router[{i}].target[{j}]", edge
+        elif down is None or down.kind != SINK:
+            reasons.append(
+                f"server[{index}] fan-in does not end at the sink"
+            )
+    # Membership checks only when the feed AND every structural walk
+    # above succeeded: a broken walk reaches fewer nodes by definition,
+    # and reporting that shortfall as extra problems would send the
+    # user chasing phantoms (every surfaced reason must be
+    # independently actionable — same discipline as _chain_plan).
+    if fed_ok and len(reasons) == structure_before:
+        if len(set(servers)) != len(model.servers):
+            reasons.append("servers outside the router fan-out")
+        _limiters_outside(model, limiters, reasons)
+    if len(reasons) > before:
+        return None
+    return {"shape": "router", "servers": servers, "policy": router.policy}
 
 
 def kernel_decision(
@@ -301,11 +388,28 @@ def kernel_decision(
     if compiled is not None:
         from happysim_tpu.tpu.kernels.event_step import (
             VMEM_TILE_BUDGET_BYTES,
+            replica_tile_bytes,
             replica_working_set_bytes,
+            state_template,
         )
 
-        per_replica = replica_working_set_bytes(compiled, macro)
+        template = state_template(compiled)
+        per_replica = replica_working_set_bytes(compiled, macro, template)
         if per_replica > VMEM_TILE_BUDGET_BYTES:
+            # Name the leaves that dominate the working set: a budget
+            # decline must tell the user WHICH state to shrink (drop
+            # transit_capacity, coarsen telemetry windows, trim queue
+            # capacity) — not just that some total is too big.
+            sizes = sorted(
+                (
+                    (replica_tile_bytes([leaf]), name)
+                    for name, leaf in template.items()
+                ),
+                reverse=True,
+            )
+            top = ", ".join(
+                f"{name} {nbytes} B" for nbytes, name in sizes[:3]
+            )
             telemetry_note = (
                 f" (telemetry nW={compiled.nW} windows — grow window_s "
                 "or trim TelemetrySpec.metrics)"
@@ -314,9 +418,9 @@ def kernel_decision(
             )
             return False, (
                 f"per-replica VMEM working set {per_replica} B exceeds the "
-                f"{VMEM_TILE_BUDGET_BYTES} B tile budget even at "
-                f"tile=1{telemetry_note}; lax event step ran "
-                f"({KERNEL_ENV} cannot override a budget decline)"
+                f"{VMEM_TILE_BUDGET_BYTES} B tile budget even at tile=1 — "
+                f"largest state leaves: {top}{telemetry_note}; lax event "
+                f"step ran ({KERNEL_ENV} cannot override a budget decline)"
             )
     if mode == "auto" and kernel_interpret_mode():
         return False, (

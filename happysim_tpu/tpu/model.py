@@ -778,16 +778,61 @@ class EnsembleModel:
                     "target before running, or pass targets to router())"
                 )
 
+    def iter_edges(self):
+        """Every latency-carrying edge spec in the model (source, server,
+        and limiter downstream edges plus router per-target edges) — the
+        one edge enumeration shared by the engine's loss gating and the
+        kernel's chaos descriptor."""
+        for s in self.sources:
+            yield s.latency
+        for v in self.servers:
+            yield v.latency
+        for l in self.limiters:
+            yield l.latency
+        for r in self.routers:
+            yield from r.target_latencies
+
+    def chaos_features(self) -> tuple[str, ...]:
+        """Compile-time descriptor of the chaos/resilience features this
+        model declares, as stable feature names. This is the "chaos
+        dimension" the Pallas kernel claims feature by feature: every
+        name here maps to state leaves (transit retry registers, hedge
+        race slots, limiter token/window state, fault-window and
+        correlated-trigger registers, loss counters) and RNG slots that
+        ride the VMEM tile, and ``kernel_plan`` records the tuple on its
+        plan so ``EnsembleResult.engine_report()`` can say exactly which
+        chaos machinery ran fused."""
+        features: list[str] = []
+        if any(s.fault is not None for s in self.servers):
+            features.append("faults")
+        if self.correlated_faults is not None:
+            features.append("correlated_outages")
+        if any(s.retry_backoff_s is not None for s in self.servers):
+            features.append("backoff_retries")
+        if any(s.hedge_delay_s is not None for s in self.servers):
+            features.append("hedging")
+        if any(s.outage_start_s is not None for s in self.servers):
+            features.append("brownouts")
+        if any(e.loss_p > 0.0 for e in self.iter_edges()):
+            features.append("packet_loss")
+        if self.limiters:
+            features.append("limiters")
+        if self.telemetry_spec is not None:
+            features.append("telemetry")
+        return tuple(features)
+
     def kernel_supported(self) -> tuple[bool, str]:
         """Whether the fused Pallas event-step kernel claims this
         topology (chain-shaped / M/M/1-shaped / single-router
-        load-balancer fan-outs with static policies; see tpu/kernels/).
+        load-balancer fan-outs with static policies, with the whole
+        chaos stack — retries, hedging, outages, brownouts, packet
+        loss, limiters — riding the VMEM tile; see tpu/kernels/).
 
         Returns ``(supported, reason)``; the reason is "" when supported
-        and otherwise names the declining feature plus the
-        ``HS_TPU_PALLAS`` escape hatch. Unsupported models always run
-        the (bit-identical contract aside) general lax event step — the
-        kernel never partially engages.
+        and otherwise names EVERY declining feature (``; ``-joined) plus
+        the ``HS_TPU_PALLAS`` escape hatch. Unsupported models always
+        run the (bit-identical contract aside) general lax event step —
+        the kernel never partially engages.
         """
         from happysim_tpu.tpu.kernels.support import kernel_plan
 

@@ -104,6 +104,27 @@ def _assert_matches_golden(resumed, golden):
     assert resumed.timeseries == golden.timeseries
 
 
+def _assert_series_close(series, base, rtol=1e-6):
+    """Windowed-series comparison across DIFFERENT compiled programs
+    (per-shard kernel tile plans): integer series must stay exact —
+    counters never pick up FMA noise — while float series (means,
+    integrals, percentile estimates) are held to float32 resolution."""
+    for name in base._ARRAY_FIELDS:
+        expected = getattr(base, name)
+        actual = getattr(series, name)
+        if expected is None:
+            assert actual is None, name
+            continue
+        expected = np.asarray(expected)
+        actual = np.asarray(actual)
+        if np.issubdtype(expected.dtype, np.integer):
+            np.testing.assert_array_equal(actual, expected, err_msg=name)
+        else:
+            np.testing.assert_allclose(
+                actual, expected, rtol=rtol, equal_nan=True, err_msg=name
+            )
+
+
 class TestReshardingResume:
     def test_1_to_8_device_resume_lands_on_the_golden(
         self, golden, snap_1dev, tmp_path
@@ -198,12 +219,36 @@ class TestMeshBitIdentity:
         ):
             assert other.sink_count == base.sink_count
             assert other.simulated_events == base.simulated_events
-            assert other.sink_mean_latency_s == base.sink_mean_latency_s
-            assert other.server_mean_wait_s == base.server_mean_wait_s
-            assert other.server_utilization == base.server_utilization
-            assert other.timeseries == base.timeseries
             assert other.blocks_total == base.blocks_total
             assert other.block_occupancy == base.block_occupancy
+            if other.engine_path == "scan+pallas":
+                # Under the CI gate's forced HS_TPU_PALLAS=1 each mesh
+                # shape compiles a DIFFERENT kernel program (the tile
+                # plan is per shard), and XLA contracts FMAs per
+                # program — so float accumulators agree to float32
+                # resolution only (the same measured caveat CHANGES
+                # records for cross-PATH floats); integer counters and
+                # series stay exact, asserted above and in
+                # _assert_series_close.
+                rel = 1e-6
+                assert other.sink_mean_latency_s == pytest.approx(
+                    base.sink_mean_latency_s, rel=rel
+                )
+                assert other.server_mean_wait_s == pytest.approx(
+                    base.server_mean_wait_s, rel=rel
+                )
+                assert other.server_utilization == pytest.approx(
+                    base.server_utilization, rel=rel
+                )
+                _assert_series_close(other.timeseries, base.timeseries)
+            else:
+                # The lax path is ONE program sharded over the mesh:
+                # the device psum-tree reduce makes every float
+                # bit-identical across mesh shapes.
+                assert other.sink_mean_latency_s == base.sink_mean_latency_s
+                assert other.server_mean_wait_s == base.server_mean_wait_s
+                assert other.server_utilization == base.server_utilization
+                assert other.timeseries == base.timeseries
 
     @pytest.mark.slow
     def test_north_star_scale_bit_identity_65k(self):

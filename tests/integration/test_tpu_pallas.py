@@ -285,34 +285,53 @@ class TestCheckpointResumeUnderKernelTelemetry:
 
 
 class TestSoundDecline:
-    def test_correlated_outages_decline_to_lax(self, monkeypatch):
-        """Per-server fault schedules ride the kernel now; the SHARED
-        correlated-outage trigger still declines (soundly, to the lax
-        step, with the reason surfaced)."""
+    def test_correlated_outages_run_the_kernel_bit_identically(
+        self, monkeypatch
+    ):
+        """ISSUE 14: the SHARED correlated-outage trigger no longer
+        declines — the ``(W_sh,)`` trigger registers are init-time state
+        leaves riding the tile like the per-server fault windows, so the
+        correlated model runs scan+pallas bit-identical to the lax
+        step."""
+        from happysim_tpu.tpu.kernels import env_override
         from happysim_tpu.tpu.model import FaultSpec
 
-        model = EnsembleModel(horizon_s=2.0, macro_block=MACRO)
-        src = model.source(rate=4.0)
-        srv = model.server(
-            service_mean=0.05,
-            queue_capacity=8,
-            fault=FaultSpec(rate=0.5, mean_duration_s=0.2, correlated=True),
-        )
-        snk = model.sink()
-        model.connect(src, srv)
-        model.connect(srv, snk)
-        model.correlated_outages(rate=0.2, mean_duration_s=0.5)
-        monkeypatch.setenv("HS_TPU_PALLAS", "1")
-        result = run_ensemble(
-            model,
-            n_replicas=4,
-            seed=3,
-            mesh=replica_mesh(jax.devices("cpu")[:1]),
-            max_events=96,
-        )
-        assert result.engine_path == "scan"
-        assert "correlated" in result.kernel_decline
-        assert "HS_TPU_PALLAS" in result.kernel_decline
+        def build():
+            model = EnsembleModel(horizon_s=2.0, macro_block=MACRO)
+            src = model.source(rate=4.0)
+            srv = model.server(
+                service_mean=0.05,
+                queue_capacity=8,
+                fault=FaultSpec(
+                    rate=0.5, mean_duration_s=0.2, correlated=True
+                ),
+            )
+            snk = model.sink()
+            model.connect(src, srv)
+            model.connect(srv, snk)
+            model.correlated_outages(rate=0.2, mean_duration_s=0.5)
+            return model
+
+        def run(pallas: bool):
+            with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+                return run_ensemble(
+                    build(),
+                    n_replicas=4,
+                    seed=3,
+                    mesh=replica_mesh(jax.devices("cpu")[:1]),
+                    max_events=96,
+                )
+
+        kernel_r = run(True)
+        assert kernel_r.engine_path == "scan+pallas", kernel_r.kernel_decline
+        assert kernel_r.kernel_decline == ""
+        assert "correlated_outages" in kernel_r.kernel_chaos
+        lax_r = run(False)
+        assert lax_r.engine_path == "scan"
+        assert kernel_r.simulated_events == lax_r.simulated_events
+        assert kernel_r.sink_count == lax_r.sink_count
+        assert kernel_r.server_fault_dropped == lax_r.server_fault_dropped
+        assert kernel_r.sink_mean_latency_s == lax_r.sink_mean_latency_s
 
     def test_checkpointing_declines_to_segmented_scan(self, monkeypatch):
         monkeypatch.setenv("HS_TPU_PALLAS", "1")

@@ -78,6 +78,108 @@ def test_removed_decline_reasons_no_longer_appear(monkeypatch):
     assert result.timeseries is not None
 
 
+def _chaos_mm1():
+    """The tier-1 chaos canary (ISSUE 14): every chaos feature on the
+    SMALLEST kernel shape — one server with a correlated fault schedule,
+    backoff+jitter retries, hedging, and a brownout window, behind a
+    token-bucket limiter over a lossy edge, with windowed telemetry.
+    Chain-shaped so the interpret-mode compile stays cheap enough for
+    tier-1; the fan-out chaos matrix lives in the slow-marked tiers."""
+    from happysim_tpu.tpu.model import FaultSpec
+
+    model = EnsembleModel(horizon_s=2.0, macro_block=2, transit_capacity=4)
+    src = model.source(rate=5.0)
+    lim = model.limiter(refill_rate=8.0, capacity=4.0)
+    srv = model.server(
+        service_mean=0.1,
+        queue_capacity=8,
+        deadline_s=0.8,
+        max_retries=2,
+        retry_backoff_s=0.05,
+        retry_jitter=0.5,
+        hedge_delay_s=0.25,
+        fault=FaultSpec(rate=0.5, mean_duration_s=0.3, correlated=True),
+        outage=(1.0, 1.3),
+    )
+    model.correlated_outages(rate=0.3, mean_duration_s=0.3, trigger_p=0.5)
+    snk = model.sink()
+    model.connect(src, lim)
+    model.connect(lim, srv, loss_p=0.05)
+    model.connect(srv, snk)
+    model.telemetry(window_s=0.5)
+    return model
+
+
+ALL_CHAOS = (
+    "faults",
+    "correlated_outages",
+    "backoff_retries",
+    "hedging",
+    "brownouts",
+    "packet_loss",
+    "limiters",
+    "telemetry",
+)
+
+
+def test_chaos_stack_decline_removed(monkeypatch):
+    """ISSUE-14 contract: limiters, correlated outages, backoff
+    retries, hedging, brownouts, and packet loss are no longer decline
+    reasons — the whole chaos stack runs engine_path == "scan+pallas"
+    when the kernel is forced, and the chaos dimension reaches
+    engine_report()."""
+    pytest.importorskip("jax.experimental.pallas")
+    from happysim_tpu.tpu.kernels import kernel_plan
+
+    plan, reason = kernel_plan(_chaos_mm1())
+    assert plan is not None and reason == ""
+    assert plan["chaos"] == ALL_CHAOS
+
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _chaos_mm1(),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=48,
+    )
+    assert result.engine_path == "scan+pallas", result.kernel_decline
+    assert result.kernel_decline == ""
+    assert result.kernel_shape == "mm1"
+    assert result.kernel_chaos == ALL_CHAOS
+    assert result.engine_report()["kernel_chaos"] == ALL_CHAOS
+    assert result.timeseries is not None
+
+
+def test_kernel_decline_surfaces_every_reason(monkeypatch):
+    """ISSUE-14 satellite: EnsembleResult.kernel_decline carries the
+    FULL decline list (``; ``-joined, first reason first), not just the
+    first reason hit."""
+    from happysim_tpu.tpu.model import RateProfile
+
+    model = _router_model()  # least_outstanding: adaptive, declines
+    model.sources[0].profile = RateProfile(
+        kind="ramp", end_rate=9.0, ramp_duration_s=0.5
+    )
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        model,
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=32,
+    )
+    assert result.engine_path == "scan"
+    decline = result.kernel_decline
+    assert "rate profile" in decline and "least_outstanding" in decline
+    # One joined list: the profile reason precedes the policy reason,
+    # separated by the "; " joiner inside one decline note.
+    assert decline.index("rate profile") < decline.index("least_outstanding")
+    assert "; " in decline.split("(", 1)[1]
+    assert "HS_TPU_PALLAS" in decline
+    assert result.kernel_chaos == ()
+
+
 def test_blanket_router_decline_removed(monkeypatch):
     """ISSUE-11 contract: "model has routers" is no longer a decline
     reason. A random-policy load-balancer fan-out is kernel-approved and

@@ -107,6 +107,56 @@ def _router_faulted_telemetry():
     return model
 
 
+def _chaos_fanout():
+    """ISSUE 14's whole chaos stack in one tile: limiter admission,
+    backoff+jitter client retries, hedged requests, correlated
+    (shared-Bernoulli) outages, a deterministic brownout window, and
+    per-target packet loss on top of the faulted+telemetry fan-out —
+    every remaining chaos decline flipped to approved, block-identical
+    by the same argument (the chaos machinery lives inside the traced
+    step closure the kernel drives)."""
+    model = EnsembleModel(horizon_s=2.0, transit_capacity=8)
+    src = model.source(rate=6.0)
+    lim = model.limiter(refill_rate=8.0, capacity=4.0)
+    servers = []
+    for index in range(4):
+        servers.append(
+            model.server(
+                service_mean=0.05,
+                queue_capacity=8,
+                deadline_s=0.6,
+                max_retries=2,
+                retry_backoff_s=0.05,
+                retry_jitter=0.5,
+                hedge_delay_s=0.15 if index % 2 == 0 else None,
+                fault=FaultSpec(
+                    rate=0.4, mean_duration_s=0.3, correlated=True
+                )
+                if index < 2
+                else None,
+                outage=(0.8, 1.1) if index == 3 else None,
+            )
+        )
+    model.correlated_outages(rate=0.3, mean_duration_s=0.3, trigger_p=0.5)
+    router = model.router(policy="round_robin")
+    snk = model.sink()
+    model.connect(src, lim)
+    model.connect(lim, router)
+    edge_mix = [(0.01, "constant"), (0.02, "exponential"), (0.0, "constant")]
+    for index, server in enumerate(servers):
+        latency_s, kind = edge_mix[index % len(edge_mix)]
+        model.connect(
+            router,
+            server,
+            latency_s=latency_s,
+            latency_kind=kind,
+            loss_p=0.05 if index % 2 == 0 else 0.0,
+        )
+        model.connect(server, snk)
+    model.telemetry(window_s=0.5)
+    return model
+
+
 def _init_batch(compiled, n_replicas, seed=0):
     keys = jax.random.split(jax.random.PRNGKey(seed), n_replicas)
     params = {
@@ -143,19 +193,21 @@ def _lax_block(compiled, horizon, state, U, params):
 MACRO = 2
 
 
-# Six topologies: the transit chain exercises the superset of the base
-# state leaves (two servers, erlang family, transit registers) WITHOUT
-# telemetry, and the faulted+telemetry chain adds the fault registers +
-# windowed buffers — so bit-identity is asserted with telemetry off AND
-# on at block level. The router fan-outs (ISSUE 11) cover all three
-# kernel-approved policies over mixed per-target edges, and the
-# faulted+telemetry fan-out pins the full load-balanced production
-# register file in one tile; they are slow-marked (each 4-server build
-# is ~20-35s of interpret-mode XLA, beyond the tier-1 envelope) and run
-# in the CI kernel-equivalence gate + the nightly tier instead. The
-# M/M/1 shape gets block-level coverage from the consecutive-blocks
-# test below and full-run coverage from the integration + regression
-# tiers.
+# Seven topologies: the transit chain exercises the superset of the
+# base state leaves (two servers, erlang family, transit registers)
+# WITHOUT telemetry, and the faulted+telemetry chain adds the fault
+# registers + windowed buffers — so bit-identity is asserted with
+# telemetry off AND on at block level. The router fan-outs (ISSUE 11)
+# cover all three kernel-approved policies over mixed per-target edges,
+# the faulted+telemetry fan-out pins the full load-balanced production
+# register file in one tile, and the chaos fan-out (ISSUE 14) layers
+# the whole resilience stack on top (limiter, backoff retries, hedging,
+# correlated outages, brownout, packet loss); they are slow-marked
+# (each 4-server build is ~20-35s of interpret-mode XLA, beyond the
+# tier-1 envelope) and run in the CI kernel-equivalence gate + the
+# nightly tier instead. The M/M/1 shape gets block-level coverage from
+# the consecutive-blocks test below and full-run coverage from the
+# integration + regression tiers.
 @pytest.mark.parametrize(
     "build",
     [
@@ -165,6 +217,7 @@ MACRO = 2
         pytest.param(_router_round_robin, marks=pytest.mark.slow),
         pytest.param(_router_weighted, marks=pytest.mark.slow),
         pytest.param(_router_faulted_telemetry, marks=pytest.mark.slow),
+        pytest.param(_chaos_fanout, marks=pytest.mark.slow),
     ],
 )
 def test_block_kernel_bit_identical_to_lax_scan(build):
@@ -368,14 +421,20 @@ class TestVmemBudgetSizing:
         assert not use
         assert "VMEM" in note and "budget" in note and "tile=1" in note
         assert "nW=64" in note  # the decline names the telemetry shape
+        # ...and the offending leaves, biggest first, with their bytes
+        # (the 64-window latency histogram dominates this shape).
+        assert "largest state leaves" in note
+        assert "tel_sink_hist" in note and "B" in note
 
 
 class TestDeclinePredicate:
     def test_mm1_and_chain_are_supported(self):
         plan, reason = kernel_plan(_mm1())
-        assert plan == {"shape": "mm1", "servers": [0]} and reason == ""
+        assert plan == {"shape": "mm1", "servers": [0], "chaos": ()}
+        assert reason == ""
         plan, reason = kernel_plan(_chain_with_transit())
-        assert plan == {"shape": "chain", "servers": [0, 1]} and reason == ""
+        assert plan == {"shape": "chain", "servers": [0, 1], "chaos": ()}
+        assert reason == ""
 
     def test_deadline_retry_chain_is_supported(self):
         model = EnsembleModel(horizon_s=5.0)
@@ -391,10 +450,11 @@ class TestDeclinePredicate:
         "mutate, fragment",
         [
             (lambda m: m.router(targets=[]), "router"),
-            (lambda m: m.limiter(refill_rate=5.0, capacity=5.0), "limiter"),
+            # An orphan limiter (never wired into the source->sink
+            # path) still declines — WIRED limiters are approved.
             (
-                lambda m: m.correlated_outages(rate=0.1, mean_duration_s=1.0),
-                "correlated",
+                lambda m: m.limiter(refill_rate=5.0, capacity=5.0),
+                "limiter[0] is outside",
             ),
             (lambda m: m.sink(), "sinks"),
             (
@@ -412,6 +472,30 @@ class TestDeclinePredicate:
         # Every decline names the engine path that ran and its flag.
         assert "HS_TPU_PALLAS" in reason and "lax" in reason
 
+    def test_decline_collects_every_reason(self):
+        """ISSUE 14 satellite: the decline surfaces the FULL reason
+        list (``; ``-joined, first reason first), so a user fixes the
+        model in one pass instead of replaying whack-a-mole."""
+        from happysim_tpu.tpu.model import RateProfile
+
+        model = _router_fanout("least_outstanding")
+        model.sources[0].profile = RateProfile(
+            kind="ramp", end_rate=9.0, ramp_duration_s=1.0
+        )
+        model.sink()  # second sink: a third independent reason
+        plan, reason = kernel_plan(model)
+        assert plan is None
+        inner = reason.split("(", 1)[1].rsplit(");", 1)[0]
+        parts = inner.split("; ")
+        assert len(parts) == 3, parts
+        # Structural counts lead, then the profile, then the policy —
+        # and the joined order is stable for message pinning.
+        assert "sinks" in parts[0]
+        assert "rate profile" in parts[1]
+        assert "least_outstanding" in parts[2] and "adaptive" in parts[2]
+        # The flag note appears ONCE, after the joined list.
+        assert reason.count("HS_TPU_PALLAS") == 2  # =1 forces / =0 silences
+
     def test_telemetry_and_faulted_chains_are_supported(self):
         """The two PR-6 removals: "model has windowed telemetry" and
         "has a stochastic fault schedule" are no longer decline reasons
@@ -422,34 +506,66 @@ class TestDeclinePredicate:
         assert plan is not None and reason == ""
 
         plan, reason = kernel_plan(_faulted_telemetry_chain())
-        assert plan == {"shape": "chain", "servers": [0, 1]} and reason == ""
+        assert plan == {
+            "shape": "chain",
+            "servers": [0, 1],
+            "chaos": ("faults", "telemetry"),
+        }
+        assert reason == ""
 
-    def test_declines_resilient_chaos_servers(self):
-        """Fault schedules ride the kernel, but the RESILIENCE semantics
-        (backoff retries, hedging) still decline — their dynamic branch
-        shapes are not claimed yet."""
+    def test_resilient_chaos_servers_are_supported(self):
+        """ISSUE 14: the resilience semantics (backoff retries, hedging,
+        correlated outages, brownouts) no longer decline — their state
+        (transit retry registers, hedge race slots, trigger draws) rides
+        the VMEM tile and their RNG slots live in the shared uniform
+        chunk, so the traced step closure fuses them like any other
+        per-lane work."""
         model = EnsembleModel(horizon_s=5.0)
         src = model.source(rate=4.0)
         srv = model.server(
             service_mean=0.1,
-            fault=FaultSpec(rate=0.05, mean_duration_s=0.5),
+            fault=FaultSpec(rate=0.05, mean_duration_s=0.5, correlated=True),
             retry_backoff_s=0.1,
             max_retries=2,
+            hedge_delay_s=0.3,
+            outage=(1.0, 2.0),
         )
+        model.correlated_outages(rate=0.1, mean_duration_s=1.0)
         snk = model.sink()
         model.connect(src, srv)
         model.connect(srv, snk)
         plan, reason = kernel_plan(model)
-        assert plan is None and "backoff" in reason
-
-    def test_declines_packet_loss_and_profiles(self):
-        model = _mm1()
-        model.servers[0].latency = type(model.servers[0].latency)(
-            mean_s=0.0, loss_p=0.1
+        assert reason == ""
+        assert plan["shape"] == "mm1"
+        assert plan["chaos"] == (
+            "faults",
+            "correlated_outages",
+            "backoff_retries",
+            "hedging",
+            "brownouts",
         )
-        plan, reason = kernel_plan(model)
-        assert plan is None and "loss" in reason
 
+    def test_wired_limiter_and_packet_loss_are_supported(self):
+        """ISSUE 14: token-bucket limiters on the source->sink path are
+        pass-through hops in the topology walk, and lossy edges spend
+        their Bernoulli from the shared uniform chunk — both approved."""
+        model = EnsembleModel(horizon_s=5.0)
+        src = model.source(rate=4.0)
+        lim = model.limiter(refill_rate=5.0, capacity=5.0)
+        srv = model.server(service_mean=0.1)
+        snk = model.sink()
+        model.connect(src, lim)
+        model.connect(lim, srv, loss_p=0.1)
+        model.connect(srv, snk)
+        plan, reason = kernel_plan(model)
+        assert reason == ""
+        assert plan == {
+            "shape": "mm1",
+            "servers": [0],
+            "chaos": ("packet_loss", "limiters"),
+        }
+
+    def test_declines_profiles(self):
         ramped = EnsembleModel(horizon_s=5.0)
         src = ramped.ramp_source(1.0, 5.0, 2.0)
         snk = ramped.sink()
@@ -463,7 +579,7 @@ class TestDeclinePredicate:
         ok, reason = _mm1().kernel_supported()
         assert ok and reason == ""
         model = _mm1()
-        model.limiter(refill_rate=1.0, capacity=2.0)
+        model.limiter(refill_rate=1.0, capacity=2.0)  # orphan: unwired
         ok, reason = model.kernel_supported()
         assert not ok and "HS_TPU_PALLAS" in reason
 
@@ -475,21 +591,40 @@ class TestRouterPlan:
     remaining decline list is actionable)."""
 
     @pytest.mark.parametrize(
-        "build, policy",
+        "build, policy, chaos",
         [
-            (_router_random, "random"),
-            (_router_round_robin, "round_robin"),
-            (_router_weighted, "weighted"),
-            (_router_faulted_telemetry, "round_robin"),
+            (_router_random, "random", ()),
+            (_router_round_robin, "round_robin", ()),
+            (_router_weighted, "weighted", ()),
+            (
+                _router_faulted_telemetry,
+                "round_robin",
+                ("faults", "telemetry"),
+            ),
+            (
+                _chaos_fanout,
+                "round_robin",
+                (
+                    "faults",
+                    "correlated_outages",
+                    "backoff_retries",
+                    "hedging",
+                    "brownouts",
+                    "packet_loss",
+                    "limiters",
+                    "telemetry",
+                ),
+            ),
         ],
     )
-    def test_fanout_shapes_are_supported(self, build, policy):
+    def test_fanout_shapes_are_supported(self, build, policy, chaos):
         plan, reason = kernel_plan(build())
         assert reason == ""
         assert plan == {
             "shape": "router",
             "servers": [0, 1, 2, 3],
             "policy": policy,
+            "chaos": chaos,
         }
 
     def test_adaptive_policy_declines_naming_the_policy(self):
@@ -506,11 +641,12 @@ class TestRouterPlan:
 
     def test_router_not_fed_by_source_declines(self):
         # The mm1 + orphan-router case from TestDeclinePredicate lands
-        # here too; this pins the specific reason text.
+        # here too; this pins the specific reason text (reworded for
+        # ISSUE 14: limiters are transparent hops, so "directly" went).
         model = _mm1()
         model.router(targets=[])
         plan, reason = kernel_plan(model)
-        assert plan is None and "not fed directly by the source" in reason
+        assert plan is None and "router is not fed by the source" in reason
 
     def test_mixed_sink_server_targets_decline(self):
         model = EnsembleModel(horizon_s=2.0)
@@ -564,14 +700,31 @@ class TestRouterPlan:
         plan, reason = kernel_plan(model)
         assert plan is None and "repeats a server target" in reason
 
-    def test_lossy_target_edge_declines(self):
+    def test_lossy_target_edge_is_supported(self):
+        """ISSUE 14: per-target packet loss no longer declines — the
+        loss Bernoulli is an ordinary slot in the shared uniform chunk."""
         model = _router_fanout("random")
         edge = model.routers[0].target_latencies[0]
         model.routers[0].target_latencies[0] = type(edge)(
             mean_s=edge.mean_s, kind=edge.kind, loss_p=0.1
         )
         plan, reason = kernel_plan(model)
-        assert plan is None and "packet loss" in reason and "router" in reason
+        assert reason == ""
+        assert plan["chaos"] == ("packet_loss",)
+
+    def test_limiter_fed_router_is_supported(self):
+        """source -> limiter -> router fan-out: admission is a
+        pass-through hop in the topology walk."""
+        from happysim_tpu.tpu.model import NodeRef
+
+        model = _router_fanout("random")
+        lim = model.limiter(refill_rate=8.0, capacity=4.0)
+        model.sources[0].downstream = lim
+        model.connect(lim, NodeRef("router", 0))
+        plan, reason = kernel_plan(model)
+        assert reason == ""
+        assert plan["shape"] == "router"
+        assert plan["chaos"] == ("limiters",)
 
 
 class TestKernelDecision:

@@ -102,7 +102,10 @@ class TestPaddedTruncationRoundTrip:
         )
         assert result.n_replicas == 8
         assert result.truncated_replicas == 0
-        assert result.engine_path == "scan"  # explicit budget skips chain
+        # Explicit budget skips chain; either scan flavor is fine — the
+        # CI mesh-execution gate re-runs this file with HS_TPU_PALLAS=1,
+        # where the supported M/M/1 shape lands on the fused kernel.
+        assert result.engine_path in ("scan", "scan+pallas")
 
 
 def _rich_state_keys():
