@@ -871,6 +871,183 @@ def bench_kernel_chaos(devices) -> dict:
     }
 
 
+def bench_resilience(devices) -> dict:
+    """ISSUE-15 metastability quantified at ensemble scale: a
+    correlated-outage rho-sweep M/M/1 with deadline retries, run as two
+    arms — UNDEFENDED (the retry storm locks in after the outage window
+    ends: post-outage demand (1 + max_retries) x lambda exceeds mu, so
+    goodput never recovers) and DEFENDED (retry budget + circuit
+    breaker: launches capped at ratio x requests, dark-window arrivals
+    failed fast), each recording ``goodput_recovery_ratio`` =
+    post-outage / pre-outage per-window goodput. Kernel-vs-lax
+    bit-identity is asserted on BOTH arms (the fused resilience stack
+    runs ``scan+pallas``; counters AND every windowed series), so the
+    recovery numbers come off the fast path with the lax step as the
+    per-lane oracle.
+    """
+    import jax
+    import numpy as np
+
+    from happysim_tpu.tpu import run_ensemble
+    from happysim_tpu.tpu.kernels import env_override, pallas_available
+    from happysim_tpu.tpu.mesh import replica_mesh
+
+    if not pallas_available():
+        return {
+            "metric": "goodput recovery (resilience-defended metastability)",
+            "skipped": "jax.experimental.pallas unavailable in this jaxlib",
+        }
+
+    from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+    mu = 25.0
+    horizon = PALLAS_HORIZON_S
+    n_windows = 16
+    outage = (0.3 * horizon, 0.45 * horizon)
+
+    def build(defended: bool):
+        model = EnsembleModel(horizon_s=horizon, transit_capacity=64)
+        model.macro_block = PALLAS_MACRO_BLOCK
+        src = model.source(rate=0.6 * mu)  # swept per replica below
+        srv = model.server(
+            concurrency=1,
+            service_mean=1.0 / mu,
+            queue_capacity=512,
+            deadline_s=0.5,
+            max_retries=3,
+            retry_backoff_s=1.0,
+            fault=FaultSpec(windows=(outage,)),
+        )
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        model.telemetry(
+            window_s=horizon / n_windows, metrics=("throughput", "rates")
+        )
+        if defended:
+            model.circuit_breaker(
+                failure_threshold=5,
+                window_s=1.0,
+                cooldown_s=0.5,
+                half_open_probes=2,
+            )
+            model.retry_budget(ratio=0.1, min_per_s=0.5, burst=4.0)
+        return model
+
+    # rho sweep confined to the metastable band: every lane is stable at
+    # base load (rho <= 0.7) but locks undefended once retries amplify
+    # demand past mu ((1 + 3) x 0.45 mu = 1.8 mu at the low end).
+    sweeps = {
+        "source_rate": np.linspace(
+            0.45 * mu, 0.7 * mu, PALLAS_REPLICAS
+        ).astype(np.float32)
+    }
+    max_events = int(12.0 * 0.7 * mu * horizon) + 64
+    mesh = replica_mesh(jax.devices()[:1])
+
+    def run(defended: bool, pallas: bool):
+        with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+            return run_ensemble(
+                build(defended),
+                n_replicas=PALLAS_REPLICAS,
+                seed=0,
+                mesh=mesh,
+                sweeps=sweeps,
+                max_events=max_events,
+            )
+
+    def recovery_ratio(result) -> float:
+        windows = result.timeseries.sink_count[:, 0].astype(np.float64)
+        first_dark = int(outage[0] / (horizon / n_windows))
+        pre = windows[1:first_dark].mean()  # skip the empty-start window
+        post = windows[-3:].mean()
+        return float(post / pre) if pre > 0 else 0.0
+
+    arms = {}
+    for defended in (False, True):
+        lax_r = run(defended, False)
+        kernel_r = run(defended, True)
+        assert kernel_r.engine_path == "scan+pallas", kernel_r.kernel_decline
+        assert lax_r.engine_path == "scan"
+        counters = (
+            "simulated_events",
+            "sink_count",
+            "server_completed",
+            "server_timed_out",
+            "server_retried",
+            "server_fault_dropped",
+            "server_fault_retried",
+            "server_breaker_dropped",
+            "breaker_tripped",
+            "server_budget_dropped",
+            "transit_dropped",
+        )
+        identical = all(
+            np.array_equal(
+                np.asarray(getattr(lax_r, name)),
+                np.asarray(getattr(kernel_r, name)),
+            )
+            for name in counters
+        ) and lax_r.breaker_open_fraction == kernel_r.breaker_open_fraction
+        for name in lax_r.timeseries._ARRAY_FIELDS:
+            lax_series = getattr(lax_r.timeseries, name)
+            kernel_series = getattr(kernel_r.timeseries, name)
+            if lax_series is None:
+                identical &= kernel_series is None
+                continue
+            identical &= bool(
+                np.array_equal(
+                    np.asarray(lax_series),
+                    np.asarray(kernel_series),
+                    equal_nan=True,
+                )
+            )
+        assert identical, (
+            "resilience stack diverged between the Pallas kernel and the "
+            "lax event step — breaker/shed/budget state must be "
+            "bit-identical per lane"
+        )
+        arms["defended" if defended else "undefended"] = (
+            kernel_r,
+            recovery_ratio(kernel_r),
+        )
+
+    undefended_r, undefended_ratio = arms["undefended"]
+    defended_r, defended_ratio = arms["defended"]
+    # The phenomenon itself, not a tuned bound: defenses must buy
+    # strictly more post-outage goodput than their absence.
+    assert defended_ratio > undefended_ratio, (
+        f"defended {defended_ratio:.3f} <= undefended {undefended_ratio:.3f}"
+    )
+    label = (
+        f"goodput_recovery_ratio (CPU fallback, INTERPRETED kernel, {PALLAS_REPLICAS}-replica correlated-outage rho sweep)"
+        if DEVICE_FALLBACK
+        else f"goodput_recovery_ratio (Pallas kernel, {PALLAS_REPLICAS // 1000}k-replica correlated-outage rho sweep)"
+    )
+    return {
+        "metric": label,
+        "value": round(defended_ratio, 4),
+        "unit": "post/pre goodput",
+        "goodput_recovery_ratio_defended": round(defended_ratio, 4),
+        "goodput_recovery_ratio_undefended": round(undefended_ratio, 4),
+        "bit_identical_counters": True,
+        "bit_identical_series": True,
+        "kernel_chaos_defended": list(defended_r.kernel_chaos),
+        "resilience_report": defended_r.engine_report()["resilience"],
+        "undefended_retried_total": int(sum(undefended_r.server_retried)),
+        "defended_retried_total": int(sum(defended_r.server_retried)),
+        "defended_events_per_sec": round(defended_r.events_per_second, 0),
+        "outage_window_s": list(outage),
+        "n_windows": n_windows,
+        "n_replicas": defended_r.n_replicas,
+        "horizon_s": defended_r.horizon_s,
+        "wall_seconds": round(defended_r.wall_seconds, 6),
+        "compile_seconds": round(defended_r.compile_seconds, 6),
+        "device": str(devices[0]),
+        "n_devices": len(devices),
+    }
+
+
 def bench_pallas_kernel(devices) -> dict:
     """Fused-kernel vs lax-step A/B on the same M/M/1 event-scan
     workload. The two paths are BIT-IDENTICAL by contract (the kernel
@@ -1261,6 +1438,7 @@ def main() -> int:
     ktel = bench_kernel_telemetry(devices)
     krouter = bench_kernel_router(devices)
     kchaos = bench_kernel_chaos(devices)
+    resilience = bench_resilience(devices)
     multichip = bench_multichip_mesh(devices)
     if DEVICE_FALLBACK:
         note = "TPU unreachable at bench time; CPU fallback at reduced scale"
@@ -1272,6 +1450,7 @@ def main() -> int:
         ktel["device_fallback"] = note
         krouter["device_fallback"] = note
         kchaos["device_fallback"] = note
+        resilience["device_fallback"] = note
         engine["north_star_ok"] = False  # per-chip target is a TPU claim
     # The general-engine entry stays LAST: trajectory tooling that keys
     # on the final JSON line keeps comparing like with like across rounds.
@@ -1282,6 +1461,7 @@ def main() -> int:
     print(json.dumps(ktel))
     print(json.dumps(krouter))
     print(json.dumps(kchaos))
+    print(json.dumps(resilience))
     print(json.dumps(multichip))
     print(json.dumps(engine))
     return 0

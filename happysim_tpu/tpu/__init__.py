@@ -45,9 +45,12 @@ from happysim_tpu.tpu.kernels import (
 )
 from happysim_tpu.tpu.mm1 import MM1Result, run_mm1_ensemble
 from happysim_tpu.tpu.model import (
+    CircuitBreakerSpec,
     CorrelatedOutages,
     EnsembleModel,
     FaultSpec,
+    LoadShedSpec,
+    RetryBudgetSpec,
     mm1_model,
     pipeline_model,
 )
@@ -65,8 +68,11 @@ from happysim_tpu.tpu.telemetry import (
 )
 
 __all__ = [
+    "CircuitBreakerSpec",
     "CorrelatedOutages",
     "DEFAULT_METRICS",
+    "LoadShedSpec",
+    "RetryBudgetSpec",
     "EnsembleCheckpoint",
     "EnsembleModel",
     "EnsembleResult",

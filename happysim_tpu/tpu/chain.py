@@ -87,6 +87,16 @@ def _source_ok(model: EnsembleModel) -> bool:
     # whole model up front.
     if getattr(model, "correlated_faults", None) is not None:
         return False
+    # Resilience layer (docs/guides/resilience.md): circuit-breaker
+    # state machines, shed admission gates, and retry budgets are
+    # event-time dynamics the deterministic Lindley recurrence cannot
+    # price — each spec declines the closed form by name.
+    if getattr(model, "circuit_breaker_spec", None) is not None:
+        return False  # circuit_breaker: open windows thin the arrivals
+    if getattr(model, "load_shed_spec", None) is not None:
+        return False  # load_shed: admission depends on live queue state
+    if getattr(model, "retry_budget_spec", None) is not None:
+        return False  # retry_budget: token state couples consecutive jobs
     source = model.sources[0]
     if source.arrival != "poisson" or source.profile is not None:
         return False

@@ -140,13 +140,15 @@ _I64_COUNTER_KEYS = frozenset({
     "srv_hedged", "srv_hedge_wins",
     "lim_admitted", "lim_dropped",
     "tr_dropped", "net_lost",
+    "srv_breaker_dropped", "brk_tripped",
+    "srv_shed_dropped", "srv_budget_dropped",
     "blocks_total",
 })
 # Telemetry reduce keys that are float time-integrals / sums (everything
 # else under tel_ is an int counter and limb-encodes like the above).
 _TEL_FLOAT_KEYS = frozenset({
     "tel_sink_sum", "tel_srv_depth_int", "tel_srv_busy_int",
-    "tel_fault_int",
+    "tel_fault_int", "tel_brk_open_int",
     "tel_spread_p10", "tel_spread_p90",
 })
 # Float accumulators reduced as fixed-point limb sums (decoded by
@@ -154,8 +156,9 @@ _TEL_FLOAT_KEYS = frozenset({
 _F64_SUM_KEYS = frozenset({
     "sink_sum", "sink_sq",
     "srv_busy_int", "srv_depth_int", "srv_wait_sum",
+    "brk_open_time",
     "tel_sink_sum", "tel_srv_depth_int", "tel_srv_busy_int",
-    "tel_fault_int",
+    "tel_fault_int", "tel_brk_open_int",
 })
 
 
@@ -378,6 +381,20 @@ def model_fingerprint(model: EnsembleModel) -> str:
     weights = tuple(r.weights for r in model.routers if r.weights)
     if weights:
         items = items + (("router_weights",) + weights,)
+    # Resilience specs change the compiled step (new state leaves, new
+    # gates); appended only when present so resilience-free fingerprints
+    # stay stable across versions — the same discipline as telemetry.
+    resilience = tuple(
+        spec
+        for spec in (
+            getattr(model, "circuit_breaker_spec", None),
+            getattr(model, "load_shed_spec", None),
+            getattr(model, "retry_budget_spec", None),
+        )
+        if spec is not None
+    )
+    if resilience:
+        items = items + (("resilience",) + resilience,)
     spec = repr(items)
     return hashlib.sha256(spec.encode()).hexdigest()[:16]
 
@@ -497,6 +514,23 @@ class EnsembleResult:
     server_hedge_wins: list[int] = dataclasses_field(default_factory=list)
     # packet-loss edge drops (whole model)
     network_lost: int = 0
+    # Resilience accounting (all zero/empty unless the model installs
+    # the matching spec — see model.circuit_breaker/load_shed/
+    # retry_budget and docs/guides/resilience.md):
+    # arrivals rejected by an open (or probe-exhausted half-open)
+    # breaker — fail-fast terminal drops that spawned no retries
+    server_breaker_dropped: list[int] = dataclasses_field(default_factory=list)
+    # closed->open (and half-open->open) breaker trips
+    breaker_tripped: list[int] = dataclasses_field(default_factory=list)
+    # fraction of (replicas x horizon) each server's breaker spent open
+    breaker_open_fraction: list[float] = dataclasses_field(default_factory=list)
+    # arrivals shed by admission control (terminal)
+    server_shed_dropped: list[int] = dataclasses_field(default_factory=list)
+    # retry/hedge launches suppressed by the retry budget
+    server_budget_dropped: list[int] = dataclasses_field(default_factory=list)
+    # which resilience defenses the model declared
+    # (model.resilience_features() names)
+    resilience_features: tuple = ()
     # Time-resolved per-window series (models with a TelemetrySpec only;
     # see tpu/telemetry.py — None otherwise).
     timeseries: Optional[EnsembleTimeseries] = None
@@ -596,6 +630,19 @@ class EnsembleResult:
                 "reduce_path": self.reduce_path,
                 "redistribution_seconds": self.redistribution_seconds,
             },
+            # Resilience-layer provenance: per-feature on/off plus the
+            # defense totals, so a report consumer can tell a run that
+            # had no defenses from one whose defenses never fired.
+            "resilience": {
+                "circuit_breaker": "circuit_breaker" in self.resilience_features,
+                "load_shed": "load_shed" in self.resilience_features,
+                "retry_budget": "retry_budget" in self.resilience_features,
+                "breaker_tripped_total": sum(self.breaker_tripped),
+                "breaker_dropped_total": sum(self.server_breaker_dropped),
+                "shed_dropped_total": sum(self.server_shed_dropped),
+                "budget_dropped_total": sum(self.server_budget_dropped),
+                "breaker_open_fraction": list(self.breaker_open_fraction),
+            },
         }
         if self.kernel_decline:
             report["escape_hatches"] = {
@@ -646,6 +693,14 @@ class EnsembleResult:
             if self.server_hedged and self.server_hedged[index]:
                 extra["hedged"] = self.server_hedged[index]
                 extra["hedge_wins"] = self.server_hedge_wins[index]
+            if self.server_breaker_dropped and self.server_breaker_dropped[index]:
+                extra["breaker_dropped"] = self.server_breaker_dropped[index]
+            if self.breaker_tripped and self.breaker_tripped[index]:
+                extra["breaker_tripped"] = self.breaker_tripped[index]
+            if self.server_shed_dropped and self.server_shed_dropped[index]:
+                extra["shed_dropped"] = self.server_shed_dropped[index]
+            if self.server_budget_dropped and self.server_budget_dropped[index]:
+                extra["budget_dropped"] = self.server_budget_dropped[index]
             entities.append(
                 EntitySummary(name=f"server[{index}]", kind="Server", extra=extra)
             )
@@ -681,6 +736,31 @@ class EnsembleResult:
         if chaos_extra:
             entities.append(
                 EntitySummary(name="model", kind="Chaos", extra=chaos_extra)
+            )
+        # Whole-model resilience accounting, mirroring the Chaos entity:
+        # the entity exists whenever defenses are DECLARED (on/off is
+        # itself signal — a defended run whose breakers never tripped is
+        # a different claim from an undefended run), with the totals
+        # appended when they fired.
+        if self.resilience_features:
+            res_extra = {"features": ", ".join(self.resilience_features)}
+            for label, per_server in (
+                ("breaker_tripped", self.breaker_tripped),
+                ("breaker_dropped", self.server_breaker_dropped),
+                ("shed_dropped", self.server_shed_dropped),
+                ("budget_dropped", self.server_budget_dropped),
+            ):
+                total = sum(per_server)
+                if total:
+                    res_extra[f"total_{label}"] = total
+            if self.breaker_open_fraction and any(
+                f > 0.0 for f in self.breaker_open_fraction
+            ):
+                res_extra["breaker_open_fraction_max"] = max(
+                    self.breaker_open_fraction
+                )
+            entities.append(
+                EntitySummary(name="model", kind="Resilience", extra=res_extra)
             )
         # Engine provenance: which path ran, and — when the kernel
         # declined — the reason plus the escape hatches, so a summary
@@ -849,6 +929,32 @@ class _Compiled:
         self.has_attempts = self.has_deadlines or self.has_fault_retries
         self.has_loss = any(e.loss_p > 0.0 for e in model.iter_edges())
 
+        # Vectorized resilience layer (docs/guides/resilience.md): the
+        # model-level specs compile to per-(replica, server) state
+        # columns + gates at the existing accounting sites. Everything
+        # is compile-time gated exactly like telemetry and the chaos
+        # stack: a resilience-free model traces to the identical jaxpr.
+        self.breaker = getattr(model, "circuit_breaker_spec", None)
+        self.shed = getattr(model, "load_shed_spec", None)
+        self.budget = getattr(model, "retry_budget_spec", None)
+        self.has_breaker = self.breaker is not None
+        self.has_shed = self.shed is not None
+        self.has_budget = self.budget is not None
+        self.has_resilience = (
+            self.has_breaker or self.has_shed or self.has_budget
+        )
+        # Sliding-window failure ring width (one slot per counted
+        # failure; the ring IS the exact window semantics).
+        self.brk_F = self.breaker.failure_threshold if self.has_breaker else 0
+        if self.has_shed and self.shed.policy == "utilization":
+            # Busy-slot threshold per server: shed when the active count
+            # is at or past ceil-free float compare busy >= thr * conc.
+            self.shed_busy_thr = (
+                self.shed.threshold * self.srv_concurrency.astype(np.float32)
+            )
+        else:
+            self.shed_busy_thr = np.zeros((self.nV,), np.float32)
+
         self.arrival_is_poisson = np.array(
             [s.arrival == "poisson" for s in model.sources], np.bool_
         )
@@ -960,6 +1066,20 @@ class _Compiled:
                 keys.append("tel_tr_dropped")
             if self.has_loss:
                 keys.append("tel_net_lost")
+            # Resilience defenses (docs/guides/resilience.md): shed /
+            # breaker / budget drop counters plus the breaker open-time
+            # integral (booked at trip time across the windows the open
+            # interval spans, like the busy integral).
+            if self.has_breaker:
+                keys += [
+                    "tel_srv_breaker_dropped",
+                    "tel_brk_tripped",
+                    "tel_brk_open_int",
+                ]
+            if self.has_shed:
+                keys.append("tel_srv_shed_dropped")
+            if self.has_budget:
+                keys.append("tel_srv_budget_dropped")
         self.tel_sum_keys = tuple(keys)
 
     def _tel_init_state(self) -> dict:
@@ -997,6 +1117,14 @@ class _Compiled:
                 state["tel_tr_dropped"] = jnp.zeros((nW, nV), jnp.int32)
             if self.has_loss:
                 state["tel_net_lost"] = jnp.zeros((nW,), jnp.int32)
+            if self.has_breaker:
+                state["tel_srv_breaker_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+                state["tel_brk_tripped"] = jnp.zeros((nW, nV), jnp.int32)
+                state["tel_brk_open_int"] = jnp.zeros((nW, nV), jnp.float32)
+            if self.has_shed:
+                state["tel_srv_shed_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_budget:
+                state["tel_srv_budget_dropped"] = jnp.zeros((nW, nV), jnp.int32)
         return state
 
     def _tel_windex(self, t):
@@ -1152,6 +1280,14 @@ class _Compiled:
             slot += 1
         else:
             self.U_JIT = None
+        # One priority Bernoulli per arrival when load shedding exempts
+        # a traffic fraction (priority_fraction == 0 needs no draw, so
+        # shed-without-priorities keeps the stream layout unchanged).
+        if self.has_shed and self.shed.priority_fraction > 0.0:
+            self.U_SHED: Optional[int] = slot
+            slot += 1
+        else:
+            self.U_SHED = None
         self.n_draws = max(slot, 1)
 
     def _uslot(self, u, slot: Optional[int]):
@@ -1254,6 +1390,29 @@ class _Compiled:
         if self.has_hedge:
             state["srv_hedged"] = jnp.zeros((self.nV,), jnp.int32)
             state["srv_hedge_wins"] = jnp.zeros((self.nV,), jnp.int32)
+        if self.has_breaker:
+            # Per-(replica, server) breaker columns: state id (0 closed,
+            # 1 open, 2 half-open), the exact sliding-window failure
+            # ring (-inf = empty slot), its cursor, the last trip time,
+            # the half-open probe count, and the trip/drop/open-time
+            # accounting.
+            state["brk_state"] = jnp.zeros((self.nV,), jnp.int32)
+            state["brk_fail_t"] = jnp.full((self.nV, self.brk_F), -INF)
+            state["brk_fail_idx"] = jnp.zeros((self.nV,), jnp.int32)
+            state["brk_open_t"] = jnp.zeros((self.nV,), jnp.float32)
+            state["brk_probes"] = jnp.zeros((self.nV,), jnp.int32)
+            state["brk_tripped"] = jnp.zeros((self.nV,), jnp.int32)
+            state["brk_open_time"] = jnp.zeros((self.nV,), jnp.float32)
+            state["srv_breaker_dropped"] = jnp.zeros((self.nV,), jnp.int32)
+        if self.has_shed:
+            state["srv_shed_dropped"] = jnp.zeros((self.nV,), jnp.int32)
+        if self.has_budget:
+            # Token bucket per (replica, server), born full at burst.
+            state["bud_tokens"] = jnp.full(
+                (self.nV,), jnp.float32(self.budget.burst)
+            )
+            state["bud_last"] = jnp.zeros((self.nV,), jnp.float32)
+            state["srv_budget_dropped"] = jnp.zeros((self.nV,), jnp.int32)
         if self.has_loss:
             state["net_lost"] = jnp.int32(0)
         if self.has_telemetry:
@@ -1790,6 +1949,173 @@ class _Compiled:
         spread = 1.0 + jitter * (u_jit - jnp.float32(0.5))
         return backoff * jnp.exp2(jnp.asarray(attempt, jnp.float32)) * spread
 
+    # -- resilience layer (docs/guides/resilience.md) -----------------------
+    # All helpers below exist only when the model declares the matching
+    # spec (compile-time gated); every consumer masks by the selected
+    # server's one-hot ``row`` so traced (router-chosen) indices work.
+
+    def _breaker_effective(self, state, row, t):
+        """Lazily-resolved breaker state for the selected server at t.
+
+        Open lazily reads as half-open once the cooldown has elapsed
+        (with a fresh probe quota) — evaluated wherever the breaker is
+        consulted, so no timer event is needed (the same move as the
+        host breaker's property-based transition). Returns
+        ``(bst, probes, cooled)`` scalars.
+        """
+        bst = self._pick(state["brk_state"], row).astype(jnp.int32)
+        open_t = self._pick(state["brk_open_t"], row)
+        probes = self._pick(state["brk_probes"], row).astype(jnp.int32)
+        cooled = (bst == 1) & (
+            t >= open_t + jnp.float32(self.breaker.cooldown_s)
+        )
+        bst = jnp.where(cooled, jnp.int32(2), bst)
+        probes = jnp.where(cooled, jnp.int32(0), probes)
+        return bst, probes, cooled
+
+    def _breaker_record_failure(self, state, row, t, failure, bst):
+        """Book one (potential) failure against the selected breaker.
+
+        Closed-state failures write the sliding-window ring and trip
+        when the ``failure_threshold`` most recent failures all landed
+        within ``window_s`` (the evicted-slot compare makes the window
+        EXACT, not tumbling); any half-open failure re-trips
+        immediately. A trip books its deterministic open interval
+        ``[t, min(t + cooldown, horizon))`` into ``brk_open_time`` (and
+        the per-window ``tel_brk_open_int``) at trip time — open ends
+        by cooldown expiry alone, so the interval is known the moment
+        the breaker opens.
+        """
+        row_i = row.astype(jnp.int32)
+        F = self.brk_F
+        idx = self._pick(state["brk_fail_idx"], row).astype(jnp.int32)
+        record = failure & (bst == 0)
+        ring_mask = row[:, None] & (
+            jnp.arange(F, dtype=jnp.int32)[None, :] == idx
+        ) & record
+        ring = jnp.where(ring_mask, t, state["brk_fail_t"])
+        # After writing, the oldest of the F most recent failures sits
+        # at the next cursor slot; -inf (ring not yet full) never trips.
+        oldest_col = jnp.arange(F, dtype=jnp.int32) == jnp.mod(idx + 1, F)
+        oldest = jnp.sum(
+            jnp.where(row[:, None] & oldest_col[None, :], ring, 0.0)
+        )
+        trip_closed = record & (
+            oldest > t - jnp.float32(self.breaker.window_s)
+        )
+        trip_half = failure & (bst == 2)
+        trip = trip_closed | trip_half
+        horizon = jnp.float32(self.model.horizon_s)
+        open_len = jnp.minimum(
+            jnp.float32(self.breaker.cooldown_s), jnp.maximum(horizon - t, 0.0)
+        )
+        # A trip resets the ring (stale closed-era failures must not
+        # re-trip the next closed period) and restarts the cursor.
+        ring = jnp.where(trip & row[:, None], -INF, ring)
+        out = {
+            **state,
+            "brk_fail_t": ring,
+            "brk_fail_idx": jnp.where(
+                row & trip,
+                jnp.int32(0),
+                jnp.where(row & record, jnp.mod(idx + 1, F), state["brk_fail_idx"]),
+            ),
+            "brk_state": jnp.where(row & trip, jnp.int32(1), state["brk_state"]),
+            "brk_open_t": jnp.where(row & trip, t, state["brk_open_t"]),
+            "brk_probes": jnp.where(row & trip, jnp.int32(0), state["brk_probes"]),
+            "brk_tripped": state["brk_tripped"] + row_i * trip.astype(jnp.int32),
+            "brk_open_time": state["brk_open_time"]
+            + row.astype(jnp.float32) * jnp.where(trip, open_len, 0.0),
+        }
+        if self.has_telemetry and self.tel_rates:
+            out["tel_brk_tripped"] = self._tel_count(
+                state, "tel_brk_tripped", self._tel_wrow(t), row, trip
+            )
+            overlap = self._tel_overlap(t, t + open_len)
+            out["tel_brk_open_int"] = state["tel_brk_open_int"] + jnp.where(
+                trip, 1.0, 0.0
+            ) * overlap[:, None] * row.astype(jnp.float32)[None, :]
+        return out
+
+    def _breaker_close_on_success(self, state, row, success, bst):
+        """A half-open success closes the breaker (ring + probes reset).
+        Successes in any other state are no-ops — closed-state successes
+        do not decay the failure window (the ring is count-based), and
+        open-state completions are stale pre-trip work. Half-open
+        requires at least one ADMITTED probe before a success may close
+        (jobs are not era-tagged, so this is the cheap approximation of
+        the host breaker's sent-state attribution: a stale pre-trip
+        completion draining out right after the cooldown cannot re-close
+        a breaker that has admitted nothing yet)."""
+        probes = self._pick(state["brk_probes"], row).astype(jnp.int32)
+        close = success & (bst == 2) & (probes > 0)
+        return {
+            **state,
+            "brk_state": jnp.where(row & close, jnp.int32(0), state["brk_state"]),
+            "brk_fail_t": jnp.where(close & row[:, None], -INF, state["brk_fail_t"]),
+            "brk_fail_idx": jnp.where(
+                row & close, jnp.int32(0), state["brk_fail_idx"]
+            ),
+            "brk_probes": jnp.where(
+                row & close, jnp.int32(0), state["brk_probes"]
+            ),
+        }
+
+    def _budget_refresh(self, state, row, t, credit):
+        """Refill the selected server's retry-budget bucket at time t.
+
+        ``credit`` is the per-request token credit (ratio on
+        first-attempt arrivals, 0 at pure launch sites); the floor
+        refill accrues at ``min_per_s`` since the last touch; both cap
+        at ``burst``. Returns ``(state, tokens)`` with the refreshed
+        bucket written back.
+        """
+        tokens = self._pick(state["bud_tokens"], row)
+        last = self._pick(state["bud_last"], row)
+        tokens = jnp.minimum(
+            tokens
+            + (t - last) * jnp.float32(self.budget.min_per_s)
+            + credit,
+            jnp.float32(self.budget.burst),
+        )
+        state = {
+            **state,
+            "bud_tokens": jnp.where(row, tokens, state["bud_tokens"]),
+            "bud_last": jnp.where(row, t, state["bud_last"]),
+        }
+        return state, tokens
+
+    def _budget_debit(self, state, row, launched):
+        """Spend one token when a retry/hedge actually launches —
+        callers must gate ``launched`` on the launch REALLY happening
+        (a retry bounced by full transit registers or a full queue is a
+        transit/queue drop, not a booked launch, and must not burn a
+        token)."""
+        return {
+            **state,
+            "bud_tokens": state["bud_tokens"]
+            - row.astype(jnp.float32) * launched.astype(jnp.float32),
+        }
+
+    def _book_budget_dropped(self, state, row, t, suppressed):
+        """One budget-suppression book (counter + windowed twin) —
+        shared by all four launch sites so the accounting cannot drift
+        site by site."""
+        out = {
+            **state,
+            "srv_budget_dropped": state["srv_budget_dropped"]
+            + row.astype(jnp.int32) * suppressed.astype(jnp.int32),
+        }
+        if self.has_telemetry and self.tel_rates:
+            out["tel_srv_budget_dropped"] = self._tel_count(
+                state,
+                "tel_srv_budget_dropped",
+                self._tel_wrow(t),
+                row,
+                suppressed,
+            )
+        return out
+
     def _arrive_server(self, state, v, t, created, attempt, u, params):
         """One job arriving at server ``v`` (which may be a traced index).
 
@@ -1803,6 +2129,48 @@ class _Compiled:
         row = self._row(v, self.nV)  # (nV,)
         row_i = row.astype(jnp.int32)
         row_f = row.astype(jnp.float32)
+        # Circuit-breaker gate (client-side fail-fast), BEFORE the
+        # server sees the job: resolve the lazy cooldown transition,
+        # short-circuit while open (or half-open with the probe quota
+        # spent), and count admitted half-open arrivals as probes. A
+        # short-circuited arrival spends no fault/queue machinery and
+        # spawns no retries — that is the defense.
+        if self.has_breaker:
+            bst, bprobes, _cooled = self._breaker_effective(state, row, t)
+            probe_ok = bprobes < jnp.int32(self.breaker.half_open_probes)
+            brk_short = (bst == 1) | ((bst == 2) & ~probe_ok)
+            probe_adm = (bst == 2) & probe_ok
+            # The probe QUOTA is spent further down, only when the
+            # arrival actually lands in a slot or the queue (a probe
+            # shed or queue-full-dropped resolves nothing, so it must
+            # not exhaust the half-open quota and stall the breaker).
+            state = {
+                **state,
+                "brk_state": jnp.where(row, bst, state["brk_state"]),
+                "brk_probes": jnp.where(row, bprobes, state["brk_probes"]),
+                "srv_breaker_dropped": state["srv_breaker_dropped"]
+                + row_i * brk_short.astype(jnp.int32),
+            }
+            if self.has_telemetry and self.tel_rates:
+                state["tel_srv_breaker_dropped"] = self._tel_count(
+                    state,
+                    "tel_srv_breaker_dropped",
+                    self._tel_wrow(t),
+                    row,
+                    brk_short,
+                )
+        else:
+            brk_short = jnp.bool_(False)
+        # Retry-budget refill: first-attempt arrivals credit ``ratio``
+        # tokens (the Finagle retries <= ratio x requests discipline).
+        if self.has_budget:
+            state, bud_tokens = self._budget_refresh(
+                state,
+                row,
+                t,
+                jnp.where(attempt == 0, jnp.float32(self.budget.ratio), 0.0),
+            )
+            bud_ok = bud_tokens >= 1.0
         slot_valid = jnp.asarray(self.slot_valid)  # (nV, C)
         done = state["srv_slot_done"]  # (nV, C)
         free = slot_valid & jnp.isinf(done) & row[:, None]
@@ -1848,6 +2216,13 @@ class _Compiled:
                 self._sample_service(self._usvc(u, self.U_HED1), v, params) * infl
             )
             hedged = jnp.isfinite(hedge_delay) & (service > hedge_delay)
+            if self.has_budget:
+                # Hedged second attempts spend from the same retry
+                # budget (a hedge IS speculative retry load); with no
+                # token the primary runs unhedged and the suppressed
+                # launch books as srv_budget_dropped below.
+                hedge_would = hedged
+                hedged = hedged & bud_ok
             hedge_win = hedged & (hedge_delay + service2 < service)
             service = jnp.where(
                 hedged, jnp.minimum(service, hedge_delay + service2), service
@@ -1860,6 +2235,10 @@ class _Compiled:
             out_start = self._pick(jnp.asarray(self.srv_outage_start), row)
             out_end = self._pick(jnp.asarray(self.srv_outage_end), row)
             dark = (t >= out_start) & (t < out_end)
+            if self.has_breaker:
+                # A short-circuited arrival never reached the server:
+                # breaker drops stay disjoint from the outage ledger.
+                dark = dark & ~brk_short
         else:
             dark = jnp.bool_(False)
         # Drop-mode stochastic fault: the arrival is rejected; with a
@@ -1871,22 +2250,64 @@ class _Compiled:
             flt_dark = (
                 jnp.any(dark_v & jnp.asarray(self.faults.drop_mode) & row) & ~dark
             )
+            if self.has_breaker:
+                flt_dark = flt_dark & ~brk_short
         else:
             flt_dark = jnp.bool_(False)
         if self.has_fault_retries:
-            retry = (
+            would_retry = (
                 flt_dark
                 & jnp.any(jnp.asarray(self.flt_can_retry) & row)
                 & (attempt < self._pick(jnp.asarray(self.srv_max_retries), row))
             )
+            retry = would_retry
+            if self.has_budget:
+                # Budget gate: a suppressed retry stays a terminal fault
+                # drop (plus a srv_budget_dropped book) — never a parked
+                # transit job.
+                retry = would_retry & bud_ok
+                bud_blocked = would_retry & ~bud_ok
         else:
             retry = jnp.bool_(False)
         fault_lost = flt_dark & ~retry
         rejected = dark | flt_dark
+        if self.has_breaker:
+            rejected = rejected | brk_short
+
+        q_len = self._pick(state["srv_q_len"], row)
+        # Load shedding: admission rejection at the server hop, BEFORE
+        # enqueue — terminal (never retried), priority traffic exempt.
+        if self.has_shed:
+            if self.shed.policy == "queue_depth":
+                shed_cond = q_len >= jnp.int32(int(self.shed.threshold))
+            else:  # utilization: busy slots at/past threshold x conc
+                busy_cnt = jnp.sum(
+                    (jnp.isfinite(done) & slot_valid & row[:, None]).astype(
+                        jnp.int32
+                    )
+                )
+                shed_cond = busy_cnt.astype(jnp.float32) >= self._pick(
+                    jnp.asarray(self.shed_busy_thr), row
+                )
+            if self.shed.priority_fraction > 0.0:
+                shed_cond = shed_cond & (
+                    self._uslot(u, self.U_SHED)
+                    >= jnp.float32(self.shed.priority_fraction)
+                )
+            shed = shed_cond & ~rejected
+            rejected = rejected | shed
+        else:
+            shed = jnp.bool_(False)
         admit_free = has_free & ~rejected
         slot_mask = slot_mask & ~rejected
 
-        q_len = self._pick(state["srv_q_len"], row)
+        # Arrival-site breaker signal: brownout drops and fault-window
+        # rejections (retried or not) are failures, recorded BEFORE the
+        # branch outputs fork so every select branch carries them.
+        if self.has_breaker:
+            state = self._breaker_record_failure(
+                state, row, t, dark | flt_dark, bst
+            )
         cap = self._pick(jnp.asarray(self.queue_cap), row)
         has_room = q_len < cap
         tail = jnp.mod(
@@ -1936,6 +2357,19 @@ class _Compiled:
             out["srv_fault_dropped"] = (
                 state["srv_fault_dropped"] + row_i * fault_lost.astype(jnp.int32)
             )
+        if self.has_shed:
+            out["srv_shed_dropped"] = state["srv_shed_dropped"] + row_i * shed.astype(
+                jnp.int32
+            )
+        if self.has_breaker:
+            # Spend the half-open probe quota only for arrivals that
+            # will actually resolve (slot start or enqueue). A tripped
+            # breaker already reset probes, but trip implies rejected,
+            # which excludes both admit paths — no double-book.
+            probe_used = probe_adm & (admit_free | enq)
+            out["brk_probes"] = state["brk_probes"] + row_i * probe_used.astype(
+                jnp.int32
+            )
         if self.has_hedge:
             launched = admit_free & hedged
             out["srv_hedged"] = state["srv_hedged"] + row_i * launched.astype(
@@ -1944,6 +2378,11 @@ class _Compiled:
             out["srv_hedge_wins"] = state["srv_hedge_wins"] + row_i * (
                 admit_free & hedge_win
             ).astype(jnp.int32)
+            if self.has_budget:
+                out = self._budget_debit(out, row, launched)
+                out = self._book_budget_dropped(
+                    out, row, t, admit_free & hedge_would & ~bud_ok
+                )
         if self.has_telemetry:
             wrow = self._tel_wrow(t)
             if self.tel_util:
@@ -1964,6 +2403,10 @@ class _Compiled:
                 if self.has_faults:
                     out["tel_srv_fault_dropped"] = self._tel_count(
                         state, "tel_srv_fault_dropped", wrow, row, fault_lost
+                    )
+                if self.has_shed:
+                    out["tel_srv_shed_dropped"] = self._tel_count(
+                        state, "tel_srv_shed_dropped", wrow, row, shed
                     )
                 if self.has_hedge:
                     out["tel_srv_hedged"] = self._tel_count(
@@ -2003,6 +2446,14 @@ class _Compiled:
                     row,
                     tr_free,
                 )
+            if self.has_budget:
+                # The launch spends a token (retry branch only — the
+                # tree_map below selects these leaves iff ``retry``)
+                # and only when the transit park REALLY happens (a
+                # register-less retry is a tr_dropped, not a launch);
+                # the suppressed launch books on the terminal branch.
+                booked = self._budget_debit(booked, row, retry & tr_free)
+                out = self._book_budget_dropped(out, row, t, bud_blocked)
             parked = self._into_transit(
                 booked,
                 v,
@@ -2111,6 +2562,18 @@ class _Compiled:
             state["tel_srv_completed"] = self._tel_count(
                 state, "tel_srv_completed", self._tel_wrow(t), row, True
             )
+        # Completion-site breaker resolution: persist the lazy cooldown
+        # transition, then let the deadline verdict below record the
+        # failure (expired) or success (in-deadline, which closes a
+        # half-open breaker). v is static here, so breaker-free models
+        # trace none of this.
+        if self.has_breaker:
+            bst, bprobes, _cooled = self._breaker_effective(state, row, t)
+            state = {
+                **state,
+                "brk_state": jnp.where(row, bst, state["brk_state"]),
+                "brk_probes": jnp.where(row, bprobes, state["brk_probes"]),
+            }
         spec = self.model.servers[v]
         if spec.deadline_s is not None:
             # Deadline accounting: a completion whose sojourn blew the
@@ -2121,6 +2584,17 @@ class _Compiled:
             # immediate tail re-enqueue.
             expired = (t - created) > jnp.float32(self.srv_deadline[v])
             can_retry = expired & (attempt < jnp.int32(self.srv_max_retries[v]))
+            if self.has_budget and spec.max_retries > 0:
+                # Retry-budget gate on deadline retries: with no token
+                # the job times out terminally (srv_timed_out) and the
+                # suppressed launch books as srv_budget_dropped.
+                state, bud_tokens = self._budget_refresh(
+                    state, row, t, jnp.float32(0.0)
+                )
+                bud_ok = bud_tokens >= 1.0
+                bud_blocked = can_retry & ~bud_ok
+                can_retry = can_retry & bud_ok
+                state = self._book_budget_dropped(state, row, t, bud_blocked)
             timed_out = expired & ~can_retry
             state = {
                 **state,
@@ -2130,6 +2604,13 @@ class _Compiled:
             if self.has_telemetry and self.tel_rates:
                 state["tel_srv_timed_out"] = self._tel_count(
                     state, "tel_srv_timed_out", self._tel_wrow(t), row, timed_out
+                )
+            if self.has_breaker:
+                state = self._breaker_record_failure(
+                    state, row, t, expired, bst
+                )
+                state = self._breaker_close_on_success(
+                    state, row, ~expired, bst
                 )
             if spec.retry_backoff_s is not None:
                 delay = self._backoff_delay(
@@ -2150,6 +2631,12 @@ class _Compiled:
                     booked["tel_srv_retried"] = self._tel_count(
                         state, "tel_srv_retried", self._tel_wrow(t), row, tr_free
                     )
+                if self.has_budget and spec.max_retries > 0:
+                    # Token spent only when the park REALLY happens (an
+                    # overflowed retry is a tr_dropped, not a launch).
+                    booked = self._budget_debit(
+                        booked, row, can_retry & tr_free
+                    )
                 retried_state = self._into_transit(
                     booked,
                     v,
@@ -2158,8 +2645,18 @@ class _Compiled:
                     attempt + 1,
                 )
             else:
+                retry_base = state
+                if self.has_budget and spec.max_retries > 0:
+                    # Same gate as _enqueue_retry's has_room: a retry
+                    # that finds the queue full is a drop, not a launch.
+                    retry_room = self._pick(
+                        state["srv_q_len"], row
+                    ) < jnp.float32(self.queue_cap[v])
+                    retry_base = self._budget_debit(
+                        state, row, can_retry & retry_room
+                    )
                 retried_state = self._enqueue_retry(
-                    state, v, t, created, attempt + 1
+                    retry_base, v, t, created, attempt + 1
                 )
             forwarded_state = self._deliver(
                 state, t, created, u, spec.downstream, spec.latency, params
@@ -2175,6 +2672,12 @@ class _Compiled:
                 state,
             )
         else:
+            if self.has_breaker:
+                # No deadline: every completion is a success (closes a
+                # half-open breaker; no-op otherwise).
+                state = self._breaker_close_on_success(
+                    state, row, jnp.bool_(True), bst
+                )
             state = self._deliver(
                 state, t, created, u, spec.downstream, spec.latency, params
             )
@@ -2223,6 +2726,17 @@ class _Compiled:
                     degraded_now, jnp.float32(self.faults.lat_factor[v]), 1.0
                 )
             hedge_pull = service > hedge_delay
+            if self.has_budget:
+                # Queue-pull hedges spend from the retry budget too —
+                # refreshed first so the min_per_s floor accrues here
+                # exactly like at the other launch sites (any
+                # deadline-retry debit above is already reflected in
+                # the token column the refresh reads).
+                hedge_pull_would = hedge_pull
+                state, pull_tokens = self._budget_refresh(
+                    state, row, t, jnp.float32(0.0)
+                )
+                hedge_pull = hedge_pull & (pull_tokens >= 1.0)
             hedge_pull_win = hedge_pull & (hedge_delay + service2 < service)
             service = jnp.where(
                 hedge_pull, jnp.minimum(service, hedge_delay + service2), service
@@ -2261,6 +2775,11 @@ class _Compiled:
             out["srv_hedge_wins"] = state["srv_hedge_wins"] + row_i * (
                 has_queued & hedge_pull_win
             ).astype(jnp.int32)
+            if self.has_budget:
+                out = self._budget_debit(out, row, launched)
+                out = self._book_budget_dropped(
+                    out, row, t, has_queued & hedge_pull_would & ~hedge_pull
+                )
         if self.has_telemetry:
             wrow = self._tel_wrow(t)
             if self.tel_util:
@@ -3082,6 +3601,14 @@ def run_ensemble(
         if compiled.has_hedge:
             per_replica["srv_hedged"] = final["srv_hedged"]
             per_replica["srv_hedge_wins"] = final["srv_hedge_wins"]
+        if compiled.has_breaker:
+            per_replica["srv_breaker_dropped"] = final["srv_breaker_dropped"]
+            per_replica["brk_tripped"] = final["brk_tripped"]
+            per_replica["brk_open_time"] = final["brk_open_time"]
+        if compiled.has_shed:
+            per_replica["srv_shed_dropped"] = final["srv_shed_dropped"]
+        if compiled.has_budget:
+            per_replica["srv_budget_dropped"] = final["srv_budget_dropped"]
         if compiled.has_loss:
             per_replica["net_lost"] = final["net_lost"]
         if compiled.has_telemetry:
@@ -3453,6 +3980,23 @@ def _build_result(
         server_fault_retried=_per_server(host, "srv_fault_retried", nV_real),
         server_hedged=_per_server(host, "srv_hedged", nV_real),
         server_hedge_wins=_per_server(host, "srv_hedge_wins", nV_real),
+        server_breaker_dropped=_per_server(host, "srv_breaker_dropped", nV_real),
+        breaker_tripped=_per_server(host, "brk_tripped", nV_real),
+        # Open time booked at trip time as min(cooldown, horizon - t);
+        # the fraction is over the whole run (not warmup-masked —
+        # breaker openness is an availability property, not a latency
+        # statistic).
+        breaker_open_fraction=(
+            [
+                float(x) / (n_replicas * horizon)
+                for x in host["brk_open_time"][:nV_real]
+            ]
+            if "brk_open_time" in host
+            else [0.0] * nV_real
+        ),
+        server_shed_dropped=_per_server(host, "srv_shed_dropped", nV_real),
+        server_budget_dropped=_per_server(host, "srv_budget_dropped", nV_real),
+        resilience_features=tuple(model.resilience_features()),
         network_lost=int(host.get("net_lost", 0)),
         timeseries=timeseries,
         compile_seconds=compile_seconds,

@@ -32,6 +32,15 @@ replica are never drawn. Size ``max_windows`` above
 ``rate * horizon_s`` (plus a few sigma) or late sim-time runs fault-free
 and the measured duty cycle falls short of :func:`duty_cycle`.
 
+Defense side: the fault accounting sites this module drives are also
+the failure signal of the vectorized resilience layer — a model-level
+:meth:`~happysim_tpu.tpu.model.EnsembleModel.circuit_breaker` trips on
+fault-window rejections (and deadline expiries / brownout drops), and
+:meth:`~happysim_tpu.tpu.model.EnsembleModel.retry_budget` caps the
+backoff-retry storms those rejections spawn, so the ensemble can
+reproduce AND defend the metastable failure modes correlated outages
+unlock (docs/guides/resilience.md).
+
 Kernel path: because the window registers are init-time state leaves
 (constant through the run) and :meth:`FaultTable.dark_vector` is pure
 elementwise work inside the traced step closure, the Pallas fused

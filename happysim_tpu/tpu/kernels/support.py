@@ -122,6 +122,19 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     by construction. The plan records the claimed features as
     ``plan["chaos"]`` (:meth:`EnsembleModel.chaos_features`).
 
+    The RESILIENCE layer (circuit breakers, load shedding, retry
+    budgets — docs/guides/resilience.md) fuses by the same argument:
+    breaker state machines, shed admission gates, and budget token
+    buckets are per-lane state columns (``brk_*`` / ``bud_*`` /
+    ``srv_shed_dropped``) updated inside the traced step closure, and
+    the only resilience RNG (the shed priority Bernoulli) is an
+    ordinary uniform slot. There are therefore NO resilience-specific
+    kernel_plan declines — declines stay purely topological — but the
+    breaker's ``(nV, failure_threshold)`` failure-time ring counts
+    toward the shared VMEM working set like every other leaf, so a
+    pathological threshold is declined by :func:`kernel_decision`'s
+    tile=1 budget check naming ``brk_fail_t``.
+
     Remaining declines are per-feature and actionable — adaptive
     (``least_outstanding``) routing, >1 router, remotes, rate profiles,
     router→sink / mixed targets, feedback loops, server chains behind

@@ -163,6 +163,12 @@ STATE_PARTITION_RULES: tuple[tuple[str, str], ...] = (
     (r"^net_lost$", "replica"),
     # sampled stochastic fault-window registers (incl. shared/correlated)
     (r"^flt_", "replica"),
+    # circuit-breaker state machines (state id, failure-time ring,
+    # cursor, trip time, probe count, trip/open-time accounting —
+    # docs/guides/resilience.md)
+    (r"^brk_", "replica"),
+    # retry-budget token buckets (tokens, last-touch time)
+    (r"^bud_", "replica"),
     # windowed telemetry buffers (tpu/telemetry.py)
     (r"^tel_", "replica"),
 )

@@ -210,6 +210,125 @@ class CorrelatedOutages:
             raise ValueError("correlated_outages: max_windows must be >= 1")
 
 
+@dataclass(frozen=True)
+class CircuitBreakerSpec:
+    """Per-(replica, server) closed -> open -> half-open state machine.
+
+    The vectorized twin of the host
+    :class:`~happysim_tpu.components.resilience.circuit_breaker.
+    CircuitBreaker`: every server of every replica carries its own
+    breaker columns, driven by the fault/timeout accounting sites the
+    compiled step already has.
+
+    Failure signal: fault-window rejections, brownout drops, and
+    deadline expiries. The failure window is an EXACT sliding window —
+    a ``(nV, failure_threshold)`` ring of recent failure times trips
+    the breaker when the ``failure_threshold`` most recent failures all
+    landed within ``window_s``. While open, arrivals are rejected
+    outright (``srv_breaker_dropped`` — terminal: the fail-fast path
+    never spawns retries). After ``cooldown_s`` the breaker reads as
+    half-open: up to ``half_open_probes`` arrivals are admitted as
+    probes; the first success closes the breaker (failure ring reset),
+    any failure re-trips it.
+    """
+
+    failure_threshold: int = 5
+    window_s: float = 1.0
+    cooldown_s: float = 1.0
+    half_open_probes: int = 1
+
+    def validate(self) -> None:
+        if self.failure_threshold < 1:
+            raise ValueError("circuit_breaker: failure_threshold must be >= 1")
+        if self.window_s <= 0.0:
+            raise ValueError("circuit_breaker: window_s must be > 0")
+        if self.cooldown_s <= 0.0:
+            raise ValueError("circuit_breaker: cooldown_s must be > 0")
+        if self.half_open_probes < 1:
+            raise ValueError("circuit_breaker: half_open_probes must be >= 1")
+
+
+LOAD_SHED_POLICIES = ("queue_depth", "utilization")
+
+
+@dataclass(frozen=True)
+class LoadShedSpec:
+    """Admission rejection at the server hop, before enqueue.
+
+    ``policy="queue_depth"``: an arrival is shed when the server's queue
+    already holds >= ``threshold`` jobs (a count). ``policy=
+    "utilization"``: shed when the busy-slot fraction is >=
+    ``threshold`` (in (0, 1]; 1.0 = "no queueing" admission — shed
+    exactly when every concurrency slot is busy). ``priority_fraction``
+    exempts that fraction of traffic (per-arrival Bernoulli on a
+    dedicated uniform slot): high-priority jobs are never shed. Shed
+    jobs are terminal drops (``srv_shed_dropped``) — shedding exists to
+    reject work cheaply, so it never spawns retries.
+    """
+
+    policy: str = "queue_depth"
+    threshold: float = 1.0
+    priority_fraction: float = 0.0
+
+    def validate(self) -> None:
+        if self.policy not in LOAD_SHED_POLICIES:
+            raise ValueError(
+                f"load_shed policy {self.policy!r} not in {LOAD_SHED_POLICIES}"
+            )
+        if self.policy == "queue_depth" and self.threshold < 1:
+            raise ValueError(
+                "load_shed: queue_depth threshold must be >= 1 (a job count)"
+            )
+        if self.policy == "utilization" and not 0.0 < self.threshold <= 1.0:
+            raise ValueError(
+                "load_shed: utilization threshold must be in (0, 1]"
+            )
+        if not 0.0 <= self.priority_fraction < 1.0:
+            raise ValueError(
+                "load_shed: priority_fraction must be in [0, 1) — 1.0 "
+                "would exempt everything and the shed could never act"
+            )
+
+
+@dataclass(frozen=True)
+class RetryBudgetSpec:
+    """Token-bucket cap on the retry/hedge amplification paths.
+
+    Per-(replica, server) bucket: every FIRST-attempt arrival credits
+    ``ratio`` tokens and the bucket refills at ``min_per_s`` tokens/s
+    (both capped at ``burst``); every retry launch — fault-rejection
+    backoff retries, deadline retries (backoff or immediate
+    re-enqueue), and hedged second attempts — debits one token. A
+    retry with no token available is NOT launched: the job books its
+    terminal outcome (fault drop / timeout) and the suppressed launch
+    counts as ``srv_budget_dropped`` — never a parked transit job.
+    This is the Finagle/Envoy "retries <= ratio x requests" discipline
+    that caps retry-storm amplification.
+    """
+
+    ratio: float = 0.1
+    min_per_s: float = 0.0
+    burst: float = 10.0
+
+    def validate(self) -> None:
+        if self.ratio < 0.0:
+            raise ValueError("retry_budget: ratio must be >= 0")
+        if self.min_per_s < 0.0:
+            raise ValueError("retry_budget: min_per_s must be >= 0")
+        if self.ratio == 0.0 and self.min_per_s == 0.0:
+            raise ValueError(
+                "retry_budget: ratio and min_per_s are both 0 — the bucket "
+                "would never refill and every retry after the initial burst "
+                "would be suppressed; set at least one"
+            )
+        if self.burst < 1.0:
+            raise ValueError(
+                "retry_budget: burst must be >= 1 (a launch spends a whole "
+                "token; a bucket that can never hold one suppresses all "
+                "retries)"
+            )
+
+
 @dataclass
 class SourceSpec:
     rate: float
@@ -356,6 +475,12 @@ class EnsembleModel:
         # keeps the compiled program bit-identical to a telemetry-free
         # build.
         self.telemetry_spec: Optional[TelemetrySpec] = None
+        # Vectorized resilience layer (docs/guides/resilience.md): each
+        # spec is compile-time gated exactly like telemetry — a
+        # resilience-free model traces to the identical jaxpr.
+        self.circuit_breaker_spec: Optional[CircuitBreakerSpec] = None
+        self.load_shed_spec: Optional[LoadShedSpec] = None
+        self.retry_budget_spec: Optional[RetryBudgetSpec] = None
 
     # -- builders ----------------------------------------------------------
     def source(
@@ -593,6 +718,84 @@ class EnsembleModel:
         self.telemetry_spec = spec
         return spec
 
+    def circuit_breaker(
+        self,
+        failure_threshold: int = 5,
+        window_s: float = 1.0,
+        cooldown_s: float = 1.0,
+        half_open_probes: int = 1,
+    ) -> CircuitBreakerSpec:
+        """Install the per-(replica, server) circuit breaker layer.
+
+        Every server gets its own closed -> open -> half-open state
+        machine per replica, driven by the existing fault/timeout
+        accounting sites: ``failure_threshold`` failures inside a
+        sliding ``window_s`` trip the breaker, arrivals while open are
+        rejected outright (``srv_breaker_dropped`` — fail-fast, no
+        retries spawned), and after ``cooldown_s`` up to
+        ``half_open_probes`` probe arrivals decide whether it re-closes
+        (first success) or re-trips (any failure). Requires at least
+        one failure site somewhere in the model (a deadline, a fault
+        schedule, or a brownout window) — validated at
+        :meth:`validate` time, since a breaker that can never observe a
+        failure is a configuration error.
+        """
+        spec = CircuitBreakerSpec(
+            failure_threshold=failure_threshold,
+            window_s=window_s,
+            cooldown_s=cooldown_s,
+            half_open_probes=half_open_probes,
+        )
+        spec.validate()
+        self.circuit_breaker_spec = spec
+        return spec
+
+    def load_shed(
+        self,
+        policy: str = "queue_depth",
+        threshold: float = 1.0,
+        priority_fraction: float = 0.0,
+    ) -> LoadShedSpec:
+        """Install admission-control load shedding on every server.
+
+        Arrivals are rejected at the server hop BEFORE enqueue when the
+        policy signal is at or past ``threshold`` (``"queue_depth"``: a
+        job count; ``"utilization"``: busy-slot fraction in (0, 1]).
+        ``priority_fraction`` of traffic is exempt (never shed). Shed
+        jobs are terminal ``srv_shed_dropped`` drops.
+        """
+        spec = LoadShedSpec(
+            policy=policy,
+            threshold=threshold,
+            priority_fraction=priority_fraction,
+        )
+        spec.validate()
+        self.load_shed_spec = spec
+        return spec
+
+    def retry_budget(
+        self,
+        ratio: float = 0.1,
+        min_per_s: float = 0.0,
+        burst: float = 10.0,
+    ) -> RetryBudgetSpec:
+        """Install the per-(replica, server) retry-budget token bucket.
+
+        Caps every retry/hedge launch path the model declares: a launch
+        debits one token, first-attempt arrivals credit ``ratio`` tokens
+        and the bucket floor-refills at ``min_per_s`` tokens/s (capped
+        at ``burst``). A budget-exhausted retry is suppressed and
+        counted as ``srv_budget_dropped`` — the job's terminal outcome
+        (timeout / fault drop) books as usual, and nothing parks in the
+        transit registers. Requires at least one consumer (a server
+        with ``max_retries > 0`` or a hedge delay) — validated at
+        :meth:`validate` time.
+        """
+        spec = RetryBudgetSpec(ratio=ratio, min_per_s=min_per_s, burst=burst)
+        spec.validate()
+        self.retry_budget_spec = spec
+        return spec
+
     def remote(self, ingress: NodeRef, latency_s: float) -> NodeRef:
         """Cross-partition egress: jobs exit here and arrive at the
         NEIGHBOR partition's ``ingress`` server after ``latency_s``
@@ -710,6 +913,43 @@ class EnsembleModel:
             self.correlated_faults.validate()
         if self.telemetry_spec is not None:
             self.telemetry_spec.validate(self.horizon_s)
+        if self.circuit_breaker_spec is not None:
+            self.circuit_breaker_spec.validate()
+            # Only drop-mode faults reject arrivals; a degrade-mode
+            # fault slows service but produces no failure signal of its
+            # own (it can still trip the breaker indirectly via a
+            # deadline, which the deadline_s clause covers).
+            has_failure_site = any(
+                s.deadline_s is not None
+                or (s.fault is not None and s.fault.mode == "outage")
+                or s.outage_start_s is not None
+                for s in self.servers
+            )
+            if not has_failure_site:
+                raise ValueError(
+                    "circuit_breaker: no server declares a failure site "
+                    "(deadline_s, an outage-mode fault, or outage=...) — "
+                    "the breaker could never observe a failure and would "
+                    "never trip"
+                )
+        if self.load_shed_spec is not None:
+            self.load_shed_spec.validate()
+            if not self.servers:
+                raise ValueError(
+                    "load_shed: the model has no servers to shed at"
+                )
+        if self.retry_budget_spec is not None:
+            self.retry_budget_spec.validate()
+            has_consumer = any(
+                s.max_retries > 0 or s.hedge_delay_s is not None
+                for s in self.servers
+            )
+            if not has_consumer:
+                raise ValueError(
+                    "retry_budget: no server declares a retry or hedge path "
+                    "(max_retries > 0 or hedge_delay_s) — the budget would "
+                    "gate nothing"
+                )
         for i, server in enumerate(self.servers):
             if server.downstream is None:
                 raise ValueError(f"server[{i}] has no downstream")
@@ -817,8 +1057,23 @@ class EnsembleModel:
             features.append("packet_loss")
         if self.limiters:
             features.append("limiters")
+        features.extend(self.resilience_features())
         if self.telemetry_spec is not None:
             features.append("telemetry")
+        return tuple(features)
+
+    def resilience_features(self) -> tuple[str, ...]:
+        """Which resilience defenses this model declares, as stable
+        feature names (a subset of :meth:`chaos_features` — defenses
+        ride the same compile-time-gated state-leaf machinery the chaos
+        features do, and the kernel claims them the same way)."""
+        features: list[str] = []
+        if self.circuit_breaker_spec is not None:
+            features.append("circuit_breaker")
+        if self.load_shed_spec is not None:
+            features.append("load_shed")
+        if self.retry_budget_spec is not None:
+            features.append("retry_budget")
         return tuple(features)
 
     def kernel_supported(self) -> tuple[bool, str]:

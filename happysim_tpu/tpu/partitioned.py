@@ -453,6 +453,18 @@ def run_partitioned(
             "hs.reduce, and HS_TPU_EARLY_EXIT=0 keeps the flat chunk "
             "scan reachable for A/B"
         )
+    resilience = model.resilience_features()
+    if resilience:
+        # Same discipline as the telemetry rejection above: decline by
+        # name rather than ship semantics this executor's window-barrier
+        # accounting has never been validated against.
+        raise ValueError(
+            f"the resilience layer ({', '.join(resilience)}) is not "
+            "supported by run_partitioned — use the mesh-first engine: "
+            "run_ensemble(mesh=replica_mesh(...)) runs breakers, load "
+            "shedding, and retry budgets at any device count (fused on "
+            "the kernel path; HS_TPU_PALLAS selects kernel vs lax step)"
+        )
     if outbox_capacity < 1:
         raise ValueError(
             f"outbox_capacity={outbox_capacity} must be >= 1: every remote "

@@ -270,6 +270,16 @@ class EnsembleTimeseries:
     limiter_admitted: Optional[np.ndarray] = None  # (nW, nL)
     limiter_dropped: Optional[np.ndarray] = None
     network_lost: Optional[np.ndarray] = None  # (nW,)
+    # resilience defenses (docs/guides/resilience.md)
+    server_breaker_dropped: Optional[np.ndarray] = None  # (nW, nV)
+    breaker_tripped: Optional[np.ndarray] = None  # (nW, nV)
+    # fraction of each window the breaker spent open, averaged over
+    # replicas (booked at trip time across the windows the deterministic
+    # open interval spans — the metastability plot's "defense active"
+    # band)
+    breaker_open_fraction: Optional[np.ndarray] = None  # (nW, nV)
+    server_shed_dropped: Optional[np.ndarray] = None  # (nW, nV)
+    server_budget_dropped: Optional[np.ndarray] = None  # (nW, nV)
     # faults
     fault_occupancy: Optional[np.ndarray] = None  # (nW, nV) fraction
 
@@ -284,6 +294,9 @@ class EnsembleTimeseries:
         "server_timed_out", "server_retried",
         "server_hedged", "server_hedge_wins", "transit_dropped",
         "limiter_admitted", "limiter_dropped", "network_lost",
+        "server_breaker_dropped", "breaker_tripped",
+        "breaker_open_fraction", "server_shed_dropped",
+        "server_budget_dropped",
         "fault_occupancy",
     )
 
@@ -338,6 +351,11 @@ class EnsembleTimeseries:
         emit("admitted", self.limiter_admitted, "limiter")
         emit("dropped", self.limiter_dropped, "limiter")
         emit("network_lost", self.network_lost, "network")
+        emit("breaker_dropped", self.server_breaker_dropped, "server")
+        emit("breaker_tripped", self.breaker_tripped, "server")
+        emit("breaker_open_fraction", self.breaker_open_fraction, "server")
+        emit("shed_dropped", self.server_shed_dropped, "server")
+        emit("budget_dropped", self.server_budget_dropped, "server")
         emit("fault_occupancy", self.fault_occupancy, "server")
         return out
 
@@ -466,6 +484,10 @@ def build_timeseries(
         ("server_hedged", "tel_srv_hedged"),
         ("server_hedge_wins", "tel_srv_hedge_wins"),
         ("transit_dropped", "tel_tr_dropped"),
+        ("server_breaker_dropped", "tel_srv_breaker_dropped"),
+        ("breaker_tripped", "tel_brk_tripped"),
+        ("server_shed_dropped", "tel_srv_shed_dropped"),
+        ("server_budget_dropped", "tel_srv_budget_dropped"),
     ):
         arr = counts(key)
         if arr is not None:
@@ -479,6 +501,17 @@ def build_timeseries(
             setattr(ts, attr, arr[:, :nL])
     if "tel_net_lost" in host:
         ts.network_lost = counts("tel_net_lost")
+    if "tel_brk_open_int" in host:
+        # Same denominator family as window_len_s: open seconds over the
+        # window's true [start, min(end, horizon)] coverage, averaged
+        # over replicas.
+        open_int = np.asarray(host["tel_brk_open_int"], np.float64)[:, :nV]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ts.breaker_open_fraction = np.where(
+                window_len[:, None] > 0,
+                open_int / (n_replicas * window_len[:, None]),
+                0.0,
+            )
     if "tel_fault_int" in host:
         # Same denominator as window_len_s: occupancy is dark seconds
         # over the window's true [start, min(end, horizon)] coverage.

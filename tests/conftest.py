@@ -45,6 +45,8 @@ _TPU_TEST_FILES = {
     "test_telemetry_regression.py",
     "test_router_regression.py",
     "test_chaos_regression.py",
+    "test_resilience_regression.py",
+    "test_tpu_resilience.py",
     "test_tpu_pallas.py",
     "test_kernel_event_step.py",
     "test_kernel_regression.py",

@@ -1,0 +1,468 @@
+"""The vectorized resilience layer, end to end (ISSUE 15).
+
+Two layers of coverage:
+
+1. Mechanism: breaker state machines trip/short-circuit/re-close, load
+   shedding rejects at the admission hop, retry budgets suppress
+   launches (``srv_budget_dropped``, never parked transit jobs), the
+   new state leaves checkpoint round-trip, and a resilience-free model
+   traces to the IDENTICAL jaxpr (the compile-time-gating contract the
+   telemetry and chaos layers already honor).
+
+2. Scenario: the two ROADMAP-item-4 metastability scenarios —
+   retry-storm collapse (a correlated outage ends but goodput never
+   recovers without a retry budget; with budgets + breakers it recovers
+   to >= 90% of pre-outage goodput) and the breaker-protected cascade
+   (a downstream brownout trips the breaker, which sheds and then
+   re-closes through half-open probes). These are the scenario class
+   the pure-Python reference fundamentally cannot reach: one compiled
+   launch Monte-Carlos the hysteresis over every replica.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.engine import _Compiled
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+
+def _mesh():
+    return replica_mesh(jax.devices("cpu")[:1])
+
+
+# ---------------------------------------------------------------------------
+# Scenario 1: retry-storm collapse (metastable failure reproduced + defended)
+# ---------------------------------------------------------------------------
+
+MU = 50.0
+LAM = 32.0  # rho = 0.64: comfortably stable — the collapse is NOT overload
+HORIZON = 12.0
+OUTAGE = (2.0, 4.0)  # correlated outage window (identical in every replica)
+
+
+def _storm_model(defended: bool) -> EnsembleModel:
+    """M/M/1 at rho=0.64 with deadline retries and a pinned outage.
+
+    The metastable mechanism: during the outage, rejected arrivals park
+    as backoff retries; the post-outage herd pushes queue wait past the
+    deadline, so EVERY completion expires and retries — sustained
+    demand (1 + max_retries) x lambda = 2.56 mu > mu keeps the queue
+    saturated and goodput at zero long after the outage ended, even
+    though the base load is stable. The defense caps retry launches at
+    ratio x requests (plus breakers failing fast during the dark
+    window), so post-outage demand stays under mu and the queue drains.
+    """
+    model = EnsembleModel(horizon_s=HORIZON, transit_capacity=64)
+    src = model.source(rate=LAM)
+    srv = model.server(
+        service_mean=1.0 / MU,
+        queue_capacity=512,
+        deadline_s=0.25,
+        max_retries=3,
+        retry_backoff_s=0.5,
+        fault=FaultSpec(windows=(OUTAGE,)),
+    )
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    model.telemetry(window_s=1.0, metrics=("throughput", "rates"))
+    if defended:
+        model.circuit_breaker(
+            failure_threshold=5, window_s=1.0, cooldown_s=0.5, half_open_probes=2
+        )
+        model.retry_budget(ratio=0.1, min_per_s=0.5, burst=4.0)
+    return model
+
+
+def _goodput_windows(result) -> np.ndarray:
+    return result.timeseries.sink_count[:, 0].astype(np.float64)
+
+
+def _run_storm(defended: bool):
+    return run_ensemble(
+        _storm_model(defended),
+        n_replicas=32,
+        seed=7,
+        mesh=_mesh(),
+        max_events=6144,
+    )
+
+
+class TestRetryStormCollapse:
+    @pytest.fixture(scope="class")
+    def undefended(self):
+        return _run_storm(False)
+
+    @pytest.fixture(scope="class")
+    def defended(self):
+        return _run_storm(True)
+
+    def test_undefended_goodput_stays_collapsed(self, undefended):
+        """The metastability pin: the outage ends at t=4 but goodput in
+        the LAST three windows (t in [9, 12) — four windows of slack
+        after the outage) never recovers. The run is not truncated, so
+        the collapse is the dynamics, not an exhausted event budget."""
+        assert undefended.truncated_replicas == 0
+        windows = _goodput_windows(undefended)
+        pre = windows[:2].mean()
+        post = windows[-3:].mean()
+        assert pre > 0.8 * LAM * 32  # healthy pre-outage goodput
+        assert post < 0.1 * pre, (
+            f"expected metastable collapse, got post/pre = {post / pre:.3f}"
+        )
+        # The storm signature: retries dwarf the offered load.
+        assert undefended.server_retried[0] > 32 * LAM * HORIZON * 0.5
+
+    def test_defended_goodput_recovers(self, defended):
+        """Budgets + breakers on: >= 90% of pre-outage goodput in the
+        tail windows (the acceptance-criteria bound)."""
+        assert defended.truncated_replicas == 0
+        windows = _goodput_windows(defended)
+        pre = windows[:2].mean()
+        post = windows[-3:].mean()
+        assert post >= 0.9 * pre, (
+            f"expected recovery >= 0.9, got {post / pre:.3f}"
+        )
+
+    def test_defenses_actually_fired(self, defended):
+        """The recovery must be attributable: budget suppressions and
+        breaker trips both nonzero, and the budget drops appear in the
+        windowed series summing to the whole-run counter."""
+        assert sum(defended.server_budget_dropped) > 0
+        assert sum(defended.breaker_tripped) > 0
+        assert sum(defended.server_breaker_dropped) > 0
+        series = defended.timeseries
+        np.testing.assert_array_equal(
+            series.server_budget_dropped.sum(axis=0),
+            np.asarray(defended.server_budget_dropped),
+        )
+        np.testing.assert_array_equal(
+            series.breaker_tripped.sum(axis=0),
+            np.asarray(defended.breaker_tripped),
+        )
+
+    def test_budget_drops_are_not_parked_transit_jobs(self, defended, undefended):
+        """Budget-suppressed retries become srv_budget_dropped, not
+        transit registrations: the defended run's transit pressure is
+        BELOW the undefended run's (which actually overflowed its
+        registers during the storm)."""
+        assert sum(defended.transit_dropped) <= sum(undefended.transit_dropped)
+        assert sum(defended.server_fault_retried) < sum(
+            undefended.server_fault_retried
+        )
+
+    def test_resilience_reaches_report_and_summary(self, defended, undefended):
+        report = defended.engine_report()["resilience"]
+        assert report["circuit_breaker"] and report["retry_budget"]
+        assert not report["load_shed"]
+        assert report["breaker_tripped_total"] == sum(defended.breaker_tripped)
+        assert report["budget_dropped_total"] == sum(
+            defended.server_budget_dropped
+        )
+        assert defended.resilience_features == ("circuit_breaker", "retry_budget")
+        resilience_entities = [
+            e for e in defended.summary().entities if e.kind == "Resilience"
+        ]
+        assert len(resilience_entities) == 1
+        extra = resilience_entities[0].extra
+        assert "circuit_breaker" in extra["features"]
+        assert extra["total_budget_dropped"] == sum(
+            defended.server_budget_dropped
+        )
+        # The undefended run declares no defenses: no Resilience entity,
+        # per-feature report all off.
+        off = undefended.engine_report()["resilience"]
+        assert not (off["circuit_breaker"] or off["load_shed"] or off["retry_budget"])
+        assert not any(
+            e.kind == "Resilience" for e in undefended.summary().entities
+        )
+
+
+# ---------------------------------------------------------------------------
+# Scenario 2: breaker-protected cascade (trip -> shed -> half-open -> close)
+# ---------------------------------------------------------------------------
+
+
+def _cascade_model() -> EnsembleModel:
+    """source -> A -> B -> sink; B browns out on [3, 4): B's breaker
+    trips on the brownout drops, short-circuits the upstream flow while
+    dark (fail-fast instead of feeding a dead hop), and re-closes
+    through half-open probes once the window ends."""
+    model = EnsembleModel(horizon_s=10.0)
+    src = model.source(rate=20.0)
+    first = model.server(service_mean=1.0 / MU, queue_capacity=128)
+    second = model.server(
+        service_mean=1.0 / MU, queue_capacity=128, outage=(3.0, 4.0)
+    )
+    snk = model.sink()
+    model.connect(src, first)
+    model.connect(first, second)
+    model.connect(second, snk)
+    model.telemetry(window_s=1.0, metrics=("throughput", "rates"))
+    model.circuit_breaker(
+        failure_threshold=4, window_s=0.5, cooldown_s=0.4, half_open_probes=1
+    )
+    return model
+
+
+class TestBreakerProtectedCascade:
+    @pytest.fixture(scope="class")
+    def result(self):
+        return run_ensemble(
+            _cascade_model(), n_replicas=32, seed=11, mesh=_mesh(), max_events=2048
+        )
+
+    def test_downstream_brownout_trips_the_breaker(self, result):
+        # Only B (server 1) observes failures; A's breaker stays closed.
+        assert result.breaker_tripped[1] > 0
+        assert result.breaker_tripped[0] == 0
+        assert result.server_breaker_dropped[1] > 0
+        assert result.server_breaker_dropped[0] == 0
+        # Fail-fast: the breaker absorbed most of the dark window — the
+        # brownout ledger itself stays capped near threshold x trips
+        # (only arrivals the breaker ADMITTED can be outage drops).
+        assert result.server_outage_dropped[1] <= (
+            result.breaker_tripped[1] * 4 + 4
+        )
+
+    def test_breaker_recloses_and_goodput_recovers(self, result):
+        series = result.timeseries
+        open_frac = series.breaker_open_fraction[:, 1]
+        # Open time concentrates in the brownout windows [3, 5)...
+        assert open_frac[3] > 0.2
+        # ...and the breaker is fully re-closed well before the end.
+        assert open_frac[-1] == 0.0
+        assert open_frac[-2] == 0.0
+        windows = series.sink_count[:, 0].astype(np.float64)
+        pre = windows[:3].mean()
+        post = windows[-3:].mean()
+        assert post >= 0.9 * pre
+        # Whole-run open fraction is the windowed integral re-expressed.
+        np.testing.assert_allclose(
+            result.breaker_open_fraction[1],
+            float(
+                (open_frac * series.window_len_s).sum() / result.horizon_s
+            ),
+            rtol=1e-6,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Mechanism tier
+# ---------------------------------------------------------------------------
+
+
+def _shed_model(policy: str, **kwargs) -> EnsembleModel:
+    model = EnsembleModel(horizon_s=4.0)
+    src = model.source(rate=40.0)
+    srv = model.server(service_mean=0.1, concurrency=2, queue_capacity=16)
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    model.load_shed(policy=policy, **kwargs)
+    return model
+
+
+class TestLoadShed:
+    def test_queue_depth_shed_caps_the_queue(self):
+        result = run_ensemble(
+            _shed_model("queue_depth", threshold=4),
+            n_replicas=16,
+            seed=3,
+            mesh=_mesh(),
+            max_events=1024,
+        )
+        assert sum(result.server_shed_dropped) > 0
+        # Shedding at depth 4 (queue cap 16) means queue-full drops
+        # cannot happen: the shed fires strictly first.
+        assert sum(result.server_dropped) == 0
+
+    def test_utilization_shed(self):
+        result = run_ensemble(
+            _shed_model("utilization", threshold=1.0),
+            n_replicas=16,
+            seed=3,
+            mesh=_mesh(),
+            max_events=1024,
+        )
+        # threshold=1.0 is "no queueing" admission: every arrival that
+        # found all slots busy was shed, so no job ever waited.
+        assert sum(result.server_shed_dropped) > 0
+        assert result.server_mean_wait_s[0] == 0.0
+
+    def test_priority_fraction_is_exempt(self):
+        full = run_ensemble(
+            _shed_model("queue_depth", threshold=2),
+            n_replicas=16,
+            seed=3,
+            mesh=_mesh(),
+            max_events=1024,
+        )
+        exempt = run_ensemble(
+            _shed_model("queue_depth", threshold=2, priority_fraction=0.5),
+            n_replicas=16,
+            seed=3,
+            mesh=_mesh(),
+            max_events=1024,
+        )
+        # Exempting half the traffic sheds strictly less.
+        assert 0 < sum(exempt.server_shed_dropped) < sum(
+            full.server_shed_dropped
+        )
+
+
+class TestHedgeBudget:
+    def _hedge_model(self, budget: bool) -> EnsembleModel:
+        model = EnsembleModel(horizon_s=6.0)
+        src = model.source(rate=20.0)
+        srv = model.server(
+            service_mean=0.1, queue_capacity=64, hedge_delay_s=0.05
+        )
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        if budget:
+            # ratio=0 + slow floor refill: the initial burst drains and
+            # most hedges are then suppressed.
+            model.retry_budget(ratio=0.0, min_per_s=0.5, burst=2.0)
+        return model
+
+    def test_budget_suppressed_hedges_are_booked(self):
+        kwargs = dict(n_replicas=16, seed=9, mesh=_mesh(), max_events=512)
+        free = run_ensemble(self._hedge_model(False), **kwargs)
+        capped = run_ensemble(self._hedge_model(True), **kwargs)
+        # The budget suppressed launches, and every suppression was
+        # booked — hedges forgone show up in srv_budget_dropped instead
+        # of silently vanishing.
+        assert sum(capped.server_hedged) < sum(free.server_hedged)
+        assert sum(capped.server_budget_dropped) > 0
+        # Floor refill only: launches are bounded by burst + accrual.
+        assert sum(capped.server_hedged) <= 16 * (2 + 0.5 * 6.0) + 16
+
+
+class TestCompileTimeGating:
+    def _plain_model(self):
+        model = EnsembleModel(horizon_s=4.0)
+        src = model.source(rate=6.0)
+        srv = model.server(service_mean=0.05, queue_capacity=8)
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        return model
+
+    def _step_jaxpr(self, model) -> str:
+        compiled = _Compiled(model)
+        step = compiled.make_step(float(model.horizon_s), external_u=True)
+        key = jnp.zeros((2,), jnp.uint32)
+        params = {
+            "src_rate": jnp.ones((compiled.nS,), jnp.float32),
+            "srv_mean": jnp.ones((compiled.nV,), jnp.float32),
+        }
+        state = compiled.init_state(key, params)
+        u = jnp.full((compiled.n_draws,), 0.5, jnp.float32)
+        return str(
+            jax.make_jaxpr(lambda s, u_row: step((s, params), u_row))(state, u)
+        )
+
+    def test_resilience_free_model_traces_to_identical_jaxpr(self):
+        """The acceptance-criteria gating assertion: a model without
+        resilience specs compiles to the exact program it compiled to
+        before the layer existed (same discipline as telemetry)."""
+        assert self._step_jaxpr(self._plain_model()) == self._step_jaxpr(
+            self._plain_model()
+        )
+        # And the resilience-free state carries none of the new leaves.
+        compiled = _Compiled(self._plain_model())
+        state = compiled.init_state(
+            jnp.zeros((2,), jnp.uint32),
+            {"src_rate": jnp.ones((1,)), "srv_mean": jnp.ones((1,))},
+        )
+        assert not any(k.startswith(("brk_", "bud_")) for k in state)
+        assert "srv_shed_dropped" not in state
+
+    def test_resilience_state_leaves_checkpoint_roundtrip(self, tmp_path):
+        """Full-stack checkpoint: snapshot mid-run, save to npz, resume,
+        land on the uninterrupted run's exact counters."""
+        def build():
+            model = _storm_model(True)
+            model.load_shed(policy="queue_depth", threshold=400)
+            return model
+
+        kwargs = dict(n_replicas=8, seed=5, mesh=_mesh(), max_events=2048)
+        snapshots = []
+        full = run_ensemble(
+            build(),
+            checkpoint_every_s=0.0,
+            checkpoint_callback=snapshots.append,
+            **kwargs,
+        )
+        assert snapshots
+        for leaf in (
+            "brk_state", "brk_fail_t", "brk_fail_idx", "brk_open_t",
+            "brk_probes", "brk_tripped", "brk_open_time",
+            "srv_breaker_dropped", "srv_shed_dropped",
+            "bud_tokens", "bud_last", "srv_budget_dropped",
+        ):
+            assert leaf in snapshots[0].state, leaf
+        path = str(tmp_path / "resilience-ck")
+        snapshots[0].save(path)
+        from happysim_tpu.tpu import EnsembleCheckpoint
+
+        resumed = run_ensemble(
+            build(),
+            resume_from=EnsembleCheckpoint.load(path),
+            checkpoint_callback=lambda snap: None,
+            **kwargs,
+        )
+        assert resumed.sink_count == full.sink_count
+        assert resumed.breaker_tripped == full.breaker_tripped
+        assert resumed.server_breaker_dropped == full.server_breaker_dropped
+        assert resumed.server_budget_dropped == full.server_budget_dropped
+        assert resumed.server_shed_dropped == full.server_shed_dropped
+        assert resumed.breaker_open_fraction == full.breaker_open_fraction
+
+    def test_resilience_declines_the_chain_fast_path(self):
+        """A resilient model must run the event scan (the closed form
+        cannot price breaker windows / shed gates / budget coupling)."""
+        from happysim_tpu.tpu.chain import fast_plan
+        from happysim_tpu.tpu.model import mm1_model
+
+        base = mm1_model(lam=4.0, mu=9.0, horizon_s=4.0)
+        assert fast_plan(base) is not None
+        for install in (
+            lambda m: (
+                setattr(m.servers[0], "deadline_s", 0.5),
+                m.circuit_breaker(),
+            ),
+            lambda m: m.load_shed(policy="queue_depth", threshold=4),
+            lambda m: (
+                setattr(m.servers[0], "deadline_s", 0.5),
+                setattr(m.servers[0], "max_retries", 1),
+                m.retry_budget(ratio=0.1),
+            ),
+        ):
+            model = mm1_model(lam=4.0, mu=9.0, horizon_s=4.0)
+            install(model)
+            assert fast_plan(model) is None
+
+    def test_partitioned_rejects_resilience_by_name(self):
+        from happysim_tpu.tpu.partitioned import run_partitioned
+
+        model = EnsembleModel(horizon_s=2.0)
+        src = model.source(rate=4.0)
+        srv = model.server(service_mean=0.05, deadline_s=0.5, max_retries=1)
+        snk = model.sink()
+        model.remote(ingress=srv, latency_s=0.5)
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        model.retry_budget(ratio=0.2)
+        with pytest.raises(ValueError) as excinfo:
+            run_partitioned(model, window_s=0.25)
+        message = str(excinfo.value)
+        assert "retry_budget" in message
+        assert "run_ensemble" in message

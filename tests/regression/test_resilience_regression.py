@@ -1,0 +1,336 @@
+"""Pinned-seed goldens for the FULL resilience stack on both engine paths.
+
+ISSUE 15 added the vectorized defense layer — circuit breakers (exact
+sliding-window failure rings, closed->open->half-open per replica x
+server), load shedding (queue-depth admission gates with a priority
+Bernoulli), and retry budgets (token buckets gating every backoff /
+deadline-retry / hedge launch) — composed here with the whole chaos
+stack the kernel already fuses (correlated outage faults, backoff+jitter
+retries, hedging, a brownout window, packet loss, a token-bucket
+limiter, windowed telemetry) on the router fan-out shape. These goldens
+pin the stack on BOTH engine paths AND on 1 and 8 (virtual) devices: the
+breaker trip/drop counters, shed/budget suppressions, and the per-window
+open-fraction vector are the defense trace itself, so a divergence in
+any resilience branch (a ring write, a lazy cooldown transition, a probe
+admission, a token debit) shows up as an exact-count mismatch.
+
+Golden provenance: seed=123, 8 replicas, source rate=6 -> limiter
+(8/s, cap 4) -> round_robin router -> 4 servers (service_mean=0.35 —
+rho ~0.5 per target so queues actually form and the shed gate fires —
+cap=8, deadline 1.1s + 2 backoff retries with 50% jitter; servers 0/2
+hedge at 0.6s; servers 0/1 carry correlated outage-mode faults; server 3
+a [1.0, 1.5) brownout) -> sink, per-target edges cycling (0.01 constant,
+0.02 exponential, latency-free) with 5% loss on even targets,
+correlated_outages(rate=0.2, mean=0.4, trigger_p=0.5), 8-window
+telemetry, breaker(threshold=2, window=1.0, cooldown=0.4, probes=1),
+load_shed(queue_depth, threshold=2, priority_fraction=0.25),
+retry_budget(ratio=0.15, min_per_s=0.3, burst=2.0), horizon=4s,
+transit_capacity=8, macro_block=4, max_events=320, recorded on the CPU
+interpret path (bit-identical to the compiled TPU kernel by
+construction — the kernel body IS the traced step closure).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas")
+
+import jax
+
+# slow: four compiled programs (2 engine paths x 2 mesh shapes) of
+# interpret-mode XLA on CPU — beyond the tier-1 envelope (tier-1 keeps
+# the cheap breaker-trips canary in test_engine_path_reasons). The CI
+# kernel-equivalence gate runs this file explicitly on every push/PR,
+# and the nightly slow tier replays it.
+pytestmark = pytest.mark.slow
+
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.kernels import env_override
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+ALL_CHAOS = (
+    "faults",
+    "correlated_outages",
+    "backoff_retries",
+    "hedging",
+    "brownouts",
+    "packet_loss",
+    "limiters",
+    "circuit_breaker",
+    "load_shed",
+    "retry_budget",
+    "telemetry",
+)
+
+GOLDEN = {
+    "simulated_events": 567,
+    "sink_count": [148],
+    "server_completed": [43, 37, 40, 33],
+    "server_dropped": [0, 0, 0, 0],
+    "server_timed_out": [0, 0, 0, 0],
+    "server_retried": [0, 3, 0, 2],
+    "server_fault_dropped": [2, 3, 0, 0],
+    "server_fault_retried": [1, 10, 0, 0],
+    "server_hedged": [11, 0, 8, 0],
+    "server_hedge_wins": [2, 0, 1, 0],
+    "server_outage_dropped": [0, 0, 0, 7],
+    "transit_dropped": [0, 0, 0, 0],
+    "limiter_admitted": [199],
+    "limiter_dropped": [5],
+    "network_lost": 5,
+    "truncated_replicas": 0,
+    "server_breaker_dropped": [1, 7, 0, 3],
+    "breaker_tripped": [1, 8, 0, 2],
+    "server_shed_dropped": [0, 1, 0, 0],
+    "server_budget_dropped": [2, 3, 0, 0],
+    "breaker_open_fraction": [
+        0.012500000186264515,
+        0.10000000149011612,
+        0.0,
+        0.02500000037252903,
+    ],
+    "sink_mean_latency_s": 0.3171330722602638,
+    "sink_p50_s": 0.2818382931264455,
+    "sink_p99_s": 1.122018454301963,
+    # Per-window p99(t): the windowed-series pin (8 windows x 1 sink).
+    "p99_t": [
+        0.2818382931264455,
+        0.7079457843841374,
+        1.122018454301963,
+        0.5623413251903491,
+        0.8912509381337459,
+        0.7079457843841374,
+        1.122018454301963,
+        0.8912509381337459,
+    ],
+    "window_sink_count": [13, 19, 27, 13, 19, 25, 15, 17],
+    "window_breaker_dropped": [0, 1, 2, 1, 3, 1, 2, 1],
+    "window_shed_dropped": [0, 0, 0, 0, 0, 0, 0, 1],
+    "window_budget_dropped": [0, 0, 0, 1, 1, 1, 2, 0],
+    "window_tripped": [0, 1, 2, 1, 3, 1, 2, 1],
+}
+
+# Whole-run counters whose windowed series must sum to them exactly —
+# including every NEW resilience counter (the scatter sites derive from
+# the one window-assignment helper, so the invariant catches a site
+# booking into the wrong buffer).
+_WINDOWED_TWINS = {
+    "server_completed": "server_completed",
+    "server_retried": "server_retried",
+    "server_fault_dropped": "server_fault_dropped",
+    "server_fault_retried": "server_fault_retried",
+    "server_hedged": "server_hedged",
+    "server_hedge_wins": "server_hedge_wins",
+    "server_outage_dropped": "server_outage_dropped",
+    "limiter_admitted": "limiter_admitted",
+    "limiter_dropped": "limiter_dropped",
+    "server_breaker_dropped": "server_breaker_dropped",
+    "breaker_tripped": "breaker_tripped",
+    "server_shed_dropped": "server_shed_dropped",
+    "server_budget_dropped": "server_budget_dropped",
+}
+
+
+def _build():
+    model = EnsembleModel(horizon_s=4.0, macro_block=4, transit_capacity=8)
+    src = model.source(rate=6.0)
+    lim = model.limiter(refill_rate=8.0, capacity=4.0)
+    servers = []
+    for index in range(4):
+        servers.append(
+            model.server(
+                service_mean=0.35,
+                queue_capacity=8,
+                deadline_s=1.1,
+                max_retries=2,
+                retry_backoff_s=0.05,
+                retry_jitter=0.5,
+                hedge_delay_s=0.6 if index % 2 == 0 else None,
+                fault=FaultSpec(
+                    rate=0.4, mean_duration_s=0.3, correlated=True
+                )
+                if index < 2
+                else None,
+                outage=(1.0, 1.5) if index == 3 else None,
+            )
+        )
+    model.correlated_outages(rate=0.2, mean_duration_s=0.4, trigger_p=0.5)
+    router = model.router(policy="round_robin")
+    snk = model.sink()
+    model.connect(src, lim)
+    model.connect(lim, router)
+    edge_mix = [(0.01, "constant"), (0.02, "exponential"), (0.0, "constant")]
+    for index, server in enumerate(servers):
+        latency_s, kind = edge_mix[index % len(edge_mix)]
+        model.connect(
+            router,
+            server,
+            latency_s=latency_s,
+            latency_kind=kind,
+            loss_p=0.05 if index % 2 == 0 else 0.0,
+        )
+        model.connect(server, snk)
+    model.telemetry(window_s=0.5)
+    model.circuit_breaker(
+        failure_threshold=2, window_s=1.0, cooldown_s=0.4, half_open_probes=1
+    )
+    model.load_shed(policy="queue_depth", threshold=2, priority_fraction=0.25)
+    model.retry_budget(ratio=0.15, min_per_s=0.3, burst=2.0)
+    return model
+
+
+def _pinned_run(pallas: bool, n_devices: int):
+    with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+        return run_ensemble(
+            _build(),
+            n_replicas=8,
+            seed=123,
+            mesh=replica_mesh(jax.devices("cpu")[:n_devices]),
+            max_events=320,
+        )
+
+
+@pytest.fixture(
+    scope="module",
+    params=[
+        (True, 1),
+        (False, 1),
+        (True, 8),
+        (False, 8),
+    ],
+    ids=["pallas-1dev", "lax-1dev", "pallas-8dev", "lax-8dev"],
+)
+def pinned(request):
+    """BOTH engine paths x BOTH mesh shapes, each asserted against the
+    SAME golden — a joint drift of kernel and lax (or of the mesh
+    reduce) cannot slip through."""
+    pallas, n_devices = request.param
+    return _pinned_run(pallas, n_devices), pallas, n_devices
+
+
+def test_engine_path(pinned):
+    result, pallas, n_devices = pinned
+    if pallas:
+        assert result.engine_path == "scan+pallas", result.kernel_decline
+        assert result.kernel_decline == ""
+        assert result.kernel_shape == "router"
+        assert result.kernel_chaos == ALL_CHAOS
+    else:
+        assert result.engine_path == "scan"
+        assert result.kernel_chaos == ()
+    assert result.resilience_features == (
+        "circuit_breaker",
+        "load_shed",
+        "retry_budget",
+    )
+    assert result.engine_report()["mesh"]["devices"] == n_devices
+
+
+def test_resilience_counters_match_golden(pinned):
+    """The defense trace itself: breaker trips/drops, shed rejections,
+    budget suppressions, and every chaos counter they modulate — exact
+    at the pinned seed on all four legs."""
+    result, _pallas, _n_devices = pinned
+    for key in (
+        "simulated_events",
+        "sink_count",
+        "server_completed",
+        "server_dropped",
+        "server_timed_out",
+        "server_retried",
+        "server_fault_dropped",
+        "server_fault_retried",
+        "server_hedged",
+        "server_hedge_wins",
+        "server_outage_dropped",
+        "transit_dropped",
+        "limiter_admitted",
+        "limiter_dropped",
+        "network_lost",
+        "truncated_replicas",
+        "server_breaker_dropped",
+        "breaker_tripped",
+        "server_shed_dropped",
+        "server_budget_dropped",
+    ):
+        assert getattr(result, key) == GOLDEN[key], key
+    np.testing.assert_allclose(
+        result.breaker_open_fraction,
+        GOLDEN["breaker_open_fraction"],
+        rtol=1e-12,
+    )
+
+
+def test_latency_and_windowed_series_match_golden(pinned):
+    result, _pallas, _n_devices = pinned
+    assert result.sink_mean_latency_s[0] == pytest.approx(
+        GOLDEN["sink_mean_latency_s"], rel=1e-12
+    )
+    assert result.sink_p50_s[0] == pytest.approx(
+        GOLDEN["sink_p50_s"], rel=1e-12
+    )
+    assert result.sink_p99_s[0] == pytest.approx(
+        GOLDEN["sink_p99_s"], rel=1e-12
+    )
+    series = result.timeseries
+    assert series is not None and series.n_windows == 8
+    np.testing.assert_allclose(
+        np.asarray(series.sink_p99_s)[:, 0], GOLDEN["p99_t"], rtol=1e-12
+    )
+    np.testing.assert_array_equal(
+        np.asarray(series.sink_count)[:, 0], GOLDEN["window_sink_count"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(series.server_breaker_dropped).sum(axis=1),
+        GOLDEN["window_breaker_dropped"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(series.server_shed_dropped).sum(axis=1),
+        GOLDEN["window_shed_dropped"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(series.server_budget_dropped).sum(axis=1),
+        GOLDEN["window_budget_dropped"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(series.breaker_tripped).sum(axis=1),
+        GOLDEN["window_tripped"],
+    )
+
+
+def test_windowed_sums_equal_whole_run_counters(pinned):
+    """Every counter's windowed series — the resilience counters
+    included — sums exactly to its whole-run twin, and the per-window
+    breaker open-fraction integral re-totals the whole-run open
+    fraction (float32 re-association aside)."""
+    result, _pallas, _n_devices = pinned
+    series = result.timeseries
+    for series_name, result_name in _WINDOWED_TWINS.items():
+        windowed = np.asarray(getattr(series, series_name)).sum(axis=0)
+        np.testing.assert_array_equal(
+            windowed, np.asarray(getattr(result, result_name)),
+            err_msg=series_name,
+        )
+    assert int(np.asarray(series.network_lost).sum()) == result.network_lost
+    open_windowed = (
+        np.asarray(series.breaker_open_fraction)
+        * np.asarray(series.window_len_s)[:, None]
+    ).sum(axis=0) / result.horizon_s
+    np.testing.assert_allclose(
+        open_windowed, result.breaker_open_fraction, rtol=1e-5, atol=1e-9
+    )
+
+
+def test_golden_exercises_every_resilience_class():
+    """Sanity on the golden itself: each defense actually fired at the
+    pinned seed (a golden of zeros would pin nothing)."""
+    assert sum(GOLDEN["breaker_tripped"]) > 0  # breakers tripped
+    assert sum(GOLDEN["server_breaker_dropped"]) > 0  # ...and failed fast
+    assert max(GOLDEN["breaker_open_fraction"]) > 0.0  # open time booked
+    assert sum(GOLDEN["server_shed_dropped"]) > 0  # admission shed
+    assert sum(GOLDEN["server_budget_dropped"]) > 0  # launches suppressed
+    assert sum(GOLDEN["server_fault_retried"]) > 0  # chaos still flowing
+    assert sum(GOLDEN["server_hedged"]) > 0
+    assert GOLDEN["network_lost"] > 0
+    assert sum(GOLDEN["limiter_dropped"]) > 0

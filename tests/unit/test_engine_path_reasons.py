@@ -151,6 +151,89 @@ def test_chaos_stack_decline_removed(monkeypatch):
     assert result.timeseries is not None
 
 
+def _resilient_chaos_mm1():
+    """The tier-1 RESILIENCE canary (ISSUE 15): the chaos canary with
+    the full defense layer on top — breaker tuned to trip at this seed,
+    queue-depth shedding with a priority fraction, and a retry budget
+    tight enough to suppress launches. Chain-shaped and macro_block=2
+    so the interpret-mode compile stays inside the tier-1 envelope."""
+    model = _chaos_mm1()
+    model.circuit_breaker(
+        failure_threshold=1, window_s=0.5, cooldown_s=0.3, half_open_probes=1
+    )
+    model.load_shed(policy="queue_depth", threshold=2, priority_fraction=0.25)
+    model.retry_budget(ratio=0.1, min_per_s=0.2, burst=1.0)
+    return model
+
+
+ALL_RESILIENCE = ("circuit_breaker", "load_shed", "retry_budget")
+
+
+def test_resilience_stack_runs_fused_and_breaker_trips(monkeypatch):
+    """ISSUE-15 contract + the tier-1 breaker-trips canary: the defense
+    layer adds NO decline reasons — breaker + shed + budget on the
+    chaos canary still runs engine_path == "scan+pallas" when forced,
+    the resilience features reach kernel_chaos / engine_report, and the
+    breaker actually TRIPS at this seed (a canary of zeros would pin
+    nothing)."""
+    pytest.importorskip("jax.experimental.pallas")
+    from happysim_tpu.tpu.kernels import kernel_plan
+
+    plan, reason = kernel_plan(_resilient_chaos_mm1())
+    assert plan is not None and reason == ""
+    assert plan["chaos"] == ALL_CHAOS[:-1] + ALL_RESILIENCE + ("telemetry",)
+
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _resilient_chaos_mm1(),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=64,
+    )
+    assert result.engine_path == "scan+pallas", result.kernel_decline
+    assert result.kernel_decline == ""
+    assert result.resilience_features == ALL_RESILIENCE
+    report = result.engine_report()["resilience"]
+    assert report["circuit_breaker"] and report["load_shed"] and report["retry_budget"]
+    # The canary teeth: the breaker tripped and short-circuited work.
+    assert sum(result.breaker_tripped) > 0
+    assert sum(result.server_breaker_dropped) > 0
+    assert report["breaker_tripped_total"] == sum(result.breaker_tripped)
+    assert max(result.breaker_open_fraction) > 0.0
+
+
+def test_resilience_adds_no_decline_reasons():
+    """The per-feature decline list stays purely topological: the same
+    declined shape (adaptive policy + rate profile) collects the same
+    "; "-joined reasons with and without the full defense layer, and no
+    resilience feature name ever appears in a decline."""
+    from happysim_tpu.tpu.kernels import kernel_plan
+    from happysim_tpu.tpu.model import RateProfile
+
+    def declined(defended: bool):
+        model = _router_model()  # least_outstanding: adaptive
+        model.sources[0].profile = RateProfile(
+            kind="ramp", end_rate=9.0, ramp_duration_s=0.5
+        )
+        if defended:
+            for server in model.servers:
+                server.deadline_s = 0.3
+                server.max_retries = 1
+            model.circuit_breaker()
+            model.load_shed(policy="utilization", threshold=1.0)
+            model.retry_budget(ratio=0.2)
+        return kernel_plan(model)
+
+    plan, bare_reason = declined(False)
+    assert plan is None
+    plan, defended_reason = declined(True)
+    assert plan is None
+    assert defended_reason == bare_reason
+    for feature in ALL_RESILIENCE:
+        assert feature not in defended_reason
+
+
 def test_kernel_decline_surfaces_every_reason(monkeypatch):
     """ISSUE-14 satellite: EnsembleResult.kernel_decline carries the
     FULL decline list (``; ``-joined, first reason first), not just the

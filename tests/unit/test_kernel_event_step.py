@@ -157,6 +157,23 @@ def _chaos_fanout():
     return model
 
 
+def _resilience_fanout():
+    """ISSUE 15's defense layer on top of the full chaos fan-out: every
+    server carries a circuit breaker state machine (the block-level
+    breaker matrix), admission-control load shedding with a priority
+    fraction (its Bernoulli is an ordinary uniform slot), and a retry
+    budget gating the backoff/hedge launch sites — all per-lane state
+    columns inside the traced step closure, so the fused block stays
+    bit-identical by the same argument as the chaos stack."""
+    model = _chaos_fanout()
+    model.circuit_breaker(
+        failure_threshold=2, window_s=0.5, cooldown_s=0.3, half_open_probes=1
+    )
+    model.load_shed(policy="queue_depth", threshold=2, priority_fraction=0.25)
+    model.retry_budget(ratio=0.2, min_per_s=0.5, burst=2.0)
+    return model
+
+
 def _init_batch(compiled, n_replicas, seed=0):
     keys = jax.random.split(jax.random.PRNGKey(seed), n_replicas)
     params = {
@@ -218,6 +235,7 @@ MACRO = 2
         pytest.param(_router_weighted, marks=pytest.mark.slow),
         pytest.param(_router_faulted_telemetry, marks=pytest.mark.slow),
         pytest.param(_chaos_fanout, marks=pytest.mark.slow),
+        pytest.param(_resilience_fanout, marks=pytest.mark.slow),
     ],
 )
 def test_block_kernel_bit_identical_to_lax_scan(build):
@@ -244,6 +262,52 @@ def test_block_kernel_bit_identical_to_lax_scan(build):
     lax_out = _lax_block(compiled, horizon, state, U, params)
 
     assert set(kernel_out) == set(lax_out)
+    for name in sorted(lax_out):
+        np.testing.assert_array_equal(
+            np.asarray(kernel_out[name]),
+            np.asarray(lax_out[name]),
+            err_msg=f"leaf {name} diverged",
+        )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "breaker_kwargs",
+    [
+        dict(failure_threshold=1, window_s=0.2, cooldown_s=0.2, half_open_probes=1),
+        dict(failure_threshold=3, window_s=0.5, cooldown_s=0.3, half_open_probes=2),
+        dict(failure_threshold=5, window_s=1.0, cooldown_s=0.5, half_open_probes=4),
+    ],
+    ids=["trip-on-first", "sliding-3", "wide-5"],
+)
+def test_block_kernel_breaker_matrix(breaker_kwargs):
+    """ISSUE-15 breaker matrix: the closed->open->half-open machine is
+    block-identical kernel-vs-lax across threshold/window/cooldown/probe
+    corners — the sliding-window ring write, the lazy cooldown
+    transition, and the probe quota are all per-lane ops inside the
+    traced closure, so every corner must agree bit for bit."""
+    model = _faulted_telemetry_chain()
+    model.servers[0].deadline_s = 0.3
+    model.circuit_breaker(**breaker_kwargs)
+    compiled = _Compiled(model)
+    horizon = float(model.horizon_s)
+    n_replicas = 4
+    keys, params, state = _init_batch(compiled, n_replicas)
+    U = jax.vmap(
+        lambda k: jax.random.uniform(
+            jax.random.fold_in(k, 0),
+            (MACRO, compiled.n_draws),
+            minval=1e-12,
+            maxval=1.0,
+        )
+    )(keys)
+    block_fn, _meta = build_block_step(
+        compiled, horizon, MACRO, n_replicas, interpret=True
+    )
+    kernel_out = block_fn(state, U, params)
+    lax_out = _lax_block(compiled, horizon, state, U, params)
+    assert set(kernel_out) == set(lax_out)
+    assert any(name.startswith("brk_") for name in kernel_out)
     for name in sorted(lax_out):
         np.testing.assert_array_equal(
             np.asarray(kernel_out[name]),
@@ -564,6 +628,68 @@ class TestDeclinePredicate:
             "servers": [0],
             "chaos": ("packet_loss", "limiters"),
         }
+
+    def test_resilience_layer_is_supported(self):
+        """ISSUE 15: the defense layer (breaker, shed, budget) adds NO
+        kernel_plan declines — its state columns and the shed priority
+        Bernoulli are per-lane machinery inside the traced closure, so
+        declines stay purely topological. The plan's chaos descriptor
+        records the resilience names (engine_report provenance)."""
+        model = _resilience_fanout()
+        plan, reason = kernel_plan(model)
+        assert reason == ""
+        assert plan["shape"] == "router"
+        assert set(
+            ("circuit_breaker", "load_shed", "retry_budget")
+        ) <= set(plan["chaos"])
+
+    def test_resilience_on_unfused_shapes_collects_topology_reasons(self):
+        """A resilience-laden model on a declined SHAPE surfaces every
+        topology reason via the PR-14 "; "-join — and no resilience
+        feature is ever named as a decline (there are none)."""
+        model = _router_fanout("least_outstanding")
+        model.sources[0].profile = __import__(
+            "happysim_tpu.tpu.model", fromlist=["RateProfile"]
+        ).RateProfile(kind="ramp", end_rate=9.0, ramp_duration_s=0.5)
+        for index in range(4):
+            model.servers[index].deadline_s = 0.3
+            model.servers[index].max_retries = 1
+        model.circuit_breaker()
+        model.load_shed(policy="queue_depth", threshold=2)
+        model.retry_budget(ratio=0.2)
+        model.validate()
+        plan, reason = kernel_plan(model)
+        assert plan is None
+        assert "rate profile" in reason and "least_outstanding" in reason
+        assert reason.index("rate profile") < reason.index("least_outstanding")
+        for feature in ("circuit_breaker", "load_shed", "retry_budget"):
+            assert feature not in reason
+
+    def test_breaker_ring_counts_toward_the_vmem_budget(self, monkeypatch):
+        """The tile=1 budget decline names the new state leaves: a
+        pathological failure_threshold makes the (nV, F) failure-time
+        ring dominate the working set, and kernel_decision's decline
+        must name ``brk_fail_t`` so the user knows which knob to
+        shrink."""
+        from happysim_tpu.tpu.engine import _Compiled as Compiled
+        from happysim_tpu.tpu.kernels import kernel_decision
+        from happysim_tpu.tpu.mesh import replica_mesh
+
+        monkeypatch.setenv("HS_TPU_PALLAS", "1")
+        model = _mm1()
+        model.servers[0].deadline_s = 0.5
+        # 2^20 ring slots x 4 B x 2 (aliased in+out tiles) > 4 MiB alone.
+        model.circuit_breaker(failure_threshold=1 << 20, window_s=1.0)
+        use, note = kernel_decision(
+            model,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            checkpointing=False,
+            macro=2,
+            compiled=Compiled(model),
+        )
+        assert not use
+        assert "brk_fail_t" in note
+        assert "tile=1" in note
 
     def test_declines_profiles(self):
         ramped = EnsembleModel(horizon_s=5.0)

@@ -136,6 +136,11 @@ def _rich_state_keys():
     model.connect(first, snk, loss_p=0.01)  # -> net_lost
     model.connect(second, snk)
     model.telemetry(window_s=1.0)
+    # Resilience layer (ISSUE 15) -> breaker columns + budget bucket +
+    # shed counter, all of which must match a partition rule.
+    model.circuit_breaker(failure_threshold=2)
+    model.load_shed(policy="queue_depth", threshold=2)
+    model.retry_budget(ratio=0.2)
     compiled = _Compiled(model)
     struct = jax.eval_shape(
         compiled.init_state,
@@ -161,6 +166,8 @@ class TestPartitionRules:
         for expected in (
             "flt_start", "tel_sink_count", "tr_time", "srv_q_attempt",
             "rr_next", "net_lost", "key", "t", "events",
+            "brk_state", "brk_fail_t", "brk_open_time",
+            "bud_tokens", "srv_shed_dropped", "srv_budget_dropped",
         ):
             assert expected in keys, f"fixture lost the {expected} leaf"
         specs = ensemble_state_specs(keys)

@@ -288,3 +288,125 @@ class TestFactories:
         model.connect(a, b)
         model.connect(b, sink)
         assert model.max_queue_capacity == 64
+
+
+class TestResilienceSpecs:
+    """The resilience-layer builders (ISSUE 15): every rejection rule
+    plus the feature-descriptor contract the kernel claim reads."""
+
+    def _chain(self, **server_kwargs):
+        model = base()
+        source = model.source(rate=5.0)
+        server = model.server(service_mean=0.1, **server_kwargs)
+        sink = model.sink()
+        model.connect(source, server)
+        model.connect(server, sink)
+        return model
+
+    def test_breaker_spec_bounds(self):
+        model = self._chain(deadline_s=0.5)
+        with pytest.raises(ValueError, match="failure_threshold"):
+            model.circuit_breaker(failure_threshold=0)
+        with pytest.raises(ValueError, match="window_s"):
+            model.circuit_breaker(window_s=0.0)
+        with pytest.raises(ValueError, match="cooldown_s"):
+            model.circuit_breaker(cooldown_s=-1.0)
+        with pytest.raises(ValueError, match="half_open_probes"):
+            model.circuit_breaker(half_open_probes=0)
+
+    def test_breaker_requires_a_failure_site(self):
+        model = self._chain()  # no deadline, fault, or brownout anywhere
+        model.circuit_breaker()
+        with pytest.raises(ValueError, match="failure site"):
+            model.validate()
+        for site in (
+            dict(deadline_s=0.5),
+            dict(fault=FaultSpec(rate=0.5, mean_duration_s=0.2)),
+            dict(outage=(1.0, 2.0)),
+        ):
+            model = self._chain(**site)
+            model.circuit_breaker()
+            model.validate()
+
+    def test_breaker_rejects_degrade_only_fault_site(self):
+        """A degrade-mode fault slows service but never rejects an
+        arrival, so alone it is NOT a failure signal the breaker can
+        observe — rejected unless a deadline turns the slowdown into
+        timeouts."""
+        degrade = FaultSpec(
+            rate=0.5, mean_duration_s=0.2, mode="degrade", latency_factor=3.0
+        )
+        model = self._chain(fault=degrade)
+        model.circuit_breaker()
+        with pytest.raises(ValueError, match="failure site"):
+            model.validate()
+        model = self._chain(fault=degrade, deadline_s=0.5)
+        model.circuit_breaker()
+        model.validate()
+
+    def test_shed_spec_bounds(self):
+        model = self._chain()
+        with pytest.raises(ValueError, match="policy"):
+            model.load_shed(policy="latency")
+        with pytest.raises(ValueError, match="queue_depth threshold"):
+            model.load_shed(policy="queue_depth", threshold=0)
+        with pytest.raises(ValueError, match="utilization threshold"):
+            model.load_shed(policy="utilization", threshold=1.5)
+        with pytest.raises(ValueError, match="priority_fraction"):
+            model.load_shed(priority_fraction=1.0)
+        model.load_shed(policy="utilization", threshold=1.0)
+        model.validate()
+
+    def test_budget_spec_bounds(self):
+        model = self._chain(deadline_s=0.5, max_retries=2)
+        with pytest.raises(ValueError, match="ratio"):
+            model.retry_budget(ratio=-0.1)
+        with pytest.raises(ValueError, match="never refill"):
+            model.retry_budget(ratio=0.0, min_per_s=0.0)
+        with pytest.raises(ValueError, match="burst"):
+            model.retry_budget(ratio=0.1, burst=0.5)
+        model.retry_budget(ratio=0.1)
+        model.validate()
+
+    def test_budget_requires_a_consumer(self):
+        model = self._chain()  # no retries, no hedging
+        model.retry_budget(ratio=0.1)
+        with pytest.raises(ValueError, match="gate nothing"):
+            model.validate()
+        model = self._chain(hedge_delay_s=0.2)
+        model.retry_budget(ratio=0.1)
+        model.validate()  # hedges alone are a consumer
+
+    def test_resilience_features_descriptor(self):
+        model = self._chain(deadline_s=0.5, max_retries=1)
+        assert model.resilience_features() == ()
+        model.circuit_breaker()
+        model.load_shed(policy="queue_depth", threshold=4)
+        model.retry_budget(ratio=0.1)
+        assert model.resilience_features() == (
+            "circuit_breaker",
+            "load_shed",
+            "retry_budget",
+        )
+        # The chaos descriptor (the kernel's claim surface) includes the
+        # resilience names, keeping telemetry last.
+        model.telemetry(window_s=1.0)
+        features = model.chaos_features()
+        assert features[-1] == "telemetry"
+        assert set(
+            ("circuit_breaker", "load_shed", "retry_budget")
+        ) <= set(features)
+
+    def test_resilience_specs_join_the_fingerprint_only_when_present(self):
+        from happysim_tpu.tpu.engine import model_fingerprint
+
+        plain = self._chain(deadline_s=0.5, max_retries=1)
+        baseline = model_fingerprint(plain)
+        defended = self._chain(deadline_s=0.5, max_retries=1)
+        defended.retry_budget(ratio=0.1)
+        assert model_fingerprint(defended) != baseline
+        # ...and a second spec-free build reproduces the baseline, so
+        # pre-resilience checkpoints keep their fingerprints.
+        assert model_fingerprint(
+            self._chain(deadline_s=0.5, max_retries=1)
+        ) == baseline
