@@ -55,6 +55,21 @@ MULTICHIP_WINDOWS = 32
 MULTICHIP_MAX_EVENTS = 640
 MULTICHIP_VIRTUAL_DEVICES = 8
 
+# Consensus entry (ISSUE 16): quorum-liveness rho-sweep — a 3-server
+# quorum cluster losing its majority to a deterministic partition
+# window, defended (breaker + retry budget) vs undefended (quorum
+# rejections retry freely and the post-heal storm depresses goodput).
+# Consensus declines the Pallas kernel BY NAME, so both arms run the
+# lax scan; the bench instead asserts 1-vs-N-device mesh bit-identity
+# on every consensus counter and windowed series. On a single-chip
+# host the measurement runs on the virtual 8-device CPU mesh in a
+# child process (same pattern as MULTICHIP), at reduced scale.
+CONSENSUS_REPLICAS = 65536
+CONSENSUS_VIRTUAL_REPLICAS = 512
+CONSENSUS_HORIZON_S = 12.0
+CONSENSUS_WINDOWS = 16
+CONSENSUS_VIRTUAL_DEVICES = 8
+
 
 def _tpu_probe(timeout_s: float = 90.0) -> str:
     """Probe JAX init in a child process — a wedged TPU tunnel blocks
@@ -1358,6 +1373,228 @@ def _multichip_virtual_child() -> int:
     return 0
 
 
+def _consensus_measure(devices, n_devices: int, virtual: bool) -> dict:
+    """Quorum-liveness under partition at ensemble scale: a rho-sweep
+    3-server quorum cluster (write=2, read=2) whose majority {s1, s2}
+    is cut by a deterministic partition window, run as two arms —
+    UNDEFENDED (every quorum rejection retries on a backoff; the
+    post-heal storm of deadline retries keeps demand above capacity)
+    and DEFENDED (retry budget + circuit breaker fail the dark window
+    fast and cap the storm) — each recording
+    ``availability_recovery_ratio`` = post-heal / pre-partition
+    per-window goodput. Both arms run the lax scan (consensus declines
+    the kernel by name); 1-vs-n-device mesh bit-identity of every
+    consensus counter AND windowed series is asserted instead.
+    """
+    import numpy as np
+
+    from happysim_tpu.tpu import run_ensemble
+    from happysim_tpu.tpu.mesh import replica_mesh
+    from happysim_tpu.tpu.model import EnsembleModel
+
+    mu = 8.0  # per server; 3 servers -> cluster capacity 3 mu
+    horizon = CONSENSUS_HORIZON_S
+    n_windows = CONSENSUS_WINDOWS
+    dark = (0.3 * horizon, 0.45 * horizon)
+    n_replicas = CONSENSUS_VIRTUAL_REPLICAS if virtual else CONSENSUS_REPLICAS
+
+    def build(defended: bool):
+        model = EnsembleModel(horizon_s=horizon, transit_capacity=16)
+        src = model.source(rate=0.6 * 3 * mu)  # swept per replica below
+        servers = [
+            model.server(
+                service_mean=1.0 / mu,
+                queue_capacity=512,
+                deadline_s=0.5,
+                max_retries=3,
+                retry_backoff_s=1.0,
+            )
+            for _ in range(3)
+        ]
+        router = model.router(policy="round_robin")
+        snk = model.sink()
+        model.connect(src, router)
+        for server in servers:
+            model.connect(
+                router, server, latency_s=0.005, latency_kind="constant"
+            )
+            model.connect(server, snk)
+        model.telemetry(
+            window_s=horizon / n_windows, metrics=("throughput", "rates")
+        )
+        model.network_partition(group=[servers[1], servers[2]], windows=(dark,))
+        model.quorum(servers, write=2, read=2)
+        model.leader_election(servers, heartbeat_s=0.25, timeout_s=0.75)
+        if defended:
+            model.circuit_breaker(
+                failure_threshold=5,
+                window_s=1.0,
+                cooldown_s=0.5,
+                half_open_probes=2,
+            )
+            model.retry_budget(ratio=0.1, min_per_s=0.5, burst=4.0)
+        return model
+
+    # rho sweep of CLUSTER load: stable at base rate, but the dark
+    # window converts every arrival into quorum-rejected retries.
+    sweeps = {
+        "source_rate": np.linspace(
+            0.45 * 3 * mu, 0.7 * 3 * mu, n_replicas
+        ).astype(np.float32)
+    }
+    max_events = int(12.0 * 0.7 * 3 * mu * horizon) + 64
+
+    def run(defended: bool, nd: int):
+        return run_ensemble(
+            build(defended),
+            n_replicas=n_replicas,
+            seed=0,
+            mesh=replica_mesh(devices[:nd]),
+            sweeps=sweeps,
+            max_events=max_events,
+        )
+
+    def recovery_ratio(result) -> float:
+        windows = result.timeseries.sink_count[:, 0].astype(np.float64)
+        first_dark = int(dark[0] / (horizon / n_windows))
+        pre = windows[1:first_dark].mean()  # skip the empty-start window
+        post = windows[-3:].mean()
+        return float(post / pre) if pre > 0 else 0.0
+
+    consensus_counters = (
+        "simulated_events",
+        "sink_count",
+        "network_partitioned",
+        "server_quorum_dropped",
+        "quorum_dark_fraction",
+        "leader_changes",
+        "time_without_leader_fraction",
+        "server_retried",
+        "server_timed_out",
+        "truncated_replicas",
+    )
+    arms = {}
+    for defended in (False, True):
+        single = run(defended, 1)
+        multi = run(defended, n_devices)
+        assert single.engine_path == "scan" and multi.engine_path == "scan"
+        identical = all(
+            np.array_equal(
+                np.asarray(getattr(single, name)),
+                np.asarray(getattr(multi, name)),
+            )
+            for name in consensus_counters
+        )
+        identical &= bool(single.timeseries == multi.timeseries)
+        assert identical, (
+            "consensus stack diverged between the 1-device and "
+            f"{n_devices}-device meshes — partition/quorum/leader state "
+            "must be bit-identical per lane"
+        )
+        arms["defended" if defended else "undefended"] = (
+            multi,
+            recovery_ratio(multi),
+        )
+
+    undefended_r, undefended_ratio = arms["undefended"]
+    defended_r, defended_ratio = arms["defended"]
+    # The phenomenon itself, not a tuned bound: defenses must buy
+    # strictly more post-heal goodput than their absence.
+    assert defended_ratio > undefended_ratio, (
+        f"defended {defended_ratio:.3f} <= undefended {undefended_ratio:.3f}"
+    )
+    mesh_kind = "virtual CPU mesh" if virtual else "TPU mesh"
+    return {
+        "metric": (
+            f"availability_recovery_ratio ({n_replicas}-replica "
+            f"quorum-liveness rho sweep, {n_devices}-device {mesh_kind})"
+        ),
+        "tag": "CONSENSUS",
+        "value": round(defended_ratio, 4),
+        "unit": "post/pre goodput",
+        "availability_recovery_ratio_defended": round(defended_ratio, 4),
+        "availability_recovery_ratio_undefended": round(undefended_ratio, 4),
+        "bit_identical_counters": True,
+        "bit_identical_series": True,
+        "n_devices": n_devices,
+        "virtual_mesh": virtual,
+        "quorum_dark_fraction": round(defended_r.quorum_dark_fraction, 6),
+        "leader_changes_total": int(defended_r.leader_changes),
+        "time_without_leader_fraction": round(
+            defended_r.time_without_leader_fraction, 6
+        ),
+        "quorum_dropped_total": int(sum(defended_r.server_quorum_dropped)),
+        "network_partitioned_total": int(defended_r.network_partitioned),
+        "consensus_report": defended_r.engine_report()["consensus"],
+        "undefended_retried_total": int(sum(undefended_r.server_retried)),
+        "defended_retried_total": int(sum(defended_r.server_retried)),
+        "defended_events_per_sec": round(defended_r.events_per_second, 0),
+        "partition_window_s": list(dark),
+        "n_windows": n_windows,
+        "n_replicas": defended_r.n_replicas,
+        "horizon_s": defended_r.horizon_s,
+        "wall_seconds": round(defended_r.wall_seconds, 6),
+        "compile_seconds": round(defended_r.compile_seconds, 6),
+        "device": str(devices[0]),
+    }
+
+
+def bench_consensus(devices) -> dict:
+    """CONSENSUS entry. With >1 real device, measure on the real mesh
+    in-process; on a single-chip host, spawn a child pinned to the
+    virtual 8-device CPU mesh (the XLA host-device-count flag must be
+    set before jax initializes, hence the subprocess)."""
+    if len(devices) > 1:
+        return _consensus_measure(devices, len(devices), virtual=False)
+
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={CONSENSUS_VIRTUAL_DEVICES}"
+        ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--consensus-virtual"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=1800,
+        )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return {
+            "metric": "availability_recovery_ratio (quorum-liveness rho sweep)",
+            "tag": "CONSENSUS",
+            "error": "child emitted no JSON",
+            "rc": proc.returncode,
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired:
+        return {
+            "metric": "availability_recovery_ratio (quorum-liveness rho sweep)",
+            "tag": "CONSENSUS",
+            "error": "child timed out",
+        }
+
+
+def _consensus_virtual_child() -> int:
+    """Entry for the ``--consensus-virtual`` child: env was pinned to the
+    CPU platform with virtual devices by the parent before python started."""
+    import jax
+
+    devices = jax.devices()
+    n = min(CONSENSUS_VIRTUAL_DEVICES, len(devices))
+    print(json.dumps(_consensus_measure(devices, n, virtual=True)))
+    return 0
+
+
 def _default_cache_dir() -> str:
     """Per-user persistent XLA cache dir, with the same squat-resistance
     discipline as the fallback stub above: the path is predictable, and
@@ -1414,6 +1651,8 @@ def _wait_for_tpu() -> bool:
 def main() -> int:
     if "--multichip-virtual" in sys.argv:
         return _multichip_virtual_child()
+    if "--consensus-virtual" in sys.argv:
+        return _consensus_virtual_child()
     if os.environ.get("HS_BENCH_CPU_FALLBACK") == "1":
         _apply_fallback_scale()
     elif not _wait_for_tpu():
@@ -1440,6 +1679,7 @@ def main() -> int:
     kchaos = bench_kernel_chaos(devices)
     resilience = bench_resilience(devices)
     multichip = bench_multichip_mesh(devices)
+    consensus = bench_consensus(devices)
     if DEVICE_FALLBACK:
         note = "TPU unreachable at bench time; CPU fallback at reduced scale"
         kernel["device_fallback"] = note
@@ -1463,6 +1703,7 @@ def main() -> int:
     print(json.dumps(kchaos))
     print(json.dumps(resilience))
     print(json.dumps(multichip))
+    print(json.dumps(consensus))
     print(json.dumps(engine))
     return 0
 

@@ -97,6 +97,16 @@ def _source_ok(model: EnsembleModel) -> bool:
         return False  # load_shed: admission depends on live queue state
     if getattr(model, "retry_budget_spec", None) is not None:
         return False  # retry_budget: token state couples consecutive jobs
+    # Consensus layer (docs/guides/consensus-scenarios.md): partition
+    # windows thin/delay deliveries stochastically and quorum/election
+    # state is a time-varying availability gate — none expressible in
+    # the deterministic recurrence; each declines by name.
+    if getattr(model, "network_partitions", None):
+        return False  # network_partitions: windows drop/delay deliveries
+    if getattr(model, "quorum_spec", None) is not None:
+        return False  # quorum: availability gate rejects in-window arrivals
+    if getattr(model, "leader_election_spec", None) is not None:
+        return False  # leader_election: per-replica election state machine
     source = model.sources[0]
     if source.arrival != "poisson" or source.profile is not None:
         return False

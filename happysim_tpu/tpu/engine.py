@@ -82,7 +82,7 @@ from jax.sharding import Mesh
 
 logger = logging.getLogger("happysim_tpu.tpu.engine")
 
-from happysim_tpu.tpu.faults import FaultTable
+from happysim_tpu.tpu.faults import FaultTable, PartitionTable
 from happysim_tpu.tpu.mesh import (
     ensemble_state_shardings,
     pad_to_multiple,
@@ -142,6 +142,7 @@ _I64_COUNTER_KEYS = frozenset({
     "tr_dropped", "net_lost",
     "srv_breaker_dropped", "brk_tripped",
     "srv_shed_dropped", "srv_budget_dropped",
+    "net_partitioned", "qrm_dropped", "ldr_changes",
     "blocks_total",
 })
 # Telemetry reduce keys that are float time-integrals / sums (everything
@@ -149,6 +150,7 @@ _I64_COUNTER_KEYS = frozenset({
 _TEL_FLOAT_KEYS = frozenset({
     "tel_sink_sum", "tel_srv_depth_int", "tel_srv_busy_int",
     "tel_fault_int", "tel_brk_open_int",
+    "tel_qrm_dark_int", "tel_ldr_uptime_int",
     "tel_spread_p10", "tel_spread_p90",
 })
 # Float accumulators reduced as fixed-point limb sums (decoded by
@@ -157,8 +159,10 @@ _F64_SUM_KEYS = frozenset({
     "sink_sum", "sink_sq",
     "srv_busy_int", "srv_depth_int", "srv_wait_sum",
     "brk_open_time",
+    "qrm_dark_time", "ldr_noleader_time",
     "tel_sink_sum", "tel_srv_depth_int", "tel_srv_busy_int",
     "tel_fault_int", "tel_brk_open_int",
+    "tel_qrm_dark_int", "tel_ldr_uptime_int",
 })
 
 
@@ -395,6 +399,18 @@ def model_fingerprint(model: EnsembleModel) -> str:
     )
     if resilience:
         items = items + (("resilience",) + resilience,)
+    # Consensus layer (partitions, quorum, leader election) likewise:
+    # join-only-when-present keeps consensus-free fingerprints stable.
+    consensus = tuple(getattr(model, "network_partitions", ()) or ()) + tuple(
+        spec
+        for spec in (
+            getattr(model, "quorum_spec", None),
+            getattr(model, "leader_election_spec", None),
+        )
+        if spec is not None
+    )
+    if consensus:
+        items = items + (("consensus",) + consensus,)
     spec = repr(items)
     return hashlib.sha256(spec.encode()).hexdigest()[:16]
 
@@ -531,6 +547,27 @@ class EnsembleResult:
     # which resilience defenses the model declared
     # (model.resilience_features() names)
     resilience_features: tuple = ()
+    # Consensus accounting (all zero/empty unless the model declares
+    # network partitions / a quorum group / a leader-election group —
+    # see model.network_partition/quorum/leader_election and
+    # docs/guides/consensus-scenarios.md):
+    # cross-partition deliveries dropped at the consult site (drop-mode
+    # partition windows; delay-mode windows reroute through transit)
+    network_partitioned: int = 0
+    # arrivals rejected because the write quorum was unreachable
+    # (retryable — includes rejections that later retried successfully)
+    server_quorum_dropped: list[int] = dataclasses_field(default_factory=list)
+    # fraction of (replicas x horizon) the quorum group spent below its
+    # write quorum (time-integral, like utilization)
+    quorum_dark_fraction: float = 0.0
+    # completed leader elections across all replicas (initial election
+    # at detection delay D included — the host twin counts it too)
+    leader_changes: int = 0
+    # fraction of (replicas x horizon) the group had no live leader
+    time_without_leader_fraction: float = 0.0
+    # which consensus features the model declared
+    # (model.consensus_features() names)
+    consensus_features: tuple = ()
     # Time-resolved per-window series (models with a TelemetrySpec only;
     # see tpu/telemetry.py — None otherwise).
     timeseries: Optional[EnsembleTimeseries] = None
@@ -642,6 +679,23 @@ class EnsembleResult:
                 "shed_dropped_total": sum(self.server_shed_dropped),
                 "budget_dropped_total": sum(self.server_budget_dropped),
                 "breaker_open_fraction": list(self.breaker_open_fraction),
+            },
+            # Consensus-layer provenance, mirroring "resilience":
+            # per-feature on/off plus totals, so a consumer can tell a
+            # partition-free run from one whose quorum never went dark.
+            "consensus": {
+                "network_partitions": (
+                    "network_partitions" in self.consensus_features
+                ),
+                "quorum": "quorum" in self.consensus_features,
+                "leader_election": "leader_election" in self.consensus_features,
+                "network_partitioned_total": self.network_partitioned,
+                "quorum_dropped_total": sum(self.server_quorum_dropped),
+                "quorum_dark_fraction": self.quorum_dark_fraction,
+                "leader_changes_total": self.leader_changes,
+                "time_without_leader_fraction": (
+                    self.time_without_leader_fraction
+                ),
             },
         }
         if self.kernel_decline:
@@ -761,6 +815,27 @@ class EnsembleResult:
                 )
             entities.append(
                 EntitySummary(name="model", kind="Resilience", extra=res_extra)
+            )
+        # Whole-model consensus accounting, same discipline as the
+        # Resilience entity: present whenever consensus features are
+        # DECLARED, totals appended when they fired.
+        if self.consensus_features:
+            con_extra = {"features": ", ".join(self.consensus_features)}
+            if self.network_partitioned:
+                con_extra["network_partitioned"] = self.network_partitioned
+            total_qrm = sum(self.server_quorum_dropped)
+            if total_qrm:
+                con_extra["total_quorum_dropped"] = total_qrm
+            if self.quorum_dark_fraction > 0.0:
+                con_extra["quorum_dark_fraction"] = self.quorum_dark_fraction
+            if self.leader_changes:
+                con_extra["leader_changes"] = self.leader_changes
+            if self.time_without_leader_fraction > 0.0:
+                con_extra["time_without_leader_max"] = (
+                    self.time_without_leader_fraction
+                )
+            entities.append(
+                EntitySummary(name="model", kind="Consensus", extra=con_extra)
             )
         # Engine provenance: which path ran, and — when the kernel
         # declined — the reason plus the escape hatches, so a summary
@@ -955,6 +1030,48 @@ class _Compiled:
         else:
             self.shed_busy_thr = np.zeros((self.nV,), np.float32)
 
+        # Consensus layer (docs/guides/consensus-scenarios.md): network
+        # partition windows compile into per-replica window registers
+        # (tpu/faults.py PartitionTable — the outage machinery's shape);
+        # quorum replication and leader election compile into init-time
+        # interval sweeps over the same member-unreachability windows.
+        # Compile-time gated like everything else: a consensus-free
+        # model traces to the identical jaxpr.
+        self.partitions = PartitionTable(model)
+        self.has_partitions = self.partitions.has_partitions
+        self.quorum = getattr(model, "quorum_spec", None)
+        self.leader = getattr(model, "leader_election_spec", None)
+        self.has_quorum = self.quorum is not None
+        self.has_leader = self.leader is not None
+        self.has_consensus = (
+            self.has_partitions or self.has_quorum or self.has_leader
+        )
+        self.qrm_member = np.zeros((self.nV,), np.bool_)
+        self.qrm_can_retry = np.zeros((self.nV,), np.bool_)
+        if self.has_quorum:
+            for v in self.quorum.group:
+                self.qrm_member[v] = True
+                self.qrm_can_retry[v] = (
+                    servers[v].retry_backoff_s is not None
+                    and servers[v].max_retries > 0
+                )
+            self.qrm_write = int(self.quorum.write)
+        else:
+            self.qrm_write = 0
+        # Quorum rejections are retryable failures: they ride the fault
+        # retry machinery (attempt numbers, backoff transit parks, the
+        # srv_fault_retried ledger) so breaker/budget defenses compose.
+        self.has_fault_retries = (
+            self.has_fault_retries or bool(self.qrm_can_retry.any())
+        )
+        self.has_attempts = self.has_deadlines or self.has_fault_retries
+        if self.has_leader:
+            self.ldr_group = tuple(self.leader.group)
+            self.ldr_delay = float(self.leader.detection_delay_s())
+        else:
+            self.ldr_group = ()
+            self.ldr_delay = 0.0
+
         self.arrival_is_poisson = np.array(
             [s.arrival == "poisson" for s in model.sources], np.bool_
         )
@@ -994,6 +1111,9 @@ class _Compiled:
                 for r in model.routers
             )
             or self.has_backoff
+            # Delay-mode partition windows reroute deliveries through
+            # the transit registers (arrival at t + delay_s).
+            or self.partitions.has_delay
         )
         self._init_telemetry(model)
         self._build_profile_tables()
@@ -1080,6 +1200,14 @@ class _Compiled:
                 keys.append("tel_srv_shed_dropped")
             if self.has_budget:
                 keys.append("tel_srv_budget_dropped")
+            # Consensus layer: partition drop + quorum rejection
+            # counters (the quorum-dark / leader-uptime time-integrals
+            # are init-time sweep outputs, reduced per-flag in
+            # reduce_final rather than through this key list).
+            if self.has_partitions:
+                keys.append("tel_net_partitioned")
+            if self.has_quorum:
+                keys.append("tel_qrm_dropped")
         self.tel_sum_keys = tuple(keys)
 
     def _tel_init_state(self) -> dict:
@@ -1125,6 +1253,10 @@ class _Compiled:
                 state["tel_srv_shed_dropped"] = jnp.zeros((nW, nV), jnp.int32)
             if self.has_budget:
                 state["tel_srv_budget_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_partitions:
+                state["tel_net_partitioned"] = jnp.zeros((nW,), jnp.int32)
+            if self.has_quorum:
+                state["tel_qrm_dropped"] = jnp.zeros((nW, nV), jnp.int32)
         return state
 
     def _tel_windex(self, t):
@@ -1385,8 +1517,11 @@ class _Compiled:
             # needs no events of its own).
             state.update(self.faults.sample_state(key))
             state["srv_fault_dropped"] = jnp.zeros((self.nV,), jnp.int32)
-            if self.has_fault_retries:
-                state["srv_fault_retried"] = jnp.zeros((self.nV,), jnp.int32)
+        if self.has_fault_retries:
+            # Outside the faults gate: quorum rejections are retryable
+            # too, so a quorum model with backoff retries but no fault
+            # specs still carries the retry ledger.
+            state["srv_fault_retried"] = jnp.zeros((self.nV,), jnp.int32)
         if self.has_hedge:
             state["srv_hedged"] = jnp.zeros((self.nV,), jnp.int32)
             state["srv_hedge_wins"] = jnp.zeros((self.nV,), jnp.int32)
@@ -1415,9 +1550,202 @@ class _Compiled:
             state["srv_budget_dropped"] = jnp.zeros((self.nV,), jnp.int32)
         if self.has_loss:
             state["net_lost"] = jnp.int32(0)
+        if self.has_partitions:
+            # Per-replica partition timelines, drawn once from this
+            # lane's key on an independent salted stream (tpu/faults.py
+            # PartitionTable) — like the fault windows, partition
+            # activation needs no events of its own.
+            state.update(self.partitions.sample_state(key))
+            state["net_partitioned"] = jnp.int32(0)
+        if self.has_quorum:
+            state["qrm_dropped"] = jnp.zeros((self.nV,), jnp.int32)
+        if self.has_quorum or self.has_leader:
+            # Quorum availability and the leader-election machine are
+            # pure functions of the sampled member-unreachability
+            # windows, so both are swept ONCE here (an O(edges) interval
+            # scan per replica) and carried as ordinary state leaves —
+            # checkpoint/resume, donation, and the reduce see nothing
+            # special.
+            state.update(self._consensus_sweeps(state))
         if self.has_telemetry:
             state.update(self._tel_init_state())
         return state
+
+    # -- consensus sweeps (docs/guides/consensus-scenarios.md) --------------
+    def _group_dark_intervals(self, state, group):
+        """``(len(group), K)`` start/end arrays of each member's
+        unreachability windows, padded to a common compile-time ``K``
+        with ``+inf`` (empty intervals).
+
+        Only sources that make a member UNREACHABLE count: drop-mode
+        fault windows (own + subscribed shared correlated windows) and
+        partition windows containing the member. Degrade-mode faults
+        and brownouts slow a member down without taking it off the
+        network, so they are excluded — the same reachability rule the
+        step-time quorum gate applies (`model._has_dark_source` is the
+        validation-side twin).
+        """
+        per_starts: list = []
+        per_ends: list = []
+        for v in group:
+            segs_s: list = []
+            segs_e: list = []
+            if self.has_faults and bool(self.faults.drop_mode[v]):
+                segs_s.append(state["flt_start"][v])
+                segs_e.append(state["flt_end"][v])
+                if self.faults.has_shared and bool(
+                    self.faults.participates[v]
+                ):
+                    segs_s.append(state["flt_sh_start"])
+                    segs_e.append(state["flt_sh_end"])
+            if self.has_partitions:
+                for p in range(self.partitions.nP):
+                    if bool(self.partitions.member[p, v]):
+                        segs_s.append(state["prt_start"][p])
+                        segs_e.append(state["prt_end"][p])
+            if not segs_s:
+                segs_s.append(jnp.full((1,), INF))
+                segs_e.append(jnp.full((1,), INF))
+            per_starts.append(jnp.concatenate(segs_s))
+            per_ends.append(jnp.concatenate(segs_e))
+        width = max(arr.shape[0] for arr in per_starts)
+
+        def pad(arr):
+            if arr.shape[0] == width:
+                return arr
+            return jnp.concatenate(
+                [arr, jnp.full((width - arr.shape[0],), INF)]
+            )
+
+        return (
+            jnp.stack([pad(a) for a in per_starts]),
+            jnp.stack([pad(a) for a in per_ends]),
+        )
+
+    def _consensus_sweeps(self, state) -> dict:
+        """Init-time interval sweeps: quorum-dark time (+ its per-window
+        integral) and the leader-election state machine.
+
+        Both are ``lax.scan``s over the SORTED union of member window
+        edges — the unreachability sets are piecewise constant between
+        edges, so evaluating membership at each segment midpoint is
+        exact. The scan carry is O(nW), never O(edges x nW): at 65k
+        replicas a broadcast interval product would materialize an
+        (R, E, nW) intermediate, which is exactly what this avoids.
+        """
+        out: dict = {}
+        hz = jnp.float32(self.model.horizon_s)
+        zero = jnp.zeros((1,), jnp.float32)
+        nW = self.nW if self.has_telemetry else 0
+        if self.has_quorum:
+            starts, ends = self._group_dark_intervals(
+                state, self.quorum.group
+            )
+            edges = jnp.sort(
+                jnp.clip(
+                    jnp.concatenate(
+                        [zero, starts.ravel(), ends.ravel(), zero + hz]
+                    ),
+                    0.0,
+                    hz,
+                )
+            )
+            n_members = len(self.quorum.group)
+            write = self.qrm_write
+
+            def qstep(carry, span):
+                dark_time, tel = carry
+                t0, t1 = span
+                mid = 0.5 * (t0 + t1)
+                dark = jnp.any((mid >= starts) & (mid < ends), axis=1)
+                alive = n_members - jnp.sum(dark.astype(jnp.int32))
+                qdark = (alive < write).astype(jnp.float32)
+                seg = jnp.maximum(t1 - t0, 0.0)
+                dark_time = dark_time + seg * qdark
+                if self.has_telemetry:
+                    tel = tel + self._tel_overlap(t0, t1) * qdark
+                return (dark_time, tel), None
+
+            (dark_time, tel), _ = lax.scan(
+                qstep,
+                (jnp.float32(0.0), jnp.zeros((nW,), jnp.float32)),
+                (edges[:-1], edges[1:]),
+            )
+            out["qrm_dark_time"] = dark_time
+            if self.has_telemetry:
+                out["tel_qrm_dark_int"] = tel
+        if self.has_leader:
+            starts, ends = self._group_dark_intervals(state, self.ldr_group)
+            delay = jnp.float32(self.ldr_delay)
+            # Base edges include the t=0 sentinel so its +delay shift
+            # covers the initial election deadline; the shifted copies
+            # are computed with the SAME float32 add the machine uses to
+            # arm ``pend = t0 + delay``, so every deadline lands exactly
+            # on a segment boundary (bit-equal, not epsilon-close).
+            base = jnp.concatenate([zero, starts.ravel(), ends.ravel()])
+            edges = jnp.sort(
+                jnp.clip(
+                    jnp.concatenate([base, base + delay, zero + hz]),
+                    0.0,
+                    hz,
+                )
+            )
+            n_members = len(self.ldr_group)
+            idxs = jnp.arange(n_members, dtype=jnp.int32)
+
+            def lstep(carry, span):
+                leader, pend, changes, noleader, upt = carry
+                t0, t1 = span
+                mid = 0.5 * (t0 + t1)
+                dark = jnp.any((mid >= starts) & (mid < ends), axis=1)
+                alive = ~dark
+                any_alive = jnp.any(alive)
+                # 1. Complete a pending election at its deadline: the
+                #    highest-group-index live member wins (bully order;
+                #    the phi strategy changes the detection delay, not
+                #    the winner). A completed election with no live
+                #    member leaves the group leaderless.
+                fire = pend <= t0
+                elect = jnp.max(jnp.where(alive, idxs, jnp.int32(-1)))
+                leader = jnp.where(fire, elect, leader)
+                changes = changes + (fire & (elect >= 0)).astype(jnp.int32)
+                pend = jnp.where(fire, INF, pend)
+                # 2. Cancel a pending detection when the leader is back.
+                leader_alive = jnp.any(alive & (idxs == leader))
+                pend = jnp.where((leader >= 0) & leader_alive, INF, pend)
+                # 3. Arm detection/election when leaderless: a dark
+                #    leader arms its failure-detection deadline; a
+                #    vacant seat arms as soon as any member is live.
+                leaderless = (leader < 0) | ~leader_alive
+                arm = (
+                    leaderless
+                    & ((leader >= 0) | any_alive)
+                    & jnp.isinf(pend)
+                )
+                pend = jnp.where(arm, t0 + delay, pend)
+                # 4. Accumulate over [t0, t1).
+                seg = jnp.maximum(t1 - t0, 0.0)
+                frac = leaderless.astype(jnp.float32)
+                noleader = noleader + seg * frac
+                if self.has_telemetry:
+                    upt = upt + self._tel_overlap(t0, t1) * (1.0 - frac)
+                return (leader, pend, changes, noleader, upt), None
+
+            init = (
+                jnp.int32(-1),  # no leader at t=0
+                delay,  # initial election completes at the deadline
+                jnp.int32(0),
+                jnp.float32(0.0),
+                jnp.zeros((nW,), jnp.float32),
+            )
+            (_, _, changes, noleader, upt), _ = lax.scan(
+                lstep, init, (edges[:-1], edges[1:])
+            )
+            out["ldr_changes"] = changes
+            out["ldr_noleader_time"] = noleader
+            if self.has_telemetry:
+                out["tel_ldr_uptime_int"] = upt
+        return out
 
     def _qro_keys(self):
         return _QRO_KEYS + (("srv_q_attempt",) if self.has_attempts else ())
@@ -1655,6 +1983,49 @@ class _Compiled:
             delivered,
         )
 
+    def _partition_select(self, state, t, created, v, delivered, arrival_t):
+        """Consult the partition table for a delivery INTO server ``v``.
+
+        The consult happens at the delivery hop at SEND time ``t``
+        (mirroring packet loss, `_select_lost`): a drop-mode cut
+        vanishes the delivery and books ``net_partitioned``; a
+        delay-mode cut reroutes it through the transit registers at
+        ``arrival_t + delay_s`` (drop wins when overlapping groups
+        disagree — a dropped packet cannot also arrive late). Jobs
+        already in flight when a window opens arrive normally: they
+        crossed the cut before it happened.
+        """
+        dark_v, drop_v, delay_v = self.partitions.consult(state, t)
+        row = self._row(v, self.nV)
+        p_drop = jnp.any(dark_v & drop_v & row)
+        booked = {
+            **state,
+            "net_partitioned": state["net_partitioned"]
+            + p_drop.astype(jnp.int32),
+        }
+        if self.has_telemetry and self.tel_rates:
+            booked["tel_net_partitioned"] = state[
+                "tel_net_partitioned"
+            ] + self._tel_wrow(t).astype(jnp.int32) * p_drop.astype(jnp.int32)
+        out = jax.tree_util.tree_map(
+            lambda drop_leaf, dlv_leaf: jnp.where(p_drop, drop_leaf, dlv_leaf),
+            booked,
+            delivered,
+        )
+        if self.partitions.has_delay:
+            p_delay = jnp.any(dark_v & ~drop_v & row)
+            held = self._into_transit(
+                state, v, arrival_t + self._pick(delay_v, row), created
+            )
+            out = jax.tree_util.tree_map(
+                lambda held_leaf, out_leaf: jnp.where(
+                    p_delay, held_leaf, out_leaf
+                ),
+                held,
+                out,
+            )
+        return out
+
     def _deliver(self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params):
         """Deliver a job leaving some node at time t across ``edge``.
 
@@ -1692,10 +2063,20 @@ class _Compiled:
         if dest.kind == SERVER:
             if edge.mean_s > 0:
                 latency = self._sample_edge(edge, self._uslot(u, self.U_LAT))
-                return self._into_transit(state, dest.index, t + latency, created)
-            return self._arrive_server(
-                state, dest.index, t, created, 0, u, params
-            )
+                arrival_t = t + latency
+                delivered = self._into_transit(
+                    state, dest.index, arrival_t, created
+                )
+            else:
+                arrival_t = t
+                delivered = self._arrive_server(
+                    state, dest.index, t, created, 0, u, params
+                )
+            if self.has_partitions and bool(self.partitions.touched[dest.index]):
+                return self._partition_select(
+                    state, t, created, dest.index, delivered, arrival_t
+                )
+            return delivered
         # Router: one dynamic hop to a homogeneous target set. Edges INTO a
         # router are latency-free by construction (model.connect rejects
         # them); only the per-target edge below carries latency.
@@ -1734,18 +2115,35 @@ class _Compiled:
 
             def to_server(state):
                 if lat_means.any():
-                    return self._into_transit(
-                        state, indices[choice], t + latency, created
+                    arrival_t = t + latency
+                    delivered = self._into_transit(
+                        state, indices[choice], arrival_t, created
                     )
-                return self._arrive_server(
-                    state,
-                    indices[choice],
-                    t,
-                    created,
-                    0,
-                    u,
-                    params,
-                )
+                else:
+                    arrival_t = t
+                    delivered = self._arrive_server(
+                        state,
+                        indices[choice],
+                        t,
+                        created,
+                        0,
+                        u,
+                        params,
+                    )
+                # Compile-time membership: the consult exists only when
+                # some server behind this router sits in a partition
+                # group (the traced chosen index selects through the
+                # per-server consult vectors).
+                if self.has_partitions and any(
+                    bool(self.partitions.touched[ref.index])
+                    for ref in router.targets
+                    if ref.kind == SERVER
+                ):
+                    return self._partition_select(
+                        state, t, created, indices[choice], delivered,
+                        arrival_t,
+                    )
+                return delivered
 
             if target_kinds == {SERVER}:
                 return to_server(state)
@@ -2254,11 +2652,43 @@ class _Compiled:
                 flt_dark = flt_dark & ~brk_short
         else:
             flt_dark = jnp.bool_(False)
+        # Quorum gate: an arrival at a group member while the group
+        # cannot assemble its write quorum is rejected (retryable —
+        # rides the fault-retry machinery below so breaker/budget
+        # defenses compose). Member reachability follows the same rule
+        # as the init-time sweeps: drop-mode fault windows + partition
+        # windows; degraded/browned-out members still vote.
+        if self.has_quorum:
+            member = jnp.asarray(self.qrm_member)
+            unreachable = jnp.zeros((self.nV,), jnp.bool_)
+            if self.has_faults:
+                unreachable = dark_v & jnp.asarray(self.faults.drop_mode)
+            if self.has_partitions:
+                unreachable = unreachable | self.partitions.consult(state, t)[0]
+            alive = jnp.int32(len(self.quorum.group)) - jnp.sum(
+                (unreachable & member).astype(jnp.int32)
+            )
+            # Disjoint from the brownout/fault/breaker ledgers: a member
+            # rejecting for its own reasons is not a quorum rejection.
+            qrm_rej = (
+                (alive < jnp.int32(self.qrm_write))
+                & jnp.any(member & row)
+                & ~(dark | flt_dark)
+            )
+            if self.has_breaker:
+                qrm_rej = qrm_rej & ~brk_short
+        else:
+            qrm_rej = jnp.bool_(False)
         if self.has_fault_retries:
-            would_retry = (
-                flt_dark
-                & jnp.any(jnp.asarray(self.flt_can_retry) & row)
-                & (attempt < self._pick(jnp.asarray(self.srv_max_retries), row))
+            rej_retryable = flt_dark & jnp.any(
+                jnp.asarray(self.flt_can_retry) & row
+            )
+            if self.has_quorum:
+                rej_retryable = rej_retryable | (
+                    qrm_rej & jnp.any(jnp.asarray(self.qrm_can_retry) & row)
+                )
+            would_retry = rej_retryable & (
+                attempt < self._pick(jnp.asarray(self.srv_max_retries), row)
             )
             retry = would_retry
             if self.has_budget:
@@ -2271,6 +2701,8 @@ class _Compiled:
             retry = jnp.bool_(False)
         fault_lost = flt_dark & ~retry
         rejected = dark | flt_dark
+        if self.has_quorum:
+            rejected = rejected | qrm_rej
         if self.has_breaker:
             rejected = rejected | brk_short
 
@@ -2301,13 +2733,33 @@ class _Compiled:
         admit_free = has_free & ~rejected
         slot_mask = slot_mask & ~rejected
 
-        # Arrival-site breaker signal: brownout drops and fault-window
-        # rejections (retried or not) are failures, recorded BEFORE the
-        # branch outputs fork so every select branch carries them.
+        # Arrival-site breaker signal: brownout drops, fault-window
+        # rejections, and quorum rejections (retried or not) are
+        # failures, recorded BEFORE the branch outputs fork so every
+        # select branch carries them.
         if self.has_breaker:
-            state = self._breaker_record_failure(
-                state, row, t, dark | flt_dark, bst
-            )
+            failure = dark | flt_dark
+            if self.has_quorum:
+                failure = failure | qrm_rej
+            state = self._breaker_record_failure(state, row, t, failure, bst)
+        # Quorum-rejection ledger: counts EVERY rejection (retried ones
+        # included — server_quorum_dropped is "requests that bounced off
+        # an unavailable quorum", the availability signal), booked before
+        # the fork for the same reason as the breaker signal above.
+        if self.has_quorum:
+            state = {
+                **state,
+                "qrm_dropped": state["qrm_dropped"]
+                + row_i * qrm_rej.astype(jnp.int32),
+            }
+            if self.has_telemetry and self.tel_rates:
+                state["tel_qrm_dropped"] = self._tel_count(
+                    state,
+                    "tel_qrm_dropped",
+                    self._tel_wrow(t),
+                    row,
+                    qrm_rej,
+                )
         cap = self._pick(jnp.asarray(self.queue_cap), row)
         has_room = q_len < cap
         tail = jnp.mod(
@@ -3596,8 +4048,8 @@ def run_ensemble(
             per_replica["tr_dropped"] = final["tr_dropped"]
         if compiled.has_faults:
             per_replica["srv_fault_dropped"] = final["srv_fault_dropped"]
-            if compiled.has_fault_retries:
-                per_replica["srv_fault_retried"] = final["srv_fault_retried"]
+        if compiled.has_fault_retries:
+            per_replica["srv_fault_retried"] = final["srv_fault_retried"]
         if compiled.has_hedge:
             per_replica["srv_hedged"] = final["srv_hedged"]
             per_replica["srv_hedge_wins"] = final["srv_hedge_wins"]
@@ -3611,6 +4063,18 @@ def run_ensemble(
             per_replica["srv_budget_dropped"] = final["srv_budget_dropped"]
         if compiled.has_loss:
             per_replica["net_lost"] = final["net_lost"]
+        if compiled.has_partitions:
+            per_replica["net_partitioned"] = final["net_partitioned"]
+        if compiled.has_quorum:
+            per_replica["qrm_dropped"] = final["qrm_dropped"]
+            per_replica["qrm_dark_time"] = final["qrm_dark_time"]
+            if compiled.has_telemetry:
+                per_replica["tel_qrm_dark_int"] = final["tel_qrm_dark_int"]
+        if compiled.has_leader:
+            per_replica["ldr_changes"] = final["ldr_changes"]
+            per_replica["ldr_noleader_time"] = final["ldr_noleader_time"]
+            if compiled.has_telemetry:
+                per_replica["tel_ldr_uptime_int"] = final["tel_ldr_uptime_int"]
         if compiled.has_telemetry:
             for key in compiled.tel_sum_keys:
                 per_replica[key] = final[key]
@@ -3998,6 +4462,23 @@ def _build_result(
         server_budget_dropped=_per_server(host, "srv_budget_dropped", nV_real),
         resilience_features=tuple(model.resilience_features()),
         network_lost=int(host.get("net_lost", 0)),
+        network_partitioned=int(host.get("net_partitioned", 0)),
+        server_quorum_dropped=_per_server(host, "qrm_dropped", nV_real),
+        # Availability fractions over (replicas x horizon), like the
+        # breaker open fraction — availability properties, not
+        # warmup-masked latency statistics.
+        quorum_dark_fraction=(
+            float(host["qrm_dark_time"]) / (n_replicas * horizon)
+            if "qrm_dark_time" in host
+            else 0.0
+        ),
+        leader_changes=int(host.get("ldr_changes", 0)),
+        time_without_leader_fraction=(
+            float(host["ldr_noleader_time"]) / (n_replicas * horizon)
+            if "ldr_noleader_time" in host
+            else 0.0
+        ),
+        consensus_features=tuple(model.consensus_features()),
         timeseries=timeseries,
         compile_seconds=compile_seconds,
         engine_path=engine_path,

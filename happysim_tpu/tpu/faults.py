@@ -61,6 +61,12 @@ import numpy as np
 # initial-gap draw (which uses the replica key directly).
 FAULT_KEY_SALT = 0x7A057A57
 
+# Distinct salt for the network-partition schedule stream: a model with
+# both faults AND partitions must draw independent window sequences, and
+# a partition-only model must not perturb the fault stream (adding a
+# partition group leaves an existing fault schedule bit-identical).
+PARTITION_KEY_SALT = 0x9A2717E5
+
 
 def duty_cycle(rate: float, mean_duration_s: float) -> float:
     """Stationary fraction of time inside a fault window.
@@ -240,3 +246,140 @@ class FaultTable:
 
         degraded = dark_v & jnp.asarray(self.degrade)
         return jnp.where(degraded, jnp.asarray(self.lat_factor), jnp.float32(1.0))
+
+
+class PartitionTable:
+    """Static (compile-time) view of the model's network-partition groups.
+
+    The partition twin of :class:`FaultTable`: each
+    :class:`~happysim_tpu.tpu.model.NetworkPartitionSpec` names a GROUP
+    of servers that fall on the dark side of a cut together — while one
+    of the group's windows is open, every delivery INTO a group member
+    is cross-partition traffic and is dropped (``mode="drop"``, booked
+    as ``net_partitioned`` terminals) or delayed by ``delay_s``
+    (``mode="delay"``, parked in the transit registers). Window
+    schedules reuse the fault machinery verbatim: stochastic gaps ~
+    Exp(rate) with Exp/constant durations, per-candidate
+    Bernoulli(trigger_p) thinning (the shared-Bernoulli correlated
+    partition — the whole group cuts together only when the candidate
+    fires), or deterministic pinned ``windows`` identical across
+    replicas (the cross-validation hook against the host
+    ``faults/network_faults.py`` twin).
+
+    A server's dark state is the OR over its containing groups, so
+    overlapping groups compose; drop-mode wins over delay when both
+    cover a dark member (a dropped packet cannot also arrive late).
+    """
+
+    def __init__(self, model):
+        specs = list(getattr(model, "network_partitions", ()) or ())
+        self.has_partitions = bool(specs)
+        self.nP = max(len(specs), 1)
+        self.nV = max(len(model.servers), 1)
+
+        widths = [1]
+        for spec in specs:
+            if spec.windows is not None:
+                widths.append(len(spec.windows))
+            elif spec.rate > 0.0:
+                widths.append(spec.max_windows)
+        self.Wp = max(widths)
+
+        nP, Wp = self.nP, self.Wp
+        self.member = np.zeros((nP, self.nV), np.bool_)
+        self.stochastic = np.zeros((nP,), np.bool_)
+        self.rate = np.ones((nP,), np.float32)  # dummy 1.0 avoids div-by-0
+        self.mean_dur = np.ones((nP,), np.float32)
+        self.dur_const = np.zeros((nP,), np.bool_)
+        self.trigger_p = np.ones((nP,), np.float32)
+        self.det_start = np.full((nP, Wp), np.inf, np.float32)
+        self.det_end = np.full((nP, Wp), np.inf, np.float32)
+        self.drop_mode = np.zeros((nP,), np.bool_)
+        self.delay_s = np.zeros((nP,), np.float32)
+
+        for p, spec in enumerate(specs):
+            for ref in spec.group:
+                self.member[p, ref] = True
+            self.drop_mode[p] = spec.mode == "drop"
+            self.delay_s[p] = spec.delay_s
+            if spec.windows is not None:
+                for w, (start, end) in enumerate(spec.windows):
+                    self.det_start[p, w] = start
+                    self.det_end[p, w] = end
+            elif spec.rate > 0.0:
+                self.stochastic[p] = True
+                self.rate[p] = spec.rate
+                self.mean_dur[p] = spec.mean_duration_s
+                self.dur_const[p] = spec.duration == "constant"
+                self.trigger_p[p] = spec.trigger_p
+        self.has_delay = self.has_partitions and bool(np.any(~self.drop_mode))
+        self.touched = self.member.any(axis=0)  # (nV,) in >= 1 group
+
+    # -- per-replica sampling (init time) -----------------------------------
+    def sample_state(self, key):
+        """Draw one replica's partition-window registers.
+
+        Returns ``prt_start`` / ``prt_end`` of shape (nP, Wp); windows a
+        Bernoulli trigger left unfired (and every deterministic row's
+        unused tail) sit at +inf, so the dark query is one compare.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        pkey = jax.random.fold_in(key, PARTITION_KEY_SALT)
+        starts = jnp.asarray(self.det_start)
+        ends = jnp.asarray(self.det_end)
+        if bool(self.stochastic.any()):
+            u = jax.random.uniform(
+                jax.random.fold_in(pkey, 0),
+                (self.nP, self.Wp, 3),
+                minval=1e-12,
+                maxval=1.0,
+            )
+            gaps = -jnp.log(u[..., 0]) / jnp.asarray(self.rate)[:, None]
+            durs = jnp.where(
+                jnp.asarray(self.dur_const)[:, None],
+                jnp.asarray(self.mean_dur)[:, None],
+                -jnp.log(u[..., 1]) * jnp.asarray(self.mean_dur)[:, None],
+            )
+            sampled_start = jnp.cumsum(gaps, axis=1) + (
+                jnp.cumsum(durs, axis=1) - durs
+            )
+            sampled_end = sampled_start + durs
+            # Candidates keep their timeline slot whether or not they
+            # fire (FaultTable's correlated-trigger discipline): the
+            # whole group cuts together exactly when its candidate does.
+            fired = u[..., 2] < jnp.asarray(self.trigger_p)[:, None]
+            sampled_start = jnp.where(fired, sampled_start, jnp.float32(jnp.inf))
+            sampled_end = jnp.where(fired, sampled_end, jnp.float32(jnp.inf))
+            stoch = jnp.asarray(self.stochastic)[:, None]
+            starts = jnp.where(stoch, sampled_start, starts)
+            ends = jnp.where(stoch, sampled_end, ends)
+        return {"prt_start": starts, "prt_end": ends}
+
+    # -- step-time queries ---------------------------------------------------
+    def dark_groups(self, state, t):
+        """(nP,) bool: which partition groups are cut at time t."""
+        import jax.numpy as jnp
+
+        return jnp.any(
+            (t >= state["prt_start"]) & (t < state["prt_end"]), axis=1
+        )
+
+    def consult(self, state, t):
+        """Per-server partition status at t: ``(dark_v, drop_v, delay_v)``.
+
+        ``dark_v`` (nV, bool): the server sits in >= 1 cut group.
+        ``drop_v`` (nV, bool): >= 1 of those cut groups is drop-mode.
+        ``delay_v`` (nV, f32): max delay over cut delay-mode groups.
+        """
+        import jax.numpy as jnp
+
+        dark_g = self.dark_groups(state, t)  # (nP,)
+        cut = jnp.asarray(self.member) & dark_g[:, None]  # (nP, nV)
+        dark_v = jnp.any(cut, axis=0)
+        drop_v = jnp.any(cut & jnp.asarray(self.drop_mode)[:, None], axis=0)
+        delay_v = jnp.max(
+            jnp.where(cut, jnp.asarray(self.delay_s)[:, None], 0.0), axis=0
+        )
+        return dark_v, drop_v, delay_v

@@ -163,6 +163,14 @@ STATE_PARTITION_RULES: tuple[tuple[str, str], ...] = (
     (r"^net_lost$", "replica"),
     # sampled stochastic fault-window registers (incl. shared/correlated)
     (r"^flt_", "replica"),
+    # network-partition window registers + cross-partition drop counter
+    # (tpu/faults.py PartitionTable; docs/guides/consensus-scenarios.md)
+    (r"^prt_", "replica"),
+    (r"^net_partitioned$", "replica"),
+    # quorum-replication ledgers (rejection counter + dark-time integral)
+    (r"^qrm_", "replica"),
+    # leader-election sweep outputs (change count + leaderless time)
+    (r"^ldr_", "replica"),
     # circuit-breaker state machines (state id, failure-time ring,
     # cursor, trip time, probe count, trip/open-time accounting —
     # docs/guides/resilience.md)

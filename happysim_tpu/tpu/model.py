@@ -329,6 +329,228 @@ class RetryBudgetSpec:
             )
 
 
+PARTITION_MODES = ("drop", "delay")
+
+
+@dataclass(frozen=True)
+class NetworkPartitionSpec:
+    """A network cut isolating a GROUP of servers while a window is open.
+
+    The vectorized twin of the host ``NetworkPartition`` fault
+    (faults/network_faults.py): while one of this group's partition
+    windows is open, every delivery INTO a group member is
+    cross-partition traffic — dropped outright (``mode="drop"``, booked
+    as ``net_partitioned`` terminals) or parked in transit for
+    ``delay_s`` (``mode="delay"``, the slow-WAN-reroute model). Window
+    schedules mirror :class:`FaultSpec` exactly: stochastic gaps ~
+    Exp(``rate``) with Exp/constant durations, OR deterministic pinned
+    ``windows`` identical in every replica (the cross-validation hook
+    against the host consensus twins). ``trigger_p`` < 1 thins the
+    stochastic candidates by an independent Bernoulli per window — the
+    shared-Bernoulli CORRELATED partition: the whole group cuts
+    together exactly when its candidate fires, one replica = one
+    Monte-Carlo draw of "the 1%-probability rack cut".
+
+    ``group`` holds server indices (the builder accepts server
+    :class:`NodeRef`\\ s). A server may sit in several groups; its dark
+    state is the OR, and drop-mode wins over delay.
+    """
+
+    group: tuple[int, ...]
+    rate: float = 0.0
+    mean_duration_s: float = 0.0
+    duration: str = "exponential"  # or "constant"
+    trigger_p: float = 1.0
+    max_windows: int = 4
+    windows: Optional[tuple] = None  # ((start, end), ...) deterministic
+    mode: str = "drop"  # or "delay"
+    delay_s: float = 0.0
+
+    def validate(self, label: str, n_servers: int) -> None:
+        if not self.group:
+            raise ValueError(f"{label}: partition group is empty")
+        if len(set(self.group)) != len(self.group):
+            raise ValueError(f"{label}: partition group repeats a server")
+        for v in self.group:
+            if not 0 <= v < n_servers:
+                raise ValueError(
+                    f"{label}: group member {v} is not a server index"
+                )
+        if self.mode not in PARTITION_MODES:
+            raise ValueError(
+                f"{label}: partition mode {self.mode!r} not in {PARTITION_MODES}"
+            )
+        if self.mode == "delay" and self.delay_s <= 0.0:
+            raise ValueError(f"{label}: mode='delay' requires delay_s > 0")
+        if self.mode == "drop" and self.delay_s != 0.0:
+            raise ValueError(
+                f"{label}: delay_s requires mode='delay' (a dropped "
+                "packet cannot also arrive late)"
+            )
+        if self.duration not in ("exponential", "constant"):
+            raise ValueError(
+                f"{label}: partition duration {self.duration!r} not in "
+                "('exponential', 'constant')"
+            )
+        if not 0.0 < self.trigger_p <= 1.0:
+            raise ValueError(f"{label}: trigger_p must be in (0, 1]")
+        if self.max_windows < 1:
+            raise ValueError(f"{label}: max_windows must be >= 1")
+        if self.windows is not None:
+            for w in self.windows:
+                start, end = w
+                if start < 0.0 or end <= start:
+                    raise ValueError(
+                        f"{label}: partition window [{start}, {end}) is "
+                        "empty or negative"
+                    )
+        elif self.rate <= 0.0:
+            raise ValueError(
+                f"{label}: stochastic partition needs rate > 0 "
+                "(or explicit windows=...)"
+            )
+        elif self.mean_duration_s <= 0.0:
+            raise ValueError(f"{label}: partition needs mean_duration_s > 0")
+
+
+@dataclass(frozen=True)
+class QuorumSpec:
+    """Quorum replication over a GROUP of servers (R + W > N discipline).
+
+    The vectorized twin of the reference's quorum datastore: every
+    request arriving at a group member must assemble a WRITE quorum of
+    ``write`` reachable replicas out of the group's ``n``. While fewer
+    than ``write`` members are reachable (fault windows and network
+    partitions both count), the group is QUORUM-DARK: arrivals at
+    members are rejected (``server_quorum_dropped`` — a retryable
+    failure, so backoff retries, circuit breakers, and retry budgets
+    all compose), and the dark time books as the per-window
+    time-integral ``tel_quorum_dark_int`` exactly like the busy
+    integral. ``read`` sizes the read quorum; ``write + read > n``
+    guarantees read-your-writes overlap and is validated here even
+    though availability is gated on the write quorum (the stricter of
+    the two under the symmetric failures this engine models).
+    """
+
+    group: tuple[int, ...]
+    write: int
+    read: int
+
+    def validate(self, n_servers: int) -> None:
+        if not self.group:
+            raise ValueError("quorum: group is empty")
+        if len(set(self.group)) != len(self.group):
+            raise ValueError("quorum: group repeats a server")
+        for v in self.group:
+            if not 0 <= v < n_servers:
+                raise ValueError(f"quorum: group member {v} is not a server")
+        n = len(self.group)
+        if not 1 <= self.write <= n:
+            raise ValueError(f"quorum: write must be in [1, {n}], was {self.write}")
+        if not 1 <= self.read <= n:
+            raise ValueError(f"quorum: read must be in [1, {n}], was {self.read}")
+        if self.write + self.read <= n:
+            raise ValueError(
+                f"quorum: write + read must exceed n for overlap "
+                f"({self.write} + {self.read} <= {n})"
+            )
+
+
+ELECTION_STRATEGIES = ("bully", "phi_accrual")
+
+
+def _erfcinv(y: float) -> float:
+    """Inverse complementary error function by bisection (host-side,
+    spec-build time — no scipy dependency)."""
+    import math
+
+    lo, hi = 0.0, 40.0
+    for _ in range(200):
+        mid = (lo + hi) / 2.0
+        if math.erfc(mid) > y:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2.0
+
+
+@dataclass(frozen=True)
+class LeaderElectionSpec:
+    """Leader election over a GROUP of servers under failure.
+
+    The vectorized twin of the host
+    :class:`~happysim_tpu.components.consensus.leader_election.
+    LeaderElection` cluster: the group's members heartbeat every
+    ``heartbeat_s``; when the current leader becomes unreachable (fault
+    window or network partition), peers detect the silence after the
+    strategy's detection delay and elect the highest-id reachable
+    member (the Bully discipline — no preemption on recovery). The
+    engine surfaces ``leader_changes``, ``time_without_leader_fraction``
+    (no leader elected, or the elected leader is dark), and a
+    per-window leader-uptime series.
+
+    ``strategy`` picks the failure detector, which sets the detection
+    delay :meth:`detection_delay_s`:
+
+    - ``"bully"``: fixed heartbeat timeout — detection after
+      ``timeout_s`` of silence.
+    - ``"phi_accrual"``: the phi-accrual detector
+      (:class:`~happysim_tpu.components.consensus.phi_accrual_detector.
+      PhiAccrualDetector`) over a deterministic heartbeat stream —
+      inter-arrival std collapses to the ``min_std_s`` floor, so phi
+      crosses ``phi_threshold`` after
+      ``heartbeat_s + min_std_s * sqrt(2) * erfcinv(2 * 10**-phi_threshold)``
+      of silence — adaptive detection that re-elects FASTER than a
+      conservative fixed timeout while keeping the same false-positive
+      budget.
+    """
+
+    group: tuple[int, ...]
+    heartbeat_s: float
+    timeout_s: float
+    strategy: str = "bully"
+    phi_threshold: float = 8.0
+    min_std_s: float = 0.1
+
+    def validate(self, n_servers: int) -> None:
+        if not self.group:
+            raise ValueError("leader_election: group is empty")
+        if len(set(self.group)) != len(self.group):
+            raise ValueError("leader_election: group repeats a server")
+        for v in self.group:
+            if not 0 <= v < n_servers:
+                raise ValueError(
+                    f"leader_election: group member {v} is not a server"
+                )
+        if self.heartbeat_s <= 0.0:
+            raise ValueError("leader_election: heartbeat_s must be > 0")
+        if self.strategy not in ELECTION_STRATEGIES:
+            raise ValueError(
+                f"leader_election strategy {self.strategy!r} not in "
+                f"{ELECTION_STRATEGIES}"
+            )
+        if self.strategy == "bully":
+            if self.timeout_s < self.heartbeat_s:
+                raise ValueError(
+                    "leader_election: timeout_s must be >= heartbeat_s (a "
+                    "timeout shorter than one heartbeat interval declares "
+                    "every live leader dead)"
+                )
+        if self.phi_threshold <= 0.0:
+            raise ValueError("leader_election: phi_threshold must be > 0")
+        if self.min_std_s <= 0.0:
+            raise ValueError("leader_election: min_std_s must be > 0")
+
+    def detection_delay_s(self) -> float:
+        """Silence (seconds) after which the failure detector fires."""
+        import math
+
+        if self.strategy == "bully":
+            return float(self.timeout_s)
+        x = _erfcinv(2.0 * 10.0 ** (-self.phi_threshold))
+        return float(self.heartbeat_s + self.min_std_s * math.sqrt(2.0) * x)
+
+
 @dataclass
 class SourceSpec:
     rate: float
@@ -481,6 +703,14 @@ class EnsembleModel:
         self.circuit_breaker_spec: Optional[CircuitBreakerSpec] = None
         self.load_shed_spec: Optional[LoadShedSpec] = None
         self.retry_budget_spec: Optional[RetryBudgetSpec] = None
+        # Consensus layer (docs/guides/consensus-scenarios.md): network
+        # partition groups plus the quorum / leader-election state
+        # machines compiled over them. Compile-time gated exactly like
+        # telemetry and resilience — a consensus-free model traces to
+        # the identical jaxpr.
+        self.network_partitions: list[NetworkPartitionSpec] = []
+        self.quorum_spec: Optional[QuorumSpec] = None
+        self.leader_election_spec: Optional[LeaderElectionSpec] = None
 
     # -- builders ----------------------------------------------------------
     def source(
@@ -568,10 +798,19 @@ class EnsembleModel:
             and fault.mode == "outage"
             and retry_backoff_s is not None
         )
-        if max_retries > 0 and deadline_s is None and not fault_can_retry:
+        if (
+            max_retries > 0
+            and deadline_s is None
+            and not fault_can_retry
+            and retry_backoff_s is None
+        ):
+            # With a backoff the decision is deferred to validate():
+            # quorum() membership (declared after the servers) also makes
+            # rejections retryable, so the retry path may still be live.
             raise ValueError(
-                "max_retries requires a deadline_s (timeout retries) or an "
-                "outage-mode fault plus retry_backoff_s (rejection retries)"
+                "max_retries requires a deadline_s (timeout retries) or "
+                "retry_backoff_s plus a rejection source (an outage-mode "
+                "fault or quorum membership)"
             )
         if fault is not None:
             fault.validate(label)
@@ -796,6 +1035,107 @@ class EnsembleModel:
         self.retry_budget_spec = spec
         return spec
 
+    def network_partition(
+        self,
+        group: Sequence[NodeRef],
+        rate: float = 0.0,
+        mean_duration_s: float = 0.0,
+        duration: str = "exponential",
+        trigger_p: float = 1.0,
+        max_windows: int = 4,
+        windows: Optional[tuple] = None,
+        mode: str = "drop",
+        delay_s: float = 0.0,
+    ) -> NetworkPartitionSpec:
+        """Declare a network-partition group over ``group`` servers.
+
+        While one of the group's windows is open, deliveries INTO its
+        members are dropped (``mode="drop"``, ``net_partitioned``
+        terminals) or parked ``delay_s`` in transit (``mode="delay"``).
+        Schedules mirror :class:`FaultSpec`: stochastic ``rate`` +
+        ``mean_duration_s`` (optionally Bernoulli-thinned by
+        ``trigger_p`` — the correlated whole-group cut), or
+        deterministic pinned ``windows``. Call repeatedly for multiple
+        independent cuts; a member of several groups is dark under the
+        OR.
+        """
+        for ref in group:
+            if ref.kind != SERVER:
+                raise ValueError("network_partition group members must be servers")
+        spec = NetworkPartitionSpec(
+            group=tuple(ref.index for ref in group),
+            rate=rate,
+            mean_duration_s=mean_duration_s,
+            duration=duration,
+            trigger_p=trigger_p,
+            max_windows=max_windows,
+            windows=windows,
+            mode=mode,
+            delay_s=delay_s,
+        )
+        spec.validate(
+            f"network_partition[{len(self.network_partitions)}]",
+            len(self.servers),
+        )
+        self.network_partitions.append(spec)
+        return spec
+
+    def quorum(self, group: Sequence[NodeRef], write: int, read: int) -> QuorumSpec:
+        """Declare quorum replication over ``group`` servers.
+
+        Requests at members are rejected (``server_quorum_dropped``, a
+        retryable failure) while fewer than ``write`` members are
+        reachable; the dark time books as the ``tel_quorum_dark_int``
+        per-window integral. Requires ``write + read > n`` and a dark
+        source (a fault schedule or partition group touching a member)
+        — validated at :meth:`validate` time, since a quorum that can
+        never lose a member is a configuration error.
+        """
+        for ref in group:
+            if ref.kind != SERVER:
+                raise ValueError("quorum group members must be servers")
+        spec = QuorumSpec(
+            group=tuple(ref.index for ref in group), write=write, read=read
+        )
+        spec.validate(len(self.servers))
+        self.quorum_spec = spec
+        return spec
+
+    def leader_election(
+        self,
+        group: Sequence[NodeRef],
+        heartbeat_s: float,
+        timeout_s: float,
+        strategy: str = "bully",
+        phi_threshold: float = 8.0,
+        min_std_s: float = 0.1,
+    ) -> LeaderElectionSpec:
+        """Declare leader election over ``group`` servers.
+
+        One election state machine per (replica, group): the
+        highest-id reachable member leads; when it goes dark, peers
+        re-elect after the ``strategy``'s detection delay (``"bully"``:
+        ``timeout_s`` of silence; ``"phi_accrual"``: the adaptive
+        phi-detector threshold). Surfaces ``leader_changes``,
+        ``time_without_leader_fraction``, and the per-window
+        leader-uptime series. Requires a dark source touching a member
+        — validated at :meth:`validate` time.
+        """
+        for ref in group:
+            if ref.kind != SERVER:
+                raise ValueError("leader_election group members must be servers")
+        spec = LeaderElectionSpec(
+            group=tuple(ref.index for ref in group),
+            heartbeat_s=heartbeat_s,
+            timeout_s=timeout_s,
+            strategy=strategy,
+            phi_threshold=phi_threshold,
+            min_std_s=min_std_s,
+        )
+        spec.validate(len(self.servers))
+        self.leader_election_spec = spec
+        return spec
+
     def remote(self, ingress: NodeRef, latency_s: float) -> NodeRef:
         """Cross-partition egress: jobs exit here and arrive at the
         NEIGHBOR partition's ``ingress`` server after ``latency_s``
@@ -924,7 +1264,7 @@ class EnsembleModel:
                 or (s.fault is not None and s.fault.mode == "outage")
                 or s.outage_start_s is not None
                 for s in self.servers
-            )
+            ) or self.quorum_spec is not None
             if not has_failure_site:
                 raise ValueError(
                     "circuit_breaker: no server declares a failure site "
@@ -950,9 +1290,48 @@ class EnsembleModel:
                     "(max_retries > 0 or hedge_delay_s) — the budget would "
                     "gate nothing"
                 )
+        for i, partition in enumerate(self.network_partitions):
+            partition.validate(f"network_partition[{i}]", len(self.servers))
+        if self.quorum_spec is not None:
+            self.quorum_spec.validate(len(self.servers))
+            if not self._has_dark_source(self.quorum_spec.group):
+                raise ValueError(
+                    "quorum: no group member has a dark source (an "
+                    "outage-mode fault schedule or a network partition "
+                    "touching it) — the quorum could never lose a member"
+                )
+        if self.leader_election_spec is not None:
+            self.leader_election_spec.validate(len(self.servers))
+            if not self._has_dark_source(self.leader_election_spec.group):
+                raise ValueError(
+                    "leader_election: no group member has a dark source "
+                    "(an outage-mode fault schedule or a network "
+                    "partition touching it) — the leader could never fail"
+                )
+        quorum_members = (
+            set(self.quorum_spec.group) if self.quorum_spec is not None else set()
+        )
         for i, server in enumerate(self.servers):
             if server.downstream is None:
                 raise ValueError(f"server[{i}] has no downstream")
+            if (
+                server.max_retries > 0
+                and server.deadline_s is None
+                and not (
+                    server.fault is not None
+                    and server.fault.mode == "outage"
+                    and server.retry_backoff_s is not None
+                )
+                and i not in quorum_members
+            ):
+                # The server()-time check deferred because a backoff was
+                # given; with no quorum membership either, no rejection
+                # source exists and the retry path is dead config.
+                raise ValueError(
+                    f"server[{i}]: max_retries requires a deadline_s "
+                    "(timeout retries) or retry_backoff_s plus a rejection "
+                    "source (an outage-mode fault or quorum membership)"
+                )
             if server.fault is not None:
                 server.fault.validate(f"server[{i}]")
                 if server.fault.correlated and self.correlated_faults is None:
@@ -1058,8 +1437,37 @@ class EnsembleModel:
         if self.limiters:
             features.append("limiters")
         features.extend(self.resilience_features())
+        features.extend(self.consensus_features())
         if self.telemetry_spec is not None:
             features.append("telemetry")
+        return tuple(features)
+
+    def _has_dark_source(self, group: tuple[int, ...]) -> bool:
+        """Whether any ``group`` member can become unreachable: an
+        outage-mode fault schedule (a degraded server still answers) or
+        a partition group covering it."""
+        partitioned = {v for p in self.network_partitions for v in p.group}
+        return any(
+            (
+                self.servers[v].fault is not None
+                and self.servers[v].fault.mode == "outage"
+            )
+            or v in partitioned
+            for v in group
+        )
+
+    def consensus_features(self) -> tuple[str, ...]:
+        """Which consensus-layer features this model declares, as stable
+        feature names (same contract as :meth:`resilience_features` —
+        each name maps to compile-time-gated state leaves, and the chain
+        and kernel paths decline each BY NAME)."""
+        features: list[str] = []
+        if self.network_partitions:
+            features.append("network_partitions")
+        if self.quorum_spec is not None:
+            features.append("quorum")
+        if self.leader_election_spec is not None:
+            features.append("leader_election")
         return tuple(features)
 
     def resilience_features(self) -> tuple[str, ...]:

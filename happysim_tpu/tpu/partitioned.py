@@ -465,6 +465,16 @@ def run_partitioned(
             "shedding, and retry budgets at any device count (fused on "
             "the kernel path; HS_TPU_PALLAS selects kernel vs lax step)"
         )
+    consensus = model.consensus_features()
+    if consensus:
+        # Same discipline as the resilience rejection above.
+        raise ValueError(
+            f"the consensus layer ({', '.join(consensus)}) is not "
+            "supported by run_partitioned — use the mesh-first engine: "
+            "run_ensemble(mesh=replica_mesh(...)) runs network "
+            "partitions, quorum replication, and leader election at any "
+            "device count on the lax event step"
+        )
     if outbox_capacity < 1:
         raise ValueError(
             f"outbox_capacity={outbox_capacity} must be >= 1: every remote "

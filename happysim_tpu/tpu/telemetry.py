@@ -280,6 +280,15 @@ class EnsembleTimeseries:
     breaker_open_fraction: Optional[np.ndarray] = None  # (nW, nV)
     server_shed_dropped: Optional[np.ndarray] = None  # (nW, nV)
     server_budget_dropped: Optional[np.ndarray] = None  # (nW, nV)
+    # consensus (docs/guides/consensus-scenarios.md)
+    server_quorum_dropped: Optional[np.ndarray] = None  # (nW, nV)
+    network_partitioned: Optional[np.ndarray] = None  # (nW,)
+    # fraction of each window the quorum group spent below its write
+    # quorum / with a live leader, averaged over replicas (init-time
+    # interval-sweep integrals — same denominator family as
+    # breaker_open_fraction)
+    quorum_dark_fraction: Optional[np.ndarray] = None  # (nW,)
+    leader_uptime_fraction: Optional[np.ndarray] = None  # (nW,)
     # faults
     fault_occupancy: Optional[np.ndarray] = None  # (nW, nV) fraction
 
@@ -297,6 +306,8 @@ class EnsembleTimeseries:
         "server_breaker_dropped", "breaker_tripped",
         "breaker_open_fraction", "server_shed_dropped",
         "server_budget_dropped",
+        "server_quorum_dropped", "network_partitioned",
+        "quorum_dark_fraction", "leader_uptime_fraction",
         "fault_occupancy",
     )
 
@@ -356,6 +367,10 @@ class EnsembleTimeseries:
         emit("breaker_open_fraction", self.breaker_open_fraction, "server")
         emit("shed_dropped", self.server_shed_dropped, "server")
         emit("budget_dropped", self.server_budget_dropped, "server")
+        emit("quorum_dropped", self.server_quorum_dropped, "server")
+        emit("network_partitioned", self.network_partitioned, "network")
+        emit("quorum_dark_fraction", self.quorum_dark_fraction, "quorum")
+        emit("leader_uptime_fraction", self.leader_uptime_fraction, "leader")
         emit("fault_occupancy", self.fault_occupancy, "server")
         return out
 
@@ -488,6 +503,7 @@ def build_timeseries(
         ("breaker_tripped", "tel_brk_tripped"),
         ("server_shed_dropped", "tel_srv_shed_dropped"),
         ("server_budget_dropped", "tel_srv_budget_dropped"),
+        ("server_quorum_dropped", "tel_qrm_dropped"),
     ):
         arr = counts(key)
         if arr is not None:
@@ -511,6 +527,23 @@ def build_timeseries(
                 window_len[:, None] > 0,
                 open_int / (n_replicas * window_len[:, None]),
                 0.0,
+            )
+    if "tel_net_partitioned" in host:
+        ts.network_partitioned = counts("tel_net_partitioned")
+    if "tel_qrm_dark_int" in host:
+        # Same denominator family as breaker_open_fraction: dark seconds
+        # over the window's true [start, min(end, horizon)] coverage,
+        # averaged over replicas.
+        qdark = np.asarray(host["tel_qrm_dark_int"], np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ts.quorum_dark_fraction = np.where(
+                window_len > 0, qdark / (n_replicas * window_len), 0.0
+            )
+    if "tel_ldr_uptime_int" in host:
+        upt = np.asarray(host["tel_ldr_uptime_int"], np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ts.leader_uptime_fraction = np.where(
+                window_len > 0, upt / (n_replicas * window_len), 0.0
             )
     if "tel_fault_int" in host:
         # Same denominator as window_len_s: occupancy is dark seconds

@@ -53,6 +53,8 @@ _TPU_TEST_FILES = {
     "test_engine_path_reasons.py",
     "test_tpu_mesh.py",
     "test_tpu_mesh_resume.py",
+    "test_tpu_consensus.py",
+    "test_consensus_regression.py",
 }
 # Long host-side suites (examples execute end-to-end, some on the TPU path).
 _SLOW_TEST_FILES = {"test_examples.py"}

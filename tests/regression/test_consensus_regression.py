@@ -1,0 +1,284 @@
+"""Pinned-seed goldens for the FULL consensus stack over the defense layer.
+
+ISSUE 16 added vectorized quorum replication and leader election under
+network partitions — per-edge/per-group partition windows (drop and
+delay modes), a write/read quorum gate whose unavailable time is booked
+as a per-window time-integral, and a bully/phi-accrual leader sweep with
+detection-delay semantics — composed here with the resilience stack of
+ISSUE 15 (circuit breakers, load shedding, retry budgets) and the chaos
+substrate (correlated outage faults, backoff+jitter retries, hedging, a
+brownout window, packet loss) on the router fan-out shape. These goldens
+pin the stack on 1 and 8 (virtual) devices AND under both HS_TPU_PALLAS
+settings (the kernel declines consensus BY NAME, so both legs must land
+on the identical scan path): cross-partition drop counts, per-server
+quorum rejections, the quorum-dark window series, leader change counts,
+and the per-window leader-uptime series are the consensus trace itself,
+so a divergence in any sweep branch (a partition row, a quorum gate, a
+detection-delay arm, a dark-time integral) shows up as an exact-count
+or exact-series mismatch.
+
+Golden provenance: seed=123, 8 replicas, source rate=6 -> limiter
+(8/s, cap 4) -> round_robin router -> 3 servers (service_mean=0.25 —
+rho ~0.5 per target — cap=8, 2 backoff retries with 50% jitter made
+retryable by quorum membership; server 0 hedges at 0.6s and carries a
+correlated outage-mode fault; server 2 a [1.0, 1.5) brownout) -> sink,
+0.01s constant edges with 5% loss on even targets,
+correlated_outages(rate=0.2, mean=0.4, trigger_p=0.5), a deterministic
+drop partition cutting {s1, s2} over [1.5, 2.5) (quorum 2-of-3 goes
+dark for exactly 1s of the 4s horizon -> quorum_dark_fraction 0.25), a
+stochastic delay-mode partition on {s0} (rate=0.3, mean=0.4,
+trigger_p=0.5, +0.1s), quorum(write=2, read=2),
+leader_election(heartbeat=0.2s, timeout=0.5s, bully), 8-window
+telemetry, breaker(threshold=2, window=1.0, cooldown=0.4, probes=1),
+load_shed(queue_depth, threshold=1, priority_fraction=0.25),
+retry_budget(ratio=0.15, min_per_s=0.3, burst=2.0), horizon=4s,
+transit_capacity=8, macro_block=4, max_events=320, recorded on the
+lax scan path (the only path — consensus declines the Pallas kernel).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas")
+
+import jax
+
+# slow: four compiled programs (2 HS_TPU_PALLAS settings x 2 mesh
+# shapes) of XLA on CPU — beyond the tier-1 envelope (tier-1 keeps the
+# cheap decline-contract pins in test_engine_path_reasons). The CI
+# mesh-execution gate runs this file explicitly on every push/PR, and
+# the nightly slow tier replays it.
+pytestmark = pytest.mark.slow
+
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.kernels import env_override
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import EnsembleModel, FaultSpec
+
+GOLDEN = {
+    "simulated_events": 430,
+    "sink_count": [101],
+    "network_partitioned": 26,
+    "server_quorum_dropped": [15, 0, 2],
+    "quorum_dark_fraction": 0.25,
+    "leader_changes": 17,
+    "time_without_leader_fraction": 0.33611200004816055,
+    "server_fault_dropped": [2, 0, 0],
+    "server_fault_retried": [15, 0, 2],
+    "server_breaker_dropped": [12, 0, 1],
+    "breaker_tripped": [12, 0, 2],
+    "server_shed_dropped": [2, 1, 1],
+    "server_budget_dropped": [7, 0, 0],
+    "network_lost": 4,
+    "window_net_partitioned": [0, 0, 0, 16, 10, 0, 0, 0],
+    "window_quorum_dropped": [0, 0, 0, 10, 7, 0, 0, 0],
+    # The deterministic [1.5, 2.5) cut spans windows 3 and 4 exactly.
+    "window_quorum_dark_fraction": [0.0, 0.0, 0.0, 1.0, 1.0, 0.0, 0.0, 0.0],
+    "window_leader_uptime_fraction": [
+        0.0,
+        1.0,
+        1.0,
+        0.0,
+        0.7413898706436157,
+        0.8087764978408813,
+        0.8081614375114441,
+        0.9527761936187744,
+    ],
+}
+
+
+def _build():
+    model = EnsembleModel(horizon_s=4.0, macro_block=4, transit_capacity=8)
+    src = model.source(rate=6.0)
+    lim = model.limiter(refill_rate=8.0, capacity=4.0)
+    servers = []
+    for index in range(3):
+        servers.append(
+            model.server(
+                service_mean=0.25,
+                queue_capacity=8,
+                max_retries=2,
+                retry_backoff_s=0.05,
+                retry_jitter=0.5,
+                hedge_delay_s=0.6 if index == 0 else None,
+                fault=FaultSpec(rate=0.4, mean_duration_s=0.3, correlated=True)
+                if index == 0
+                else None,
+                outage=(1.0, 1.5) if index == 2 else None,
+            )
+        )
+    model.correlated_outages(rate=0.2, mean_duration_s=0.4, trigger_p=0.5)
+    router = model.router(policy="round_robin")
+    snk = model.sink()
+    model.connect(src, lim)
+    model.connect(lim, router)
+    for index, server in enumerate(servers):
+        model.connect(
+            router,
+            server,
+            latency_s=0.01,
+            latency_kind="constant",
+            loss_p=0.05 if index % 2 == 0 else 0.0,
+        )
+        model.connect(server, snk)
+    model.telemetry(window_s=0.5)
+    model.network_partition(group=[servers[1], servers[2]], windows=((1.5, 2.5),))
+    model.network_partition(
+        group=[servers[0]],
+        rate=0.3,
+        mean_duration_s=0.4,
+        trigger_p=0.5,
+        mode="delay",
+        delay_s=0.1,
+    )
+    model.quorum(servers, write=2, read=2)
+    model.leader_election(servers, heartbeat_s=0.2, timeout_s=0.5)
+    model.circuit_breaker(
+        failure_threshold=2, window_s=1.0, cooldown_s=0.4, half_open_probes=1
+    )
+    model.load_shed(policy="queue_depth", threshold=1, priority_fraction=0.25)
+    model.retry_budget(ratio=0.15, min_per_s=0.3, burst=2.0)
+    return model
+
+
+def _pinned_run(pallas: bool, n_devices: int):
+    with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+        return run_ensemble(
+            _build(),
+            n_replicas=8,
+            seed=123,
+            mesh=replica_mesh(jax.devices("cpu")[:n_devices]),
+            max_events=320,
+        )
+
+
+@pytest.fixture(
+    scope="module",
+    params=[
+        (True, 1),
+        (False, 1),
+        (True, 8),
+        (False, 8),
+    ],
+    ids=["pallas-1dev", "lax-1dev", "pallas-8dev", "lax-8dev"],
+)
+def pinned(request):
+    """BOTH HS_TPU_PALLAS settings x BOTH mesh shapes, each asserted
+    against the SAME golden — the pallas legs prove the kernel decline
+    reroutes onto the bit-identical scan path, and the 8-device legs
+    prove the psum-tree reduction preserves every consensus counter."""
+    pallas, n_devices = request.param
+    return _pinned_run(pallas, n_devices), pallas, n_devices
+
+
+def test_engine_path_declines_kernel_by_name(pinned):
+    """Consensus is scan-only: BOTH pallas legs must land on "scan"
+    with the three feature names in the decline."""
+    result, pallas, n_devices = pinned
+    assert result.engine_path == "scan"
+    if pallas:
+        for name in ("network partitions", "quorum group", "leader election"):
+            assert name in result.kernel_decline, result.kernel_decline
+    assert set(result.consensus_features) == {
+        "network_partitions",
+        "quorum",
+        "leader_election",
+    }
+    assert result.engine_report()["mesh"]["devices"] == n_devices
+
+
+def test_consensus_counters_match_golden(pinned):
+    """The consensus trace itself: cross-partition drops, per-server
+    quorum rejections, leader changes, and the defense counters they
+    modulate — exact at the pinned seed on all four legs."""
+    result, _pallas, _n_devices = pinned
+    for key in (
+        "simulated_events",
+        "sink_count",
+        "network_partitioned",
+        "server_quorum_dropped",
+        "leader_changes",
+        "server_fault_dropped",
+        "server_fault_retried",
+        "server_breaker_dropped",
+        "breaker_tripped",
+        "server_shed_dropped",
+        "server_budget_dropped",
+        "network_lost",
+    ):
+        assert getattr(result, key) == GOLDEN[key], key
+    assert result.quorum_dark_fraction == pytest.approx(
+        GOLDEN["quorum_dark_fraction"], rel=1e-12
+    )
+    assert result.time_without_leader_fraction == pytest.approx(
+        GOLDEN["time_without_leader_fraction"], rel=1e-9
+    )
+
+
+def test_consensus_windowed_series_match_golden(pinned):
+    result, _pallas, _n_devices = pinned
+    series = result.timeseries
+    assert series is not None and series.n_windows == 8
+    np.testing.assert_array_equal(
+        np.asarray(series.network_partitioned),
+        GOLDEN["window_net_partitioned"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(series.server_quorum_dropped).sum(axis=1),
+        GOLDEN["window_quorum_dropped"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(series.quorum_dark_fraction),
+        GOLDEN["window_quorum_dark_fraction"],
+        rtol=0,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(series.leader_uptime_fraction),
+        GOLDEN["window_leader_uptime_fraction"],
+        rtol=1e-6,
+    )
+
+
+def test_windowed_sums_equal_whole_run_counters(pinned):
+    """Every NEW consensus counter's windowed series sums exactly to
+    its whole-run twin, and the two time-integral series (quorum-dark,
+    leader-uptime) re-total the whole-run fractions (float32
+    re-association aside)."""
+    result, _pallas, _n_devices = pinned
+    series = result.timeseries
+    assert int(np.asarray(series.network_partitioned).sum()) == (
+        result.network_partitioned
+    )
+    np.testing.assert_array_equal(
+        np.asarray(series.server_quorum_dropped).sum(axis=0),
+        np.asarray(result.server_quorum_dropped),
+    )
+    window_len = np.asarray(series.window_len_s)
+    dark_total = (
+        np.asarray(series.quorum_dark_fraction) * window_len
+    ).sum() / result.horizon_s
+    assert dark_total == pytest.approx(result.quorum_dark_fraction, abs=1e-6)
+    leaderless_total = (
+        (1.0 - np.asarray(series.leader_uptime_fraction)) * window_len
+    ).sum() / result.horizon_s
+    assert leaderless_total == pytest.approx(
+        result.time_without_leader_fraction, abs=1e-5
+    )
+
+
+def test_golden_exercises_every_consensus_class():
+    """Sanity on the golden itself: each consensus mechanism AND each
+    defense actually fired at the pinned seed (a golden of zeros would
+    pin nothing)."""
+    assert GOLDEN["network_partitioned"] > 0  # cross-partition drops
+    assert sum(GOLDEN["server_quorum_dropped"]) > 0  # quorum rejections
+    assert GOLDEN["quorum_dark_fraction"] > 0.0  # dark time booked
+    assert GOLDEN["leader_changes"] > 0  # elections fired
+    assert GOLDEN["time_without_leader_fraction"] > 0.0  # leaderless time
+    assert min(GOLDEN["window_leader_uptime_fraction"][1:3]) == 1.0  # ...and led
+    assert sum(GOLDEN["breaker_tripped"]) > 0  # defenses engaged on top
+    assert sum(GOLDEN["server_shed_dropped"]) > 0
+    assert sum(GOLDEN["server_budget_dropped"]) > 0
+    assert sum(GOLDEN["server_fault_retried"]) > 0  # chaos still flowing
+    assert GOLDEN["network_lost"] > 0

@@ -234,6 +234,123 @@ def test_resilience_adds_no_decline_reasons():
         assert feature not in defended_reason
 
 
+def _consensus_mm1():
+    """The chain-eligible M/M/1 shape with the full consensus layer on
+    top: partition windows (the dark source), a 1-of-1 quorum, and a
+    single-member election — the smallest model that must decline BOTH
+    fast paths by name."""
+    model = EnsembleModel(horizon_s=2.0, macro_block=2)
+    src = model.source(rate=5.0)
+    srv = model.server(service_mean=0.1, queue_capacity=8)
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    model.network_partition(group=[srv], windows=((0.5, 1.0),))
+    model.quorum([srv], write=1, read=1)
+    model.leader_election([srv], heartbeat_s=0.1, timeout_s=0.3)
+    return model
+
+
+CONSENSUS_DECLINES = (
+    "network partitions",
+    "quorum group",
+    "leader election",
+)
+
+
+def test_consensus_declines_kernel_by_name(monkeypatch):
+    """ISSUE-16 contract: partitions, quorum, and leader election each
+    decline the Pallas kernel with a NAMED per-feature reason (no
+    blanket "consensus" reason), all collected into the one "; "-joined
+    kernel_decline note."""
+    from happysim_tpu.tpu.kernels import kernel_plan
+
+    plan, reason = kernel_plan(_consensus_mm1())
+    assert plan is None
+    for feature in CONSENSUS_DECLINES:
+        assert feature in reason, (feature, reason)
+    # One joined list, partitions first (the consult site the kernel
+    # would have to fuse first).
+    assert reason.index("network partitions") < reason.index("quorum group")
+    assert reason.index("quorum group") < reason.index("leader election")
+    assert reason.count("; ") >= 2
+
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _consensus_mm1(),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=48,
+    )
+    # The chain closed form also declines (silently, by construction):
+    # the scan ran, and the decline note surfaces every reason.
+    assert result.engine_path == "scan"
+    for feature in CONSENSUS_DECLINES:
+        assert feature in result.kernel_decline
+    assert "HS_TPU_PALLAS" in result.kernel_decline
+    assert result.consensus_features == (
+        "network_partitions",
+        "quorum",
+        "leader_election",
+    )
+
+
+def test_consensus_chain_decline_by_feature():
+    """Each consensus feature ALONE pushes the chain-eligible M/M/1 off
+    the closed form onto the scan — and the consensus-free base model
+    still runs the chain (the decline is per-feature, not blanket)."""
+    from happysim_tpu.tpu.model import mm1_model
+
+    base = mm1_model(lam=4.0, mu=9.0, horizon_s=2.0)
+    result = run_ensemble(
+        base,
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+    )
+    assert result.engine_path == "chain"
+
+    def with_feature(feature):
+        from happysim_tpu.tpu.model import SERVER, NodeRef
+
+        model = mm1_model(lam=4.0, mu=9.0, horizon_s=2.0)
+        srv = NodeRef(SERVER, 0)
+        if feature in ("partition", "quorum", "leader"):
+            model.network_partition(group=[srv], windows=((0.5, 1.0),))
+        if feature == "quorum":
+            model.quorum([srv], write=1, read=1)
+        if feature == "leader":
+            model.leader_election([srv], heartbeat_s=0.1, timeout_s=0.3)
+        return run_ensemble(
+            model,
+            n_replicas=4,
+            seed=0,
+            mesh=replica_mesh(jax.devices("cpu")[:1]),
+            max_events=48,
+        )
+
+    for feature in ("partition", "quorum", "leader"):
+        assert with_feature(feature).engine_path == "scan", feature
+
+
+def test_consensus_free_models_add_no_new_reasons():
+    """The declined-shape reason list is unchanged for models without
+    consensus specs, and no consensus feature name ever appears in a
+    consensus-free decline."""
+    from happysim_tpu.tpu.kernels import kernel_plan
+    from happysim_tpu.tpu.model import RateProfile
+
+    model = _router_model()  # least_outstanding: adaptive, declines
+    model.sources[0].profile = RateProfile(
+        kind="ramp", end_rate=9.0, ramp_duration_s=0.5
+    )
+    plan, reason = kernel_plan(model)
+    assert plan is None
+    for feature in CONSENSUS_DECLINES:
+        assert feature not in reason
+
+
 def test_kernel_decline_surfaces_every_reason(monkeypatch):
     """ISSUE-14 satellite: EnsembleResult.kernel_decline carries the
     FULL decline list (``; ``-joined, first reason first), not just the
