@@ -691,6 +691,148 @@ def bench_kernel_router(devices) -> dict:
     }
 
 
+def bench_kernel_graph(devices) -> dict:
+    """The ISSUE-17 shape on the fast path: a ρ-sweep TWO-TIER service
+    DAG (ramp-profiled source -> least_outstanding front tier of 2
+    servers -> a second least_outstanding router -> shared back tier of
+    2 servers -> sink), fused-kernel vs lax-step A/B. This is the
+    general topology walk end to end: multi-router planning, the
+    adaptive outstanding-count gather, and the profile lookup tables
+    riding VMEM as tile-shared constants. ρ is swept via a
+    ``service_mean`` sweep — ``source_rate`` sweeps are incompatible
+    with profiled sources (the profile already owns rate(t)) — walking
+    each replica's back tier from idle to near-saturation. Bit-identity
+    is asserted on the counters INCLUDING the per-server completion
+    spread across BOTH tiers — the routing trace itself — so a
+    divergence in the gather, the route slots, or the table lookup
+    cannot hide behind aggregate sink stats.
+    """
+    import jax
+    import numpy as np
+
+    from happysim_tpu.tpu import run_ensemble
+    from happysim_tpu.tpu.kernels import env_override, pallas_available
+    from happysim_tpu.tpu.mesh import replica_mesh
+
+    if not pallas_available():
+        return {
+            "metric": "simulated-events/sec (kernel-path 2-tier graph)",
+            "skipped": "jax.experimental.pallas unavailable in this jaxlib",
+        }
+
+    from happysim_tpu.tpu.model import EnsembleModel
+
+    n_tier = 2  # servers per tier (front + shared back)
+    peak_rate = 40.0  # ramp target, req/s
+
+    def build():
+        model = EnsembleModel(
+            horizon_s=PALLAS_HORIZON_S,
+            warmup_s=PALLAS_HORIZON_S / 4,
+            transit_capacity=16,
+        )
+        model.macro_block = PALLAS_MACRO_BLOCK
+        src = model.ramp_source(
+            peak_rate / 2, peak_rate, PALLAS_HORIZON_S / 2
+        )
+        front = [
+            model.server(concurrency=1, service_mean=0.02, queue_capacity=256)
+            for _ in range(n_tier)
+        ]
+        back = [
+            model.server(concurrency=1, service_mean=0.02, queue_capacity=256)
+            for _ in range(n_tier)
+        ]
+        front_lb = model.router(policy="least_outstanding", targets=front)
+        back_lb = model.router(policy="least_outstanding", targets=back)
+        snk = model.sink()
+        model.connect(src, front_lb)
+        for server in front:
+            model.connect(server, back_lb)
+        for server in back:
+            model.connect(server, snk)
+        return model
+
+    # ρ sweep via service_mean: the ramp averages ~0.75*peak_rate, split
+    # over n_tier servers per tier, so mean per-server ρ is
+    # (0.75 * peak / n_tier) * service_mean. Sweeping service_mean over
+    # [0.1, 0.95] / that arrival rate walks each replica's tiers from
+    # idle to near-saturation (source_rate sweeps would fight the
+    # profile, so the SERVICE side carries the sweep).
+    per_server_rate = 0.75 * peak_rate / n_tier
+    sweeps = {
+        "service_mean": (
+            np.linspace(0.1, 0.95, PALLAS_REPLICAS) / per_server_rate
+        ).astype(np.float32)
+    }
+    # Each job: source fire + front completion + back completion.
+    max_events = int(4.0 * peak_rate * PALLAS_HORIZON_S) + 64
+    mesh = replica_mesh(jax.devices()[:1])  # 1-shard A/B (kernel is mesh-first)
+
+    def run(pallas: bool):
+        with env_override("HS_TPU_PALLAS", "1" if pallas else "0"):
+            return run_ensemble(
+                build(),
+                n_replicas=PALLAS_REPLICAS,
+                seed=0,
+                mesh=mesh,
+                sweeps=sweeps,
+                max_events=max_events,
+            )
+
+    lax_r = run(False)
+    kernel_r = run(True)
+    assert kernel_r.engine_path == "scan+pallas", kernel_r.kernel_decline
+    assert kernel_r.kernel_shape == "graph"
+    assert lax_r.engine_path == "scan"
+    bit_identical = bool(
+        lax_r.simulated_events == kernel_r.simulated_events
+        and lax_r.sink_count == kernel_r.sink_count
+        and lax_r.sink_mean_latency_s == kernel_r.sink_mean_latency_s
+        and lax_r.server_completed == kernel_r.server_completed
+        and lax_r.server_dropped == kernel_r.server_dropped
+        and lax_r.transit_dropped == kernel_r.transit_dropped
+        and (np.asarray(lax_r.sink_hist) == np.asarray(kernel_r.sink_hist)).all()
+    )
+    assert bit_identical, (
+        "2-tier graph diverged between the Pallas kernel and the lax "
+        "event step — the tier-by-tier routing trace (per-server "
+        "counters) must be bit-identical per lane"
+    )
+    speedup = lax_r.wall_seconds / max(kernel_r.wall_seconds, 1e-9)
+    label = (
+        f"simulated-events/sec (CPU fallback, INTERPRETED kernel, {PALLAS_REPLICAS}-replica 2-tier LB graph rho sweep)"
+        if DEVICE_FALLBACK
+        else f"simulated-events/sec/chip (Pallas kernel, {PALLAS_REPLICAS // 1000}k-replica 2-tier LB graph rho sweep)"
+    )
+    return {
+        "metric": label,
+        "value": round(kernel_r.events_per_second, 0),
+        "unit": "events/sec",
+        "vs_baseline": round(
+            kernel_r.events_per_second / REFERENCE_EVENTS_PER_SEC, 2
+        ),
+        "lax_events_per_sec": round(lax_r.events_per_second, 0),
+        "kernel_vs_lax_speedup": round(speedup, 3),
+        "bit_identical": bit_identical,
+        "router_policies": ["least_outstanding", "least_outstanding"],
+        "source_profile": "ramp",
+        "n_servers": 2 * n_tier,
+        "kernel_shape": kernel_r.kernel_shape,
+        "tier_completed": [int(c) for c in kernel_r.server_completed],
+        "macro_block": PALLAS_MACRO_BLOCK,
+        "n_replicas": kernel_r.n_replicas,
+        "horizon_s": kernel_r.horizon_s,
+        "simulated_events": kernel_r.simulated_events,
+        "wall_seconds": round(kernel_r.wall_seconds, 6),
+        "lax_wall_seconds": round(lax_r.wall_seconds, 6),
+        "compile_seconds": round(kernel_r.compile_seconds, 6),
+        "lax_compile_seconds": round(lax_r.compile_seconds, 6),
+        "device": str(devices[0]),
+        "n_devices": len(devices),
+    }
+
+
 def bench_kernel_chaos(devices) -> dict:
     """The ISSUE-14 stack on the fast path: a faulted+resilient+lossy
     router ρ-sweep (limiter admission -> round_robin fan-out over 4
@@ -1676,6 +1818,7 @@ def main() -> int:
     pallas = bench_pallas_kernel(devices)
     ktel = bench_kernel_telemetry(devices)
     krouter = bench_kernel_router(devices)
+    kgraph = bench_kernel_graph(devices)
     kchaos = bench_kernel_chaos(devices)
     resilience = bench_resilience(devices)
     multichip = bench_multichip_mesh(devices)
@@ -1689,6 +1832,7 @@ def main() -> int:
         pallas["device_fallback"] = note
         ktel["device_fallback"] = note
         krouter["device_fallback"] = note
+        kgraph["device_fallback"] = note
         kchaos["device_fallback"] = note
         resilience["device_fallback"] = note
         engine["north_star_ok"] = False  # per-chip target is a TPU claim
@@ -1700,6 +1844,7 @@ def main() -> int:
     print(json.dumps(pallas))
     print(json.dumps(ktel))
     print(json.dumps(krouter))
+    print(json.dumps(kgraph))
     print(json.dumps(kchaos))
     print(json.dumps(resilience))
     print(json.dumps(multichip))

@@ -179,7 +179,9 @@ def fanout_plan(model: EnsembleModel) -> Optional[dict]:
 
     Each router target is a sink (zero-latency pass-through) or the head
     of a disjoint server chain ending at the sink. Random (uniform) and
-    round-robin policies only — least_outstanding is state-dependent.
+    round-robin policies only — least_outstanding is state-dependent, so
+    no closed form exists (the scan engines run it, and the Pallas graph
+    plan fuses it; this closed-form path simply stays out).
     Returns {"policy": ..., "branches": [[server indices], ...]}.
     """
     if not _source_ok(model) or len(model.routers) != 1:

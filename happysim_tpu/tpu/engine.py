@@ -581,8 +581,9 @@ class EnsembleResult:
     # kernel ran or the run never reached the scan dispatch).
     kernel_decline: str = ""
     # Which kernel_plan shape the Pallas path engaged on ("mm1", "chain",
-    # or "router"; "" off the kernel path) — coverage provenance for
-    # engine_report() consumers tracking which topology class ran fused.
+    # "router", or "graph" for the general multi-router DAG walk; "" off
+    # the kernel path) — coverage provenance for engine_report()
+    # consumers tracking which topology class ran fused.
     kernel_shape: str = ""
     # The chaos dimension of that shape: which declared chaos/resilience
     # features (model.chaos_features() names — "faults",
@@ -1354,15 +1355,53 @@ class _Compiled:
         return False
 
     # -- uniform-slot layout -------------------------------------------------
+    def _router_hop_depth(self) -> int:
+        """Longest chain of DIRECT router->router target edges, plus
+        one — the most router hops a single delivery can take (server
+        arrivals, sinks, and transit parks all end the delivery, so
+        only direct chaining stacks hops). ``validate()`` rejects
+        router cycles; the ``seen`` guard below keeps a hand-mutated
+        cyclic spec from hanging this walk (it still fails validation
+        before any run)."""
+        memo: dict[int, int] = {}
+
+        def depth(i: int, seen: frozenset) -> int:
+            if i in memo:
+                return memo[i]
+            if i in seen:
+                return 0
+            nested = [
+                depth(t.index, seen | {i})
+                for t in self.model.routers[i].targets
+                if t.kind == ROUTER
+            ]
+            memo[i] = 1 + max(nested, default=0)
+            return memo[i]
+
+        return max(
+            (depth(i, frozenset()) for i in range(len(self.model.routers))),
+            default=0,
+        )
+
+    def _route_slot(self, hop: int) -> Optional[int]:
+        """The choice-draw slot for a router hop at nesting depth
+        ``hop`` (0 = the first router a delivery meets). The min is
+        structural armor only: ``_router_hop_depth`` bounds the hops
+        any trace can take, so a longer index cannot occur."""
+        if not self.U_ROUTE_HOPS:
+            return None
+        return self.U_ROUTE_HOPS[min(hop, len(self.U_ROUTE_HOPS) - 1)]
+
     def _assign_uniform_slots(self) -> None:
         """Compile-time map of draw slots the topology can consume.
 
-        Slots: arrival gap (any Poisson source), router choice (any
-        "random"- or "weighted"-policy router — both spend one uniform
-        per hop), edge latency (any exponential edge with positive
-        mean), and two service-draw windows (a delivery arrival and a
-        completion's queue pull can both sample service in one step).
-        An M/M/1 ends up with 3 draws/step instead of a fixed 8.
+        Slots: arrival gap (any Poisson source), router choices (any
+        "random"- or "weighted"-policy router — one uniform per router
+        HOP, depth-indexed when routers chain directly), edge latency
+        (any exponential edge with positive mean), and two service-draw
+        windows (a delivery arrival and a completion's queue pull can
+        both sample service in one step). An M/M/1 ends up with 3
+        draws/step instead of a fixed 8.
         """
         slot = 0
         if self.arrival_is_poisson.any():
@@ -1371,9 +1410,20 @@ class _Compiled:
         else:
             self.U_GAP = None
         if any(r.policy in ("random", "weighted") for r in self.model.routers):
+            # One choice draw per ROUTER HOP: a delivery crossing D
+            # directly-chained routers (multi-tier DAGs) can spend up to
+            # D uniforms, one per random/weighted hop, each from its own
+            # depth-indexed slot. Single-tier models have depth 1 and
+            # allocate exactly the one U_ROUTE slot they always had, so
+            # existing RNG streams (and their pinned goldens) are
+            # byte-identical; U_ROUTE stays the hop-0 alias for
+            # consumers that never chain (partitioned.py).
+            hops = self._router_hop_depth()
+            self.U_ROUTE_HOPS: tuple = tuple(range(slot, slot + hops))
             self.U_ROUTE: Optional[int] = slot
-            slot += 1
+            slot += hops
         else:
+            self.U_ROUTE_HOPS = ()
             self.U_ROUTE = None
         if any(
             e.mean_s > 0 and e.kind == "exponential" for e in self.model.iter_edges()
@@ -1466,6 +1516,24 @@ class _Compiled:
             self.profile_times[i] = grid
             self.profile_cum[i] = cumulative
             self.profile_end_rate[i] = max(rates[-1], 1e-9)
+        # Device-resident grids, created ONCE per profiled source and
+        # closed over by _profile_cum_at/_invert_profile. Both lookup
+        # sites share the same array object, so the traced step closure
+        # carries exactly one (G,) times grid and one (G,) cumulative
+        # grid per profiled source — which is what lets the kernel's
+        # hoisted-const working-set accounting (kernels/event_step.py
+        # shared_const_bytes) be exact instead of estimating duplicate
+        # per-call constants.
+        self._profile_times_dev = {
+            i: jnp.asarray(self.profile_times[i])
+            for i in range(self.nS)
+            if self.has_profile[i]
+        }
+        self._profile_cum_dev = {
+            i: jnp.asarray(self.profile_cum[i])
+            for i in range(self.nS)
+            if self.has_profile[i]
+        }
 
     # -- state -------------------------------------------------------------
     def init_state(self, key, params):
@@ -1928,16 +1996,16 @@ class _Compiled:
 
     def _profile_cum_at(self, i: int, t):
         """Lambda_i(t) with linear extrapolation past the grid."""
-        times = jnp.asarray(self.profile_times[i])
-        cum = jnp.asarray(self.profile_cum[i])
+        times = self._profile_times_dev[i]
+        cum = self._profile_cum_dev[i]
         inside = jnp.interp(t, times, cum)
         beyond = cum[-1] + (t - times[-1]) * self.profile_end_rate[i]
         return jnp.where(t <= times[-1], inside, beyond)
 
     def _invert_profile(self, i: int, t, target_increment):
         """Gap g such that Lambda_i(t+g) - Lambda_i(t) = target_increment."""
-        times = jnp.asarray(self.profile_times[i])
-        cum = jnp.asarray(self.profile_cum[i])
+        times = self._profile_times_dev[i]
+        cum = self._profile_cum_dev[i]
         target = self._profile_cum_at(i, t) + target_increment
         inside = jnp.interp(target, cum, times)
         beyond = times[-1] + (target - cum[-1]) / self.profile_end_rate[i]
@@ -2026,7 +2094,17 @@ class _Compiled:
             )
         return out
 
-    def _deliver(self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params):
+    def _deliver(
+        self,
+        state,
+        t,
+        created,
+        u,
+        dest: NodeRef,
+        edge: EdgeLatency,
+        params,
+        hop: int = 0,
+    ):
         """Deliver a job leaving some node at time t across ``edge``.
 
         ``u`` is the step's full uniform vector; the named slots
@@ -2034,7 +2112,9 @@ class _Compiled:
         edge drops the crossing with probability ``edge.loss_p`` inside
         its loss window — the job vanishes and ``net_lost`` counts it
         (router per-target losses are handled at the router hop below,
-        after the choice is made).
+        after the choice is made). ``hop`` counts the router hops this
+        delivery has already taken (it selects the depth-indexed route
+        draw slot when routers chain directly).
         """
         if edge.loss_p > 0.0:
             # Validation confines loss to edges into sinks/servers, so
@@ -2047,16 +2127,26 @@ class _Compiled:
                 jnp.float32(edge.loss_end_s),
             )
             delivered = self._deliver_chosen(
-                state, t, created, u, dest, edge, params
+                state, t, created, u, dest, edge, params, hop
             )
             return self._select_lost(state, lost, delivered, t)
-        return self._deliver_chosen(state, t, created, u, dest, edge, params)
+        return self._deliver_chosen(state, t, created, u, dest, edge, params, hop)
 
     def _deliver_chosen(
-        self, state, t, created, u, dest: NodeRef, edge: EdgeLatency, params
+        self,
+        state,
+        t,
+        created,
+        u,
+        dest: NodeRef,
+        edge: EdgeLatency,
+        params,
+        hop: int = 0,
     ):
         if dest.kind == LIMITER:
-            return self._through_limiter(state, t, created, u, dest.index, params)
+            return self._through_limiter(
+                state, t, created, u, dest.index, params, hop
+            )
         if dest.kind == SINK:
             latency = self._sample_edge(edge, self._uslot(u, self.U_LAT))
             return self._deliver_sink(state, t + latency, created, dest.index)
@@ -2077,13 +2167,18 @@ class _Compiled:
                     state, t, created, dest.index, delivered, arrival_t
                 )
             return delivered
-        # Router: one dynamic hop to a homogeneous target set. Edges INTO a
+        # Router: one dynamic hop to its target list. Edges INTO a
         # router are latency-free by construction (model.connect rejects
-        # them); only the per-target edge below carries latency.
+        # them); only the per-target edge below carries latency. A
+        # chosen ROUTER target recurses — statically, at trace time,
+        # with hop+1 selecting the next depth-indexed route draw —
+        # which is how multi-tier DAGs unroll into the one traced step
+        # closure the kernel fuses (validate() rejects router cycles,
+        # so the recursion is bounded by the DAG depth).
         router = self.model.routers[dest.index]
         target_kinds = {ref.kind for ref in router.targets}
         indices = jnp.asarray([ref.index for ref in router.targets], jnp.int32)
-        choice = self._route_choice(state, u, dest.index, router, indices)
+        choice = self._route_choice(state, u, dest.index, router, indices, hop)
         state = self._bump_rr(state, dest.index, router)
         lat_means = np.asarray(
             [e.mean_s for e in router.target_latencies], np.float32
@@ -2145,6 +2240,74 @@ class _Compiled:
                     )
                 return delivered
 
+            def to_routers(state):
+                # One candidate delivery through each DISTINCT
+                # downstream router (edges into routers are latency- and
+                # loss-free by construction, so the hop itself spends no
+                # latency draw), selected by the chosen target's router
+                # index. Unchosen candidates — their rr_next bumps and
+                # deeper deliveries included — are discarded whole by
+                # the select, exactly like the server/sink mix below.
+                candidates = [
+                    (
+                        r_index,
+                        self._deliver_chosen(
+                            state,
+                            t,
+                            created,
+                            u,
+                            NodeRef(ROUTER, r_index),
+                            EdgeLatency(),
+                            params,
+                            hop + 1,
+                        ),
+                    )
+                    for r_index in dict.fromkeys(
+                        ref.index
+                        for ref in router.targets
+                        if ref.kind == ROUTER
+                    )
+                ]
+                if len(candidates) == 1:
+                    return candidates[0][1]
+                chosen_router = jnp.asarray(
+                    [
+                        ref.index if ref.kind == ROUTER else -1
+                        for ref in router.targets
+                    ],
+                    jnp.int32,
+                )[choice]
+                out = candidates[0][1]
+                for r_index, candidate in candidates[1:]:
+                    picked = chosen_router == r_index
+                    out = jax.tree_util.tree_map(
+                        lambda cand_leaf, acc_leaf, _p=picked: jnp.where(
+                            _p, cand_leaf, acc_leaf
+                        ),
+                        candidate,
+                        out,
+                    )
+                return out
+
+            if target_kinds == {ROUTER}:
+                return to_routers(state)
+            if target_kinds == {ROUTER, SERVER}:
+                # Tier-or-serve mix: both arms are computed predicated
+                # and selected by the chosen target's kind (validate()
+                # rejects router+sink mixes, so these two arms are
+                # exhaustive here).
+                is_router = jnp.asarray(
+                    [ref.kind == ROUTER for ref in router.targets]
+                )[choice]
+                routed = to_routers(state)
+                served = to_server(state)
+                return jax.tree_util.tree_map(
+                    lambda router_leaf, server_leaf: jnp.where(
+                        is_router, router_leaf, server_leaf
+                    ),
+                    routed,
+                    served,
+                )
             if target_kinds == {SERVER}:
                 return to_server(state)
             # Mixed server/sink targets ("done or continue" — probabilistic
@@ -2184,7 +2347,7 @@ class _Compiled:
             return self._select_lost(state, lost, finish(state), t)
         return finish(state)
 
-    def _through_limiter(self, state, t, created, u, l: int, params):
+    def _through_limiter(self, state, t, created, u, l: int, params, hop: int = 0):
         """Token-bucket admission, inline (limiter edges are latency-free)."""
         limiter = self.model.limiters[l]
         row = self._row(l, self.nL)
@@ -2213,7 +2376,7 @@ class _Compiled:
                 state, "tel_lim_dropped", wrow, row, ~admit
             )
         delivered = self._deliver(
-            state, t, created, u, limiter.downstream, limiter.latency, params
+            state, t, created, u, limiter.downstream, limiter.latency, params, hop
         )
         # Rejected jobs vanish: keep the admission bookkeeping, drop the
         # delivery's effects. (Big queue arrays aren't in this state — the
@@ -2224,11 +2387,12 @@ class _Compiled:
             state,
         )
 
-    def _route_choice(self, state, u, router_index, router, indices):
+    def _route_choice(self, state, u, router_index, router, indices, hop: int = 0):
         n = len(router.targets)
         if router.policy == "random":
             return jnp.minimum(
-                (self._uslot(u, self.U_ROUTE) * n).astype(jnp.int32), n - 1
+                (self._uslot(u, self._route_slot(hop)) * n).astype(jnp.int32),
+                n - 1,
             )
         if router.policy == "weighted":
             # Static per-target weights: choice i iff u lands in
@@ -2240,7 +2404,9 @@ class _Compiled:
             cum = jnp.asarray((np.cumsum(weights) / weights.sum()), jnp.float32)
             return jnp.minimum(
                 jnp.sum(
-                    (self._uslot(u, self.U_ROUTE) >= cum).astype(jnp.int32)
+                    (self._uslot(u, self._route_slot(hop)) >= cum).astype(
+                        jnp.int32
+                    )
                 ),
                 n - 1,
             )
@@ -3902,7 +4068,7 @@ def run_ensemble(
     )
 
     # One shape analysis serves both the dispatch decision and the
-    # engine_report() provenance ("mm1" / "chain" / "router").
+    # engine_report() provenance ("mm1" / "chain" / "router" / "graph").
     kplan = kernel_plan(model)
     use_pallas, kernel_note = kernel_decision(
         model,

@@ -15,25 +15,28 @@ closure, so the float op order per lane is identical to the lax path by
 construction — results are bit-identical, and ``HS_TPU_PALLAS=0`` /
 ``=1`` is a pure A/B lever (see docs/guides/tpu-kernels.md).
 
-Coverage: chain-shaped and M/M/1-shaped models (single source -> server
-chain -> sink) AND single-router load-balancer fan-outs (source ->
-random/round_robin/weighted router -> N servers -> fan-in -> sink, with
-per-target latency edges), with the WHOLE chaos stack riding either
-shape: per-server stochastic fault schedules, correlated
-(shared-Bernoulli) outages, backoff+jitter client retries, hedged
-requests, deterministic brownouts, per-edge packet loss, token-bucket
-limiters (pass-through hops on the source->sink path), and windowed
-telemetry. The ``(nW, ...)`` telemetry buffers, ``(nV, W)`` fault and
-``(W_sh,)`` trigger registers, limiter token columns, transit retry
-registers, and router state (``rr_next`` cursor, fan-out queue rings)
-are ordinary state leaves, so they ride the VMEM-resident tile, their
-RNG slots draw from the same fold_in(key, abs-block) uniform chunk as
-the lax path, and the scatter-adds are the engine's own traced
-accounting sites (the realistic "load-balanced resilient model with
-telemetry on" configuration runs on the fast path end to end). Adaptive
-(least_outstanding) routing, >1 router, rate profiles, mixed router
-targets, feedback loops, and register files that exceed the VMEM tile
-budget *soundly decline* to the lax step via :func:`kernel_plan` /
+Coverage: any single-source, single-sink service graph the model can
+express — M/M/1s, server chains, load-balancer fan-outs under every
+router policy (``random`` / ``round_robin`` / ``weighted`` / adaptive
+``least_outstanding``), multi-router tiers (routers targeting routers),
+shared backends, probabilistic server/sink exits, per-tier token-bucket
+limiters, and sources with ramp/spike rate profiles (inverse-integral
+lookup tables riding the tile as shared VMEM constants) — with the
+WHOLE chaos stack riding any shape: per-server stochastic fault
+schedules, correlated (shared-Bernoulli) outages, backoff+jitter client
+retries, hedged requests, deterministic brownouts, per-edge packet
+loss, and windowed telemetry. The ``(nW, ...)`` telemetry buffers,
+``(nV, W)`` fault and ``(W_sh,)`` trigger registers, limiter token
+columns, transit retry registers, and router state (``rr_next`` cursor,
+fan-out queue rings) are ordinary state leaves, so they ride the
+VMEM-resident tile, their RNG slots draw from the same fold_in(key,
+abs-block) uniform chunk as the lax path, and the scatter-adds are the
+engine's own traced accounting sites (the realistic "load-balanced
+resilient model with telemetry on" configuration runs on the fast path
+end to end). The consensus tier (partitions / quorum / leader
+election), remote egress nodes, graphs with nodes off the source->sink
+walk, and register files that exceed the VMEM tile budget *soundly
+decline* to the lax step via :func:`kernel_plan` /
 :func:`kernel_decision` — the same pattern as ``chain.fast_plan`` — so
 correctness never depends on kernel coverage, and the decline reason
 carries EVERY offending feature (``;``-joined).
@@ -46,6 +49,7 @@ from happysim_tpu.tpu.kernels.event_step import (
     pad_replicas,
     replica_tile_bytes,
     replica_working_set_bytes,
+    shared_const_bytes,
     state_template,
 )
 from happysim_tpu.tpu.kernels.support import (
@@ -74,5 +78,6 @@ __all__ = [
     "pallas_available",
     "replica_tile_bytes",
     "replica_working_set_bytes",
+    "shared_const_bytes",
     "state_template",
 ]

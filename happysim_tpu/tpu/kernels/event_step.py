@@ -30,10 +30,13 @@ so one tile's in+out register file fits the VMEM budget; a replica
 count that is not a tile multiple is edge-padded (the padded lanes
 duplicate the last replica and are sliced away before reduction).
 Telemetry buffers count toward the same budget — the tile shrinks as
-``nW`` grows, and a register file that exceeds the budget even at
-tile=1 is DECLINED by :func:`~happysim_tpu.tpu.kernels.support.
-kernel_decision` (with a budget-naming reason) rather than silently
-spilled to HBM.
+``nW`` grows — and TILE-SHARED constants (the rate-profile
+inverse-integral lookup tables, hoisted into ``const_spec`` operands so
+every lane in the tile reads one copy) are subtracted from the budget
+up front via :func:`shared_const_bytes`. A register file that exceeds
+the budget even at tile=1 is DECLINED by
+:func:`~happysim_tpu.tpu.kernels.support.kernel_decision` (with a
+budget-naming reason) rather than silently spilled to HBM.
 """
 
 from __future__ import annotations
@@ -109,6 +112,24 @@ def state_template(compiled) -> dict:
     return template
 
 
+def shared_const_bytes(compiled) -> int:
+    """Bytes of VMEM the TILE-SHARED step constants pin — today the
+    rate-profile lookup tables (one ``(G,)`` time grid plus one ``(G,)``
+    cumulative grid per profiled source, hoisted by the engine to ONE
+    device array each so the jaxpr const dedup makes this count exact),
+    plus a small allowance for the 0-d consts every closure carries.
+    These ride the kernel as ``const_spec`` operands (whole block every
+    grid step), so they are paid ONCE per tile rather than per replica:
+    :func:`build_block_step` and ``kernel_decision`` both subtract this
+    from the tile budget before dividing by the per-replica working
+    set."""
+    n_profiled = int(np.asarray(compiled.has_profile).sum())
+    if n_profiled == 0:
+        return 0
+    n_grid = int(compiled.profile_times.shape[1])
+    return n_profiled * (2 * n_grid * 4 + 16)
+
+
 def replica_working_set_bytes(compiled, macro: int, template=None) -> int:
     """Bytes of VMEM one replica pins during a fused macro-block: state
     counted twice (the aliased outputs still occupy a tile during the
@@ -173,13 +194,22 @@ def build_block_step(
     template = state_template(compiled)
     names = tuple(sorted(template))
     per_replica = replica_working_set_bytes(compiled, macro, template)
+    shared = shared_const_bytes(compiled)
     if tile is None:
-        tile = choose_tile(n_replicas, per_replica)
+        # Tile-shared consts (profile lookup tables) are paid once per
+        # tile, not per replica: subtract them from the budget before
+        # sizing the tile. max(..., 1) keeps a pathological shared set
+        # from zeroing the budget — the tile=1 decline in
+        # kernel_decision fires first and names the tables.
+        tile = choose_tile(
+            n_replicas, per_replica, max(VMEM_TILE_BUDGET_BYTES - shared, 1)
+        )
     padded = padded_replica_count(n_replicas, tile)
     meta = {
         "tile": tile,
         "padded_replicas": padded,
         "bytes_per_replica": per_replica,
+        "shared_const_bytes": shared,
     }
 
     param_names = ("src_rate", "srv_mean")

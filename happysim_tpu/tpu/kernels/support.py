@@ -95,16 +95,23 @@ def _decline(reason: str) -> tuple[None, str]:
 def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     """The kernel's supported-shape predicate: ``(plan, reason)``.
 
-    Supported: exactly one source (Poisson or constant arrivals, no rate
-    profile) feeding EITHER a chain of FIFO servers (any concurrency,
-    any service family, optional deadlines/retries, constant or
-    exponential edges with or without latency) OR a single
-    load-balancing router fanning out over N servers that fan back in
-    at the sink (``random`` / ``round_robin`` / ``weighted`` policies,
-    per-target latency edges of either kind — the router hop's per-lane
-    divergence stays inside the traced step closure the kernel drives,
-    so the ragged work is VMEM-resident), ending at exactly one sink —
-    with the WHOLE chaos stack riding along on either shape: windowed
+    Supported: exactly one source (Poisson or constant arrivals, WITH or
+    without a rate profile — ramps/spikes compile to inverse-integral
+    lookup tables that ride the tile as shared VMEM constants) feeding
+    ANY source -> {routers, limiters, servers} -> sink graph the model
+    can express, ending at exactly one sink: server chains, a
+    load-balancing fan-out under every router policy (``random`` /
+    ``round_robin`` / ``weighted`` / adaptive ``least_outstanding`` —
+    the outstanding-count gather reads the same in-service + queued
+    accounting the lax path does, inside the traced step closure, so
+    the adaptive choice is bit-identical per lane), multi-router tiers
+    (routers targeting routers unroll statically with depth-indexed
+    choice draws), shared backends reachable from several routers,
+    chains behind fan-outs, probabilistic server/sink exits ("done or
+    continue" feedback), and per-tier token-bucket limiters — the
+    router hops' per-lane divergence stays inside the traced step
+    closure the kernel drives, so the ragged work is VMEM-resident —
+    with the WHOLE chaos stack riding along on any shape: windowed
     telemetry, per-server stochastic fault schedules (outage OR degrade
     windows), correlated (shared-Bernoulli) outage schedules,
     backoff+jitter client retries, hedged requests with
@@ -135,16 +142,24 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     pathological threshold is declined by :func:`kernel_decision`'s
     tile=1 budget check naming ``brk_fail_t``.
 
-    Remaining declines are per-feature and actionable — adaptive
-    (``least_outstanding``) routing, >1 router, remotes, rate profiles,
-    router→sink / mixed targets, feedback loops, server chains behind
-    the fan-out — and are COLLECTED: the reason string ``; ``-joins
-    every decline the model hits (first reason first), so a user fixes
-    the model in one pass instead of replaying whack-a-mole. The
-    decline is SOUND: the caller must run the lax step, never a partial
-    kernel. (Register files whose leaves do not fit the VMEM tile
-    budget are declined by :func:`kernel_decision`, which sees the
-    compiled state template and names the offending leaves.)
+    Remaining declines are per-feature and actionable — the consensus
+    tier by name (partitions / quorum / leader election), remote egress
+    nodes, more than one source or sink, nodes outside the walked
+    source->sink graph, and a source that never reaches the sink — and
+    are COLLECTED: the reason string ``; ``-joins every decline the
+    model hits (first reason first), so a user fixes the model in one
+    pass instead of replaying whack-a-mole. The decline is SOUND: the
+    caller must run the lax step, never a partial kernel. (Register
+    files whose leaves do not fit the VMEM tile budget are declined by
+    :func:`kernel_decision`, which sees the compiled state template and
+    names the offending leaves; the profile tables count there as
+    tile-shared bytes.)
+
+    The plan's ``shape`` is provenance for ``EnsembleResult``:
+    ``"mm1"`` / ``"chain"`` for router-free lines, ``"router"`` for the
+    classic single-router pure fan-out (all targets distinct servers
+    draining straight to the sink), and ``"graph"`` for everything else
+    the walk approves.
     """
     reasons: list[str] = []
     # Consensus layer (docs/guides/consensus-scenarios.md): partition
@@ -163,28 +178,17 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
         reasons.append(
             "model has leader election (not fused in the kernel yet)"
         )
-    if len(model.routers) > 1:
-        reasons.append(
-            f"model has {len(model.routers)} routers (kernel supports 1)"
-        )
     if model.remotes:
         reasons.append("model has remote egress nodes")
     if len(model.sources) != 1:
         reasons.append(f"{len(model.sources)} sources (kernel supports 1)")
     if len(model.sinks) != 1:
         reasons.append(f"{len(model.sinks)} sinks (kernel supports 1)")
-    if len(model.sources) == 1:
-        source = model.sources[0]
-        if source.profile is not None and source.profile.kind != "constant":
-            reasons.append("source has a rate profile")
     plan: Optional[dict] = None
-    # The topology walks need the single source; run them even when
+    # The topology walk needs the single source; run it even when
     # feature reasons were already collected so EVERY decline surfaces.
     if len(model.sources) == 1:
-        if len(model.routers) == 1:
-            plan = _router_plan(model, reasons)
-        elif not model.routers:
-            plan = _chain_plan(model, reasons)
+        plan = _graph_plan(model, reasons)
     if reasons:
         # One pass may visit a structure twice (e.g. a repeated fan-out
         # target re-walks its fan-in): dedupe, first occurrence first —
@@ -231,117 +235,143 @@ def _limiters_outside(
             )
 
 
-def _chain_plan(
+# Router policies the kernel claims. All four: the static policies are
+# pure functions of (uniform draw, rr_next cursor), and adaptive
+# least_outstanding is a static gather of per-server outstanding counts
+# (in-service + queued) inside the same traced closure — the tuple is
+# armor against a future policy landing without a kernel audit.
+KERNEL_ROUTER_POLICIES = (
+    "random",
+    "round_robin",
+    "weighted",
+    "least_outstanding",
+)
+
+
+def _graph_plan(
     model: EnsembleModel, reasons: list[str]
 ) -> Optional[dict]:
-    """The linear source -> (limiter?) -> server chain -> sink shape.
+    """The general topology walk: BFS from the single source across
+    every node a job can reach — servers (their one downstream edge),
+    routers (every target, any policy in :data:`KERNEL_ROUTER_POLICIES`,
+    routers-targeting-routers included), and token-bucket limiters
+    (transparent admission hops) — accepting any graph that reaches the
+    single sink and touches every declared node. Probabilistic
+    server/sink exits and server-mediated feedback are fine (a server
+    arrival ends the delivery, so the traced closure stays finite;
+    ``model.validate()`` already rejects the direct router cycles that
+    would not). Structural declines are APPENDED rather than returned,
+    so a model with several problems surfaces all of them at once; the
+    plan dict comes back only when this walk added no reasons.
 
-    Appends every structural decline to ``reasons`` (the caller joins);
-    returns the plan dict only when this walk added none."""
+    Shape classification keeps the provenance (and the pinned plan
+    dicts) of the special cases: router-free lines stay ``"mm1"`` /
+    ``"chain"`` with chain-ordered servers, the classic single-router
+    pure fan-out stays ``"router"`` with target-ordered servers, and
+    everything else is ``"graph"`` with BFS-ordered node lists."""
     before = len(reasons)
-    source = model.sources[0]
     limiters: list[int] = []
-    seen: list[int] = []
-    ref = _follow_limiters(model, source.downstream, limiters, reasons)
-    while ref is not None and ref.kind == SERVER:
-        if ref.index in seen:
-            reasons.append("server chain has a feedback loop")
-            break
-        seen.append(ref.index)
-        ref = _follow_limiters(
-            model, model.servers[ref.index].downstream, limiters, reasons
-        )
-    # A loop/limiter failure above already appended its reason, so this
-    # guard doubles as "the walk itself stayed clean".
-    if len(reasons) == before and (ref is None or ref.kind != SINK):
-        reasons.append("source path does not end at a sink")
+    seen_servers: list[int] = []
+    seen_routers: list[int] = []
+    reached_sink = False
+    visited: set[tuple[int, int]] = set()
+    queue = [model.sources[0].downstream]
+    while queue:
+        ref = _follow_limiters(model, queue.pop(0), limiters, reasons)
+        if ref is None:
+            # Dangling downstream (or a limiter loop, which recorded its
+            # own reason): nothing to enqueue. A branch that never
+            # reaches the sink surfaces through reached_sink below.
+            continue
+        if (ref.kind, ref.index) in visited:
+            continue
+        visited.add((ref.kind, ref.index))
+        if ref.kind == SINK:
+            reached_sink = True
+        elif ref.kind == SERVER:
+            seen_servers.append(ref.index)
+            queue.append(model.servers[ref.index].downstream)
+        elif ref.kind == ROUTER:
+            seen_routers.append(ref.index)
+            router = model.routers[ref.index]
+            if router.policy not in KERNEL_ROUTER_POLICIES:
+                # No nested parens: _decline wraps the reason itself.
+                reasons.append(
+                    f"router[{ref.index}] policy {router.policy!r} is "
+                    "outside the kernel set "
+                    + "/".join(KERNEL_ROUTER_POLICIES)
+                )
+            queue.extend(router.targets)
+        # REMOTE egress falls through: the by-name decline above already
+        # covers it, so the walk result is discarded anyway.
+    if len(reasons) == before and not reached_sink:
+        reasons.append("no path from the source reaches the sink")
     # Membership checks only when the walk itself succeeded: a broken
     # walk reaches fewer nodes by definition, and reporting that
-    # shortfall as a second problem would send the user chasing a
-    # phantom (every surfaced reason must be independently actionable).
+    # shortfall as extra problems would send the user chasing phantoms
+    # (every surfaced reason must be independently actionable).
     if len(reasons) == before:
-        if len(seen) != len(model.servers):
-            reasons.append("servers outside the source->sink chain")
+        orphans = [
+            i for i in range(len(model.servers)) if i not in seen_servers
+        ]
+        if orphans:
+            reasons.append(
+                "servers outside the source->sink graph: "
+                + ", ".join(f"server[{i}]" for i in orphans)
+            )
+        for i in range(len(model.routers)):
+            if i not in seen_routers:
+                reasons.append(
+                    f"router[{i}] is outside the source->sink graph"
+                )
         _limiters_outside(model, limiters, reasons)
     if len(reasons) > before:
         return None
-    shape = "mm1" if len(seen) == 1 else "chain"
-    return {"shape": shape, "servers": seen}
+    if not seen_routers:
+        # BFS order IS chain order on a router-free line (each server
+        # has one downstream), preserving the pinned chain plan dicts.
+        shape = "mm1" if len(seen_servers) == 1 else "chain"
+        return {"shape": shape, "servers": seen_servers}
+    pure = _pure_fanout_plan(model)
+    if pure is not None:
+        return pure
+    return {
+        "shape": "graph",
+        "servers": seen_servers,
+        "routers": seen_routers,
+        "policies": tuple(
+            model.routers[i].policy for i in seen_routers
+        ),
+    }
 
 
-# Router policies whose choice is a pure function of (uniform draw,
-# rr_next cursor) — compile-time constants aside. Adaptive policies
-# (least_outstanding reads live queue state across the fan-out) are not
-# claimed yet.
-KERNEL_ROUTER_POLICIES = ("random", "round_robin", "weighted")
-
-
-def _router_plan(
-    model: EnsembleModel, reasons: list[str]
-) -> Optional[dict]:
-    """The load-balancer fan-out shape: 1 source -> (limiter?) -> router
-    -> N servers -> fan-in -> 1 sink, with per-target latency edges of
-    either kind (lossy ones included — the loss Bernoulli is an
-    ordinary RNG slot). Every structural decline names the specific
-    router feature (not a blanket "model has routers") and is APPENDED
-    rather than returned, so a model with several problems surfaces all
-    of them at once; the plan dict comes back only when this walk added
-    no reasons."""
-    before = len(reasons)
+def _pure_fanout_plan(model: EnsembleModel) -> Optional[dict]:
+    """The classic load-balancer shape, kept as its own provenance
+    class: 1 source -> (limiter?) -> the ONE router -> N distinct
+    servers (every declared server) -> (limiter?) -> the sink. Returns
+    the pinned ``"router"`` plan dict (servers in TARGET order) or
+    ``None`` when the approved graph is anything richer. Called only
+    after a clean walk, so the limiter-following here cannot loop."""
+    if len(model.routers) != 1:
+        return None
     router = model.routers[0]
-    source = model.sources[0]
-    limiters: list[int] = []
-    fed = _follow_limiters(model, source.downstream, limiters, reasons)
-    fed_ok = fed is not None and fed.kind == ROUTER
-    if not fed_ok:
-        reasons.append("router is not fed by the source")
-    if router.policy not in KERNEL_ROUTER_POLICIES:
-        # No nested parens: _decline wraps the reason in its own pair.
-        reasons.append(
-            f"router policy {router.policy!r} is adaptive — kernel supports "
-            + "/".join(KERNEL_ROUTER_POLICIES)
-        )
-    # Reasons from here down are STRUCTURAL (they change which nodes
-    # the walk can reach); the policy check above is orthogonal and
-    # must not suppress the membership checks below.
-    structure_before = len(reasons)
-    kinds = {t.kind for t in router.targets}
-    if kinds == {SERVER, SINK}:
-        reasons.append(
-            "router has mixed sink/server targets (probabilistic exits)"
-        )
-    elif SINK in kinds:
-        reasons.append("router targets only sinks (no server fan-out)")
-    servers = [t.index for t in router.targets if t.kind == SERVER]
-    if len(set(servers)) != len(servers):
-        reasons.append("router fan-out repeats a server target")
-    for index in dict.fromkeys(servers):
-        down = _follow_limiters(
-            model, model.servers[index].downstream, limiters, reasons
-        )
-        if down is not None and down.kind == ROUTER:
-            reasons.append(
-                f"server[{index}] feeds back into the router (feedback loop)"
-            )
-        elif down is not None and down.kind == SERVER:
-            reasons.append(
-                f"server[{index}] chains to another server behind the router"
-            )
-        elif down is None or down.kind != SINK:
-            reasons.append(
-                f"server[{index}] fan-in does not end at the sink"
-            )
-    # Membership checks only when the feed AND every structural walk
-    # above succeeded: a broken walk reaches fewer nodes by definition,
-    # and reporting that shortfall as extra problems would send the
-    # user chasing phantoms (every surfaced reason must be
-    # independently actionable — same discipline as _chain_plan).
-    if fed_ok and len(reasons) == structure_before:
-        if len(set(servers)) != len(model.servers):
-            reasons.append("servers outside the router fan-out")
-        _limiters_outside(model, limiters, reasons)
-    if len(reasons) > before:
+    if any(t.kind != SERVER for t in router.targets):
         return None
+    servers = [t.index for t in router.targets]
+    if len(set(servers)) != len(servers):
+        return None
+    if set(servers) != set(range(len(model.servers))):
+        return None
+    scratch: list[str] = []
+    fed = _follow_limiters(model, model.sources[0].downstream, [], scratch)
+    if fed is None or fed.kind != ROUTER:
+        return None
+    for index in servers:
+        down = _follow_limiters(
+            model, model.servers[index].downstream, [], scratch
+        )
+        if down is None or down.kind != SINK:
+            return None
     return {"shape": "router", "servers": servers, "policy": router.policy}
 
 
@@ -419,12 +449,17 @@ def kernel_decision(
             VMEM_TILE_BUDGET_BYTES,
             replica_tile_bytes,
             replica_working_set_bytes,
+            shared_const_bytes,
             state_template,
         )
 
         template = state_template(compiled)
         per_replica = replica_working_set_bytes(compiled, macro, template)
-        if per_replica > VMEM_TILE_BUDGET_BYTES:
+        # Tile-shared constants (rate-profile lookup tables) are paid
+        # once per tile: the tile=1 working set is per_replica + shared,
+        # the same subtraction build_block_step makes before sizing.
+        shared = shared_const_bytes(compiled)
+        if per_replica + shared > VMEM_TILE_BUDGET_BYTES:
             # Name the leaves that dominate the working set: a budget
             # decline must tell the user WHICH state to shrink (drop
             # transit_capacity, coarsen telemetry windows, trim queue
@@ -436,6 +471,9 @@ def kernel_decision(
                 ),
                 reverse=True,
             )
+            if shared:
+                sizes.insert(0, (shared, "profile tables [tile-shared]"))
+                sizes.sort(reverse=True)
             top = ", ".join(
                 f"{name} {nbytes} B" for nbytes, name in sizes[:3]
             )
@@ -446,7 +484,8 @@ def kernel_decision(
                 else ""
             )
             return False, (
-                f"per-replica VMEM working set {per_replica} B exceeds the "
+                f"per-replica VMEM working set {per_replica + shared} B "
+                f"(tile-shared consts {shared} B included) exceeds the "
                 f"{VMEM_TILE_BUDGET_BYTES} B tile budget even at tile=1 — "
                 f"largest state leaves: {top}{telemetry_note}; lax event "
                 f"step ran ({KERNEL_ENV} cannot override a budget decline)"

@@ -153,7 +153,9 @@ STATE_PARTITION_RULES: tuple[tuple[str, str], ...] = (
     (r"^srv_", "replica"),
     # transit registers (latency edges + backoff re-arrivals)
     (r"^tr_", "replica"),
-    # router round-robin cursor
+    # router round-robin cursors — one (nR,) column covering every
+    # router tier in the graph plan (profile lookup tables are traced
+    # CONSTANTS, not state leaves, so they need no rule here)
     (r"^rr_next$", "replica"),
     # token-bucket limiter state
     (r"^lim_", "replica"),

@@ -1216,8 +1216,11 @@ class EnsembleModel:
             self.limiters[origin.index].downstream = downstream
             self.limiters[origin.index].latency = edge
         elif origin.kind == ROUTER:
-            if downstream.kind == ROUTER:
-                raise ValueError("Routers cannot target routers (single hop)")
+            # Router->router edges are legal (multi-tier DAGs: a front
+            # load balancer routing to per-zone balancers). The
+            # into-router check above already forces them latency- and
+            # loss-free; validate() rejects router CYCLES, which the
+            # delivery recursion could not unroll.
             self.routers[origin.index].targets.append(downstream)
             self.routers[origin.index].target_latencies.append(edge)
         elif origin.kind == REMOTE:
@@ -1351,8 +1354,6 @@ class EnsembleModel:
         for i, router in enumerate(self.routers):
             kinds = {t.kind for t in router.targets}
             for target in router.targets:
-                if target.kind == ROUTER:
-                    raise ValueError(f"router[{i}] targets another router")
                 if target.kind == LIMITER:
                     raise ValueError(
                         f"router[{i}] targets a limiter (route after, not into, "
@@ -1363,16 +1364,26 @@ class EnsembleModel:
                         f"router[{i}] targets a remote — partitioned mode only"
                     )
             # Server/sink sets (including mixes — "done or continue", e.g.
-            # probabilistic feedback loops), plus (partitioned)
-            # sink+remote mixes, which model "stay local or hop to the
-            # neighbor".
-            allowed = kinds <= {SERVER, SINK} or (
+            # probabilistic feedback loops), downstream routers
+            # (multi-tier DAGs, server mixes included), plus
+            # (partitioned) sink+remote mixes, which model "stay local
+            # or hop to the neighbor". A ROUTER+SINK mix is degenerate:
+            # the sink arm would be a zero-work exit raced against a
+            # routing tier — put the probabilistic exit on the
+            # DOWNSTREAM router's own target list instead.
+            allowed = kinds <= {SERVER, SINK, ROUTER} or (
                 allow_remote and kinds <= {SINK, REMOTE}
             )
             if not allowed:
                 raise ValueError(
-                    f"router[{i}] targets must be servers and/or sinks, or "
-                    "(partitioned) sinks+remotes"
+                    f"router[{i}] targets must be servers, sinks, and/or "
+                    "downstream routers, or (partitioned) sinks+remotes"
+                )
+            if ROUTER in kinds and SINK in kinds:
+                raise ValueError(
+                    f"router[{i}] mixes a downstream router with a sink "
+                    "target — a done-or-continue exit belongs on the "
+                    "downstream router's target list, not raced against it"
                 )
             if kinds == {SERVER, SINK} and router.policy == "least_outstanding":
                 raise ValueError(
@@ -1383,10 +1394,10 @@ class EnsembleModel:
                 raise ValueError(
                     f"router[{i}]: remote targets require the 'random' policy"
                 )
-            if router.policy == "least_outstanding" and kinds == {SINK}:
+            if router.policy == "least_outstanding" and kinds - {SERVER}:
                 raise ValueError(
                     f"router[{i}]: least_outstanding requires server targets "
-                    "(sinks have no outstanding work)"
+                    "(only servers carry outstanding work)"
                 )
             if router.policy == "weighted" and len(router.weights) != len(
                 router.targets
@@ -1396,6 +1407,44 @@ class EnsembleModel:
                     f"weights for {len(router.targets)} targets (wire every "
                     "target before running, or pass targets to router())"
                 )
+        self._validate_router_acyclic()
+
+    def _validate_router_acyclic(self) -> None:
+        """Reject router cycles through DIRECT router->router targets.
+
+        The delivery hop recurses into a chosen downstream router at
+        trace time, so a direct cycle (router[0] -> router[1] ->
+        router[0]) would never finish tracing. Cycles THROUGH a server
+        are fine — a server arrival ends the delivery, so "done or
+        continue" feedback loops stay legal. Errors name the router
+        index on the cycle."""
+        # state: 0 unvisited, 1 on the current DFS path, 2 done.
+        state = [0] * len(self.routers)
+
+        def visit(i: int, path: list[int]) -> None:
+            if state[i] == 1:
+                start = path.index(i)
+                cycle = " -> ".join(
+                    f"router[{j}]" for j in path[start:] + [i]
+                )
+                raise ValueError(
+                    f"router[{i}] is on a router cycle ({cycle}) — route "
+                    "feedback through a server, not directly between "
+                    "routers"
+                )
+            if state[i] == 2:
+                return
+            state[i] = 1
+            path.append(i)
+            for target in self.routers[i].targets:
+                if target.kind == ROUTER:
+                    visit(target.index, path)
+            path.pop()
+            state[i] = 2
+
+        for i in range(len(self.routers)):
+            if state[i] == 0:
+                visit(i, [])
 
     def iter_edges(self):
         """Every latency-carrying edge spec in the model (source, server,
@@ -1486,10 +1535,12 @@ class EnsembleModel:
 
     def kernel_supported(self) -> tuple[bool, str]:
         """Whether the fused Pallas event-step kernel claims this
-        topology (chain-shaped / M/M/1-shaped / single-router
-        load-balancer fan-outs with static policies, with the whole
-        chaos stack — retries, hedging, outages, brownouts, packet
-        loss, limiters — riding the VMEM tile; see tpu/kernels/).
+        topology (any source -> {routers, limiters, servers} -> sink
+        DAG the model can express — chains, fan-outs under every router
+        policy including adaptive ``least_outstanding``, multi-router
+        tiers, shared backends, profiled sources — with the whole chaos
+        stack — retries, hedging, outages, brownouts, packet loss,
+        limiters — riding the VMEM tile; see tpu/kernels/).
 
         Returns ``(supported, reason)``; the reason is "" when supported
         and otherwise names EVERY declining feature (``; ``-joined) plus

@@ -44,6 +44,7 @@ _TPU_TEST_FILES = {
     "test_arrival_regression.py",
     "test_telemetry_regression.py",
     "test_router_regression.py",
+    "test_graph_regression.py",
     "test_chaos_regression.py",
     "test_resilience_regression.py",
     "test_tpu_resilience.py",

@@ -207,11 +207,17 @@ class TestValidation:
         with pytest.raises(ValueError, match="no downstream"):
             run_ensemble(model, n_replicas=8)
 
-    def test_router_to_router_rejected(self):
+    def test_router_to_router_is_legal_but_cycles_are_not(self):
         model = EnsembleModel()
+        source = model.source(rate=1.0)
         r1 = model.router(policy="random")
-        with pytest.raises(ValueError):
-            model.connect(r1, model.router(policy="random"))
+        r2 = model.router(policy="random")
+        model.sink()
+        model.connect(source, r1)
+        model.connect(r1, r2)  # immediate hop: allowed since the graph planner
+        model.connect(r2, r1)  # ...but closing a direct router cycle is not
+        with pytest.raises(ValueError, match="router cycle"):
+            model.validate()
 
 
 class TestPipeline:

@@ -16,13 +16,13 @@ from happysim_tpu.tpu.model import EnsembleModel
 
 
 def _router_model(policy="least_outstanding"):
-    """Two-server fan-out. The default ``least_outstanding`` policy is
-    ADAPTIVE (reads live queue state), so it stays kernel-unsupported
-    with a per-feature decline reason — the decline fixture for this
-    file now that random/round_robin/weighted fan-outs run the kernel
-    (ISSUE 11). macro_block=2: the random-policy variant compiles the
-    KERNEL under the CI gate's forced HS_TPU_PALLAS=1, and interpret
-    compile scales with the unroll (macro 32 costs two minutes)."""
+    """Two-server fan-out. Every policy — the adaptive
+    ``least_outstanding`` default included, since ISSUE 17's graph
+    planner — is kernel-approved, so this is an APPROVED fixture; the
+    decline fixtures for this file are the consensus models below.
+    macro_block=2: the fan-out compiles the KERNEL under the CI gate's
+    forced HS_TPU_PALLAS=1, and interpret compile scales with the
+    unroll (macro 32 costs two minutes)."""
     model = EnsembleModel(horizon_s=1.0, macro_block=2)
     src = model.source(rate=4.0)
     first = model.server(service_mean=0.05, queue_capacity=4)
@@ -204,18 +204,14 @@ def test_resilience_stack_runs_fused_and_breaker_trips(monkeypatch):
 
 
 def test_resilience_adds_no_decline_reasons():
-    """The per-feature decline list stays purely topological: the same
-    declined shape (adaptive policy + rate profile) collects the same
+    """The per-feature decline list stays purely non-resilience: the
+    same declined shape (the consensus M/M/1) collects the same
     "; "-joined reasons with and without the full defense layer, and no
     resilience feature name ever appears in a decline."""
     from happysim_tpu.tpu.kernels import kernel_plan
-    from happysim_tpu.tpu.model import RateProfile
 
     def declined(defended: bool):
-        model = _router_model()  # least_outstanding: adaptive
-        model.sources[0].profile = RateProfile(
-            kind="ramp", end_rate=9.0, ramp_duration_s=0.5
-        )
+        model = _consensus_mm1()
         if defended:
             for server in model.servers:
                 server.deadline_s = 0.3
@@ -248,6 +244,20 @@ def _consensus_mm1():
     model.network_partition(group=[srv], windows=((0.5, 1.0),))
     model.quorum([srv], write=1, read=1)
     model.leader_election([srv], heartbeat_s=0.1, timeout_s=0.3)
+    return model
+
+
+def _two_sink_mm1():
+    """An M/M/1 with a second, unconnected sink — the smallest purely
+    TOPOLOGICAL decline left now that the graph planner approves every
+    single-source single-sink service graph (ISSUE 17)."""
+    model = EnsembleModel(horizon_s=2.0, macro_block=2)
+    src = model.source(rate=5.0)
+    srv = model.server(service_mean=0.1, queue_capacity=8)
+    snk = model.sink()
+    model.sink()  # second sink: kernel supports exactly one
+    model.connect(src, srv)
+    model.connect(srv, snk)
     return model
 
 
@@ -339,12 +349,8 @@ def test_consensus_free_models_add_no_new_reasons():
     consensus specs, and no consensus feature name ever appears in a
     consensus-free decline."""
     from happysim_tpu.tpu.kernels import kernel_plan
-    from happysim_tpu.tpu.model import RateProfile
 
-    model = _router_model()  # least_outstanding: adaptive, declines
-    model.sources[0].profile = RateProfile(
-        kind="ramp", end_rate=9.0, ramp_duration_s=0.5
-    )
+    model = _two_sink_mm1()  # 2 sinks: a topological decline
     plan, reason = kernel_plan(model)
     assert plan is None
     for feature in CONSENSUS_DECLINES:
@@ -355,11 +361,11 @@ def test_kernel_decline_surfaces_every_reason(monkeypatch):
     """ISSUE-14 satellite: EnsembleResult.kernel_decline carries the
     FULL decline list (``; ``-joined, first reason first), not just the
     first reason hit."""
-    from happysim_tpu.tpu.model import RateProfile
+    from happysim_tpu.tpu.model import SERVER, NodeRef
 
-    model = _router_model()  # least_outstanding: adaptive, declines
-    model.sources[0].profile = RateProfile(
-        kind="ramp", end_rate=9.0, ramp_duration_s=0.5
+    model = _two_sink_mm1()
+    model.network_partition(
+        group=[NodeRef(SERVER, 0)], windows=((0.5, 1.0),)
     )
     monkeypatch.setenv("HS_TPU_PALLAS", "1")
     result = run_ensemble(
@@ -371,10 +377,10 @@ def test_kernel_decline_surfaces_every_reason(monkeypatch):
     )
     assert result.engine_path == "scan"
     decline = result.kernel_decline
-    assert "rate profile" in decline and "least_outstanding" in decline
-    # One joined list: the profile reason precedes the policy reason,
+    assert "network partitions" in decline and "2 sinks" in decline
+    # One joined list: the feature reason precedes the topology reason,
     # separated by the "; " joiner inside one decline note.
-    assert decline.index("rate profile") < decline.index("least_outstanding")
+    assert decline.index("network partitions") < decline.index("2 sinks")
     assert "; " in decline.split("(", 1)[1]
     assert "HS_TPU_PALLAS" in decline
     assert result.kernel_chaos == ()
@@ -406,6 +412,65 @@ def test_blanket_router_decline_removed(monkeypatch):
     assert result.kernel_decline == ""
     assert result.kernel_shape == "router"
     assert result.engine_report()["kernel_shape"] == "router"
+
+
+def _graph_dag_model():
+    """ISSUE 17's acceptance shape: a ramp-profiled source feeding a
+    2-router shared-backend DAG under adaptive least_outstanding
+    routing — front tier fans out, both front servers feed the back
+    router, back tier drains to the sink. macro_block=2 keeps the
+    interpret-mode kernel compile inside the tier-1 envelope."""
+    model = EnsembleModel(horizon_s=1.0, macro_block=2, transit_capacity=4)
+    src = model.ramp_source(start_rate=3.0, end_rate=9.0, ramp_duration_s=0.8)
+    front = [model.server(service_mean=0.05, queue_capacity=4) for _ in range(2)]
+    back = [model.server(service_mean=0.04, queue_capacity=4) for _ in range(2)]
+    front_lb = model.router(policy="least_outstanding")
+    back_lb = model.router(policy="least_outstanding")
+    snk = model.sink()
+    model.connect(src, front_lb)
+    for server in front:
+        model.connect(front_lb, server)
+        model.connect(server, back_lb)
+    for server in back:
+        model.connect(back_lb, server)
+        model.connect(server, snk)
+    return model
+
+
+def test_graph_era_decline_reasons_removed():
+    """ISSUE-17 contract: adaptive (least_outstanding) routing, rate
+    profiles, and >1 router are no longer decline reasons — the 2-router
+    shared-backend DAG with a ramp profile is kernel-APPROVED with
+    shape "graph", and none of the retired reason fragments appear
+    anywhere in the (empty) reason."""
+    from happysim_tpu.tpu.kernels import kernel_plan
+
+    plan, reason = kernel_plan(_graph_dag_model())
+    assert plan is not None and reason == "", reason
+    assert plan["shape"] == "graph"
+    assert plan["servers"] == [0, 1, 2, 3]
+    assert plan["routers"] == [0, 1]
+    assert plan["policies"] == ("least_outstanding", "least_outstanding")
+
+
+def test_graph_shape_runs_fused(monkeypatch):
+    """The tier-1 graph canary: the DAG above runs engine_path ==
+    "scan+pallas" when forced, with kernel_shape == "graph" provenance
+    reaching engine_report()."""
+    pytest.importorskip("jax.experimental.pallas")
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _graph_dag_model(),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=48,
+    )
+    assert result.engine_path == "scan+pallas", result.kernel_decline
+    assert result.kernel_decline == ""
+    assert result.kernel_shape == "graph"
+    assert result.engine_report()["kernel_shape"] == "graph"
+    assert sum(result.sink_count) > 0
 
 
 def test_multi_device_mesh_runs_the_kernel(monkeypatch):
@@ -450,7 +515,7 @@ def test_host_mesh_decline_names_the_mesh_first_path(monkeypatch):
 def test_engine_report_names_escape_hatches_on_decline(monkeypatch):
     monkeypatch.setenv("HS_TPU_PALLAS", "1")
     result = run_ensemble(
-        _router_model(),
+        _consensus_mm1(),
         n_replicas=4,
         seed=0,
         mesh=replica_mesh(jax.devices("cpu")[:1]),
@@ -458,7 +523,7 @@ def test_engine_report_names_escape_hatches_on_decline(monkeypatch):
     )
     report = result.engine_report()
     assert report["engine_path"] == "scan"
-    assert "router" in report["kernel_decline"]
+    assert "network partitions" in report["kernel_decline"]
     assert set(report["escape_hatches"]) == {
         "HS_TPU_PALLAS",
         "HS_TPU_EARLY_EXIT",
@@ -475,7 +540,7 @@ def test_engine_report_names_escape_hatches_on_decline(monkeypatch):
     extra = engine_entities[0].extra
     assert "HS_TPU_PALLAS" in extra["escape_hatches"]
     assert "HS_TPU_EARLY_EXIT" in extra["escape_hatches"]
-    assert "router" in extra["kernel_decline"]
+    assert "network partitions" in extra["kernel_decline"]
 
 
 def test_engine_report_on_the_chain_path():
@@ -506,14 +571,14 @@ def test_kernel_decline_reason_reaches_result(monkeypatch):
     lax scan AND surfaces the decline (naming the flag) on the result."""
     monkeypatch.setenv("HS_TPU_PALLAS", "1")
     result = run_ensemble(
-        _router_model(),
+        _two_sink_mm1(),
         n_replicas=4,
         seed=0,
         mesh=replica_mesh(jax.devices("cpu")[:1]),
         max_events=32,
     )
     assert result.engine_path == "scan"
-    assert "router" in result.kernel_decline
+    assert "2 sinks" in result.kernel_decline
     assert "HS_TPU_PALLAS" in result.kernel_decline
     assert "lax" in result.kernel_decline
 

@@ -115,11 +115,25 @@ class TestConnectRules:
         with pytest.raises(ValueError, match="router"):
             model.connect(source, router, latency_s=0.5)
 
-    def test_router_to_router_rejected(self):
+    def test_router_to_router_is_legal(self):
+        """ISSUE 17: multi-router tiers are a supported topology — the
+        old "single hop" connect rejection is gone. Cycles between
+        routers are caught at validate() time instead (see
+        TestValidateRules)."""
         model = base()
         a, b = model.router(), model.router()
-        with pytest.raises(ValueError, match="single hop"):
-            model.connect(a, b)
+        model.connect(a, b)
+        assert model.routers[0].targets[-1].kind == "router"
+        assert model.routers[0].targets[-1].index == 1
+
+    def test_latency_into_downstream_router_still_rejected(self):
+        """Router->router is legal ONLY as an immediate hop: a latency
+        edge into the downstream router would need a transit register
+        per tier, and connect keeps rejecting it."""
+        model = base()
+        a, b = model.router(), model.router()
+        with pytest.raises(ValueError, match="router"):
+            model.connect(a, b, latency_s=0.1)
 
     def test_limiter_to_limiter_rejected(self):
         model = base()
@@ -197,6 +211,93 @@ class TestValidateRules:
         model.connect(source, router)
         model.connect(router, sink)
         with pytest.raises(ValueError, match="least_outstanding"):
+            model.validate()
+
+    def test_router_cycle_rejected_naming_the_cycle(self):
+        """ISSUE 17: direct router->router cycles would trace forever
+        (the delivery hop recurses into the chosen downstream router),
+        so validate() rejects them with the full cycle spelled out —
+        while feedback THROUGH a server stays legal."""
+        model = base()
+        source = model.source(rate=1.0)
+        a = model.router(policy="random")
+        b = model.router(policy="random")
+        model.sink()
+        model.connect(source, a)
+        model.connect(a, b)
+        model.connect(b, a)
+        with pytest.raises(
+            ValueError,
+            match=r"router cycle \(router\[0\] -> router\[1\] -> router\[0\]\)",
+        ):
+            model.validate()
+
+    def test_router_self_loop_rejected(self):
+        model = base()
+        source = model.source(rate=1.0)
+        a = model.router(policy="random")
+        model.sink()
+        model.connect(source, a)
+        model.connect(a, a)
+        with pytest.raises(ValueError, match=r"router\[0\] is on a router cycle"):
+            model.validate()
+
+    def test_server_mediated_router_feedback_is_legal(self):
+        """The cycle check only walks DIRECT router->router edges: a
+        server on the loop ends each delivery, so router -> server ->
+        router feedback validates."""
+        model = base()
+        source = model.source(rate=1.0)
+        done = model.server()
+        retry = model.server()
+        sink = model.sink()
+        router = model.router(policy="random")
+        model.connect(source, router)
+        model.connect(router, done)
+        model.connect(router, retry)
+        model.connect(done, sink)
+        model.connect(retry, router)  # loop back through the server
+        model.validate()
+
+    def test_router_sink_mix_rejected_naming_the_router(self):
+        """ISSUE 17: a router target list mixing a downstream ROUTER
+        with a SINK races a zero-work exit against a routing tier —
+        rejected by name; the probabilistic exit belongs on the
+        downstream router's own list."""
+        model = base()
+        source = model.source(rate=1.0)
+        server = model.server()
+        sink = model.sink()
+        back = model.router(policy="random")
+        model.connect(back, server)
+        front = model.router(policy="random")
+        model.connect(source, front)
+        model.connect(front, back)
+        model.connect(front, sink)
+        model.connect(server, sink)
+        with pytest.raises(
+            ValueError, match=r"router\[1\] mixes a downstream router"
+        ):
+            model.validate()
+
+    def test_least_outstanding_rejects_router_targets(self):
+        """least_outstanding gathers per-SERVER outstanding counts, so
+        a router target has no defined ordering key — rejected at
+        validate() with the policy named."""
+        model = base()
+        source = model.source(rate=1.0)
+        server = model.server()
+        sink = model.sink()
+        back = model.router(policy="random")
+        model.connect(back, server)
+        front = model.router(policy="least_outstanding")
+        model.connect(source, front)
+        model.connect(front, back)
+        model.connect(front, server)
+        model.connect(server, sink)
+        with pytest.raises(
+            ValueError, match="only servers carry outstanding work"
+        ):
             model.validate()
 
     def test_mixed_server_sink_router_is_legal(self):
