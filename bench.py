@@ -70,6 +70,25 @@ CONSENSUS_HORIZON_S = 12.0
 CONSENSUS_WINDOWS = 16
 CONSENSUS_VIRTUAL_DEVICES = 8
 
+# Trace-ingestion entry (ISSUE 18): streamed open-world load — a
+# diurnal and a flash-crowd recorded trace paged host->device in
+# fixed-size chunks around the event scan (tpu/traces.py). Traces
+# decline the Pallas kernel BY NAME, so the entry measures the scan
+# path: events/s/chip replaying the whole trace on every replica, the
+# buffer-stall fraction (wall-clock the device spent waiting on host
+# paging — 0.0 means the double buffer always prefetched in time), and
+# 1-vs-N-device mesh bit-identity of every counter and windowed series.
+# On a single-chip host the measurement runs on the virtual 8-device
+# CPU mesh in a child process (same pattern as MULTICHIP/CONSENSUS),
+# at reduced replica count — the trace itself is shared by all
+# replicas, so its page schedule is identical at any scale.
+TRACE_REPLICAS = 65536
+TRACE_VIRTUAL_REPLICAS = 512
+TRACE_HORIZON_S = 16.0
+TRACE_CHUNK_LEN = 64
+TRACE_MAX_EVENTS = 16384
+TRACE_VIRTUAL_DEVICES = 8
+
 
 def _tpu_probe(timeout_s: float = 90.0) -> str:
     """Probe JAX init in a child process — a wedged TPU tunnel blocks
@@ -1737,6 +1756,192 @@ def _consensus_virtual_child() -> int:
     return 0
 
 
+def _trace_measure(devices, n_devices: int, virtual: bool) -> dict:
+    """Streamed trace ingestion at ensemble scale: every replica replays
+    the SAME recorded arrival trace, paged host->device in
+    TRACE_CHUNK_LEN-arrival chunks double-buffered around the event
+    scan. Two open-world shapes are measured — a diurnal sinusoid and a
+    flash crowd — each on a 1-device and an n-device mesh, with
+    bit-identity of every counter AND windowed series asserted across
+    the mesh shapes (the page schedule moves wall time, never a
+    number). The per-scenario stall fraction is the honesty metric for
+    the double buffer itself: 0.0 means the next page was always
+    resident before the device asked for it.
+    """
+    import numpy as np
+
+    from happysim_tpu.tpu import run_ensemble
+    from happysim_tpu.tpu.mesh import replica_mesh
+    from happysim_tpu.tpu.model import EnsembleModel
+    from happysim_tpu.tpu.traces import diurnal_trace, flash_crowd_trace
+
+    n_replicas = TRACE_VIRTUAL_REPLICAS if virtual else TRACE_REPLICAS
+    horizon = TRACE_HORIZON_S
+    scenarios = {
+        "diurnal": diurnal_trace(
+            base_rate=200.0,
+            amplitude=0.6,
+            period_s=horizon / 2,
+            horizon_s=horizon,
+            seed=11,
+            chunk_len=TRACE_CHUNK_LEN,
+        ),
+        "flash_crowd": flash_crowd_trace(
+            base_rate=100.0,
+            spike_rate=500.0,
+            spike_start_s=horizon / 4,
+            spike_end_s=horizon * 3 / 8,
+            horizon_s=horizon,
+            seed=11,
+            chunk_len=TRACE_CHUNK_LEN,
+        ),
+    }
+
+    def build(trace):
+        model = EnsembleModel(horizon_s=horizon, macro_block=16)
+        src = model.trace_arrivals(trace)
+        srv = model.server(concurrency=4, service_mean=0.004, queue_capacity=64)
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        model.telemetry(
+            window_s=2.0, metrics=("throughput", "latency", "rates")
+        )
+        return model
+
+    def run(trace, nd: int):
+        return run_ensemble(
+            build(trace),
+            n_replicas=n_replicas,
+            seed=0,
+            mesh=replica_mesh(devices[:nd]),
+            max_events=TRACE_MAX_EVENTS,
+        )
+
+    mesh_kind = "virtual CPU mesh" if virtual else "TPU mesh"
+    per_scenario = {}
+    for name, trace in scenarios.items():
+        single = run(trace, 1)
+        multi = run(trace, n_devices)
+        counters_identical = bool(
+            single.simulated_events == multi.simulated_events
+            and single.sink_count == multi.sink_count
+            and single.server_dropped == multi.server_dropped
+            and single.trace_tenant_arrivals == multi.trace_tenant_arrivals
+            and single.sink_p99_s == multi.sink_p99_s
+            and np.array_equal(single.sink_hist, multi.sink_hist)
+        )
+        series_identical = bool(single.timeseries == multi.timeseries)
+        report = multi.engine_report()["trace"]
+        assert multi.engine_path == "scan" and report["enabled"]
+        # Enforced, not just recorded — a page schedule that moves one
+        # number invalidates the trace determinism contract.
+        assert counters_identical and series_identical, (
+            f"trace mesh bit-identity broke on {name} "
+            f"(counters={counters_identical}, series={series_identical})"
+        )
+        assert report["max_resident_chunks"] <= 2, report
+        per_scenario[name] = {
+            "events_per_sec_per_chip": round(
+                multi.events_per_second / n_devices, 0
+            ),
+            "aggregate_events_per_sec": round(multi.events_per_second, 0),
+            "single_device_events_per_sec": round(single.events_per_second, 0),
+            "n_arrivals": trace.n_arrivals,
+            "n_chunks": report["n_chunks"],
+            "chunks_streamed": report["chunks_streamed"],
+            "max_resident_chunks": report["max_resident_chunks"],
+            "buffer_stall_fraction": round(report["stall_fraction"], 6),
+            "buffer_stall_seconds": round(report["buffer_stall_seconds"], 6),
+            "stream_steps": report["stream_steps"],
+            "bit_identical_counters": counters_identical,
+            "bit_identical_series": series_identical,
+            "simulated_events": multi.simulated_events,
+            "wall_seconds": round(multi.wall_seconds, 6),
+            "compile_seconds": round(multi.compile_seconds, 6),
+        }
+
+    flash = per_scenario["flash_crowd"]
+    return {
+        "metric": (
+            f"TRACE per-chip events/sec (streamed flash-crowd trace, "
+            f"{n_devices}-device {mesh_kind})"
+        ),
+        "tag": "TRACE",
+        "value": flash["events_per_sec_per_chip"],
+        "unit": "events/sec/chip",
+        "n_devices": n_devices,
+        "virtual_mesh": virtual,
+        "n_replicas": n_replicas,
+        "chunk_len": TRACE_CHUNK_LEN,
+        "buffer_stall_fraction": flash["buffer_stall_fraction"],
+        "bit_identical_counters": all(
+            s["bit_identical_counters"] for s in per_scenario.values()
+        ),
+        "bit_identical_series": all(
+            s["bit_identical_series"] for s in per_scenario.values()
+        ),
+        "scenarios": per_scenario,
+        "device": str(devices[0]),
+    }
+
+
+def bench_trace_ingestion(devices) -> dict:
+    """TRACE entry. With >1 real device, measure on the real mesh
+    in-process; on a single-chip host, spawn a child pinned to the
+    virtual 8-device CPU mesh (the XLA host-device-count flag must be
+    set before jax initializes, hence the subprocess)."""
+    if len(devices) > 1:
+        return _trace_measure(devices, len(devices), virtual=False)
+
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={TRACE_VIRTUAL_DEVICES}"
+        ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--trace-virtual"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=1800,
+        )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return {
+            "metric": "TRACE per-chip events/sec (streamed trace, virtual mesh)",
+            "tag": "TRACE",
+            "error": "child emitted no JSON",
+            "rc": proc.returncode,
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired:
+        return {
+            "metric": "TRACE per-chip events/sec (streamed trace, virtual mesh)",
+            "tag": "TRACE",
+            "error": "child timed out",
+        }
+
+
+def _trace_virtual_child() -> int:
+    """Entry for the ``--trace-virtual`` child: env was pinned to the
+    CPU platform with virtual devices by the parent before python started."""
+    import jax
+
+    devices = jax.devices()
+    n = min(TRACE_VIRTUAL_DEVICES, len(devices))
+    print(json.dumps(_trace_measure(devices, n, virtual=True)))
+    return 0
+
+
 def _default_cache_dir() -> str:
     """Per-user persistent XLA cache dir, with the same squat-resistance
     discipline as the fallback stub above: the path is predictable, and
@@ -1795,6 +2000,8 @@ def main() -> int:
         return _multichip_virtual_child()
     if "--consensus-virtual" in sys.argv:
         return _consensus_virtual_child()
+    if "--trace-virtual" in sys.argv:
+        return _trace_virtual_child()
     if os.environ.get("HS_BENCH_CPU_FALLBACK") == "1":
         _apply_fallback_scale()
     elif not _wait_for_tpu():
@@ -1823,6 +2030,7 @@ def main() -> int:
     resilience = bench_resilience(devices)
     multichip = bench_multichip_mesh(devices)
     consensus = bench_consensus(devices)
+    trace = bench_trace_ingestion(devices)
     if DEVICE_FALLBACK:
         note = "TPU unreachable at bench time; CPU fallback at reduced scale"
         kernel["device_fallback"] = note
@@ -1849,6 +2057,7 @@ def main() -> int:
     print(json.dumps(resilience))
     print(json.dumps(multichip))
     print(json.dumps(consensus))
+    print(json.dumps(trace))
     print(json.dumps(engine))
     return 0
 

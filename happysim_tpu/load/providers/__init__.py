@@ -1,9 +1,11 @@
 from happysim_tpu.load.providers.constant_arrival import ConstantArrivalTimeProvider
 from happysim_tpu.load.providers.distributed_field import DistributedFieldProvider
 from happysim_tpu.load.providers.poisson_arrival import PoissonArrivalTimeProvider
+from happysim_tpu.load.providers.recorded_arrival import RecordedArrivalTimeProvider
 
 __all__ = [
     "ConstantArrivalTimeProvider",
     "DistributedFieldProvider",
     "PoissonArrivalTimeProvider",
+    "RecordedArrivalTimeProvider",
 ]

@@ -1,0 +1,60 @@
+"""Recorded arrivals: replay a fixed array of instants in order.
+
+The host twin of the TPU engine's trace ingestion
+(``happysim_tpu/tpu/traces.py``): where the engine walks a per-replica
+cursor over streamed trace pages, this provider walks the same cursor
+over the same array on the host — so a recorded trace replayed through a
+host :class:`~happysim_tpu.load.source.Source` reproduces the engine's
+arrival instants exactly (``tests/integration/test_tpu_traces.py`` pins
+the cross-validation).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from happysim_tpu.core.temporal import Instant
+from happysim_tpu.load.arrival_time_provider import ArrivalTimeProvider
+from happysim_tpu.load.profile import ConstantRateProfile
+
+
+class RecordedArrivalTimeProvider(ArrivalTimeProvider):
+    """Replays recorded arrival instants by cursor, ignoring ``now``.
+
+    A trace is data, not randomness: each call returns the next recorded
+    instant in order (the engine's ``trc_cursor`` semantics), and an
+    exhausted trace returns ``Instant.Infinity`` — the same sentinel the
+    engine reads from its +inf page padding.  ``reset()`` rewinds the
+    cursor, so a provider can drive several simulation runs.
+    """
+
+    def __init__(self, times_s: Sequence[float]):
+        times = np.asarray(times_s, dtype=np.float64)
+        if times.ndim != 1:
+            raise ValueError(
+                f"recorded arrivals must be a 1-D sequence, got shape {times.shape}"
+            )
+        if times.size and np.any(np.diff(times) < 0):
+            raise ValueError("recorded arrival times must be non-decreasing")
+        # The profile slot is bookkeeping only (the base-class solver is
+        # never consulted): report the trace's mean rate for reports.
+        span = float(times[-1] - times[0]) if times.size > 1 else 0.0
+        mean_rate = (times.size - 1) / span if span > 0 else 0.0
+        super().__init__(ConstantRateProfile(mean_rate))
+        self._times = times
+        self._cursor = 0
+
+    def _target_integral(self) -> float:  # pragma: no cover - never solved
+        return 1.0
+
+    def next_arrival_time(self, now: Instant) -> Instant:
+        if self._cursor >= self._times.size:
+            return Instant.Infinity
+        t = float(self._times[self._cursor])
+        self._cursor += 1
+        return Instant.from_seconds(t)
+
+    def reset(self) -> None:
+        self._cursor = 0

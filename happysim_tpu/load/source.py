@@ -22,6 +22,7 @@ from happysim_tpu.load.event_provider import EventProvider, SimpleEventProvider
 from happysim_tpu.load.profile import ConstantRateProfile, Profile
 from happysim_tpu.load.providers.constant_arrival import ConstantArrivalTimeProvider
 from happysim_tpu.load.providers.poisson_arrival import PoissonArrivalTimeProvider
+from happysim_tpu.load.providers.recorded_arrival import RecordedArrivalTimeProvider
 from happysim_tpu.load.source_event import SourceEvent
 
 
@@ -108,6 +109,24 @@ class Source(Entity):
         """Poisson arrivals with mean ``rate`` events/second (seedable)."""
         provider = cls._payload_provider(target, event_type, stop_after, event_provider)
         return cls(name, provider, PoissonArrivalTimeProvider(rate, seed=seed))
+
+    @classmethod
+    def recorded(
+        cls,
+        times_s,
+        target: Optional[Entity] = None,
+        event_type: str = "Request",
+        *,
+        name: str = "Source",
+        stop_after: Union[float, Instant, None] = None,
+        event_provider: Optional[EventProvider] = None,
+    ) -> "Source":
+        """Replay recorded arrival instants in order — the host twin of
+        the TPU engine's ``model.trace_arrivals(...)`` (same cursor
+        semantics; ``tests/integration/test_tpu_traces.py`` pins the
+        cross-validation)."""
+        provider = cls._payload_provider(target, event_type, stop_after, event_provider)
+        return cls(name, provider, RecordedArrivalTimeProvider(times_s))
 
     @classmethod
     def with_profile(

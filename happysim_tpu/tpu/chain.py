@@ -108,6 +108,11 @@ def _source_ok(model: EnsembleModel) -> bool:
     if getattr(model, "leader_election_spec", None) is not None:
         return False  # leader_election: per-replica election state machine
     source = model.sources[0]
+    # Trace-driven arrivals (tpu/traces.py): the closed form prices a
+    # Poisson stream analytically — a recorded stream has no closed
+    # form, and the streamed-page ingestion loop lives in the scan path.
+    if getattr(source, "trace", None) is not None:
+        return False  # trace_arrivals: recorded stream, scan path only
     if source.arrival != "poisson" or source.profile is not None:
         return False
     return _constant_edge(source.latency) is not None

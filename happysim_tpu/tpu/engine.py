@@ -88,6 +88,7 @@ from happysim_tpu.tpu.mesh import (
     pad_to_multiple,
     replica_mesh,
     replica_sharding,
+    trace_chunk_sharding,
 )
 from happysim_tpu.tpu.reduce import (
     MAX_EXACT_REPLICAS,
@@ -144,6 +145,7 @@ _I64_COUNTER_KEYS = frozenset({
     "srv_shed_dropped", "srv_budget_dropped",
     "net_partitioned", "qrm_dropped", "ldr_changes",
     "blocks_total",
+    "trc_arrivals",
 })
 # Telemetry reduce keys that are float time-integrals / sums (everything
 # else under tel_ is an int counter and limb-encodes like the above).
@@ -411,6 +413,17 @@ def model_fingerprint(model: EnsembleModel) -> str:
     )
     if consensus:
         items = items + (("consensus",) + consensus,)
+    # Trace-driven arrivals: SourceSpec.trace is repr=False (the arrays
+    # would bloat the repr and numpy reprs elide elements), so the trace
+    # CONTENT enters the digest via its own content hash — appended only
+    # when present so trace-free fingerprints stay stable.
+    traces = tuple(
+        (i, s.trace.signature())
+        for i, s in enumerate(model.sources)
+        if getattr(s, "trace", None) is not None
+    )
+    if traces:
+        items = items + (("trace",) + traces,)
     spec = repr(items)
     return hashlib.sha256(spec.encode()).hexdigest()[:16]
 
@@ -618,6 +631,25 @@ class EnsembleResult:
     per_shard_replicas: int = 0
     reduce_path: str = "device-psum-tree"
     redistribution_seconds: float = 0.0
+    # Trace-ingestion accounting (all zero/empty unless the model has a
+    # trace_arrivals() source — see tpu/traces.py and
+    # docs/guides/trace-driven-load.md). The stream loop counts pages
+    # placed host→device (chunks_streamed; the initial double-buffer
+    # fill counts 2), the high-water mark of pages resident per shard
+    # (max_resident_chunks — the ≤2 HBM-footprint contract), seconds the
+    # scan sat waiting on a page the prefetch had not landed
+    # (buffer_stall_seconds), and the number of host stream iterations.
+    trace: bool = False
+    trace_chunks_streamed: int = 0
+    trace_chunk_len: int = 0
+    trace_n_chunks: int = 0
+    trace_max_resident_chunks: int = 0
+    trace_buffer_stall_seconds: float = 0.0
+    trace_stream_steps: int = 0
+    # Whole-run per-tenant arrival counts delivered from the trace
+    # (length n_tenants; sums the windowed tel_trc_arrivals series when
+    # telemetry is on).
+    trace_tenant_arrivals: list = dataclasses_field(default_factory=list)
 
     def engine_report(self) -> dict:
         """Machine-readable engine provenance: which path ran, why the
@@ -696,6 +728,27 @@ class EnsembleResult:
                 "leader_changes_total": self.leader_changes,
                 "time_without_leader_fraction": (
                     self.time_without_leader_fraction
+                ),
+            },
+            # Trace-ingestion provenance, mirroring "resilience" /
+            # "consensus": present (all-zero) even for trace-free runs
+            # so report consumers can key on it unconditionally.
+            "trace": {
+                "enabled": self.trace,
+                "chunks_streamed": self.trace_chunks_streamed,
+                "chunk_len": self.trace_chunk_len,
+                "n_chunks": self.trace_n_chunks,
+                "max_resident_chunks": self.trace_max_resident_chunks,
+                "buffer_stall_seconds": self.trace_buffer_stall_seconds,
+                "stream_steps": self.trace_stream_steps,
+                "tenant_arrivals": list(self.trace_tenant_arrivals),
+                # Fraction of run wall-clock the device scan spent
+                # stalled on host paging (0.0 = every page prefetched in
+                # time — the double buffer did its job).
+                "stall_fraction": (
+                    self.trace_buffer_stall_seconds / self.wall_seconds
+                    if self.wall_seconds > 0
+                    else 0.0
                 ),
             },
         }
@@ -1084,6 +1137,30 @@ class _Compiled:
             np.float32,
         )
 
+        # Trace-driven arrivals (tpu/traces.py; docs/guides/
+        # trace-driven-load.md). Compile-time gated like every other
+        # subsystem: a trace-free model traces to the identical jaxpr.
+        # The padded host arrays stay OUT of the compiled program — the
+        # stream loop pages them host→device two chunks at a time and
+        # the step only ever sees the (2P,) resident window.
+        self.trace_src = model.traced_source_index()
+        self.has_trace = self.trace_src is not None
+        if self.has_trace:
+            trace = model.sources[self.trace_src].trace
+            self.trace = trace
+            self.trace_times = trace.padded_times()  # host np, +inf padded
+            self.trace_tenants = trace.padded_tenants()
+            self.trace_chunk_len = int(trace.chunk_len)
+            self.trace_pages = int(trace.n_chunks)
+            self.n_tenants = int(trace.n_tenants)
+            # First arrival instant, baked as a trace-time constant into
+            # init_state's src_next (no resident window exists yet at
+            # init, and times[0] is model data like any rate).
+            self.trace_first_time = float(trace.times[0])
+        else:
+            self.trace = None
+            self.n_tenants = 0
+
         self.lim_rate = np.array(
             [l.refill_rate for l in model.limiters] or [1.0], np.float32
         )
@@ -1209,6 +1286,10 @@ class _Compiled:
                 keys.append("tel_net_partitioned")
             if self.has_quorum:
                 keys.append("tel_qrm_dropped")
+            # Trace ingestion: per-(window, tenant) arrival counts — the
+            # windowed view of the whole-run trc_arrivals ledger.
+            if self.has_trace:
+                keys.append("tel_trc_arrivals")
         self.tel_sum_keys = tuple(keys)
 
     def _tel_init_state(self) -> dict:
@@ -1258,6 +1339,10 @@ class _Compiled:
                 state["tel_net_partitioned"] = jnp.zeros((nW,), jnp.int32)
             if self.has_quorum:
                 state["tel_qrm_dropped"] = jnp.zeros((nW, nV), jnp.int32)
+            if self.has_trace:
+                state["tel_trc_arrivals"] = jnp.zeros(
+                    (nW, self.n_tenants), jnp.int32
+                )
         return state
 
     def _tel_windex(self, t):
@@ -1538,6 +1623,17 @@ class _Compiled:
     # -- state -------------------------------------------------------------
     def init_state(self, key, params):
         gaps = self._initial_gaps(key, params)
+        if self.has_trace:
+            # The traced source's first arrival is times[0], baked as a
+            # trace-time constant (no resident window exists at init).
+            # The uniform draw count of _initial_gaps is unchanged — the
+            # traced lane's draw is simply discarded, keeping the slot
+            # layout of mixed trace+poisson models stable.
+            gaps = jnp.where(
+                jnp.arange(self.nS) == self.trace_src,
+                jnp.float32(self.trace_first_time),
+                gaps,
+            )
         gaps = jnp.where(gaps > jnp.asarray(self.stop_after), INF, gaps)
         state = {
             "t": jnp.float32(0.0),
@@ -1635,6 +1731,15 @@ class _Compiled:
             # checkpoint/resume, donation, and the reduce see nothing
             # special.
             state.update(self._consensus_sweeps(state))
+        if self.has_trace:
+            # Trace replay registers (docs/guides/trace-driven-load.md):
+            # the read cursor (arrivals already fired), the absolute
+            # macro-block counter (the RNG stream index — carried in
+            # state so stalls and resumes never shift the key schedule),
+            # and the whole-run per-tenant arrival ledger.
+            state["trc_cursor"] = jnp.uint32(0)
+            state["trc_blocks"] = jnp.int32(0)
+            state["trc_arrivals"] = jnp.zeros((self.n_tenants,), jnp.int32)
         if self.has_telemetry:
             state.update(self._tel_init_state())
         return state
@@ -3142,7 +3247,9 @@ class _Compiled:
         return created, enq, attempt
 
     # -- event branches ----------------------------------------------------
-    def _fire_source(self, i: int, state, qro, t, u, params):
+    def _fire_source(self, i: int, state, qro, t, u, params, trace_ctx=None):
+        if trace_ctx is not None and i == self.trace_src:
+            return self._fire_trace_source(i, state, qro, t, u, params, trace_ctx)
         gap = self._sample_gap(self._uslot(u, self.U_GAP), i, t, params)
         next_time = t + gap
         stopped = next_time > jnp.float32(self.stop_after[i])
@@ -3150,6 +3257,45 @@ class _Compiled:
             **state,
             "src_next": state["src_next"].at[i].set(jnp.where(stopped, INF, next_time)),
         }
+        source = self.model.sources[i]
+        return self._deliver(
+            state, t, t, u, source.downstream, source.latency, params
+        )
+
+    def _fire_trace_source(self, i: int, state, qro, t, u, params, trace_ctx):
+        """Fire the traced source: deliver the arrival the cursor points
+        at, then read the NEXT instant from the resident trace window.
+
+        ``trace_ctx = (resident_t, resident_g, base)``: the (2P,)
+        double-buffered times/tenants pages and the absolute arrival
+        index of ``resident_t[0]``. The stall-freeze gate in the traced
+        runner guarantees in-window reads for the lane that actually
+        fires; the clip below is the predicated-execution guard — under
+        vmap every ``lax.switch`` branch runs for every lane, so lanes
+        NOT firing the trace evaluate this body on garbage offsets, and
+        clipping keeps those discarded reads in bounds. No arrival-gap
+        uniform is consumed (the trace is data, not randomness).
+        """
+        resident_t, resident_g, base = trace_ctx
+        span = resident_t.shape[0]  # 2P, compile-time constant
+        cursor = state["trc_cursor"]
+        off = jnp.clip(cursor.astype(jnp.int32) - base, 0, span - 1)
+        tenant = resident_g[off]
+        c_new = cursor + jnp.uint32(1)
+        off_next = jnp.clip(c_new.astype(jnp.int32) - base, 0, span - 1)
+        next_time = resident_t[off_next]  # +inf padding past trace end
+        stopped = next_time > jnp.float32(self.stop_after[i])
+        state = {
+            **state,
+            "trc_cursor": c_new,
+            "trc_arrivals": state["trc_arrivals"].at[tenant].add(1),
+            "src_next": state["src_next"].at[i].set(jnp.where(stopped, INF, next_time)),
+        }
+        if self.has_telemetry and self.tel_rates:
+            w = self._tel_windex(t)
+            state["tel_trc_arrivals"] = (
+                state["tel_trc_arrivals"].at[w, tenant].add(1)
+            )
         source = self.model.sources[i]
         return self._deliver(
             state, t, t, u, source.downstream, source.latency, params
@@ -3463,6 +3609,7 @@ class _Compiled:
         horizon: Optional[float] = None,
         windowed: bool = False,
         external_u: bool = False,
+        trace_ctx=None,
     ):
         """The one-event scan step.
 
@@ -3471,12 +3618,17 @@ class _Compiled:
         is the traced window end carried as (state, params, window_end).
         ``external_u=True``: the scan xs supply the per-step uniform row
         (chunked generation); otherwise draws are counter-keyed per event.
+        ``trace_ctx``: the resident trace window (see
+        :meth:`_fire_trace_source`) for trace-driven models — the traced
+        runner rebuilds the step inside its jit with the window operands
+        threaded through, so the closure stays trace-free for everyone
+        else.
         """
         nS = self.nS
         nV_real = len(self.model.servers)
 
         branches = (
-            [partial(self._fire_source, i) for i in range(nS)]
+            [partial(self._fire_source, i, trace_ctx=trace_ctx) for i in range(nS)]
             + [partial(self._complete_server, v) for v in range(nV_real)]
             + (
                 [partial(self._transit_arrive, v) for v in range(nV_real)]
@@ -3599,6 +3751,10 @@ def _source_jobs(model: EnsembleModel, source, rate: float) -> float:
         if source.stop_after_s is not None
         else model.horizon_s
     )
+    if getattr(source, "trace", None) is not None:
+        # A trace is exact, not a rate estimate: the emission count is
+        # the number of recorded instants inside the active window.
+        return float(np.searchsorted(source.trace.times, window, side="right"))
     if source.profile is not None and source.profile.kind != "constant":
         # Trapezoid over the profile (same integral the tables encode).
         grid = np.linspace(0.0, window, 256)
@@ -3654,6 +3810,79 @@ def _blocks_reduce(blocks, n_chunks: int) -> dict:
 CHECKPOINT_SEGMENTS = 32
 
 
+def _validate_resume(
+    resume_from: EnsembleCheckpoint,
+    state_shardings,
+    *,
+    n_replicas: int,
+    seed: int,
+    max_events: int,
+    n_chunks: int,
+    fingerprint: str,
+    p_fingerprint: str,
+    macro_block: int,
+    telemetry_sig: str,
+) -> None:
+    """Shared resume-compatibility gate for every resumable execution
+    path (the segmented scan and the traced stream runner): metadata
+    mismatches first, then per-leaf shape validation BEFORE any device
+    transfer — a tampered or truncated state array would otherwise
+    surface as an opaque sharding/compile error deep in the runner."""
+    mismatches = {
+        "n_replicas": (resume_from.n_replicas, n_replicas),
+        "seed": (resume_from.seed, seed),
+        "max_events": (resume_from.max_events, max_events),
+        "n_chunks": (resume_from.n_chunks, n_chunks),
+        "model_fingerprint": (resume_from.model_fingerprint, fingerprint),
+        "params_fingerprint": (resume_from.params_fingerprint, p_fingerprint),
+        "macro_block": (resume_from.macro_block, macro_block),
+        # Telemetry buffers ride the state, so a spec mismatch is a
+        # silent shape/meaning error; "" on BOTH sides (telemetry-free
+        # run resuming a pre-telemetry or telemetry-free checkpoint)
+        # passes the plain equality check.
+        "telemetry": (resume_from.telemetry, telemetry_sig),
+    }
+    # Empty fingerprints / macro_block 0 = "unknown" (checkpoint
+    # predates the field): skip those rather than reject older files.
+    bad = {
+        k: v
+        for k, v in mismatches.items()
+        if v[0] != v[1]
+        and not (k.endswith("fingerprint") and v[0] == "")
+        and not (k == "macro_block" and v[0] == 0)
+    }
+    if bad:
+        raise ValueError(
+            f"resume_from does not match this run: {bad} "
+            "(checkpoint value vs requested value; n_replicas counts "
+            "include mesh padding — pad_to_multiple(requested, "
+            "mesh.size) must equal the checkpoint's count)"
+        )
+    missing = sorted(set(state_shardings) - set(resume_from.state))
+    if missing:
+        raise ValueError(
+            f"resume_from state is missing leaves {missing}: the "
+            "archive is truncated or hand-edited (fingerprints match, "
+            "so the model expects every compiled state leaf)"
+        )
+    for name, leaf in resume_from.state.items():
+        if name not in state_shardings:
+            raise ValueError(
+                f"resume_from state carries unknown leaf {name!r}: "
+                "not a state leaf of this model's compiled step "
+                "(fingerprints match, so the archive itself is "
+                "corrupt or hand-edited)"
+            )
+        shape = np.shape(leaf)
+        if not shape or shape[0] != n_replicas:
+            raise ValueError(
+                f"resume_from state leaf {name!r} has shape {shape}: "
+                f"expected a leading replica axis of {n_replicas} "
+                "(the checkpoint's n_replicas) — the state cannot be "
+                "redistributed onto this mesh"
+            )
+
+
 def _run_ensemble_segmented(
     compiled,
     replica_chunks,
@@ -3690,62 +3919,18 @@ def _run_ensemble_segmented(
     fingerprint = model_fingerprint(compiled.model)
     p_fingerprint = params_fingerprint(params)
     if resume_from is not None:
-        mismatches = {
-            "n_replicas": (resume_from.n_replicas, n_replicas),
-            "seed": (resume_from.seed, seed),
-            "max_events": (resume_from.max_events, max_events),
-            "n_chunks": (resume_from.n_chunks, n_chunks),
-            "model_fingerprint": (resume_from.model_fingerprint, fingerprint),
-            "params_fingerprint": (resume_from.params_fingerprint, p_fingerprint),
-            "macro_block": (resume_from.macro_block, macro_block),
-            # Telemetry buffers ride the state, so a spec mismatch is a
-            # silent shape/meaning error; "" on BOTH sides (telemetry-free
-            # run resuming a pre-telemetry or telemetry-free checkpoint)
-            # passes the plain equality check.
-            "telemetry": (resume_from.telemetry, telemetry_sig),
-        }
-        # Empty fingerprints / macro_block 0 = "unknown" (checkpoint
-        # predates the field): skip those rather than reject older files.
-        bad = {
-            k: v
-            for k, v in mismatches.items()
-            if v[0] != v[1]
-            and not (k.endswith("fingerprint") and v[0] == "")
-            and not (k == "macro_block" and v[0] == 0)
-        }
-        if bad:
-            raise ValueError(
-                f"resume_from does not match this run: {bad} "
-                "(checkpoint value vs requested value; n_replicas counts "
-                "include mesh padding — pad_to_multiple(requested, "
-                "mesh.size) must equal the checkpoint's count)"
-            )
-        # Shape validation BEFORE any device transfer: a tampered or
-        # truncated state array would otherwise surface as an opaque
-        # sharding/compile error deep in the segment runner.
-        missing = sorted(set(state_shardings) - set(resume_from.state))
-        if missing:
-            raise ValueError(
-                f"resume_from state is missing leaves {missing}: the "
-                "archive is truncated or hand-edited (fingerprints match, "
-                "so the model expects every compiled state leaf)"
-            )
-        for name, leaf in resume_from.state.items():
-            if name not in state_shardings:
-                raise ValueError(
-                    f"resume_from state carries unknown leaf {name!r}: "
-                    "not a state leaf of this model's compiled step "
-                    "(fingerprints match, so the archive itself is "
-                    "corrupt or hand-edited)"
-                )
-            shape = np.shape(leaf)
-            if not shape or shape[0] != n_replicas:
-                raise ValueError(
-                    f"resume_from state leaf {name!r} has shape {shape}: "
-                    f"expected a leading replica axis of {n_replicas} "
-                    "(the checkpoint's n_replicas) — the state cannot be "
-                    "redistributed onto this mesh"
-                )
+        _validate_resume(
+            resume_from,
+            state_shardings,
+            n_replicas=n_replicas,
+            seed=seed,
+            max_events=max_events,
+            n_chunks=n_chunks,
+            fingerprint=fingerprint,
+            p_fingerprint=p_fingerprint,
+            macro_block=macro_block,
+            telemetry_sig=telemetry_sig,
+        )
 
     seg_chunks = max(1, -(-n_chunks // CHECKPOINT_SEGMENTS))
 
@@ -3896,6 +4081,360 @@ def _run_ensemble_segmented(
     events_total = int(host_i64(np.asarray(reduced["events"])))
     wall = _wall.perf_counter() - start
     return reduced, events_total, wall, compile_seconds, redistribution_seconds
+
+
+def _run_ensemble_traced(
+    compiled,
+    reduce_final,
+    replica_halted,
+    keys,
+    params,
+    sharding,
+    state_shardings,
+    mesh,
+    *,
+    n_chunks: int,
+    n_replicas: int,
+    seed: int,
+    max_events: int,
+    macro: int,
+    horizon: float,
+    early_exit: bool,
+    telemetry_sig: str,
+    checkpoint_every_s: Optional[float],
+    checkpoint_callback,
+    resume_from: Optional[EnsembleCheckpoint],
+):
+    """The trace-ingestion execution path (docs/guides/trace-driven-load.md):
+    the first host-streaming data path in an engine that was purely
+    closed-form until now.
+
+    The trace (padded host arrays in ``compiled.trace_times/tenants``)
+    is paged host→device in fixed ``P = chunk_len`` arrival pages, with
+    a 2-page resident window ``[page, page+1]`` REPLICATED per mesh
+    shard (``trace_chunk_sharding``: one ``device_put`` lands the page
+    pre-sharded on every shard). The device runs a stall-gated
+    macro-block loop: a replica enters a block only if it can finish it
+    without reading past the resident window (``cursor + macro <
+    base + 2P``, sound because ``P >= macro`` is validated below);
+    otherwise the lane FREEZES mid-trace and resumes on the next stream
+    step after the host advances the window. Stalling gates the WHOLE
+    replica, not just its source — processing later events while the
+    next arrival instant is unreadable would violate event-time order.
+
+    Schedule independence (the bit-identity argument): each replica's
+    RNG block key is ``fold_in(key, trc_blocks)`` where ``trc_blocks``
+    is the replica's OWN absolute block counter riding the carry, and a
+    stall only pauses a lane — it never skips a block or consumes a
+    draw. Every replica therefore executes the exact same block
+    sequence with the exact same keys under ANY paging schedule, so
+    1-vs-N-device meshes and interrupted-vs-uninterrupted runs produce
+    identical bits by construction (the regression file pins this).
+
+    Progress guarantee: the window base is driven by the MINIMUM read
+    cursor over lanes still consuming the trace. That lane has
+    ``cursor < (base_page + 1) P``, so ``cursor + macro <= base + 2P``
+    — never stalled — and every stream step retires at least one block
+    somewhere. The scheduler therefore terminates in at most
+    ``n_pages + block budget`` stream steps.
+
+    Double buffering: while stream step N executes, the host
+    ``device_put``s the page the NEXT window will need (the classic
+    compute/DMA overlap). The resident set the scan can address never
+    exceeds 2 pages per shard (``trace_max_resident_chunks``); a
+    prediction miss falls back to a synchronous upload timed into
+    ``trace_buffer_stall_seconds``.
+    """
+    P = compiled.trace_chunk_len
+    if P < macro:
+        raise ValueError(
+            f"trace_arrivals: chunk_len={P} is smaller than the "
+            f"macro-block length {macro} — a replica could stall with "
+            "the window unable to cover one block (deadlock). Raise "
+            "chunk_len or lower macro_block/HS_TPU_MACRO_BLOCK."
+        )
+    ti = compiled.trace_src
+    n_pages = compiled.trace_pages
+    times_host = compiled.trace_times  # (n_pages * P,) +inf padded
+    tenants_host = compiled.trace_tenants
+    page_sharding = trace_chunk_sharding(mesh)
+    span = 2 * P
+
+    fingerprint = model_fingerprint(compiled.model)
+    p_fingerprint = params_fingerprint(params)
+    if resume_from is not None:
+        _validate_resume(
+            resume_from,
+            state_shardings,
+            n_replicas=n_replicas,
+            seed=seed,
+            max_events=max_events,
+            n_chunks=n_chunks,
+            fingerprint=fingerprint,
+            p_fingerprint=p_fingerprint,
+            macro_block=macro,
+            telemetry_sig=telemetry_sig,
+        )
+
+    init_all = jax.jit(
+        lambda keys, params: jax.vmap(compiled.init_state)(keys, params),
+        out_shardings=state_shardings,
+    )
+
+    donate = _donation_enabled()
+    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+
+    def stream_step(state, keys, params, t0, g0, t1, g1, base):
+        """One device dispatch: every replica runs stall-gated
+        macro-blocks against the resident window until done, halted, or
+        frozen at the window edge. Returns (state, paging stats)."""
+        resident_t = jnp.concatenate([t0, t1])
+        resident_g = jnp.concatenate([g0, g1])
+        step = compiled.make_step(
+            horizon, external_u=True, trace_ctx=(resident_t, resident_g, base)
+        )
+
+        def one(key, s, p):
+            def stalled(s):
+                nxt = s["src_next"][ti]
+                return jnp.isfinite(nxt) & (
+                    s["trc_cursor"].astype(jnp.int32) + macro >= base + span
+                )
+
+            def cond(carry):
+                s, _p = carry
+                live = s["trc_blocks"] < n_chunks
+                if early_exit:
+                    live = live & ~replica_halted(s)
+                return live & ~stalled(s)
+
+            def body(carry):
+                s, p = carry
+                c = s["trc_blocks"]
+                chunk_key = jax.random.fold_in(key, c.astype(jnp.uint32))
+                with jax.named_scope("hs.macro_block"):
+                    U = jax.random.uniform(
+                        chunk_key,
+                        (macro, compiled.n_draws),
+                        minval=1e-12,
+                        maxval=1.0,
+                    )
+                    s = {**s, "trc_blocks": c + 1}
+                    (s, p), _ = lax.scan(step, (s, p), U, unroll=2)
+                return (s, p)
+
+            s, _ = lax.while_loop(cond, body, (s, p))
+            return s
+
+        state = jax.vmap(one)(keys, state, params)
+        # Paging stats (tiny replicated scalars — the ONE host sync per
+        # stream step): which lanes still need trace data, and the
+        # minimum cursor among them (drives the next window base). In
+        # flat mode (early_exit off) a halted lane still owes its
+        # remaining no-op blocks, so it stays in `reads` and keeps the
+        # window from advancing past it until its budget drains.
+        blocks = state["trc_blocks"]
+        reads = jnp.isfinite(state["src_next"][:, ti]) & (blocks < n_chunks)
+        if early_exit:
+            reads = reads & ~jax.vmap(replica_halted)(state)
+        stats = {
+            "active": jnp.sum(reads.astype(jnp.int32)),
+            "min_read": jnp.min(
+                jnp.where(reads, state["trc_cursor"], jnp.uint32(0xFFFFFFFF))
+            ),
+            "min_blocks": jnp.min(blocks),
+        }
+        return state, stats
+
+    stream_jit = jax.jit(
+        stream_step,
+        in_shardings=(
+            state_shardings,
+            sharding,
+            sharding,
+            page_sharding,
+            page_sharding,
+            page_sharding,
+            page_sharding,
+            page_sharding,
+        ),
+        out_shardings=(state_shardings, None),
+        **jit_kwargs,
+    )
+
+    def reduce_all(final):
+        reduced = reduce_final(final)
+        # The per-replica block counters ride the carry on this path
+        # (the stall gate needs them on device), so the occupancy
+        # histogram reduces straight off the state leaf.
+        reduced.update(_blocks_reduce(final["trc_blocks"], n_chunks))
+        return reduced
+
+    # -- host-side page cache -------------------------------------------
+    # page index -> (times_dev, tenants_dev), placed replicated so each
+    # shard holds its own copy ("2 resident chunks per shard"). Pages at
+    # or past n_pages are synthesized padding (+inf times: the
+    # end-of-trace sentinel) for windows straddling the trace tail.
+    page_cache: dict = {}
+    trace_stats = {
+        "chunks_streamed": 0,
+        "max_resident_chunks": 0,
+        "buffer_stall_seconds": 0.0,
+        "stream_steps": 0,
+    }
+
+    def put_page(idx: int):
+        if idx in page_cache:
+            return
+        if idx < n_pages:
+            t_np = times_host[idx * P : (idx + 1) * P]
+            g_np = tenants_host[idx * P : (idx + 1) * P]
+        else:
+            t_np = np.full((P,), np.inf, np.float32)
+            g_np = np.zeros((P,), np.int32)
+        page_cache[idx] = (
+            jax.device_put(t_np, page_sharding),
+            jax.device_put(g_np, page_sharding),
+        )
+        trace_stats["chunks_streamed"] += 1
+
+    def fetch_page(idx: int) -> tuple:
+        """Resident-window read: a cache hit is the prefetched page; a
+        miss is a synchronous upload timed as a buffer stall."""
+        if idx not in page_cache:
+            stall_start = _wall.perf_counter()
+            put_page(idx)
+            jax.block_until_ready(page_cache[idx])
+            trace_stats["buffer_stall_seconds"] += (
+                _wall.perf_counter() - stall_start
+            )
+        return page_cache[idx]
+
+    def evict_below(idx: int):
+        for k in [k for k in page_cache if k < idx]:
+            del page_cache[k]
+
+    # -- state preparation + AOT compile (outside the timed region) -----
+    redistribution_seconds = 0.0
+    if resume_from is not None:
+        redistribute_start = _wall.perf_counter()
+        state = {
+            k: jax.device_put(v, state_shardings[k])
+            for k, v in resume_from.state.items()
+        }
+        state = jax.block_until_ready(state)
+        redistribution_seconds = _wall.perf_counter() - redistribute_start
+        # Recover the window base from the snapshot itself: the per-lane
+        # cursors/blocks ARE the resume point (chunk_index is
+        # provenance). Halted lanes are conservatively included — a
+        # too-low base costs at most one no-progress stream step before
+        # the device stats correct it, and never unsoundness.
+        cursor_h = np.asarray(resume_from.state["trc_cursor"], np.uint32)
+        blocks_h = np.asarray(resume_from.state["trc_blocks"], np.int32)
+        next_h = np.asarray(resume_from.state["src_next"], np.float32)[:, ti]
+        reads_h = np.isfinite(next_h) & (blocks_h < n_chunks)
+        base_page = (
+            int(cursor_h[reads_h].min()) // P if reads_h.any() else 0
+        )
+    else:
+        state = init_all(keys, params)
+        base_page = 0
+
+    compile_start = _wall.perf_counter()
+    put_page(base_page)
+    put_page(base_page + 1)
+    trace_stats["max_resident_chunks"] = 2
+    base0 = jax.device_put(np.int32(base_page * P), page_sharding)
+    t0, g0 = page_cache[base_page]
+    t1, g1 = page_cache[base_page + 1]
+    stream_compiled = (
+        stream_jit.lower(state, keys, params, t0, g0, t1, g1, base0).compile()
+    )
+    reduce_jit = (
+        jax.jit(reduce_all, in_shardings=(state_shardings,), **jit_kwargs)
+        .lower(state)
+        .compile()
+    )
+    compile_seconds = _wall.perf_counter() - compile_start
+
+    # -- the stream loop -------------------------------------------------
+    start = _wall.perf_counter()
+    last_snapshot = _wall.perf_counter()
+    base_dev = base0
+    while True:
+        t0, g0 = fetch_page(base_page)
+        t1, g1 = fetch_page(base_page + 1)
+        state, stats = stream_compiled(
+            state, keys, params, t0, g0, t1, g1, base_dev
+        )
+        trace_stats["stream_steps"] += 1
+        # Prefetch the page the NEXT window will need while the device
+        # executes (dispatch above is async; the np.asarray stats fetch
+        # below is the sync point). The window almost always advances by
+        # exactly one page, so page base+2 is the prediction.
+        put_page(base_page + 2)
+        active = int(np.asarray(stats["active"]))
+        every = (
+            checkpoint_every_s
+            if checkpoint_every_s is not None
+            else (0.0 if checkpoint_callback is not None else None)
+        )
+        due = (
+            every is not None
+            and _wall.perf_counter() - last_snapshot >= every
+        )
+        if checkpoint_callback is not None and due and active > 0:
+            # Mid-chunk snapshot: lanes sit at heterogeneous cursors
+            # (most frozen mid-page) — resume needs nothing beyond the
+            # carry, because the cursors/block counters ride it.
+            snapshot = EnsembleCheckpoint(
+                chunk_index=int(np.asarray(stats["min_blocks"])),
+                n_chunks=n_chunks,
+                n_replicas=n_replicas,
+                seed=seed,
+                max_events=max_events,
+                state={k: np.asarray(v) for k, v in state.items()},
+                model_fingerprint=fingerprint,
+                params_fingerprint=p_fingerprint,
+                macro_block=macro,
+                telemetry=telemetry_sig,
+                mesh_devices=mesh.size,
+            )
+            checkpoint_callback(snapshot)
+            last_snapshot = _wall.perf_counter()
+        if active == 0:
+            break
+        # Advance the window to the minimum still-reading cursor's page.
+        # Stalled lanes sit at cursor >= base + 2P - macro >= base + P,
+        # so the new base is strictly past the old one — the loop can
+        # never spin without progress.
+        new_page = int(np.asarray(stats["min_read"])) // P
+        if new_page == base_page:
+            # Only possible on the first step after a resume whose
+            # host-estimated base included a halted lane; the device
+            # stats exclude it, so retrying with their base progresses.
+            new_page = base_page + 1
+        base_page = new_page
+        evict_below(base_page)
+        base_dev = jax.device_put(np.int32(base_page * P), page_sharding)
+        resident_now = len(
+            [k for k in page_cache if base_page <= k <= base_page + 1]
+        )
+        trace_stats["max_resident_chunks"] = max(
+            trace_stats["max_resident_chunks"], resident_now
+        )
+
+    reduced = dict(reduce_jit(state))
+    events_total = int(host_i64(np.asarray(reduced["events"])))
+    wall = _wall.perf_counter() - start
+    return (
+        reduced,
+        events_total,
+        wall,
+        compile_seconds,
+        redistribution_seconds,
+        trace_stats,
+    )
 
 
 def run_ensemble(
@@ -4241,6 +4780,8 @@ def run_ensemble(
             per_replica["ldr_noleader_time"] = final["ldr_noleader_time"]
             if compiled.has_telemetry:
                 per_replica["tel_ldr_uptime_int"] = final["tel_ldr_uptime_int"]
+        if compiled.has_trace:
+            per_replica["trc_arrivals"] = final["trc_arrivals"]
         if compiled.has_telemetry:
             for key in compiled.tel_sum_keys:
                 per_replica[key] = final[key]
@@ -4287,7 +4828,43 @@ def run_ensemble(
             "checkpoint_every_s without checkpoint_callback would take no "
             "snapshots (pass a callback to receive them)"
         )
-    if not checkpointing_requested:
+    trace_stats = None
+    if compiled.has_trace:
+        # Trace ingestion owns its own host loop (stall-gated stream
+        # steps with double-buffered page uploads), so it subsumes both
+        # the single-dispatch and segmented paths — checkpointing rides
+        # the same loop.
+        (
+            reduced,
+            events_total,
+            wall,
+            compile_seconds,
+            redistribution_seconds,
+            trace_stats,
+        ) = _run_ensemble_traced(
+            compiled,
+            reduce_final,
+            replica_halted,
+            keys,
+            params,
+            sharding,
+            state_shardings,
+            mesh,
+            n_chunks=n_chunks,
+            n_replicas=n_replicas,
+            seed=seed,
+            max_events=max_events,
+            macro=macro,
+            horizon=horizon,
+            early_exit=early_exit,
+            telemetry_sig=(
+                compiled.telemetry.signature() if compiled.has_telemetry else ""
+            ),
+            checkpoint_every_s=checkpoint_every_s,
+            checkpoint_callback=checkpoint_callback,
+            resume_from=resume_from,
+        )
+    elif not checkpointing_requested:
 
         # keys/params are consumed exactly once; donating them lets XLA
         # reuse their buffers during the run (state itself is born inside
@@ -4491,6 +5068,7 @@ def run_ensemble(
         max_blocks=n_chunks,
         padded_replicas=kernel_padded or n_replicas,
         redistribution_seconds=redistribution_seconds,
+        trace_stats=trace_stats,
         **mesh_kwargs,
     )
 
@@ -4516,6 +5094,7 @@ def _build_result(
     mesh_shape: tuple = (),
     per_shard_replicas: int = 0,
     redistribution_seconds: float = 0.0,
+    trace_stats: Optional[dict] = None,
 ) -> EnsembleResult:
     """Shared result assembly for the event scan and the chain fast path
     (``chain.run_chain`` emits the same ``reduced`` key set and the same
@@ -4662,6 +5241,31 @@ def _build_result(
         per_shard_replicas=per_shard_replicas or n_replicas,
         reduce_path="device-psum-tree",
         redistribution_seconds=redistribution_seconds,
+        trace=compiled.has_trace,
+        trace_chunks_streamed=(
+            int(trace_stats["chunks_streamed"]) if trace_stats else 0
+        ),
+        trace_chunk_len=(
+            compiled.trace_chunk_len if compiled.has_trace else 0
+        ),
+        trace_n_chunks=(compiled.trace_pages if compiled.has_trace else 0),
+        trace_max_resident_chunks=(
+            int(trace_stats["max_resident_chunks"]) if trace_stats else 0
+        ),
+        trace_buffer_stall_seconds=(
+            float(trace_stats["buffer_stall_seconds"]) if trace_stats else 0.0
+        ),
+        trace_stream_steps=(
+            int(trace_stats["stream_steps"]) if trace_stats else 0
+        ),
+        # Ensemble total (summed over replicas: every replica replays
+        # the same trace, so this is n_replicas x the trace's per-tenant
+        # counts when no replica halts early).
+        trace_tenant_arrivals=(
+            [int(x) for x in host["trc_arrivals"]]
+            if "trc_arrivals" in host
+            else []
+        ),
     )
 
 

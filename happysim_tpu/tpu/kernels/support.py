@@ -143,9 +143,13 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     tile=1 budget check naming ``brk_fail_t``.
 
     Remaining declines are per-feature and actionable — the consensus
-    tier by name (partitions / quorum / leader election), remote egress
-    nodes, more than one source or sink, nodes outside the walked
-    source->sink graph, and a source that never reaches the sink — and
+    tier by name (partitions / quorum / leader election), trace-driven
+    arrivals by name (the streamed-page ingestion loop lives in the
+    host scheduler around the lax scan; the kernel's single fused
+    dispatch has no page-advance boundary to stream through yet),
+    remote egress nodes, more than one source or sink, nodes outside
+    the walked source->sink graph, and a source that never reaches the
+    sink — and
     are COLLECTED: the reason string ``; ``-joins every decline the
     model hits (first reason first), so a user fixes the model in one
     pass instead of replaying whack-a-mole. The decline is SOUND: the
@@ -177,6 +181,11 @@ def kernel_plan(model: EnsembleModel) -> tuple[Optional[dict], str]:
     if getattr(model, "leader_election_spec", None) is not None:
         reasons.append(
             "model has leader election (not fused in the kernel yet)"
+        )
+    if any(getattr(s, "trace", None) is not None for s in model.sources):
+        reasons.append(
+            "model has trace-driven arrivals (streamed trace pages are "
+            "not fused in the kernel yet)"
         )
     if model.remotes:
         reasons.append("model has remote egress nodes")

@@ -122,6 +122,17 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def trace_chunk_sharding(mesh: Mesh) -> NamedSharding:
+    """Placement for streamed trace pages (tpu/traces.py): fully
+    replicated, so one ``device_put`` lands the page pre-sharded on
+    every mesh shard and each shard's replicas gather from a local copy.
+    Every replica replays the SAME global trace, so the page is shared
+    data, not per-replica state — "2 resident chunks per shard" in the
+    ingestion accounting means two copies of this placement alive at
+    once (the double buffer), independent of mesh width."""
+    return replicated_sharding(mesh)
+
+
 def pad_to_multiple(n: int, devices: int) -> int:
     """Round replica count up so it divides evenly across devices."""
     return ((n + devices - 1) // devices) * devices
@@ -181,6 +192,10 @@ STATE_PARTITION_RULES: tuple[tuple[str, str], ...] = (
     (r"^bud_", "replica"),
     # windowed telemetry buffers (tpu/telemetry.py)
     (r"^tel_", "replica"),
+    # trace-driven arrival cursors/counters (tpu/traces.py; the resident
+    # trace pages themselves are NOT state leaves — they are replicated
+    # operands placed via trace_chunk_sharding, outside the carry)
+    (r"^trc_", "replica"),
 )
 
 

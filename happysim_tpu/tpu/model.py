@@ -72,6 +72,38 @@ class RateProfile:
     spike_start_s: float = 0.0
     spike_end_s: float = 0.0
 
+    def validate(self) -> None:
+        """Kind + per-kind parameter checks; every error names the
+        offending kind so a sweep over profiles reads unambiguously."""
+        if self.kind not in ("constant", "ramp", "spike"):
+            raise ValueError(
+                f"unknown profile kind {self.kind!r} "
+                "(valid kinds: 'constant', 'ramp', 'spike')"
+            )
+        if self.kind == "ramp":
+            if self.ramp_duration_s <= 0.0:
+                raise ValueError(
+                    f"profile kind 'ramp': ramp_duration_s must be > 0, "
+                    f"got {self.ramp_duration_s}"
+                )
+            if self.end_rate < 0.0:
+                raise ValueError(
+                    f"profile kind 'ramp': end_rate must be >= 0, "
+                    f"got {self.end_rate}"
+                )
+        if self.kind == "spike":
+            if self.spike_rate < 0.0:
+                raise ValueError(
+                    f"profile kind 'spike': spike_rate must be >= 0, "
+                    f"got {self.spike_rate}"
+                )
+            if not 0.0 <= self.spike_start_s < self.spike_end_s:
+                raise ValueError(
+                    f"profile kind 'spike': need 0 <= spike_start_s < "
+                    f"spike_end_s, got [{self.spike_start_s}, "
+                    f"{self.spike_end_s})"
+                )
+
     def rate_at(self, base_rate: float, t: float) -> float:
         if self.kind == "ramp":
             if self.ramp_duration_s <= 0:
@@ -559,6 +591,12 @@ class SourceSpec:
     downstream: Optional[NodeRef] = None
     profile: Optional[RateProfile] = None
     latency: EdgeLatency = field(default_factory=EdgeLatency)
+    # Trace-driven arrivals (tpu/traces.py): when set, this source
+    # replays the recorded instants instead of sampling gaps — arrival
+    # kind "trace", one arrival authority per source (validate rejects a
+    # trace+profile mix). repr=False keeps model reprs readable; the
+    # trace content enters fingerprints via TraceSpec.signature().
+    trace: Optional[object] = field(default=None, repr=False)
 
 
 @dataclass
@@ -722,10 +760,42 @@ class EnsembleModel:
     ) -> NodeRef:
         if kind not in ARRIVAL_KINDS:
             raise ValueError(f"arrival kind {kind!r} not in {ARRIVAL_KINDS}")
-        if profile is not None and profile.kind not in ("constant", "ramp", "spike"):
-            raise ValueError(f"unknown profile kind {profile.kind!r}")
+        if profile is not None:
+            profile.validate()
         self.sources.append(
             SourceSpec(rate=rate, arrival=kind, stop_after_s=stop_after_s, profile=profile)
+        )
+        return NodeRef(SOURCE, len(self.sources) - 1)
+
+    def trace_arrivals(
+        self,
+        trace,
+        stop_after_s: Optional[float] = None,
+    ) -> NodeRef:
+        """Source replaying a recorded/synthesized arrival stream
+        (``tpu/traces.TraceSpec``): every replica fires the same trace
+        instants deterministically, streamed host→device in
+        double-buffered pages (see docs/guides/trace-driven-load.md).
+
+        Arrival kind is ``"trace"`` — not a ``source()`` kind: the trace
+        is the sole arrival authority for this source (no ``rate``, no
+        ``profile``), and the engine draws no arrival-gap randomness for
+        it. ``stop_after_s`` still truncates the replay early.
+        """
+        from happysim_tpu.tpu.traces import TraceSpec
+
+        if not isinstance(trace, TraceSpec):
+            raise TypeError(
+                f"trace_arrivals: expected a TraceSpec, got {type(trace).__name__}"
+            )
+        trace.validate()
+        self.sources.append(
+            SourceSpec(
+                rate=0.0,
+                arrival="trace",
+                stop_after_s=stop_after_s,
+                trace=trace,
+            )
         )
         return NodeRef(SOURCE, len(self.sources) - 1)
 
@@ -1245,6 +1315,13 @@ class EnsembleModel:
         for i, remote in enumerate(self.remotes):
             if remote.ingress is None or remote.ingress.kind != SERVER:
                 raise ValueError(f"remote[{i}] needs a server ingress")
+        traced = [i for i, s in enumerate(self.sources) if s.trace is not None]
+        if len(traced) > 1:
+            raise ValueError(
+                f"trace_arrivals: at most one traced source per model "
+                f"(sources {traced} all carry traces) — merge the streams "
+                "into one TraceSpec with tenant ids"
+            )
         for i, source in enumerate(self.sources):
             if source.downstream is None:
                 raise ValueError(f"source[{i}] has no downstream")
@@ -1252,6 +1329,28 @@ class EnsembleModel:
                 source.downstream.index
             ].targets:
                 raise ValueError(f"router targeted by source[{i}] has no targets")
+            if source.profile is not None:
+                source.profile.validate()
+            if source.trace is not None:
+                if source.profile is not None:
+                    raise ValueError(
+                        f"source[{i}]: profile (kind "
+                        f"{source.profile.kind!r}) and trace_arrivals "
+                        f"({source.trace!r}) on the same source — one "
+                        "arrival authority per source; drop one of them"
+                    )
+                if source.arrival != "trace":
+                    raise ValueError(
+                        f"source[{i}]: carries a trace but arrival kind is "
+                        f"{source.arrival!r} — build traced sources via "
+                        "model.trace_arrivals(...)"
+                    )
+                source.trace.validate()
+            elif source.arrival == "trace":
+                raise ValueError(
+                    f"source[{i}]: arrival kind 'trace' without a TraceSpec "
+                    "— build traced sources via model.trace_arrivals(...)"
+                )
         if self.correlated_faults is not None:
             self.correlated_faults.validate()
         if self.telemetry_spec is not None:
@@ -1489,7 +1588,19 @@ class EnsembleModel:
         features.extend(self.consensus_features())
         if self.telemetry_spec is not None:
             features.append("telemetry")
+        if self.traced_source_index() is not None:
+            features.append("trace_arrivals")
         return tuple(features)
+
+    def traced_source_index(self) -> Optional[int]:
+        """Index of the (at most one — validate enforces) traced source,
+        or None for trace-free models. The engine streams this source's
+        TraceSpec; the chain, kernel, and partitioned paths decline it
+        BY NAME."""
+        for i, source in enumerate(self.sources):
+            if source.trace is not None:
+                return i
+        return None
 
     def _has_dark_source(self, group: tuple[int, ...]) -> bool:
         """Whether any ``group`` member can become unreachable: an

@@ -475,6 +475,16 @@ def run_partitioned(
             "partitions, quorum replication, and leader election at any "
             "device count on the lax event step"
         )
+    if any(getattr(s, "trace", None) is not None for s in model.sources):
+        # Same discipline: the streamed-page ingestion loop lives in
+        # run_ensemble's host scheduler; this executor's window barrier
+        # has no page-advance boundary to stream trace chunks through.
+        raise ValueError(
+            "trace-driven arrivals (trace_arrivals) are not supported "
+            "by run_partitioned — use the mesh-first engine: "
+            "run_ensemble(mesh=replica_mesh(...)) streams trace pages "
+            "host->device around the lax event scan at any device count"
+        )
     if outbox_capacity < 1:
         raise ValueError(
             f"outbox_capacity={outbox_capacity} must be >= 1: every remote "

@@ -289,6 +289,11 @@ class EnsembleTimeseries:
     # breaker_open_fraction)
     quorum_dark_fraction: Optional[np.ndarray] = None  # (nW,)
     leader_uptime_fraction: Optional[np.ndarray] = None  # (nW,)
+    # trace-driven load (tpu/traces.py): per-window arrival counts per
+    # tenant, summed over replicas (every replica replays the same
+    # trace, so each column is n_replicas x the trace's per-window
+    # count while no replica halts early)
+    trace_tenant_arrivals: Optional[np.ndarray] = None  # (nW, nT) int64
     # faults
     fault_occupancy: Optional[np.ndarray] = None  # (nW, nV) fraction
 
@@ -308,6 +313,7 @@ class EnsembleTimeseries:
         "server_budget_dropped",
         "server_quorum_dropped", "network_partitioned",
         "quorum_dark_fraction", "leader_uptime_fraction",
+        "trace_tenant_arrivals",
         "fault_occupancy",
     )
 
@@ -371,6 +377,7 @@ class EnsembleTimeseries:
         emit("network_partitioned", self.network_partitioned, "network")
         emit("quorum_dark_fraction", self.quorum_dark_fraction, "quorum")
         emit("leader_uptime_fraction", self.leader_uptime_fraction, "leader")
+        emit("arrivals", self.trace_tenant_arrivals, "tenant")
         emit("fault_occupancy", self.fault_occupancy, "server")
         return out
 
@@ -545,6 +552,10 @@ def build_timeseries(
             ts.leader_uptime_fraction = np.where(
                 window_len > 0, upt / (n_replicas * window_len), 0.0
             )
+    if "tel_trc_arrivals" in host:
+        # (nW, nT) trace arrivals per tenant — raw device-reduced counts
+        # (the host-twin cross-validation divides by n_replicas).
+        ts.trace_tenant_arrivals = counts("tel_trc_arrivals")
     if "tel_fault_int" in host:
         # Same denominator as window_len_s: occupancy is dark seconds
         # over the window's true [start, min(end, horizon)] coverage.

@@ -56,6 +56,9 @@ _TPU_TEST_FILES = {
     "test_tpu_mesh_resume.py",
     "test_tpu_consensus.py",
     "test_consensus_regression.py",
+    "test_traces.py",
+    "test_tpu_traces.py",
+    "test_trace_regression.py",
 }
 # Long host-side suites (examples execute end-to-end, some on the TPU path).
 _SLOW_TEST_FILES = {"test_examples.py"}

@@ -1,0 +1,139 @@
+"""Host-twin cross-validation for trace-driven load (ISSUE 18).
+
+The determinism contract says a trace is data, not randomness: the SAME
+recorded arrival instants replayed through the host ``load/`` stack
+(``Source.recorded`` -> ``RecordedArrivalTimeProvider`` cursor) and
+through the TPU engine's streamed-page ingestion
+(``model.trace_arrivals`` -> ``trc_cursor`` in the scan carry) must
+produce the SAME per-window arrival counts — exactly, not
+statistically. The pinned scenario is a 3-tenant Zipf mix: each
+tenant's sub-stream drives one host source, and the engine's
+``(nW, nT)`` windowed tenant series (divided by n_replicas — every
+replica replays the whole trace) must match the host counts per window
+per tenant.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from happysim_tpu import Entity, Instant, Simulation, Source
+from happysim_tpu.load.providers import RecordedArrivalTimeProvider
+from happysim_tpu.tpu import run_ensemble
+from happysim_tpu.tpu.mesh import replica_mesh
+from happysim_tpu.tpu.model import EnsembleModel
+from happysim_tpu.tpu.telemetry import window_index
+from happysim_tpu.tpu.traces import zipf_tenant_trace
+
+HORIZON_S = 12.0
+WINDOW_S = 1.5
+N_TENANTS = 3
+
+TRACE = zipf_tenant_trace(
+    rate=40.0,
+    n_tenants=N_TENANTS,
+    alpha=1.2,
+    horizon_s=HORIZON_S,
+    seed=2024,
+    chunk_len=64,
+)
+
+
+class WindowCounter(Entity):
+    """Buckets every received event's time into the engine's window
+    grid (same ``window_index`` twin the telemetry tests pin)."""
+
+    def __init__(self, name: str, n_windows: int):
+        super().__init__(name)
+        self.counts = np.zeros(n_windows, dtype=np.int64)
+
+    def handle_event(self, event):
+        t = event.time.to_seconds()
+        if t < HORIZON_S:
+            self.counts[window_index(t, WINDOW_S, self.counts.size)] += 1
+        return []
+
+
+def _host_window_counts() -> np.ndarray:
+    """The recorded trace through the host Source/provider stack: one
+    source per tenant sub-stream, each feeding a window-bucketing
+    counter entity."""
+    n_windows = int(np.ceil(HORIZON_S / WINDOW_S))
+    counters, sources = [], []
+    for tenant in range(N_TENANTS):
+        times = TRACE.times[TRACE.tenants == tenant]
+        counter = WindowCounter(f"tenant{tenant}", n_windows)
+        counters.append(counter)
+        sources.append(
+            Source.recorded(times, target=counter, name=f"trace{tenant}")
+        )
+    Simulation(
+        sources=sources,
+        entities=counters,
+        end_time=Instant.from_seconds(HORIZON_S + 1.0),
+    ).run()
+    return np.stack([c.counts for c in counters], axis=1)  # (nW, nT)
+
+
+def _engine_window_counts(n_replicas: int, n_devices: int) -> np.ndarray:
+    model = EnsembleModel(horizon_s=HORIZON_S)
+    src = model.trace_arrivals(TRACE)
+    srv = model.server(concurrency=4, service_mean=0.01, queue_capacity=32)
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    model.telemetry(window_s=WINDOW_S, metrics=("throughput", "rates"))
+    result = run_ensemble(
+        model,
+        n_replicas=n_replicas,
+        seed=5,
+        mesh=replica_mesh(jax.devices("cpu")[:n_devices]),
+        max_events=4096,
+    )
+    assert result.engine_path == "scan"
+    series = result.timeseries.trace_tenant_arrivals
+    assert series is not None and series.shape[1] == N_TENANTS
+    # Every replica replays the identical trace, so the ensemble series
+    # is an exact integer multiple of the per-replica one.
+    assert (series % n_replicas == 0).all()
+    return series // n_replicas
+
+
+def test_recorded_provider_replays_in_order():
+    provider = RecordedArrivalTimeProvider([0.5, 1.0, 1.0, 2.5])
+    now = Instant.from_seconds(0.0)
+    got = [provider.next_arrival_time(now).to_seconds() for _ in range(4)]
+    assert got == [0.5, 1.0, 1.0, 2.5]
+    assert provider.next_arrival_time(now).is_infinite()
+    provider.reset()
+    assert provider.next_arrival_time(now).to_seconds() == 0.5
+
+
+def test_recorded_provider_rejects_bad_input():
+    with pytest.raises(ValueError, match="non-decreasing"):
+        RecordedArrivalTimeProvider([1.0, 0.5])
+    with pytest.raises(ValueError, match="1-D"):
+        RecordedArrivalTimeProvider([[0.1], [0.2]])
+
+
+def test_host_twin_reproduces_engine_window_counts():
+    """The cross-validation itself: host per-window per-tenant counts
+    == engine per-window per-tenant counts, exactly, on the pinned
+    3-tenant Zipf scenario."""
+    host = _host_window_counts()
+    engine = _engine_window_counts(n_replicas=4, n_devices=1)
+    np.testing.assert_array_equal(engine, host)
+    # The Zipf law showed up (tenant 0 is the heavy hitter) — a
+    # degenerate all-one-tenant trace would cross-validate nothing.
+    totals = host.sum(axis=0)
+    assert totals[0] > totals[1] > 0 and totals[2] > 0
+    assert totals.sum() == TRACE.n_arrivals
+
+
+def test_host_twin_parity_survives_the_mesh():
+    """Same parity on the 8-device mesh: the replicated page placement
+    and psum-tree window reduction change nothing about the counts."""
+    host = _host_window_counts()
+    engine = _engine_window_counts(n_replicas=8, n_devices=8)
+    np.testing.assert_array_equal(engine, host)

@@ -632,6 +632,144 @@ def test_compile_cache_noop_without_env(monkeypatch):
     assert engine._COMPILE_CACHE_WIRED == before
 
 
+def _traced_mm1(chunk_len=8):
+    """The chain-eligible M/M/1 shape with a recorded trace driving the
+    source — the smallest model that must decline BOTH fast paths by
+    name (ISSUE 18): the chain's closed form prices Poisson streams
+    only, and the kernel's fused dispatch has no page-advance boundary
+    to stream trace pages through."""
+    import numpy as np
+
+    from happysim_tpu.tpu.traces import TraceSpec
+
+    times = np.linspace(0.05, 1.9, 24).astype(np.float32)
+    trace = TraceSpec(times=times, tenants=None, chunk_len=chunk_len)
+    model = EnsembleModel(horizon_s=2.0, macro_block=2)
+    src = model.trace_arrivals(trace)
+    srv = model.server(service_mean=0.05, queue_capacity=8)
+    snk = model.sink()
+    model.connect(src, srv)
+    model.connect(srv, snk)
+    return model
+
+
+def test_trace_declines_kernel_by_name(monkeypatch):
+    """ISSUE-18 contract: trace-driven arrivals decline the Pallas
+    kernel with a NAMED reason, and forcing HS_TPU_PALLAS=1 soundly
+    runs the scan with the decline surfaced on the result."""
+    from happysim_tpu.tpu.kernels import kernel_plan
+
+    plan, reason = kernel_plan(_traced_mm1())
+    assert plan is None
+    assert "trace-driven arrivals" in reason
+
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    result = run_ensemble(
+        _traced_mm1(),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=64,
+    )
+    assert result.engine_path == "scan"
+    assert "trace-driven arrivals" in result.kernel_decline
+    assert "HS_TPU_PALLAS" in result.kernel_decline
+
+
+def test_trace_declines_chain_by_name():
+    """The chain closed form declines traced sources: the same M/M/1
+    shape runs the chain without a trace and the scan WITH one (no
+    explicit max_events, so the chain dispatch is reachable)."""
+    from happysim_tpu.tpu.chain import fast_plan
+    from happysim_tpu.tpu.model import mm1_model
+
+    base = mm1_model(lam=4.0, mu=9.0, horizon_s=2.0)
+    assert fast_plan(base) is not None
+    assert fast_plan(_traced_mm1()) is None
+
+    result = run_ensemble(
+        _traced_mm1(),
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+    )
+    assert result.engine_path == "scan"
+
+
+def test_partitioned_trace_rejection_names_feature():
+    """run_partitioned declines traced models naming the feature and
+    the mesh-first path that does support it."""
+    from happysim_tpu.tpu.partitioned import run_partitioned
+
+    from happysim_tpu.tpu.model import SERVER, NodeRef
+
+    model = _traced_mm1()  # plus a remote: the partitioned executor's gate
+    model.remote(ingress=NodeRef(SERVER, 0), latency_s=0.5)
+    with pytest.raises(ValueError) as excinfo:
+        run_partitioned(model, window_s=0.25)
+    message = str(excinfo.value)
+    assert "trace_arrivals" in message
+    assert "run_ensemble" in message
+
+
+def test_traced_model_runs_scan_end_to_end(monkeypatch):
+    """The tier-1 trace canary: a traced M/M/1 runs engine_path ==
+    "scan" end to end (kernel forced on — the decline must route around
+    it), delivers exactly n_replicas * n_arrivals jobs, and the
+    ingestion accounting reaches engine_report()["trace"]."""
+    monkeypatch.setenv("HS_TPU_PALLAS", "1")
+    model = _traced_mm1(chunk_len=8)
+    n_arrivals = model.sources[0].trace.n_arrivals
+    result = run_ensemble(
+        model,
+        n_replicas=4,
+        seed=0,
+        mesh=replica_mesh(jax.devices("cpu")[:1]),
+        max_events=128,
+    )
+    assert result.engine_path == "scan"
+    assert result.trace
+    assert sum(result.trace_tenant_arrivals) == 4 * n_arrivals
+    report = result.engine_report()["trace"]
+    assert report["enabled"] is True
+    assert report["chunk_len"] == 8
+    assert report["n_chunks"] == 3  # 24 arrivals / 8 per page
+    assert report["max_resident_chunks"] <= 2
+    assert report["chunks_streamed"] >= report["n_chunks"]
+    assert report["stream_steps"] >= 1
+
+
+def test_trace_profile_conflict_rejected():
+    """ISSUE-18 small fix: a profile and trace_arrivals on the same
+    source is rejected at validate() time, naming both."""
+    from happysim_tpu.tpu.model import RateProfile
+
+    model = _traced_mm1()
+    model.sources[0].profile = RateProfile(
+        kind="ramp", end_rate=2.0, ramp_duration_s=1.0
+    )
+    with pytest.raises(ValueError) as excinfo:
+        model.validate()
+    message = str(excinfo.value)
+    assert "profile" in message and "trace_arrivals" in message
+    assert "ramp" in message
+
+
+def test_rate_profile_errors_name_the_kind():
+    """ISSUE-18 small fix: RateProfile validation errors carry the
+    offending kind."""
+    from happysim_tpu.tpu.model import RateProfile
+
+    with pytest.raises(ValueError, match="ramp"):
+        RateProfile(kind="ramp", end_rate=2.0, ramp_duration_s=0.0).validate()
+    with pytest.raises(ValueError, match="spike"):
+        RateProfile(
+            kind="spike", spike_rate=-1.0, spike_start_s=0.0, spike_end_s=1.0
+        ).validate()
+    with pytest.raises(ValueError, match="wobble"):
+        RateProfile(kind="wobble").validate()
+
+
 def test_chain_decline_log_names_flags(caplog):
     """The chain fast path's certificate fallback tells the user which
     scan flavor ran (flag names in the log record)."""

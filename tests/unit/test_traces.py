@@ -1,0 +1,194 @@
+"""TraceSpec validation, paging math, synthesizers, and model wiring
+(ISSUE 18 tentpole surface)."""
+
+import numpy as np
+import pytest
+
+from happysim_tpu.tpu.model import EnsembleModel
+from happysim_tpu.tpu.traces import (
+    DEFAULT_CHUNK_LEN,
+    TraceSpec,
+    diurnal_trace,
+    flash_crowd_trace,
+    zipf_tenant_trace,
+)
+
+
+def _spec(times, **kwargs):
+    kwargs.setdefault("tenants", None)
+    return TraceSpec(times=np.asarray(times, np.float32), **kwargs)
+
+
+class TestTraceSpecValidation:
+    def test_accepts_sane_trace(self):
+        _spec([0.0, 0.5, 0.5, 2.0], chunk_len=2).validate()
+
+    def test_rejects_empty(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            _spec([]).validate()
+
+    def test_rejects_non_finite(self):
+        with pytest.raises(ValueError, match="finite"):
+            _spec([0.0, np.inf]).validate()
+
+    def test_rejects_negative_start(self):
+        with pytest.raises(ValueError, match=">= 0"):
+            _spec([-1.0, 0.5]).validate()
+
+    def test_rejects_decreasing_with_index(self):
+        with pytest.raises(ValueError, match=r"times\[2\] < times\[1\]"):
+            _spec([0.0, 1.0, 0.5]).validate()
+
+    def test_rejects_tenant_shape_mismatch(self):
+        spec = _spec([0.0, 1.0])
+        spec.tenants = np.zeros(3, np.int32)
+        with pytest.raises(ValueError, match="shape"):
+            spec.validate()
+
+    def test_rejects_tenant_out_of_range(self):
+        spec = TraceSpec(
+            times=np.asarray([0.0, 1.0], np.float32),
+            tenants=np.asarray([0, 5], np.int32),
+            n_tenants=2,
+        )
+        with pytest.raises(ValueError, match=r"\[0, 2\)"):
+            spec.validate()
+
+    def test_rejects_bad_chunk_len(self):
+        with pytest.raises(ValueError, match="chunk_len"):
+            _spec([0.0], chunk_len=0).validate()
+
+
+class TestPagingMath:
+    def test_page_count_rounds_up(self):
+        spec = _spec(np.linspace(0, 1, 10), chunk_len=4)
+        assert spec.n_arrivals == 10
+        assert spec.n_chunks == 3
+
+    def test_padding_is_inf_and_zero(self):
+        spec = _spec([0.0, 1.0, 2.0], chunk_len=4)
+        times = spec.padded_times()
+        tenants = spec.padded_tenants()
+        assert times.shape == (4,) and tenants.shape == (4,)
+        assert times.dtype == np.float32 and tenants.dtype == np.int32
+        np.testing.assert_array_equal(times[:3], [0.0, 1.0, 2.0])
+        assert np.isinf(times[3]) and tenants[3] == 0
+
+    def test_default_chunk_len_covers_default_macro(self):
+        # The engine validates chunk_len >= macro_block at run time; the
+        # DEFAULT must clear the default RNG_CHUNK comfortably.
+        from happysim_tpu.tpu.engine import RNG_CHUNK
+
+        assert DEFAULT_CHUNK_LEN >= RNG_CHUNK
+
+
+class TestSignature:
+    def test_signature_is_stable_and_content_sensitive(self):
+        a = _spec([0.0, 1.0], chunk_len=8)
+        b = _spec([0.0, 1.0], chunk_len=8)
+        assert a.signature() == b.signature()
+        assert a.signature() != _spec([0.0, 1.5], chunk_len=8).signature()
+        assert a.signature() != _spec([0.0, 1.0], chunk_len=4).signature()
+
+    def test_fingerprint_carries_the_trace(self):
+        from happysim_tpu.tpu.engine import model_fingerprint
+
+        def build(times):
+            model = EnsembleModel(horizon_s=2.0)
+            src = model.trace_arrivals(_spec(times, chunk_len=8))
+            srv = model.server(service_mean=0.1)
+            snk = model.sink()
+            model.connect(src, srv)
+            model.connect(srv, snk)
+            return model
+
+        assert model_fingerprint(build([0.0, 1.0])) == model_fingerprint(
+            build([0.0, 1.0])
+        )
+        assert model_fingerprint(build([0.0, 1.0])) != model_fingerprint(
+            build([0.0, 1.5])
+        )
+
+
+class TestSynthesizers:
+    def test_same_seed_same_trace(self):
+        a = diurnal_trace(50.0, 0.5, 10.0, 20.0, seed=7)
+        b = diurnal_trace(50.0, 0.5, 10.0, 20.0, seed=7)
+        np.testing.assert_array_equal(a.times, b.times)
+        assert a.signature() == b.signature()
+        assert a.times.size != diurnal_trace(50.0, 0.5, 10.0, 20.0, seed=8).times.size or not np.array_equal(
+            a.times, diurnal_trace(50.0, 0.5, 10.0, 20.0, seed=8).times
+        )
+
+    def test_diurnal_rate_modulation(self):
+        # amplitude 1.0: the rate dips to ~0 in the trough half-period.
+        trace = diurnal_trace(200.0, 1.0, 10.0, 10.0, seed=1)
+        trace.validate()
+        peak = np.sum((trace.times >= 1.5) & (trace.times < 3.5))
+        trough = np.sum((trace.times >= 6.5) & (trace.times < 8.5))
+        assert peak > 4 * max(trough, 1)
+        with pytest.raises(ValueError, match="amplitude"):
+            diurnal_trace(200.0, 1.5, 10.0, 10.0)
+
+    def test_flash_crowd_burst(self):
+        trace = flash_crowd_trace(20.0, 400.0, 2.0, 3.0, 6.0, seed=2)
+        trace.validate()
+        in_spike = np.sum((trace.times >= 2.0) & (trace.times < 3.0))
+        before = np.sum(trace.times < 2.0)
+        assert in_spike > 4 * before
+        with pytest.raises(ValueError, match="spike_rate"):
+            flash_crowd_trace(20.0, 10.0, 2.0, 3.0, 6.0)
+        with pytest.raises(ValueError, match="spike_start_s"):
+            flash_crowd_trace(20.0, 40.0, 3.0, 2.0, 6.0)
+
+    def test_zipf_tenant_skew(self):
+        trace = zipf_tenant_trace(100.0, 4, 1.5, 30.0, seed=3)
+        trace.validate()
+        counts = np.bincount(trace.tenants, minlength=4)
+        assert counts[0] > counts[1] > counts[3]
+        assert trace.n_tenants == 4
+
+    def test_synthesizer_kind_and_params_recorded(self):
+        trace = flash_crowd_trace(20.0, 40.0, 1.0, 2.0, 4.0, seed=9)
+        assert trace.kind == "flash_crowd"
+        assert trace.params == (20.0, 40.0, 1.0, 2.0, 4.0, 9)
+
+
+class TestModelWiring:
+    def _traced(self, **kwargs):
+        model = EnsembleModel(horizon_s=2.0)
+        src = model.trace_arrivals(_spec([0.1, 0.5, 1.2], chunk_len=8), **kwargs)
+        srv = model.server(service_mean=0.1)
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        return model
+
+    def test_trace_arrivals_requires_a_trace_spec(self):
+        model = EnsembleModel(horizon_s=2.0)
+        with pytest.raises(TypeError, match="TraceSpec"):
+            model.trace_arrivals([0.1, 0.5])
+
+    def test_traced_source_index_and_chaos_feature(self):
+        model = self._traced()
+        assert model.traced_source_index() == 0
+        assert "trace_arrivals" in model.chaos_features()
+        model.validate()
+
+    def test_at_most_one_traced_source(self):
+        model = self._traced()
+        model.trace_arrivals(_spec([0.2], chunk_len=8))
+        with pytest.raises(ValueError, match="at most one traced source"):
+            model.validate()
+
+    def test_chunk_len_smaller_than_macro_block_raises(self):
+        from happysim_tpu.tpu import run_ensemble
+
+        model = EnsembleModel(horizon_s=2.0, macro_block=16)
+        src = model.trace_arrivals(_spec([0.1, 0.5], chunk_len=4))
+        srv = model.server(service_mean=0.1)
+        snk = model.sink()
+        model.connect(src, srv)
+        model.connect(srv, snk)
+        with pytest.raises(ValueError, match="chunk_len=4"):
+            run_ensemble(model, n_replicas=2, seed=0, max_events=32)
